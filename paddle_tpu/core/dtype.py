@@ -1,0 +1,92 @@
+"""Dtype registry.
+
+Analogue of the reference's VarType dtype enum
+(/root/reference/paddle/fluid/framework/framework.proto:104-135) and
+platform/float16.h. On TPU the canonical compute dtype is bfloat16 (MXU
+native); fp16 is retained for API parity. Dtypes are plain jnp dtypes plus
+string aliases, with promotion rules delegated to jax.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_ALIASES = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "fp16": float16,
+    "half": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float": float32,
+    "float64": float64,
+    "fp64": float64,
+    "double": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+DTypeLike = Union[str, type, np.dtype, Any]
+
+
+def convert_dtype(dtype: DTypeLike):
+    """Normalize any dtype spec to a numpy/jnp dtype object."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key not in _ALIASES:
+            raise ValueError(f"unknown dtype '{dtype}'")
+        return jnp.dtype(_ALIASES[key])
+    return jnp.dtype(dtype)
+
+
+def is_floating(dtype: DTypeLike) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype: DTypeLike) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), jnp.integer)
+
+
+def is_complex(dtype: DTypeLike) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), jnp.complexfloating)
+
+
+# Default dtype management (ref: fluid get_default_dtype/set_default_dtype)
+_default_dtype = jnp.float32
+
+
+def set_default_dtype(dtype: DTypeLike) -> None:
+    global _default_dtype
+    d = convert_dtype(dtype)
+    if not jnp.issubdtype(d, jnp.floating):
+        raise ValueError("default dtype must be floating point")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype

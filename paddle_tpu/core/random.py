@@ -1,0 +1,105 @@
+"""RNG state management.
+
+Analogue of the reference's Generator (/root/reference/paddle/fluid/
+framework/generator.cc — global per-device RNG state) redesigned for JAX's
+functional, key-based PRNG:
+
+- Eager mode keeps a global stateful :class:`Generator` whose ``split()``
+  advances an internal key — matching the reference's "global seed" UX.
+- Under ``jit`` tracing, stateful splitting would bake one fixed key into the
+  compiled program. Traced code must instead draw keys from a *bound stream*
+  (:func:`rng_scope`), which the Layer/executor machinery seeds per step with
+  a key threaded through the step's functional state. ``split()`` inside a
+  scope folds a trace-time counter into the bound key, so every dropout call
+  site gets a distinct, step-varying key without retracing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, Iterator, Optional
+
+import jax
+
+
+class Generator:
+    """Stateful PRNG-key source for eager mode."""
+
+    def __init__(self, seed: int = 0) -> None:
+        self._seed = seed
+        self._key = jax.random.key(seed)
+        self._lock = threading.Lock()
+
+    def manual_seed(self, seed: int) -> "Generator":
+        with self._lock:
+            self._seed = seed
+            self._key = jax.random.key(seed)
+        return self
+
+    @property
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def split(self) -> jax.Array:
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+
+_default_generator = Generator(0)
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(value: int) -> Generator:
+    """Global seed — mirrors ``paddle.seed``."""
+    return _default_generator.manual_seed(value)
+
+
+class _RngStream:
+    """A bound key plus a trace-time call counter."""
+
+    def __init__(self, key: jax.Array) -> None:
+        self.key = key
+        self.count = 0
+
+    def next(self) -> jax.Array:
+        sub = jax.random.fold_in(self.key, self.count)
+        self.count += 1
+        return sub
+
+
+class _ScopeState(threading.local):
+    def __init__(self) -> None:
+        self.streams: Optional[Dict[str, _RngStream]] = None
+
+
+_scope = _ScopeState()
+
+
+@contextlib.contextmanager
+def rng_scope(**keys: jax.Array) -> Iterator[None]:
+    """Bind named key streams (e.g. ``dropout=key``) for traced code."""
+    prev = _scope.streams
+    _scope.streams = {name: _RngStream(k) for name, k in keys.items()}
+    try:
+        yield
+    finally:
+        _scope.streams = prev
+
+
+def next_key(stream: str = "default") -> jax.Array:
+    """Draw the next key: from the bound scope if present, else eagerly."""
+    if _scope.streams is not None:
+        if stream in _scope.streams:
+            return _scope.streams[stream].next()
+        if "default" in _scope.streams:
+            return _scope.streams["default"].next()
+    return _default_generator.split()
+
+
+def in_rng_scope() -> bool:
+    return _scope.streams is not None

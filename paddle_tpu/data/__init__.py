@@ -1,0 +1,269 @@
+"""Data pipeline.
+
+TPU-native redesign of the reference's reader stack
+(/root/reference/python/paddle/fluid/reader.py:123 DataLoader,
+python/paddle/fluid/dataloader/dataloader_iter.py:237,335 worker processes,
+and the C++ BufferedReader async device prefetch
+paddle/fluid/operators/reader/buffered_reader.h:46). v1 is a threaded
+Python pipeline with device prefetch; the C++ industrial pipeline
+(data_feed/Dataset parity) lands in csrc/ and plugs in behind the same
+DataLoader API.
+
+Key TPU-specific piece: :class:`DeviceLoader` overlaps host batch prep with
+device compute by keeping ``buffer_size`` batches in flight via
+jax.device_put (the BufferedReader.ReadAsync role).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+class Dataset:
+    """Map-style dataset (ref: dataloader/dataset.py)."""
+
+    def __getitem__(self, idx: int):
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+
+class IterableDataset:
+    def __iter__(self):
+        raise NotImplementedError
+
+
+class TensorDataset(Dataset):
+    def __init__(self, *arrays) -> None:
+        self.arrays = [np.asarray(a) for a in arrays]
+
+    def __getitem__(self, idx: int):
+        return tuple(a[idx] for a in self.arrays)
+
+    def __len__(self) -> int:
+        return len(self.arrays[0])
+
+
+class Sampler:
+    def __init__(self, data_source=None) -> None:
+        self.data_source = data_source
+
+    def __iter__(self) -> Iterator[int]:
+        raise NotImplementedError
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement: bool = False,
+                 seed: Optional[int] = None) -> None:
+        super().__init__(data_source)
+        self.replacement = replacement
+        self.rng = np.random.default_rng(seed)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        if self.replacement:
+            return iter(self.rng.integers(0, n, size=n).tolist())
+        return iter(self.rng.permutation(n).tolist())
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class DistributedBatchSampler(Sampler):
+    """(ref: dataloader/batch_sampler.py DistributedBatchSampler) shards
+    batches across data-parallel ranks."""
+
+    def __init__(self, dataset, batch_size: int, num_replicas: int = 1,
+                 rank: int = 0, shuffle: bool = False,
+                 drop_last: bool = False, seed: int = 0) -> None:
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.seed = seed
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __iter__(self):
+        n = len(self.dataset)
+        idx = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            idx = rng.permutation(n)
+        # pad so each replica sees the same number of samples
+        per_replica = int(np.ceil(n / self.num_replicas))
+        padded = np.concatenate([idx, idx[:per_replica * self.num_replicas
+                                          - n]])
+        local = padded[self.rank::self.num_replicas]
+        batches = [local[i:i + self.batch_size].tolist()
+                   for i in range(0, len(local), self.batch_size)]
+        if self.drop_last and batches and \
+                len(batches[-1]) < self.batch_size:
+            batches.pop()
+        return iter(batches)
+
+    def __len__(self):
+        per_replica = int(np.ceil(len(self.dataset) / self.num_replicas))
+        if self.drop_last:
+            return per_replica // self.batch_size
+        return int(np.ceil(per_replica / self.batch_size))
+
+
+class BatchSampler(Sampler):
+    def __init__(self, sampler=None, dataset=None, batch_size: int = 1,
+                 shuffle: bool = False, drop_last: bool = False) -> None:
+        if sampler is None:
+            sampler = RandomSampler(dataset) if shuffle \
+                else SequenceSampler(dataset)
+        self.sampler = sampler
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        batch: List[int] = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+def default_collate_fn(batch: Sequence[Any]):
+    """Stack samples into a batch (ref: dataloader collate)."""
+    first = batch[0]
+    if isinstance(first, (tuple, list)):
+        return tuple(default_collate_fn([b[i] for b in batch])
+                     for i in range(len(first)))
+    if isinstance(first, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in first}
+    return np.stack([np.asarray(b) for b in batch])
+
+
+class DataLoader:
+    """(ref: reader.py:123). Threaded prefetch; worker parsing runs in a
+    thread pool (numpy releases the GIL for the heavy stacking)."""
+
+    def __init__(self, dataset, batch_size: int = 1, shuffle: bool = False,
+                 drop_last: bool = False, collate_fn: Optional[Callable]
+                 = None, num_workers: int = 0, batch_sampler=None,
+                 prefetch_factor: int = 2, places=None,
+                 return_list: bool = True) -> None:
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(prefetch_factor, 1)
+        if batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        elif isinstance(dataset, IterableDataset):
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset=dataset, batch_size=batch_size, shuffle=shuffle,
+                drop_last=drop_last)
+
+    def _iter_batches(self):
+        if self.batch_sampler is None:
+            # iterable dataset: batch on the fly
+            it = iter(self.dataset)
+            while True:
+                batch = list(itertools.islice(it, self.batch_size))
+                if not batch:
+                    return
+                if len(batch) < self.batch_size and self.drop_last:
+                    return
+                yield self.collate_fn(batch)
+        else:
+            for indices in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self.num_workers <= 0:
+            yield from self._iter_batches()
+            return
+        q: "queue.Queue" = queue.Queue(
+            maxsize=self.num_workers * self.prefetch_factor)
+        stop = object()
+
+        def producer():
+            try:
+                for b in self._iter_batches():
+                    q.put(b)
+            finally:
+                q.put(stop)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is stop:
+                break
+            yield item
+
+    def __len__(self):
+        if self.batch_sampler is None:
+            raise TypeError("IterableDataset has no length")
+        return len(self.batch_sampler)
+
+
+class DeviceLoader:
+    """Async host→device prefetch (ref: buffered_reader.h:46 ReadAsync)."""
+
+    def __init__(self, loader: Iterable, buffer_size: int = 2,
+                 sharding=None) -> None:
+        self.loader = loader
+        self.buffer_size = buffer_size
+        self.sharding = sharding
+
+    def _put(self, batch):
+        if self.sharding is not None:
+            return jax.tree.map(
+                lambda x: jax.device_put(x, self.sharding), batch)
+        return jax.tree.map(jax.device_put, batch)
+
+    def __iter__(self):
+        it = iter(self.loader)
+        buf: List[Any] = []
+        try:
+            for _ in range(self.buffer_size):
+                buf.append(self._put(next(it)))
+        except StopIteration:
+            pass
+        while buf:
+            out = buf.pop(0)
+            try:
+                buf.append(self._put(next(it)))
+            except StopIteration:
+                pass
+            yield out
+
+    def __len__(self):
+        return len(self.loader)

@@ -1,0 +1,7 @@
+"""paddle.distributed analogue: collectives + fleet orchestration."""
+
+from ..parallel import (all_gather, all_reduce, barrier, broadcast,
+                        get_rank, get_world_size, init_parallel_env,
+                        new_group, reduce, scatter)
+from ..parallel.env import ParallelEnv
+from . import fleet

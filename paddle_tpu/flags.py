@@ -1,0 +1,148 @@
+"""Global flag registry.
+
+TPU-native analogue of the reference's gflags layer
+(/root/reference/paddle/fluid/platform/flags.cc:33-359 and
+pybind/global_value_getter_setter.cc): a typed, env-overridable registry of
+runtime flags, settable from Python via ``set_flags``/``get_flags``.
+
+Unlike the reference (where flags are C++ globals exported through pybind),
+flags here live in one Python-side registry and are consulted by the runtime
+pieces (executor, allocator-stats, nan checks, determinism) at trace/run time.
+Environment variables of the form ``FLAGS_<name>`` override defaults at import.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclass
+class _FlagSpec:
+    name: str
+    default: Any
+    type: type
+    help: str
+    on_change: Optional[Callable[[Any], None]] = None
+
+
+class FlagRegistry:
+    """Thread-safe typed flag registry with env-var overrides."""
+
+    def __init__(self) -> None:
+        self._specs: Dict[str, _FlagSpec] = {}
+        self._values: Dict[str, Any] = {}
+        self._lock = threading.RLock()
+
+    def define(self, name: str, default: Any, help: str = "",
+               on_change: Optional[Callable[[Any], None]] = None) -> None:
+        with self._lock:
+            if name in self._specs:
+                raise ValueError(f"flag '{name}' already defined")
+            spec = _FlagSpec(name, default, type(default), help, on_change)
+            self._specs[name] = spec
+            value = default
+            env = os.environ.get("FLAGS_" + name)
+            if env is not None:
+                value = self._parse(spec, env)
+            self._values[name] = value
+
+    @staticmethod
+    def _parse(spec: _FlagSpec, text: str) -> Any:
+        if spec.type is bool:
+            return text.strip().lower() in ("1", "true", "yes", "on")
+        if spec.type is int:
+            return int(text)
+        if spec.type is float:
+            return float(text)
+        return text
+
+    def set(self, name: str, value: Any) -> None:
+        with self._lock:
+            spec = self._specs.get(name)
+            if spec is None:
+                raise KeyError(f"unknown flag '{name}'")
+            if spec.type is not type(value):
+                if spec.type is float and isinstance(value, int):
+                    value = float(value)
+                elif isinstance(value, str):
+                    value = self._parse(spec, value)
+                else:
+                    raise TypeError(
+                        f"flag '{name}' expects {spec.type.__name__}, got "
+                        f"{type(value).__name__}")
+            self._values[name] = value
+            if spec.on_change is not None:
+                spec.on_change(value)
+
+    def get(self, name: str) -> Any:
+        with self._lock:
+            if name not in self._values:
+                raise KeyError(f"unknown flag '{name}'")
+            return self._values[name]
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._specs)
+
+    def describe(self, name: str) -> str:
+        with self._lock:
+            return self._specs[name].help
+
+
+GLOBAL_FLAGS = FlagRegistry()
+
+
+def define_flag(name: str, default: Any, help: str = "",
+                on_change: Optional[Callable[[Any], None]] = None) -> None:
+    GLOBAL_FLAGS.define(name, default, help, on_change)
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """Set multiple flags; mirrors ``fluid.set_flags``."""
+    for k, v in flags.items():
+        GLOBAL_FLAGS.set(k, v)
+
+
+def get_flags(names) -> Dict[str, Any]:
+    if isinstance(names, str):
+        names = [names]
+    return {n: GLOBAL_FLAGS.get(n) for n in names}
+
+
+# ---------------------------------------------------------------------------
+# Core runtime flags (analogues of reference flags.cc where meaningful on TPU)
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False,
+            "After each jitted step, scan outputs for NaN/Inf "
+            "(ref: FLAGS_check_nan_inf, platform/flags.cc:44).")
+define_flag("benchmark", False,
+            "Block on each step for accurate timing "
+            "(ref: FLAGS_benchmark, framework/operator.cc:1022).")
+define_flag("deterministic", False,
+            "Force deterministic XLA lowering choices "
+            "(ref: FLAGS_cudnn_deterministic, platform/flags.cc:98).")
+define_flag("allocator_strategy", "xla",
+            "Host staging allocator strategy (xla | arena).")
+define_flag("eager_delete_tensor_gb", 0.0,
+            "Retained-buffer GC threshold for host staging arena.")
+define_flag("matmul_precision", "default",
+            "jax matmul precision: default | float32 | tensorfloat32 | "
+            "highest. bf16 MXU passes use 'default'.")
+define_flag("use_pallas_kernels", True,
+            "Route hot ops (attention, layer_norm, adam) through Pallas "
+            "kernels when on TPU.")
+define_flag("profile_dir", "",
+            "If set, write xplane profiler traces under this directory.")
+define_flag("log_level", 0, "Framework VLOG level (0 = off).")
+define_flag("selected_devices", "",
+            "Comma-separated device ordinals to use (ref: "
+            "FLAGS_selected_gpus).")
+define_flag("io_threadpool_size", 4,
+            "Worker threads for the host data pipeline "
+            "(ref: FLAGS_io_threadpool_size).")
+define_flag("fuse_parameter_groups_size", 32 * 1024 * 1024,
+            "Gradient coalescing bucket size in bytes for DP fusion "
+            "(ref: FLAGS_fuse_parameter_groups_size).")

@@ -1,0 +1,57 @@
+"""Pallas custom kernels for hot ops.
+
+TPU-native replacement for the reference's hand-written CUDA kernels
+(/root/reference/paddle/fluid/operators/fused/: multihead_matmul_op.cu,
+fused_fc_elementwise_layernorm_op.cu; operators/math/bert_encoder_functor.cu;
+operators/optimizers/adam_op.h). Routing policy: each ``maybe_*`` entry point
+checks the ``use_pallas_kernels`` flag and the backend, and falls back to the
+pure-XLA composition in ops/ — so CPU tests and TPU production share one
+call site. Kernels themselves live in sibling modules (flash_attention,
+layer_norm, fused_adam).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ..flags import GLOBAL_FLAGS
+
+
+def _on_tpu() -> bool:
+    try:
+        platform = jax.default_backend()
+    except Exception:
+        return False
+    return platform in ("tpu", "axon")
+
+
+def pallas_enabled() -> bool:
+    return GLOBAL_FLAGS.get("use_pallas_kernels") and _on_tpu()
+
+
+def maybe_layer_norm(x, weight, bias, epsilon: float, begin_norm_axis: int):
+    from ..ops.nn_functional import layer_norm as ref_impl
+    if pallas_enabled() and begin_norm_axis == x.ndim - 1 and x.ndim >= 2:
+        try:
+            from .layer_norm import layer_norm_pallas
+            return layer_norm_pallas(x, weight, bias, epsilon)
+        except NotImplementedError:
+            pass
+    return ref_impl(x, weight, bias, epsilon, begin_norm_axis)
+
+
+def maybe_flash_attention(q, k, v, mask=None, scale: Optional[float] = None,
+                          causal: bool = False, dropout_p: float = 0.0,
+                          training: bool = False):
+    """q/k/v: [B, H, T, D]."""
+    from ..ops.attention import scaled_dot_product_attention as ref_impl
+    if pallas_enabled() and dropout_p == 0.0 and mask is None:
+        try:
+            from .flash_attention import flash_attention
+            return flash_attention(q, k, v, causal=causal, scale=scale)
+        except NotImplementedError:
+            pass
+    return ref_impl(q, k, v, mask=mask, scale=scale, causal=causal,
+                    dropout_p=dropout_p, training=training)

@@ -1,0 +1,121 @@
+"""Streaming metrics.
+
+Analogue of /root/reference/python/paddle/metric/metrics.py (Metric base,
+Accuracy, Precision, Recall, Auc) and the metric ops in
+operators/metrics/ (accuracy_op.cc, auc_op.cc). Per-batch compute is pure
+(ops/metrics_ops.py, jit-safe); accumulation is host-side Python state.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import metrics_ops as M
+
+
+class Metric:
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def update(self, *args) -> None:
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self) -> str:
+        return type(self).__name__.lower()
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,)) -> None:
+        self.topk = topk if isinstance(topk, (list, tuple)) else (topk,)
+        self.reset()
+
+    def reset(self) -> None:
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label):
+        return [M.accuracy(pred, label, k) for k in self.topk]
+
+    def update(self, correct) -> None:
+        batch = 1
+        for i, c in enumerate(correct if isinstance(correct, (list, tuple))
+                              else [correct]):
+            self.total[i] += float(c)
+            self.count[i] += batch
+
+    def accumulate(self):
+        acc = self.total / np.maximum(self.count, 1)
+        return acc[0] if len(self.topk) == 1 else list(acc)
+
+
+class Precision(Metric):
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self.tp = 0.0
+        self.fp = 0.0
+
+    def update(self, preds, labels) -> None:
+        p = (np.asarray(preds) > 0.5).astype(np.int32).reshape(-1)
+        l = np.asarray(labels).astype(np.int32).reshape(-1)
+        self.tp += float(np.sum((p == 1) & (l == 1)))
+        self.fp += float(np.sum((p == 1) & (l == 0)))
+
+    def accumulate(self) -> float:
+        denom = self.tp + self.fp
+        return self.tp / denom if denom > 0 else 0.0
+
+
+class Recall(Metric):
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self.tp = 0.0
+        self.fn = 0.0
+
+    def update(self, preds, labels) -> None:
+        p = (np.asarray(preds) > 0.5).astype(np.int32).reshape(-1)
+        l = np.asarray(labels).astype(np.int32).reshape(-1)
+        self.tp += float(np.sum((p == 1) & (l == 1)))
+        self.fn += float(np.sum((p == 0) & (l == 1)))
+
+    def accumulate(self) -> float:
+        denom = self.tp + self.fn
+        return self.tp / denom if denom > 0 else 0.0
+
+
+class Auc(Metric):
+    """(ref: auc_op.cc streaming histogram AUC)."""
+
+    def __init__(self, num_thresholds: int = 2048) -> None:
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self) -> None:
+        self.tp_buckets = np.zeros(self.num_thresholds)
+        self.fp_buckets = np.zeros(self.num_thresholds)
+
+    def update(self, preds, labels) -> None:
+        preds = jnp.asarray(preds)
+        pred_pos = preds[:, 1] if preds.ndim == 2 and preds.shape[1] == 2 \
+            else preds.reshape(-1)
+        tp, fp = M.auc_stats(pred_pos, jnp.asarray(labels),
+                             self.num_thresholds)
+        self.tp_buckets += np.asarray(tp)
+        self.fp_buckets += np.asarray(fp)
+
+    def accumulate(self) -> float:
+        return float(M.auc_from_stats(jnp.asarray(self.tp_buckets),
+                                      jnp.asarray(self.fp_buckets)))
+
+
+def accuracy(input, label, k: int = 1):
+    return M.accuracy(input, label, k)

@@ -1,0 +1,9 @@
+"""paddle.nn.functional analogue — re-export of the functional ops."""
+
+from ..ops.activation import *  # noqa: F401,F403
+from ..ops.attention import (multihead_matmul,  # noqa: F401
+                             scaled_dot_product_attention)
+from ..ops.loss import *  # noqa: F401,F403
+from ..ops.nn_functional import *  # noqa: F401,F403
+from ..ops.sequence import (sequence_mask, sequence_pool,  # noqa: F401
+                            sequence_softmax)

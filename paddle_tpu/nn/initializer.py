@@ -1,0 +1,193 @@
+"""Parameter initializers.
+
+TPU-native analogue of /root/reference/python/paddle/fluid/initializer.py
+(ConstantInitializer, UniformInitializer, NormalInitializer,
+TruncatedNormalInitializer, XavierInitializer :366, MSRAInitializer :516,
+BilinearInitializer, NumpyArrayInitializer). Each initializer is a callable
+``(key, shape, dtype) -> array`` built on jax.random — deterministic given
+the global seed, independent per parameter via key folding.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as _random
+from ..core.dtype import convert_dtype
+
+
+def _fans(shape: Sequence[int]):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels [out, in, *spatial] (OIHW)
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype="float32", key=None):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0) -> None:
+        self.value = value
+
+    def __call__(self, shape, dtype="float32", key=None):
+        return jnp.full(tuple(shape), self.value, convert_dtype(dtype))
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0) -> None:
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype="float32", key=None):
+        key = key if key is not None else _random.next_key("init")
+        return jax.random.uniform(key, tuple(shape), convert_dtype(dtype),
+                                  self.low, self.high)
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0) -> None:
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype="float32", key=None):
+        key = key if key is not None else _random.next_key("init")
+        return self.mean + self.std * jax.random.normal(
+            key, tuple(shape), convert_dtype(dtype))
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0) -> None:
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype="float32", key=None):
+        key = key if key is not None else _random.next_key("init")
+        return self.mean + self.std * jax.random.truncated_normal(
+            key, -2.0, 2.0, tuple(shape), convert_dtype(dtype))
+
+
+class XavierUniform(Initializer):
+    """(ref: initializer.py:366 XavierInitializer uniform branch)."""
+
+    def __init__(self, gain: float = 1.0) -> None:
+        self.gain = gain
+
+    def __call__(self, shape, dtype="float32", key=None):
+        key = key if key is not None else _random.next_key("init")
+        fan_in, fan_out = _fans(shape)
+        limit = self.gain * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, tuple(shape), convert_dtype(dtype),
+                                  -limit, limit)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, gain: float = 1.0) -> None:
+        self.gain = gain
+
+    def __call__(self, shape, dtype="float32", key=None):
+        key = key if key is not None else _random.next_key("init")
+        fan_in, fan_out = _fans(shape)
+        std = self.gain * math.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(key, tuple(shape),
+                                       convert_dtype(dtype))
+
+
+class KaimingUniform(Initializer):
+    """(ref: initializer.py:516 MSRAInitializer uniform branch)."""
+
+    def __init__(self, negative_slope: float = 0.0,
+                 nonlinearity: str = "relu", fan_mode: str = "fan_in") -> None:
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+        self.fan_mode = fan_mode
+
+    def _gain(self) -> float:
+        if self.nonlinearity == "relu":
+            return math.sqrt(2.0)
+        if self.nonlinearity == "leaky_relu":
+            return math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        if self.nonlinearity == "tanh":
+            return 5.0 / 3.0
+        return 1.0
+
+    def __call__(self, shape, dtype="float32", key=None):
+        key = key if key is not None else _random.next_key("init")
+        fan_in, fan_out = _fans(shape)
+        fan = fan_in if self.fan_mode == "fan_in" else fan_out
+        limit = self._gain() * math.sqrt(3.0 / fan)
+        return jax.random.uniform(key, tuple(shape), convert_dtype(dtype),
+                                  -limit, limit)
+
+
+class KaimingNormal(KaimingUniform):
+    def __call__(self, shape, dtype="float32", key=None):
+        key = key if key is not None else _random.next_key("init")
+        fan_in, fan_out = _fans(shape)
+        fan = fan_in if self.fan_mode == "fan_in" else fan_out
+        std = self._gain() / math.sqrt(fan)
+        return std * jax.random.normal(key, tuple(shape),
+                                       convert_dtype(dtype))
+
+
+class Bilinear(Initializer):
+    """(ref: initializer.py BilinearInitializer — for upsample deconv)."""
+
+    def __call__(self, shape, dtype="float32", key=None):
+        if len(shape) != 4:
+            raise ValueError("Bilinear init expects conv kernel rank 4")
+        out_c, in_c, kh, kw = shape
+        f_h = math.ceil(kh / 2.0)
+        f_w = math.ceil(kw / 2.0)
+        c_h = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        c_w = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        og = np.ogrid[:kh, :kw]
+        filt = (1 - abs(og[0] / f_h - c_h)) * (1 - abs(og[1] / f_w - c_w))
+        weight = np.zeros(shape, dtype=np.float32)
+        for i in range(min(out_c, in_c)):
+            weight[i, i] = filt
+        return jnp.asarray(weight, dtype=convert_dtype(dtype))
+
+
+class Assign(Initializer):
+    """(ref: NumpyArrayInitializer)."""
+
+    def __init__(self, value) -> None:
+        self.value = np.asarray(value)
+
+    def __call__(self, shape, dtype="float32", key=None):
+        if tuple(self.value.shape) != tuple(shape):
+            raise ValueError(
+                f"Assign init shape {self.value.shape} != {tuple(shape)}")
+        return jnp.asarray(self.value, dtype=convert_dtype(dtype))
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain: float = 1.0) -> None:
+        self.gain = gain
+
+    def __call__(self, shape, dtype="float32", key=None):
+        key = key if key is not None else _random.next_key("init")
+        return self.gain * jax.nn.initializers.orthogonal()(
+            key, tuple(shape), convert_dtype(dtype))
+
+
+def _resolve(init, default: Initializer) -> Initializer:
+    if init is None:
+        return default
+    if isinstance(init, Initializer):
+        return init
+    if isinstance(init, (int, float)):
+        return Constant(float(init))
+    if callable(init):
+        return init
+    raise TypeError(f"bad initializer {init!r}")

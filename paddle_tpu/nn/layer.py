@@ -1,0 +1,438 @@
+"""Layer: the module system.
+
+TPU-native redesign of the reference's dygraph Layer
+(/root/reference/python/paddle/fluid/dygraph/layers.py and
+paddle/fluid/imperative/layer.h): named parameters/buffers/sublayers with
+eager execution — but built so the SAME object compiles under jit:
+
+- Eagerly, a Layer holds concrete jax arrays and ``layer(x)`` dispatches ops
+  immediately (the imperative Tracer path, tracer.cc:46, is simply jax eager).
+- For compiled training, :meth:`state_dict` extracts the param/buffer pytree
+  and :func:`functional_call` temporarily binds a (possibly traced) state
+  into the layer tree, runs forward, and captures mutated buffers (BN
+  running stats) — giving a pure function XLA can compile and donate
+  buffers through. This replaces the reference's scope/variable mutation
+  model (framework/scope.h:46) with state threading.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..errors import InvalidArgumentError, NotFoundError
+
+
+class Parameter:
+    """Trainable leaf. Holds the array plus attributes the reference keeps
+    on framework.Parameter (framework.py:5018): trainable flag, name,
+    regularizer, and optimizer metadata hooks."""
+
+    __slots__ = ("value", "trainable", "name", "regularizer", "need_clip")
+
+    def __init__(self, value, trainable: bool = True,
+                 name: Optional[str] = None, regularizer=None,
+                 need_clip: bool = True) -> None:
+        self.value = jnp.asarray(value)
+        self.trainable = trainable
+        self.name = name
+        self.regularizer = regularizer
+        self.need_clip = need_clip
+
+    @property
+    def shape(self):
+        return self.value.shape
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def __repr__(self) -> str:
+        return (f"Parameter(shape={tuple(self.value.shape)}, "
+                f"dtype={self.value.dtype}, trainable={self.trainable})")
+
+
+class Layer:
+    """Base class for all layers."""
+
+    def __init__(self) -> None:
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        object.__setattr__(self, "_sub_layers", OrderedDict())
+        object.__setattr__(self, "training", True)
+        object.__setattr__(self, "_forward_pre_hooks", OrderedDict())
+        object.__setattr__(self, "_forward_post_hooks", OrderedDict())
+
+    # ------------------------------------------------------------------
+    # attribute plumbing
+    # ------------------------------------------------------------------
+    def __setattr__(self, name: str, value: Any) -> None:
+        params = self.__dict__.get("_parameters")
+        buffers = self.__dict__.get("_buffers")
+        subs = self.__dict__.get("_sub_layers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise InvalidArgumentError(
+                    "call Layer.__init__ before assigning parameters")
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            subs[name] = value
+            self.__dict__.pop(name, None)
+        elif params is not None and name in params:
+            # assigning an array to an existing parameter name updates it
+            params[name].value = jnp.asarray(value)
+        elif buffers is not None and name in buffers:
+            buffers[name] = jnp.asarray(value)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str) -> Any:
+        # only called when normal lookup fails
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                v = d[name]
+                return v.value if isinstance(v, Parameter) else v
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name: str) -> None:
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    # ------------------------------------------------------------------
+    # registration
+    # ------------------------------------------------------------------
+    def add_parameter(self, name: str, param: Optional[Parameter]) -> \
+            Optional[Parameter]:
+        if param is not None and not isinstance(param, Parameter):
+            param = Parameter(param)
+        if param is None:
+            self._parameters.pop(name, None)
+        else:
+            self._parameters[name] = param
+        return param
+
+    def register_buffer(self, name: str, value, persistable: bool = True):
+        self._buffers[name] = jnp.asarray(value) if value is not None \
+            else None
+        return self._buffers[name]
+
+    def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def get_parameter(self, name: str) -> Parameter:
+        obj: Layer = self
+        parts = name.split(".")
+        for p in parts[:-1]:
+            obj = obj._sub_layers[p]
+        if parts[-1] not in obj._parameters:
+            raise NotFoundError(f"parameter '{name}' not found")
+        return obj._parameters[parts[-1]]
+
+    # ------------------------------------------------------------------
+    # traversal
+    # ------------------------------------------------------------------
+    def named_sublayers(self, prefix: str = "", include_self: bool = False) \
+            -> Iterator[Tuple[str, "Layer"]]:
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield sub_prefix, sub
+            yield from sub.named_sublayers(prefix=sub_prefix)
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_parameters(self, prefix: str = "") \
+            -> Iterator[Tuple[str, Parameter]]:
+        for name, p in self._parameters.items():
+            yield (f"{prefix}.{name}" if prefix else name), p
+        for name, sub in self._sub_layers.items():
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from sub.named_parameters(prefix=sub_prefix)
+
+    def parameters(self) -> List[Parameter]:
+        return [p for _, p in self.named_parameters()]
+
+    def named_buffers(self, prefix: str = "") \
+            -> Iterator[Tuple[str, jax.Array]]:
+        for name, b in self._buffers.items():
+            yield (f"{prefix}.{name}" if prefix else name), b
+        for name, sub in self._sub_layers.items():
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from sub.named_buffers(prefix=sub_prefix)
+
+    def buffers(self) -> List[jax.Array]:
+        return [b for _, b in self.named_buffers()]
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # ------------------------------------------------------------------
+    # train / eval
+    # ------------------------------------------------------------------
+    def train(self) -> "Layer":
+        for layer in self.sublayers(include_self=True):
+            object.__setattr__(layer, "training", True)
+        return self
+
+    def eval(self) -> "Layer":
+        for layer in self.sublayers(include_self=True):
+            object.__setattr__(layer, "training", False)
+        return self
+
+    # ------------------------------------------------------------------
+    # state dict
+    # ------------------------------------------------------------------
+    def state_dict(self, include_buffers: bool = True,
+                   trainable_only: bool = False) -> Dict[str, jax.Array]:
+        out: Dict[str, jax.Array] = OrderedDict()
+        for name, p in self.named_parameters():
+            if trainable_only and not p.trainable:
+                continue
+            out[name] = p.value
+        if include_buffers:
+            for name, b in self.named_buffers():
+                if b is not None:
+                    out[name] = b
+        return out
+
+    def set_state_dict(self, state: Dict[str, Any],
+                       strict: bool = True) -> None:
+        own_params = dict(self.named_parameters())
+        own_buffers = self._named_buffer_slots()
+        for name, value in state.items():
+            if name in own_params:
+                own_params[name].value = jnp.asarray(value)
+            elif name in own_buffers:
+                layer, bname = own_buffers[name]
+                layer._buffers[bname] = jnp.asarray(value)
+            elif strict:
+                raise NotFoundError(f"state key '{name}' not found in layer")
+
+    load_dict = set_state_dict
+
+    def _named_buffer_slots(self) -> Dict[str, Tuple["Layer", str]]:
+        out: Dict[str, Tuple[Layer, str]] = {}
+
+        def walk(layer: "Layer", prefix: str) -> None:
+            for bname in layer._buffers:
+                out[f"{prefix}.{bname}" if prefix else bname] = (layer, bname)
+            for sname, sub in layer._sub_layers.items():
+                walk(sub, f"{prefix}.{sname}" if prefix else sname)
+
+        walk(self, "")
+        return out
+
+    # split state: params vs buffers — the functional step threads both
+    def param_dict(self, trainable_only: bool = True) -> Dict[str, jax.Array]:
+        return OrderedDict(
+            (n, p.value) for n, p in self.named_parameters()
+            if p.trainable or not trainable_only)
+
+    def buffer_dict(self) -> Dict[str, jax.Array]:
+        return OrderedDict((n, b) for n, b in self.named_buffers()
+                           if b is not None)
+
+    # ------------------------------------------------------------------
+    # functional binding (see module docstring)
+    # ------------------------------------------------------------------
+    @contextlib.contextmanager
+    def bind(self, params: Optional[Dict[str, Any]] = None,
+             buffers: Optional[Dict[str, Any]] = None):
+        """Temporarily substitute leaves; on exit, restore originals. The
+        yielded capture object exposes mutated buffers after the block."""
+        saved_params = {n: p.value for n, p in self.named_parameters()}
+        saved_buffers = {}
+        slots = self._named_buffer_slots()
+        for n, (layer, bname) in slots.items():
+            saved_buffers[n] = layer._buffers[bname]
+
+        capture = _BindCapture()
+        try:
+            if params:
+                own = dict(self.named_parameters())
+                for n, v in params.items():
+                    own[n].value = v
+            if buffers:
+                for n, v in buffers.items():
+                    layer, bname = slots[n]
+                    layer._buffers[bname] = v
+            yield capture
+            capture.buffers = OrderedDict(
+                (n, layer._buffers[bname])
+                for n, (layer, bname) in slots.items()
+                if layer._buffers[bname] is not None)
+        finally:
+            own = dict(self.named_parameters())
+            for n, v in saved_params.items():
+                own[n].value = v
+            for n, (layer, bname) in slots.items():
+                layer._buffers[bname] = saved_buffers[n]
+
+    # ------------------------------------------------------------------
+    # call
+    # ------------------------------------------------------------------
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, args)
+            if result is not None:
+                args = result if isinstance(result, tuple) else (result,)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, args, out)
+            if result is not None:
+                out = result
+        return out
+
+    def register_forward_pre_hook(self, hook) -> "HookRemoveHelper":
+        handle = HookRemoveHelper(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook) -> "HookRemoveHelper":
+        handle = HookRemoveHelper(self._forward_post_hooks)
+        self._forward_post_hooks[handle.id] = hook
+        return handle
+
+    # ------------------------------------------------------------------
+    # dtype conversion
+    # ------------------------------------------------------------------
+    def to(self, dtype=None) -> "Layer":
+        if dtype is not None:
+            from ..core.dtype import convert_dtype
+            dt = convert_dtype(dtype)
+            for p in self.parameters():
+                if jnp.issubdtype(p.value.dtype, jnp.floating):
+                    p.value = p.value.astype(dt)
+        return self
+
+    def astype(self, dtype) -> "Layer":
+        return self.to(dtype=dtype)
+
+    def __repr__(self) -> str:
+        lines = [type(self).__name__ + "("]
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).replace("\n", "\n  ")
+            lines.append(f"  ({name}): {sub_repr}")
+        lines.append(")")
+        return "\n".join(lines) if len(lines) > 2 else \
+            type(self).__name__ + "()"
+
+
+class _BindCapture:
+    def __init__(self) -> None:
+        self.buffers: Dict[str, jax.Array] = OrderedDict()
+
+
+class HookRemoveHelper:
+    _next_id = 0
+
+    def __init__(self, store: Dict) -> None:
+        self._store = store
+        self.id = HookRemoveHelper._next_id
+        HookRemoveHelper._next_id += 1
+
+    def remove(self) -> None:
+        self._store.pop(self.id, None)
+
+
+def functional_call(layer: Layer, params: Dict[str, Any],
+                    buffers: Optional[Dict[str, Any]], *args,
+                    capture_buffers: bool = False, **kwargs):
+    """Pure-function view of ``layer``: run forward with the given state.
+
+    Returns ``out`` or ``(out, new_buffers)`` when capture_buffers is set.
+    """
+    with layer.bind(params, buffers) as cap:
+        out = layer(*args, **kwargs)
+    if capture_buffers:
+        return out, cap.buffers
+    return out
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None) -> None:
+        super().__init__()
+        if sublayers is not None:
+            for i, l in enumerate(sublayers):
+                self.add_sublayer(str(i), l)
+
+    def append(self, sublayer: Layer) -> "LayerList":
+        self.add_sublayer(str(len(self._sub_layers)), sublayer)
+        return self
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return list(self._sub_layers.values())[idx]
+        if idx < 0:
+            idx += len(self._sub_layers)
+        return self._sub_layers[str(idx)]
+
+    def __len__(self) -> int:
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None) -> None:
+        super().__init__()
+        if parameters is not None:
+            for i, p in enumerate(parameters):
+                self.add_parameter(str(i), p)
+
+    def append(self, p) -> "ParameterList":
+        self.add_parameter(str(len(self._parameters)), p)
+        return self
+
+    def __getitem__(self, idx: int):
+        return self._parameters[str(idx)].value
+
+    def __len__(self) -> int:
+        return len(self._parameters)
+
+
+class Sequential(Layer):
+    def __init__(self, *layers) -> None:
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and \
+                layers[0] and isinstance(layers[0][0], (list, tuple)):
+            for name, layer in layers[0]:
+                self.add_sublayer(name, layer)
+        else:
+            for i, layer in enumerate(layers):
+                self.add_sublayer(str(i), layer)
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+    def __getitem__(self, idx: int) -> Layer:
+        return list(self._sub_layers.values())[idx]
+
+    def __len__(self) -> int:
+        return len(self._sub_layers)

@@ -1,0 +1,232 @@
+"""Common layers: Linear, Embedding, Dropout, activations-as-layers, Flatten.
+
+TPU-native layer wrappers over ops/ (reference:
+python/paddle/fluid/dygraph/nn.py Linear/Embedding/Dropout and
+python/paddle/nn/layer/common.py). Each stores Parameters and calls the
+functional op, so the same code runs eagerly and under jit via Layer.bind.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from ...core.dtype import get_default_dtype
+from ...ops import activation as A
+from ...ops import nn_functional as F
+from .. import initializer as I
+from ..layer import Layer, Parameter
+
+
+class Linear(Layer):
+    """y = x W + b with W [in, out] (reference fc convention)."""
+
+    def __init__(self, in_features: int, out_features: int,
+                 weight_attr=None, bias_attr=None, name=None) -> None:
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        w_init = I._resolve(weight_attr, I.XavierUniform())
+        self.weight = Parameter(
+            w_init((in_features, out_features), get_default_dtype()))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            b_init = I._resolve(bias_attr, I.Constant(0.0))
+            self.bias = Parameter(b_init((out_features,),
+                                         get_default_dtype()))
+
+    def forward(self, x):
+        return F.linear(x, self.weight,
+                        self.bias if "bias" in self._parameters else None)
+
+
+class Embedding(Layer):
+    """(ref: lookup_table_v2_op.cc; dygraph/nn.py Embedding)."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 padding_idx: Optional[int] = None, sparse: bool = False,
+                 weight_attr=None, name=None) -> None:
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.padding_idx = padding_idx
+        self.sparse = sparse
+        w_init = I._resolve(weight_attr, I.XavierNormal())
+        self.weight = Parameter(
+            w_init((num_embeddings, embedding_dim), get_default_dtype()))
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, self.padding_idx)
+
+
+class Dropout(Layer):
+    def __init__(self, p: float = 0.5,
+                 mode: str = "upscale_in_train") -> None:
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x):
+        return F.dropout(x, self.p, training=self.training, mode=self.mode)
+
+
+class Dropout2D(Layer):
+    def __init__(self, p: float = 0.5) -> None:
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.dropout2d(x, self.p, training=self.training)
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p: float = 0.5) -> None:
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.alpha_dropout(x, self.p, training=self.training)
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis: int = 1, stop_axis: int = -1) -> None:
+        super().__init__()
+        self.start_axis = start_axis
+        self.stop_axis = stop_axis
+
+    def forward(self, x):
+        from ...ops.manipulation import flatten
+        return flatten(x, self.start_axis, self.stop_axis)
+
+
+class Identity(Layer):
+    def forward(self, x):
+        return x
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode: str = "nearest",
+                 align_corners: bool = False) -> None:
+        super().__init__()
+        self.size = size
+        self.scale_factor = scale_factor
+        self.mode = mode
+        self.align_corners = align_corners
+
+    def forward(self, x):
+        return F.interpolate(x, self.size, self.scale_factor, self.mode,
+                             self.align_corners)
+
+
+class Pad2D(Layer):
+    def __init__(self, padding, mode: str = "constant",
+                 value: float = 0.0, data_format: str = "NCHW") -> None:
+        super().__init__()
+        self.padding = padding
+        self.mode = mode
+        self.value = value
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad2d(x, self.padding, self.mode, self.value,
+                       self.data_format)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis: int = 1, eps: float = 1e-8) -> None:
+        super().__init__()
+        self.axis = axis
+        self.eps = eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, self.axis, self.eps)
+
+
+class Bilinear(Layer):
+    """(ref: bilinear_tensor_product_op.cc)."""
+
+    def __init__(self, in1_features: int, in2_features: int,
+                 out_features: int, weight_attr=None,
+                 bias_attr=None) -> None:
+        super().__init__()
+        w_init = I._resolve(weight_attr, I.XavierUniform())
+        self.weight = Parameter(w_init(
+            (out_features, in1_features, in2_features), get_default_dtype()))
+        if bias_attr is False:
+            pass
+        else:
+            b_init = I._resolve(bias_attr, I.Constant(0.0))
+            self.bias = Parameter(b_init((out_features,),
+                                         get_default_dtype()))
+
+    def forward(self, x1, x2):
+        from ...ops.math import bilinear_tensor_product
+        bias = self.bias if "bias" in self._parameters else None
+        return bilinear_tensor_product(x1, x2, self.weight, bias)
+
+
+def _activation_layer(fn_name: str, **defaults):
+    fn = getattr(A, fn_name)
+
+    class _Act(Layer):
+        def __init__(self, **kwargs) -> None:
+            super().__init__()
+            self.kwargs = {**defaults, **kwargs}
+
+        def forward(self, x):
+            return fn(x, **self.kwargs)
+
+    _Act.__name__ = "".join(s.capitalize() for s in fn_name.split("_"))
+    return _Act
+
+
+ReLU = _activation_layer("relu")
+ReLU6 = _activation_layer("relu6")
+LeakyReLU = _activation_layer("leaky_relu")
+ELU = _activation_layer("elu")
+SELU = _activation_layer("selu")
+CELU = _activation_layer("celu")
+GELU = _activation_layer("gelu")
+Sigmoid = _activation_layer("sigmoid")
+LogSigmoid = _activation_layer("logsigmoid")
+Hardsigmoid = _activation_layer("hard_sigmoid")
+Hardswish = _activation_layer("hard_swish")
+Hardshrink = _activation_layer("hard_shrink")
+Softshrink = _activation_layer("soft_shrink")
+Hardtanh = _activation_layer("hard_tanh")
+Tanh = _activation_layer("tanh")
+Tanhshrink = _activation_layer("tanh_shrink")
+Softplus = _activation_layer("softplus")
+Softsign = _activation_layer("softsign")
+Swish = _activation_layer("swish")
+Silu = _activation_layer("swish")
+Mish = _activation_layer("mish")
+ThresholdedReLU = _activation_layer("thresholded_relu")
+LogSoftmax = _activation_layer("log_softmax")
+Softmax = _activation_layer("softmax")
+GLU = _activation_layer("glu")
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters: int = 1, init: float = 0.25) -> None:
+        super().__init__()
+        self.weight = Parameter(jnp.full((num_parameters,), init,
+                                         get_default_dtype()))
+
+    def forward(self, x):
+        w = self.weight
+        if w.shape[0] > 1:
+            w = w.reshape((1, -1) + (1,) * (x.ndim - 2))
+        return A.prelu(x, w)
+
+
+class Maxout(Layer):
+    def __init__(self, groups: int, axis: int = 1) -> None:
+        super().__init__()
+        self.groups = groups
+        self.axis = axis
+
+    def forward(self, x):
+        return A.maxout(x, self.groups, self.axis)

@@ -1,0 +1,236 @@
+"""Convolution & pooling layers.
+
+TPU-native wrappers (reference: python/paddle/fluid/dygraph/nn.py Conv2D /
+Pool2D and python/paddle/nn/layer/conv.py, pooling.py; kernels in
+paddle/fluid/operators/conv_op.cc and pool_op.cc). Weight layout is OIHW to
+match the reference; XLA re-layouts for the MXU internally.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+from ...core.dtype import get_default_dtype
+from ...ops import nn_functional as F
+from .. import initializer as I
+from ..layer import Layer, Parameter
+
+IntOrPair = Union[int, Sequence[int]]
+
+
+def _pair(v, n=2):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _ConvNd(Layer):
+    def __init__(self, in_channels: int, out_channels: int,
+                 kernel_size: IntOrPair, stride: IntOrPair, padding,
+                 dilation: IntOrPair, groups: int, weight_attr, bias_attr,
+                 spatial: int, transpose: bool = False,
+                 output_padding: IntOrPair = 0,
+                 data_format: str = "NCHW") -> None:
+        super().__init__()
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = _pair(kernel_size, spatial)
+        self.stride = stride
+        self.padding = padding
+        self.output_padding = output_padding
+        self.dilation = dilation
+        self.groups = groups
+        self.data_format = data_format
+        w_init = I._resolve(weight_attr, I.KaimingUniform())
+        if transpose:
+            w_shape = (in_channels, out_channels // groups) \
+                + self.kernel_size
+        else:
+            w_shape = (out_channels, in_channels // groups) \
+                + self.kernel_size
+        self.weight = Parameter(w_init(w_shape, get_default_dtype()))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            b_init = I._resolve(bias_attr, I.Constant(0.0))
+            self.bias = Parameter(b_init((out_channels,),
+                                         get_default_dtype()))
+
+    def _bias(self):
+        return self.bias if "bias" in self._parameters else None
+
+
+class Conv1D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None, data_format="NCL") -> None:
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, weight_attr, bias_attr,
+                         spatial=1)
+
+    def forward(self, x):
+        k = self.kernel_size[0]
+        s = self.stride if isinstance(self.stride, int) else self.stride[0]
+        d = self.dilation if isinstance(self.dilation, int) \
+            else self.dilation[0]
+        p = self.padding if isinstance(self.padding, (int, str)) \
+            else self.padding[0]
+        return F.conv1d(x, self.weight, self._bias(), s, p, d, self.groups)
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None, data_format="NCHW") -> None:
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, weight_attr, bias_attr,
+                         spatial=2, data_format=data_format)
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self._bias(), self.stride,
+                        self.padding, self.dilation, self.groups,
+                        self.data_format)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None, data_format="NCDHW") -> None:
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, weight_attr, bias_attr,
+                         spatial=3, data_format=data_format)
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self._bias(), self.stride,
+                        self.padding, self.dilation, self.groups,
+                        self.data_format)
+
+
+class Conv2DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None,
+                 data_format="NCHW") -> None:
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, weight_attr, bias_attr,
+                         spatial=2, transpose=True,
+                         output_padding=output_padding)
+
+    def forward(self, x):
+        return F.conv2d_transpose(x, self.weight, self._bias(), self.stride,
+                                  self.padding, self.output_padding,
+                                  self.dilation, self.groups)
+
+
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode: bool = False) -> None:
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+
+    def forward(self, x):
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            self.ceil_mode)
+
+
+class AvgPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode: bool = False, exclusive: bool = True) -> None:
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+        self.exclusive = exclusive
+
+    def forward(self, x):
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            self.ceil_mode, self.exclusive)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode: bool = False) -> None:
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+
+    def forward(self, x):
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            self.ceil_mode)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode: bool = False) -> None:
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+
+    def forward(self, x):
+        return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            self.ceil_mode)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size) -> None:
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self.output_size)
+
+
+class AdaptiveMaxPool2D(Layer):
+    def __init__(self, output_size) -> None:
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self.output_size)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0,
+                 dilations=1) -> None:
+        super().__init__()
+        self.kernel_sizes = kernel_sizes
+        self.strides = strides
+        self.paddings = paddings
+        self.dilations = dilations
+
+    def forward(self, x):
+        return F.unfold(x, self.kernel_sizes, self.strides, self.paddings,
+                        self.dilations)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1) -> None:
+        super().__init__()
+        self.output_sizes = output_sizes
+        self.kernel_sizes = kernel_sizes
+        self.strides = strides
+        self.paddings = paddings
+        self.dilations = dilations
+
+    def forward(self, x):
+        return F.fold(x, self.output_sizes, self.kernel_sizes, self.strides,
+                      self.paddings, self.dilations)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor: int,
+                 data_format: str = "NCHW") -> None:
+        super().__init__()
+        self.upscale_factor = upscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        from ...ops.manipulation import pixel_shuffle
+        return pixel_shuffle(x, self.upscale_factor, self.data_format)

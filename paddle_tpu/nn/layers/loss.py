@@ -1,0 +1,150 @@
+"""Loss layers (class wrappers over ops.loss).
+
+Reference: python/paddle/nn/layer/loss.py (CrossEntropyLoss, MSELoss,
+L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss, KLDivLoss, SmoothL1Loss,
+MarginRankingLoss, CTCLoss).
+"""
+
+from __future__ import annotations
+
+from ...ops import loss as L
+from ..layer import Layer
+
+
+class CrossEntropyLoss(Layer):
+    def __init__(self, weight=None, ignore_index: int = -100,
+                 reduction: str = "mean", soft_label: bool = False,
+                 axis: int = -1, use_softmax: bool = True) -> None:
+        super().__init__()
+        self.weight = weight
+        self.ignore_index = ignore_index
+        self.reduction = reduction
+        self.soft_label = soft_label
+        self.axis = axis
+        self.use_softmax = use_softmax
+
+    def forward(self, input, label):
+        return L.cross_entropy(input, label, self.soft_label,
+                               self.ignore_index, self.reduction, self.axis,
+                               self.use_softmax, self.weight)
+
+
+class MSELoss(Layer):
+    def __init__(self, reduction: str = "mean") -> None:
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return L.mse_loss(input, label, self.reduction)
+
+
+class L1Loss(Layer):
+    def __init__(self, reduction: str = "mean") -> None:
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return L.l1_loss(input, label, self.reduction)
+
+
+class NLLLoss(Layer):
+    def __init__(self, weight=None, ignore_index: int = -100,
+                 reduction: str = "mean") -> None:
+        super().__init__()
+        self.weight = weight
+        self.ignore_index = ignore_index
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return L.nll_loss(input, label, self.weight, self.ignore_index,
+                          self.reduction)
+
+
+class BCELoss(Layer):
+    def __init__(self, weight=None, reduction: str = "mean") -> None:
+        super().__init__()
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return L.bce_loss(input, label, self.weight, self.reduction)
+
+
+class BCEWithLogitsLoss(Layer):
+    def __init__(self, weight=None, reduction: str = "mean",
+                 pos_weight=None) -> None:
+        super().__init__()
+        self.weight = weight
+        self.reduction = reduction
+        self.pos_weight = pos_weight
+
+    def forward(self, logit, label):
+        return L.binary_cross_entropy_with_logits(
+            logit, label, self.weight, self.pos_weight, self.reduction)
+
+
+class KLDivLoss(Layer):
+    def __init__(self, reduction: str = "mean") -> None:
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return L.kl_div(input, label, self.reduction)
+
+
+class SmoothL1Loss(Layer):
+    def __init__(self, reduction: str = "mean", delta: float = 1.0) -> None:
+        super().__init__()
+        self.reduction = reduction
+        self.delta = delta
+
+    def forward(self, input, label):
+        return L.smooth_l1_loss(input, label, self.delta, self.reduction)
+
+
+class MarginRankingLoss(Layer):
+    def __init__(self, margin: float = 0.0,
+                 reduction: str = "mean") -> None:
+        super().__init__()
+        self.margin = margin
+        self.reduction = reduction
+
+    def forward(self, input, other, label):
+        return L.margin_ranking_loss(input, other, label, self.margin,
+                                     self.reduction)
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank: int = 0, reduction: str = "mean") -> None:
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths):
+        return L.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          self.blank, self.reduction)
+
+
+class CosineEmbeddingLoss(Layer):
+    def __init__(self, margin: float = 0.0,
+                 reduction: str = "mean") -> None:
+        super().__init__()
+        self.margin = margin
+        self.reduction = reduction
+
+    def forward(self, input1, input2, label):
+        return L.cosine_embedding_loss(input1, input2, label, self.margin,
+                                       self.reduction)
+
+
+class TripletMarginLoss(Layer):
+    def __init__(self, margin: float = 1.0, p: float = 2.0,
+                 reduction: str = "mean") -> None:
+        super().__init__()
+        self.margin = margin
+        self.p = p
+        self.reduction = reduction
+
+    def forward(self, anchor, positive, negative):
+        return L.triplet_margin_loss(anchor, positive, negative,
+                                     self.margin, self.p, self.reduction)

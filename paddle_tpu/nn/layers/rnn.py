@@ -1,0 +1,234 @@
+"""Recurrent layers.
+
+TPU-native redesign of the reference's RNN stack (reference:
+paddle/fluid/operators/lstm_op.cc, gru_op.cc, cudnn_lstm_op.cu,
+rnn layers in python/paddle/fluid/layers/rnn.py). cuDNN's fused RNN has no
+TPU analogue; instead cells are expressed as matmul-heavy step functions and
+the time loop is ``lax.scan`` — XLA pipelines the per-step matmuls onto the
+MXU and the scan keeps compile time flat in sequence length.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dtype import get_default_dtype
+from .. import initializer as I
+from ..layer import Layer, Parameter
+
+
+class LSTMCell(Layer):
+    """(ref: lstm_unit_op.cc gate math: i,f,c,o with forget bias)."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None) -> None:
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        dt = get_default_dtype()
+        k = 1.0 / (hidden_size ** 0.5)
+        init = I.Uniform(-k, k)
+        self.weight_ih = Parameter(
+            I._resolve(weight_ih_attr, init)((input_size, 4 * hidden_size),
+                                             dt))
+        self.weight_hh = Parameter(
+            I._resolve(weight_hh_attr, init)((hidden_size, 4 * hidden_size),
+                                             dt))
+        self.bias_ih = Parameter(
+            I._resolve(bias_ih_attr, init)((4 * hidden_size,), dt))
+        self.bias_hh = Parameter(
+            I._resolve(bias_hh_attr, init)((4 * hidden_size,), dt))
+
+    def forward(self, x, states: Optional[Tuple] = None):
+        if states is None:
+            b = x.shape[0]
+            states = self.get_initial_states(b)
+        h, c = states
+        gates = x @ self.weight_ih + self.bias_ih \
+            + h @ self.weight_hh + self.bias_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        new_c = f * c + i * g
+        new_h = o * jnp.tanh(new_c)
+        return new_h, (new_h, new_c)
+
+    def get_initial_states(self, batch_size: int):
+        z = jnp.zeros((batch_size, self.hidden_size), get_default_dtype())
+        return (z, z)
+
+
+class GRUCell(Layer):
+    """(ref: gru_unit_op.cc)."""
+
+    def __init__(self, input_size: int, hidden_size: int) -> None:
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        dt = get_default_dtype()
+        k = 1.0 / (hidden_size ** 0.5)
+        init = I.Uniform(-k, k)
+        self.weight_ih = Parameter(init((input_size, 3 * hidden_size), dt))
+        self.weight_hh = Parameter(init((hidden_size, 3 * hidden_size), dt))
+        self.bias_ih = Parameter(init((3 * hidden_size,), dt))
+        self.bias_hh = Parameter(init((3 * hidden_size,), dt))
+
+    def forward(self, x, states=None):
+        if states is None:
+            states = self.get_initial_states(x.shape[0])
+        h = states
+        x_g = x @ self.weight_ih + self.bias_ih
+        h_g = h @ self.weight_hh + self.bias_hh
+        xr, xz, xn = jnp.split(x_g, 3, axis=-1)
+        hr, hz, hn = jnp.split(h_g, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        new_h = (1.0 - z) * n + z * h
+        return new_h, new_h
+
+    def get_initial_states(self, batch_size: int):
+        return jnp.zeros((batch_size, self.hidden_size), get_default_dtype())
+
+
+class SimpleRNNCell(Layer):
+    def __init__(self, input_size: int, hidden_size: int,
+                 activation: str = "tanh") -> None:
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        dt = get_default_dtype()
+        k = 1.0 / (hidden_size ** 0.5)
+        init = I.Uniform(-k, k)
+        self.weight_ih = Parameter(init((input_size, hidden_size), dt))
+        self.weight_hh = Parameter(init((hidden_size, hidden_size), dt))
+        self.bias_ih = Parameter(init((hidden_size,), dt))
+        self.bias_hh = Parameter(init((hidden_size,), dt))
+
+    def forward(self, x, states=None):
+        if states is None:
+            states = self.get_initial_states(x.shape[0])
+        h = states
+        pre = x @ self.weight_ih + self.bias_ih \
+            + h @ self.weight_hh + self.bias_hh
+        new_h = jnp.tanh(pre) if self.activation == "tanh" \
+            else jax.nn.relu(pre)
+        return new_h, new_h
+
+    def get_initial_states(self, batch_size: int):
+        return jnp.zeros((batch_size, self.hidden_size), get_default_dtype())
+
+
+class RNN(Layer):
+    """Run a cell over time with lax.scan (ref: layers/rnn.py RNN)."""
+
+    def __init__(self, cell: Layer, is_reverse: bool = False,
+                 time_major: bool = False) -> None:
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None,
+                sequence_length=None):
+        xs = inputs if self.time_major else jnp.swapaxes(inputs, 0, 1)
+        if self.is_reverse:
+            xs = jnp.flip(xs, axis=0)
+        batch = xs.shape[1]
+        if initial_states is None:
+            initial_states = self.cell.get_initial_states(batch)
+
+        cell = self.cell
+
+        def step(states, x_t):
+            out_t, new_states = cell(x_t, states)
+            return new_states, out_t
+
+        final, outs = lax.scan(step, initial_states, xs)
+        if self.is_reverse:
+            outs = jnp.flip(outs, axis=0)
+        if not self.time_major:
+            outs = jnp.swapaxes(outs, 0, 1)
+        return outs, final
+
+
+class _StackedRNNBase(Layer):
+    _cell_cls = None
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 num_layers: int = 1, direction: str = "forward",
+                 dropout: float = 0.0, time_major: bool = False) -> None:
+        super().__init__()
+        self.num_layers = num_layers
+        self.direction = direction
+        self.dropout = dropout
+        self.time_major = time_major
+        self.hidden_size = hidden_size
+        bidirect = direction in ("bidirect", "bidirectional")
+        self.bidirect = bidirect
+        from ..layer import LayerList
+        self.fw = LayerList()
+        self.bw = LayerList() if bidirect else None
+        for i in range(num_layers):
+            in_size = input_size if i == 0 else \
+                hidden_size * (2 if bidirect else 1)
+            self.fw.append(RNN(self._make_cell(in_size, hidden_size)))
+            if bidirect:
+                self.bw.append(RNN(self._make_cell(in_size, hidden_size),
+                                   is_reverse=True))
+
+    def _make_cell(self, in_size, hidden):
+        raise NotImplementedError
+
+    def forward(self, inputs, initial_states=None):
+        x = inputs if not self.time_major else jnp.swapaxes(inputs, 0, 1)
+        finals_f = []
+        finals_b = []
+        from ...ops.nn_functional import dropout as dropout_fn
+        for i in range(self.num_layers):
+            out_f, fin_f = self.fw[i](x)
+            finals_f.append(fin_f)
+            if self.bidirect:
+                out_b, fin_b = self.bw[i](x)
+                finals_b.append(fin_b)
+                x = jnp.concatenate([out_f, out_b], axis=-1)
+            else:
+                x = out_f
+            if self.dropout > 0 and i < self.num_layers - 1:
+                x = dropout_fn(x, self.dropout, training=self.training)
+        if self.time_major:
+            x = jnp.swapaxes(x, 0, 1)
+        finals = finals_f + finals_b
+        return x, self._merge_finals(finals)
+
+    def _merge_finals(self, finals):
+        if isinstance(finals[0], tuple):
+            hs = jnp.stack([f[0] for f in finals], axis=0)
+            cs = jnp.stack([f[1] for f in finals], axis=0)
+            return (hs, cs)
+        return jnp.stack(finals, axis=0)
+
+
+class LSTM(_StackedRNNBase):
+    """(ref: cudnn_lstm_op.cu capability)."""
+
+    def _make_cell(self, in_size, hidden):
+        return LSTMCell(in_size, hidden)
+
+
+class GRU(_StackedRNNBase):
+    def _make_cell(self, in_size, hidden):
+        return GRUCell(in_size, hidden)
+
+
+class SimpleRNN(_StackedRNNBase):
+    def _make_cell(self, in_size, hidden):
+        return SimpleRNNCell(in_size, hidden)

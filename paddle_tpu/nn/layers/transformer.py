@@ -1,0 +1,209 @@
+"""Transformer layers.
+
+TPU-native transformer stack. The reference's transformer support is
+op-level fusions (fused/multihead_matmul_op.cu,
+fused_embedding_eltwise_layernorm_op.cu, ir skip_layernorm_fuse_pass) used
+by its BERT/ERNIE models; here the same capability is a first-class layer
+family whose attention core routes through kernels.maybe_flash_attention
+(Pallas on TPU). Shapes are [batch, seq, hidden] throughout; bf16-friendly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ...core.dtype import get_default_dtype
+from ...ops import activation as A
+from ...ops import nn_functional as F
+from .. import initializer as I
+from ..layer import Layer, LayerList, Parameter
+from .common import Dropout, Linear
+from .norm import LayerNorm
+
+
+class MultiHeadAttention(Layer):
+    """(capability ref: multihead_matmul_op.cu fused attention)."""
+
+    def __init__(self, embed_dim: int, num_heads: int,
+                 dropout: float = 0.0, kdim: Optional[int] = None,
+                 vdim: Optional[int] = None, need_weights: bool = False,
+                 weight_attr=None, bias_attr=None) -> None:
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout = dropout
+        self.need_weights = need_weights
+        kdim = kdim or embed_dim
+        vdim = vdim or embed_dim
+        self.q_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+        self.k_proj = Linear(kdim, embed_dim, weight_attr, bias_attr)
+        self.v_proj = Linear(vdim, embed_dim, weight_attr, bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+
+    def _split(self, x):
+        b, t, _ = x.shape
+        return jnp.moveaxis(
+            x.reshape(b, t, self.num_heads, self.head_dim), 2, 1)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                causal: bool = False):
+        key = query if key is None else key
+        value = key if value is None else value
+        q = self._split(self.q_proj(query))
+        k = self._split(self.k_proj(key))
+        v = self._split(self.v_proj(value))
+        from ...kernels import maybe_flash_attention
+        out = maybe_flash_attention(
+            q, k, v, mask=attn_mask, causal=causal,
+            dropout_p=self.dropout, training=self.training)
+        b, h, t, d = out.shape
+        out = jnp.moveaxis(out, 1, 2).reshape(b, t, h * d)
+        return self.out_proj(out)
+
+
+class TransformerEncoderLayer(Layer):
+    def __init__(self, d_model: int, nhead: int, dim_feedforward: int,
+                 dropout: float = 0.1, activation: str = "relu",
+                 attn_dropout: Optional[float] = None,
+                 act_dropout: Optional[float] = None,
+                 normalize_before: bool = False) -> None:
+        super().__init__()
+        self.self_attn = MultiHeadAttention(
+            d_model, nhead,
+            dropout=attn_dropout if attn_dropout is not None else dropout)
+        self.linear1 = Linear(d_model, dim_feedforward)
+        self.linear2 = Linear(dim_feedforward, d_model)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.act_dropout = Dropout(
+            act_dropout if act_dropout is not None else dropout)
+        self.activation = getattr(A, activation)
+        self.normalize_before = normalize_before
+
+    def forward(self, src, src_mask=None):
+        residual = src
+        if self.normalize_before:
+            src = self.norm1(src)
+        src = self.self_attn(src, attn_mask=src_mask)
+        src = residual + self.dropout1(src)
+        if not self.normalize_before:
+            src = self.norm1(src)
+        residual = src
+        if self.normalize_before:
+            src = self.norm2(src)
+        src = self.linear2(self.act_dropout(self.activation(
+            self.linear1(src))))
+        src = residual + self.dropout2(src)
+        if not self.normalize_before:
+            src = self.norm2(src)
+        return src
+
+
+class TransformerEncoder(Layer):
+    def __init__(self, encoder_layer_ctor, num_layers: int,
+                 norm: Optional[Layer] = None) -> None:
+        super().__init__()
+        self.layers = LayerList([encoder_layer_ctor()
+                                 for _ in range(num_layers)])
+        if norm is not None:
+            self.norm = norm
+        self.has_norm = norm is not None
+
+    def forward(self, src, src_mask=None):
+        out = src
+        for layer in self.layers:
+            out = layer(out, src_mask=src_mask)
+        if self.has_norm:
+            out = self.norm(out)
+        return out
+
+
+class TransformerDecoderLayer(Layer):
+    def __init__(self, d_model: int, nhead: int, dim_feedforward: int,
+                 dropout: float = 0.1, activation: str = "relu",
+                 normalize_before: bool = False) -> None:
+        super().__init__()
+        self.self_attn = MultiHeadAttention(d_model, nhead, dropout=dropout)
+        self.cross_attn = MultiHeadAttention(d_model, nhead, dropout=dropout)
+        self.linear1 = Linear(d_model, dim_feedforward)
+        self.linear2 = Linear(dim_feedforward, d_model)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.norm3 = LayerNorm(d_model)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.dropout3 = Dropout(dropout)
+        self.activation = getattr(A, activation)
+        self.normalize_before = normalize_before
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None):
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm1(tgt)
+        tgt = self.self_attn(tgt, attn_mask=tgt_mask, causal=tgt_mask is None)
+        tgt = residual + self.dropout1(tgt)
+        if not self.normalize_before:
+            tgt = self.norm1(tgt)
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm2(tgt)
+        tgt = self.cross_attn(tgt, memory, memory, attn_mask=memory_mask)
+        tgt = residual + self.dropout2(tgt)
+        if not self.normalize_before:
+            tgt = self.norm2(tgt)
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm3(tgt)
+        tgt = self.linear2(self.activation(self.linear1(tgt)))
+        tgt = residual + self.dropout3(tgt)
+        if not self.normalize_before:
+            tgt = self.norm3(tgt)
+        return tgt
+
+
+class TransformerDecoder(Layer):
+    def __init__(self, decoder_layer_ctor, num_layers: int,
+                 norm: Optional[Layer] = None) -> None:
+        super().__init__()
+        self.layers = LayerList([decoder_layer_ctor()
+                                 for _ in range(num_layers)])
+        if norm is not None:
+            self.norm = norm
+        self.has_norm = norm is not None
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None):
+        out = tgt
+        for layer in self.layers:
+            out = layer(out, memory, tgt_mask, memory_mask)
+        if self.has_norm:
+            out = self.norm(out)
+        return out
+
+
+class Transformer(Layer):
+    def __init__(self, d_model: int = 512, nhead: int = 8,
+                 num_encoder_layers: int = 6, num_decoder_layers: int = 6,
+                 dim_feedforward: int = 2048, dropout: float = 0.1,
+                 activation: str = "relu",
+                 normalize_before: bool = False) -> None:
+        super().__init__()
+        self.encoder = TransformerEncoder(
+            lambda: TransformerEncoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                normalize_before=normalize_before), num_encoder_layers,
+            LayerNorm(d_model) if normalize_before else None)
+        self.decoder = TransformerDecoder(
+            lambda: TransformerDecoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                normalize_before), num_decoder_layers,
+            LayerNorm(d_model) if normalize_before else None)
+
+    def forward(self, src, tgt, src_mask=None, tgt_mask=None,
+                memory_mask=None):
+        memory = self.encoder(src, src_mask)
+        return self.decoder(tgt, memory, tgt_mask, memory_mask)

@@ -1,0 +1,37 @@
+"""Functional operator library.
+
+The TPU-native analogue of the reference's operator layer
+(/root/reference/paddle/fluid/operators/ — ~449 registered ops, SURVEY.md
+§2.4): pure functions over jax arrays, lowered through XLA (MXU for matmul/
+conv, VPU for elementwise, fusion by the compiler). Hot fused ops live in
+paddle_tpu.kernels as Pallas kernels and are routed automatically.
+
+Submodules group ops the way the reference groups operator directories:
+math, activation, reduction, manipulation, nn_functional, loss, search,
+random_ops, sequence (ragged/LoD analogue), control_flow, sparse
+(SelectedRows analogue), metrics_ops.
+"""
+
+from . import (activation, control_flow, loss, manipulation, math,
+               metrics_ops, nn_functional, random_ops, reduction, search,
+               sequence, sparse)
+
+from .activation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .reduction import (  # noqa: F401
+    all, amax, amin, any, frobenius_norm, l1_norm, logsumexp, max, mean,
+    median, min, nanmean, nansum, p_norm, prod, squared_l2_norm, std, sum,
+    var)
+from .manipulation import *  # noqa: F401,F403
+from .nn_functional import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random_ops import *  # noqa: F401,F403
+from .control_flow import (case, cond, fori_loop, scan,  # noqa: F401
+                           static_rnn, switch_case, while_loop)
+from .sequence import *  # noqa: F401,F403
+from .metrics_ops import (accuracy, auc_from_stats,  # noqa: F401
+                          auc_stats, positive_negative_pair,
+                          precision_recall_stats)
+from .sparse import RowSlices, embedding_grad, merge_rows  # noqa: F401
+from .sparse import scatter_apply, to_dense  # noqa: F401

@@ -1,0 +1,155 @@
+"""Activation ops.
+
+TPU-native lowerings for the reference's activation family
+(/root/reference/paddle/fluid/operators/activation_op.cc:678+ — ~40
+activations registered through FOR_EACH_ACTIVATION_OP in activation_op.h).
+All are jnp/jax.nn compositions; XLA fuses them into surrounding matmuls so
+none need custom kernels on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def relu6(x, threshold: float = 6.0):
+    return jnp.clip(x, 0.0, threshold)
+
+
+def leaky_relu(x, negative_slope: float = 0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+def prelu(x, weight):
+    return jnp.where(x > 0, x, weight * x)
+
+
+def elu(x, alpha: float = 1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def selu(x, scale: float = 1.0507009873554805,
+         alpha: float = 1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def celu(x, alpha: float = 1.0):
+    return jax.nn.celu(x, alpha)
+
+
+def gelu(x, approximate: bool = False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def logsigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+def hard_sigmoid(x, slope: float = 0.2, offset: float = 0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def hard_swish(x, threshold: float = 6.0, scale: float = 6.0,
+               offset: float = 3.0):
+    return x * jnp.clip(x + offset, 0.0, threshold) / scale
+
+
+def hard_shrink(x, threshold: float = 0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def soft_shrink(x, threshold: float = 0.5):
+    return jnp.sign(x) * jnp.maximum(jnp.abs(x) - threshold, 0.0)
+
+
+softshrink = soft_shrink
+hardshrink = hard_shrink
+
+
+def hard_tanh(x, min: float = -1.0, max: float = 1.0):
+    return jnp.clip(x, min, max)
+
+
+hardtanh = hard_tanh
+brelu = hard_tanh
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def tanh_shrink(x):
+    return x - jnp.tanh(x)
+
+
+tanhshrink = tanh_shrink
+
+
+def softplus(x, beta: float = 1.0, threshold: float = 20.0):
+    bx = beta * x
+    return jnp.where(bx > threshold, x, jnp.log1p(jnp.exp(bx)) / beta)
+
+
+def soft_relu(x, threshold: float = 40.0):
+    return jnp.log1p(jnp.exp(jnp.clip(x, -threshold, threshold)))
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def swish(x, beta: float = 1.0):
+    return x * jax.nn.sigmoid(beta * x)
+
+
+silu = swish
+
+
+def mish(x):
+    return x * jnp.tanh(softplus(x))
+
+
+def maxout(x, groups: int, axis: int = 1):
+    shape = list(x.shape)
+    axis = axis % x.ndim
+    c = shape[axis]
+    shape[axis:axis + 1] = [c // groups, groups]
+    return jnp.max(x.reshape(shape), axis=axis + 1)
+
+
+def thresholded_relu(x, threshold: float = 1.0):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+def log_softmax(x, axis: int = -1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def softmax(x, axis: int = -1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def glu(x, axis: int = -1):
+    return jax.nn.glu(x, axis=axis)
+
+
+def rrelu(x, lower: float = 0.125, upper: float = 0.333, training: bool = False,
+          key=None):
+    if training:
+        from ..core import random as _random
+        if key is None:
+            key = _random.next_key("rrelu")
+        slope = jax.random.uniform(key, x.shape, x.dtype, lower, upper)
+    else:
+        slope = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, slope * x)

@@ -1,0 +1,64 @@
+"""Attention ops.
+
+TPU-native equivalent of the reference's fused attention kernels
+(/root/reference/paddle/fluid/operators/fused/multihead_matmul_op.cu and
+operators/math/bert_encoder_functor.cu). The reference fuses QK^T + scale +
+mask + softmax + PV into one CUDA kernel; here the base path is an XLA
+composition (which XLA fuses well on TPU) and the hot path is the Pallas
+flash-attention kernel in kernels/flash_attention.py, selected via
+kernels.maybe_flash_attention.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _random
+
+
+def scaled_dot_product_attention(q, k, v, mask=None,
+                                 scale: Optional[float] = None,
+                                 causal: bool = False,
+                                 dropout_p: float = 0.0,
+                                 training: bool = False, key=None):
+    """q,k,v: [B, H, T, D] (or any [..., T, D]). mask broadcasts to
+    [..., Tq, Tk]; additive if float, boolean keep-mask otherwise."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    logits = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        logits = jnp.where(causal_mask, logits,
+                           jnp.finfo(logits.dtype).min)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+        else:
+            logits = logits + mask
+    weights = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0 and training:
+        if key is None:
+            key = _random.next_key("dropout")
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, weights.shape)
+        weights = jnp.where(keep, weights / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("...qk,...kd->...qd", weights, v)
+
+
+def multihead_matmul(x, w_qkv, b_qkv, num_heads: int, mask=None,
+                     scale: Optional[float] = None):
+    """Fused QKV projection + attention (ref: multihead_matmul_op.cu).
+
+    x: [B, T, C]; w_qkv: [C, 3C]; returns [B, T, C].
+    """
+    b, t, c = x.shape
+    qkv = x @ w_qkv + b_qkv  # [B, T, 3C]
+    qkv = qkv.reshape(b, t, 3, num_heads, c // num_heads)
+    q, k, v = (jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3))
+    from ..kernels import maybe_flash_attention
+    out = maybe_flash_attention(q, k, v, mask=mask, scale=scale)
+    return jnp.moveaxis(out, 1, 2).reshape(b, t, c)

@@ -1,0 +1,351 @@
+"""Loss ops.
+
+TPU-native lowerings for the reference's loss operator family
+(/root/reference/paddle/fluid/operators/: cross_entropy_op.cc,
+softmax_with_cross_entropy_op.cc, bce_loss_op.cc,
+sigmoid_cross_entropy_with_logits_op.cc, nll_loss_op.cc, kldiv_loss_op.cc,
+smooth_l1_loss_op.cc, huber_loss_op.cc, hinge_loss_op.cc, log_loss_op.cc,
+margin_rank_loss_op.cc, rank_loss_op.cc, bpr_loss_op.cc,
+modified_huber_loss_op.cc, squared_l2_distance_op.cc,
+sigmoid_focal_loss_op.cc, mse in layers, warpctc_op.cc → ctc_loss, ...).
+
+All are fused by XLA; softmax+xent is composed in log-space for stability
+(the reference fuses these in softmax_with_cross_entropy_op.cu for the same
+reason).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .activation import log_softmax, sigmoid
+
+
+def _reduce(loss, reduction: str):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def softmax_with_cross_entropy(logits, label, soft_label: bool = False,
+                               ignore_index: int = -100, axis: int = -1,
+                               return_softmax: bool = False):
+    log_p = log_softmax(logits, axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * log_p, axis=axis, keepdims=True)
+    else:
+        lbl = label
+        if lbl.ndim == logits.ndim:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        picked = jnp.take_along_axis(
+            log_p, jnp.expand_dims(lbl, axis).astype(jnp.int32), axis=axis)
+        loss = -picked
+        mask = jnp.expand_dims(lbl, axis) != ignore_index
+        loss = jnp.where(mask, loss, 0.0)
+    if return_softmax:
+        return loss, jnp.exp(log_p)
+    return loss
+
+
+def cross_entropy(input, label, soft_label: bool = False,
+                  ignore_index: int = -100, reduction: str = "mean",
+                  axis: int = -1, use_softmax: bool = True,
+                  weight=None):
+    """2.0-style cross_entropy over logits (default) or probabilities."""
+    if use_softmax:
+        loss = softmax_with_cross_entropy(input, label, soft_label,
+                                          ignore_index, axis)
+    else:
+        if soft_label:
+            loss = -jnp.sum(label * jnp.log(jnp.maximum(input, 1e-20)),
+                            axis=axis, keepdims=True)
+        else:
+            lbl = label
+            if lbl.ndim == input.ndim:
+                lbl = jnp.squeeze(lbl, axis=axis)
+            picked = jnp.take_along_axis(
+                jnp.log(jnp.maximum(input, 1e-20)),
+                jnp.expand_dims(lbl, axis).astype(jnp.int32), axis=axis)
+            loss = -picked
+    if weight is not None and not soft_label:
+        lbl = label if label.ndim < input.ndim else jnp.squeeze(label, axis)
+        w = jnp.take(weight, lbl.astype(jnp.int32))
+        loss = loss * jnp.expand_dims(w, axis)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+    return _reduce(loss, reduction)
+
+
+def nll_loss(log_prob, label, weight=None, ignore_index: int = -100,
+             reduction: str = "mean"):
+    picked = jnp.take_along_axis(
+        log_prob, label[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    loss = -picked
+    mask = (label != ignore_index).astype(loss.dtype)
+    if weight is not None:
+        w = jnp.take(weight, label.astype(jnp.int32)) * mask
+    else:
+        w = mask
+    loss = loss * w
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+    return _reduce(loss, reduction)
+
+
+def bce_loss(input, label, weight=None, reduction: str = "mean"):
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.maximum(input, eps))
+             + (1 - label) * jnp.log(jnp.maximum(1 - input, eps)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     pos_weight=None,
+                                     reduction: str = "mean"):
+    max_val = jnp.maximum(-logit, 0.0)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1.0) * label + 1.0
+        loss = (1 - label) * logit + log_w * (
+            jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)
+    else:
+        loss = (1 - label) * logit + max_val \
+            + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index: int = -100,
+                                      normalize: bool = False):
+    """(ref: sigmoid_cross_entropy_with_logits_op.cc)."""
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    mask = (label != ignore_index).astype(x.dtype)
+    loss = loss * mask
+    if normalize:
+        loss = loss / jnp.maximum(jnp.sum(mask), 1.0)
+    return loss
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha: float = 0.25,
+                       gamma: float = 2.0, reduction: str = "sum"):
+    """(ref: sigmoid_focal_loss_op.cc)."""
+    p = sigmoid(logit)
+    ce = jnp.maximum(logit, 0.0) - logit * label \
+        + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    p_t = p * label + (1 - p) * (1 - label)
+    alpha_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = alpha_t * jnp.power(1 - p_t, gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+def mse_loss(input, label, reduction: str = "mean"):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+def square_error_cost(input, label):
+    """(ref: squared_l2_distance / layers square_error_cost)."""
+    return jnp.square(input - label)
+
+
+def l1_loss(input, label, reduction: str = "mean"):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+def smooth_l1_loss(input, label, delta: float = 1.0,
+                   reduction: str = "mean"):
+    diff = jnp.abs(input - label)
+    loss = jnp.where(diff < delta, 0.5 * jnp.square(diff) / delta,
+                     diff - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+def huber_loss(input, label, delta: float = 1.0):
+    """(ref: huber_loss_op.cc)."""
+    diff = jnp.abs(label - input)
+    return jnp.where(diff <= delta, 0.5 * jnp.square(diff),
+                     delta * (diff - 0.5 * delta))
+
+
+def modified_huber_loss(input, label):
+    """(ref: modified_huber_loss_op.cc) label in {0,1} → y in {-1,1}."""
+    y = 2.0 * label - 1.0
+    z = input * y
+    return jnp.where(z < -1.0, -4.0 * z,
+                     jnp.where(z < 1.0, jnp.square(1.0 - z), 0.0))
+
+
+def hinge_loss(input, label):
+    """(ref: hinge_loss_op.cc)."""
+    y = 2.0 * label - 1.0
+    return jnp.maximum(0.0, 1.0 - input * y)
+
+
+def kl_div(input, label, reduction: str = "mean"):
+    """(ref: kldiv_loss_op.cc) input is log-probabilities."""
+    loss = label * (jnp.log(jnp.maximum(label, 1e-20)) - input)
+    loss = jnp.where(label > 0, loss, 0.0)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+def log_loss(input, label, epsilon: float = 1e-4):
+    """(ref: log_loss_op.cc)."""
+    return -label * jnp.log(input + epsilon) \
+        - (1 - label) * jnp.log(1 - input + epsilon)
+
+
+def margin_rank_loss(label, left, right, margin: float = 0.1):
+    """(ref: margin_rank_loss_op.cc)."""
+    return jnp.maximum(0.0, -label * (left - right) + margin)
+
+
+def margin_ranking_loss(input, other, label, margin: float = 0.0,
+                        reduction: str = "mean"):
+    loss = jnp.maximum(0.0, -label * (input - other) + margin)
+    return _reduce(loss, reduction)
+
+
+def rank_loss(label, left, right):
+    """(ref: rank_loss_op.cc)."""
+    diff = left - right
+    return jnp.log1p(jnp.exp(diff)) - label * diff
+
+
+def bpr_loss(input, label):
+    """(ref: bpr_loss_op.cc) Bayesian personalized ranking."""
+    n, c = input.shape
+    pos = jnp.take_along_axis(input, label.reshape(-1, 1).astype(jnp.int32),
+                              axis=1)
+    diff = input - pos
+    loss = -jnp.log(jnp.maximum(sigmoid(-diff), 1e-8))
+    mask = jnp.ones((n, c)).at[jnp.arange(n),
+                               label.reshape(-1).astype(jnp.int32)].set(0.0)
+    return jnp.sum(loss * mask, axis=1, keepdims=True) / (c - 1)
+
+
+def cosine_embedding_loss(input1, input2, label, margin: float = 0.0,
+                          reduction: str = "mean"):
+    cos = jnp.sum(input1 * input2, axis=-1) / jnp.maximum(
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1),
+        1e-12)
+    loss = jnp.where(label > 0, 1.0 - cos,
+                     jnp.maximum(0.0, cos - margin))
+    return _reduce(loss, reduction)
+
+
+def triplet_margin_loss(anchor, positive, negative, margin: float = 1.0,
+                        p: float = 2.0, reduction: str = "mean"):
+    dp = jnp.power(jnp.sum(jnp.power(jnp.abs(anchor - positive), p),
+                           axis=-1), 1 / p)
+    dn = jnp.power(jnp.sum(jnp.power(jnp.abs(anchor - negative), p),
+                           axis=-1), 1 / p)
+    return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+
+def squared_l2_distance(x, y):
+    """(ref: squared_l2_distance_op.cc)."""
+    d = x - y
+    return jnp.sum(jnp.square(d), axis=-1), d
+
+
+def teacher_student_sigmoid_loss(x, label, soft_max_up_bound: float = 15.0,
+                                 soft_max_lower_bound: float = -15.0):
+    """(ref: teacher_student_sigmoid_loss_op.cc)."""
+    z = jnp.clip(x, soft_max_lower_bound, soft_max_up_bound)
+    teacher = jnp.where(label > 0.0, label, 0.0)
+    student = jnp.log1p(jnp.exp(z)) - z * jnp.where(label > 0, 1.0, 0.0)
+    return student + (jnp.log1p(jnp.exp(z)) - z * teacher)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths,
+             blank: int = 0, reduction: str = "mean"):
+    """(ref: warpctc_op.cc) CTC via dynamic-programming in log space.
+
+    log_probs: [T, B, C] log-softmax outputs. labels: [B, S] padded.
+    Implemented with lax.scan over time — shape-static, jit/TPU friendly.
+    """
+    t_max, b, c = log_probs.shape
+    s_max = labels.shape[1]
+    # extended label sequence with blanks: length 2S+1
+    ext = jnp.full((b, 2 * s_max + 1), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(labels.astype(jnp.int32))
+    ext_len = 2 * label_lengths.astype(jnp.int32) + 1
+
+    neg_inf = -1e30
+    # allow transitions s-2 → s when ext[s] != blank and ext[s] != ext[s-2]
+    same_as_prev2 = jnp.concatenate(
+        [jnp.ones((b, 2), dtype=bool),
+         ext[:, 2:] == ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (~same_as_prev2)
+
+    alpha0 = jnp.full((b, 2 * s_max + 1), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(log_probs[0, jnp.arange(b), ext[:, 0]])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(ext_len > 1, log_probs[0, jnp.arange(b), ext[:, 1]],
+                  neg_inf))
+
+    def logaddexp3(a, b_, c_):
+        m = jnp.maximum(jnp.maximum(a, b_), c_)
+        m_safe = jnp.where(m == neg_inf, 0.0, m)
+        return jnp.where(
+            m == neg_inf, neg_inf,
+            m_safe + jnp.log(jnp.exp(a - m_safe) + jnp.exp(b_ - m_safe)
+                             + jnp.exp(c_ - m_safe)))
+
+    def step(alpha, lp_t):
+        prev1 = jnp.concatenate(
+            [jnp.full((b, 1), neg_inf), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate(
+            [jnp.full((b, 2), neg_inf), alpha[:, :-2]], axis=1)
+        prev2 = jnp.where(can_skip, prev2, neg_inf)
+        merged = logaddexp3(alpha, prev1, prev2)
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)
+        return merged + emit, None
+
+    def masked_step(carry, inp):
+        alpha, t = carry
+        lp_t, t_idx = inp
+        new_alpha, _ = step(alpha, lp_t)
+        keep = (t_idx < input_lengths.astype(jnp.int32))[:, None]
+        return (jnp.where(keep, new_alpha, alpha), t + 1), None
+
+    (alpha_final, _), _ = jax.lax.scan(
+        masked_step, (alpha0, 1),
+        (log_probs[1:], jnp.arange(1, t_max)))
+
+    idx_last = (ext_len - 1)[:, None]
+    idx_prev = jnp.maximum(ext_len - 2, 0)[:, None]
+    a_last = jnp.take_along_axis(alpha_final, idx_last, axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(alpha_final, idx_prev, axis=1)[:, 0]
+    m = jnp.maximum(a_last, a_prev)
+    m_safe = jnp.where(m == neg_inf, 0.0, m)
+    ll = m_safe + jnp.log(jnp.exp(a_last - m_safe) + jnp.exp(a_prev - m_safe))
+    loss = -ll
+    if reduction == "mean":
+        return jnp.mean(loss / jnp.maximum(label_lengths, 1))
+    return _reduce(loss, reduction)
+
+
+def center_loss(features, label, centers, alpha: float = 0.5,
+                update_centers: bool = True):
+    """(ref: center_loss_op.cc). Returns (loss, new_centers)."""
+    lbl = label.reshape(-1).astype(jnp.int32)
+    picked = jnp.take(centers, lbl, axis=0)
+    diff = features - picked
+    loss = 0.5 * jnp.sum(jnp.square(diff), axis=1, keepdims=True)
+    if not update_centers:
+        return loss, centers
+    counts = jnp.zeros((centers.shape[0],), features.dtype).at[lbl].add(1.0)
+    grad = jnp.zeros_like(centers).at[lbl].add(-diff)
+    new_centers = centers - alpha * grad / (counts[:, None] + 1.0)
+    return loss, new_centers

@@ -1,0 +1,451 @@
+"""Tensor manipulation ops.
+
+TPU-native lowerings for the reference's shape/index/layout operator family
+(/root/reference/paddle/fluid/operators/: concat_op.cc, split_op.cc,
+reshape_op.cc, squeeze_op.cc, unsqueeze_op.cc, stack_op.cc, unstack_op.cc,
+transpose_op.cc, tile_op.cc, expand_v2_op.cc, flip_op.cc, roll_op.cc,
+gather_op.cc, gather_nd_op.cc, scatter_op.cc, scatter_nd_add_op.cc,
+index_select_op.cc, index_sample_op.cc, masked_select_op.cc, unique_op.cc,
+where_op.cc, pad_op.cc, slice_op.cc, strided_slice_op.cc, unbind_op.cc,
+flatten_op.cc, meshgrid_op.cc, shard_index_op.cc, ...).
+
+Ops with data-dependent output shapes (masked_select, where_index, unique)
+cannot be dynamically shaped under XLA; they take an optional static ``size``
+with a documented fill policy, matching jnp.nonzero's size= idiom — this is
+the TPU-native replacement for the reference's LoD dynamic outputs.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def concat(xs: Sequence[jax.Array], axis: int = 0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def split(x, num_or_sections: Union[int, Sequence[int]], axis: int = 0):
+    axis = axis % x.ndim
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    sections: List[int] = list(num_or_sections)
+    if -1 in sections:
+        known = builtins.sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = x.shape[axis] - known
+    offsets = []
+    acc = 0
+    for s in sections[:-1]:
+        acc += s
+        offsets.append(acc)
+    return jnp.split(x, offsets, axis=axis)
+
+
+def chunk(x, chunks: int, axis: int = 0):
+    return jnp.array_split(x, chunks, axis=axis)
+
+
+def reshape(x, shape: Sequence[int]):
+    shape = tuple(int(s) if s != 0 else x.shape[i]
+                  for i, s in enumerate(shape)) if 0 in tuple(shape) \
+        else tuple(shape)
+    return jnp.reshape(x, shape)
+
+
+def squeeze(x, axis: Optional[Union[int, Sequence[int]]] = None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a % x.ndim for a in axis if x.shape[a % x.ndim] == 1)
+    return jnp.squeeze(x, axis=axis) if axis else x
+
+
+def unsqueeze(x, axis: Union[int, Sequence[int]]):
+    if isinstance(axis, int):
+        axis = (axis,)
+    out = x
+    for a in sorted(a % (out.ndim + 1) for a in axis):
+        out = jnp.expand_dims(out, a)
+    return out
+
+
+def stack(xs: Sequence[jax.Array], axis: int = 0):
+    return jnp.stack(xs, axis=axis)
+
+
+def unstack(x, axis: int = 0, num: Optional[int] = None):
+    n = num if num is not None else x.shape[axis]
+    return [jnp.squeeze(s, axis=axis)
+            for s in jnp.split(x, n, axis=axis)]
+
+
+def unbind(x, axis: int = 0):
+    return unstack(x, axis)
+
+
+def transpose(x, perm: Sequence[int]):
+    return jnp.transpose(x, axes=perm)
+
+
+def swapaxes(x, axis1: int, axis2: int):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def tile(x, repeat_times: Sequence[int]):
+    return jnp.tile(x, tuple(repeat_times))
+
+
+def expand(x, shape: Sequence[int]):
+    shape = tuple(x.shape[i - (len(shape) - x.ndim)] if s == -1 else s
+                  for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def broadcast_to(x, shape: Sequence[int]):
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+def broadcast_tensors(xs: Sequence[jax.Array]):
+    return jnp.broadcast_arrays(*xs)
+
+
+def flip(x, axis: Union[int, Sequence[int]]):
+    return jnp.flip(x, axis=axis)
+
+
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def rot90(x, k: int = 1, axes: Sequence[int] = (0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+def flatten(x, start_axis: int = 0, stop_axis: int = -1):
+    start = start_axis % x.ndim
+    stop = stop_axis % x.ndim
+    shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return jnp.reshape(x, shape)
+
+
+def cast(x, dtype):
+    from ..core.dtype import convert_dtype
+    return x.astype(convert_dtype(dtype))
+
+
+def assign(x):
+    return jnp.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# gather / scatter family
+# ---------------------------------------------------------------------------
+
+def gather(x, index, axis: int = 0):
+    """(ref: gather_op.cc) select rows of ``x`` along ``axis`` by index."""
+    return jnp.take(x, index.reshape(-1), axis=axis)
+
+
+def gather_nd(x, index):
+    """(ref: gather_nd_op.cc) index is [..., k]; gathers x[idx] slices."""
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def take_along_axis(x, index, axis: int):
+    return jnp.take_along_axis(x, index, axis=axis)
+
+
+def index_select(x, index, axis: int = 0):
+    return jnp.take(x, index.reshape(-1), axis=axis)
+
+
+def index_sample(x, index):
+    """(ref: index_sample_op.cc) per-row gather: out[i,j] = x[i, index[i,j]]."""
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def scatter(x, index, updates, overwrite: bool = True):
+    """(ref: scatter_op.cc) write update rows into x at index."""
+    index = index.reshape(-1)
+    if overwrite:
+        return x.at[index].set(updates)
+    base = x.at[index].set(jnp.zeros_like(updates))
+    return base.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd(index, updates, shape: Sequence[int]):
+    zeros = jnp.zeros(tuple(shape), dtype=updates.dtype)
+    return scatter_nd_add(zeros, index, updates)
+
+
+def put_along_axis(x, index, values, axis: int, reduce: str = "assign"):
+    if reduce == "assign":
+        return jnp.put_along_axis(x, index, values, axis=axis, inplace=False)
+    if reduce == "add":
+        dim_idx = [jnp.arange(s).reshape(
+        	(1,) * i + (-1,) + (1,) * (x.ndim - i - 1))
+            for i, s in enumerate(x.shape)]
+        dim_idx[axis] = index
+        full = jnp.broadcast_arrays(*dim_idx)
+        return x.at[tuple(full)].add(jnp.broadcast_to(values, full[0].shape))
+    raise ValueError(f"unsupported reduce '{reduce}'")
+
+
+# ---------------------------------------------------------------------------
+# data-dependent-shape ops — static ``size`` contract (see module docstring)
+# ---------------------------------------------------------------------------
+
+def masked_select(x, mask, size: Optional[int] = None, fill_value=0):
+    """(ref: masked_select_op.cc). Without ``size`` works only eagerly."""
+    flat_x = x.reshape(-1)
+    flat_m = mask.reshape(-1)
+    if size is None:
+        return flat_x[jnp.nonzero(flat_m)[0]]
+    idx = jnp.nonzero(flat_m, size=size, fill_value=flat_x.shape[0])[0]
+    padded = jnp.concatenate(
+        [flat_x, jnp.full((1,), fill_value, dtype=x.dtype)])
+    return padded[idx]
+
+
+def where_index(condition, size: Optional[int] = None):
+    """(ref: where_index_op.cc = paddle.nonzero)."""
+    if size is None:
+        return jnp.stack(jnp.nonzero(condition), axis=-1)
+    res = jnp.nonzero(condition, size=size, fill_value=-1)
+    return jnp.stack(res, axis=-1)
+
+
+nonzero = where_index
+
+
+def unique(x, return_index: bool = False, return_inverse: bool = False,
+           return_counts: bool = False, size: Optional[int] = None,
+           fill_value=None):
+    """(ref: unique_op.cc / unique_with_counts)."""
+    res = jnp.unique(x.reshape(-1), return_index=return_index,
+                     return_inverse=return_inverse,
+                     return_counts=return_counts, size=size,
+                     fill_value=fill_value)
+    return res
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition)
+    return jnp.where(condition, x, y)
+
+
+# ---------------------------------------------------------------------------
+# pad / slice
+# ---------------------------------------------------------------------------
+
+def pad(x, paddings: Sequence[int], mode: str = "constant",
+        value: float = 0.0, data_format: str = "NCHW"):
+    """Flat [before0, after0, before1, after1, ...] or per-NCHW padding.
+
+    (ref: pad_op.cc / pad2d_op.cc / pad3d_op.cc)
+    """
+    if len(paddings) == 2 * x.ndim:
+        pads = [(paddings[2 * i], paddings[2 * i + 1])
+                for i in range(x.ndim)]
+    else:
+        # pad2d/pad3d convention: paddings apply to spatial dims only
+        n_spatial = len(paddings) // 2
+        pads = [(0, 0)] * x.ndim
+        if data_format.startswith("NC"):
+            spatial_start = 2
+        else:
+            spatial_start = 1
+        for i in range(n_spatial):
+            pads[spatial_start + i] = (paddings[2 * i], paddings[2 * i + 1])
+    if mode == "constant":
+        return jnp.pad(x, pads, mode="constant", constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "edge": "edge",
+             "circular": "wrap"}[mode]
+    return jnp.pad(x, pads, mode=jmode)
+
+
+def pad_constant_like(x, y, value: float = 0.0):
+    pads = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return jnp.pad(y, pads, mode="constant", constant_values=value)
+
+
+def slice(x, axes: Sequence[int], starts: Sequence[int],
+          ends: Sequence[int]):
+    idx = [builtins.slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        idx[a] = builtins.slice(s, e)
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes: Sequence[int], starts: Sequence[int],
+                  ends: Sequence[int], strides: Sequence[int]):
+    idx = [builtins.slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = builtins.slice(s, e, st)
+    return x[tuple(idx)]
+
+
+def crop(x, shape: Sequence[int], offsets: Optional[Sequence[int]] = None):
+    offsets = offsets or [0] * x.ndim
+    return lax.dynamic_slice(x, tuple(offsets), tuple(shape))
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+def meshgrid(*xs, indexing: str = "ij"):
+    return jnp.meshgrid(*xs, indexing=indexing)
+
+
+def shard_index(x, index_num: int, nshards: int, shard_id: int,
+                ignore_value: int = -1):
+    """(ref: shard_index_op.cc) remap global ids to shard-local ids."""
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return jnp.where(in_shard, x % shard_size, ignore_value)
+
+
+def shape(x):
+    return jnp.array(x.shape, dtype=jnp.int32)
+
+
+def numel(x):
+    return jnp.array(x.size, dtype=jnp.int64)
+
+
+def rank(x):
+    return jnp.array(x.ndim, dtype=jnp.int32)
+
+
+def fill_constant(shape: Sequence[int], dtype, value):
+    from ..core.dtype import convert_dtype
+    return jnp.full(tuple(shape), value, dtype=convert_dtype(dtype))
+
+
+def full(shape, fill_value, dtype=None):
+    from ..core.dtype import convert_dtype
+    return jnp.full(tuple(shape), fill_value,
+                    dtype=convert_dtype(dtype) if dtype else None)
+
+
+def full_like(x, fill_value, dtype=None):
+    from ..core.dtype import convert_dtype
+    return jnp.full_like(x, fill_value,
+                         dtype=convert_dtype(dtype) if dtype else None)
+
+
+def zeros(shape, dtype="float32"):
+    from ..core.dtype import convert_dtype
+    return jnp.zeros(tuple(shape), dtype=convert_dtype(dtype))
+
+
+def ones(shape, dtype="float32"):
+    from ..core.dtype import convert_dtype
+    return jnp.ones(tuple(shape), dtype=convert_dtype(dtype))
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=dtype)
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=dtype)
+
+
+def fill_zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+def arange(start, end=None, step=1, dtype="int64"):
+    from ..core.dtype import convert_dtype
+    if end is None:
+        start, end = 0, start
+    return jnp.arange(start, end, step, dtype=convert_dtype(dtype))
+
+
+def linspace(start, stop, num, dtype="float32"):
+    from ..core.dtype import convert_dtype
+    return jnp.linspace(start, stop, int(num), dtype=convert_dtype(dtype))
+
+
+def eye(num_rows: int, num_columns: Optional[int] = None, dtype="float32"):
+    from ..core.dtype import convert_dtype
+    return jnp.eye(num_rows, num_columns, dtype=convert_dtype(dtype))
+
+
+def space_to_depth(x, blocksize: int):
+    """(ref: space_to_depth_op.cc) NCHW."""
+    n, c, h, w = x.shape
+    b = blocksize
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+def pixel_shuffle(x, upscale_factor: int, data_format: str = "NCHW"):
+    """(ref: pixel_shuffle_op.cc)."""
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return x.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, r, r, c // (r * r))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(n, h * r, w * r, c // (r * r))
+
+
+def shuffle_channel(x, group: int):
+    """(ref: shuffle_channel_op.cc) NCHW."""
+    n, c, h, w = x.shape
+    x = x.reshape(n, group, c // group, h, w)
+    x = jnp.swapaxes(x, 1, 2)
+    return x.reshape(n, c, h, w)
+
+
+def temporal_shift(x, seg_num: int, shift_ratio: float = 0.25):
+    """(ref: temporal_shift_op.cc) NCHW with N = batch*seg_num."""
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x = x.reshape(n, seg_num, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    pre = jnp.concatenate(
+        [jnp.zeros_like(x[:, :1, :c1]), x[:, :-1, :c1]], axis=1)
+    post = jnp.concatenate(
+        [x[:, 1:, c1:c2], jnp.zeros_like(x[:, :1, c1:c2])], axis=1)
+    rest = x[:, :, c2:]
+    out = jnp.concatenate([pre, post, rest], axis=2)
+    return out.reshape(nt, c, h, w)
+
+
+def im2sequence(x, kernel: Sequence[int], stride: Sequence[int] = (1, 1),
+                padding: Sequence[int] = (0, 0, 0, 0)):
+    """(ref: im2sequence_op.cc) sliding patches flattened to rows."""
+    from .nn_functional import unfold
+    cols = unfold(x, kernel, strides=stride,
+                  paddings=padding)  # [N, C*kh*kw, L]
+    n, ckk, l = cols.shape
+    return jnp.swapaxes(cols, 1, 2).reshape(n * l, ckk)

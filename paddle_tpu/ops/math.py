@@ -1,0 +1,437 @@
+"""Dense math ops.
+
+TPU-native lowerings for the reference's dense-math operator family
+(/root/reference/paddle/fluid/operators/: matmul_op.cc, mul_op.cc, bmm_op.cc,
+elementwise/*, cumsum_op.cc, clip_op.cc, scale_op.cc, kron_op.cc, dot_op.cc,
+addmm_op.cc, cholesky_op.cc, inverse_op.cc, tril_triu_op.cc, ...). Each op is
+a thin jnp/lax composition so XLA fuses and tiles them onto the MXU/VPU; no
+per-op kernels are hand-scheduled. Matmuls honor the global
+``matmul_precision`` flag so benchmarks can pin MXU bf16 vs fp32 passes.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..flags import GLOBAL_FLAGS
+
+
+def _precision():
+    p = GLOBAL_FLAGS.get("matmul_precision")
+    return None if p == "default" else p
+
+
+# ---------------------------------------------------------------------------
+# matmul family (ref: matmul_op.cc:60, mul_op.cc, bmm_op.cc, dot_op.cc)
+# ---------------------------------------------------------------------------
+
+def matmul(x, y, transpose_x: bool = False, transpose_y: bool = False,
+           alpha: float = 1.0):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y, precision=_precision())
+    if alpha != 1.0:
+        out = out * alpha
+    return out
+
+
+def mul(x, y, x_num_col_dims: int = 1, y_num_col_dims: int = 1):
+    """Flattening matmul (ref: mul_op.cc) — collapses leading dims."""
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((int(jnp.prod(jnp.array(xs[:x_num_col_dims]))), -1))
+    y2 = y.reshape((int(jnp.prod(jnp.array(ys[:y_num_col_dims]))), -1))
+    out = jnp.matmul(x2, y2, precision=_precision())
+    return out.reshape(xs[:x_num_col_dims] + ys[y_num_col_dims:])
+
+
+def bmm(x, y):
+    return jnp.matmul(x, y, precision=_precision())
+
+
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def addmm(input, x, y, beta: float = 1.0, alpha: float = 1.0):
+    return beta * input + alpha * jnp.matmul(x, y, precision=_precision())
+
+
+def bilinear_tensor_product(x, y, weight, bias=None):
+    """(ref: bilinear_tensor_product_op.cc) out[b,k] = x[b,:] W[k] y[b,:]^T."""
+    out = jnp.einsum("bi,kij,bj->bk", x, weight, y,
+                     precision=_precision())
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+def cross(x, y, axis: Optional[int] = None):
+    if axis is None:
+        axis = next(i for i, d in enumerate(x.shape) if d == 3)
+    return jnp.cross(x, y, axis=axis)
+
+
+def einsum(equation: str, *operands):
+    return jnp.einsum(equation, *operands, precision=_precision())
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary family (ref: operators/elementwise/)
+# Broadcasting follows numpy; the reference's `axis` attr aligned y's dims to
+# x starting at `axis` — supported via explicit reshape.
+# ---------------------------------------------------------------------------
+
+def _align(y, x_ndim: int, axis: int):
+    if axis == -1 or y.ndim == x_ndim:
+        return y
+    shape = (1,) * axis + y.shape + (1,) * (x_ndim - axis - y.ndim)
+    return y.reshape(shape)
+
+
+def _binary(fn, x, y, axis: int = -1):
+    x = jnp.asarray(x)
+    y = _align(jnp.asarray(y), x.ndim, axis)
+    return fn(x, y)
+
+
+def add(x, y, axis: int = -1):
+    return _binary(jnp.add, x, y, axis)
+
+
+def subtract(x, y, axis: int = -1):
+    return _binary(jnp.subtract, x, y, axis)
+
+
+def multiply(x, y, axis: int = -1):
+    return _binary(jnp.multiply, x, y, axis)
+
+
+def divide(x, y, axis: int = -1):
+    return _binary(jnp.divide, x, y, axis)
+
+
+def floor_divide(x, y, axis: int = -1):
+    return _binary(jnp.floor_divide, x, y, axis)
+
+
+def remainder(x, y, axis: int = -1):
+    return _binary(jnp.remainder, x, y, axis)
+
+
+mod = remainder
+
+
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+def elementwise_pow(x, y, axis: int = -1):
+    return _binary(jnp.power, x, y, axis)
+
+
+def maximum(x, y, axis: int = -1):
+    return _binary(jnp.maximum, x, y, axis)
+
+
+def minimum(x, y, axis: int = -1):
+    return _binary(jnp.minimum, x, y, axis)
+
+
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+# ---------------------------------------------------------------------------
+# unary math (ref: activation_op.h FOR_EACH_ACTIVATION_OP math subset + misc)
+# ---------------------------------------------------------------------------
+
+def abs(x):
+    return jnp.abs(x)
+
+
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+def rsqrt(x):
+    return lax.rsqrt(x)
+
+
+def square(x):
+    return jnp.square(x)
+
+
+def exp(x):
+    return jnp.exp(x)
+
+
+def expm1(x):
+    return jnp.expm1(x)
+
+
+def log(x):
+    return jnp.log(x)
+
+
+def log1p(x):
+    return jnp.log1p(x)
+
+
+def log2(x):
+    return jnp.log2(x)
+
+
+def log10(x):
+    return jnp.log10(x)
+
+
+def sin(x):
+    return jnp.sin(x)
+
+
+def cos(x):
+    return jnp.cos(x)
+
+
+def tan(x):
+    return jnp.tan(x)
+
+
+def asin(x):
+    return jnp.arcsin(x)
+
+
+def acos(x):
+    return jnp.arccos(x)
+
+
+def atan(x):
+    return jnp.arctan(x)
+
+
+def atan2(y, x):
+    return jnp.arctan2(y, x)
+
+
+def sinh(x):
+    return jnp.sinh(x)
+
+
+def cosh(x):
+    return jnp.cosh(x)
+
+
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+def ceil(x):
+    return jnp.ceil(x)
+
+
+def floor(x):
+    return jnp.floor(x)
+
+
+def round(x):
+    return jnp.round(x)
+
+
+def trunc(x):
+    return jnp.trunc(x)
+
+
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+def sign(x):
+    return jnp.sign(x)
+
+
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+def neg(x):
+    return jnp.negative(x)
+
+
+def isnan(x):
+    return jnp.isnan(x)
+
+
+def isinf(x):
+    return jnp.isinf(x)
+
+
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+def logit(x, eps: Optional[float] = None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+# ---------------------------------------------------------------------------
+# scale / clip / increment / misc (ref: scale_op.cc, clip_op.cc, ...)
+# ---------------------------------------------------------------------------
+
+def scale(x, scale: float = 1.0, bias: float = 0.0,
+          bias_after_scale: bool = True, act: Optional[str] = None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    if act is not None:
+        from . import activation as _act
+        out = getattr(_act, act)(out)
+    return out
+
+
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+def clip_by_norm(x, max_norm: float):
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale_f = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12),
+                        1.0)
+    return x * scale_f
+
+
+def increment(x, value: float = 1.0):
+    return x + value
+
+
+def stanh(x, scale_a: float = 0.67, scale_b: float = 1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def minus(x, y):
+    return x - y
+
+
+def cumsum(x, axis: Optional[int] = None, reverse: bool = False,
+           exclusive: bool = False):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    if reverse:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if exclusive:
+        out = out - x
+    if reverse:
+        out = jnp.flip(out, axis)
+    return out
+
+
+def cumprod(x, axis: int = 0):
+    return jnp.cumprod(x, axis=axis)
+
+
+def logcumsumexp(x, axis: int = 0):
+    return lax.cumlogsumexp(x, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# linalg (ref: cholesky_op.cc, inverse_op.cc, trace_op.cc, tril_triu_op.cc,
+# dist_op.cc, ...)
+# ---------------------------------------------------------------------------
+
+def cholesky(x, upper: bool = False):
+    out = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(out, -1, -2) if upper else out
+
+
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+def trace(x, offset: int = 0, axis1: int = 0, axis2: int = 1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def tril(x, diagonal: int = 0):
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x, diagonal: int = 0):
+    return jnp.triu(x, k=diagonal)
+
+
+def diag(x, offset: int = 0, padding_value: float = 0.0):
+    if x.ndim == 1:
+        out = jnp.diag(x, k=offset)
+        if padding_value != 0.0:
+            mask = jnp.diag(jnp.ones_like(x, dtype=bool), k=offset)
+            out = jnp.where(mask, out, padding_value)
+        return out
+    return jnp.diagonal(x, offset=offset)
+
+
+def diag_embed(x, offset: int = 0):
+    n = x.shape[-1] + builtins.abs(offset)
+    base = jnp.zeros(x.shape[:-1] + (n, n), dtype=x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    rows = idx + builtins.abs(min(offset, 0))
+    cols = idx + builtins.abs(max(offset, 0))
+    return base.at[..., rows, cols].set(x)
+
+
+def dist(x, y, p: float = 2.0):
+    d = (x - y).reshape(-1)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(d))
+    if p == 0:
+        return jnp.sum(d != 0).astype(x.dtype)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)
+
+
+def matrix_power(x, n: int):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def multiplex(inputs: Sequence[jax.Array], index):
+    """(ref: multiplex_op.cc) row-wise select among stacked inputs."""
+    stacked = jnp.stack(inputs, axis=0)  # [n, batch, ...]
+    idx = index.reshape(-1).astype(jnp.int32)
+    rows = jnp.arange(stacked.shape[1])
+    return stacked[idx, rows]

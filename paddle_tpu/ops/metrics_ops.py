@@ -1,0 +1,78 @@
+"""Metric ops.
+
+TPU-native lowerings for /root/reference/paddle/fluid/operators/metrics/:
+accuracy_op.cc, auc_op.cc, precision_recall_op.cc; plus chunk_eval-style
+helpers. Stateful accumulation lives in paddle_tpu.metric; these are the
+pure per-batch kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def accuracy(input, label, k: int = 1):
+    """(ref: accuracy_op.cc) fraction of rows whose top-k contains label."""
+    _, topk_idx = jax.lax.top_k(input, k)
+    lbl = label.reshape(-1, 1)
+    correct = jnp.any(topk_idx == lbl, axis=1)
+    return jnp.mean(correct.astype(jnp.float32))
+
+
+def auc_stats(pred_pos, label, num_thresholds: int = 2048):
+    """Per-batch (tp, fp) histogram buckets for streaming AUC
+    (ref: auc_op.cc)."""
+    bucket = jnp.clip((pred_pos * num_thresholds).astype(jnp.int32), 0,
+                      num_thresholds - 1)
+    pos = (label > 0).astype(jnp.float32).reshape(-1)
+    neg = 1.0 - pos
+    tp = jnp.zeros((num_thresholds,), jnp.float32).at[bucket.reshape(-1)].add(
+        pos)
+    fp = jnp.zeros((num_thresholds,), jnp.float32).at[bucket.reshape(-1)].add(
+        neg)
+    return tp, fp
+
+
+def auc_from_stats(tp_buckets, fp_buckets):
+    """Trapezoidal AUC over accumulated buckets (ref: auc_op.h AucKernel)."""
+    # sweep thresholds high→low: cumulative sums from the top bucket
+    tp_cum = jnp.cumsum(tp_buckets[::-1])
+    fp_cum = jnp.cumsum(fp_buckets[::-1])
+    tot_pos = tp_cum[-1]
+    tot_neg = fp_cum[-1]
+    tpr = tp_cum / jnp.maximum(tot_pos, 1.0)
+    fpr = fp_cum / jnp.maximum(tot_neg, 1.0)
+    tpr = jnp.concatenate([jnp.zeros(1), tpr])
+    fpr = jnp.concatenate([jnp.zeros(1), fpr])
+    return jnp.sum((fpr[1:] - fpr[:-1]) * (tpr[1:] + tpr[:-1]) / 2.0)
+
+
+def precision_recall_stats(pred_label, label, num_classes: int):
+    """Per-batch confusion counts (ref: precision_recall_op.cc)."""
+    pl = pred_label.reshape(-1).astype(jnp.int32)
+    tl = label.reshape(-1).astype(jnp.int32)
+    correct = (pl == tl)
+    tp = jnp.zeros((num_classes,), jnp.float32).at[pl].add(
+        correct.astype(jnp.float32))
+    pred_cnt = jnp.zeros((num_classes,), jnp.float32).at[pl].add(1.0)
+    true_cnt = jnp.zeros((num_classes,), jnp.float32).at[tl].add(1.0)
+    return tp, pred_cnt, true_cnt
+
+
+def positive_negative_pair(score, label, query_id):
+    """(ref: positive_negative_pair_op.cc) ranking pair stats per query."""
+    s = score.reshape(-1)
+    l = label.reshape(-1)
+    q = query_id.reshape(-1)
+    same_q = q[:, None] == q[None, :]
+    li = l[:, None]
+    lj = l[None, :]
+    si = s[:, None]
+    sj = s[None, :]
+    valid = same_q & (li > lj)
+    pos = jnp.sum(valid & (si > sj))
+    neg = jnp.sum(valid & (si < sj))
+    neu = jnp.sum(valid & (si == sj))
+    return pos.astype(jnp.float32), neg.astype(jnp.float32), \
+        neu.astype(jnp.float32)

@@ -1,0 +1,784 @@
+"""Neural-network functional ops.
+
+TPU-native lowerings for the reference's NN operator family
+(/root/reference/paddle/fluid/operators/: conv_op.cc + conv_cudnn_op.cu,
+conv_transpose_op.cc, pool_op.cc, batch_norm_op.cc, layer_norm_op.cc,
+instance_norm_op.cc, group_norm_op.cc, data_norm_op.cc, dropout_op.cc,
+lookup_table_v2_op.cc, one_hot_op.cc, interpolate_op.cc, unfold_op.cc,
+grid_sampler_op.cc, lrn_op.cc, affine_channel_op.cc, ...).
+
+Convs/matmuls lower to XLA conv_general_dilated / dot_general so they tile
+onto the MXU; layout is NCHW at the API (reference parity) with XLA free to
+re-layout internally. Norm ops return functional (out, new_stats) instead of
+mutating buffers — the Layer wrappers thread stats through step state.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import random as _random
+from ..flags import GLOBAL_FLAGS
+
+IntOrPair = Union[int, Sequence[int]]
+
+
+def _pair(v: IntOrPair, n: int = 2) -> Tuple[int, ...]:
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+def _conv_padding(padding, spatial: int):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * spatial
+    padding = list(padding)
+    if len(padding) == spatial:
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * spatial:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(spatial)]
+    raise ValueError(f"bad padding {padding}")
+
+
+# ---------------------------------------------------------------------------
+# convolution (ref: conv_op.cc, conv_cudnn_op.cu, depthwise_conv_op.cu)
+# ---------------------------------------------------------------------------
+
+def conv2d(x, weight, bias=None, stride: IntOrPair = 1,
+           padding: Union[str, IntOrPair] = 0, dilation: IntOrPair = 1,
+           groups: int = 1, data_format: str = "NCHW"):
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
+        else ("NHWC", "HWIO", "NHWC"))
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=_pair(stride),
+        padding=_conv_padding(padding, 2),
+        rhs_dilation=_pair(dilation), dimension_numbers=dn,
+        feature_group_count=groups, precision=None)
+    if bias is not None:
+        shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        out = out + bias.reshape(shape)
+    return out
+
+
+def conv3d(x, weight, bias=None, stride: IntOrPair = 1,
+           padding: Union[str, IntOrPair] = 0, dilation: IntOrPair = 1,
+           groups: int = 1, data_format: str = "NCDHW"):
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCDHW", "OIDHW", "NCDHW") if data_format == "NCDHW"
+        else ("NDHWC", "DHWIO", "NDHWC"))
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=_pair(stride, 3),
+        padding=_conv_padding(padding, 3),
+        rhs_dilation=_pair(dilation, 3), dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        shape = (1, -1, 1, 1, 1) if data_format == "NCDHW" else (1,) * 4 + (-1,)
+        out = out + bias.reshape(shape)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride: int = 1,
+           padding: Union[str, int] = 0, dilation: int = 1, groups: int = 1):
+    x4 = x[:, :, None, :]
+    w4 = weight[:, :, None, :]
+    pad = padding if isinstance(padding, str) else [0, padding]
+    out = conv2d(x4, w4, bias, stride=[1, stride], padding=pad,
+                 dilation=[1, dilation], groups=groups)
+    return out[:, :, 0, :]
+
+
+def depthwise_conv2d(x, weight, bias=None, stride: IntOrPair = 1,
+                     padding: Union[str, IntOrPair] = 0,
+                     dilation: IntOrPair = 1, data_format: str = "NCHW"):
+    channels = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+    return conv2d(x, weight, bias, stride, padding, dilation,
+                  groups=channels, data_format=data_format)
+
+
+def conv2d_transpose(x, weight, bias=None, stride: IntOrPair = 1,
+                     padding: IntOrPair = 0, output_padding: IntOrPair = 0,
+                     dilation: IntOrPair = 1, groups: int = 1,
+                     data_format: str = "NCHW"):
+    """(ref: conv_transpose_op.cc). weight layout [in, out//groups, kh, kw]."""
+    stride = _pair(stride)
+    pad = _conv_padding(padding, 2)
+    if isinstance(pad, str):
+        raise ValueError("string padding unsupported for transpose conv")
+    opad = _pair(output_padding)
+    dilation = _pair(dilation)
+    kh = (weight.shape[2] - 1) * dilation[0] + 1
+    kw = (weight.shape[3] - 1) * dilation[1] + 1
+    # Gradient-of-conv formulation: lhs_dilation=stride, flipped kernel.
+    pad_t = (kh - 1 - pad[0][0], kh - 1 - pad[0][1] + opad[0])
+    pad_l = (kw - 1 - pad[1][0], kw - 1 - pad[1][1] + opad[1])
+    w = jnp.flip(weight, axis=(2, 3))  # [I, O/g, kh, kw]
+    if groups > 1:
+        i, og, khs, kws = w.shape
+        w = w.reshape(groups, i // groups, og, khs, kws)
+        w = jnp.swapaxes(w, 1, 2).reshape(groups * og, i // groups, khs, kws)
+    else:
+        w = jnp.swapaxes(w, 0, 1)  # [O, I, kh, kw]
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=[pad_t, pad_l],
+        lhs_dilation=stride, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def conv_shift(x, y):
+    """(ref: conv_shift_op.cc) circular correlation of each row."""
+    b, m = x.shape
+    _, n = y.shape
+    half = n // 2
+    idx = (jnp.arange(m)[:, None] + jnp.arange(-half, half + 1)[None, :]) % m
+    gathered = x[:, idx]  # [b, m, n]
+    return jnp.einsum("bmn,bn->bm", gathered, y)
+
+
+# ---------------------------------------------------------------------------
+# pooling (ref: pool_op.cc, spp_op.cc, max_pool2d_with_index)
+# ---------------------------------------------------------------------------
+
+def _pool(x, kind: str, ksize: IntOrPair, stride: Optional[IntOrPair],
+          padding: IntOrPair, ceil_mode: bool, exclusive: bool,
+          spatial: int, global_pool: bool):
+    if global_pool:
+        ksize = x.shape[2:2 + spatial]
+        stride = ksize
+        padding = 0
+    ksize = _pair(ksize, spatial)
+    stride = _pair(stride if stride is not None else ksize, spatial)
+    pads = _conv_padding(padding, spatial)
+    window = (1, 1) + ksize
+    strides = (1, 1) + stride
+    if isinstance(pads, str):
+        padding_cfg = pads
+    else:
+        padding_cfg = [(0, 0), (0, 0)] + list(pads)
+        if ceil_mode:
+            padding_cfg = [
+                (lo, hi + (s - 1)) if i >= 2 else (lo, hi)
+                for i, ((lo, hi), s) in enumerate(zip(padding_cfg, strides))]
+    if kind == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, strides,
+                                 padding_cfg)
+    summed = lax.reduce_window(x, 0.0, lax.add, window, strides, padding_cfg)
+    if exclusive and (isinstance(padding_cfg, list)
+                      and builtins.any(p != (0, 0) for p in padding_cfg)):
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides,
+                                   padding_cfg)
+        return summed / jnp.maximum(counts, 1.0)
+    denom = 1.0
+    for k in ksize:
+        denom *= k
+    return summed / denom
+
+
+def max_pool2d(x, kernel_size: IntOrPair, stride: Optional[IntOrPair] = None,
+               padding: IntOrPair = 0, ceil_mode: bool = False):
+    return _pool(x, "max", kernel_size, stride, padding, ceil_mode, True, 2,
+                 False)
+
+
+def avg_pool2d(x, kernel_size: IntOrPair, stride: Optional[IntOrPair] = None,
+               padding: IntOrPair = 0, ceil_mode: bool = False,
+               exclusive: bool = True):
+    return _pool(x, "avg", kernel_size, stride, padding, ceil_mode,
+                 exclusive, 2, False)
+
+
+def max_pool3d(x, kernel_size: IntOrPair, stride: Optional[IntOrPair] = None,
+               padding: IntOrPair = 0, ceil_mode: bool = False):
+    return _pool(x, "max", kernel_size, stride, padding, ceil_mode, True, 3,
+                 False)
+
+
+def avg_pool3d(x, kernel_size: IntOrPair, stride: Optional[IntOrPair] = None,
+               padding: IntOrPair = 0, ceil_mode: bool = False,
+               exclusive: bool = True):
+    return _pool(x, "avg", kernel_size, stride, padding, ceil_mode,
+                 exclusive, 3, False)
+
+
+def pool2d(x, pool_size: IntOrPair = -1, pool_type: str = "max",
+           pool_stride: IntOrPair = 1, pool_padding: IntOrPair = 0,
+           global_pooling: bool = False, ceil_mode: bool = False,
+           exclusive: bool = True):
+    """Legacy fluid.layers.pool2d signature (ref: pool_op.cc)."""
+    return _pool(x, pool_type, pool_size, pool_stride, pool_padding,
+                 ceil_mode, exclusive, 2, global_pooling)
+
+
+def adaptive_avg_pool2d(x, output_size: IntOrPair):
+    oh, ow = _pair(output_size)
+    n, c, h, w = x.shape
+    if h % oh == 0 and w % ow == 0:
+        return jnp.mean(x.reshape(n, c, oh, h // oh, ow, w // ow),
+                        axis=(3, 5))
+    # General case: mean over variable windows via interpolation-style bins
+    out = jnp.zeros((n, c, oh, ow), dtype=x.dtype)
+    rows = [(h * i) // oh for i in range(oh + 1)]
+    cols = [(w * j) // ow for j in range(ow + 1)]
+    parts = []
+    for i in range(oh):
+        row = []
+        for j in range(ow):
+            row.append(jnp.mean(
+                x[:, :, rows[i]:rows[i + 1], cols[j]:cols[j + 1]],
+                axis=(2, 3)))
+        parts.append(jnp.stack(row, axis=-1))
+    return jnp.stack(parts, axis=-2)
+
+
+def adaptive_max_pool2d(x, output_size: IntOrPair):
+    oh, ow = _pair(output_size)
+    n, c, h, w = x.shape
+    if h % oh == 0 and w % ow == 0:
+        return jnp.max(x.reshape(n, c, oh, h // oh, ow, w // ow),
+                       axis=(3, 5))
+    rows = [(h * i) // oh for i in range(oh + 1)]
+    cols = [(w * j) // ow for j in range(ow + 1)]
+    parts = []
+    for i in range(oh):
+        row = []
+        for j in range(ow):
+            row.append(jnp.max(
+                x[:, :, rows[i]:rows[i + 1], cols[j]:cols[j + 1]],
+                axis=(2, 3)))
+        parts.append(jnp.stack(row, axis=-1))
+    return jnp.stack(parts, axis=-2)
+
+
+def max_pool2d_with_index(x, kernel_size: IntOrPair,
+                          stride: Optional[IntOrPair] = None,
+                          padding: IntOrPair = 0):
+    """(ref: max_pool2d_with_index_op) returns (out, argmax flat indices)."""
+    out = max_pool2d(x, kernel_size, stride, padding)
+    n, c, h, w = x.shape
+    flat_idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+    flat_idx = jnp.broadcast_to(flat_idx, x.shape)
+    # select index of max via reduce_window over (value, index) pairs
+    ksize = _pair(kernel_size)
+    stride_ = _pair(stride if stride is not None else kernel_size)
+    pads = _conv_padding(padding, 2)
+    window = (1, 1) + ksize
+    strides = (1, 1) + stride_
+
+    def reducer(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
+
+    vals, idxs = lax.reduce_window(
+        (x, flat_idx), (-jnp.inf, jnp.float32(-1)), reducer, window, strides,
+        [(0, 0), (0, 0)] + list(pads))
+    return vals, idxs.astype(jnp.int64)
+
+
+def unpool(x, indices, kernel_size: IntOrPair, stride: IntOrPair = None,
+           output_size: Optional[Sequence[int]] = None):
+    """(ref: unpool_op.cc) scatter pooled values back by argmax index."""
+    n, c, h, w = x.shape
+    ksize = _pair(kernel_size)
+    stride = _pair(stride if stride is not None else kernel_size)
+    if output_size is None:
+        oh = (h - 1) * stride[0] + ksize[0]
+        ow = (w - 1) * stride[1] + ksize[1]
+    else:
+        oh, ow = output_size[-2:]
+    out = jnp.zeros((n, c, oh * ow), dtype=x.dtype)
+    flat_idx = indices.reshape(n, c, -1).astype(jnp.int32)
+    vals = x.reshape(n, c, -1)
+    out = jax.vmap(jax.vmap(lambda o, i, v: o.at[i].set(v)))(out, flat_idx,
+                                                            vals)
+    return out.reshape(n, c, oh, ow)
+
+
+# ---------------------------------------------------------------------------
+# normalization — functional, stats threaded (see module docstring)
+# ---------------------------------------------------------------------------
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training: bool = False, momentum: float = 0.9,
+               epsilon: float = 1e-5, data_format: str = "NCHW"):
+    """Returns (out, new_running_mean, new_running_var).
+
+    (ref: batch_norm_op.cc; momentum semantics: new = m*old + (1-m)*batch)
+    """
+    if data_format in ("NCHW", "NCL", "NCDHW"):
+        axes = (0,) + tuple(range(2, x.ndim))
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        axes = tuple(range(x.ndim - 1))
+        shape = (1,) * (x.ndim - 1) + (-1,)
+    if training:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        n = x.size // x.shape[1 if data_format.startswith("NC") else -1]
+        unbiased = var * n / builtins.max(n - 1, 1)
+        new_mean = momentum * running_mean + (1 - momentum) * mean
+        new_var = momentum * running_var + (1 - momentum) * unbiased
+    else:
+        mean, var = running_mean, running_var
+        new_mean, new_var = running_mean, running_var
+    inv = lax.rsqrt(var + epsilon)
+    out = (x - mean.reshape(shape)) * inv.reshape(shape)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, new_mean, new_var
+
+
+def sync_batch_norm(x, running_mean, running_var, weight=None, bias=None,
+                    training: bool = False, momentum: float = 0.9,
+                    epsilon: float = 1e-5, data_format: str = "NCHW",
+                    axis_name: Optional[str] = None):
+    """(ref: sync_batch_norm_op.cc) — batch stats allreduced over the data
+    axis when run inside shard_map/pmap with ``axis_name``."""
+    if not training or axis_name is None:
+        return batch_norm(x, running_mean, running_var, weight, bias,
+                          training, momentum, epsilon, data_format)
+    if data_format.startswith("NC"):
+        axes = (0,) + tuple(range(2, x.ndim))
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        axes = tuple(range(x.ndim - 1))
+        shape = (1,) * (x.ndim - 1) + (-1,)
+    mean = lax.pmean(jnp.mean(x, axis=axes), axis_name)
+    mean_sq = lax.pmean(jnp.mean(jnp.square(x), axis=axes), axis_name)
+    var = mean_sq - jnp.square(mean)
+    new_mean = momentum * running_mean + (1 - momentum) * mean
+    new_var = momentum * running_var + (1 - momentum) * var
+    inv = lax.rsqrt(var + epsilon)
+    out = (x - mean.reshape(shape)) * inv.reshape(shape)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, new_mean, new_var
+
+
+def layer_norm(x, weight=None, bias=None, epsilon: float = 1e-5,
+               begin_norm_axis: int = -1):
+    """(ref: layer_norm_op.cc). Normalizes over dims [begin_norm_axis:)."""
+    if begin_norm_axis < 0:
+        begin_norm_axis = x.ndim + begin_norm_axis
+    axes = tuple(range(begin_norm_axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + epsilon)
+    norm_shape = x.shape[begin_norm_axis:]
+    if weight is not None:
+        out = out * weight.reshape(norm_shape)
+    if bias is not None:
+        out = out + bias.reshape(norm_shape)
+    return out
+
+
+def instance_norm(x, weight=None, bias=None, epsilon: float = 1e-5):
+    """(ref: instance_norm_op.cc) NCHW; per-(n, c) spatial stats."""
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + epsilon)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+def group_norm(x, groups: int, weight=None, bias=None,
+               epsilon: float = 1e-5, data_format: str = "NCHW"):
+    """(ref: group_norm_op.cc)."""
+    if data_format != "NCHW":
+        raise NotImplementedError("group_norm supports NCHW")
+    n, c = x.shape[:2]
+    spatial = x.shape[2:]
+    g = x.reshape((n, groups, c // groups) + spatial)
+    axes = tuple(range(2, g.ndim))
+    mean = jnp.mean(g, axis=axes, keepdims=True)
+    var = jnp.var(g, axis=axes, keepdims=True)
+    out = ((g - mean) * lax.rsqrt(var + epsilon)).reshape(x.shape)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+def local_response_norm(x, size: int = 5, alpha: float = 1e-4,
+                        beta: float = 0.75, k: float = 1.0):
+    """(ref: lrn_op.cc) NCHW cross-channel LRN."""
+    sq = jnp.square(x)
+    half = size // 2
+    padded = jnp.pad(sq, ((0, 0), (half, size - 1 - half), (0, 0), (0, 0)))
+    window = jnp.stack([padded[:, i:i + x.shape[1]] for i in range(size)],
+                       axis=0).sum(axis=0)
+    return x / jnp.power(k + alpha * window, beta)
+
+
+lrn = local_response_norm
+
+
+def data_norm(x, batch_size, batch_sum, batch_square_sum,
+              epsilon: float = 1e-4):
+    """(ref: data_norm_op.cc) normalization by accumulated batch statistics."""
+    mean = batch_sum / batch_size
+    scale = lax.rsqrt(batch_square_sum / batch_size - jnp.square(mean)
+                      + epsilon)
+    return (x - mean) * scale
+
+
+def affine_channel(x, scale, bias, data_format: str = "NCHW"):
+    """(ref: affine_channel_op.cc)."""
+    shape = (1, -1) + (1,) * (x.ndim - 2) if data_format == "NCHW" \
+        else (1,) * (x.ndim - 1) + (-1,)
+    return x * scale.reshape(shape) + bias.reshape(shape)
+
+
+def spectral_norm(weight, u, v, power_iters: int = 1, epsilon: float = 1e-12,
+                  dim: int = 0):
+    """(ref: spectral_norm_op.cc) returns normalized weight."""
+    w = jnp.moveaxis(weight, dim, 0)
+    w_mat = w.reshape(w.shape[0], -1)
+
+    def body(_, uv):
+        u_, v_ = uv
+        v_ = w_mat.T @ u_
+        v_ = v_ / (jnp.linalg.norm(v_) + epsilon)
+        u_ = w_mat @ v_
+        u_ = u_ / (jnp.linalg.norm(u_) + epsilon)
+        return (u_, v_)
+
+    u, v = lax.fori_loop(0, power_iters, body, (u, v))
+    sigma = u @ w_mat @ v
+    return weight / sigma
+
+
+# ---------------------------------------------------------------------------
+# dropout & friends (ref: dropout_op.cc)
+# ---------------------------------------------------------------------------
+
+def dropout(x, p: float = 0.5, training: bool = True,
+            mode: str = "upscale_in_train", key=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return x * (1.0 - p)
+        return x
+    if key is None:
+        key = _random.next_key("dropout")
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    return jnp.where(keep, x, 0.0).astype(x.dtype)
+
+
+def dropout2d(x, p: float = 0.5, training: bool = True, key=None):
+    if not training or p == 0.0:
+        return x
+    if key is None:
+        key = _random.next_key("dropout")
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape[:2] + (1, 1))
+    return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+
+
+def alpha_dropout(x, p: float = 0.5, training: bool = True, key=None):
+    if not training or p == 0.0:
+        return x
+    if key is None:
+        key = _random.next_key("dropout")
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    a = (1.0 / ((1 - p) * (1 + p * alpha_p ** 2)) ** 0.5)
+    b = -a * alpha_p * p
+    return (a * jnp.where(keep, x, alpha_p) + b).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# embedding / one-hot (ref: lookup_table_v2_op.cc, one_hot_op.cc)
+# ---------------------------------------------------------------------------
+
+def embedding(ids, weight, padding_idx: Optional[int] = None):
+    out = jnp.take(weight, ids, axis=0)
+    if padding_idx is not None:
+        mask = (ids != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+lookup_table = embedding
+
+
+def one_hot(x, num_classes: int, dtype="float32"):
+    from ..core.dtype import convert_dtype
+    return jax.nn.one_hot(x, num_classes, dtype=convert_dtype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# linear / fc
+# ---------------------------------------------------------------------------
+
+def linear(x, weight, bias=None):
+    """weight is [in, out] (reference fc convention, fc_op.cc)."""
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+fc = linear
+
+
+# ---------------------------------------------------------------------------
+# interpolate (ref: interpolate_op.cc: nearest/bilinear/bicubic/trilinear)
+# ---------------------------------------------------------------------------
+
+def interpolate(x, size: Optional[Sequence[int]] = None,
+                scale_factor: Optional[Union[float, Sequence[float]]] = None,
+                mode: str = "nearest", align_corners: bool = False,
+                data_format: str = "NCHW"):
+    if data_format not in ("NCHW", "NCDHW", "NCL"):
+        raise NotImplementedError("interpolate supports channel-first")
+    spatial_in = x.shape[2:]
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * len(spatial_in)
+        size = [int(s * f) for s, f in zip(spatial_in, scale_factor)]
+    size = tuple(int(s) for s in size)
+
+    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+              "trilinear": "linear", "bicubic": "cubic",
+              "area": "linear"}[mode]
+    if align_corners and method != "nearest":
+        # jax.image.resize has no align_corners; build index grid manually.
+        return _resize_align_corners(x, size, method)
+    out_shape = x.shape[:2] + size
+    return jax.image.resize(x, out_shape, method=method)
+
+
+def _resize_align_corners(x, size, method):
+    spatial_in = x.shape[2:]
+    coords = []
+    for s_in, s_out in zip(spatial_in, size):
+        if s_out == 1:
+            coords.append(jnp.zeros((1,)))
+        else:
+            coords.append(jnp.linspace(0.0, s_in - 1, s_out))
+    if len(size) == 1:
+        coords = [jnp.zeros((1,)), coords[0]]
+        x = x[:, :, None, :]
+        out = _resize_align_corners(x, (1, size[0]), method)
+        return out[:, :, 0, :]
+    if len(size) == 2:
+        h, w = coords
+        if method == "nearest":
+            hi = jnp.round(h).astype(jnp.int32)
+            wi = jnp.round(w).astype(jnp.int32)
+            return x[:, :, hi[:, None], wi[None, :]]
+        h0 = jnp.floor(h).astype(jnp.int32)
+        h1 = jnp.minimum(h0 + 1, spatial_in[0] - 1)
+        w0 = jnp.floor(w).astype(jnp.int32)
+        w1 = jnp.minimum(w0 + 1, spatial_in[1] - 1)
+        fh2 = (h - h0)[None, None, :, None]
+        fw2 = (w - w0)[None, None, None, :]
+        tl = x[:, :, h0[:, None], w0[None, :]]
+        tr = x[:, :, h0[:, None], w1[None, :]]
+        bl = x[:, :, h1[:, None], w0[None, :]]
+        br = x[:, :, h1[:, None], w1[None, :]]
+        top = tl + (tr - tl) * fw2
+        bot = bl + (br - bl) * fw2
+        return top + (bot - top) * fh2
+    if len(size) == 3:
+        out_shape = x.shape[:2] + tuple(size)
+        return jax.image.resize(x, out_shape, method=method)
+    raise NotImplementedError
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False):
+    return interpolate(x, size, scale_factor, mode, align_corners)
+
+
+# ---------------------------------------------------------------------------
+# unfold / grid sample / misc vision-adjacent
+# ---------------------------------------------------------------------------
+
+def unfold(x, kernel_sizes: IntOrPair, strides: IntOrPair = 1,
+           paddings: IntOrPair = 0, dilations: IntOrPair = 1):
+    """(ref: unfold_op.cc = im2col) NCHW → [N, C*kh*kw, L]."""
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    pads = _conv_padding(paddings, 2)
+    n, c, h, w = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), pads[0], pads[1]))
+    hp = x.shape[2]
+    wp = x.shape[3]
+    oh = (hp - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (wp - (dw * (kw - 1) + 1)) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            patch = x[:, :, i * dh:i * dh + oh * sh:sh,
+                      j * dw:j * dw + ow * sw:sw]
+            patches.append(patch)
+    out = jnp.stack(patches, axis=2)  # [N, C, kh*kw, oh, ow]
+    return out.reshape(n, c * kh * kw, oh * ow)
+
+
+def fold(x, output_sizes: IntOrPair, kernel_sizes: IntOrPair,
+         strides: IntOrPair = 1, paddings: IntOrPair = 0,
+         dilations: IntOrPair = 1):
+    oh_, ow_ = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    pads = _conv_padding(paddings, 2)
+    n, ckk, l = x.shape
+    c = ckk // (kh * kw)
+    hp = oh_ + pads[0][0] + pads[0][1]
+    wp = ow_ + pads[1][0] + pads[1][1]
+    oh = (hp - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (wp - (dw * (kw - 1) + 1)) // sw + 1
+    cols = x.reshape(n, c, kh * kw, oh, ow)
+    out = jnp.zeros((n, c, hp, wp), dtype=x.dtype)
+    k = 0
+    for i in range(kh):
+        for j in range(kw):
+            out = out.at[:, :, i * dh:i * dh + oh * sh:sh,
+                         j * dw:j * dw + ow * sw:sw].add(cols[:, :, k])
+            k += 1
+    return out[:, :, pads[0][0]:hp - pads[0][1], pads[1][0]:wp - pads[1][1]]
+
+
+def grid_sample(x, grid, mode: str = "bilinear",
+                padding_mode: str = "zeros", align_corners: bool = True):
+    """(ref: grid_sampler_op.cc) NCHW x, grid [N, Ho, Wo, 2] in [-1, 1]."""
+    n, c, h, w = x.shape
+    gx = grid[..., 0]
+    gy = grid[..., 1]
+    if align_corners:
+        fx = (gx + 1) * (w - 1) / 2
+        fy = (gy + 1) * (h - 1) / 2
+    else:
+        fx = ((gx + 1) * w - 1) / 2
+        fy = ((gy + 1) * h - 1) / 2
+
+    def sample(ix, iy):
+        valid = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+        ix_c = jnp.clip(ix, 0, w - 1)
+        iy_c = jnp.clip(iy, 0, h - 1)
+        # batched gather: out[n, c, ho, wo] = x[n, c, iy[n,ho,wo], ix[n,ho,wo]]
+        vals = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(x, iy_c, ix_c)
+        if padding_mode == "zeros":
+            vals = vals * valid[:, None].astype(x.dtype)
+        return vals
+
+    if mode == "nearest":
+        return sample(jnp.round(fx).astype(jnp.int32),
+                      jnp.round(fy).astype(jnp.int32))
+    x0 = jnp.floor(fx).astype(jnp.int32)
+    y0 = jnp.floor(fy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wa = ((x1 - fx) * (y1 - fy))[:, None]
+    wb = ((x1 - fx) * (fy - y0))[:, None]
+    wc = ((fx - x0) * (y1 - fy))[:, None]
+    wd = ((fx - x0) * (fy - y0))[:, None]
+    return (sample(x0, y0) * wa + sample(x0, y1) * wb
+            + sample(x1, y0) * wc + sample(x1, y1) * wd).astype(x.dtype)
+
+
+def affine_grid(theta, out_shape: Sequence[int], align_corners: bool = True):
+    """(ref: affine_grid_op.cc) theta [N,2,3] → grid [N,H,W,2]."""
+    n, _, h, w = out_shape
+
+    def linsp(num):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, num)
+        step = 2.0 / num
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, num)
+
+    ys = linsp(h)
+    xs = linsp(w)
+    gx, gy = jnp.meshgrid(xs, ys)
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)  # [H, W, 3]
+    return jnp.einsum("hwk,njk->nhwj", base, theta)
+
+
+# ---------------------------------------------------------------------------
+# misc nn ops
+# ---------------------------------------------------------------------------
+
+def cosine_similarity(x1, x2, axis: int = 1, eps: float = 1e-8):
+    dot_ = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot_ / jnp.maximum(n1 * n2, eps)
+
+
+def cos_sim(x, y):
+    """(ref: cos_sim_op.cc)."""
+    return cosine_similarity(x, y, axis=-1)[..., None]
+
+
+def normalize(x, p: float = 2, axis: int = 1, epsilon: float = 1e-12):
+    norm = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis,
+                             keepdims=True), 1.0 / p)
+    return x / jnp.maximum(norm, epsilon)
+
+
+def l2_normalize(x, axis: int = -1, epsilon: float = 1e-12):
+    return x * lax.rsqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True)
+                         + epsilon)
+
+
+def label_smooth(label, prior_dist=None, epsilon: float = 0.1):
+    """(ref: label_smooth_op.cc)."""
+    k = label.shape[-1]
+    if prior_dist is None:
+        return (1 - epsilon) * label + epsilon / k
+    return (1 - epsilon) * label + epsilon * prior_dist
+
+
+def pad2d(x, paddings, mode: str = "constant", pad_value: float = 0.0,
+          data_format: str = "NCHW"):
+    from .manipulation import pad as _pad
+    return _pad(x, paddings, mode=mode, value=pad_value,
+                data_format=data_format)
+
+
+def npair_loss(anchor, positive, labels, l2_reg: float = 0.002):
+    """(ref: npair_loss in layers/loss.py)."""
+    reg = l2_reg * (jnp.sum(jnp.square(anchor), axis=1)
+                    + jnp.sum(jnp.square(positive), axis=1)).mean() * 0.25
+    logits = anchor @ positive.T
+    labels = labels.reshape(-1)
+    same = (labels[:, None] == labels[None, :]).astype(logits.dtype)
+    prob = same / jnp.sum(same, axis=1, keepdims=True)
+    xent = -jnp.sum(prob * jax.nn.log_softmax(logits, axis=1), axis=1)
+    return jnp.mean(xent) + reg

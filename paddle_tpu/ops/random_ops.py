@@ -1,0 +1,129 @@
+"""Random ops.
+
+TPU-native lowerings for /root/reference/paddle/fluid/operators/:
+gaussian_random_op.cc, uniform_random_op.cc, truncated_gaussian_random_op.cc,
+randint_op ~ (via uniform), randperm, bernoulli, multinomial
+(sample_logits_op.cc neighborhood), shuffle_batch_op.cc, dropout is in
+nn_functional. Keys come from the bound rng scope under jit or the global
+generator eagerly (core/random.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _random
+from ..core.dtype import convert_dtype
+
+
+def _key(key):
+    return key if key is not None else _random.next_key("random")
+
+
+def uniform(shape: Sequence[int], dtype="float32", min: float = -1.0,
+            max: float = 1.0, key=None):
+    return jax.random.uniform(_key(key), tuple(shape),
+                              convert_dtype(dtype), min, max)
+
+
+uniform_random = uniform
+
+
+def gaussian(shape: Sequence[int], mean: float = 0.0, std: float = 1.0,
+             dtype="float32", key=None):
+    return mean + std * jax.random.normal(_key(key), tuple(shape),
+                                          convert_dtype(dtype))
+
+
+gaussian_random = gaussian
+
+
+def normal(mean=0.0, std=1.0, shape=None, key=None):
+    if shape is None:
+        shape = jnp.broadcast_shapes(jnp.shape(mean), jnp.shape(std))
+    return mean + std * jax.random.normal(_key(key), tuple(shape))
+
+
+def standard_normal(shape, dtype="float32", key=None):
+    return jax.random.normal(_key(key), tuple(shape), convert_dtype(dtype))
+
+
+def randn(shape, dtype="float32", key=None):
+    return standard_normal(shape, dtype, key)
+
+
+def rand(shape, dtype="float32", key=None):
+    return jax.random.uniform(_key(key), tuple(shape), convert_dtype(dtype))
+
+
+def randint(low: int, high: Optional[int] = None, shape=(1,),
+            dtype="int64", key=None):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(_key(key), tuple(shape), low, high,
+                              convert_dtype(dtype))
+
+
+def randperm(n: int, dtype="int64", key=None):
+    return jax.random.permutation(_key(key), n).astype(convert_dtype(dtype))
+
+
+def truncated_gaussian_random(shape, mean: float = 0.0, std: float = 1.0,
+                              dtype="float32", a: float = -2.0,
+                              b: float = 2.0, key=None):
+    return mean + std * jax.random.truncated_normal(
+        _key(key), a, b, tuple(shape), convert_dtype(dtype))
+
+
+truncated_normal = truncated_gaussian_random
+
+
+def bernoulli(p, key=None):
+    return jax.random.bernoulli(_key(key), p).astype(jnp.float32)
+
+
+def multinomial(probs, num_samples: int = 1, replacement: bool = False,
+                key=None):
+    logits = jnp.log(jnp.maximum(probs, 1e-20))
+    k = _key(key)
+    if replacement:
+        return jax.random.categorical(
+            k, logits, axis=-1,
+            shape=(num_samples,) + logits.shape[:-1]).T
+    # Gumbel top-k for sampling without replacement
+    g = jax.random.gumbel(k, logits.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx
+
+
+def shuffle(x, axis: int = 0, key=None):
+    return jax.random.permutation(_key(key), x, axis=axis)
+
+
+def shuffle_batch(x, key=None):
+    """(ref: shuffle_batch_op.cc) shuffle along batch dim."""
+    return shuffle(x, axis=0, key=key)
+
+
+def sample_logits(logits, labels, num_samples: int, key=None):
+    """(ref: sample_logits_op.cc) sampled-softmax helper: returns
+    (sampled_logits, sampled_labels) with true label at column 0."""
+    b, c = logits.shape
+    k = _key(key)
+    neg = jax.random.randint(k, (b, num_samples), 0, c)
+    lbl = labels.reshape(-1, 1).astype(jnp.int32)
+    idx = jnp.concatenate([lbl, neg], axis=1)
+    sampled = jnp.take_along_axis(logits, idx, axis=1)
+    return sampled, jnp.zeros((b,), dtype=jnp.int64)
+
+
+def poisson(lam, key=None):
+    return jax.random.poisson(_key(key), lam).astype(jnp.float32)
+
+
+def exponential(shape, rate: float = 1.0, dtype="float32", key=None):
+    return jax.random.exponential(_key(key), tuple(shape),
+                                  convert_dtype(dtype)) / rate

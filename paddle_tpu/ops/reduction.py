@@ -1,0 +1,112 @@
+"""Reduction ops.
+
+TPU-native lowerings for /root/reference/paddle/fluid/operators/reduce_ops/
+(reduce_sum/mean/max/min/prod/any/all over axes) plus norm ops
+(frobenius_norm_op, p_norm_op, squared_l2_norm_op, l1_norm_op) and
+logsumexp. Reductions lower to XLA reduce ops which tile onto the VPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax.numpy as jnp
+
+Axes = Optional[Union[int, Sequence[int]]]
+
+
+def _norm_axis(axis: Axes):
+    if axis is None:
+        return None
+    if isinstance(axis, int):
+        return axis
+    return tuple(axis)
+
+
+def sum(x, axis: Axes = None, keepdim: bool = False, dtype=None):
+    return jnp.sum(x, axis=_norm_axis(axis), keepdims=keepdim, dtype=dtype)
+
+
+def mean(x, axis: Axes = None, keepdim: bool = False):
+    return jnp.mean(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def max(x, axis: Axes = None, keepdim: bool = False):
+    return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def min(x, axis: Axes = None, keepdim: bool = False):
+    return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def prod(x, axis: Axes = None, keepdim: bool = False, dtype=None):
+    return jnp.prod(x, axis=_norm_axis(axis), keepdims=keepdim, dtype=dtype)
+
+
+def any(x, axis: Axes = None, keepdim: bool = False):
+    return jnp.any(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def all(x, axis: Axes = None, keepdim: bool = False):
+    return jnp.all(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def logsumexp(x, axis: Axes = None, keepdim: bool = False):
+    from jax.scipy.special import logsumexp as _lse
+    return _lse(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def frobenius_norm(x, axis: Axes = None, keepdim: bool = False):
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=_norm_axis(axis),
+                            keepdims=keepdim))
+
+
+def p_norm(x, p: float = 2.0, axis: Optional[int] = None,
+           keepdim: bool = False, epsilon: float = 1e-12):
+    a = _norm_axis(axis)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=a, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=a, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=a, keepdims=keepdim)
+    s = jnp.sum(jnp.power(jnp.abs(x), p), axis=a, keepdims=keepdim)
+    return jnp.power(jnp.maximum(s, epsilon), 1.0 / p)
+
+
+def squared_l2_norm(x):
+    return jnp.sum(jnp.square(x))
+
+
+def l1_norm(x):
+    return jnp.sum(jnp.abs(x))
+
+
+def nanmean(x, axis: Axes = None, keepdim: bool = False):
+    return jnp.nanmean(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def nansum(x, axis: Axes = None, keepdim: bool = False):
+    return jnp.nansum(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def var(x, axis: Axes = None, unbiased: bool = True, keepdim: bool = False):
+    return jnp.var(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+def std(x, axis: Axes = None, unbiased: bool = True, keepdim: bool = False):
+    return jnp.std(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+def median(x, axis: Optional[int] = None, keepdim: bool = False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def amax(x, axis: Axes = None, keepdim: bool = False):
+    return jnp.amax(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def amin(x, axis: Axes = None, keepdim: bool = False):
+    return jnp.amin(x, axis=_norm_axis(axis), keepdims=keepdim)

@@ -1,0 +1,169 @@
+"""Search / sort / comparison ops.
+
+TPU-native lowerings for /root/reference/paddle/fluid/operators/:
+argsort_op.cc, arg_max_op.cc, arg_min_op.cc, top_k_op.cc (+top_k_v2),
+compare ops (controlflow/compare_op.cc), logical ops
+(controlflow/logical_op.cc), isfinite ops, kthvalue/mode/searchsorted
+equivalents. Sorts lower to XLA variadic sort; top_k to lax.top_k
+(TPU-optimized bitonic path).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def argsort(x, axis: int = -1, descending: bool = False):
+    """Returns (sorted, indices) like the reference argsort op."""
+    idx = jnp.argsort(-x if descending else x, axis=axis, stable=True)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return out, idx
+
+
+def sort(x, axis: int = -1, descending: bool = False):
+    out = jnp.sort(x, axis=axis)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+def argmax(x, axis: int = -1, keepdim: bool = False, dtype="int64"):
+    from ..core.dtype import convert_dtype
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim)
+    return out.astype(convert_dtype(dtype))
+
+
+def argmin(x, axis: int = -1, keepdim: bool = False, dtype="int64"):
+    from ..core.dtype import convert_dtype
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim)
+    return out.astype(convert_dtype(dtype))
+
+
+def topk(x, k: int, axis: int = -1, largest: bool = True,
+         sorted: bool = True):
+    axis = axis % x.ndim
+    if axis != x.ndim - 1:
+        xt = jnp.moveaxis(x, axis, -1)
+    else:
+        xt = x
+    if largest:
+        vals, idxs = lax.top_k(xt, k)
+    else:
+        vals, idxs = lax.top_k(-xt, k)
+        vals = -vals
+    if axis != x.ndim - 1:
+        vals = jnp.moveaxis(vals, -1, axis)
+        idxs = jnp.moveaxis(idxs, -1, axis)
+    return vals, idxs.astype(jnp.int64)
+
+
+def kthvalue(x, k: int, axis: int = -1, keepdim: bool = False):
+    s = jnp.sort(x, axis=axis)
+    idx = jnp.argsort(x, axis=axis, stable=True)
+    taken = jnp.take(s, k - 1, axis=axis)
+    taken_idx = jnp.take(idx, k - 1, axis=axis)
+    if keepdim:
+        taken = jnp.expand_dims(taken, axis)
+        taken_idx = jnp.expand_dims(taken_idx, axis)
+    return taken, taken_idx.astype(jnp.int64)
+
+
+def mode(x, axis: int = -1, keepdim: bool = False):
+    sorted_x = jnp.sort(x, axis=axis)
+    # count occurrences pairwise (O(n^2) — mode is not a hot op)
+    moved = jnp.moveaxis(sorted_x, axis, -1)
+    counts = jnp.sum(moved[..., :, None] == moved[..., None, :], axis=-1)
+    best = jnp.argmax(counts, axis=-1)
+    vals = jnp.take_along_axis(moved, best[..., None], axis=-1)[..., 0]
+    idx = jnp.argmax(jnp.moveaxis(x, axis, -1) == vals[..., None], axis=-1)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+def searchsorted(sorted_sequence, values, right: bool = False):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        return jnp.searchsorted(sorted_sequence, values, side=side)
+    return jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+        sorted_sequence, values)
+
+
+def bucketize(x, sorted_sequence, right: bool = False):
+    return jnp.searchsorted(sorted_sequence, x,
+                            side="right" if right else "left")
+
+
+# comparison (ref: controlflow/compare_op.cc)
+
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+def allclose(x, y, rtol: float = 1e-5, atol: float = 1e-8,
+             equal_nan: bool = False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol: float = 1e-5, atol: float = 1e-8,
+            equal_nan: bool = False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+# logical (ref: controlflow/logical_op.cc)
+
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+def bitwise_not(x):
+    return jnp.bitwise_not(x)

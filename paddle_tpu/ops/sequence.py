@@ -1,0 +1,204 @@
+"""Ragged-sequence ops.
+
+TPU-native redesign of the reference's LoD (level-of-detail) sequence system
+(/root/reference/paddle/fluid/framework/lod_tensor.h:104 and
+operators/sequence_ops/: sequence_pool_op.cc, sequence_pad_op.cc,
+sequence_unpad_op.cc, sequence_expand_op.cc, sequence_softmax_op.cc,
+sequence_mask_op.cc, sequence_reverse_op.cc, sequence_concat_op.cc,
+sequence_erase_op.cc, sequence_enumerate_op.cc, ...).
+
+XLA requires static shapes, so the LoD ragged layout becomes **dense padded
+[batch, max_len, ...] + per-row lengths** — every op here takes ``(x, length)``
+instead of a packed LoD tensor. This is the idiomatic TPU representation
+(masking fuses into the surrounding compute; no dynamic shapes), and
+:class:`RaggedBatch` in core/lod.py converts between packed numpy LoD data and
+this layout at the host boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def sequence_mask(lengths, maxlen: Optional[int] = None, dtype="bool"):
+    """(ref: sequence_mask_op.cc)."""
+    from ..core.dtype import convert_dtype
+    if maxlen is None:
+        maxlen = int(jnp.max(lengths))  # eager only; pass maxlen under jit
+    steps = jnp.arange(maxlen)
+    mask = steps[None, :] < lengths.reshape(-1, 1)
+    return mask.astype(convert_dtype(dtype))
+
+
+def _mask(x, length):
+    m = jnp.arange(x.shape[1])[None, :] < length.reshape(-1, 1)
+    return m.reshape(m.shape + (1,) * (x.ndim - 2))
+
+
+def sequence_pool(x, length, pool_type: str = "sum", pad_value: float = 0.0):
+    """(ref: sequence_pool_op.cc) x: [B, T, ...], length: [B]."""
+    mask = _mask(x, length).astype(x.dtype)
+    empty = (length == 0).reshape((-1,) + (1,) * (x.ndim - 2))
+    if pool_type in ("sum", "sqrt", "average", "mean"):
+        s = jnp.sum(x * mask, axis=1)
+        if pool_type == "sum":
+            out = s
+        elif pool_type == "sqrt":
+            out = s / jnp.sqrt(jnp.maximum(length, 1)).reshape(
+                (-1,) + (1,) * (s.ndim - 1)).astype(x.dtype)
+        else:
+            out = s / jnp.maximum(length, 1).reshape(
+                (-1,) + (1,) * (s.ndim - 1)).astype(x.dtype)
+    elif pool_type == "max":
+        neg = jnp.full_like(x, -jnp.inf)
+        out = jnp.max(jnp.where(mask > 0, x, neg), axis=1)
+    elif pool_type == "min":
+        pos = jnp.full_like(x, jnp.inf)
+        out = jnp.min(jnp.where(mask > 0, x, pos), axis=1)
+    elif pool_type == "first":
+        out = x[:, 0]
+    elif pool_type == "last":
+        idx = jnp.maximum(length - 1, 0).astype(jnp.int32)
+        out = jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1)[:, 0]
+    else:
+        raise ValueError(f"unknown pool_type {pool_type}")
+    return jnp.where(empty, pad_value, out)
+
+
+def sequence_softmax(x, length):
+    """(ref: sequence_softmax_op.cc) masked softmax over time axis."""
+    mask = _mask(x, length)
+    neg = jnp.finfo(x.dtype).min
+    masked = jnp.where(mask, x, neg)
+    out = jax.nn.softmax(masked, axis=1)
+    return jnp.where(mask, out, 0.0)
+
+
+def sequence_pad(x, length, max_len: int, pad_value: float = 0.0):
+    """(ref: sequence_pad_op.cc) here: re-pad to a new max_len."""
+    b, t = x.shape[:2]
+    if max_len <= t:
+        out = x[:, :max_len]
+    else:
+        pads = [(0, 0), (0, max_len - t)] + [(0, 0)] * (x.ndim - 2)
+        out = jnp.pad(x, pads, constant_values=pad_value)
+    mask = jnp.arange(out.shape[1])[None, :] < length.reshape(-1, 1)
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    return jnp.where(mask, out, pad_value)
+
+
+def sequence_unpad(x, length):
+    """(ref: sequence_unpad_op.cc) → zeroes out positions past length."""
+    mask = _mask(x, length).astype(x.dtype)
+    return x * mask
+
+
+def sequence_reverse(x, length):
+    """(ref: sequence_reverse_op.cc) reverse each row's valid prefix."""
+    t = x.shape[1]
+    idx = jnp.arange(t)[None, :]
+    L = length.reshape(-1, 1)
+    rev = jnp.where(idx < L, L - 1 - idx, idx)
+    return jnp.take_along_axis(
+        x, rev.reshape(rev.shape + (1,) * (x.ndim - 2)).astype(jnp.int32)
+        if x.ndim > 2 else rev.astype(jnp.int32), axis=1)
+
+
+def sequence_expand(x, ref_length, x_length=None):
+    """(ref: sequence_expand_op.cc simplified): repeat rows by ref_length.
+
+    x: [B, ...] one entry per sequence; returns [B, max_ref, ...] padded.
+    """
+    max_ref = ref_length.shape[0] if ref_length.ndim == 0 else None
+    # dense interpretation: broadcast each row up to max len with mask
+    raise NotImplementedError(
+        "use sequence_expand_dense(x, ref_length, max_len)")
+
+
+def sequence_expand_dense(x, ref_length, max_len: int):
+    out = jnp.repeat(x[:, None], max_len, axis=1)
+    mask = jnp.arange(max_len)[None, :] < ref_length.reshape(-1, 1)
+    return out * mask.reshape(mask.shape + (1,) * (x.ndim - 1)).astype(
+        x.dtype)
+
+
+def sequence_concat(xs, lengths):
+    """(ref: sequence_concat_op.cc) concat along time respecting lengths.
+
+    xs: list of [B, Ti, ...]; lengths: list of [B]. Returns (out, out_len)
+    with out [B, sum(Ti), ...]: each row holds the concatenation of valid
+    prefixes, left-packed.
+    """
+    total_t = sum(x.shape[1] for x in xs)
+    b = xs[0].shape[0]
+    feat = xs[0].shape[2:]
+    out = jnp.zeros((b, total_t) + feat, dtype=xs[0].dtype)
+    out_len = jnp.zeros((b,), dtype=jnp.int32)
+    pos = jnp.arange(total_t)
+    for x, ln in zip(xs, lengths):
+        t = x.shape[1]
+        # scatter x's valid prefix at offset out_len per row
+        src_idx = jnp.arange(t)
+        valid = src_idx[None, :] < ln.reshape(-1, 1)
+        dst = out_len.reshape(-1, 1) + src_idx[None, :]
+        dst = jnp.where(valid, dst, total_t)  # out-of-range drops
+        padded = jnp.concatenate(
+            [out, jnp.zeros((b, 1) + feat, out.dtype)], axis=1)
+        padded = jax.vmap(
+            lambda o, d, v: o.at[d].set(v))(padded, dst.astype(jnp.int32), x)
+        out = padded[:, :total_t]
+        out_len = out_len + ln.astype(jnp.int32)
+    return out, out_len
+
+
+def sequence_enumerate(x, length, win_size: int, pad_value: int = 0):
+    """(ref: sequence_enumerate_op.cc) sliding windows of ids."""
+    b, t = x.shape
+    windows = []
+    for w in range(win_size):
+        shifted = jnp.concatenate(
+            [x[:, w:], jnp.full((b, w), pad_value, x.dtype)], axis=1)
+        valid = (jnp.arange(t)[None, :] + w) < length.reshape(-1, 1)
+        windows.append(jnp.where(valid, shifted, pad_value))
+    return jnp.stack(windows, axis=-1)
+
+
+def sequence_erase(x, length, tokens):
+    """(ref: sequence_erase_op.cc) remove tokens, left-pack remainder."""
+    b, t = x.shape
+    keep = jnp.ones_like(x, dtype=bool)
+    for tok in tokens:
+        keep &= x != tok
+    keep &= jnp.arange(t)[None, :] < length.reshape(-1, 1)
+    new_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    packed = jnp.take_along_axis(x, order, axis=1)
+    mask = jnp.arange(t)[None, :] < new_len.reshape(-1, 1)
+    return jnp.where(mask, packed, 0), new_len
+
+
+def sequence_slice(x, length, offset, size):
+    """(ref: sequence_slice_op.cc) per-row slice [offset, offset+size)."""
+    t = x.shape[1]
+    idx = offset.reshape(-1, 1) + jnp.arange(t)[None, :]
+    idx = jnp.minimum(idx, t - 1).astype(jnp.int32)
+    shifted = jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+        if x.ndim > 2 else idx, axis=1)
+    mask = jnp.arange(t)[None, :] < size.reshape(-1, 1)
+    mask = mask & (jnp.arange(t)[None, :]
+                   < (length - offset).reshape(-1, 1))
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    return jnp.where(mask, shifted, 0), size.astype(jnp.int32)
+
+
+def sequence_first_step(x, length):
+    return sequence_pool(x, length, "first")
+
+
+def sequence_last_step(x, length):
+    return sequence_pool(x, length, "last")

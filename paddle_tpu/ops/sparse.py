@@ -1,0 +1,98 @@
+"""Sparse row-slice tensors (SelectedRows analogue).
+
+TPU-native redesign of the reference's SelectedRows
+(/root/reference/paddle/fluid/framework/selected_rows.h:32 and
+operators/math/selected_rows_functor.cc): a (rows, values) pair produced by
+embedding-style gathers' gradients. In JAX the same role is played by an
+IndexedSlices-style pytree; XLA scatter-add applies it densely. Keeping the
+sparse form until the optimizer step preserves the reference's bandwidth
+win for large embedding tables.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class RowSlices:
+    """Sparse gradient: values[i] belongs to full row rows[i]."""
+
+    def __init__(self, rows: jax.Array, values: jax.Array,
+                 dense_rows: int) -> None:
+        self.rows = rows
+        self.values = values
+        self.dense_rows = dense_rows
+
+    @property
+    def dense_shape(self) -> Tuple[int, ...]:
+        return (self.dense_rows,) + tuple(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def tree_flatten(self):
+        return (self.rows, self.values), self.dense_rows
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+    def __repr__(self) -> str:
+        return (f"RowSlices(rows={self.rows.shape}, "
+                f"values={self.values.shape}, dense_rows={self.dense_rows})")
+
+
+def to_dense(s: RowSlices) -> jax.Array:
+    """(ref: get_tensor_from_selected_rows_op.cc)."""
+    out = jnp.zeros(s.dense_shape, dtype=s.values.dtype)
+    return out.at[s.rows].add(s.values)
+
+
+def merge_rows(s: RowSlices) -> RowSlices:
+    """(ref: merge_selected_rows_op.cc) — sum duplicate row indices.
+
+    Output keeps the same static row count (XLA static shapes); duplicate
+    rows are summed into the first occurrence and the extras point at a
+    zeroed dummy row index (dense_rows, dropped on apply).
+    """
+    order = jnp.argsort(s.rows, stable=True)
+    rows_sorted = s.rows[order]
+    vals_sorted = s.values[order]
+    is_first = jnp.concatenate(
+        [jnp.ones((1,), bool), rows_sorted[1:] != rows_sorted[:-1]])
+    # segment ids: position of the first occurrence of each row value
+    seg = jnp.cumsum(is_first) - 1
+    merged_vals = jnp.zeros_like(vals_sorted).at[seg].add(vals_sorted)
+    merged_rows = jnp.where(is_first, rows_sorted, s.dense_rows)
+    return RowSlices(merged_rows, merged_vals, s.dense_rows)
+
+
+def scatter_apply(param: jax.Array, s: RowSlices, fn) -> jax.Array:
+    """Apply ``fn(param_rows, grad_values)`` to the touched rows only."""
+    safe_rows = jnp.minimum(s.rows, s.dense_rows - 1)
+    valid = (s.rows < s.dense_rows)[:, None].astype(param.dtype)
+    current = param[safe_rows]
+    updated = fn(current, s.values)
+    delta = (updated - current) * valid
+    return param.at[safe_rows].add(delta)
+
+
+def embedding_grad(ids: jax.Array, grad_out: jax.Array,
+                   vocab_size: int) -> RowSlices:
+    """Build the sparse grad of an embedding lookup
+    (ref: lookup_table_v2_op grad → SelectedRows)."""
+    flat_ids = ids.reshape(-1)
+    flat_g = grad_out.reshape(-1, grad_out.shape[-1])
+    return RowSlices(flat_ids, flat_g, vocab_size)
+
+
+def add(a: RowSlices, b: RowSlices) -> RowSlices:
+    """(ref: selected_rows_functor sum) concat-style sparse add."""
+    assert a.dense_rows == b.dense_rows
+    return RowSlices(jnp.concatenate([a.rows, b.rows]),
+                     jnp.concatenate([a.values, b.values]), a.dense_rows)

@@ -1,0 +1,475 @@
+"""Optimizers.
+
+TPU-native redesign of the reference's optimizer family
+(/root/reference/paddle/fluid/operators/optimizers/: sgd_op.cc,
+momentum_op.cc, lars_momentum_op.cc, adam_op.cc/adam_op.h, adamax_op.cc,
+adagrad_op.cc, adadelta_op.cc, rmsprop_op.cc, ftrl_op.cc, lamb_op.cc,
+dpsgd_op.cc + python/paddle/fluid/optimizer.py:55). In the reference each
+optimizer is a graph op mutating params in a scope; here each is a pure
+``(params, grads, state, step) -> (new_params, new_state)`` transform that
+compiles INTO the jitted train step with donated buffers — the in-graph
+update capability, the XLA way. The stateful ``step()`` method gives eager
+(dygraph) parity on an attached Layer.
+
+Sparse RowSlices grads (ops/sparse.py, SelectedRows analogue) get row-wise
+updates for SGD/Adagrad/Momentum (lazy-mode semantics of the reference's
+selected-rows kernels, adam_op.h:473).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer, Parameter
+from ..ops.sparse import RowSlices, scatter_apply, to_dense
+from . import lr as lr_module
+from .lr import LRScheduler, resolve_lr
+
+
+def _tree_map(fn, *trees):
+    return jax.tree.map(fn, *trees,
+                        is_leaf=lambda x: isinstance(x, RowSlices))
+
+
+class Optimizer:
+    """Base optimizer.
+
+    Functional protocol (used by jitted train steps):
+      state = opt.init(params)
+      new_params, new_state = opt.apply_gradients(params, grads, state)
+
+    Eager protocol (dygraph parity):
+      opt = Adam(parameters=model.parameters()); loss_grads = ...;
+      opt.step(grads)  # or attach via set_grads then step()
+    """
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay: Optional[float] = None, grad_clip=None,
+                 name: Optional[str] = None) -> None:
+        self.learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters else None
+        self.weight_decay = weight_decay
+        self.grad_clip = grad_clip
+        self._eager_state = None
+
+    # ------------------------------------------------------------------
+    # functional API
+    # ------------------------------------------------------------------
+    def init(self, params) -> Dict[str, Any]:
+        slots = _tree_map(lambda p: self.init_slots(p), params)
+        return {"step": jnp.zeros((), jnp.int32), "slots": slots}
+
+    def init_slots(self, p) -> Dict[str, jax.Array]:
+        return {}
+
+    def apply_gradients(self, params, grads, state,
+                        lr_override=None) -> Tuple[Any, Dict[str, Any]]:
+        step = state["step"] + 1
+        lr_t = lr_override if lr_override is not None \
+            else resolve_lr(self.learning_rate, step)
+        if self.grad_clip is not None:
+            grads = self.grad_clip(grads)
+        if self.weight_decay:
+            grads = _tree_map(
+                lambda g, p: g + self.weight_decay * p
+                if not isinstance(g, RowSlices) else g, grads, params)
+
+        flat_p, treedef = jax.tree.flatten(
+            params, is_leaf=lambda x: isinstance(x, RowSlices))
+        flat_g = treedef.flatten_up_to(grads)
+        flat_s = treedef.flatten_up_to(state["slots"])
+        new_p, new_s = [], []
+        for p, g, s in zip(flat_p, flat_g, flat_s):
+            if g is None:
+                new_p.append(p)
+                new_s.append(s)
+            elif isinstance(g, RowSlices):
+                np_, ns_ = self.update_sparse(p, g, s, lr_t, step)
+                new_p.append(np_)
+                new_s.append(ns_)
+            else:
+                np_, ns_ = self.update(p, g, s, lr_t, step)
+                new_p.append(np_)
+                new_s.append(ns_)
+        return (jax.tree.unflatten(treedef, new_p),
+                {"step": step, "slots": jax.tree.unflatten(treedef, new_s)})
+
+    def update(self, p, g, slots, lr_t, step):
+        raise NotImplementedError
+
+    def update_sparse(self, p, g: RowSlices, slots, lr_t, step):
+        # default: densify (correct, not bandwidth-optimal)
+        return self.update(p, to_dense(g), slots, lr_t, step)
+
+    # ------------------------------------------------------------------
+    # eager API
+    # ------------------------------------------------------------------
+    def _eager_params(self) -> Dict[int, Parameter]:
+        if self._parameter_list is None:
+            raise ValueError(
+                "pass parameters= to the optimizer for eager step()")
+        return {i: p for i, p in enumerate(self._parameter_list)
+                if p.trainable}
+
+    def step(self, grads: Optional[Sequence[jax.Array]] = None) -> None:
+        params = self._eager_params()
+        if grads is None:
+            raise ValueError("eager step() needs grads aligned with "
+                             "the optimizer's parameter list")
+        values = {i: p.value for i, p in params.items()}
+        gdict = {i: g for (i, _), g in zip(params.items(), grads)}
+        if self._eager_state is None:
+            self._eager_state = self.init(values)
+        new_values, self._eager_state = self.apply_gradients(
+            values, gdict, self._eager_state)
+        for i, p in params.items():
+            p.value = new_values[i]
+
+    def clear_grad(self) -> None:
+        pass  # grads are values, not state, in the functional design
+
+    def get_lr(self) -> float:
+        if isinstance(self.learning_rate, LRScheduler):
+            return self.learning_rate.get_lr()
+        return float(self.learning_rate)
+
+    def set_lr(self, value: float) -> None:
+        self.learning_rate = value
+
+    def state_dict(self):
+        return self._eager_state or {}
+
+    def set_state_dict(self, state) -> None:
+        self._eager_state = state
+
+    # reference-style one-call minimize for eager models
+    def minimize(self, loss_fn: Callable, model: Layer):
+        params = model.param_dict()
+        buffers = model.buffer_dict()
+
+        def lf(p):
+            from ..nn.layer import functional_call
+            out, new_buf = functional_call(model, p, buffers,
+                                           capture_buffers=True)
+            return out, new_buf
+
+        raise NotImplementedError(
+            "use paddle_tpu.static.TrainStep or jax.value_and_grad with "
+            "apply_gradients; minimize() of arbitrary closures is not "
+            "supported in the functional design")
+
+
+class SGD(Optimizer):
+    """(ref: sgd_op.cc)."""
+
+    def update(self, p, g, slots, lr_t, step):
+        return p - lr_t * g.astype(p.dtype), slots
+
+    def update_sparse(self, p, g: RowSlices, slots, lr_t, step):
+        return scatter_apply(p, g, lambda rows, vals:
+                             rows - lr_t * vals.astype(p.dtype)), slots
+
+
+class Momentum(Optimizer):
+    """(ref: momentum_op.cc; use_nesterov attr)."""
+
+    def __init__(self, learning_rate=0.001, momentum: float = 0.9,
+                 use_nesterov: bool = False, **kw) -> None:
+        super().__init__(learning_rate, **kw)
+        self.momentum = momentum
+        self.use_nesterov = use_nesterov
+
+    def init_slots(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    def update(self, p, g, slots, lr_t, step):
+        g = g.astype(p.dtype)
+        v = self.momentum * slots["velocity"] + g
+        if self.use_nesterov:
+            new_p = p - lr_t * (g + self.momentum * v)
+        else:
+            new_p = p - lr_t * v
+        return new_p, {"velocity": v}
+
+
+class LarsMomentum(Optimizer):
+    """(ref: lars_momentum_op.cc) layer-adaptive rate scaling."""
+
+    def __init__(self, learning_rate=0.001, momentum: float = 0.9,
+                 lars_coeff: float = 0.001, lars_weight_decay: float = 0.0005,
+                 epsilon: float = 1e-9, **kw) -> None:
+        super().__init__(learning_rate, **kw)
+        self.momentum = momentum
+        self.lars_coeff = lars_coeff
+        self.lars_weight_decay = lars_weight_decay
+        self.epsilon = epsilon
+
+    def init_slots(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    def update(self, p, g, slots, lr_t, step):
+        g = g.astype(p.dtype)
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        local_lr = lr_t * self.lars_coeff * p_norm / (
+            g_norm + self.lars_weight_decay * p_norm + self.epsilon)
+        local_lr = jnp.where(p_norm > 0, local_lr, lr_t)
+        v = self.momentum * slots["velocity"] \
+            + local_lr * (g + self.lars_weight_decay * p)
+        return p - v, {"velocity": v}
+
+
+class Adam(Optimizer):
+    """(ref: adam_op.h AdamFunctor)."""
+
+    def __init__(self, learning_rate=0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8,
+                 lazy_mode: bool = False, **kw) -> None:
+        super().__init__(learning_rate, **kw)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_mode = lazy_mode
+
+    def init_slots(self, p):
+        return {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p)}
+
+    def _bias_correct_lr(self, lr_t, step):
+        step_f = step.astype(jnp.float32)
+        bc1 = 1.0 - jnp.power(self.beta1, step_f)
+        bc2 = 1.0 - jnp.power(self.beta2, step_f)
+        return lr_t * jnp.sqrt(bc2) / bc1
+
+    def update(self, p, g, slots, lr_t, step):
+        g = g.astype(p.dtype)
+        m = self.beta1 * slots["m"] + (1 - self.beta1) * g
+        v = self.beta2 * slots["v"] + (1 - self.beta2) * jnp.square(g)
+        lr_c = self._bias_correct_lr(lr_t, step)
+        new_p = p - lr_c * m / (jnp.sqrt(v) + self.epsilon)
+        return new_p, {"m": m, "v": v}
+
+    def update_sparse(self, p, g: RowSlices, slots, lr_t, step):
+        if not self.lazy_mode:
+            return self.update(p, to_dense(g), slots, lr_t, step)
+        # lazy: only touched rows updated (ref: adam_op.h:473 sparse functor)
+        lr_c = self._bias_correct_lr(lr_t, step)
+        m, v = slots["m"], slots["v"]
+        safe_rows = jnp.minimum(g.rows, p.shape[0] - 1)
+        valid = (g.rows < p.shape[0])[:, None].astype(p.dtype)
+        g_rows = g.values.astype(p.dtype) * valid
+        m_rows = self.beta1 * m[safe_rows] + (1 - self.beta1) * g_rows
+        v_rows = self.beta2 * v[safe_rows] + (1 - self.beta2) \
+            * jnp.square(g_rows)
+        p_rows = p[safe_rows] - lr_c * m_rows / (jnp.sqrt(v_rows)
+                                                 + self.epsilon)
+        return (p.at[safe_rows].set(p[safe_rows] * (1 - valid)
+                                    + p_rows * valid),
+                {"m": m.at[safe_rows].set(m[safe_rows] * (1 - valid)
+                                          + m_rows * valid),
+                 "v": v.at[safe_rows].set(v[safe_rows] * (1 - valid)
+                                          + v_rows * valid)})
+
+
+class AdamW(Adam):
+    """(ref: adamw in optimizer.py — decoupled weight decay)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, weight_decay: float = 0.01,
+                 apply_decay_param_fun=None, **kw) -> None:
+        kw.pop("weight_decay", None)
+        super().__init__(learning_rate, beta1, beta2, epsilon, **kw)
+        self.decoupled_weight_decay = weight_decay
+        self.apply_decay_param_fun = apply_decay_param_fun
+        self.weight_decay = None  # decoupled, not L2
+
+    def update(self, p, g, slots, lr_t, step):
+        new_p, new_slots = super().update(p, g, slots, lr_t, step)
+        new_p = new_p - lr_t * self.decoupled_weight_decay * p
+        return new_p, new_slots
+
+
+class Adamax(Optimizer):
+    """(ref: adamax_op.cc)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw) -> None:
+        super().__init__(learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_slots(self, p):
+        return {"m": jnp.zeros_like(p), "u": jnp.zeros_like(p)}
+
+    def update(self, p, g, slots, lr_t, step):
+        g = g.astype(p.dtype)
+        m = self.beta1 * slots["m"] + (1 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * slots["u"], jnp.abs(g))
+        step_f = step.astype(jnp.float32)
+        lr_c = lr_t / (1.0 - jnp.power(self.beta1, step_f))
+        return p - lr_c * m / (u + self.epsilon), {"m": m, "u": u}
+
+
+class Adagrad(Optimizer):
+    """(ref: adagrad_op.cc)."""
+
+    def __init__(self, learning_rate=0.001, epsilon: float = 1e-6,
+                 initial_accumulator_value: float = 0.0, **kw) -> None:
+        super().__init__(learning_rate, **kw)
+        self.epsilon = epsilon
+        self.initial_accumulator_value = initial_accumulator_value
+
+    def init_slots(self, p):
+        return {"moment": jnp.full_like(p, self.initial_accumulator_value)}
+
+    def update(self, p, g, slots, lr_t, step):
+        g = g.astype(p.dtype)
+        moment = slots["moment"] + jnp.square(g)
+        return p - lr_t * g / (jnp.sqrt(moment) + self.epsilon), \
+            {"moment": moment}
+
+
+class Adadelta(Optimizer):
+    """(ref: adadelta_op.cc)."""
+
+    def __init__(self, learning_rate=1.0, rho: float = 0.95,
+                 epsilon: float = 1e-6, **kw) -> None:
+        super().__init__(learning_rate, **kw)
+        self.rho, self.epsilon = rho, epsilon
+
+    def init_slots(self, p):
+        return {"avg_sq_grad": jnp.zeros_like(p),
+                "avg_sq_update": jnp.zeros_like(p)}
+
+    def update(self, p, g, slots, lr_t, step):
+        g = g.astype(p.dtype)
+        asg = self.rho * slots["avg_sq_grad"] + (1 - self.rho) * jnp.square(g)
+        upd = g * jnp.sqrt(slots["avg_sq_update"] + self.epsilon) \
+            / jnp.sqrt(asg + self.epsilon)
+        asu = self.rho * slots["avg_sq_update"] \
+            + (1 - self.rho) * jnp.square(upd)
+        return p - lr_t * upd, {"avg_sq_grad": asg, "avg_sq_update": asu}
+
+
+class RMSProp(Optimizer):
+    """(ref: rmsprop_op.cc; centered variant supported)."""
+
+    def __init__(self, learning_rate=0.001, rho: float = 0.95,
+                 epsilon: float = 1e-6, momentum: float = 0.0,
+                 centered: bool = False, **kw) -> None:
+        super().__init__(learning_rate, **kw)
+        self.rho, self.epsilon = rho, epsilon
+        self.momentum_coef = momentum
+        self.centered = centered
+
+    def init_slots(self, p):
+        s = {"mean_square": jnp.zeros_like(p),
+             "moment": jnp.zeros_like(p)}
+        if self.centered:
+            s["mean_grad"] = jnp.zeros_like(p)
+        return s
+
+    def update(self, p, g, slots, lr_t, step):
+        g = g.astype(p.dtype)
+        ms = self.rho * slots["mean_square"] + (1 - self.rho) * jnp.square(g)
+        new_slots = {"mean_square": ms}
+        if self.centered:
+            mg = self.rho * slots["mean_grad"] + (1 - self.rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self.epsilon)
+            new_slots["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self.epsilon)
+        mom = self.momentum_coef * slots["moment"] + lr_t * g / denom
+        new_slots["moment"] = mom
+        return p - mom, new_slots
+
+
+class Lamb(Optimizer):
+    """(ref: lamb_op.cc) layer-adaptive Adam for large-batch."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay: float = 0.01,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-6, exclude_from_weight_decay_fn=None,
+                 **kw) -> None:
+        super().__init__(learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lamb_weight_decay = lamb_weight_decay
+        self.exclude_fn = exclude_from_weight_decay_fn
+
+    def init_slots(self, p):
+        return {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p)}
+
+    def update(self, p, g, slots, lr_t, step):
+        g = g.astype(p.dtype)
+        m = self.beta1 * slots["m"] + (1 - self.beta1) * g
+        v = self.beta2 * slots["v"] + (1 - self.beta2) * jnp.square(g)
+        step_f = step.astype(jnp.float32)
+        m_hat = m / (1.0 - jnp.power(self.beta1, step_f))
+        v_hat = v / (1.0 - jnp.power(self.beta2, step_f))
+        r = m_hat / (jnp.sqrt(v_hat) + self.epsilon) \
+            + self.lamb_weight_decay * p
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        return p - lr_t * trust * r, {"m": m, "v": v}
+
+
+class Ftrl(Optimizer):
+    """(ref: ftrl_op.cc)."""
+
+    def __init__(self, learning_rate=0.001, l1: float = 0.0,
+                 l2: float = 0.0, lr_power: float = -0.5, **kw) -> None:
+        super().__init__(learning_rate, **kw)
+        self.l1, self.l2, self.lr_power = l1, l2, lr_power
+
+    def init_slots(self, p):
+        return {"squared": jnp.zeros_like(p), "linear": jnp.zeros_like(p)}
+
+    def update(self, p, g, slots, lr_t, step):
+        g = g.astype(p.dtype)
+        sq = slots["squared"]
+        new_sq = sq + jnp.square(g)
+        sigma = (jnp.power(new_sq, -self.lr_power)
+                 - jnp.power(jnp.maximum(sq, 1e-20), -self.lr_power)) / lr_t
+        lin = slots["linear"] + g - sigma * p
+        quad = jnp.power(new_sq, -self.lr_power) / lr_t + 2 * self.l2
+        pre_shrink = (self.l1 * jnp.sign(lin) - lin) / quad
+        new_p = jnp.where(jnp.abs(lin) > self.l1, pre_shrink, 0.0)
+        return new_p, {"squared": new_sq, "linear": lin}
+
+
+class Dpsgd(Optimizer):
+    """(ref: dpsgd_op.cc) differentially-private SGD: clip + noise."""
+
+    def __init__(self, learning_rate=0.001, clip: float = 10.0,
+                 batch_size: float = 16.0, sigma: float = 1.0, seed: int = 0,
+                 **kw) -> None:
+        super().__init__(learning_rate, **kw)
+        self.clip = clip
+        self.batch_size = batch_size
+        self.sigma = sigma
+        self.seed = seed
+
+    def update(self, p, g, slots, lr_t, step):
+        g = g.astype(p.dtype)
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        scale = jnp.minimum(1.0, self.clip / jnp.maximum(g_norm, 1e-12))
+        g = g * scale
+        key = jax.random.fold_in(jax.random.key(self.seed), step)
+        noise = self.sigma * self.clip / self.batch_size \
+            * jax.random.normal(key, g.shape, g.dtype)
+        return p - lr_t * (g + noise), slots
+
+
+# Reference-era aliases (fluid.optimizer spellings)
+SGDOptimizer = SGD
+MomentumOptimizer = Momentum
+AdamOptimizer = Adam
+AdamaxOptimizer = Adamax
+AdagradOptimizer = Adagrad
+AdadeltaOptimizer = Adadelta
+RMSPropOptimizer = RMSProp
+LambOptimizer = Lamb
+FtrlOptimizer = Ftrl
+LarsMomentumOptimizer = LarsMomentum

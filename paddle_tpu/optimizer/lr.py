@@ -1,0 +1,286 @@
+"""Learning-rate schedulers.
+
+TPU-native redesign of the reference's LR schedule machinery
+(/root/reference/python/paddle/fluid/dygraph/learning_rate_scheduler.py and
+layers/learning_rate_scheduler.py — schedules are graph ops there). Here a
+scheduler is a pure function ``lr(step) -> float`` of a traced step counter,
+so the schedule compiles INTO the jitted train step (no retrace per epoch,
+no host sync); the object wrapper adds the stateful ``step()/get_lr()`` API
+for eager parity.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, List, Optional, Sequence
+
+import jax.numpy as jnp
+
+
+class LRScheduler:
+    """Base: subclasses implement lr_at(step) with jnp-traceable math."""
+
+    def __init__(self, learning_rate: float = 0.1,
+                 last_epoch: int = -1, verbose: bool = False) -> None:
+        self.base_lr = learning_rate
+        self.last_epoch = last_epoch
+        self.verbose = verbose
+        self.step()  # advance to epoch 0 like the reference
+
+    def lr_at(self, step):
+        raise NotImplementedError
+
+    def __call__(self, step):
+        return self.lr_at(step)
+
+    def get_lr(self):
+        return float(self.lr_at(jnp.asarray(self.last_epoch)))
+
+    def step(self, epoch: Optional[int] = None) -> None:
+        self.last_epoch = epoch if epoch is not None else self.last_epoch + 1
+
+    def state_dict(self):
+        return {"last_epoch": self.last_epoch}
+
+    def set_state_dict(self, state):
+        self.last_epoch = state["last_epoch"]
+
+
+class NoamDecay(LRScheduler):
+    """(ref: learning_rate_scheduler.py NoamDecay)."""
+
+    def __init__(self, d_model: int, warmup_steps: int,
+                 learning_rate: float = 1.0, last_epoch: int = -1,
+                 verbose: bool = False) -> None:
+        self.d_model = d_model
+        self.warmup_steps = warmup_steps
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        step = jnp.maximum(step, 1).astype(jnp.float32)
+        a = step ** -0.5
+        b = step * (self.warmup_steps ** -1.5)
+        return self.base_lr * (self.d_model ** -0.5) * jnp.minimum(a, b)
+
+
+class PiecewiseDecay(LRScheduler):
+    def __init__(self, boundaries: Sequence[int], values: Sequence[float],
+                 last_epoch: int = -1, verbose: bool = False) -> None:
+        self.boundaries = list(boundaries)
+        self.values = list(values)
+        super().__init__(values[0], last_epoch, verbose)
+
+    def lr_at(self, step):
+        idx = jnp.searchsorted(jnp.asarray(self.boundaries), step,
+                               side="right")
+        return jnp.asarray(self.values)[idx]
+
+
+class NaturalExpDecay(LRScheduler):
+    def __init__(self, learning_rate: float, gamma: float,
+                 last_epoch: int = -1, verbose: bool = False) -> None:
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.base_lr * jnp.exp(-self.gamma * step)
+
+
+class ExponentialDecay(LRScheduler):
+    def __init__(self, learning_rate: float, gamma: float,
+                 last_epoch: int = -1, verbose: bool = False) -> None:
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.base_lr * jnp.power(self.gamma, step.astype(jnp.float32)
+                                        if hasattr(step, "astype") else step)
+
+
+class InverseTimeDecay(LRScheduler):
+    def __init__(self, learning_rate: float, gamma: float,
+                 last_epoch: int = -1, verbose: bool = False) -> None:
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.base_lr / (1.0 + self.gamma * step)
+
+
+class PolynomialDecay(LRScheduler):
+    def __init__(self, learning_rate: float, decay_steps: int,
+                 end_lr: float = 0.0001, power: float = 1.0,
+                 cycle: bool = False, last_epoch: int = -1,
+                 verbose: bool = False) -> None:
+        self.decay_steps = decay_steps
+        self.end_lr = end_lr
+        self.power = power
+        self.cycle = cycle
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        step_f = jnp.asarray(step, jnp.float32)
+        if self.cycle:
+            ratio = jnp.ceil(jnp.maximum(step_f, 1.0) / self.decay_steps)
+            ds = self.decay_steps * jnp.maximum(ratio, 1.0)
+        else:
+            ds = float(self.decay_steps)
+            step_f = jnp.minimum(step_f, ds)
+        frac = (1.0 - step_f / ds) ** self.power
+        return (self.base_lr - self.end_lr) * frac + self.end_lr
+
+
+class CosineAnnealingDecay(LRScheduler):
+    def __init__(self, learning_rate: float, T_max: int,
+                 eta_min: float = 0.0, last_epoch: int = -1,
+                 verbose: bool = False) -> None:
+        self.T_max = T_max
+        self.eta_min = eta_min
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        cos = jnp.cos(jnp.pi * jnp.asarray(step, jnp.float32) / self.T_max)
+        return self.eta_min + (self.base_lr - self.eta_min) * (1 + cos) / 2
+
+
+class LinearWarmup(LRScheduler):
+    """(ref: layers/learning_rate_scheduler.py linear_lr_warmup)."""
+
+    def __init__(self, learning_rate, warmup_steps: int, start_lr: float,
+                 end_lr: float, last_epoch: int = -1,
+                 verbose: bool = False) -> None:
+        self.lr_after = learning_rate
+        self.warmup_steps = warmup_steps
+        self.start_lr = start_lr
+        self.end_lr = end_lr
+        base = learning_rate if isinstance(learning_rate, float) \
+            else learning_rate.base_lr
+        super().__init__(base, last_epoch, verbose)
+
+    def lr_at(self, step):
+        step_f = jnp.asarray(step, jnp.float32)
+        warm = self.start_lr + (self.end_lr - self.start_lr) \
+            * step_f / max(self.warmup_steps, 1)
+        if isinstance(self.lr_after, LRScheduler):
+            after = self.lr_after.lr_at(step - self.warmup_steps)
+        else:
+            after = self.lr_after
+        return jnp.where(step_f < self.warmup_steps, warm, after)
+
+
+class StepDecay(LRScheduler):
+    def __init__(self, learning_rate: float, step_size: int,
+                 gamma: float = 0.1, last_epoch: int = -1,
+                 verbose: bool = False) -> None:
+        self.step_size = step_size
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.base_lr * jnp.power(
+            self.gamma, (jnp.asarray(step) // self.step_size).astype(
+                jnp.float32))
+
+
+class MultiStepDecay(LRScheduler):
+    def __init__(self, learning_rate: float, milestones: Sequence[int],
+                 gamma: float = 0.1, last_epoch: int = -1,
+                 verbose: bool = False) -> None:
+        self.milestones = list(milestones)
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        idx = jnp.searchsorted(jnp.asarray(self.milestones), step,
+                               side="right").astype(jnp.float32)
+        return self.base_lr * jnp.power(self.gamma, idx)
+
+
+class LambdaDecay(LRScheduler):
+    def __init__(self, learning_rate: float, lr_lambda: Callable,
+                 last_epoch: int = -1, verbose: bool = False) -> None:
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def lr_at(self, step):
+        return self.base_lr * self.lr_lambda(step)
+
+
+class ReduceOnPlateau(LRScheduler):
+    """Host-side stateful schedule (metric-driven; not jit-traceable —
+    call .step(metric) per epoch like the reference)."""
+
+    def __init__(self, learning_rate: float, mode: str = "min",
+                 factor: float = 0.1, patience: int = 10,
+                 threshold: float = 1e-4, cooldown: int = 0,
+                 min_lr: float = 0.0, verbose: bool = False) -> None:
+        self.mode = mode
+        self.factor = factor
+        self.patience = patience
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.best = None
+        self.num_bad = 0
+        self.cooldown_counter = 0
+        self.current_lr = learning_rate
+        self.base_lr = learning_rate
+        self.last_epoch = 0
+        self.verbose = verbose
+
+    def lr_at(self, step):
+        return jnp.asarray(self.current_lr)
+
+    def step(self, metrics=None, epoch: Optional[int] = None) -> None:
+        if metrics is None:
+            return
+        m = float(metrics)
+        improved = (self.best is None
+                    or (self.mode == "min" and m < self.best - self.threshold)
+                    or (self.mode == "max" and m > self.best + self.threshold))
+        if improved:
+            self.best = m
+            self.num_bad = 0
+        elif self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+        else:
+            self.num_bad += 1
+            if self.num_bad > self.patience:
+                self.current_lr = max(self.current_lr * self.factor,
+                                      self.min_lr)
+                self.cooldown_counter = self.cooldown
+                self.num_bad = 0
+        self.last_epoch += 1
+
+
+class OneCycleLR(LRScheduler):
+    def __init__(self, max_learning_rate: float, total_steps: int,
+                 divide_factor: float = 25.0, end_learning_rate=None,
+                 phase_pct: float = 0.3, last_epoch: int = -1,
+                 verbose: bool = False) -> None:
+        self.max_lr = max_learning_rate
+        self.total_steps = total_steps
+        self.initial_lr = max_learning_rate / divide_factor
+        self.min_lr = end_learning_rate if end_learning_rate is not None \
+            else self.initial_lr / 1e4
+        self.phase_pct = phase_pct
+        super().__init__(self.initial_lr, last_epoch, verbose)
+
+    def lr_at(self, step):
+        step_f = jnp.asarray(step, jnp.float32)
+        up_steps = self.phase_pct * self.total_steps
+        down_steps = self.total_steps - up_steps
+        up = self.initial_lr + (self.max_lr - self.initial_lr) \
+            * jnp.minimum(step_f / jnp.maximum(up_steps, 1.0), 1.0)
+        pct = jnp.clip((step_f - up_steps) / jnp.maximum(down_steps, 1.0),
+                       0.0, 1.0)
+        down = self.min_lr + (self.max_lr - self.min_lr) \
+            * (1 + jnp.cos(jnp.pi * pct)) / 2
+        return jnp.where(step_f < up_steps, up, down)
+
+
+def resolve_lr(lr, step):
+    """Evaluate a float or scheduler at a (possibly traced) step."""
+    if isinstance(lr, LRScheduler):
+        return lr.lr_at(step)
+    return jnp.asarray(lr, jnp.float32)

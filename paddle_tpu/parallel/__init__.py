@@ -1,0 +1,17 @@
+"""Distributed / parallel runtime (SURVEY.md §2.8-2.9).
+
+One `comm` design: ICI collectives are XLA ops over mesh axes (collective.py
+keyed by ring_id-style CommGroups), DCN multi-host comes from
+jax.distributed (env.py), data/tensor parallel training compiles through
+ShardedTrainStep (spmd.py), pipeline parallelism through pipeline.py.
+"""
+
+from . import collective, mesh, spmd
+from .collective import (all_gather, all_reduce, all_to_all, barrier,
+                         broadcast, get_group, new_group, ppermute,
+                         reduce, reduce_scatter, scatter)
+from .data_parallel import DataParallel
+from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env
+from .mesh import (batch_sharding, create_mesh, data_parallel_mesh,
+                   named_sharding, replicated)
+from .spmd import ShardedTrainStep, make_param_specs, megatron_param_rule

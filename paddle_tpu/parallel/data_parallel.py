@@ -1,0 +1,52 @@
+"""DataParallel layer wrapper (dygraph parity).
+
+Reference: /root/reference/python/paddle/fluid/dygraph/parallel.py:225
+(DataParallel: scale_loss :289, coalesce + allreduce + split
+apply_collective_grads :386) and imperative/all_reduce.cc. In the TPU
+design the wrapper is thin: grads are reduced by XLA inside the sharded
+step (spmd.py), so DataParallel only (a) carries the mesh/env metadata,
+(b) provides scale_loss / apply_collective_grads API parity for code
+written against the reference, where apply_collective_grads is the
+explicit shard_map grad-psum path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+from ..nn.layer import Layer
+from . import collective
+from .env import ParallelEnv
+from .mesh import data_parallel_mesh
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None,
+                 mesh: Optional[Mesh] = None) -> None:
+        super().__init__()
+        self._layers = layers
+        self.mesh = mesh if mesh is not None else data_parallel_mesh()
+        self.env = ParallelEnv()
+        self.nranks = int(jax.device_count())
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        """(ref: parallel.py:289) — with pmean-based reduction this is an
+        identity; kept for API parity when loss_sum + allreduce is used."""
+        return loss
+
+    def apply_collective_grads(self, grads):
+        """psum grads over the dp axis (valid inside shard_map)."""
+        return jax.tree.map(
+            lambda g: collective.all_reduce(g, "mean", group="dp"), grads)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)

@@ -1,0 +1,72 @@
+"""Multi-process environment bootstrap.
+
+TPU-native replacement for the reference's launch/bootstrap machinery
+(/root/reference/python/paddle/distributed/launch.py:193 env plumbing,
+c_gen_nccl_id_op.cc:49-60 id exchange over RPC, role_maker.py env parsing).
+jax.distributed's coordination service plays the role of the gRPC
+id-exchange server: PADDLE-style env vars are read for parity and mapped
+onto jax.distributed.initialize.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+class ParallelEnv:
+    """(ref: dygraph/parallel.py ParallelEnv) env-derived rank info."""
+
+    def __init__(self) -> None:
+        self.rank = int(os.environ.get(
+            "PADDLE_TRAINER_ID", os.environ.get("RANK", "0")))
+        self.world_size = int(os.environ.get(
+            "PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE", "1")))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self.trainer_endpoints = eps.split(",") if eps else []
+        self.current_endpoint = os.environ.get(
+            "PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def local_rank(self) -> int:
+        return self.rank
+
+    @property
+    def nranks(self) -> int:
+        return self.world_size
+
+
+_initialized = False
+
+
+def init_parallel_env(coordinator_address: Optional[str] = None,
+                      num_processes: Optional[int] = None,
+                      process_id: Optional[int] = None) -> ParallelEnv:
+    """(ref: paddle.distributed.init_parallel_env). Single-process runs
+    (incl. 1 process driving all local TPU chips) need no coordination
+    service; multi-host runs initialize jax.distributed, whose coordination
+    server replaces the reference's c_gen_nccl_id gRPC exchange."""
+    global _initialized
+    env = ParallelEnv()
+    if _initialized:
+        return env
+    world = num_processes if num_processes is not None else env.world_size
+    if world > 1:
+        addr = coordinator_address
+        if addr is None and env.trainer_endpoints:
+            addr = env.trainer_endpoints[0]
+        rank = process_id if process_id is not None else env.rank
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=world, process_id=rank)
+    _initialized = True
+    return env
+
+
+def get_rank() -> int:
+    return ParallelEnv().rank
+
+
+def get_world_size() -> int:
+    return ParallelEnv().world_size

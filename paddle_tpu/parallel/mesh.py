@@ -1,0 +1,67 @@
+"""Device mesh construction.
+
+TPU-native replacement for the reference's device topology handling
+(NCCLContextMap per-device comms, /root/reference/paddle/fluid/platform/
+nccl_helper.h:92; hierarchical inter/intra rings nccl_helper.h:185). On TPU
+the topology is a named :class:`jax.sharding.Mesh`; collectives ride ICI
+along mesh axes and DCN across slices — XLA picks the rings. Standard axis
+names: ``dp`` (data), ``mp`` (tensor/model), ``pp`` (pipeline), ``sp``
+(sequence/context), ``ep`` (expert).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DP, MP, PP, SP, EP = "dp", "mp", "pp", "sp", "ep"
+
+
+def create_mesh(axes: Optional[Dict[str, int]] = None,
+                devices: Optional[Sequence] = None) -> Mesh:
+    """Build a mesh from an axis→size dict, e.g. {"dp": 4, "mp": 2}.
+
+    Sizes of -1 (at most one) absorb the remaining devices.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    axes = dict(axes) if axes else {DP: len(devices)}
+    n = len(devices)
+    known = 1
+    wild = None
+    for name, size in axes.items():
+        if size == -1:
+            wild = name
+        else:
+            known *= size
+    if wild is not None:
+        axes[wild] = n // known
+    total = int(np.prod(list(axes.values())))
+    if total != n:
+        raise ValueError(f"mesh axes {axes} need {total} devices, have {n}")
+    arr = np.array(devices).reshape(tuple(axes.values()))
+    return Mesh(arr, tuple(axes))
+
+
+def data_parallel_mesh(n: Optional[int] = None) -> Mesh:
+    devs = jax.devices()[:n] if n else jax.devices()
+    return create_mesh({DP: len(devs)}, devs)
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis] if axis in mesh.shape else 1
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharding(mesh: Mesh, axis: str = DP) -> NamedSharding:
+    """Shard leading (batch) dim over the data axis."""
+    return NamedSharding(mesh, PartitionSpec(axis))

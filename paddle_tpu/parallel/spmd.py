@@ -1,0 +1,169 @@
+"""SPMD sharded training step.
+
+TPU-native replacement for the reference's ParallelExecutor + multi-device
+graph pass + allreduce op-handles
+(/root/reference/paddle/fluid/framework/parallel_executor.cc:443,
+ir/multi_devices_graph_pass/multi_devices_graph_pass.cc,
+details/all_reduce_op_handle.cc:48). Where the reference clones the graph
+per device and inserts NCCL allreduce ops per gradient, here ONE program is
+compiled with sharding annotations over a Mesh and **XLA inserts the ICI
+collectives** — grad allreduce appears automatically from "batch sharded ×
+params replicated" propagation; tensor parallelism from sharded param
+specs; no pass pipeline needed.
+
+Param placement rules (:func:`make_param_specs`) are the analogue of
+BuildStrategy: a callable from param name/shape → PartitionSpec.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core import random as _random
+from ..nn.layer import Layer, functional_call
+from ..optimizer import Optimizer
+from . import mesh as mesh_lib
+
+
+def make_param_specs(params: Dict[str, Any],
+                     rule: Optional[Callable[[str, Any], P]] = None) \
+        -> Dict[str, P]:
+    """Default: replicate everything (pure DP). A rule can shard params
+    (e.g. megatron-style: q/k/v column-parallel over 'mp')."""
+    if rule is None:
+        return jax.tree.map(lambda _: P(), params)
+    out = {}
+    for name, value in params.items():
+        out[name] = rule(name, value)
+    return out
+
+
+class ShardedTrainStep:
+    """Compile model+loss+optimizer into one pjit program over a mesh.
+
+    - batch_spec: PartitionSpec for every leaf of the batch
+      (default P('dp'): leading dim sharded over the data axis).
+    - param_rule: name→PartitionSpec callable for TP/EP-style placement.
+    - donate: state buffers are donated (in-place update in HBM).
+    """
+
+    def __init__(self, model: Layer, optimizer: Optimizer,
+                 loss_fn: Callable, mesh: Mesh,
+                 batch_spec: P = P("dp"),
+                 param_rule: Optional[Callable] = None,
+                 seed: int = 0,
+                 extra_metrics: Optional[Dict[str, Callable]] = None) \
+            -> None:
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        self.batch_spec = batch_spec
+        self.extra_metrics = extra_metrics or {}
+
+        params = model.param_dict()
+        buffers = model.buffer_dict()
+        param_specs = make_param_specs(params, param_rule)
+        opt_state = optimizer.init(params)
+
+        def spec_of(name_spec, tree):
+            # optimizer slots inherit their param's spec; scalars replicate
+            return jax.tree.map(
+                lambda x: name_spec if hasattr(x, "ndim") and x.ndim > 0
+                else P(), tree)
+
+        self.state_specs = {
+            "params": param_specs,
+            "buffers": jax.tree.map(lambda _: P(), buffers),
+            "opt": {
+                "step": P(),
+                "slots": {n: jax.tree.map(lambda _: param_specs[n], s)
+                          for n, s in opt_state["slots"].items()},
+            },
+            "rng": P(),
+        }
+        state = {"params": params, "buffers": buffers, "opt": opt_state,
+                 "rng": jax.random.key(seed)}
+        # place initial state according to specs
+        self.state = jax.device_put(
+            state, jax.tree.map(
+                lambda s: NamedSharding(mesh, s), self.state_specs,
+                is_leaf=lambda x: isinstance(x, P)))
+
+        state_shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), self.state_specs,
+            is_leaf=lambda x: isinstance(x, P))
+        self.batch_sharding = NamedSharding(mesh, batch_spec)
+
+        self._jitted = jax.jit(
+            self._step,
+            in_shardings=(state_shardings, self.batch_sharding),
+            out_shardings=(state_shardings, None),
+            donate_argnums=(0,))
+
+    def _step(self, state, batch):
+        params = state["params"]
+        buffers = state["buffers"]
+        rng, step_key = jax.random.split(state["rng"])
+
+        def loss_of(p):
+            with _random.rng_scope(default=step_key, dropout=step_key):
+                out, new_buffers = functional_call(
+                    self.model, p, buffers, *batch["args"],
+                    capture_buffers=True)
+                loss = self.loss_fn(out, *batch["labels"])
+            return loss, (new_buffers, out)
+
+        (loss, (new_buffers, out)), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(params)
+        new_params, new_opt = self.optimizer.apply_gradients(
+            params, grads, state["opt"])
+        metrics = {"loss": loss}
+        for name, fn in self.extra_metrics.items():
+            metrics[name] = fn(out, *batch["labels"])
+        return ({"params": new_params, "buffers": new_buffers,
+                 "opt": new_opt, "rng": rng}, metrics)
+
+    def shard_batch(self, *arrays):
+        """Place host arrays onto the mesh with the batch sharding."""
+        return tuple(jax.device_put(jnp.asarray(a), self.batch_sharding)
+                     for a in arrays)
+
+    def __call__(self, *args, labels=()):
+        batch = {"args": args, "labels": tuple(labels)}
+        with self.mesh:
+            self.state, metrics = self._jitted(self.state, batch)
+        return metrics
+
+    @property
+    def params(self):
+        return self.state["params"]
+
+    def sync_to_model(self) -> None:
+        host = jax.tree.map(lambda x: jax.device_get(x),
+                            {**self.state["params"],
+                             **self.state["buffers"]})
+        self.model.set_state_dict(host, strict=False)
+
+
+def megatron_param_rule(mp_axis: str = "mp"):
+    """Example TP rule: shard large 2-D matmul weights column-wise, their
+    paired output projections row-wise, replicate the rest. Heuristic by
+    name; models can pass their own rule."""
+
+    def rule(name: str, value) -> P:
+        shape = getattr(value, "shape", ())
+        if len(shape) == 2:
+            if any(tag in name for tag in ("q_proj", "k_proj", "v_proj",
+                                           "linear1", "fc1")):
+                return P(None, mp_axis)
+            if any(tag in name for tag in ("out_proj", "linear2", "fc2")):
+                return P(mp_axis, None)
+        return P()
+
+    return rule

@@ -1,0 +1,35 @@
+"""Weight-decay regularizers.
+
+Analogue of /root/reference/python/paddle/fluid/regularizer.py
+(L1DecayRegularizer, L2DecayRegularizer — emitted as grad-append ops there;
+here applied functionally inside the optimizer step).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param, grad):
+        raise NotImplementedError
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff: float = 0.0) -> None:
+        self.coeff = coeff
+
+    def __call__(self, param, grad):
+        return grad + self.coeff * param
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff: float = 0.0) -> None:
+        self.coeff = coeff
+
+    def __call__(self, param, grad):
+        return grad + self.coeff * jnp.sign(param)
+
+
+L2DecayRegularizer = L2Decay
+L1DecayRegularizer = L1Decay

@@ -1,0 +1,280 @@
+"""Static-graph programming surface: Program, Executor, Scope, TrainStep.
+
+TPU-native redesign of the reference's static core
+(/root/reference/paddle/fluid/framework/: program_desc.h, scope.h:46,
+executor.h:53; python/paddle/fluid/framework.py Program :3901,
+executor.py Executor.run :900). The mapping:
+
+- ProgramDesc (protobuf op list) → **traced jaxpr**: a Program wraps a pure
+  Python function; tracing it IS program construction, XLA compilation IS
+  the pass pipeline, and the compiled executable replaces the op-by-op
+  C++ interpreter loop (executor.cc:465-472).
+- Scope (hierarchical name→Variable map) → :class:`Scope`, a name→array
+  store with parent-chain lookup; it holds params/optimizer/buffer state
+  between steps and is threaded through compiled programs functionally
+  (donated, so XLA updates in place — no copy per step).
+- Executor.run(feed/fetch) keeps its exact shape: feeds are arrays bound to
+  placeholder names, fetches name outputs.
+- append_backward + optimizer ops → :class:`TrainStep`, which fuses
+  forward, jax.grad backward, and the optimizer update into ONE compiled
+  XLA program (the reference needs three pass systems for this).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as _random
+from ..errors import NotFoundError
+from ..flags import GLOBAL_FLAGS
+from ..nn.layer import Layer, functional_call
+from ..optimizer import Optimizer
+
+
+class Scope:
+    """Hierarchical variable store (ref: scope.h:46)."""
+
+    def __init__(self, parent: Optional["Scope"] = None) -> None:
+        self._vars: Dict[str, Any] = {}
+        self._parent = parent
+        self._kids: List[Scope] = []
+
+    def var(self, name: str, value=None):
+        if name not in self._vars:
+            self._vars[name] = value
+        return self._vars[name]
+
+    def find_var(self, name: str):
+        scope: Optional[Scope] = self
+        while scope is not None:
+            if name in scope._vars:
+                return scope._vars[name]
+            scope = scope._parent
+        raise NotFoundError(f"variable '{name}' not found in scope chain")
+
+    def has_var(self, name: str) -> bool:
+        try:
+            self.find_var(name)
+            return True
+        except NotFoundError:
+            return False
+
+    def set_var(self, name: str, value) -> None:
+        self._vars[name] = value
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self) -> None:
+        self._kids.clear()
+
+    def local_var_names(self) -> List[str]:
+        return list(self._vars)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(self._vars)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+class Program:
+    """A compiled-function program.
+
+    ``fn(state: dict, feeds: dict) -> (new_state: dict, fetches: dict)``
+    where ``state`` holds named persistent variables (params, optimizer
+    slots, stats). Feeds/fetches are name-keyed, matching Executor.run's
+    reference API (executor.py:900). State buffers are donated.
+    """
+
+    def __init__(self, fn: Callable, state_names: Optional[Sequence[str]]
+                 = None, name: str = "program") -> None:
+        self.fn = fn
+        self.name = name
+        self.state_names = list(state_names) if state_names else None
+        self._compiled = None
+
+    def _get_compiled(self):
+        if self._compiled is None:
+            self._compiled = jax.jit(self.fn, donate_argnums=(0,))
+        return self._compiled
+
+    def run(self, state: Dict[str, Any], feeds: Dict[str, Any]):
+        return self._get_compiled()(state, feeds)
+
+    def clone(self, for_test: bool = False) -> "Program":
+        return Program(self.fn, self.state_names, self.name + "_clone")
+
+
+class Executor:
+    """(ref: executor.py:900 / executor.cc:180). Holds the scope, binds
+    feeds, runs compiled programs, returns fetches as numpy."""
+
+    def __init__(self, place=None) -> None:
+        from ..core.place import get_device
+        self.place = place if place is not None else get_device()
+        self.scope = global_scope()
+
+    def run(self, program: Program, feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[Sequence[str]] = None,
+            scope: Optional[Scope] = None, return_numpy: bool = True):
+        scope = scope or self.scope
+        feed = feed or {}
+        feed = {k: jnp.asarray(v) for k, v in feed.items()}
+        state_names = program.state_names
+        if state_names is None:
+            state = scope.as_dict()
+        else:
+            state = {n: scope.find_var(n) for n in state_names}
+        new_state, fetches = program.run(state, feed)
+        for k, v in new_state.items():
+            scope.set_var(k, v)
+        if GLOBAL_FLAGS.get("check_nan_inf"):
+            _check_nan_inf(fetches, program.name)
+        if fetch_list is None:
+            out = fetches
+        else:
+            out = [fetches[name] for name in fetch_list]
+        if return_numpy:
+            out = jax.tree.map(np.asarray, out)
+        return out
+
+
+def _check_nan_inf(tree, what: str) -> None:
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating) and \
+                not np.isfinite(arr).all():
+            raise FloatingPointError(
+                f"NaN/Inf detected in {what} output {path}"
+                " (FLAGS_check_nan_inf)")
+
+
+# ---------------------------------------------------------------------------
+# TrainStep — the fused train program builder
+# ---------------------------------------------------------------------------
+
+class TrainStep:
+    """Compile model+loss+optimizer into one donated-state XLA program.
+
+    Replaces the reference's append_backward (backward.py:1215) + optimizer
+    op emission + ParallelExecutor run loop for the single-device case.
+
+    Usage::
+
+        step = TrainStep(model, opt, loss_fn)
+        for batch in loader:
+            loss = step(batch)     # state lives inside, donated each call
+    """
+
+    def __init__(self, model: Layer, optimizer: Optimizer,
+                 loss_fn: Callable, extra_metrics: Optional[Dict[str,
+                 Callable]] = None, seed: int = 0) -> None:
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.extra_metrics = extra_metrics or {}
+        params = model.param_dict()
+        buffers = model.buffer_dict()
+        self.state = {
+            "params": params,
+            "buffers": buffers,
+            "opt": optimizer.init(params),
+            "rng": jax.random.key(seed),
+        }
+        self._jitted = jax.jit(self._step, donate_argnums=(0,))
+
+    def _step(self, state, batch):
+        params = state["params"]
+        buffers = state["buffers"]
+        rng, step_key = jax.random.split(state["rng"])
+
+        def loss_of(p):
+            with _random.rng_scope(default=step_key, dropout=step_key):
+                out, new_buffers = functional_call(
+                    self.model, p, buffers, *batch["args"],
+                    capture_buffers=True, **batch.get("kwargs", {}))
+                loss = self.loss_fn(out, *batch["labels"])
+            return loss, (new_buffers, out)
+
+        (loss, (new_buffers, out)), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(params)
+        new_params, new_opt = self.optimizer.apply_gradients(
+            params, grads, state["opt"])
+        metrics = {"loss": loss}
+        for name, fn in self.extra_metrics.items():
+            metrics[name] = fn(out, *batch["labels"])
+        return ({"params": new_params, "buffers": new_buffers,
+                 "opt": new_opt, "rng": rng}, metrics)
+
+    def __call__(self, *args, labels=(), **kwargs):
+        batch = {"args": args, "labels": tuple(labels), "kwargs": kwargs}
+        self.state, metrics = self._jitted(self.state, batch)
+        return metrics
+
+    # sync trained state back into the eager model
+    def sync_to_model(self) -> None:
+        params = jax.tree.map(lambda x: x, self.state["params"])
+        self.model.set_state_dict({**params, **self.state["buffers"]},
+                                  strict=False)
+
+    @property
+    def params(self):
+        return self.state["params"]
+
+
+class EvalStep:
+    """Jitted inference step (no grad, eval-mode buffers frozen)."""
+
+    def __init__(self, model: Layer,
+                 metric_fns: Optional[Dict[str, Callable]] = None) -> None:
+        self.model = model
+        self.metric_fns = metric_fns or {}
+        self._jitted = jax.jit(self._step)
+
+    def _step(self, params, buffers, batch):
+        was_training = self.model.training
+        self.model.eval()
+        try:
+            out = functional_call(self.model, params, buffers,
+                                  *batch["args"])
+        finally:
+            if was_training:
+                self.model.train()
+        metrics = {name: fn(out, *batch["labels"])
+                   for name, fn in self.metric_fns.items()}
+        return out, metrics
+
+    def __call__(self, params, buffers, *args, labels=()):
+        return self._jitted(params, buffers,
+                            {"args": args, "labels": tuple(labels)})
+
+
+# ---------------------------------------------------------------------------
+# program_guard-era helpers (thin parity shims)
+# ---------------------------------------------------------------------------
+
+def data(name: str, shape: Sequence[int], dtype="float32"):
+    """Placeholder declaration (ref: fluid.data). Returns a spec used for
+    documentation/validation; programs take feeds by name at run time."""
+    from ..core.dtype import convert_dtype
+    return jax.ShapeDtypeStruct(
+        tuple(s if s and s > 0 else 1 for s in shape), convert_dtype(dtype))
+
+
+def default_main_program():
+    raise NotImplementedError(
+        "program construction is tracing in the TPU design: wrap your "
+        "computation in a function and build a Program(fn) "
+        "(see paddle_tpu.static.Program)")

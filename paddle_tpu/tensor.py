@@ -1,0 +1,43 @@
+"""Tensor facade.
+
+The reference's Tensor/LoDTensor/Variable triplet
+(/root/reference/paddle/fluid/framework/tensor.h:37, lod_tensor.h:104,
+variable.h:26) collapses on TPU to **jax.Array**: device placement, dtype,
+and layout are owned by XLA/PJRT, autograd comes from functional transforms,
+and ragged sequences use the dense-padded representation in ops.sequence.
+``Tensor`` is therefore an alias plus conversion helpers — the idiomatic
+design is that every framework function accepts and returns jax arrays
+directly (zero wrapper overhead in traced code).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core.dtype import convert_dtype
+from .core.place import Place
+
+Tensor = jax.Array
+
+
+def to_tensor(data: Any, dtype=None, place: Optional[Place] = None,
+              stop_gradient: bool = True) -> jax.Array:
+    """Mirrors paddle.to_tensor. ``stop_gradient`` is advisory only —
+    differentiation is selected by what you pass to jax.grad."""
+    dt = convert_dtype(dtype) if dtype is not None else None
+    arr = jnp.asarray(data, dtype=dt)
+    if place is not None:
+        arr = jax.device_put(arr, place.jax_device())
+    return arr
+
+
+def to_numpy(x: Any) -> np.ndarray:
+    return np.asarray(x)
+
+
+def is_tensor(x: Any) -> bool:
+    return isinstance(x, jax.Array)

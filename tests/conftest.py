@@ -1,0 +1,29 @@
+"""Test configuration.
+
+Tests run on XLA-CPU with 8 virtual devices (the "no real cluster" fake
+backend — SURVEY.md §4 TPU plan), so sharding/collective tests exercise the
+same mesh code paths the driver validates with dryrun_multichip.
+Must set env vars BEFORE jax initializes.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture(autouse=True)
+def _seed_framework():
+    import paddle_tpu
+    paddle_tpu.seed(1234)
+    yield

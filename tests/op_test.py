@@ -1,0 +1,84 @@
+"""OpTest harness: numeric-vs-analytic gradient checking.
+
+Mirrors the reference's operator test strategy
+(/root/reference/python/paddle/fluid/tests/unittests/op_test.py:170:
+check_output compares op outputs against numpy references; check_grad :1236
+compares analytic grads against central finite differences
+get_numeric_gradient :57). Here the analytic grad comes from jax.grad and
+the numeric one from central differences at fp64-on-CPU precision.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def check_output(fn: Callable, args: Sequence[np.ndarray],
+                 expected, rtol: float = 1e-5, atol: float = 1e-6) -> None:
+    """Run ``fn`` eagerly AND under jit; both must match ``expected``."""
+    jargs = [jnp.asarray(a) for a in args]
+    eager = fn(*jargs)
+    jitted = jax.jit(fn)(*jargs)
+    for got, name in ((eager, "eager"), (jitted, "jit")):
+        got_flat = jax.tree.leaves(got)
+        exp_flat = jax.tree.leaves(expected)
+        assert len(got_flat) == len(exp_flat), \
+            f"{name}: output arity {len(got_flat)} != {len(exp_flat)}"
+        for g, e in zip(got_flat, exp_flat):
+            np.testing.assert_allclose(
+                np.asarray(g, dtype=np.float64)
+                if np.issubdtype(np.asarray(g).dtype, np.floating)
+                else np.asarray(g),
+                np.asarray(e), rtol=rtol, atol=atol,
+                err_msg=f"[{name} path]")
+
+
+def numeric_grad(fn: Callable, args: Sequence[np.ndarray], wrt: int = 0,
+                 eps: float = 1e-3) -> np.ndarray:
+    """Central finite differences of sum(fn(args)) wrt args[wrt]
+    (ref: op_test.py get_numeric_gradient :57)."""
+    args = [np.asarray(a, dtype=np.float64 if np.issubdtype(
+        np.asarray(a).dtype, np.floating) else None) for a in args]
+    base = args[wrt].astype(np.float64)
+    grad = np.zeros_like(base)
+    flat = base.reshape(-1)
+    gflat = grad.reshape(-1)
+
+    def total(x):
+        call_args = list(args)
+        call_args[wrt] = x.astype(np.float32)
+        out = fn(*[jnp.asarray(a) for a in call_args])
+        return float(jnp.sum(jnp.asarray(out, jnp.float64)
+                             if not isinstance(out, tuple)
+                             else sum(jnp.sum(o) for o in out)))
+
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        f_plus = total(base.reshape(args[wrt].shape))
+        flat[i] = orig - eps
+        f_minus = total(base.reshape(args[wrt].shape))
+        flat[i] = orig
+        gflat[i] = (f_plus - f_minus) / (2 * eps)
+    return grad
+
+
+def check_grad(fn: Callable, args: Sequence[np.ndarray], wrt: int = 0,
+               rtol: float = 5e-2, atol: float = 1e-3,
+               eps: float = 1e-3) -> None:
+    """Compare jax.grad of sum(fn) against central differences."""
+    jargs = [jnp.asarray(a) for a in args]
+
+    def scalar_fn(*xs):
+        out = fn(*xs)
+        if isinstance(out, tuple):
+            return sum(jnp.sum(o) for o in out)
+        return jnp.sum(out)
+
+    analytic = np.asarray(jax.grad(scalar_fn, argnums=wrt)(*jargs))
+    numeric = numeric_grad(fn, args, wrt=wrt, eps=eps)
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)

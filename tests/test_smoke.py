@@ -1,0 +1,53 @@
+"""Package import + basic op smoke tests."""
+
+import numpy as np
+
+
+def test_import():
+    import paddle_tpu
+    assert paddle_tpu.__version__
+
+
+def test_basic_ops():
+    import paddle_tpu as pt
+    x = pt.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    y = pt.to_tensor(np.array([[5.0, 6.0], [7.0, 8.0]], np.float32))
+    out = pt.matmul(x, y)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.array([[19, 22], [43, 50]], np.float32))
+    assert float(pt.ops.reduction.sum(x)) == 10.0
+
+
+def test_flags():
+    import paddle_tpu as pt
+    pt.set_flags({"check_nan_inf": True})
+    assert pt.get_flags("check_nan_inf")["check_nan_inf"] is True
+    pt.set_flags({"check_nan_inf": False})
+
+
+def test_place():
+    import paddle_tpu as pt
+    p = pt.CPUPlace()
+    assert p.jax_device().platform == "cpu"
+
+
+def test_layer_basics():
+    import paddle_tpu as pt
+    lin = pt.nn.Linear(4, 3)
+    x = pt.ops.random_ops.randn((2, 4))
+    out = lin(x)
+    assert out.shape == (2, 3)
+    sd = lin.state_dict()
+    assert set(sd) == {"weight", "bias"}
+
+
+def test_sequential_and_state_dict():
+    import paddle_tpu as pt
+    model = pt.nn.Sequential(
+        pt.nn.Linear(4, 8), pt.nn.ReLU(), pt.nn.Linear(8, 2))
+    x = pt.ops.random_ops.randn((5, 4))
+    out = model(x)
+    assert out.shape == (5, 2)
+    sd = model.state_dict()
+    assert "0.weight" in sd and "2.bias" in sd
+    model.set_state_dict(sd)
