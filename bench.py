@@ -1,0 +1,70 @@
+"""Benchmark driver: BERT-base pretraining tokens/sec/chip on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is measured MFU / 0.80-of-A100-MFU-equivalent target
+(BASELINE.json: ≥80% A100-MFU-equivalent). A100 bf16 peak ≈ 312 TFLOPs;
+v5e chip bf16 peak ≈ 394 TFLOPs ⇒ the target throughput for this chip is
+0.8 * 394 = 315 TFLOPs effective; vs_baseline = achieved_TFLOPs / 315.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.models import (BertConfig, BertForPretraining,
+                                   pretraining_loss)
+    from paddle_tpu.static import TrainStep
+
+    on_accel = any(d.platform in ("tpu", "axon") for d in jax.devices())
+    # BERT-base, seq 512, bf16 compute
+    config = BertConfig()
+    batch, seq = (8, 512) if on_accel else (2, 128)
+
+    pt.seed(0)
+    model = BertForPretraining(config)
+    # bf16 params for MXU; LN/softmax stay fp32 inside ops
+    model.to(dtype="bfloat16")
+    opt = pt.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01)
+    step = TrainStep(model, opt,
+                     lambda out, mlm, nsp: pretraining_loss(out, mlm, nsp))
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, config.vocab_size, (batch, seq)).astype(np.int32)
+    mlm = rng.integers(0, config.vocab_size, (batch, seq)).astype(np.int64)
+    nsp = rng.integers(0, 2, (batch,)).astype(np.int64)
+
+    # warmup/compile
+    m = step(ids, labels=(mlm, nsp))
+    jax.block_until_ready(m["loss"])
+
+    iters = 20 if on_accel else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        m = step(ids, labels=(mlm, nsp))
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+    # BERT-base fwd+bwd ≈ 3 × 2 × params × tokens FLOPs (params ≈ 110e6)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    flops_per_token = 6 * n_params
+    achieved_tflops = tokens_per_sec * flops_per_token / 1e12
+    target_tflops = 0.8 * 394.0  # 80% of v5e bf16 peak (A100-MFU-equiv)
+    print(json.dumps({
+        "metric": "BERT-base pretrain tokens/sec/chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(achieved_tflops / target_tflops, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

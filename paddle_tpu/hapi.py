@@ -1,0 +1,259 @@
+"""High-level Model API (Model.fit/evaluate/predict).
+
+TPU-native analogue of /root/reference/python/paddle/incubate/hapi/model.py
+(Model.fit :632, evaluate :1079, predict; callbacks in hapi/callbacks.py;
+ProgBarLogger). The reference switches between static/dygraph adapters;
+here there is one path — the jitted TrainStep/EvalStep — so fit() is a
+thin loop: DataLoader → step → metrics/callbacks → checkpoints.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import io as io_mod
+from .metric import Metric
+from .nn.layer import Layer
+from .optimizer import Optimizer
+from .static import EvalStep, TrainStep
+
+
+class Callback:
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_batch_end(self, step, logs=None):
+        pass
+
+
+class ProgBarLogger(Callback):
+    """(ref: hapi/callbacks.py ProgBarLogger)."""
+
+    def __init__(self, log_freq: int = 10, verbose: int = 1) -> None:
+        self.log_freq = log_freq
+        self.verbose = verbose
+        self._t0 = 0.0
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._t0 = time.time()
+        self._epoch = epoch
+
+    def on_batch_end(self, step, logs=None):
+        if self.verbose and step % self.log_freq == 0:
+            items = " ".join(f"{k}={float(v):.4f}"
+                             for k, v in (logs or {}).items())
+            print(f"[epoch {self._epoch} step {step}] {items}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - self._t0
+            items = " ".join(f"{k}={float(v):.4f}"
+                             for k, v in (logs or {}).items())
+            print(f"[epoch {epoch} done in {dt:.1f}s] {items}")
+
+
+class ModelCheckpoint(Callback):
+    """(ref: hapi/callbacks.py ModelCheckpoint)."""
+
+    def __init__(self, model: "Model", save_dir: str,
+                 save_freq: int = 1) -> None:
+        self.model = model
+        self.save_dir = save_dir
+        self.save_freq = save_freq
+
+    def on_epoch_end(self, epoch, logs=None):
+        if (epoch + 1) % self.save_freq == 0:
+            self.model.save(f"{self.save_dir}/epoch-{epoch}")
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor: str = "loss", patience: int = 3,
+                 mode: str = "min") -> None:
+        self.monitor = monitor
+        self.patience = patience
+        self.mode = mode
+        self.best = None
+        self.bad = 0
+        self.stop_training = False
+
+    def on_epoch_end(self, epoch, logs=None):
+        val = float((logs or {}).get(self.monitor, np.nan))
+        better = (self.best is None
+                  or (self.mode == "min" and val < self.best)
+                  or (self.mode == "max" and val > self.best))
+        if better:
+            self.best = val
+            self.bad = 0
+        else:
+            self.bad += 1
+            if self.bad >= self.patience:
+                self.stop_training = True
+
+
+class Model:
+    """(ref: hapi/model.py Model)."""
+
+    def __init__(self, network: Layer, loss: Optional[Callable] = None,
+                 optimizer: Optional[Optimizer] = None,
+                 metrics: Optional[Sequence[Metric]] = None) -> None:
+        self.network = network
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = list(metrics or [])
+        self._train_step: Optional[TrainStep] = None
+        self._eval_step: Optional[EvalStep] = None
+
+    def prepare(self, optimizer: Optional[Optimizer] = None,
+                loss: Optional[Callable] = None,
+                metrics: Optional[Sequence[Metric]] = None) -> "Model":
+        if optimizer is not None:
+            self._optimizer = optimizer
+        if loss is not None:
+            self._loss = loss
+        if metrics is not None:
+            self._metrics = list(metrics)
+        return self
+
+    def _get_train_step(self) -> TrainStep:
+        if self._train_step is None:
+            loss_fn = self._loss
+            if isinstance(loss_fn, Layer):
+                fn = loss_fn
+
+                def loss_call(out, *labels):
+                    return fn(out, *labels)
+            else:
+                loss_call = loss_fn
+            extra = {}
+            for m in self._metrics:
+                if hasattr(m, "compute") and hasattr(m, "topk"):
+                    from .ops.metrics_ops import accuracy as acc_fn
+                    extra["acc"] = (lambda out, *ls:
+                                    acc_fn(out, ls[0]))
+            self._train_step = TrainStep(self.network, self._optimizer,
+                                         loss_call, extra_metrics=extra)
+        return self._train_step
+
+    def train_batch(self, inputs, labels) -> Dict[str, float]:
+        step = self._get_train_step()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        metrics = step(*inputs, labels=tuple(labels))
+        return {k: float(v) for k, v in metrics.items()}
+
+    def fit(self, train_loader, eval_loader=None, epochs: int = 1,
+            callbacks: Optional[List[Callback]] = None,
+            verbose: int = 1, log_freq: int = 10) -> None:
+        callbacks = list(callbacks or [])
+        if verbose:
+            callbacks.append(ProgBarLogger(log_freq, verbose))
+        for cb in callbacks:
+            cb.on_train_begin()
+        for epoch in range(epochs):
+            for cb in callbacks:
+                cb.on_epoch_begin(epoch)
+            logs: Dict[str, float] = {}
+            for i, batch in enumerate(train_loader):
+                *inputs, label = batch
+                logs = self.train_batch(inputs, [label])
+                for cb in callbacks:
+                    cb.on_batch_end(i, logs)
+            if eval_loader is not None:
+                logs.update(self.evaluate(eval_loader, verbose=0))
+            for cb in callbacks:
+                cb.on_epoch_end(epoch, logs)
+            if any(getattr(cb, "stop_training", False)
+                   for cb in callbacks):
+                break
+        for cb in callbacks:
+            cb.on_train_end()
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+
+    def _current_state(self):
+        if self._optimizer is not None and self._train_step is not None:
+            st = self._train_step.state
+            return st["params"], st["buffers"]
+        return self.network.param_dict(), self.network.buffer_dict()
+
+    def _get_eval_step(self) -> EvalStep:
+        if self._eval_step is None:
+            self._eval_step = EvalStep(self.network)
+        return self._eval_step
+
+    def evaluate(self, eval_loader, verbose: int = 1) -> Dict[str, float]:
+        params, buffers = self._current_state()
+        ev = self._get_eval_step()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in eval_loader:
+            *inputs, label = batch
+            out, _ = ev(params, buffers, *inputs)
+            if self._loss is not None:
+                losses.append(float(self._loss(out, jnp.asarray(label))))
+            for m in self._metrics:
+                if hasattr(m, "compute"):
+                    m.update(m.compute(out, jnp.asarray(label)))
+                else:
+                    m.update(out, label)
+        result = {}
+        if losses:
+            result["eval_loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            result[f"eval_{m.name()}"] = m.accumulate()
+        return result
+
+    def predict_batch(self, inputs):
+        params, buffers = self._current_state()
+        ev = self._get_eval_step()
+        out, _ = ev(params, buffers,
+                    *(inputs if isinstance(inputs, (list, tuple))
+                      else [inputs]))
+        return out
+
+    def predict(self, loader) -> List:
+        return [np.asarray(self.predict_batch(list(b)[:-1]
+                                              if isinstance(b, tuple)
+                                              else b)) for b in loader]
+
+    def save(self, path: str) -> None:
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+        io_mod.save(self.network.state_dict(), path + ".pdparams")
+
+    def load(self, path: str) -> None:
+        state = io_mod.load(path + ".pdparams")
+        self.network.set_state_dict(
+            {k.replace("/", "."): v for k, v in state.items()},
+            strict=False)
+        self._train_step = None
+        self._eval_step = None
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self) -> str:
+        lines = ["Layer (type)                 Param #"]
+        total = 0
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.value.shape))
+            total += n
+            lines.append(f"{name:<30} {n}")
+        lines.append(f"Total params: {total}")
+        out = "\n".join(lines)
+        print(out)
+        return out

@@ -47,11 +47,9 @@ def maybe_flash_attention(q, k, v, mask=None, scale: Optional[float] = None,
                           training: bool = False):
     """q/k/v: [B, H, T, D]."""
     from ..ops.attention import scaled_dot_product_attention as ref_impl
-    if pallas_enabled() and dropout_p == 0.0 and mask is None:
-        try:
-            from .flash_attention import flash_attention
-            return flash_attention(q, k, v, causal=causal, scale=scale)
-        except NotImplementedError:
-            pass
+    if (pallas_enabled() and dropout_p == 0.0 and mask is None
+            and q.ndim == 4 and q.shape[-1] % 128 == 0):
+        from .flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal, scale=scale)
     return ref_impl(q, k, v, mask=mask, scale=scale, causal=causal,
                     dropout_p=dropout_p, training=training)

@@ -1,0 +1,7 @@
+"""Model zoo (targets from BASELINE.json configs)."""
+
+from .bert import (BertConfig, BertForPretraining, BertModel,
+                   bert_base_config, bert_large_config, pretraining_loss)
+from .lenet import LeNet
+from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
+                     resnet152, resnext50_32x4d)

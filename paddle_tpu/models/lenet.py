@@ -1,0 +1,37 @@
+"""LeNet-5 for MNIST.
+
+Functional parity target: the reference's recognize_digits book test
+(/root/reference/python/paddle/fluid/tests/book/test_recognize_digits.py —
+conv_pool x2 + fc softmax trained to accuracy threshold). BASELINE.json
+config 1.
+"""
+
+from __future__ import annotations
+
+from .. import nn
+
+
+class LeNet(nn.Layer):
+    def __init__(self, num_classes: int = 10) -> None:
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(1, 6, 5, padding=2),
+            nn.ReLU(),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(6, 16, 5),
+            nn.ReLU(),
+            nn.MaxPool2D(2, 2),
+        )
+        self.flatten = nn.Flatten()
+        self.fc = nn.Sequential(
+            nn.Linear(16 * 5 * 5, 120),
+            nn.ReLU(),
+            nn.Linear(120, 84),
+            nn.ReLU(),
+            nn.Linear(84, num_classes),
+        )
+
+    def forward(self, x):
+        x = self.features(x)
+        x = self.flatten(x)
+        return self.fc(x)

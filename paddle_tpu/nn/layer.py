@@ -204,7 +204,7 @@ class Layer:
     # ------------------------------------------------------------------
     def state_dict(self, include_buffers: bool = True,
                    trainable_only: bool = False) -> Dict[str, jax.Array]:
-        out: Dict[str, jax.Array] = OrderedDict()
+        out: Dict[str, jax.Array] = {}
         for name, p in self.named_parameters():
             if trainable_only and not p.trainable:
                 continue
@@ -244,13 +244,11 @@ class Layer:
 
     # split state: params vs buffers — the functional step threads both
     def param_dict(self, trainable_only: bool = True) -> Dict[str, jax.Array]:
-        return OrderedDict(
-            (n, p.value) for n, p in self.named_parameters()
-            if p.trainable or not trainable_only)
+        return {n: p.value for n, p in self.named_parameters()
+                if p.trainable or not trainable_only}
 
     def buffer_dict(self) -> Dict[str, jax.Array]:
-        return OrderedDict((n, b) for n, b in self.named_buffers()
-                           if b is not None)
+        return {n: b for n, b in self.named_buffers() if b is not None}
 
     # ------------------------------------------------------------------
     # functional binding (see module docstring)
@@ -277,10 +275,10 @@ class Layer:
                     layer, bname = slots[n]
                     layer._buffers[bname] = v
             yield capture
-            capture.buffers = OrderedDict(
-                (n, layer._buffers[bname])
+            capture.buffers = {
+                n: layer._buffers[bname]
                 for n, (layer, bname) in slots.items()
-                if layer._buffers[bname] is not None)
+                if layer._buffers[bname] is not None}
         finally:
             own = dict(self.named_parameters())
             for n, v in saved_params.items():
@@ -343,7 +341,7 @@ class Layer:
 
 class _BindCapture:
     def __init__(self) -> None:
-        self.buffers: Dict[str, jax.Array] = OrderedDict()
+        self.buffers: Dict[str, jax.Array] = {}
 
 
 class HookRemoveHelper:

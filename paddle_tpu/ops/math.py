@@ -44,9 +44,10 @@ def matmul(x, y, transpose_x: bool = False, transpose_y: bool = False,
 
 def mul(x, y, x_num_col_dims: int = 1, y_num_col_dims: int = 1):
     """Flattening matmul (ref: mul_op.cc) — collapses leading dims."""
+    import numpy as _np
     xs, ys = x.shape, y.shape
-    x2 = x.reshape((int(jnp.prod(jnp.array(xs[:x_num_col_dims]))), -1))
-    y2 = y.reshape((int(jnp.prod(jnp.array(ys[:y_num_col_dims]))), -1))
+    x2 = x.reshape((int(_np.prod(xs[:x_num_col_dims])), -1))
+    y2 = y.reshape((int(_np.prod(ys[:y_num_col_dims])), -1))
     out = jnp.matmul(x2, y2, precision=_precision())
     return out.reshape(xs[:x_num_col_dims] + ys[y_num_col_dims:])
 

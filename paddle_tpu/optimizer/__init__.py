@@ -245,6 +245,17 @@ class Adam(Optimizer):
 
     def update(self, p, g, slots, lr_t, step):
         g = g.astype(p.dtype)
+        from ..kernels import pallas_enabled
+        if (pallas_enabled() and p.dtype == jnp.float32
+                and slots["m"].dtype == jnp.float32 and p.size >= 1024):
+            from ..kernels.fused_adam import fused_adam_flat
+            lr_c = self._bias_correct_lr(lr_t, step)
+            p_new, m, v = fused_adam_flat(
+                p.ravel(), g.ravel(), slots["m"].ravel(),
+                slots["v"].ravel(), lr_c, self.beta1, self.beta2,
+                self.epsilon)
+            return (p_new.reshape(p.shape),
+                    {"m": m.reshape(p.shape), "v": v.reshape(p.shape)})
         m = self.beta1 * slots["m"] + (1 - self.beta1) * g
         v = self.beta2 * slots["v"] + (1 - self.beta2) * jnp.square(g)
         lr_c = self._bias_correct_lr(lr_t, step)

@@ -1,0 +1,189 @@
+"""Profiling & observability.
+
+TPU-native redesign of the reference's three-part tracing stack
+(/root/reference/paddle/fluid/platform/profiler.h:126 RecordEvent spans,
+profiler.h:208 Enable/DisableProfiler + chrome-trace output;
+device_tracer.cc:61 CUPTI device timelines; monitor.h:33 global stat
+registry). Mapping:
+
+- CUPTI device tracing → **jax.profiler / XPlane**: start_profiler writes
+  TensorBoard-loadable traces with real TPU kernel timelines.
+- RecordEvent host spans → :class:`RecordEvent` (times host code AND
+  forwards to jax.profiler.TraceAnnotation so spans land in the xplane).
+- monitor.h STAT registry → :class:`StatRegistry` (monotonic counters).
+- FLAGS_benchmark per-op sync → ``benchmark_sync()`` helper that
+  block_until_ready()s a pytree (operator.cc:1022 analogue).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from .flags import GLOBAL_FLAGS
+
+
+class _ProfilerState:
+    def __init__(self) -> None:
+        self.active = False
+        self.log_dir: Optional[str] = None
+        self.events: List[Dict[str, Any]] = []
+        self.lock = threading.Lock()
+
+
+_state = _ProfilerState()
+
+
+def start_profiler(log_dir: Optional[str] = None) -> None:
+    """(ref: EnableProfiler, profiler.h:208)."""
+    log_dir = log_dir or GLOBAL_FLAGS.get("profile_dir") or "/tmp/pt_prof"
+    jax.profiler.start_trace(log_dir)
+    _state.active = True
+    _state.log_dir = log_dir
+
+
+def stop_profiler() -> Optional[str]:
+    """(ref: DisableProfiler) — returns the trace directory."""
+    if _state.active:
+        jax.profiler.stop_trace()
+        _state.active = False
+    return _state.log_dir
+
+
+@contextlib.contextmanager
+def profiler(log_dir: Optional[str] = None):
+    """Context manager parity with fluid.profiler.profiler()."""
+    start_profiler(log_dir)
+    try:
+        yield
+    finally:
+        stop_profiler()
+
+
+class RecordEvent:
+    """Host-side span that also annotates the device trace
+    (ref: platform::RecordEvent RAII, profiler.h:126)."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._trace_ctx = None
+        self._t0 = 0.0
+
+    def __enter__(self) -> "RecordEvent":
+        self._t0 = time.perf_counter()
+        self._trace_ctx = jax.profiler.TraceAnnotation(self.name)
+        self._trace_ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._trace_ctx.__exit__(*exc)
+        dt = time.perf_counter() - self._t0
+        with _state.lock:
+            _state.events.append({"name": self.name, "dur_s": dt,
+                                  "ts": self._t0})
+
+
+def get_host_events() -> List[Dict[str, Any]]:
+    with _state.lock:
+        return list(_state.events)
+
+
+def reset_host_events() -> None:
+    with _state.lock:
+        _state.events.clear()
+
+
+def event_summary() -> Dict[str, Dict[str, float]]:
+    """Aggregated table like the reference's profiler summary printer."""
+    agg: Dict[str, Dict[str, float]] = defaultdict(
+        lambda: {"calls": 0, "total_s": 0.0, "max_s": 0.0})
+    for e in get_host_events():
+        a = agg[e["name"]]
+        a["calls"] += 1
+        a["total_s"] += e["dur_s"]
+        a["max_s"] = max(a["max_s"], e["dur_s"])
+    for a in agg.values():
+        a["avg_s"] = a["total_s"] / max(a["calls"], 1)
+    return dict(agg)
+
+
+class StatRegistry:
+    """(ref: monitor.h:33 StatRegistry, STAT_ADD :129)."""
+
+    def __init__(self) -> None:
+        self._stats: Dict[str, int] = defaultdict(int)
+        self._lock = threading.Lock()
+
+    def add(self, name: str, value: int = 1) -> None:
+        with self._lock:
+            self._stats[name] += value
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._stats[name]
+
+    def set(self, name: str, value: int) -> None:
+        with self._lock:
+            self._stats[name] = value
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._stats)
+
+
+stats = StatRegistry()
+
+
+def stat_add(name: str, value: int = 1) -> None:
+    stats.add(name, value)
+
+
+def benchmark_sync(tree) -> Any:
+    """Block on device work for accurate timing
+    (ref: FLAGS_benchmark sync, operator.cc:1022)."""
+    return jax.block_until_ready(tree)
+
+
+def device_memory_stats() -> Dict[str, int]:
+    """Allocator stats analogue (ref: memory/stats + gpu_info mem flags)."""
+    out: Dict[str, int] = {}
+    for d in jax.local_devices():
+        try:
+            ms = d.memory_stats()
+            if ms:
+                out[str(d)] = int(ms.get("bytes_in_use", 0))
+        except Exception:
+            pass
+    return out
+
+
+class StepTimer:
+    """Per-step timing hook with throughput accounting — the
+    trainer-loop observability the reference gets from DeviceWorker
+    PrintFetchVars/monitor stats."""
+
+    def __init__(self, items_per_step: int = 0) -> None:
+        self.items_per_step = items_per_step
+        self.times: List[float] = []
+        self._t0: Optional[float] = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self, result=None) -> float:
+        if GLOBAL_FLAGS.get("benchmark") and result is not None:
+            benchmark_sync(result)
+        dt = time.perf_counter() - (self._t0 or time.perf_counter())
+        self.times.append(dt)
+        return dt
+
+    def throughput(self, skip_first: int = 1) -> float:
+        ts = self.times[skip_first:] or self.times
+        if not ts:
+            return 0.0
+        return self.items_per_step * len(ts) / sum(ts)

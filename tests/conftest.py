@@ -8,13 +8,32 @@ Must set env vars BEFORE jax initializes.
 
 import os
 
-os.environ.setdefault("XLA_FLAGS",
-                      "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Force-override to the virtual 8-device CPU backend. NOTE: the ambient
+# environment both pins JAX_PLATFORMS to the real accelerator AND
+# pre-imports jax via sitecustomize, so env vars alone are too late —
+# jax.config.update is required. XLA_FLAGS is still read at (lazy) CPU
+# client creation, which has not happened yet at conftest time.
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_ENABLE_X64"] = "0"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
 
 import numpy as np
 import pytest
+
+# Op-correctness tests check math, not MXU throughput: run matmuls at
+# highest precision (bench/production paths use the bf16 default).
+jax.config.update("jax_default_matmul_precision", "highest")
+
+# Persistent compilation cache: CPU-XLA conv compiles are slow (~20s for
+# LeNet); cache them across pytest runs.
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
 @pytest.fixture

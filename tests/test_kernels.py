@@ -1,0 +1,161 @@
+"""Pallas kernel correctness vs XLA reference compositions (interpret mode
+on CPU; the same kernels compile natively on TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+class TestLayerNormKernel:
+    def test_matches_reference(self, rng):
+        from paddle_tpu.kernels.layer_norm import layer_norm_pallas
+        from paddle_tpu.ops.nn_functional import layer_norm
+
+        x = rng.standard_normal((32, 256)).astype(np.float32)
+        w = rng.standard_normal((256,)).astype(np.float32)
+        b = rng.standard_normal((256,)).astype(np.float32)
+        ref = layer_norm(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+                         1e-5, -1)
+        got = layer_norm_pallas(jnp.asarray(x), jnp.asarray(w),
+                                jnp.asarray(b), 1e-5, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_3d_input(self, rng):
+        from paddle_tpu.kernels.layer_norm import layer_norm_pallas
+        from paddle_tpu.ops.nn_functional import layer_norm
+
+        x = rng.standard_normal((4, 16, 128)).astype(np.float32)
+        w = np.ones((128,), np.float32)
+        b = np.zeros((128,), np.float32)
+        ref = layer_norm(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+                         1e-5, -1)
+        got = layer_norm_pallas(jnp.asarray(x), jnp.asarray(w),
+                                jnp.asarray(b), 1e-5, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+class TestFlashAttention:
+    def _reference(self, q, k, v, causal=False):
+        from paddle_tpu.ops.attention import scaled_dot_product_attention
+        return scaled_dot_product_attention(q, k, v, causal=causal)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward(self, rng, causal):
+        from paddle_tpu.kernels.flash_attention import flash_attention
+
+        q = rng.standard_normal((2, 2, 128, 64)).astype(np.float32)
+        k = rng.standard_normal((2, 2, 128, 64)).astype(np.float32)
+        v = rng.standard_normal((2, 2, 128, 64)).astype(np.float32)
+        ref = self._reference(jnp.asarray(q), jnp.asarray(k),
+                              jnp.asarray(v), causal)
+        got = flash_attention(jnp.asarray(q), jnp.asarray(k),
+                              jnp.asarray(v), causal, None, True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_multi_block_seq(self, rng):
+        """Sequence longer than one K block exercises the online softmax."""
+        from paddle_tpu.kernels import flash_attention as fa
+        orig_q, orig_k = fa.BLOCK_Q, fa.BLOCK_K
+        fa.BLOCK_Q, fa.BLOCK_K = 64, 64
+        try:
+            q = rng.standard_normal((1, 1, 256, 32)).astype(np.float32)
+            k = rng.standard_normal((1, 1, 256, 32)).astype(np.float32)
+            v = rng.standard_normal((1, 1, 256, 32)).astype(np.float32)
+            ref = self._reference(jnp.asarray(q), jnp.asarray(k),
+                                  jnp.asarray(v), True)
+            got = fa.flash_attention(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), True, None, True)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       rtol=2e-4, atol=2e-4)
+        finally:
+            fa.BLOCK_Q, fa.BLOCK_K = orig_q, orig_k
+
+    def test_unaligned_seq_k(self, rng):
+        """seq not divisible by the K block — tail masking must hold."""
+        from paddle_tpu.kernels import flash_attention as fa
+        orig_q, orig_k = fa.BLOCK_Q, fa.BLOCK_K
+        fa.BLOCK_Q, fa.BLOCK_K = 64, 64
+        try:
+            q = rng.standard_normal((1, 1, 100, 32)).astype(np.float32)
+            k = rng.standard_normal((1, 1, 100, 32)).astype(np.float32)
+            v = rng.standard_normal((1, 1, 100, 32)).astype(np.float32)
+            ref = self._reference(jnp.asarray(q), jnp.asarray(k),
+                                  jnp.asarray(v))
+            got = fa.flash_attention(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), False, None, True)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       rtol=2e-4, atol=2e-4)
+        finally:
+            fa.BLOCK_Q, fa.BLOCK_K = orig_q, orig_k
+
+    def test_causal_cross_length(self, rng):
+        """tq != tk causal: bottom-right alignment must match reference."""
+        from paddle_tpu.kernels import flash_attention as fa
+        orig_q, orig_k = fa.BLOCK_Q, fa.BLOCK_K
+        fa.BLOCK_Q, fa.BLOCK_K = 32, 32
+        try:
+            q = rng.standard_normal((1, 1, 32, 16)).astype(np.float32)
+            k = rng.standard_normal((1, 1, 96, 16)).astype(np.float32)
+            v = rng.standard_normal((1, 1, 96, 16)).astype(np.float32)
+            ref = self._reference(jnp.asarray(q), jnp.asarray(k),
+                                  jnp.asarray(v), True)
+            got = fa.flash_attention(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), True, None, True)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       rtol=2e-4, atol=2e-4)
+        finally:
+            fa.BLOCK_Q, fa.BLOCK_K = orig_q, orig_k
+
+    def test_backward_matches_reference(self, rng):
+        from paddle_tpu.kernels.flash_attention import flash_attention
+
+        q = rng.standard_normal((1, 2, 64, 32)).astype(np.float32)
+        k = rng.standard_normal((1, 2, 64, 32)).astype(np.float32)
+        v = rng.standard_normal((1, 2, 64, 32)).astype(np.float32)
+
+        def loss_flash(q_, k_, v_):
+            return jnp.sum(flash_attention(q_, k_, v_, False, None, True)
+                           ** 2)
+
+        def loss_ref(q_, k_, v_):
+            return jnp.sum(self._reference(q_, k_, v_) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-3)
+
+
+class TestFusedAdam:
+    def test_matches_unfused(self, rng):
+        from paddle_tpu.kernels.fused_adam import fused_adam_flat
+
+        n = 1024
+        p = rng.standard_normal(n).astype(np.float32)
+        g = rng.standard_normal(n).astype(np.float32)
+        m = rng.standard_normal(n).astype(np.float32) * 0.1
+        v = np.abs(rng.standard_normal(n)).astype(np.float32) * 0.1
+        beta1, beta2, eps, lr = 0.9, 0.999, 1e-8, 1e-3
+        step_t = 5
+        lr_c = lr * np.sqrt(1 - beta2 ** step_t) / (1 - beta1 ** step_t)
+
+        m_ref = beta1 * m + (1 - beta1) * g
+        v_ref = beta2 * v + (1 - beta2) * g * g
+        p_ref = p - lr_c * m_ref / (np.sqrt(v_ref) + eps)
+
+        p_new, m_new, v_new = fused_adam_flat(
+            jnp.asarray(p), jnp.asarray(g), jnp.asarray(m), jnp.asarray(v),
+            lr_c, beta1, beta2, eps, interpret=True)
+        np.testing.assert_allclose(np.asarray(m_new), m_ref, rtol=1e-5,
+                                   atol=1e-7)
+        np.testing.assert_allclose(np.asarray(v_new), v_ref, rtol=1e-5,
+                                   atol=1e-7)
+        np.testing.assert_allclose(np.asarray(p_new), p_ref, rtol=1e-5,
+                                   atol=1e-6)

@@ -1,0 +1,192 @@
+"""Math/reduction/activation op correctness + gradient checks
+(mirrors reference op_test.py-style per-op tests, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from op_test import check_grad, check_output
+
+import paddle_tpu.ops as ops
+
+
+class TestMatmul:
+    def test_output(self, rng):
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        y = rng.standard_normal((4, 5)).astype(np.float32)
+        check_output(ops.matmul, [x, y], x @ y, rtol=1e-4)
+
+    def test_transpose_attrs(self, rng):
+        x = rng.standard_normal((4, 3)).astype(np.float32)
+        y = rng.standard_normal((5, 4)).astype(np.float32)
+        check_output(lambda a, b: ops.matmul(a, b, True, True), [x, y],
+                     x.T @ y.T, rtol=1e-4)
+
+    def test_grad(self, rng):
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        y = rng.standard_normal((4, 5)).astype(np.float32)
+        check_grad(ops.matmul, [x, y], wrt=0)
+        check_grad(ops.matmul, [x, y], wrt=1)
+
+    def test_batched(self, rng):
+        x = rng.standard_normal((2, 3, 4)).astype(np.float32)
+        y = rng.standard_normal((2, 4, 5)).astype(np.float32)
+        check_output(ops.bmm, [x, y], np.matmul(x, y), rtol=1e-4)
+
+
+class TestMul:
+    def test_flattening(self, rng):
+        x = rng.standard_normal((2, 3, 4)).astype(np.float32)
+        y = rng.standard_normal((12, 5)).astype(np.float32)
+        expected = (x.reshape(2, 12) @ y)
+        check_output(lambda a, b: ops.mul(a, b, 1, 1), [x, y], expected,
+                     rtol=1e-4)
+
+
+class TestElementwise:
+    @pytest.mark.parametrize("op,np_op", [
+        (ops.add, np.add), (ops.subtract, np.subtract),
+        (ops.multiply, np.multiply), (ops.divide, np.divide),
+        (ops.maximum, np.maximum), (ops.minimum, np.minimum),
+    ])
+    def test_binary(self, rng, op, np_op):
+        x = rng.standard_normal((3, 4)).astype(np.float32) + 2.0
+        y = rng.standard_normal((3, 4)).astype(np.float32) + 2.0
+        check_output(op, [x, y], np_op(x, y), rtol=1e-5)
+
+    def test_broadcast_axis(self, rng):
+        # reference elementwise axis semantics: y aligned at axis
+        x = rng.standard_normal((2, 3, 4)).astype(np.float32)
+        y = rng.standard_normal((3,)).astype(np.float32)
+        expected = x + y.reshape(1, 3, 1)
+        check_output(lambda a, b: ops.add(a, b, axis=1), [x, y], expected)
+
+    def test_grads(self, rng):
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        y = rng.standard_normal((3, 4)).astype(np.float32) + 3.0
+        check_grad(ops.multiply, [x, y], wrt=0)
+        check_grad(ops.divide, [x, y], wrt=1)
+
+
+class TestUnary:
+    @pytest.mark.parametrize("op,np_op,domain", [
+        (ops.exp, np.exp, (-1, 1)),
+        (ops.log, np.log, (0.5, 2)),
+        (ops.sqrt, np.sqrt, (0.5, 4)),
+        (ops.abs, np.abs, (-2, 2)),
+        (ops.sin, np.sin, (-2, 2)),
+        (ops.cos, np.cos, (-2, 2)),
+        (ops.tanh, np.tanh, (-2, 2)),
+        (ops.floor, np.floor, (-2, 2)),
+        (ops.ceil, np.ceil, (-2, 2)),
+        (ops.reciprocal, np.reciprocal, (0.5, 2)),
+        (ops.square, np.square, (-2, 2)),
+        (ops.sign, np.sign, (-2, 2)),
+    ])
+    def test_forward(self, rng, op, np_op, domain):
+        lo, hi = domain
+        x = rng.uniform(lo, hi, (3, 4)).astype(np.float32)
+        # atol dominates near zeros (e.g. log(x) at x≈1) where fp32
+        # transcendental error is absolute, not relative
+        check_output(op, [x], np_op(x), rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("op", [ops.exp, ops.tanh, ops.sqrt])
+    def test_grad(self, rng, op):
+        x = rng.uniform(0.5, 2.0, (3, 3)).astype(np.float32)
+        check_grad(op, [x])
+
+
+class TestReduce:
+    def test_sum_axis(self, rng):
+        x = rng.standard_normal((2, 3, 4)).astype(np.float32)
+        check_output(lambda a: ops.sum(a, axis=[1, 2]), [x],
+                     x.sum(axis=(1, 2)), rtol=1e-5)
+        check_output(lambda a: ops.mean(a, axis=0, keepdim=True), [x],
+                     x.mean(axis=0, keepdims=True), rtol=1e-5)
+        check_output(lambda a: ops.max(a, axis=1), [x], x.max(axis=1))
+        check_output(lambda a: ops.prod(a), [x], x.prod(), rtol=1e-4)
+
+    def test_norms(self, rng):
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        check_output(ops.frobenius_norm, [x],
+                     np.linalg.norm(x), rtol=1e-5)
+        check_output(lambda a: ops.p_norm(a, p=2.0, axis=1), [x],
+                     np.linalg.norm(x, axis=1), rtol=1e-5)
+        check_output(ops.squared_l2_norm, [x], (x ** 2).sum(), rtol=1e-5)
+
+    def test_logsumexp(self, rng):
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        from scipy.special import logsumexp as sp_lse
+        check_output(lambda a: ops.logsumexp(a, axis=1), [x],
+                     sp_lse(x, axis=1), rtol=1e-4)
+
+    def test_grad(self, rng):
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        check_grad(lambda a: ops.mean(a, axis=1), [x])
+
+
+class TestActivations:
+    @pytest.mark.parametrize("name", [
+        "relu", "relu6", "sigmoid", "gelu", "elu", "selu", "softplus",
+        "softsign", "swish", "mish", "leaky_relu", "hard_sigmoid",
+        "hard_swish", "tanh_shrink", "logsigmoid", "thresholded_relu",
+        "hard_shrink", "soft_shrink", "stanh",
+    ])
+    def test_finite_and_grad(self, rng, name):
+        import paddle_tpu.ops.activation as A
+        import paddle_tpu.ops.math as M
+        fn = getattr(A, name, None) or getattr(M, name)
+        x = rng.uniform(-3, 3, (4, 5)).astype(np.float32)
+        out = np.asarray(fn(x))
+        assert np.isfinite(out).all()
+        check_grad(fn, [x + 0.05], rtol=8e-2, atol=5e-3)
+
+    def test_softmax(self, rng):
+        x = rng.standard_normal((3, 5)).astype(np.float32)
+        from scipy.special import softmax as sp_softmax
+        import paddle_tpu.ops.activation as A
+        check_output(lambda a: A.softmax(a, axis=-1), [x],
+                     sp_softmax(x, axis=-1), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(A.softmax(x)).sum(axis=-1), 1.0, rtol=1e-5)
+
+
+class TestCumAndLinalg:
+    def test_cumsum(self, rng):
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        check_output(lambda a: ops.cumsum(a, axis=1), [x],
+                     np.cumsum(x, axis=1), rtol=1e-5)
+        # exclusive + reverse
+        expected = np.flip(np.cumsum(np.flip(x, 1), 1) - np.flip(x, 1), 1)
+        check_output(lambda a: ops.cumsum(a, axis=1, reverse=True,
+                                          exclusive=True), [x], expected,
+                     rtol=1e-5)
+
+    def test_tril_triu_trace(self, rng):
+        x = rng.standard_normal((4, 4)).astype(np.float32)
+        check_output(ops.tril, [x], np.tril(x))
+        check_output(ops.triu, [x], np.triu(x))
+        check_output(ops.trace, [x], np.trace(x), rtol=1e-5)
+
+    def test_cholesky_inverse(self, rng):
+        a = rng.standard_normal((3, 3)).astype(np.float32)
+        spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+        check_output(ops.cholesky, [spd], np.linalg.cholesky(spd),
+                     rtol=1e-4, atol=1e-4)
+        check_output(ops.inverse, [spd], np.linalg.inv(spd), rtol=1e-3,
+                     atol=1e-4)
+
+    def test_clip_scale(self, rng):
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        check_output(lambda a: ops.clip(a, -0.5, 0.5), [x],
+                     np.clip(x, -0.5, 0.5))
+        check_output(lambda a: ops.scale(a, 2.0, 1.0), [x], x * 2 + 1)
+        check_output(lambda a: ops.scale(a, 2.0, 1.0,
+                                         bias_after_scale=False), [x],
+                     (x + 1) * 2)
+
+    def test_multiplex(self, rng):
+        a = rng.standard_normal((4, 3)).astype(np.float32)
+        b = rng.standard_normal((4, 3)).astype(np.float32)
+        idx = np.array([0, 1, 1, 0], np.int32)
+        expected = np.where(idx[:, None] == 0, a, b)
+        check_output(lambda i: ops.multiplex([a, b], i), [idx], expected)

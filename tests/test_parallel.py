@@ -1,0 +1,152 @@
+"""Multi-device sharding/collective tests on the virtual 8-CPU mesh
+(SURVEY.md §4 TPU plan tier 2: sharded-vs-single-chip loss comparison —
+analogue of the reference's parallel_executor_test_base.py which compares
+Executor vs ParallelExecutor losses)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu.ops import loss as L
+from paddle_tpu.parallel import (ShardedTrainStep, all_gather, all_reduce,
+                                 create_mesh, data_parallel_mesh)
+from paddle_tpu.static import TrainStep
+
+
+def test_eight_virtual_devices():
+    assert jax.device_count() == 8
+
+
+def test_mesh_creation():
+    mesh = create_mesh({"dp": 4, "mp": 2})
+    assert mesh.shape == {"dp": 4, "mp": 2}
+    mesh2 = create_mesh({"dp": -1, "mp": 2})
+    assert mesh2.shape["dp"] == 4
+
+
+def test_collectives_inside_shard_map():
+    from jax.experimental.shard_map import shard_map
+    mesh = data_parallel_mesh()
+    from paddle_tpu.parallel.collective import new_group
+    new_group("dp", ring_id=0)
+
+    def fn(x):
+        s = all_reduce(x, "sum", group="dp")
+        g = all_gather(x, axis=0, group="dp")
+        return s, g
+
+    x = jnp.arange(8.0).reshape(8, 1)
+    s, g = shard_map(fn, mesh=mesh, in_specs=P("dp"),
+                     out_specs=(P("dp"), P("dp")))(x)
+    # every shard's sum equals total
+    np.testing.assert_allclose(np.asarray(s).reshape(-1), [28.0] * 8)
+    assert g.shape == (64, 1)
+
+
+def test_dp_matches_single_device():
+    """Sharded-vs-single loss parity (the reference's PE-vs-Executor test)."""
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 16)).astype(np.float32)
+    w = rng.standard_normal((16, 1)).astype(np.float32)
+    y = (x @ w).astype(np.float32)
+
+    def build(step_cls, **kw):
+        pt.seed(123)
+        model = pt.nn.Sequential(pt.nn.Linear(16, 32), pt.nn.Tanh(),
+                                 pt.nn.Linear(32, 1))
+        opt = pt.optimizer.Momentum(learning_rate=0.05, momentum=0.9)
+        return step_cls(model, opt, lambda out, yy: L.mse_loss(out, yy),
+                        **kw)
+
+    single = build(TrainStep)
+    sharded = build(ShardedTrainStep, mesh=data_parallel_mesh())
+
+    losses_single, losses_sharded = [], []
+    for i in range(5):
+        losses_single.append(float(single(x, labels=(y,))["loss"]))
+        losses_sharded.append(float(sharded(x, labels=(y,))["loss"]))
+    np.testing.assert_allclose(losses_single, losses_sharded, rtol=2e-4,
+                               atol=1e-5)
+
+
+def test_tensor_parallel_step_runs():
+    from paddle_tpu.parallel import megatron_param_rule
+    mesh = create_mesh({"dp": 4, "mp": 2})
+    pt.seed(0)
+
+    class TinyMLP(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = pt.nn.Linear(16, 64)
+            self.act = pt.nn.GELU()
+            self.fc2 = pt.nn.Linear(64, 4)
+
+        def forward(self, x):
+            return self.fc2(self.act(self.fc1(x)))
+
+    model = TinyMLP()
+    opt = pt.optimizer.Adam(1e-3)
+    step = ShardedTrainStep(
+        model, opt, lambda out, y: L.cross_entropy(out, y), mesh,
+        param_rule=lambda name, v:
+            P(None, "mp") if name == "fc1.weight"
+            else (P("mp", None) if name == "fc2.weight" else P()))
+    x = np.random.default_rng(0).standard_normal((32, 16)).astype(np.float32)
+    y = np.random.default_rng(1).integers(0, 4, 32).astype(np.int64)
+    m1 = step(x, labels=(y,))
+    m2 = step(x, labels=(y,))
+    assert float(m2["loss"]) < float(m1["loss"]) + 1.0
+    # param sharding preserved after update
+    w1 = step.state["params"]["fc1.weight"]
+    assert w1.sharding.spec == P(None, "mp")
+
+
+def test_gradient_merge_strategy():
+    from paddle_tpu.distributed import fleet
+
+    pt.seed(3)
+    model = pt.nn.Linear(8, 1)
+    opt = pt.optimizer.SGD(0.1)
+    strategy = fleet.DistributedStrategy()
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs.k_steps = 4
+
+    step = fleet.fleet.init(strategy=strategy).build_train_step(
+        model, opt, lambda out, y: L.mse_loss(out, y),
+        mesh=data_parallel_mesh())
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 8)).astype(np.float32)
+    y = rng.standard_normal((64, 1)).astype(np.float32)
+    first = float(step(x, labels=(y,))["loss"])
+    for _ in range(20):
+        m = step(x, labels=(y,))
+    assert float(m["loss"]) < first
+
+
+def test_recompute_strategy_matches_plain():
+    from paddle_tpu.distributed import fleet as fleet_mod
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 8)).astype(np.float32)
+    y = rng.standard_normal((16, 1)).astype(np.float32)
+
+    def build(recompute):
+        pt.seed(11)
+        model = pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.Tanh(),
+                                 pt.nn.Linear(16, 1))
+        opt = pt.optimizer.SGD(0.1)
+        strategy = fleet_mod.DistributedStrategy()
+        strategy.recompute = recompute
+        return fleet_mod.apply_strategy(
+            strategy, model, opt, lambda out, yy: L.mse_loss(out, yy),
+            mesh=data_parallel_mesh())
+
+    plain = build(False)
+    remat = build(True)
+    for _ in range(3):
+        lp = float(plain(x, labels=(y,))["loss"])
+        lr = float(remat(x, labels=(y,))["loss"])
+    np.testing.assert_allclose(lp, lr, rtol=1e-5)

@@ -1,0 +1,166 @@
+"""End-to-end training tests — the book-test analogue
+(ref: /root/reference/python/paddle/fluid/tests/book/test_recognize_digits.py
+trains to a loss threshold; same strategy here on synthetic data)."""
+
+import numpy as np
+import pytest
+
+
+def _synthetic_mnist(n=256, seed=0):
+    """Linearly-separable-ish synthetic digits: class mean + noise."""
+    rng = np.random.default_rng(seed)
+    means = rng.standard_normal((10, 1, 28, 28)).astype(np.float32)
+    labels = rng.integers(0, 10, n)
+    x = means[labels] + 0.3 * rng.standard_normal(
+        (n, 1, 28, 28)).astype(np.float32)
+    return x.astype(np.float32), labels.astype(np.int64)
+
+
+def test_lenet_trains_to_low_loss():
+    import paddle_tpu as pt
+    from paddle_tpu.models import LeNet
+    from paddle_tpu.ops import loss as L
+    from paddle_tpu.static import TrainStep
+
+    pt.seed(42)
+    model = LeNet()
+    opt = pt.optimizer.Adam(learning_rate=1e-3)
+    step = TrainStep(model, opt, lambda out, y: L.cross_entropy(out, y))
+
+    x, y = _synthetic_mnist(256)
+    losses = []
+    for epoch in range(6):
+        for i in range(0, 256, 64):
+            m = step(x[i:i + 64], labels=(y[i:i + 64],))
+            losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.5, losses[-5:]
+    assert losses[-1] < 0.8, f"final loss too high: {losses[-1]}"
+
+
+def test_lenet_accuracy_metric_and_eval():
+    import paddle_tpu as pt
+    from paddle_tpu.models import LeNet
+    from paddle_tpu.ops import loss as L
+    from paddle_tpu.ops.metrics_ops import accuracy
+    from paddle_tpu.static import EvalStep, TrainStep
+
+    pt.seed(7)
+    model = LeNet()
+    opt = pt.optimizer.Momentum(learning_rate=0.01, momentum=0.9)
+    step = TrainStep(model, opt, lambda out, y: L.cross_entropy(out, y),
+                     extra_metrics={"acc": lambda out, y:
+                                    accuracy(out, y)})
+    x, y = _synthetic_mnist(256, seed=3)
+    for epoch in range(8):
+        for i in range(0, 256, 64):
+            m = step(x[i:i + 64], labels=(y[i:i + 64],))
+    assert float(m["acc"]) > 0.7, float(m["acc"])
+
+    ev = EvalStep(model, {"acc": lambda out, y: accuracy(out, y)})
+    out, metrics = ev(step.state["params"], step.state["buffers"],
+                      x[:64], labels=(y[:64],))
+    assert out.shape == (64, 10)
+    assert float(metrics["acc"]) > 0.7
+
+
+def test_mlp_sgd_with_scheduler_and_clip():
+    import paddle_tpu as pt
+    from paddle_tpu.clip import ClipGradByGlobalNorm
+    from paddle_tpu.ops import loss as L
+    from paddle_tpu.static import TrainStep
+
+    pt.seed(0)
+    model = pt.nn.Sequential(pt.nn.Linear(8, 32), pt.nn.Tanh(),
+                             pt.nn.Linear(32, 1))
+    sched = pt.optimizer.lr.ExponentialDecay(0.1, gamma=0.98)
+    opt = pt.optimizer.SGD(learning_rate=sched,
+                           grad_clip=ClipGradByGlobalNorm(1.0))
+    step = TrainStep(model, opt, lambda out, y: L.mse_loss(out, y))
+
+    rng = np.random.default_rng(1)
+    w_true = rng.standard_normal((8, 1)).astype(np.float32)
+    x = rng.standard_normal((512, 8)).astype(np.float32)
+    y = x @ w_true + 0.01 * rng.standard_normal((512, 1)).astype(np.float32)
+    first = None
+    for epoch in range(30):
+        m = step(x, labels=(y,))
+        if first is None:
+            first = float(m["loss"])
+    assert float(m["loss"]) < first * 0.1
+
+
+def test_batchnorm_buffers_update():
+    import paddle_tpu as pt
+    from paddle_tpu.ops import loss as L
+    from paddle_tpu.static import TrainStep
+
+    pt.seed(0)
+    model = pt.nn.Sequential(pt.nn.Linear(4, 8), pt.nn.BatchNorm1D(8),
+                             pt.nn.ReLU(), pt.nn.Linear(8, 2))
+    opt = pt.optimizer.SGD(learning_rate=0.05)
+    step = TrainStep(model, opt, lambda out, y: L.cross_entropy(out, y))
+    x = np.random.default_rng(0).standard_normal((32, 4)).astype(np.float32)
+    # make features non-centered so the running mean must move
+    x = x + 5.0
+    y = (x[:, 0] > 5.0).astype(np.int64)
+    mean_before = np.asarray(step.state["buffers"]["1._mean"]).copy()
+    for _ in range(5):
+        step(x, labels=(y,))
+    mean_after = np.asarray(step.state["buffers"]["1._mean"])
+    assert not np.allclose(mean_before, mean_after)
+    assert np.abs(mean_after).max() > 0.1
+
+
+def test_dropout_rng_varies_per_step():
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.ops import loss as L
+    from paddle_tpu.static import TrainStep
+
+    pt.seed(0)
+    model = pt.nn.Sequential(pt.nn.Linear(16, 16), pt.nn.Dropout(0.5),
+                             pt.nn.Linear(16, 2))
+    opt = pt.optimizer.SGD(learning_rate=0.0)  # lr=0: params frozen
+    step = TrainStep(model, opt, lambda out, y: L.cross_entropy(out, y))
+    x = np.ones((4, 16), np.float32)
+    y = np.zeros((4,), np.int64)
+    l1 = float(step(x, labels=(y,))["loss"])
+    l2 = float(step(x, labels=(y,))["loss"])
+    # with lr=0 the only difference between steps is the dropout mask
+    assert l1 != l2
+
+
+def test_optimizer_variants_converge():
+    import paddle_tpu as pt
+    from paddle_tpu.ops import loss as L
+    from paddle_tpu.static import TrainStep
+
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((128, 6)).astype(np.float32)
+    w_true = rng.standard_normal((6, 1)).astype(np.float32)
+    y = x @ w_true
+
+    # (threshold, ctor): Adadelta warms up slowly by construction
+    # (avg_sq_update starts at 0) so it gets a looser bar.
+    for threshold, make_opt in [
+        (0.6, lambda: pt.optimizer.Adam(1e-2)),
+        (0.6, lambda: pt.optimizer.AdamW(1e-2, weight_decay=0.01)),
+        (0.6, lambda: pt.optimizer.RMSProp(1e-2)),
+        (0.6, lambda: pt.optimizer.Adagrad(5e-2)),
+        (0.6, lambda: pt.optimizer.Adamax(1e-2)),
+        (0.85, lambda: pt.optimizer.Adadelta(1.0)),
+        (0.6, lambda: pt.optimizer.Lamb(0.1)),
+        (0.6, lambda: pt.optimizer.Momentum(1e-2, use_nesterov=True)),
+        (0.6, lambda: pt.optimizer.LarsMomentum(1.0, lars_coeff=0.1)),
+    ]:
+        pt.seed(5)
+        model = pt.nn.Linear(6, 1)
+        step = TrainStep(model, make_opt(),
+                         lambda out, yy: L.mse_loss(out, yy))
+        first = None
+        for _ in range(60):
+            m = step(x, labels=(y,))
+            if first is None:
+                first = float(m["loss"])
+        assert float(m["loss"]) < first * threshold, \
+            f"{make_opt().__class__.__name__}: {first} → {float(m['loss'])}"
