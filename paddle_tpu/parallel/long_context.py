@@ -1,0 +1,138 @@
+"""Long-context attention: ring attention + Ulysses sequence parallelism.
+
+The reference (2020-era) has no sequence/context parallelism (SURVEY.md §5
+"Long-context: Absent") — its long-sequence story was recompute+pipeline.
+This module provides the modern TPU-native capability the survey schedules
+as the idiomatic equivalent:
+
+- **Ring attention** (context parallelism): Q stays put, K/V shards rotate
+  around the 'sp' mesh axis via lax.ppermute over ICI while an
+  online-softmax accumulator folds in each block — peak memory O(T/N),
+  comms overlap with the per-block matmuls (XLA pipelines the ppermute
+  with the dot). Composes the same math as kernels/flash_attention.py,
+  distributed across chips.
+- **Ulysses** (sequence → head re-sharding): all-to-all flips the sharding
+  from the sequence axis to the head axis, runs ordinary (flash) attention
+  locally with full sequence per head group, and flips back. Cheaper than
+  ring for models with many heads; needs head_count % sp == 0.
+
+Both run inside shard_map; wrappers build the shard_map for [B, H, T, D]
+inputs sharded on T.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    # check_vma=False: carries mix replicated inits with ppermute-varying
+    # values, which strict VMA checking rejects
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+_NEG_INF = -1e30
+
+
+def _ring_attention_local(q, k, v, axis: str, causal: bool,
+                          scale: Optional[float]):
+    """Runs inside shard_map. q/k/v: [B, H, Tl, D] local shards."""
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    t_local = q.shape[2]
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    qf = q.astype(jnp.float32) * scale
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q_pos = (idx * t_local
+             + lax.broadcasted_iota(jnp.int32, (t_local, t_local), 0))
+
+    def block(carry, step):
+        acc, m_prev, l_prev, k_cur, v_cur = carry
+        # K/V arriving at `step` originated on rank (idx - step) mod n
+        src = (idx - step) % n
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_cur.astype(jnp.float32))
+        if causal:
+            k_pos = (src * t_local
+                     + lax.broadcasted_iota(jnp.int32,
+                                            (t_local, t_local), 1))
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
+        # rotate K/V one hop around the ring (overlaps with next block)
+        k_next = lax.ppermute(k_cur, axis, perm)
+        v_next = lax.ppermute(v_cur, axis, perm)
+        return (acc, m_new, l_new, k_next, v_next), None
+
+    b, h = q.shape[:2]
+    acc0 = jnp.zeros((b, h, t_local, d), jnp.float32)
+    m0 = jnp.full((b, h, t_local, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t_local, 1), jnp.float32)
+    (acc, _, l_fin, _, _), _ = lax.scan(
+        block, (acc0, m0, l0, k, v), jnp.arange(n))
+    return (acc / jnp.maximum(l_fin, 1e-30)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
+                   causal: bool = False, scale: Optional[float] = None):
+    """Context-parallel attention over full [B, H, T, D] inputs; T is
+    sharded over ``axis``, output keeps the same sharding."""
+    spec = P(None, None, axis, None)
+
+    def fn(q_, k_, v_):
+        return _ring_attention_local(q_, k_, v_, axis, causal, scale)
+
+    return _shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)(q, k, v)
+
+
+def _ulysses_local(q, k, v, axis: str, causal: bool,
+                   scale: Optional[float]):
+    """Inside shard_map: seq-sharded [B, H, Tl, D] → a2a to head-sharded
+    [B, H/n, T, D] → local flash attention → a2a back."""
+    n = lax.axis_size(axis)
+
+    def seq_to_head(x):
+        # split heads across ranks, gather full sequence
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def head_to_seq(x):
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh = seq_to_head(q)
+    kh = seq_to_head(k)
+    vh = seq_to_head(v)
+    from ..kernels import maybe_flash_attention
+    out = maybe_flash_attention(qh, kh, vh, causal=causal, scale=scale)
+    return head_to_seq(out)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
+                      causal: bool = False,
+                      scale: Optional[float] = None):
+    """Ulysses sequence parallelism; needs num_heads % mesh[axis] == 0."""
+    n = mesh.shape[axis]
+    if q.shape[1] % n != 0:
+        raise ValueError(
+            f"num_heads={q.shape[1]} not divisible by sp={n}; "
+            "use ring_attention")
+    spec = P(None, None, axis, None)
+
+    def fn(q_, k_, v_):
+        return _ulysses_local(q_, k_, v_, axis, causal, scale)
+
+    return _shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)(q, k, v)

@@ -37,11 +37,17 @@ def create_mesh(axes: Optional[Dict[str, int]] = None,
         else:
             known *= size
     if wild is not None:
+        if known <= 0 or n % known != 0:
+            raise ValueError(
+                f"mesh axes {axes} with wildcard: {n} devices not "
+                f"divisible by {known}")
         axes[wild] = n // known
     total = int(np.prod(list(axes.values())))
-    if total != n:
+    # explicit sizes smaller than the device count build a submesh on the
+    # first `total` devices; wildcard meshes always cover all devices
+    if total > n or total <= 0:
         raise ValueError(f"mesh axes {axes} need {total} devices, have {n}")
-    arr = np.array(devices).reshape(tuple(axes.values()))
+    arr = np.array(devices[:total]).reshape(tuple(axes.values()))
     return Mesh(arr, tuple(axes))
 
 

@@ -23,7 +23,6 @@ from typing import Any, Callable, Optional, Sequence
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..nn.layer import Layer, functional_call
@@ -90,7 +89,7 @@ def gpipe(stage_fn: Callable, stacked_params, x, num_microbatches: int,
         return lax.psum(outputs, axis)
 
     param_specs = jax.tree.map(lambda _: P(axis), stacked_params)
-    out = shard_map(
+    out = jax.shard_map(
         spmd_fn, mesh=mesh,
         in_specs=(param_specs, P()),
         out_specs=P(),
