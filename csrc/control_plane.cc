@@ -1,0 +1,418 @@
+// TCP control plane: key-value rendezvous, atomic counters, barriers.
+//
+// TPU-native replacement for the reference's coordination stack — the role
+// played there by gRPC id exchange (c_gen_nccl_id_op.cc:49: rank0 serves the
+// ncclUniqueId, peers fetch it), GlooWrapper barriers
+// (framework/fleet/gloo_wrapper.h:146) and the PS RPC bootstrap
+// (operators/distributed/grpc/grpc_server.h:46). One small server (usually on
+// the coordinator host) + persistent client connections; the data path stays
+// entirely on ICI/DCN via XLA collectives, so this only carries tiny control
+// messages (mesh topology, elastic state, data-pipeline epochs, barriers).
+//
+// Wire protocol (client -> server), little-endian:
+//   u8 op | u32 klen | key bytes | op-specific payload
+//   SET(1):     u64 vlen | value
+//   GET(2):     u8 block | u32 timeout_ms
+//   ADD(3):     i64 delta
+//   BARRIER(4): i32 world | u32 timeout_ms
+// Response: i64 status/len [| payload]
+
+#include "ptnative.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t { kSet = 1, kGet = 2, kAdd = 3, kBarrier = 4 };
+
+bool ReadFull(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool WriteFull(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct BarrierState {
+  int arrived = 0;
+  int64_t generation = 0;
+};
+
+class Server {
+ public:
+  explicit Server(int port) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+            0 ||
+        ::listen(listen_fd_, 128) < 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return;
+    }
+    socklen_t alen = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port_ = ntohs(addr.sin_port);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+  }
+
+  ~Server() { Stop(); }
+
+  bool ok() const { return listen_fd_ >= 0; }
+  int port() const { return port_; }
+
+  void Stop() {
+    bool expected = false;
+    if (!stopped_.compare_exchange_strong(expected, true)) return;
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    if (accept_thread_.joinable()) accept_thread_.join();
+    std::vector<std::thread> workers;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      workers.swap(workers_);
+      for (int fd : client_fds_) ::shutdown(fd, SHUT_RDWR);
+      cv_.notify_all();  // wake workers parked in blocking GET / barrier
+    }
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+  }
+
+ private:
+  void AcceptLoop() {
+    while (!stopped_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(mu_);
+      client_fds_.push_back(fd);
+      workers_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  void Serve(int fd) {
+    while (!stopped_.load()) {
+      uint8_t op;
+      uint32_t klen;
+      if (!ReadFull(fd, &op, 1) || !ReadFull(fd, &klen, 4)) break;
+      if (klen > (1u << 20)) break;
+      std::string key(klen, '\0');
+      if (!ReadFull(fd, key.data(), klen)) break;
+      if (!Dispatch(fd, static_cast<Op>(op), key)) break;
+    }
+    ::close(fd);
+    std::lock_guard<std::mutex> lk(mu_);
+    client_fds_.erase(std::remove(client_fds_.begin(), client_fds_.end(), fd),
+                      client_fds_.end());
+  }
+
+  bool Dispatch(int fd, Op op, const std::string& key) {
+    switch (op) {
+      case kSet: {
+        uint64_t vlen;
+        if (!ReadFull(fd, &vlen, 8) || vlen > (1ull << 32)) return false;
+        std::string val(vlen, '\0');
+        if (!ReadFull(fd, val.data(), vlen)) return false;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          kv_[key] = std::move(val);
+        }
+        cv_.notify_all();
+        int64_t st = 0;
+        return WriteFull(fd, &st, 8);
+      }
+      case kGet: {
+        uint8_t block;
+        uint32_t timeout_ms;
+        if (!ReadFull(fd, &block, 1) || !ReadFull(fd, &timeout_ms, 4))
+          return false;
+        std::string val;
+        bool found = false;
+        {
+          std::unique_lock<std::mutex> lk(mu_);
+          auto pred = [&] { return kv_.count(key) > 0 || stopped_.load(); };
+          if (block) {
+            cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred);
+          }
+          auto it = kv_.find(key);
+          if (it != kv_.end()) {
+            val = it->second;
+            found = true;
+          }
+        }
+        // -1 = missing (nonblocking), -2 = blocking wait timed out
+        int64_t len = found ? static_cast<int64_t>(val.size())
+                            : (block ? -2 : -1);
+        if (!WriteFull(fd, &len, 8)) return false;
+        return !found || WriteFull(fd, val.data(), val.size());
+      }
+      case kAdd: {
+        int64_t delta;
+        if (!ReadFull(fd, &delta, 8)) return false;
+        int64_t nv;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          nv = (counters_[key] += delta);
+        }
+        cv_.notify_all();
+        return WriteFull(fd, &nv, 8);
+      }
+      case kBarrier: {
+        int32_t world;
+        uint32_t timeout_ms;
+        if (!ReadFull(fd, &world, 4) || !ReadFull(fd, &timeout_ms, 4))
+          return false;
+        int64_t st = DoBarrier(key, world, timeout_ms) ? 0 : -1;
+        return WriteFull(fd, &st, 8);
+      }
+    }
+    return false;
+  }
+
+  bool DoBarrier(const std::string& name, int world, uint32_t timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    BarrierState& b = barriers_[name];
+    int64_t my_gen = b.generation;
+    if (++b.arrived == world) {
+      b.arrived = 0;
+      b.generation++;
+      cv_.notify_all();
+      return true;
+    }
+    bool ok = cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
+      return barriers_[name].generation != my_gen || stopped_.load();
+    });
+    if (!ok) --b.arrived;  // timed out: withdraw
+    return ok && !stopped_.load();
+  }
+
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::atomic<bool> stopped_{false};
+  std::thread accept_thread_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> kv_;
+  std::map<std::string, int64_t> counters_;
+  std::map<std::string, BarrierState> barriers_;
+  std::vector<std::thread> workers_;
+  std::vector<int> client_fds_;
+};
+
+class Client {
+ public:
+  void Shutdown() {
+    if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);  // wakes blocked reads
+  }
+
+  Client(const char* host, int port, int timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    while (std::chrono::steady_clock::now() < deadline) {
+      fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(static_cast<uint16_t>(port));
+      ::inet_pton(AF_INET, host, &addr.sin_addr);
+      if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) ==
+          0) {
+        int one = 1;
+        ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return;
+      }
+      ::close(fd_);
+      fd_ = -1;
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+  }
+
+  ~Client() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  bool ok() const { return fd_ >= 0; }
+
+  // Callers hold mu() across each request/response pair so concurrent
+  // threads can share one connection.
+  std::mutex& mu() { return mu_; }
+  int fd() const { return fd_; }
+
+ private:
+  int fd_ = -1;
+  std::mutex mu_;
+};
+
+std::mutex g_registry_mu;
+std::map<int64_t, std::unique_ptr<Server>> g_servers;
+// shared_ptr: a concurrent call may still hold the client while another
+// thread closes the handle; the object must outlive in-flight requests.
+std::map<int64_t, std::shared_ptr<Client>> g_clients;
+int64_t g_next_handle = 1;
+
+bool SendRequest(Client* c, Op op, const char* key,
+                 const std::string& payload) {
+  uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+  std::string msg;
+  msg.reserve(5 + klen + payload.size());
+  msg.push_back(static_cast<char>(op));
+  msg.append(reinterpret_cast<char*>(&klen), 4);
+  msg.append(key, klen);
+  msg.append(payload);
+  return WriteFull(c->fd(), msg.data(), msg.size());
+}
+
+std::shared_ptr<Client> GetClient(int64_t h) {
+  std::lock_guard<std::mutex> lk(g_registry_mu);
+  auto it = g_clients.find(h);
+  return it == g_clients.end() ? nullptr : it->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t pt_cp_server_start(int port) {
+  auto s = std::make_unique<Server>(port);
+  if (!s->ok()) return -1;
+  std::lock_guard<std::mutex> lk(g_registry_mu);
+  int64_t h = g_next_handle++;
+  g_servers[h] = std::move(s);
+  return h;
+}
+
+int pt_cp_server_port(int64_t handle) {
+  std::lock_guard<std::mutex> lk(g_registry_mu);
+  auto it = g_servers.find(handle);
+  return it == g_servers.end() ? -1 : it->second->port();
+}
+
+void pt_cp_server_stop(int64_t handle) {
+  std::unique_ptr<Server> s;
+  {
+    std::lock_guard<std::mutex> lk(g_registry_mu);
+    auto it = g_servers.find(handle);
+    if (it == g_servers.end()) return;
+    s = std::move(it->second);
+    g_servers.erase(it);
+  }
+  s->Stop();
+}
+
+int64_t pt_cp_client_connect(const char* host, int port, int timeout_ms) {
+  auto c = std::make_shared<Client>(host, port, timeout_ms);
+  if (!c->ok()) return -1;
+  std::lock_guard<std::mutex> lk(g_registry_mu);
+  int64_t h = g_next_handle++;
+  g_clients[h] = std::move(c);
+  return h;
+}
+
+void pt_cp_client_close(int64_t handle) {
+  std::shared_ptr<Client> c;
+  {
+    std::lock_guard<std::mutex> lk(g_registry_mu);
+    auto it = g_clients.find(handle);
+    if (it == g_clients.end()) return;
+    c = std::move(it->second);
+    g_clients.erase(it);
+  }
+  c->Shutdown();  // wake any thread blocked in a request on this connection
+}
+
+int pt_cp_set(int64_t h, const char* key, const uint8_t* val, int64_t len) {
+  auto c = GetClient(h);
+  if (!c) return -1;
+  std::lock_guard<std::mutex> lk(c->mu());
+  uint64_t vlen = static_cast<uint64_t>(len);
+  std::string payload(reinterpret_cast<char*>(&vlen), 8);
+  payload.append(reinterpret_cast<const char*>(val), len);
+  if (!SendRequest(c.get(), kSet, key, payload)) return -1;
+  int64_t st;
+  return ReadFull(c->fd(), &st, 8) ? static_cast<int>(st) : -1;
+}
+
+// Returns >=0 length; -1 missing; -2 blocking wait timed out; -3 buffer
+// too small (value preserved server-side, retry with larger cap); -4
+// transport/handle error.
+int64_t pt_cp_get(int64_t h, const char* key, uint8_t* buf, int64_t cap,
+                  int block, int timeout_ms) {
+  auto c = GetClient(h);
+  if (!c) return -4;
+  std::lock_guard<std::mutex> lk(c->mu());
+  std::string payload;
+  uint8_t b = block ? 1 : 0;
+  uint32_t t = static_cast<uint32_t>(timeout_ms);
+  payload.push_back(static_cast<char>(b));
+  payload.append(reinterpret_cast<char*>(&t), 4);
+  if (!SendRequest(c.get(), kGet, key, payload)) return -4;
+  int64_t len;
+  if (!ReadFull(c->fd(), &len, 8)) return -4;
+  if (len < 0) return len;  // -1 missing / -2 timeout (server codes)
+  std::string val(len, '\0');
+  if (!ReadFull(c->fd(), val.data(), len)) return -4;
+  if (len > cap) return -3;
+  std::memcpy(buf, val.data(), len);
+  return len;
+}
+
+int64_t pt_cp_add(int64_t h, const char* key, int64_t delta) {
+  auto c = GetClient(h);
+  if (!c) return INT64_MIN;
+  std::lock_guard<std::mutex> lk(c->mu());
+  std::string payload(reinterpret_cast<char*>(&delta), 8);
+  if (!SendRequest(c.get(), kAdd, key, payload)) return INT64_MIN;
+  int64_t nv;
+  return ReadFull(c->fd(), &nv, 8) ? nv : INT64_MIN;
+}
+
+int pt_cp_barrier(int64_t h, const char* name, int world, int timeout_ms) {
+  auto c = GetClient(h);
+  if (!c) return -1;
+  std::lock_guard<std::mutex> lk(c->mu());
+  int32_t w = world;
+  uint32_t t = static_cast<uint32_t>(timeout_ms);
+  std::string payload(reinterpret_cast<char*>(&w), 4);
+  payload.append(reinterpret_cast<char*>(&t), 4);
+  if (!SendRequest(c.get(), kBarrier, name, payload)) return -1;
+  int64_t st;
+  return ReadFull(c->fd(), &st, 8) ? static_cast<int>(st) : -1;
+}
+
+}  // extern "C"

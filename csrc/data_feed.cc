@@ -1,0 +1,504 @@
+// Threaded slot-based data feed: the native industrial data pipeline.
+//
+// TPU-native equivalent of the reference's MultiSlotDataFeed /
+// InMemoryDataFeed (paddle/fluid/framework/data_feed.h:255,650: N reader
+// threads parse slot-formatted text into channels) and DatasetImpl's
+// LoadIntoMemory / LocalShuffle (paddle/fluid/framework/data_set.h:43,157).
+// Global shuffle is composed in Python: serialize_range -> control-plane /
+// peer exchange -> deserialize_append (the reference routes this through
+// FleetWrapper RPC, data_set.h:111).
+//
+// Record text format (one sample per line, slots in declaration order):
+//   <count> v1 ... vcount  <count> v1 ... vcount  ...
+// dense slot: count == dim, float values; sparse slot: count int64 ids.
+
+#include "ptnative.h"
+
+#include <algorithm>
+#include <atomic>
+#include <cassert>
+#include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct SlotDesc {
+  std::string name;
+  bool dense;
+  int dim;  // dense: row width; sparse: max_len (pad/truncate)
+};
+
+// One parsed sample: per-slot payload.
+struct Record {
+  std::vector<std::vector<float>> dense;    // [n_dense][dim]
+  std::vector<std::vector<int64_t>> sparse;  // [n_sparse][len]
+};
+
+struct Batch {
+  std::vector<Record> rows;
+};
+
+// Bounded MPMC channel (reference: framework/channel.h usage by data_set).
+class BatchChannel {
+ public:
+  explicit BatchChannel(size_t cap) : cap_(cap) {}
+
+  void Push(Batch&& b) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_push_.wait(lk, [&] { return q_.size() < cap_ || closed_; });
+    if (closed_) return;
+    q_.push_back(std::move(b));
+    cv_pop_.notify_one();
+  }
+
+  bool Pop(Batch* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_pop_.wait(lk, [&] { return !q_.empty() || (closed_ && producers_ == 0); });
+    if (q_.empty()) return false;
+    *out = std::move(q_.front());
+    q_.pop_front();
+    cv_push_.notify_one();
+    return true;
+  }
+
+  void AddProducer() {
+    std::lock_guard<std::mutex> lk(mu_);
+    ++producers_;
+  }
+
+  void RemoveProducer() {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (--producers_ == 0) {
+      closed_ = true;
+      cv_pop_.notify_all();
+    }
+  }
+
+  void Reset() {
+    std::lock_guard<std::mutex> lk(mu_);
+    q_.clear();
+    closed_ = false;
+    producers_ = 0;
+    cv_push_.notify_all();
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    producers_ = 0;
+    cv_pop_.notify_all();
+    cv_push_.notify_all();
+  }
+
+ private:
+  size_t cap_;
+  std::deque<Batch> q_;
+  int producers_ = 0;
+  bool closed_ = false;
+  std::mutex mu_;
+  std::condition_variable cv_push_, cv_pop_;
+};
+
+class DataFeed {
+ public:
+  DataFeed(std::vector<SlotDesc> slots, int batch_size, int num_threads,
+           int queue_cap)
+      : slots_(std::move(slots)),
+        batch_size_(batch_size),
+        num_threads_(num_threads),
+        channel_(queue_cap > 0 ? queue_cap : 64) {
+    for (const auto& s : slots_) {
+      if (s.dense)
+        dense_index_.push_back(static_cast<int>(&s - slots_.data()));
+      else
+        sparse_index_.push_back(static_cast<int>(&s - slots_.data()));
+    }
+  }
+
+  ~DataFeed() { Stop(); }
+
+  void SetFiles(std::vector<std::string> files) {
+    files_ = std::move(files);
+  }
+
+  bool ParseLine(const std::string& line, Record* rec) const {
+    const char* p = line.c_str();
+    char* end = nullptr;
+    rec->dense.clear();
+    rec->sparse.clear();
+    for (const auto& slot : slots_) {
+      long count = std::strtol(p, &end, 10);
+      if (end == p || count < 0) return false;
+      p = end;
+      if (slot.dense) {
+        if (count != slot.dim) return false;
+        std::vector<float> vals(count);
+        for (long i = 0; i < count; ++i) {
+          vals[i] = std::strtof(p, &end);
+          if (end == p) return false;
+          p = end;
+        }
+        rec->dense.push_back(std::move(vals));
+      } else {
+        std::vector<int64_t> ids(count);
+        for (long i = 0; i < count; ++i) {
+          ids[i] = std::strtoll(p, &end, 10);
+          if (end == p) return false;
+          p = end;
+        }
+        rec->sparse.push_back(std::move(ids));
+      }
+    }
+    return true;
+  }
+
+  // ---- streaming mode ----
+  bool Start() {
+    Stop();
+    channel_.Reset();
+    file_cursor_.store(0);
+    running_ = true;
+    int n = std::max(1, num_threads_);
+    for (int t = 0; t < n; ++t) channel_.AddProducer();
+    for (int t = 0; t < n; ++t)
+      threads_.emplace_back([this] { ParseWorker(); });
+    return true;
+  }
+
+  // ---- in-memory mode ----
+  int64_t LoadIntoMemory() {
+    Stop();
+    memory_.clear();  // a reload replaces, never silently duplicates
+    std::mutex mem_mu;
+    file_cursor_.store(0);
+    int n = std::max(1, num_threads_);
+    std::vector<std::thread> loaders;
+    std::atomic<bool> ok{true};
+    for (int t = 0; t < n; ++t) {
+      loaders.emplace_back([&] {
+        std::vector<Record> local;
+        size_t idx;
+        while ((idx = file_cursor_.fetch_add(1)) < files_.size()) {
+          std::ifstream in(files_[idx]);
+          if (!in) {
+            ok = false;
+            return;
+          }
+          std::string line;
+          Record rec;
+          while (std::getline(in, line)) {
+            if (line.empty()) continue;
+            if (ParseLine(line, &rec)) local.push_back(std::move(rec));
+          }
+        }
+        std::lock_guard<std::mutex> lk(mem_mu);
+        for (auto& r : local) memory_.push_back(std::move(r));
+      });
+    }
+    for (auto& t : loaders) t.join();
+    return ok ? static_cast<int64_t>(memory_.size()) : -1;
+  }
+
+  void LocalShuffle(uint64_t seed) {
+    std::mt19937_64 rng(seed);
+    std::shuffle(memory_.begin(), memory_.end(), rng);
+  }
+
+  bool StartFromMemory() {
+    Stop();
+    channel_.Reset();
+    running_ = true;
+    channel_.AddProducer();
+    threads_.emplace_back([this] {
+      Batch b;
+      for (auto& rec : memory_) {
+        if (!running_) break;
+        b.rows.push_back(rec);  // copy: memory_ reusable across epochs
+        if (static_cast<int>(b.rows.size()) == batch_size_) {
+          channel_.Push(std::move(b));
+          b = Batch{};
+        }
+      }
+      if (!b.rows.empty() && running_) channel_.Push(std::move(b));
+      channel_.RemoveProducer();
+    });
+    return true;
+  }
+
+  // ---- global-shuffle record exchange ----
+  int64_t SerializeRange(int64_t begin, int64_t end, uint8_t* buf,
+                         int64_t cap) const {
+    if (begin < 0 || end > static_cast<int64_t>(memory_.size()) || begin > end)
+      return -1;
+    // format per record: per dense slot: f32*dim; per sparse slot:
+    // u32 len + i64*len
+    int64_t need = 0;
+    for (int64_t i = begin; i < end; ++i) {
+      const Record& r = memory_[i];
+      for (const auto& d : r.dense) need += 4 * static_cast<int64_t>(d.size());
+      for (const auto& s : r.sparse)
+        need += 4 + 8 * static_cast<int64_t>(s.size());
+    }
+    if (buf == nullptr || cap < need) return need;
+    uint8_t* p = buf;
+    for (int64_t i = begin; i < end; ++i) {
+      const Record& r = memory_[i];
+      for (const auto& d : r.dense) {
+        std::memcpy(p, d.data(), 4 * d.size());
+        p += 4 * d.size();
+      }
+      for (const auto& s : r.sparse) {
+        uint32_t len = static_cast<uint32_t>(s.size());
+        std::memcpy(p, &len, 4);
+        p += 4;
+        std::memcpy(p, s.data(), 8 * s.size());
+        p += 8 * s.size();
+      }
+    }
+    return need;
+  }
+
+  int64_t DeserializeAppend(const uint8_t* buf, int64_t len) {
+    const uint8_t* p = buf;
+    const uint8_t* endp = buf + len;
+    int64_t added = 0;
+    while (p < endp) {
+      Record rec;
+      for (const auto& slot : slots_) {
+        if (slot.dense) {
+          if (p + 4 * slot.dim > endp) return -1;
+          std::vector<float> vals(slot.dim);
+          std::memcpy(vals.data(), p, 4 * slot.dim);
+          p += 4 * slot.dim;
+          rec.dense.push_back(std::move(vals));
+        } else {
+          if (p + 4 > endp) return -1;
+          uint32_t n;
+          std::memcpy(&n, p, 4);
+          p += 4;
+          if (p + 8 * static_cast<int64_t>(n) > endp) return -1;
+          std::vector<int64_t> ids(n);
+          std::memcpy(ids.data(), p, 8 * static_cast<size_t>(n));
+          p += 8 * static_cast<size_t>(n);
+          rec.sparse.push_back(std::move(ids));
+        }
+      }
+      memory_.push_back(std::move(rec));
+      ++added;
+    }
+    return added;
+  }
+
+  int64_t MemorySize() const { return static_cast<int64_t>(memory_.size()); }
+  void ClearMemory() { memory_.clear(); }
+
+  // Fill caller buffers from the next batch. Returns rows, 0 at end.
+  int Next(float** dense_bufs, int64_t** sparse_bufs, int64_t** len_bufs) {
+    Batch b;
+    if (!channel_.Pop(&b)) return 0;
+    int rows = static_cast<int>(b.rows.size());
+    for (size_t di = 0; di < dense_index_.size(); ++di) {
+      const SlotDesc& slot = slots_[dense_index_[di]];
+      float* out = dense_bufs ? dense_bufs[di] : nullptr;
+      if (!out) continue;
+      for (int r = 0; r < rows; ++r) {
+        const auto& vals = b.rows[r].dense[di];
+        std::memcpy(out + static_cast<int64_t>(r) * slot.dim, vals.data(),
+                    4 * slot.dim);
+      }
+    }
+    for (size_t si = 0; si < sparse_index_.size(); ++si) {
+      const SlotDesc& slot = slots_[sparse_index_[si]];
+      int64_t* out = sparse_bufs ? sparse_bufs[si] : nullptr;
+      int64_t* lens = len_bufs ? len_bufs[si] : nullptr;
+      if (!out) continue;
+      for (int r = 0; r < rows; ++r) {
+        const auto& ids = b.rows[r].sparse[si];
+        int64_t n = std::min<int64_t>(static_cast<int64_t>(ids.size()),
+                                      slot.dim);
+        int64_t* row = out + static_cast<int64_t>(r) * slot.dim;
+        std::memcpy(row, ids.data(), 8 * n);
+        std::memset(row + n, 0, 8 * (slot.dim - n));
+        if (lens) lens[r] = n;
+      }
+    }
+    return rows;
+  }
+
+  void Stop() {
+    running_ = false;
+    channel_.Close();
+    for (auto& t : threads_)
+      if (t.joinable()) t.join();
+    threads_.clear();
+  }
+
+ private:
+  void ParseWorker() {
+    Batch b;
+    size_t idx;
+    Record rec;
+    while (running_ && (idx = file_cursor_.fetch_add(1)) < files_.size()) {
+      std::ifstream in(files_[idx]);
+      if (!in) continue;
+      std::string line;
+      while (running_ && std::getline(in, line)) {
+        if (line.empty()) continue;
+        if (!ParseLine(line, &rec)) continue;
+        b.rows.push_back(std::move(rec));
+        rec = Record{};
+        if (static_cast<int>(b.rows.size()) == batch_size_) {
+          channel_.Push(std::move(b));
+          b = Batch{};
+        }
+      }
+    }
+    if (!b.rows.empty() && running_) channel_.Push(std::move(b));
+    channel_.RemoveProducer();
+  }
+
+  std::vector<SlotDesc> slots_;
+  std::vector<int> dense_index_, sparse_index_;
+  int batch_size_;
+  int num_threads_;
+  BatchChannel channel_;
+  std::vector<std::string> files_;
+  std::atomic<size_t> file_cursor_{0};
+  std::atomic<bool> running_{false};
+  std::vector<std::thread> threads_;
+  std::vector<Record> memory_;
+};
+
+std::mutex g_df_mu;
+// shared_ptr: pt_df_destroy may race a thread blocked in pt_df_next; the
+// feed must outlive in-flight calls (Stop() wakes them via channel close).
+std::map<int64_t, std::shared_ptr<DataFeed>> g_feeds;
+int64_t g_df_next = 1;
+
+std::shared_ptr<DataFeed> GetFeed(int64_t h) {
+  std::lock_guard<std::mutex> lk(g_df_mu);
+  auto it = g_feeds.find(h);
+  return it == g_feeds.end() ? nullptr : it->second;
+}
+
+std::vector<std::string> SplitSemicolon(const char* s) {
+  std::vector<std::string> out;
+  std::stringstream ss(s);
+  std::string item;
+  while (std::getline(ss, item, ';'))
+    if (!item.empty()) out.push_back(item);
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t pt_df_create(const char* slots_desc, int batch_size, int num_threads,
+                     int queue_capacity) {
+  std::vector<SlotDesc> slots;
+  for (const auto& part : SplitSemicolon(slots_desc)) {
+    // "name:dense:8" | "name:sparse:64"
+    size_t c1 = part.find(':');
+    size_t c2 = part.find(':', c1 + 1);
+    if (c1 == std::string::npos || c2 == std::string::npos) return -1;
+    SlotDesc d;
+    d.name = part.substr(0, c1);
+    std::string kind = part.substr(c1 + 1, c2 - c1 - 1);
+    d.dim = std::atoi(part.c_str() + c2 + 1);
+    if (kind == "dense")
+      d.dense = true;
+    else if (kind == "sparse")
+      d.dense = false;
+    else
+      return -1;
+    if (d.dim <= 0) return -1;
+    slots.push_back(std::move(d));
+  }
+  if (slots.empty() || batch_size <= 0) return -1;
+  std::lock_guard<std::mutex> lk(g_df_mu);
+  int64_t h = g_df_next++;
+  g_feeds[h] = std::make_shared<DataFeed>(std::move(slots), batch_size,
+                                          num_threads, queue_capacity);
+  return h;
+}
+
+void pt_df_destroy(int64_t h) {
+  std::shared_ptr<DataFeed> f;
+  {
+    std::lock_guard<std::mutex> lk(g_df_mu);
+    auto it = g_feeds.find(h);
+    if (it == g_feeds.end()) return;
+    f = std::move(it->second);
+    g_feeds.erase(it);
+  }
+  f->Stop();  // wakes any thread blocked in pt_df_next via channel close
+}
+
+int pt_df_set_files(int64_t h, const char* files_semicolon) {
+  auto f = GetFeed(h);
+  if (!f) return -1;
+  f->SetFiles(SplitSemicolon(files_semicolon));
+  return 0;
+}
+
+int pt_df_start(int64_t h) {
+  auto f = GetFeed(h);
+  return f && f->Start() ? 0 : -1;
+}
+
+int64_t pt_df_load_into_memory(int64_t h) {
+  auto f = GetFeed(h);
+  return f ? f->LoadIntoMemory() : -1;
+}
+
+void pt_df_local_shuffle(int64_t h, uint64_t seed) {
+  auto f = GetFeed(h);
+  if (f) f->LocalShuffle(seed);
+}
+
+int pt_df_start_from_memory(int64_t h) {
+  auto f = GetFeed(h);
+  return f && f->StartFromMemory() ? 0 : -1;
+}
+
+int64_t pt_df_serialize_range(int64_t h, int64_t begin, int64_t end,
+                              uint8_t* buf, int64_t cap) {
+  auto f = GetFeed(h);
+  return f ? f->SerializeRange(begin, end, buf, cap) : -1;
+}
+
+int64_t pt_df_deserialize_append(int64_t h, const uint8_t* buf, int64_t len) {
+  auto f = GetFeed(h);
+  return f ? f->DeserializeAppend(buf, len) : -1;
+}
+
+int64_t pt_df_memory_size(int64_t h) {
+  auto f = GetFeed(h);
+  return f ? f->MemorySize() : -1;
+}
+
+void pt_df_clear_memory(int64_t h) {
+  auto f = GetFeed(h);
+  if (f) f->ClearMemory();
+}
+
+int pt_df_next(int64_t h, float** dense_bufs, int64_t** sparse_bufs,
+               int64_t** len_bufs) {
+  auto f = GetFeed(h);
+  return f ? f->Next(dense_bufs, sparse_bufs, len_bufs) : -1;
+}
+
+}  // extern "C"

@@ -1,0 +1,50 @@
+// Named atomic int64 stat registry.
+// TPU-native equivalent of paddle/fluid/platform/monitor.h:33 (Monitor
+// singleton + STAT_ADD/STAT_GET macros used for runtime counters).
+
+#include "ptnative.h"
+
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+std::mutex g_mu;
+std::map<std::string, int64_t> g_stats;
+}  // namespace
+
+extern "C" {
+
+void pt_mon_add(const char* name, int64_t v) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_stats[name] += v;
+}
+
+int64_t pt_mon_get(const char* name) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_stats.find(name);
+  return it == g_stats.end() ? 0 : it->second;
+}
+
+void pt_mon_reset(const char* name) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_stats.erase(name);
+}
+
+int64_t pt_mon_dump(char* buf, int64_t cap) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  std::string out;
+  char line[512];
+  for (const auto& kv : g_stats) {
+    std::snprintf(line, sizeof(line), "%s=%lld\n", kv.first.c_str(),
+                  static_cast<long long>(kv.second));
+    out += line;
+  }
+  int64_t need = static_cast<int64_t>(out.size());
+  if (buf && cap >= need) std::memcpy(buf, out.data(), need);
+  return need;
+}
+
+}  // extern "C"

@@ -1,0 +1,92 @@
+// C API of the native runtime for the TPU framework.
+//
+// Three native subsystems, mirroring the reference's native components
+// (cited from /root/reference):
+//  - control plane (control_plane.cc): TCP key-value rendezvous + barrier +
+//    atomic counters. Replaces the reference's bootstrap/coordination
+//    machinery: ncclUniqueId exchange over RPC
+//    (paddle/fluid/operators/collective/c_gen_nccl_id_op.cc:49),
+//    Gloo barriers (paddle/fluid/framework/fleet/gloo_wrapper.h:146) and the
+//    gRPC PS control path (paddle/fluid/operators/distributed/grpc/).
+//  - data feed (data_feed.cc): threaded slot-record parser + bounded batch
+//    channel + in-memory shuffle. Replaces MultiSlotDataFeed /
+//    InMemoryDataFeed (paddle/fluid/framework/data_feed.h:255,650) and the
+//    DatasetImpl load/shuffle path (paddle/fluid/framework/data_set.h:43).
+//  - monitor (monitor.cc): named atomic int64 stat registry. Replaces
+//    paddle/fluid/platform/monitor.h:33 (STAT_ADD etc.).
+//
+// The binding layer is plain C + ctypes (no pybind11 in the image), the
+// moral equivalent of the reference's paddle/fluid/pybind/pybind.cc surface.
+#ifndef PTNATIVE_H_
+#define PTNATIVE_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+// ---------------- control plane ----------------
+// Server. port==0 picks an ephemeral port. Returns handle >0, or -1.
+int64_t pt_cp_server_start(int port);
+int pt_cp_server_port(int64_t handle);
+void pt_cp_server_stop(int64_t handle);
+
+// Client. Retries connect until timeout_ms elapses. Returns handle >0 or -1.
+int64_t pt_cp_client_connect(const char* host, int port, int timeout_ms);
+void pt_cp_client_close(int64_t handle);
+
+// KV: set stores bytes; get copies value into buf (cap bytes) and returns the
+// value length, -1 on timeout/error, -2 if cap too small (length returned via
+// *need). block!=0 waits for the key to appear.
+int pt_cp_set(int64_t h, const char* key, const uint8_t* val, int64_t len);
+int64_t pt_cp_get(int64_t h, const char* key, uint8_t* buf, int64_t cap,
+                  int block, int timeout_ms);
+// Atomic fetch-add on an int64 cell (created at 0). Returns the new value.
+int64_t pt_cp_add(int64_t h, const char* key, int64_t delta);
+// Barrier across `world` participants identified by name. 0 ok, -1 timeout.
+int pt_cp_barrier(int64_t h, const char* name, int world, int timeout_ms);
+
+// ---------------- data feed ----------------
+// slots_desc: semicolon-separated "name:dense:<dim>" | "name:sparse:<max_len>"
+// Returns handle >0 or -1.
+int64_t pt_df_create(const char* slots_desc, int batch_size, int num_threads,
+                     int queue_capacity);
+void pt_df_destroy(int64_t h);
+int pt_df_set_files(int64_t h, const char* files_semicolon);
+// Streaming mode: parser threads read files and emit batches as they go.
+int pt_df_start(int64_t h);
+// In-memory mode (reference: InMemoryDataFeed::LoadIntoMemory
+// data_feed.h:650, DatasetImpl::LocalShuffle data_set.h:157).
+int64_t pt_df_load_into_memory(int64_t h);  // returns #records or -1
+void pt_df_local_shuffle(int64_t h, uint64_t seed);
+int pt_df_start_from_memory(int64_t h);
+// Exchange a contiguous range of in-memory records for global shuffle:
+// serialize records [begin,end) into buf; parse buf back in (append).
+int64_t pt_df_serialize_range(int64_t h, int64_t begin, int64_t end,
+                              uint8_t* buf, int64_t cap);
+int64_t pt_df_deserialize_append(int64_t h, const uint8_t* buf, int64_t len);
+int64_t pt_df_memory_size(int64_t h);
+void pt_df_clear_memory(int64_t h);
+
+// Fetch next batch. For slot i (declaration order):
+//  dense slot  -> dense_bufs[i] points at float[batch*dim]
+//  sparse slot -> sparse_bufs[i] points at int64[batch*max_len] (0-padded)
+//                 and len_bufs[i] at int64[batch]
+// Unused entries may be null. Returns actual batch rows (may be < batch at
+// epoch end), 0 when the epoch is exhausted, -1 on error.
+int pt_df_next(int64_t h, float** dense_bufs, int64_t** sparse_bufs,
+               int64_t** len_bufs);
+
+// ---------------- monitor ----------------
+void pt_mon_add(const char* name, int64_t v);
+int64_t pt_mon_get(const char* name);
+void pt_mon_reset(const char* name);
+// Write "name=value\n" lines; returns bytes written (or needed if cap==0).
+int64_t pt_mon_dump(char* buf, int64_t cap);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  // PTNATIVE_H_

@@ -103,6 +103,14 @@ class EarlyStopping(Callback):
                 self.stop_training = True
 
 
+def _as_metric_list(metrics) -> List[Metric]:
+    if metrics is None:
+        return []
+    if isinstance(metrics, Metric):  # single metric accepted like reference
+        return [metrics]
+    return list(metrics)
+
+
 class Model:
     """(ref: hapi/model.py Model)."""
 
@@ -112,7 +120,7 @@ class Model:
         self.network = network
         self._loss = loss
         self._optimizer = optimizer
-        self._metrics = list(metrics or [])
+        self._metrics = _as_metric_list(metrics)
         self._train_step: Optional[TrainStep] = None
         self._eval_step: Optional[EvalStep] = None
 
@@ -124,7 +132,7 @@ class Model:
         if loss is not None:
             self._loss = loss
         if metrics is not None:
-            self._metrics = list(metrics)
+            self._metrics = _as_metric_list(metrics)
         return self
 
     def _get_train_step(self) -> TrainStep:

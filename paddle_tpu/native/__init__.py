@@ -1,0 +1,379 @@
+"""ctypes binding of the native runtime (csrc/).
+
+This is the framework's equivalent of the reference's pybind layer
+(/root/reference/paddle/fluid/pybind/pybind.cc) — a narrow C surface over
+the native components:
+
+- ControlPlaneServer / ControlPlaneClient — TCP KV rendezvous, atomic
+  counters and barriers (replaces c_gen_nccl_id_op.cc:49 id exchange,
+  gloo_wrapper.h:146 barriers, and the PS gRPC bootstrap).
+- NativeDataFeed — threaded slot-record parser + bounded batch channel +
+  in-memory shuffle (replaces data_feed.h:255 MultiSlotDataFeed and
+  data_set.h:43 DatasetImpl).
+- monitor counters (replaces platform/monitor.h:33).
+
+The library auto-builds from ``csrc/`` with g++ on first use (the image has
+no pybind11; ctypes keeps the binding dependency-free).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.dirname(os.path.dirname(_PKG_DIR))
+_CSRC = os.path.join(_REPO_ROOT, "csrc")
+_SO_PATH = os.path.join(_PKG_DIR, "libptnative.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _needs_build() -> bool:
+    have_so = os.path.exists(_SO_PATH)
+    if not os.path.isdir(_CSRC):
+        # installed without sources: use the prebuilt .so if present
+        if have_so:
+            return False
+        raise RuntimeError(
+            f"native library missing: no {_SO_PATH} and no sources at "
+            f"{_CSRC}")
+    if not have_so:
+        return True
+    so_mtime = os.path.getmtime(_SO_PATH)
+    for name in os.listdir(_CSRC):
+        if name.endswith((".cc", ".h")):
+            if os.path.getmtime(os.path.join(_CSRC, name)) > so_mtime:
+                return True
+    return False
+
+
+def build(force: bool = False) -> str:
+    """Compile csrc/ into libptnative.so (cached by mtime)."""
+    if force or _needs_build():
+        srcs = sorted(
+            os.path.join(_CSRC, f) for f in os.listdir(_CSRC)
+            if f.endswith(".cc"))
+        cmd = ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
+               "-o", _SO_PATH] + srcs
+        proc = subprocess.run(cmd, cwd=_CSRC, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"native build failed ({' '.join(cmd)}):\n{proc.stderr}")
+    return _SO_PATH
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        build()
+        lib = ctypes.CDLL(_SO_PATH)
+        c = ctypes
+        sigs = {
+            "pt_cp_server_start": ([c.c_int], c.c_int64),
+            "pt_cp_server_port": ([c.c_int64], c.c_int),
+            "pt_cp_server_stop": ([c.c_int64], None),
+            "pt_cp_client_connect": ([c.c_char_p, c.c_int, c.c_int],
+                                     c.c_int64),
+            "pt_cp_client_close": ([c.c_int64], None),
+            "pt_cp_set": ([c.c_int64, c.c_char_p, c.POINTER(c.c_uint8),
+                           c.c_int64], c.c_int),
+            "pt_cp_get": ([c.c_int64, c.c_char_p, c.POINTER(c.c_uint8),
+                           c.c_int64, c.c_int, c.c_int], c.c_int64),
+            "pt_cp_add": ([c.c_int64, c.c_char_p, c.c_int64], c.c_int64),
+            "pt_cp_barrier": ([c.c_int64, c.c_char_p, c.c_int, c.c_int],
+                              c.c_int),
+            "pt_df_create": ([c.c_char_p, c.c_int, c.c_int, c.c_int],
+                             c.c_int64),
+            "pt_df_destroy": ([c.c_int64], None),
+            "pt_df_set_files": ([c.c_int64, c.c_char_p], c.c_int),
+            "pt_df_start": ([c.c_int64], c.c_int),
+            "pt_df_load_into_memory": ([c.c_int64], c.c_int64),
+            "pt_df_local_shuffle": ([c.c_int64, c.c_uint64], None),
+            "pt_df_start_from_memory": ([c.c_int64], c.c_int),
+            "pt_df_serialize_range": ([c.c_int64, c.c_int64, c.c_int64,
+                                       c.POINTER(c.c_uint8), c.c_int64],
+                                      c.c_int64),
+            "pt_df_deserialize_append": ([c.c_int64, c.POINTER(c.c_uint8),
+                                          c.c_int64], c.c_int64),
+            "pt_df_memory_size": ([c.c_int64], c.c_int64),
+            "pt_df_clear_memory": ([c.c_int64], None),
+            "pt_df_next": ([c.c_int64, c.POINTER(c.c_void_p),
+                            c.POINTER(c.c_void_p), c.POINTER(c.c_void_p)],
+                           c.c_int),
+            "pt_mon_add": ([c.c_char_p, c.c_int64], None),
+            "pt_mon_get": ([c.c_char_p], c.c_int64),
+            "pt_mon_reset": ([c.c_char_p], None),
+            "pt_mon_dump": ([c.c_char_p, c.c_int64], c.c_int64),
+        }
+        for name, (argtypes, restype) in sigs.items():
+            fn = getattr(lib, name)
+            fn.argtypes = argtypes
+            fn.restype = restype
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    try:
+        _load()
+        return True
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------- control plane
+
+class ControlPlaneServer:
+    """KV/barrier server; run one per job (usually on the coordinator)."""
+
+    def __init__(self, port: int = 0):
+        lib = _load()
+        self._h = lib.pt_cp_server_start(port)
+        if self._h < 0:
+            raise RuntimeError(f"control-plane server failed on port {port}")
+        self.port = lib.pt_cp_server_port(self._h)
+
+    def stop(self) -> None:
+        if self._h > 0:
+            _load().pt_cp_server_stop(self._h)
+            self._h = -1
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class ControlPlaneClient:
+    """Client of the control plane; safe for use from multiple threads."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 timeout_ms: int = 30000):
+        lib = _load()
+        self._h = lib.pt_cp_client_connect(host.encode(), port, timeout_ms)
+        if self._h < 0:
+            raise RuntimeError(f"connect to control plane {host}:{port} failed")
+
+    def set(self, key: str, value: bytes) -> None:
+        buf = (ctypes.c_uint8 * len(value)).from_buffer_copy(value)
+        rc = _load().pt_cp_set(self._h, key.encode(), buf, len(value))
+        if rc != 0:
+            raise RuntimeError(f"control-plane set({key!r}) failed")
+
+    def get(self, key: str, block: bool = True,
+            timeout_ms: int = 30000, max_size: int = 1 << 20) -> bytes:
+        buf = (ctypes.c_uint8 * max_size)()
+        n = _load().pt_cp_get(self._h, key.encode(), buf, max_size,
+                              1 if block else 0, timeout_ms)
+        if n == -3:  # buffer too small: grow and retry
+            return self.get(key, block, timeout_ms, max_size * 16)
+        if n == -2:
+            raise TimeoutError(
+                f"control-plane get({key!r}) timed out after {timeout_ms}ms")
+        if n == -1:
+            raise KeyError(key)
+        if n < 0:
+            raise RuntimeError(f"control-plane get({key!r}) transport error")
+        return bytes(buf[:n])
+
+    def add(self, key: str, delta: int = 1) -> int:
+        v = _load().pt_cp_add(self._h, key.encode(), delta)
+        if v == -(2 ** 63):
+            raise RuntimeError(f"control-plane add({key!r}) failed")
+        return v
+
+    def barrier(self, name: str, world: int, timeout_ms: int = 60000) -> None:
+        rc = _load().pt_cp_barrier(self._h, name.encode(), world, timeout_ms)
+        if rc != 0:
+            raise TimeoutError(f"barrier {name!r} timed out "
+                               f"(world={world})")
+
+    def close(self) -> None:
+        if self._h > 0:
+            _load().pt_cp_client_close(self._h)
+            self._h = -1
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ------------------------------------------------------------------- data feed
+
+class SlotSpec:
+    """One input slot: dense (fixed float vector) or sparse (id list)."""
+
+    def __init__(self, name: str, kind: str, dim: int):
+        if kind not in ("dense", "sparse"):
+            raise ValueError(f"slot kind must be dense|sparse, got {kind}")
+        self.name, self.kind, self.dim = name, kind, dim
+
+    @property
+    def dense(self) -> bool:
+        return self.kind == "dense"
+
+    def descr(self) -> str:
+        return f"{self.name}:{self.kind}:{self.dim}"
+
+
+class NativeDataFeed:
+    """Threaded file->record->batch pipeline backed by the C++ feed."""
+
+    def __init__(self, slots: Sequence[SlotSpec], batch_size: int,
+                 num_threads: int = 4, queue_capacity: int = 64):
+        lib = _load()
+        self.slots = list(slots)
+        self.batch_size = batch_size
+        desc = ";".join(s.descr() for s in self.slots)
+        self._h = lib.pt_df_create(desc.encode(), batch_size, num_threads,
+                                   queue_capacity)
+        if self._h < 0:
+            raise RuntimeError(f"bad slot spec: {desc}")
+        self._dense = [s for s in self.slots if s.dense]
+        self._sparse = [s for s in self.slots if not s.dense]
+
+    def set_files(self, files: Sequence[str]) -> None:
+        _load().pt_df_set_files(self._h, ";".join(files).encode())
+
+    def start(self) -> None:
+        if _load().pt_df_start(self._h) != 0:
+            raise RuntimeError("data feed start failed")
+
+    def load_into_memory(self) -> int:
+        n = _load().pt_df_load_into_memory(self._h)
+        if n < 0:
+            raise RuntimeError("load_into_memory failed (unreadable file?)")
+        return n
+
+    def local_shuffle(self, seed: int = 0) -> None:
+        _load().pt_df_local_shuffle(self._h, seed)
+
+    def start_from_memory(self) -> None:
+        if _load().pt_df_start_from_memory(self._h) != 0:
+            raise RuntimeError("start_from_memory failed")
+
+    def memory_size(self) -> int:
+        return _load().pt_df_memory_size(self._h)
+
+    def clear_memory(self) -> None:
+        _load().pt_df_clear_memory(self._h)
+
+    def serialize_range(self, begin: int, end: int) -> bytes:
+        lib = _load()
+        need = lib.pt_df_serialize_range(self._h, begin, end, None, 0)
+        if need < 0:
+            raise ValueError(f"bad range [{begin},{end})")
+        buf = (ctypes.c_uint8 * max(need, 1))()
+        got = lib.pt_df_serialize_range(self._h, begin, end, buf, need)
+        if got != need:
+            raise RuntimeError("serialize_range failed")
+        return bytes(buf[:need])
+
+    def deserialize_append(self, data: bytes) -> int:
+        buf = (ctypes.c_uint8 * max(len(data), 1)).from_buffer_copy(
+            data or b"\0")
+        n = _load().pt_df_deserialize_append(self._h, buf, len(data))
+        if n < 0:
+            raise RuntimeError("deserialize_append: corrupt payload")
+        return n
+
+    def next_batch(self) -> Optional[Dict[str, np.ndarray]]:
+        """Pop one batch as numpy arrays; None at end of epoch.
+
+        dense slot -> float32 [rows, dim]; sparse slot -> (int64 [rows,
+        max_len] zero-padded, int64 [rows] lengths).
+        """
+        bs = self.batch_size
+        dense_arrays = [np.empty((bs, s.dim), np.float32)
+                        for s in self._dense]
+        sparse_arrays = [np.empty((bs, s.dim), np.int64)
+                         for s in self._sparse]
+        len_arrays = [np.empty((bs,), np.int64) for _ in self._sparse]
+
+        def ptrs(arrays, ctype):
+            arr = (ctypes.c_void_p * max(len(arrays), 1))()
+            for i, a in enumerate(arrays):
+                arr[i] = a.ctypes.data_as(ctypes.c_void_p)
+            return arr
+
+        rows = _load().pt_df_next(self._h, ptrs(dense_arrays, None),
+                                  ptrs(sparse_arrays, None),
+                                  ptrs(len_arrays, None))
+        if rows < 0:
+            raise RuntimeError("data feed error")
+        if rows == 0:
+            return None
+        out: Dict[str, np.ndarray] = {}
+        for s, a in zip(self._dense, dense_arrays):
+            out[s.name] = a[:rows]
+        for s, a, ln in zip(self._sparse, sparse_arrays, len_arrays):
+            out[s.name] = a[:rows]
+            out[s.name + "_len"] = ln[:rows]
+        return out
+
+    def __iter__(self):
+        while True:
+            b = self.next_batch()
+            if b is None:
+                return
+            yield b
+
+    def close(self) -> None:
+        if getattr(self, "_h", -1) > 0:
+            _load().pt_df_destroy(self._h)
+            self._h = -1
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# --------------------------------------------------------------------- monitor
+
+def stat_add(name: str, value: int = 1) -> None:
+    _load().pt_mon_add(name.encode(), value)
+
+
+def stat_get(name: str) -> int:
+    return _load().pt_mon_get(name.encode())
+
+
+def stat_reset(name: str) -> None:
+    _load().pt_mon_reset(name.encode())
+
+
+def stat_dump() -> Dict[str, int]:
+    lib = _load()
+    need = lib.pt_mon_dump(None, 0)
+    if need <= 0:
+        return {}
+    buf = ctypes.create_string_buffer(need)
+    lib.pt_mon_dump(buf, need)
+    out = {}
+    for line in buf.raw[:need].decode().splitlines():
+        if "=" in line:
+            k, v = line.rsplit("=", 1)
+            out[k] = int(v)
+    return out

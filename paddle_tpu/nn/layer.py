@@ -55,6 +55,17 @@ class Parameter:
     def numpy(self):
         return np.asarray(self.value)
 
+    def set_value(self, value) -> None:
+        """In-place value replacement (ref: VarBase.set_value,
+        imperative/layer.h). Shape must match; dtype follows the new value
+        if jax-compatible, else keeps the old dtype."""
+        new = jnp.asarray(value)
+        if tuple(new.shape) != tuple(self.value.shape):
+            raise InvalidArgumentError(
+                f"set_value shape mismatch: parameter has "
+                f"{tuple(self.value.shape)}, got {tuple(new.shape)}")
+        self.value = new
+
     def __repr__(self) -> str:
         return (f"Parameter(shape={tuple(self.value.shape)}, "
                 f"dtype={self.value.dtype}, trainable={self.trainable})")

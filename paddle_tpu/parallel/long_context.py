@@ -53,8 +53,7 @@ def _ring_attention_local(q, k, v, axis: str, causal: bool,
     q_pos = (idx * t_local
              + lax.broadcasted_iota(jnp.int32, (t_local, t_local), 0))
 
-    def block(carry, step):
-        acc, m_prev, l_prev, k_cur, v_cur = carry
+    def fold(acc, m_prev, l_prev, k_cur, v_cur, step):
         # K/V arriving at `step` originated on rank (idx - step) mod n
         src = (idx - step) % n
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_cur.astype(jnp.float32))
@@ -70,6 +69,11 @@ def _ring_attention_local(q, k, v, axis: str, causal: bool,
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc = acc * alpha + jnp.einsum(
             "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
+        return acc, m_new, l_new
+
+    def block(carry, step):
+        acc, m_prev, l_prev, k_cur, v_cur = carry
+        acc, m_new, l_new = fold(acc, m_prev, l_prev, k_cur, v_cur, step)
         # rotate K/V one hop around the ring (overlaps with next block)
         k_next = lax.ppermute(k_cur, axis, perm)
         v_next = lax.ppermute(v_cur, axis, perm)
@@ -79,8 +83,13 @@ def _ring_attention_local(q, k, v, axis: str, causal: bool,
     acc0 = jnp.zeros((b, h, t_local, d), jnp.float32)
     m0 = jnp.full((b, h, t_local, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, t_local, 1), jnp.float32)
-    (acc, _, l_fin, _, _), _ = lax.scan(
-        block, (acc0, m0, l0, k, v), jnp.arange(n))
+    carry = (acc0, m0, l0, k, v)
+    if n > 1:
+        # scan the first n-1 blocks (each ends with a rotation); the last
+        # block folds outside the scan so its K/V are not rotated again
+        carry, _ = lax.scan(block, carry, jnp.arange(n - 1))
+    acc, m_prev, l_prev, k_last, v_last = carry
+    acc, _, l_fin = fold(acc, m_prev, l_prev, k_last, v_last, n - 1)
     return (acc / jnp.maximum(l_fin, 1e-30)).astype(q.dtype)
 
 

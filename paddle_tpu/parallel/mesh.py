@@ -21,10 +21,14 @@ DP, MP, PP, SP, EP = "dp", "mp", "pp", "sp", "ep"
 
 
 def create_mesh(axes: Optional[Dict[str, int]] = None,
-                devices: Optional[Sequence] = None) -> Mesh:
+                devices: Optional[Sequence] = None,
+                allow_submesh: bool = False) -> Mesh:
     """Build a mesh from an axis→size dict, e.g. {"dp": 4, "mp": 2}.
 
-    Sizes of -1 (at most one) absorb the remaining devices.
+    Sizes of -1 (at most one) absorb the remaining devices. Axis sizes that
+    cover fewer devices than available are an error unless
+    ``allow_submesh=True`` (which builds the mesh on the first ``total``
+    devices and leaves the rest idle).
     """
     devices = list(devices) if devices is not None else jax.devices()
     axes = dict(axes) if axes else {DP: len(devices)}
@@ -43,10 +47,12 @@ def create_mesh(axes: Optional[Dict[str, int]] = None,
                 f"divisible by {known}")
         axes[wild] = n // known
     total = int(np.prod(list(axes.values())))
-    # explicit sizes smaller than the device count build a submesh on the
-    # first `total` devices; wildcard meshes always cover all devices
     if total > n or total <= 0:
         raise ValueError(f"mesh axes {axes} need {total} devices, have {n}")
+    if total < n and not allow_submesh:
+        raise ValueError(
+            f"mesh axes {axes} cover {total} of {n} devices; use -1 to "
+            f"absorb the rest or allow_submesh=True to idle them")
     arr = np.array(devices[:total]).reshape(tuple(axes.values()))
     return Mesh(arr, tuple(axes))
 

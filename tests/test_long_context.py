@@ -33,7 +33,7 @@ def test_ring_attention_matches_reference(qkv, causal):
 @pytest.mark.parametrize("causal", [False, True])
 def test_ulysses_matches_reference(qkv, causal):
     q, k, v = qkv
-    mesh = create_mesh({"sp": 4})
+    mesh = create_mesh({"sp": 4}, allow_submesh=True)
     ref = scaled_dot_product_attention(q, k, v, causal=causal)
     got = ulysses_attention(q, k, v, mesh, causal=causal)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
@@ -86,7 +86,7 @@ def test_gpipe_train_step_converges(rng):
     from paddle_tpu.parallel.pipeline import GPipeTrainStep
     from paddle_tpu.ops import loss as L
 
-    mesh = create_mesh({"pp": 4})
+    mesh = create_mesh({"pp": 4}, allow_submesh=True)
     pt.seed(0)
     embed = pt.nn.Linear(8, 16)
     stages = [pt.nn.Sequential(pt.nn.Linear(16, 16), pt.nn.Tanh())
