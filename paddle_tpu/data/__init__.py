@@ -267,3 +267,6 @@ class DeviceLoader:
 
     def __len__(self):
         return len(self.loader)
+
+from .dataset import (DatasetBase, DatasetFactory, InMemoryDataset,
+                      QueueDataset)  # noqa: E402,F401

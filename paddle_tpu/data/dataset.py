@@ -1,0 +1,205 @@
+"""Industrial dataset pipeline: file-driven training datasets.
+
+TPU-native rebuild of the reference's Dataset stack
+(/root/reference/python/paddle/fluid/dataset.py DatasetFactory/
+InMemoryDataset/QueueDataset; C++ side paddle/fluid/framework/data_set.h:43
+DatasetImpl, data_feed.h:255 MultiSlotDataFeed). Parsing/shuffling/batching
+runs in the C++ native feed (csrc/data_feed.cc) on reader threads; global
+shuffle exchanges serialized record ranges through the control plane
+(the reference ships records between nodes via FleetWrapper RPC,
+data_set.h:111 GlobalShuffle).
+
+Slot model: each line holds every slot in declaration order,
+``<count> v...`` per slot — dense slots are fixed-width float vectors,
+sparse slots variable-length int64 id lists (reference: MultiSlot format,
+data_feed.proto).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import native
+
+
+class _SlotDef:
+    def __init__(self, name: str, kind: str, dim: int,
+                 shape: Optional[Tuple[int, ...]] = None):
+        self.name, self.kind, self.dim = name, kind, dim
+        self.shape = shape  # optional reshape for dense slots
+
+
+class DatasetBase:
+    """Shared config surface (ref: dataset.py DatasetBase)."""
+
+    def __init__(self) -> None:
+        self._slots: List[_SlotDef] = []
+        self._batch_size = 1
+        self._thread = 1
+        self._filelist: List[str] = []
+        self._queue_capacity = 64
+        self._feed: Optional[native.NativeDataFeed] = None
+
+    # -- reference-parity setters (dataset.py set_batch_size/set_thread/...)
+    def set_batch_size(self, batch_size: int) -> None:
+        self._batch_size = int(batch_size)
+
+    def set_thread(self, thread_num: int) -> None:
+        self._thread = int(thread_num)
+
+    def set_filelist(self, filelist: Sequence[str]) -> None:
+        self._filelist = list(filelist)
+
+    def set_queue_capacity(self, capacity: int) -> None:
+        self._queue_capacity = int(capacity)
+
+    def set_slots(self, slots: Sequence) -> None:
+        """Declare input slots, in file order.
+
+        Each slot: (name, kind, dim) tuple or dict with those keys plus
+        optional 'shape' to reshape dense slots (e.g. (1, 28, 28)).
+        This is the analogue of set_use_var (dataset.py): the reference
+        derives slots from program variables; here they are declared.
+        """
+        defs = []
+        for s in slots:
+            if isinstance(s, dict):
+                defs.append(_SlotDef(s["name"], s["kind"], int(s["dim"]),
+                                     tuple(s["shape"]) if s.get("shape")
+                                     else None))
+            else:
+                name, kind, dim = s
+                defs.append(_SlotDef(name, kind, int(dim)))
+        self._slots = defs
+
+    # alias for reference drop-in style
+    set_use_var = set_slots
+
+    def slot_names(self) -> List[str]:
+        return [s.name for s in self._slots]
+
+    # -- feed lifecycle
+    def _make_feed(self) -> native.NativeDataFeed:
+        if not self._slots:
+            raise ValueError("dataset has no slots; call set_slots first")
+        specs = [native.SlotSpec(s.name, s.kind, s.dim) for s in self._slots]
+        feed = native.NativeDataFeed(specs, batch_size=self._batch_size,
+                                     num_threads=self._thread,
+                                     queue_capacity=self._queue_capacity)
+        feed.set_files(self._filelist)
+        return feed
+
+    def _feed_or_make(self) -> native.NativeDataFeed:
+        if self._feed is None:
+            self._feed = self._make_feed()
+        return self._feed
+
+    def _postprocess(self, batch: Dict[str, np.ndarray]) \
+            -> Dict[str, np.ndarray]:
+        for s in self._slots:
+            if s.kind == "dense" and s.shape is not None:
+                b = batch[s.name]
+                batch[s.name] = b.reshape((b.shape[0],) + s.shape)
+        return batch
+
+    def release(self) -> None:
+        if self._feed is not None:
+            self._feed.close()
+            self._feed = None
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset: reader threads parse files straight into the
+    batch queue each epoch (ref: dataset.py QueueDataset; C++
+    MultiSlotDataFeed)."""
+
+    def __iter__(self):
+        feed = self._feed_or_make()
+        feed.set_files(self._filelist)
+        feed.start()
+        for batch in feed:
+            yield self._postprocess(batch)
+
+
+class InMemoryDataset(DatasetBase):
+    """Load-once dataset with local/global shuffle
+    (ref: dataset.py InMemoryDataset; data_set.h:157 LocalShuffle,
+    :111 GlobalShuffle)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._epoch = 0
+        self._shuffle_round = 0
+
+    def load_into_memory(self) -> int:
+        feed = self._feed_or_make()
+        feed.set_files(self._filelist)
+        return feed.load_into_memory()
+
+    def get_memory_data_size(self) -> int:
+        return self._feed_or_make().memory_size()
+
+    def local_shuffle(self, seed: Optional[int] = None) -> None:
+        if seed is None:
+            seed = self._shuffle_round
+        self._shuffle_round += 1
+        self._feed_or_make().local_shuffle(seed)
+
+    def global_shuffle(self, client: "native.ControlPlaneClient",
+                       rank: int, world: int,
+                       timeout_ms: int = 120000) -> int:
+        """Shuffle records across `world` workers through the control plane.
+
+        Every worker r: local-shuffles, splits its records into `world`
+        contiguous chunks, publishes chunk d under key gshuf/<round>/<r>-><d>,
+        barriers, then rebuilds its memory from all chunks destined to it.
+        Returns the new local record count. (Reference routes this through
+        FleetWrapper RPC: data_set.h:111; the capability is identical, the
+        transport is the TPU framework's control plane.)
+        """
+        feed = self._feed_or_make()
+        rnd = self._shuffle_round
+        self._shuffle_round += 1
+        feed.local_shuffle(seed=rnd * 1000003 + 17)
+        n = feed.memory_size()
+        bounds = [int(round(i * n / world)) for i in range(world + 1)]
+        for dst in range(world):
+            blob = feed.serialize_range(bounds[dst], bounds[dst + 1])
+            client.set(f"gshuf/{rnd}/{rank}->{dst}", blob)
+        client.barrier(f"gshuf/{rnd}/posted", world, timeout_ms)
+        feed.clear_memory()
+        total = 0
+        for src in range(world):
+            blob = client.get(f"gshuf/{rnd}/{src}->{rank}", block=True,
+                              timeout_ms=timeout_ms)
+            total += feed.deserialize_append(blob)
+        client.barrier(f"gshuf/{rnd}/done", world, timeout_ms)
+        feed.local_shuffle(seed=rnd * 7919 + rank)
+        return total
+
+    def release_memory(self) -> None:
+        self._feed_or_make().clear_memory()
+
+    def __iter__(self):
+        feed = self._feed_or_make()
+        feed.start_from_memory()
+        self._epoch += 1
+        for batch in feed:
+            yield self._postprocess(batch)
+
+
+class DatasetFactory:
+    """(ref: dataset.py DatasetFactory.create_dataset)."""
+
+    _KINDS = {"InMemoryDataset": InMemoryDataset,
+              "QueueDataset": QueueDataset}
+
+    def create_dataset(self, datafeed_class: str = "QueueDataset"):
+        if datafeed_class not in self._KINDS:
+            raise ValueError(
+                f"unknown dataset class {datafeed_class!r}; "
+                f"choose from {sorted(self._KINDS)}")
+        return self._KINDS[datafeed_class]()

@@ -150,6 +150,66 @@ class Executor:
             out = jax.tree.map(np.asarray, out)
         return out
 
+    def train_from_dataset(self, program, dataset,
+                           input_slots: Optional[Sequence[str]] = None,
+                           label_slots: Optional[Sequence[str]] = None,
+                           epochs: int = 1, drop_last: bool = True,
+                           print_period: int = 0,
+                           fetch_handler: Optional[Callable] = None):
+        """Drive a TrainStep from a file-backed Dataset
+        (ref: executor.py:1572 train_from_dataset → C++ Trainer loop
+        hogwild_worker.cc:191 TrainFiles; here the C++ data feed threads
+        produce batches and the hot loop is one donated-buffer XLA call).
+
+        - program: a TrainStep (or ShardedTrainStep) — the fused
+          train program.
+        - dataset: data.QueueDataset / data.InMemoryDataset with slots
+          declared; `input_slots`/`label_slots` name which slots feed the
+          model args vs the loss labels (default: all-but-last / last).
+        - drop_last: skip the final partial batch (avoids recompiling the
+          program for a second batch shape).
+        Returns per-epoch mean loss list.
+        """
+        names = dataset.slot_names()
+        if input_slots is None or label_slots is None:
+            input_slots = names[:-1]
+            label_slots = names[-1:]
+        history: List[float] = []
+        step_idx = 0
+        for _ in range(int(epochs)):
+            total, count = 0.0, 0
+            for batch in dataset:
+                rows = batch[names[0]].shape[0]
+                if drop_last and rows < dataset._batch_size:
+                    continue
+                args = tuple(batch[n] for n in input_slots)
+                labels = tuple(batch[n] for n in label_slots)
+                metrics = program(*args, labels=labels)
+                loss = float(metrics["loss"])
+                total += loss
+                count += 1
+                step_idx += 1
+                if print_period and step_idx % print_period == 0:
+                    print(f"step {step_idx}: loss={loss:.6f}")
+                if fetch_handler is not None:
+                    fetch_handler(metrics)
+            history.append(total / max(count, 1))
+        return history
+
+    def infer_from_dataset(self, program, dataset,
+                           input_slots: Optional[Sequence[str]] = None,
+                           drop_last: bool = False):
+        """Inference counterpart (ref: executor.py:1451): run a callable
+        program over every batch, return list of outputs."""
+        names = dataset.slot_names()
+        if input_slots is None:
+            input_slots = names
+        outs = []
+        for batch in dataset:
+            args = tuple(batch[n] for n in input_slots)
+            outs.append(program(*args))
+        return outs
+
 
 def _check_nan_inf(tree, what: str) -> None:
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
