@@ -166,14 +166,20 @@ def load_dygraph(path: str):
 
 def save_inference_model(dirname: str, model, example_args,
                          params: Optional[Dict[str, Any]] = None) -> None:
-    """Export a pruned serving function (ref: io.py save_inference_model).
-
-    Saves params + the jax export artifact of model.forward when possible;
-    always saves params so a Python-side reload can serve.
+    """Export a pruned serving artifact (ref: io.py save_inference_model:52
+    — saves the feed/fetch-pruned ProgramDesc + persistables; here the
+    pruned program is a serialized jax.export StableHLO module of the eval
+    forward, via paddle_tpu.jit.save).
     """
     from ..nn.layer import Layer
+    from .. import jit as jit_mod
     if isinstance(model, Layer):
-        params = params if params is not None else model.state_dict()
+        spec = [jit_mod.InputSpec(tuple(np.asarray(a).shape),
+                                  str(np.asarray(a).dtype))
+                for a in example_args]
+        jit_mod.save(model, dirname, input_spec=spec)
+        return
+    # non-Layer fallback: params-only blob for Python-side reload
     save(params or {}, os.path.join(dirname, "params"))
     meta = {"format": "paddle_tpu_inference", "version": _VERSION}
     with open(os.path.join(dirname, "inference.json"), "w") as f:
@@ -181,6 +187,15 @@ def save_inference_model(dirname: str, model, example_args,
 
 
 def load_inference_model(dirname: str, model=None):
+    from .. import jit as jit_mod
+    if os.path.exists(os.path.join(dirname, "module.bin")):
+        translated = jit_mod.load(dirname)
+        if model is not None:
+            model.set_state_dict(
+                {k.replace("/", "."): v
+                 for k, v in translated._params.items()}, strict=False)
+            return model
+        return translated
     params = load(os.path.join(dirname, "params"))
     if model is not None:
         model.set_state_dict({k.replace("/", "."): v

@@ -1,0 +1,247 @@
+"""jit: dygraph→static capture, traced layers, and serialized inference.
+
+TPU-native rebuild of the reference's dygraph-to-static stack
+(/root/reference/python/paddle/fluid/dygraph/jit.py: @declarative/
+TracedLayer/jit.save+load; dygraph_to_static/program_translator.py). The
+reference transpiles Python ASTs into ProgramDesc ops; on TPU **tracing is
+compilation** — jax traces the function once into a jaxpr and XLA compiles
+it, so:
+
+- ``to_static(fn)``    → a :class:`StaticFunction`: cached jax.jit over the
+  eager code (AST transpiling collapses into tracing; data-dependent
+  control flow must use lax.cond/scan, matching the reference's
+  while_op/conditional_block constraint).
+- ``TracedLayer.trace``→ capture a Layer + example inputs into a frozen
+  (params, compiled-fn) pair for deployment.
+- ``jit.save/load``    → portable artifacts: parameters + a serialized
+  ``jax.export`` StableHLO module (versioned, runnable without the model's
+  Python class — the analogue of save_inference_model's pruned
+  ProgramDesc, io.py:52).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import io as io_mod
+from .nn.layer import Layer, functional_call
+
+__all__ = ["to_static", "not_to_static", "StaticFunction", "TracedLayer",
+           "save", "load", "TranslatedLayer", "InputSpec"]
+
+
+class InputSpec:
+    """Declarative input signature (ref: static/input.py InputSpec).
+
+    None leading dims mark symbolic batch: export uses jax shape
+    polymorphism so any batch size can be served.
+    """
+
+    def __init__(self, shape: Sequence[Optional[int]], dtype="float32",
+                 name: Optional[str] = None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def to_sds(self, symbol: str = "b") -> jax.ShapeDtypeStruct:
+        from .core.dtype import convert_dtype
+        if any(s is None for s in self.shape):
+            dims = ",".join(symbol if s is None else str(s)
+                            for s in self.shape)
+            shp = jax.export.symbolic_shape(f"({dims})")
+        else:
+            shp = self.shape
+        return jax.ShapeDtypeStruct(shp, convert_dtype(self.dtype))
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+class StaticFunction:
+    """A callable captured for compilation (ref: jit.py @declarative →
+    StaticFunction in dygraph_to_static/program_translator.py)."""
+
+    def __init__(self, fn: Callable, input_spec=None) -> None:
+        self._fn = fn
+        self._input_spec = input_spec
+        self._jitted = jax.jit(fn)
+        self.__wrapped__ = fn
+
+    def __call__(self, *args, **kwargs):
+        return self._jitted(*args, **kwargs)
+
+    @property
+    def concrete_program(self):
+        """Trace with the declared input_spec and return the jaxpr — the
+        analogue of inspecting the generated ProgramDesc."""
+        if self._input_spec is None:
+            raise ValueError("concrete_program needs input_spec")
+        sds = [s.to_sds() if isinstance(s, InputSpec) else s
+               for s in self._input_spec]
+        return jax.make_jaxpr(self._fn)(*sds)
+
+    def rollback(self) -> Callable:
+        """Return the original eager function (ref: jit.py rollback)."""
+        return self._fn
+
+
+def to_static(function=None, input_spec=None):
+    """Decorator/wrapper marking a function or Layer for compilation
+    (ref: @fluid.dygraph.jit.declarative, jit.py)."""
+
+    def wrap(fn):
+        if isinstance(fn, Layer):
+            layer = fn
+
+            def call(*args, **kwargs):
+                return layer(*args, **kwargs)
+
+            sf = StaticFunction(call, input_spec)
+            sf.layer = layer
+            return sf
+        return StaticFunction(fn, input_spec)
+
+    if function is None:
+        return wrap
+    return wrap(function)
+
+
+def not_to_static(fn: Callable) -> Callable:
+    """Marker parity shim (ref: jit.not_to_static): returns fn unchanged —
+    in the tracing design only explicitly wrapped functions compile."""
+    fn.__pt_not_to_static__ = True
+    return fn
+
+
+class TracedLayer:
+    """Frozen (params, compiled forward) capture of a Layer
+    (ref: jit.py TracedLayer.trace/save_inference_model)."""
+
+    def __init__(self, layer: Layer, params: Dict[str, Any],
+                 buffers: Dict[str, Any], example_args: Tuple) -> None:
+        self._layer = layer
+        self._params = params
+        self._buffers = buffers
+        self._example_args = example_args
+
+        def fwd(params, buffers, *args):
+            was_training = layer.training
+            layer.eval()
+            try:
+                return functional_call(layer, params, buffers, *args)
+            finally:
+                if was_training:
+                    layer.train()
+
+        self._fwd = fwd
+        self._jitted = jax.jit(fwd)
+
+    @staticmethod
+    def trace(layer: Layer, inputs: Sequence) -> Tuple[Any, "TracedLayer"]:
+        inputs = tuple(jnp.asarray(np.asarray(x)) for x in inputs)
+        traced = TracedLayer(layer, layer.param_dict(), layer.buffer_dict(),
+                             inputs)
+        out = traced(*inputs)
+        return out, traced
+
+    def __call__(self, *args):
+        return self._jitted(self._params, self._buffers, *args)
+
+    def save_inference_model(self, dirname: str) -> None:
+        save(self._layer, dirname,
+             input_spec=[InputSpec(x.shape, str(x.dtype))
+                         for x in self._example_args])
+
+
+def save(layer, path: str, input_spec: Optional[Sequence] = None) -> None:
+    """Serialize a Layer (or StaticFunction over one) for serving
+    (ref: jit.py save → TranslatedLayer; io.py save_inference_model:52).
+
+    Writes under ``path``:
+      - ``params/``      parameter+buffer checkpoint
+      - ``module.bin``   jax.export StableHLO artifact of the eval forward
+      - ``meta.json``    input specs + platforms
+    """
+    if isinstance(layer, StaticFunction):
+        if not hasattr(layer, "layer"):
+            raise ValueError("jit.save needs a Layer or to_static(Layer)")
+        layer = layer.layer
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (shapes may use "
+                         "None for a polymorphic batch dim)")
+    specs = [s if isinstance(s, InputSpec) else InputSpec(*s)
+             for s in input_spec]
+    params = layer.param_dict()
+    buffers = layer.buffer_dict()
+
+    def serving(params, buffers, *args):
+        was_training = layer.training
+        layer.eval()
+        try:
+            return functional_call(layer, params, buffers, *args)
+        finally:
+            if was_training:
+                layer.train()
+
+    sds = [s.to_sds() for s in specs]
+    p_sds = jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                         params)
+    b_sds = jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                         buffers)
+    exported = jax.export.export(jax.jit(serving))(p_sds, b_sds, *sds)
+
+    os.makedirs(path, exist_ok=True)
+    io_mod.save({"params": params, "buffers": buffers},
+                os.path.join(path, "params"))
+    with open(os.path.join(path, "module.bin"), "wb") as f:
+        f.write(exported.serialize())
+    meta = {
+        "format": "paddle_tpu_jit", "version": 1,
+        "platforms": list(exported.platforms),
+        "input_spec": [{"shape": [None if s is None else int(s)
+                                  for s in sp.shape],
+                        "dtype": str(sp.dtype)} for sp in specs],
+    }
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+
+
+class TranslatedLayer:
+    """A loaded serving module (ref: jit.py TranslatedLayer): runs the
+    deserialized StableHLO with the stored weights — no Python model class
+    required."""
+
+    def __init__(self, path: str) -> None:
+        with open(os.path.join(path, "meta.json")) as f:
+            self.meta = json.load(f)
+        if self.meta.get("format") != "paddle_tpu_jit":
+            raise ValueError(f"{path} is not a paddle_tpu jit artifact")
+        with open(os.path.join(path, "module.bin"), "rb") as f:
+            self._exported = jax.export.deserialize(f.read())
+        flat = io_mod.load(os.path.join(path, "params"))
+        # io.load flattens pytrees to "/"-joined keys; param/buffer names
+        # are the dotted layer paths after the first segment
+        self._params = {k.split("/", 1)[1]: v for k, v in flat.items()
+                        if k.startswith("params/")}
+        self._buffers = {k.split("/", 1)[1]: v for k, v in flat.items()
+                         if k.startswith("buffers/")}
+
+    def __call__(self, *args):
+        args = tuple(jnp.asarray(np.asarray(a)) for a in args)
+        return self._exported.call(self._params, self._buffers, *args)
+
+    @property
+    def input_spec(self):
+        return [InputSpec(tuple(s["shape"]), s["dtype"])
+                for s in self.meta["input_spec"]]
+
+
+def load(path: str) -> TranslatedLayer:
+    """(ref: jit.py load)."""
+    return TranslatedLayer(path)

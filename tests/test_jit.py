@@ -1,0 +1,122 @@
+"""jit module tests (ref surface: dygraph/jit.py declarative/TracedLayer/
+save/load; tests modeled on test_jit_save_load.py patterns)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import jit
+from paddle_tpu.nn import Linear
+
+
+def _mlp():
+    pt.seed(0)
+    return pt.nn.Sequential(Linear(8, 16), pt.nn.ReLU(), Linear(16, 4))
+
+
+def test_to_static_function():
+    @jit.to_static
+    def f(x, y):
+        return pt.matmul(x, y) + 1.0
+
+    a = np.ones((2, 3), np.float32)
+    b = np.ones((3, 4), np.float32)
+    out = f(a, b)
+    np.testing.assert_allclose(np.asarray(out), np.full((2, 4), 4.0))
+    assert callable(f.rollback())
+
+
+def test_to_static_layer_matches_eager():
+    net = _mlp()
+    sf = jit.to_static(net)
+    x = np.random.default_rng(0).normal(0, 1, (4, 8)).astype(np.float32)
+    eager = np.asarray(net(pt.to_tensor(x)))
+    static = np.asarray(sf(pt.to_tensor(x)))
+    np.testing.assert_allclose(eager, static, rtol=1e-6)
+
+
+def test_concrete_program_jaxpr():
+    spec = [jit.InputSpec([2, 8])]
+
+    @jit.to_static(input_spec=spec)
+    def f(x):
+        return x * 2.0
+
+    jaxpr = f.concrete_program
+    assert "mul" in str(jaxpr)
+
+
+def test_traced_layer_roundtrip(tmp_path):
+    net = _mlp()
+    x = np.random.default_rng(1).normal(0, 1, (4, 8)).astype(np.float32)
+    out, traced = jit.TracedLayer.trace(net, [x])
+    np.testing.assert_allclose(np.asarray(traced(pt.to_tensor(x))),
+                               np.asarray(out), rtol=1e-6)
+    # trace froze params: mutating the layer afterwards must not change it
+    before = np.asarray(traced(pt.to_tensor(x)))
+    for p in net.parameters():
+        p.set_value(np.zeros_like(p.numpy()))
+    np.testing.assert_allclose(np.asarray(traced(pt.to_tensor(x))), before)
+
+
+def test_jit_save_load_fixed_shape(tmp_path):
+    net = _mlp()
+    x = np.random.default_rng(2).normal(0, 1, (4, 8)).astype(np.float32)
+    expected = np.asarray(net(pt.to_tensor(x)))
+    d = os.path.join(str(tmp_path), "saved")
+    jit.save(net, d, input_spec=[jit.InputSpec([4, 8])])
+    assert os.path.exists(os.path.join(d, "module.bin"))
+    loaded = jit.load(d)
+    np.testing.assert_allclose(np.asarray(loaded(x)), expected, rtol=1e-5)
+
+
+def test_jit_save_load_polymorphic_batch(tmp_path):
+    net = _mlp()
+    d = os.path.join(str(tmp_path), "saved_poly")
+    jit.save(net, d, input_spec=[jit.InputSpec([None, 8])])
+    loaded = jit.load(d)
+    for bs in (1, 3, 16):
+        x = np.ones((bs, 8), np.float32)
+        expected = np.asarray(net(pt.to_tensor(x)))
+        np.testing.assert_allclose(np.asarray(loaded(x)), expected,
+                                   rtol=1e-5)
+    assert loaded.input_spec[0].shape[0] is None
+
+
+def test_jit_save_requires_spec():
+    with pytest.raises(ValueError):
+        jit.save(_mlp(), "/tmp/nope")
+
+
+def test_load_rejects_non_artifact(tmp_path):
+    d = str(tmp_path)
+    with open(os.path.join(d, "meta.json"), "w") as f:
+        f.write("{}")
+    with pytest.raises(ValueError):
+        jit.load(d)
+
+
+def test_save_inference_model_via_traced_layer(tmp_path):
+    net = _mlp()
+    x = np.random.default_rng(3).normal(0, 1, (2, 8)).astype(np.float32)
+    out, traced = jit.TracedLayer.trace(net, [x])
+    d = os.path.join(str(tmp_path), "infer")
+    traced.save_inference_model(d)
+    loaded = jit.load(d)
+    np.testing.assert_allclose(np.asarray(loaded(x)), np.asarray(out),
+                               rtol=1e-5)
+
+
+def test_dropout_layer_exports_in_eval_mode(tmp_path):
+    pt.seed(0)
+    net = pt.nn.Sequential(Linear(8, 8), pt.nn.Dropout(0.5))
+    net.train()
+    d = os.path.join(str(tmp_path), "dropout")
+    jit.save(net, d, input_spec=[jit.InputSpec([2, 8])])
+    loaded = jit.load(d)
+    x = np.ones((2, 8), np.float32)
+    a = np.asarray(loaded(x))
+    b = np.asarray(loaded(x))
+    np.testing.assert_allclose(a, b)  # eval mode: deterministic
