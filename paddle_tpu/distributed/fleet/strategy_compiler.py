@@ -41,6 +41,21 @@ def apply_strategy(strategy, model: Layer, optimizer: Optimizer,
         else:
             mesh = data_parallel_mesh()
 
+    # dgc / localsgd replace the whole step structure (they change how
+    # gradients cross replicas), so they take precedence and compose only
+    # with optimizer substitution
+    if strategy.dgc:
+        from ...parallel.dgc import DGCTrainStep
+        return DGCTrainStep(
+            model, optimizer, loss_fn, mesh,
+            sparsity=strategy.dgc_configs.sparsity,
+            rampup_steps=strategy.dgc_configs.rampup_begin_step, seed=seed)
+    if strategy.localsgd:
+        from ...parallel.localsgd import LocalSGDStep
+        return LocalSGDStep(
+            model, optimizer, loss_fn, mesh,
+            k_steps=strategy.localsgd_configs.k_steps, seed=seed)
+
     # lars/lamb: optimizer substitution (ref: lars/lamb meta-optimizers)
     if strategy.lamb and not isinstance(optimizer, Lamb):
         optimizer = Lamb(learning_rate=optimizer.learning_rate)
@@ -64,13 +79,14 @@ def apply_strategy(strategy, model: Layer, optimizer: Optimizer,
         if strategy.gradient_merge else 1
     local_k = strategy.localsgd_configs.k_steps if strategy.localsgd else 1
 
+    zero_stage = strategy.sharding_configs.stage if strategy.sharding else 0
     step = _ComposedTrainStep(
         model, optimizer, loss_fn, mesh, batch_spec=batch_spec,
         param_rule=param_rule, seed=seed,
         remat_policy=model_call,
         grad_accum_steps=k_steps,
         grad_accum_avg=strategy.gradient_merge_configs.avg,
-        localsgd_k=local_k)
+        localsgd_k=local_k, zero_stage=zero_stage)
     return step
 
 
@@ -80,14 +96,16 @@ class _ComposedTrainStep(ShardedTrainStep):
     def __init__(self, model, optimizer, loss_fn, mesh, batch_spec=P("dp"),
                  param_rule=None, seed: int = 0, remat_policy=None,
                  grad_accum_steps: int = 1, grad_accum_avg: bool = True,
-                 localsgd_k: int = 1, extra_metrics=None) -> None:
+                 localsgd_k: int = 1, zero_stage: int = 0,
+                 extra_metrics=None) -> None:
         self.remat_policy = remat_policy
         self.grad_accum_steps = grad_accum_steps
         self.grad_accum_avg = grad_accum_avg
         self.localsgd_k = localsgd_k
         super().__init__(model, optimizer, loss_fn, mesh,
                          batch_spec=batch_spec, param_rule=param_rule,
-                         seed=seed, extra_metrics=extra_metrics)
+                         seed=seed, extra_metrics=extra_metrics,
+                         zero_stage=zero_stage)
 
     def _loss_and_buffers(self, params, buffers, args, labels, key):
         from ...core import random as _random

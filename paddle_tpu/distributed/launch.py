@@ -1,0 +1,111 @@
+"""Multi-process launcher with failure watch + control-plane bootstrap.
+
+TPU-native rebuild of the reference's launcher
+(/root/reference/python/paddle/distributed/launch.py:193 launch —
+spawns one process per device with PADDLE_TRAINER_ID/ENDPOINTS env;
+utils.py:252 terminate_local_procs + the watch loop launch.py:219 that
+tears the job down when any child dies). Differences by design:
+
+- On TPU one process typically drives a whole host's chips, so `nproc`
+  defaults to 1 per host; multi-process is for multi-host emulation and
+  CPU-mesh tests.
+- Rank 0's process environment hosts the native control-plane server
+  (csrc/control_plane.cc) and its address rides PT_CP_ENDPOINT — children
+  rendezvous through it (the reference exchanges ncclUniqueId through a
+  bespoke gRPC server, c_gen_nccl_id_op.cc:49).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["launch_procs", "terminate_local_procs", "get_cluster_env"]
+
+
+def get_cluster_env(rank: int, world: int, cp_endpoint: str) \
+        -> Dict[str, str]:
+    """Env block for one trainer process (reference names kept for
+    drop-in parity + PT_* spellings)."""
+    return {
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PT_TRAINER_ID": str(rank),
+        "PT_TRAINERS_NUM": str(world),
+        "PT_CP_ENDPOINT": cp_endpoint,
+    }
+
+
+def terminate_local_procs(procs: Sequence[subprocess.Popen],
+                          grace_s: float = 5.0) -> None:
+    """(ref: distributed/utils.py:252)."""
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.time() + grace_s
+    for p in procs:
+        while p.poll() is None and time.time() < deadline:
+            time.sleep(0.1)
+        if p.poll() is None:
+            p.kill()
+
+
+def launch_procs(cmd: Sequence[str], nproc: int,
+                 env_extra: Optional[Dict[str, str]] = None,
+                 start_control_plane: bool = True,
+                 poll_interval: float = 0.5) -> int:
+    """Spawn `nproc` copies of cmd with rank env; watch until all exit.
+
+    Any child failing tears the whole job down (reference watch loop
+    launch.py:219-226). Returns the first nonzero exit code, or 0.
+    """
+    server = None
+    cp_endpoint = ""
+    if start_control_plane:
+        from .. import native
+        server = native.ControlPlaneServer()
+        cp_endpoint = f"127.0.0.1:{server.port}"
+    procs: List[subprocess.Popen] = []
+    try:
+        for rank in range(nproc):
+            env = dict(os.environ)
+            env.update(get_cluster_env(rank, nproc, cp_endpoint))
+            if env_extra:
+                env.update(env_extra)
+            procs.append(subprocess.Popen(list(cmd), env=env))
+        exit_code = 0
+        while True:
+            states = [p.poll() for p in procs]
+            if any(s not in (None, 0) for s in states):
+                exit_code = next(s for s in states if s not in (None, 0))
+                terminate_local_procs(procs)
+                break
+            if all(s == 0 for s in states):
+                break
+            time.sleep(poll_interval)
+        return exit_code
+    finally:
+        terminate_local_procs(procs)
+        if server is not None:
+            server.stop()
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI: python -m paddle_tpu.distributed.launch --nproc N script.py
+    args... (ref: python -m paddle.distributed.launch)."""
+    import argparse
+    parser = argparse.ArgumentParser(prog="paddle_tpu.distributed.launch")
+    parser.add_argument("--nproc", type=int, default=1)
+    parser.add_argument("script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    cmd = [sys.executable, args.script] + list(args.script_args)
+    return launch_procs(cmd, args.nproc)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,3 +15,5 @@ from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env
 from .mesh import (batch_sharding, create_mesh, data_parallel_mesh,
                    named_sharding, replicated)
 from .spmd import ShardedTrainStep, make_param_specs, megatron_param_rule
+from .localsgd import LocalSGDStep  # noqa: E402,F401
+from .dgc import DGCTrainStep, dgc_allreduce, topk_sparsify  # noqa: E402,F401

@@ -1,0 +1,257 @@
+"""Advanced fleet strategies on the virtual 8-device mesh: ZeRO sharding,
+LocalSGD, DGC compressed allreduce, elastic auto-checkpoint, launcher.
+
+Reference test models: localsgd/dgc/sharding meta-optimizer tests under
+/root/reference/python/paddle/fluid/tests/unittests/ (test_fleet_*
+_meta_optimizer.py) assert the rewritten program contains the strategy's
+ops; here we assert the *behavior* (convergence / divergence-resync /
+compression numerics) since there is no op list to inspect.
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.strategy_compiler import apply_strategy
+from paddle_tpu.parallel import (DGCTrainStep, LocalSGDStep, ShardedTrainStep,
+                                 create_mesh, data_parallel_mesh,
+                                 dgc_allreduce, topk_sparsify)
+
+
+def _toy_data(n=64, din=16, dout=4, seed=0):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(0, 1, (din, dout)).astype(np.float32)
+    x = rng.normal(0, 1, (n, din)).astype(np.float32)
+    y = (x @ w + 0.01 * rng.normal(0, 1, (n, dout))).astype(np.float32)
+    return x, y
+
+
+def _mse(out, y):
+    return pt.nn.functional.mse_loss(out, y)
+
+
+class TestZeroSharding:
+    @pytest.mark.parametrize("stage", [1, 3])
+    def test_zero_shards_state_and_converges(self, stage):
+        mesh = data_parallel_mesh()
+        pt.seed(0)
+        model = pt.nn.Linear(16, 8)
+        step = ShardedTrainStep(model, pt.optimizer.Adam(learning_rate=0.05),
+                                _mse, mesh, zero_stage=stage)
+        # optimizer slots must actually be sharded over dp
+        slot_specs = step.state_specs["opt"]["slots"]
+        flat = [s for s in jax.tree.leaves(
+            slot_specs, is_leaf=lambda x: hasattr(x, "index"))]
+        assert any("dp" in str(s) for s in flat), slot_specs
+        if stage >= 3:
+            assert any("dp" in str(s)
+                       for s in step.state_specs["params"].values())
+        x, y = _toy_data(n=64, din=16, dout=8)
+        losses = [float(step(x, labels=(y,))["loss"]) for _ in range(60)]
+        assert losses[-1] < 0.05 * losses[0], (losses[0], losses[-1])
+
+    def test_zero_matches_plain_dp(self):
+        """ZeRO is a memory layout, not an algorithm change: same losses."""
+        x, y = _toy_data(n=32, din=8, dout=4, seed=1)
+        results = []
+        for stage in (0, 1):
+            mesh = data_parallel_mesh()
+            pt.seed(7)
+            model = pt.nn.Linear(8, 4)
+            step = ShardedTrainStep(
+                model, pt.optimizer.Adam(learning_rate=0.1), _mse, mesh,
+                zero_stage=stage)
+            results.append([float(step(x, labels=(y,))["loss"])
+                            for _ in range(5)])
+        np.testing.assert_allclose(results[0], results[1], rtol=1e-4)
+
+
+class TestLocalSGD:
+    def test_divergence_and_resync(self):
+        mesh = data_parallel_mesh()
+        pt.seed(0)
+        model = pt.nn.Linear(8, 4)
+        step = LocalSGDStep(model, pt.optimizer.Momentum(learning_rate=0.05,
+                                                         momentum=0.9),
+                            _mse, mesh, k_steps=4)
+        x, y = _toy_data(n=64, din=8, dout=4)
+        # replicas see different shards -> params diverge between syncs
+        step(x, labels=(y,))
+        assert step.replica_divergence() > 0
+        step(x, labels=(y,))
+        step(x, labels=(y,))
+        step(x, labels=(y,))  # 4th call -> sync
+        assert step.replica_divergence() < 1e-6
+
+    def test_converges(self):
+        mesh = data_parallel_mesh()
+        pt.seed(0)
+        model = pt.nn.Linear(16, 4)
+        step = LocalSGDStep(model, pt.optimizer.Adam(learning_rate=0.05),
+                            _mse, mesh, k_steps=2)
+        x, y = _toy_data(n=64, din=16, dout=4)
+        losses = [float(step(x, labels=(y,))["loss"]) for _ in range(60)]
+        assert losses[-1] < 0.05 * losses[0]
+
+
+class TestDGC:
+    def test_topk_sparsify(self):
+        g = jnp.asarray(np.array([0.1, -5.0, 0.2, 3.0, -0.05],
+                                 np.float32))
+        vals, idx, residual = topk_sparsify(g, 2)
+        assert set(np.asarray(idx).tolist()) == {1, 3}
+        np.testing.assert_allclose(np.sort(np.abs(np.asarray(vals))),
+                                   [3.0, 5.0])
+        # residual keeps exactly the dropped mass
+        np.testing.assert_allclose(np.asarray(residual),
+                                   [0.1, 0.0, 0.2, 0.0, -0.05], atol=1e-7)
+
+    def test_error_feedback_preserves_gradient_mass(self):
+        """Over many steps of a constant gradient, compressed updates with
+        error feedback must deliver the full gradient on average."""
+        mesh = data_parallel_mesh()
+        from jax.sharding import PartitionSpec as P
+
+        g_const = jnp.asarray(
+            np.random.default_rng(0).normal(0, 1, (8, 32)).astype(
+                np.float32))
+
+        def run(carry, _):
+            res = carry
+
+            def inner(r):
+                out, new_r = dgc_allreduce(g_const, r, "dp", sparsity=0.9)
+                return out, new_r
+
+            out, new_res = jax.shard_map(
+                inner, mesh=mesh, in_specs=(P(),), out_specs=(P(), P()),
+                check_vma=False)(res)
+            return new_res, out
+
+        res0 = jnp.zeros_like(g_const)
+        with mesh:
+            final_res, outs = jax.lax.scan(run, res0, None, length=20)
+        total_delivered = jnp.sum(outs, axis=0) + final_res
+        np.testing.assert_allclose(np.asarray(total_delivered),
+                                   np.asarray(g_const) * 20, rtol=1e-3)
+
+    def test_dgc_step_converges(self):
+        mesh = data_parallel_mesh()
+        pt.seed(0)
+        model = pt.nn.Linear(16, 4)
+        step = DGCTrainStep(model, pt.optimizer.Momentum(
+            learning_rate=0.05, momentum=0.9), _mse, mesh, sparsity=0.75)
+        x, y = _toy_data(n=64, din=16, dout=4)
+        losses = [float(step(x, labels=(y,))["loss"]) for _ in range(80)]
+        assert losses[-1] < 0.1 * losses[0], (losses[0], losses[-1])
+
+
+class TestStrategyCompilerRouting:
+    def test_dgc_routes_to_dgc_step(self):
+        s = fleet.DistributedStrategy()
+        s.dgc = True
+        pt.seed(0)
+        step = apply_strategy(s, pt.nn.Linear(8, 4),
+                              pt.optimizer.Momentum(learning_rate=0.01,
+                                                    momentum=0.9), _mse)
+        assert isinstance(step, DGCTrainStep)
+
+    def test_localsgd_routes(self):
+        s = fleet.DistributedStrategy()
+        s.localsgd = True
+        s.localsgd_configs.k_steps = 3
+        pt.seed(0)
+        step = apply_strategy(s, pt.nn.Linear(8, 4),
+                              pt.optimizer.SGD(learning_rate=0.01), _mse)
+        assert isinstance(step, LocalSGDStep) and step.k_steps == 3
+
+    def test_sharding_routes_to_zero(self):
+        s = fleet.DistributedStrategy()
+        s.sharding = True
+        s.sharding_configs.stage = 1
+        pt.seed(0)
+        step = apply_strategy(s, pt.nn.Linear(8, 8),
+                              pt.optimizer.Adam(learning_rate=0.01), _mse)
+        slot_specs = step.state_specs["opt"]["slots"]
+        assert any("dp" in str(sp) for sp in jax.tree.leaves(
+            slot_specs, is_leaf=lambda x: hasattr(x, "index")))
+
+
+class TestAutoCheckpoint:
+    def test_epoch_resume(self, tmp_path):
+        from paddle_tpu.incubate.auto_checkpoint import TrainEpochRange
+        d = str(tmp_path)
+        seen = []
+        r1 = TrainEpochRange(max_epoch=5, save_dir=d, name="job")
+        counter = {"steps": 0}
+        r1.register("ctr", lambda: {"steps": np.int64(counter["steps"])},
+                    lambda s: counter.update(steps=int(s["steps"])))
+        for epoch in r1:
+            counter["steps"] += 10
+            seen.append(epoch)
+            if epoch == 2:
+                break  # simulated crash after saving epochs 0,1 (+2 saved
+                # only if loop completes its body — epoch 2 not saved)
+        assert seen == [0, 1, 2]
+        r1._ckpt.wait()
+
+        # "restarted job": resumes from last completed save (epoch 2 state)
+        counter2 = {"steps": -1}
+        r2 = TrainEpochRange(max_epoch=5, save_dir=d, name="job")
+        r2.register("ctr", lambda: {"steps": np.int64(counter2["steps"])},
+                    lambda s: counter2.update(steps=int(s["steps"])))
+        assert r2.restored
+        assert counter2["steps"] == 20  # epochs 0,1 completed+saved
+        remaining = list(r2)
+        assert remaining == [2, 3, 4]
+
+    def test_requires_dir(self):
+        from paddle_tpu.incubate.auto_checkpoint import TrainEpochRange
+        os.environ.pop("PT_CHECKPOINT_DIR", None)
+        with pytest.raises(ValueError):
+            TrainEpochRange(max_epoch=1)
+
+
+class TestLauncher:
+    def test_launch_two_ranks_rendezvous(self, tmp_path):
+        """Two real processes rendezvous through the control plane
+        (reference pattern: test_dist_base.py loopback subprocesses)."""
+        from paddle_tpu.distributed.launch import launch_procs
+        script = os.path.join(str(tmp_path), "worker.py")
+        out = os.path.join(str(tmp_path), "out")
+        with open(script, "w") as f:
+            f.write(f"""
+import os, sys
+sys.path.insert(0, "/root/repo")
+from paddle_tpu import native
+rank = int(os.environ["PT_TRAINER_ID"])
+world = int(os.environ["PT_TRAINERS_NUM"])
+host, port = os.environ["PT_CP_ENDPOINT"].split(":")
+c = native.ControlPlaneClient(host, int(port))
+c.set(f"hello/{{rank}}", str(rank).encode())
+c.barrier("ready", world, 20000)
+peers = sorted(int(c.get(f"hello/{{r}}")) for r in range(world))
+assert peers == list(range(world)), peers
+with open(r"{out}" + f"-{{rank}}", "w") as fh:
+    fh.write("ok")
+""")
+        rc = launch_procs([sys.executable, script], nproc=2)
+        assert rc == 0
+        for r in range(2):
+            assert os.path.exists(f"{out}-{r}")
+
+    def test_failed_child_propagates(self, tmp_path):
+        from paddle_tpu.distributed.launch import launch_procs
+        script = os.path.join(str(tmp_path), "boom.py")
+        with open(script, "w") as f:
+            f.write("import sys; sys.exit(3)\n")
+        rc = launch_procs([sys.executable, script], nproc=2,
+                          start_control_plane=False)
+        assert rc == 3
