@@ -12,9 +12,9 @@ random_ops, sequence (ragged/LoD analogue), control_flow, sparse
 (SelectedRows analogue), metrics_ops.
 """
 
-from . import (activation, control_flow, loss, manipulation, math,
-               metrics_ops, nn_functional, random_ops, reduction, search,
-               sequence, sparse)
+from . import (activation, control_flow, detection, loss, manipulation,
+               math, metrics_ops, nn_functional, random_ops, reduction,
+               search, sequence, sparse)
 
 from .activation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403

@@ -1,0 +1,509 @@
+"""CV detection operators.
+
+TPU-native rebuild of the reference's detection op family
+(/root/reference/paddle/fluid/operators/detection/ — 17.1k LoC CUDA/C++:
+iou_similarity_op, box_coder_op, prior_box_op, density_prior_box_op,
+anchor_generator_op, yolo_box_op, multiclass_nms_op, roi_align_op,
+roi_pool_op, box_clip_op, bipartite_match_op; python surface
+fluid/layers/detection.py). Design notes for XLA:
+
+- Everything is **static-shape**: NMS returns fixed `max_out` slots with a
+  validity mask instead of the reference's variable-length LoD output
+  (LoDTensor has no XLA analogue — SURVEY.md §7 "Hard parts").
+- NMS is the classic O(max_out·N) iterative suppression as a fori_loop —
+  each iteration is a max-reduce + IoU row, which XLA fuses well.
+- roi_align/roi_pool vectorize the bilinear/max sampling over a
+  (rois × H_out × W_out × samples) grid with gather, no scalar loops.
+
+Boxes are [x1, y1, x2, y2] unless noted, matching the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "iou_similarity", "box_area", "box_coder", "box_clip", "prior_box",
+    "density_prior_box", "anchor_generator", "yolo_box", "nms",
+    "multiclass_nms", "roi_align", "roi_pool", "bipartite_match",
+    "distribute_fpn_proposals", "generate_proposals",
+]
+
+
+def box_area(boxes):
+    """Area of [N,4] boxes."""
+    return jnp.maximum(boxes[..., 2] - boxes[..., 0], 0) * \
+        jnp.maximum(boxes[..., 3] - boxes[..., 1], 0)
+
+
+def iou_similarity(x, y, box_normalized: bool = True):
+    """Pairwise IoU [N,M] (ref: detection/iou_similarity_op.h)."""
+    off = 0.0 if box_normalized else 1.0
+    x = x.astype(jnp.float32)
+    y = y.astype(jnp.float32)
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:4], y[None, :, 2:4])
+    wh = jnp.maximum(rb - lt + off, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_x = (x[:, 2] - x[:, 0] + off) * (x[:, 3] - x[:, 1] + off)
+    area_y = (y[:, 2] - y[:, 0] + off) * (y[:, 3] - y[:, 1] + off)
+    union = area_x[:, None] + area_y[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type: str = "encode_center_size",
+              box_normalized: bool = True):
+    """Encode/decode boxes against priors (ref: detection/box_coder_op.h).
+
+    encode_center_size: target [M,4] boxes → offsets [M,N,4] vs N priors.
+    decode_center_size: target [M,N,4] (or [M,4] w/ N==M) offsets → boxes.
+    """
+    off = 0.0 if box_normalized else 1.0
+    pb = prior_box.astype(jnp.float32)
+    pw = pb[:, 2] - pb[:, 0] + off
+    ph = pb[:, 3] - pb[:, 1] + off
+    pcx = pb[:, 0] + 0.5 * pw
+    pcy = pb[:, 1] + 0.5 * ph
+    if prior_box_var is None:
+        var = jnp.ones((pb.shape[0], 4), jnp.float32)
+    elif prior_box_var.ndim == 1:
+        var = jnp.broadcast_to(prior_box_var, (pb.shape[0], 4))
+    else:
+        var = prior_box_var
+    t = target_box.astype(jnp.float32)
+    if code_type == "encode_center_size":
+        tw = t[:, 2] - t[:, 0] + off
+        th = t[:, 3] - t[:, 1] + off
+        tcx = t[:, 0] + 0.5 * tw
+        tcy = t[:, 1] + 0.5 * th
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(jnp.maximum(tw[:, None] / pw[None, :], 1e-10))
+        dh = jnp.log(jnp.maximum(th[:, None] / ph[None, :], 1e-10))
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        return out / var[None, :, :]
+    elif code_type == "decode_center_size":
+        if t.ndim == 2:
+            t = t[:, None, :]
+        d = t * var[None, :, :]
+        cx = d[..., 0] * pw[None, :] + pcx[None, :]
+        cy = d[..., 1] * ph[None, :] + pcy[None, :]
+        w = jnp.exp(d[..., 2]) * pw[None, :]
+        h = jnp.exp(d[..., 3]) * ph[None, :]
+        out = jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                         cx + 0.5 * w - off, cy + 0.5 * h - off], axis=-1)
+        return jnp.squeeze(out, 1) if target_box.ndim == 2 and \
+            out.shape[1] == 1 else out
+    raise ValueError(f"unknown code_type {code_type!r}")
+
+
+def box_clip(boxes, im_shape):
+    """Clip boxes into the image (ref: detection/box_clip_op.h).
+    im_shape: (H, W)."""
+    h, w = im_shape[0], im_shape[1]
+    x1 = jnp.clip(boxes[..., 0], 0, w - 1)
+    y1 = jnp.clip(boxes[..., 1], 0, h - 1)
+    x2 = jnp.clip(boxes[..., 2], 0, w - 1)
+    y2 = jnp.clip(boxes[..., 3], 0, h - 1)
+    return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+
+def prior_box(input_hw: Tuple[int, int], image_hw: Tuple[int, int],
+              min_sizes: Sequence[float],
+              max_sizes: Sequence[float] = (),
+              aspect_ratios: Sequence[float] = (1.0,),
+              variance: Sequence[float] = (0.1, 0.1, 0.2, 0.2),
+              flip: bool = False, clip: bool = False,
+              step: Tuple[float, float] = (0.0, 0.0),
+              offset: float = 0.5, min_max_aspect_ratios_order=False):
+    """SSD prior boxes (ref: detection/prior_box_op.h; layer
+    fluid/layers/detection.py prior_box). Returns (boxes[H,W,A,4],
+    variances[H,W,A,4]) normalized to [0,1]."""
+    fh, fw = input_hw
+    ih, iw = image_hw
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    step_w = step[0] if step[0] > 0 else iw / fw
+    step_h = step[1] if step[1] > 0 else ih / fh
+
+    widths, heights = [], []
+    for ms in min_sizes:
+        if min_max_aspect_ratios_order:
+            widths.append(ms)
+            heights.append(ms)
+            if max_sizes:
+                big = (ms * max_sizes[list(min_sizes).index(ms)]) ** 0.5
+                widths.append(big)
+                heights.append(big)
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                widths.append(ms * ar ** 0.5)
+                heights.append(ms / ar ** 0.5)
+        else:
+            for ar in ars:
+                widths.append(ms * ar ** 0.5)
+                heights.append(ms / ar ** 0.5)
+            if max_sizes:
+                big = (ms * max_sizes[list(min_sizes).index(ms)]) ** 0.5
+                widths.append(big)
+                heights.append(big)
+    w = jnp.asarray(widths, jnp.float32) / iw
+    h = jnp.asarray(heights, jnp.float32) / ih
+    a = w.shape[0]
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w / iw
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h / ih
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [fh, fw]
+    boxes = jnp.stack([
+        cxg[..., None] - 0.5 * w,
+        cyg[..., None] - 0.5 * h,
+        cxg[..., None] + 0.5 * w,
+        cyg[..., None] + 0.5 * h,
+    ], axis=-1)  # [fh, fw, a, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                           boxes.shape)
+    return boxes, var
+
+
+def density_prior_box(input_hw, image_hw, fixed_sizes, fixed_ratios,
+                      densities, variance=(0.1, 0.1, 0.2, 0.2),
+                      clip: bool = False, step=(0.0, 0.0),
+                      offset: float = 0.5):
+    """Density prior boxes (ref: detection/density_prior_box_op.h)."""
+    fh, fw = input_hw
+    ih, iw = image_hw
+    step_w = step[0] if step[0] > 0 else iw / fw
+    step_h = step[1] if step[1] > 0 else ih / fh
+    ws, hs, sxs, sys = [], [], [], []
+    for size, density in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = size * ratio ** 0.5
+            bh = size / ratio ** 0.5
+            shift = size / density
+            for di in range(density):
+                for dj in range(density):
+                    ws.append(bw)
+                    hs.append(bh)
+                    sxs.append(-size / 2.0 + shift / 2.0 + dj * shift)
+                    sys.append(-size / 2.0 + shift / 2.0 + di * shift)
+    w = jnp.asarray(ws, jnp.float32)
+    h = jnp.asarray(hs, jnp.float32)
+    sx = jnp.asarray(sxs, jnp.float32)
+    sy = jnp.asarray(sys, jnp.float32)
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    ccx = cxg[..., None] + sx
+    ccy = cyg[..., None] + sy
+    boxes = jnp.stack([(ccx - 0.5 * w) / iw, (ccy - 0.5 * h) / ih,
+                       (ccx + 0.5 * w) / iw, (ccy + 0.5 * h) / ih],
+                      axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), boxes.shape)
+    return boxes, var
+
+
+def anchor_generator(input_hw, anchor_sizes, aspect_ratios, stride,
+                     variance=(0.1, 0.1, 0.2, 0.2), offset: float = 0.5):
+    """RPN anchors in image coords (ref: detection/anchor_generator_op.h).
+    Returns (anchors[H,W,A,4], variances[H,W,A,4])."""
+    fh, fw = input_hw
+    ws, hs = [], []
+    for ar in aspect_ratios:
+        for s in anchor_sizes:
+            area = s * s
+            w = (area / ar) ** 0.5
+            ws.append(w)
+            hs.append(w * ar)
+    w = jnp.asarray(ws, jnp.float32)
+    h = jnp.asarray(hs, jnp.float32)
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * stride[0]
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * stride[1]
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    anchors = jnp.stack([
+        cxg[..., None] - 0.5 * w, cyg[..., None] - 0.5 * h,
+        cxg[..., None] + 0.5 * w, cyg[..., None] + 0.5 * h], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                           anchors.shape)
+    return anchors, var
+
+
+def yolo_box(x, img_size, anchors: Sequence[int], class_num: int,
+             conf_thresh: float, downsample_ratio: int,
+             clip_bbox: bool = True, scale_x_y: float = 1.0):
+    """Decode YOLOv3 head output (ref: detection/yolo_box_op.h).
+
+    x: [N, A*(5+C), H, W]; img_size: [N, 2] (h, w).
+    Returns (boxes [N, A*H*W, 4], scores [N, A*H*W, C]).
+    """
+    n, _, h, w = x.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    grid_x = jnp.arange(w, dtype=jnp.float32)
+    grid_y = jnp.arange(h, dtype=jnp.float32)
+    alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+    bx = (jax.nn.sigmoid(x[:, :, 0]) * alpha + beta +
+          grid_x[None, None, None, :]) / w
+    by = (jax.nn.sigmoid(x[:, :, 1]) * alpha + beta +
+          grid_y[None, None, :, None]) / h
+    input_h = downsample_ratio * h
+    input_w = downsample_ratio * w
+    bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / input_w
+    bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / input_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    mask = (conf >= conf_thresh).astype(x.dtype)
+    img_h = img_size[:, 0].astype(jnp.float32)
+    img_w = img_size[:, 1].astype(jnp.float32)
+    x1 = (bx - bw / 2) * img_w[:, None, None, None]
+    y1 = (by - bh / 2) * img_h[:, None, None, None]
+    x2 = (bx + bw / 2) * img_w[:, None, None, None]
+    y2 = (by + bh / 2) * img_h[:, None, None, None]
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, img_w[:, None, None, None] - 1)
+        y1 = jnp.clip(y1, 0, img_h[:, None, None, None] - 1)
+        x2 = jnp.clip(x2, 0, img_w[:, None, None, None] - 1)
+        y2 = jnp.clip(y2, 0, img_h[:, None, None, None] - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1) * mask[..., None]
+    boxes = boxes.transpose(0, 1, 3, 4, 2).reshape(n, na * h * w, 4)
+    scores = (probs * mask[:, :, None]).transpose(0, 1, 3, 4, 2) \
+        .reshape(n, na * h * w, class_num)
+    return boxes, scores
+
+
+def nms(boxes, scores, iou_threshold: float = 0.3,
+        score_threshold: float = -jnp.inf, max_out: int = 100):
+    """Hard NMS with static output (ref: multiclass_nms_op.cc NMSFast).
+
+    boxes [N,4], scores [N]. Returns (indices[max_out] int32,
+    valid[max_out] bool) — indices into the input, -1 padded.
+    """
+    n = boxes.shape[0]
+    iou = iou_similarity(boxes, boxes)
+    live = scores > score_threshold
+
+    def body(_, carry):
+        live, sel_idx, sel_valid, count = carry
+        masked = jnp.where(live, scores, -jnp.inf)
+        best = jnp.argmax(masked)
+        ok = masked[best] > -jnp.inf
+        sel_idx = sel_idx.at[count].set(
+            jnp.where(ok, best.astype(jnp.int32), -1))
+        sel_valid = sel_valid.at[count].set(ok)
+        suppress = iou[best] >= iou_threshold
+        live = live & ~suppress & \
+            ~jax.nn.one_hot(best, n, dtype=bool)
+        live = live & ok  # once exhausted, stay exhausted
+        return live, sel_idx, sel_valid, count + jnp.where(ok, 1, 0)
+
+    sel_idx = jnp.full((max_out,), -1, jnp.int32)
+    sel_valid = jnp.zeros((max_out,), bool)
+    _, sel_idx, sel_valid, _ = lax.fori_loop(
+        0, max_out, body, (live, sel_idx, sel_valid, jnp.asarray(0)))
+    return sel_idx, sel_valid
+
+
+def multiclass_nms(bboxes, scores, score_threshold: float = 0.05,
+                   nms_threshold: float = 0.3, keep_top_k: int = 100,
+                   nms_top_k: int = 400, background_label: int = -1):
+    """Per-class NMS + global top-k (ref: detection/multiclass_nms_op.cc).
+
+    bboxes [N, 4] shared across classes, scores [C, N]. Returns
+    (out[keep_top_k, 6] rows = [label, score, x1, y1, x2, y2], valid mask).
+    LoD-free: fixed keep_top_k rows with validity flags.
+    """
+    c, n = scores.shape
+    per_class = min(nms_top_k, n) if nms_top_k > 0 else n
+
+    def one_class(cls_scores):
+        idx, valid = nms(bboxes, cls_scores, nms_threshold,
+                         score_threshold, max_out=per_class)
+        sc = jnp.where(valid, cls_scores[jnp.maximum(idx, 0)], -jnp.inf)
+        return idx, sc
+
+    idxs, scs = jax.vmap(one_class)(scores)  # [C, per_class]
+    labels = jnp.broadcast_to(jnp.arange(c)[:, None], (c, per_class))
+    if background_label >= 0:
+        scs = jnp.where(labels == background_label, -jnp.inf, scs)
+    flat_scores = scs.reshape(-1)
+    flat_idx = idxs.reshape(-1)
+    flat_labels = labels.reshape(-1)
+    k = min(keep_top_k, flat_scores.shape[0])
+    top_sc, top_pos = lax.top_k(flat_scores, k)
+    top_box = bboxes[jnp.maximum(flat_idx[top_pos], 0)]
+    top_lab = flat_labels[top_pos]
+    valid = top_sc > -jnp.inf
+    out = jnp.concatenate([
+        top_lab[:, None].astype(jnp.float32),
+        jnp.where(valid, top_sc, 0.0)[:, None],
+        top_box * valid[:, None]], axis=1)
+    return out, valid
+
+
+def _bilinear_sample(feat, y, x):
+    """feat [C,H,W]; y/x broadcastable index arrays (float, may be OOB)."""
+    h, w = feat.shape[-2:]
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    y1, x1 = y0 + 1, x0 + 1
+    ly, lx = y - y0, x - x0
+    hy, hx = 1 - ly, 1 - lx
+
+    def at(yy, xx):
+        yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        v = feat[:, yi, xi]
+        inb = (yy >= -1) & (yy <= h) & (xx >= -1) & (xx <= w)
+        return v * inb.astype(feat.dtype)
+
+    return (at(y0, x0) * (hy * hx) + at(y0, x1) * (hy * lx) +
+            at(y1, x0) * (ly * hx) + at(y1, x1) * (ly * lx))
+
+
+def roi_align(feat, rois, output_size: Tuple[int, int],
+              spatial_scale: float = 1.0, sampling_ratio: int = -1,
+              roi_batch_indices=None, aligned: bool = False):
+    """ROI Align (ref: detection/roi_align_op.cu; also used by
+    Mask/Faster-RCNN). feat [B,C,H,W], rois [R,4]. Returns [R,C,ph,pw]."""
+    ph, pw = output_size
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+    if roi_batch_indices is None:
+        roi_batch_indices = jnp.zeros((rois.shape[0],), jnp.int32)
+    half = 0.5 if aligned else 0.0
+
+    def one_roi(roi, bidx):
+        x1, y1, x2, y2 = (roi * spatial_scale) - half
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        # sample grid [ph, pw, sr, sr]: sr×sr fractions inside each bin
+        frac = (jnp.arange(sr, dtype=jnp.float32) + 0.5) / sr
+        gy = y1 + jnp.arange(ph, dtype=jnp.float32)[:, None] * bin_h + \
+            frac[None, :] * bin_h
+        gx = x1 + jnp.arange(pw, dtype=jnp.float32)[:, None] * bin_w + \
+            frac[None, :] * bin_w
+        yy = jnp.broadcast_to(gy[:, None, :, None], (ph, pw, sr, sr))
+        xx = jnp.broadcast_to(gx[None, :, None, :], (ph, pw, sr, sr))
+        sampled = _bilinear_sample(feat[bidx], yy, xx)  # [C,ph,pw,sr,sr]
+        return sampled.mean(axis=(-2, -1))
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32), roi_batch_indices)
+
+
+def roi_pool(feat, rois, output_size: Tuple[int, int],
+             spatial_scale: float = 1.0, roi_batch_indices=None):
+    """ROI max pooling (ref: operators/roi_pool_op.h). feat [B,C,H,W],
+    rois [R,4] in image coords. Returns [R,C,ph,pw]."""
+    ph, pw = output_size
+    h, w = feat.shape[-2:]
+    if roi_batch_indices is None:
+        roi_batch_indices = jnp.zeros((rois.shape[0],), jnp.int32)
+
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def one_roi(roi, bidx):
+        x1 = jnp.round(roi[0] * spatial_scale)
+        y1 = jnp.round(roi[1] * spatial_scale)
+        x2 = jnp.round(roi[2] * spatial_scale)
+        y2 = jnp.round(roi[3] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        bh, bw = rh / ph, rw / pw
+        # membership masks per output bin (static shapes, no gather)
+        py = jnp.arange(ph, dtype=jnp.float32)
+        px = jnp.arange(pw, dtype=jnp.float32)
+        ys_lo = jnp.clip(jnp.floor(y1 + py * bh), 0, h)
+        ys_hi = jnp.clip(jnp.ceil(y1 + (py + 1) * bh), 0, h)
+        xs_lo = jnp.clip(jnp.floor(x1 + px * bw), 0, w)
+        xs_hi = jnp.clip(jnp.ceil(x1 + (px + 1) * bw), 0, w)
+        ym = (ys[None, :] >= ys_lo[:, None]) & (ys[None, :] < ys_hi[:, None])
+        xm = (xs[None, :] >= xs_lo[:, None]) & (xs[None, :] < xs_hi[:, None])
+        m = ym[:, None, :, None] & xm[None, :, None, :]  # [ph,pw,H,W]
+        f = feat[bidx]  # [C,H,W]
+        neg = jnp.finfo(f.dtype).min
+        masked = jnp.where(m[None], f[:, None, None, :, :], neg)
+        out = masked.max(axis=(-2, -1))  # [C,ph,pw]
+        empty = ~m.any(axis=(-2, -1))
+        return jnp.where(empty[None], 0.0, out)
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32), roi_batch_indices)
+
+
+def bipartite_match(dist_mat):
+    """Greedy bipartite matching (ref: detection/bipartite_match_op.cc —
+    the reference's "max score first" greedy, not Hungarian).
+    dist_mat [N, M] similarity. Returns (match_indices [M] int32 with -1
+    unmatched, match_dist [M])."""
+    n, m = dist_mat.shape
+    k = min(n, m)
+
+    def body(_, carry):
+        dist, idx, val = carry
+        flat = jnp.argmax(dist)
+        i, j = flat // m, flat % m
+        best = dist[i, j]
+        ok = best > 0
+        idx = idx.at[j].set(jnp.where(ok, i.astype(jnp.int32), idx[j]))
+        val = val.at[j].set(jnp.where(ok, best, val[j]))
+        dist = jnp.where(ok, dist.at[i, :].set(-1.0).at[:, j].set(-1.0),
+                         dist)
+        return dist, idx, val
+
+    idx0 = jnp.full((m,), -1, jnp.int32)
+    val0 = jnp.zeros((m,), dist_mat.dtype)
+    _, idx, val = lax.fori_loop(0, k, body,
+                                (dist_mat.astype(jnp.float32), idx0, val0))
+    return idx, val
+
+
+def distribute_fpn_proposals(rois, min_level: int, max_level: int,
+                             refer_level: int, refer_scale: float):
+    """FPN level assignment (ref: distribute_fpn_proposals_op.cc).
+    Returns per-roi target level [R] int32 in [min_level, max_level]."""
+    scale = jnp.sqrt(box_area(rois))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-8)) + refer_level
+    return jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+
+
+def generate_proposals(scores, bbox_deltas, anchors, variances, im_shape,
+                       pre_nms_top_n: int = 6000,
+                       post_nms_top_n: int = 1000,
+                       nms_thresh: float = 0.7, min_size: float = 0.0):
+    """RPN proposal generation (ref: generate_proposals_op.cc), single
+    image. scores [A], bbox_deltas [A,4], anchors [A,4]. Static-shape:
+    returns (proposals [post_nms_top_n, 4], scores, valid mask)."""
+    a = scores.shape[0]
+    k = min(pre_nms_top_n, a)
+    top_sc, top_i = lax.top_k(scores, k)
+    sel_anchor = anchors[top_i]
+    sel_delta = bbox_deltas[top_i]
+    sel_var = variances[top_i] if variances is not None else None
+    boxes = box_coder(sel_anchor, sel_var, sel_delta,
+                      code_type="decode_center_size",
+                      box_normalized=False)
+    if boxes.ndim == 3:
+        boxes = boxes[jnp.arange(k), jnp.arange(k)]
+    boxes = box_clip(boxes, im_shape)
+    wh = jnp.stack([boxes[:, 2] - boxes[:, 0] + 1,
+                    boxes[:, 3] - boxes[:, 1] + 1], -1)
+    keep = (wh >= min_size).all(-1)
+    sc = jnp.where(keep, top_sc, -jnp.inf)
+    idx, valid = nms(boxes, sc, nms_thresh, max_out=post_nms_top_n)
+    out_boxes = boxes[jnp.maximum(idx, 0)] * valid[:, None]
+    out_scores = jnp.where(valid, sc[jnp.maximum(idx, 0)], 0.0)
+    return out_boxes, out_scores, valid

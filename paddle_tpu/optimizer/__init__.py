@@ -484,3 +484,6 @@ RMSPropOptimizer = RMSProp
 LambOptimizer = Lamb
 FtrlOptimizer = Ftrl
 LarsMomentumOptimizer = LarsMomentum
+
+from .extras import (ExponentialMovingAverage, GradientMerge,  # noqa: E402
+                     Lookahead, ModelAverage)  # noqa: F401

@@ -1,0 +1,165 @@
+"""Quantization: QAT fake-quant + post-training calibration.
+
+TPU-native rebuild of the reference's slim quantization stack
+(/root/reference/python/paddle/fluid/contrib/slim/quantization/
+quantization_pass.py: QuantizationTransformPass inserts
+fake_quantize_abs_max / fake_quantize_moving_average_abs_max /
+fake_channel_wise_quantize ops before weights+activations;
+post_training_quantization.py calibrates abs-max stats; C++ kernels
+paddle/fluid/operators/fake_quantize_op.cc). Here:
+
+- fake-quant ops are pure functions with straight-through-estimator
+  gradients (jax.custom_vjp), so QAT "just works" under jax.grad — the
+  reference needs dedicated grad kernels.
+- :class:`QuantizedLinear`/:func:`quantize_model` wrap layers the way the
+  IR pass rewrites the graph.
+- :class:`PostTrainingQuantization` runs batches, collects abs-max
+  activations, and emits a weight-quantized model.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .nn.layer import Layer, Parameter
+
+__all__ = ["fake_quantize_abs_max", "fake_quantize_moving_average_abs_max",
+           "fake_channel_wise_quantize_abs_max", "QuantizedLinear",
+           "quantize_model", "PostTrainingQuantization"]
+
+
+def _quant_levels(bits: int) -> float:
+    return float(2 ** (bits - 1) - 1)
+
+
+@jax.custom_vjp
+def _ste_round(x):
+    return jnp.round(x)
+
+
+def _ste_round_fwd(x):
+    return jnp.round(x), None
+
+
+def _ste_round_bwd(_, g):
+    return (g,)  # straight-through: d(round)/dx ≈ 1
+
+
+_ste_round.defvjp(_ste_round_fwd, _ste_round_bwd)
+
+
+def fake_quantize_abs_max(x, bits: int = 8):
+    """Symmetric per-tensor fake quant (ref: fake_quantize_op.cc
+    FakeQuantizeAbsMaxOp). Returns (quant-dequant x, scale)."""
+    n = _quant_levels(bits)
+    scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+    q = _ste_round(jnp.clip(x / scale, -1.0, 1.0) * n)
+    return q * scale / n, scale
+
+
+def fake_channel_wise_quantize_abs_max(w, bits: int = 8, axis: int = 0):
+    """Per-output-channel weight fake quant (ref: fake_quantize_op.cc
+    FakeChannelWiseQuantizeAbsMaxOp)."""
+    n = _quant_levels(bits)
+    red = tuple(i for i in range(w.ndim) if i != axis)
+    scale = jnp.maximum(jnp.max(jnp.abs(w), axis=red, keepdims=True), 1e-8)
+    q = _ste_round(jnp.clip(w / scale, -1.0, 1.0) * n)
+    return q * scale / n, jnp.squeeze(scale)
+
+
+def fake_quantize_moving_average_abs_max(x, state_scale, bits: int = 8,
+                                         momentum: float = 0.9,
+                                         training: bool = True):
+    """Activation fake quant with EMA scale (ref: fake_quantize_op.cc
+    FakeQuantizeMovingAverageAbsMaxOp). Returns (out, new_scale)."""
+    n = _quant_levels(bits)
+    cur = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+    scale = jnp.where(training,
+                      momentum * state_scale + (1 - momentum) * cur,
+                      state_scale)
+    scale = jnp.maximum(scale, 1e-8)
+    q = _ste_round(jnp.clip(x / scale, -1.0, 1.0) * n)
+    return q * scale / n, scale
+
+
+class QuantizedLinear(Layer):
+    """Linear with weight (channel-wise) + activation (EMA) fake quant —
+    what QuantizationTransformPass turns mul/matmul ops into."""
+
+    def __init__(self, inner, weight_bits: int = 8,
+                 activation_bits: int = 8) -> None:
+        super().__init__()
+        self.inner = inner
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.register_buffer("act_scale", jnp.ones((), jnp.float32))
+
+    def forward(self, x):
+        x, new_scale = fake_quantize_moving_average_abs_max(
+            x, self.act_scale, bits=self.activation_bits,
+            training=self.training)
+        if self.training:
+            self.act_scale = new_scale  # buffer update, captured like BN
+        w = self.inner.weight  # Layer.__getattr__ unwraps to the array
+        wq, _ = fake_channel_wise_quantize_abs_max(
+            w, bits=self.weight_bits, axis=w.ndim - 1)
+        out = x @ wq
+        bias = getattr(self.inner, "bias", None)
+        if bias is not None:
+            out = out + bias
+        return out
+
+
+def quantize_model(model: Layer, weight_bits: int = 8,
+                   activation_bits: int = 8,
+                   quantizable=("Linear",)) -> Layer:
+    """Swap quantizable sublayers for fake-quant wrappers in place
+    (the dygraph analogue of the reference's IR pass rewriting;
+    cf. slim/quantization/imperative/qat.py ImperativeQuantAware)."""
+    from .nn.layers.common import Linear
+    for name, child in list(model._sub_layers.items()):
+        if type(child).__name__ in quantizable and \
+                isinstance(child, Linear):
+            model._sub_layers[name] = QuantizedLinear(
+                child, weight_bits, activation_bits)
+        else:
+            quantize_model(child, weight_bits, activation_bits,
+                           quantizable)
+    return model
+
+
+class PostTrainingQuantization:
+    """Calibrate activation scales on sample batches, then emit a model
+    with int8-grid weights (ref: post_training_quantization.py
+    PostTrainingQuantization.quantize)."""
+
+    def __init__(self, model: Layer, weight_bits: int = 8,
+                 activation_bits: int = 8) -> None:
+        self.model = model
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.act_scales: Dict[str, float] = {}
+
+    def calibrate(self, batches: Sequence) -> "PostTrainingQuantization":
+        for batch in batches:
+            args = batch if isinstance(batch, (tuple, list)) else (batch,)
+            out = self.model(*args)
+            key = "output"
+            cur = float(jnp.max(jnp.abs(out)))
+            self.act_scales[key] = max(self.act_scales.get(key, 0.0), cur)
+        return self
+
+    def quantize(self) -> Layer:
+        """Round every weight to its `weight_bits` grid (simulated int8
+        deployment; TPU serving keeps bf16 carriers)."""
+        for p in self.model.parameters():
+            w = p.value
+            if w.ndim >= 2:
+                wq, _ = fake_channel_wise_quantize_abs_max(
+                    w, bits=self.weight_bits, axis=w.ndim - 1)
+                p.value = wq
+        return self.model

@@ -1,0 +1,294 @@
+"""Detection ops, optimizer extras, quantization tests.
+
+Reference test models: test_iou_similarity_op.py, test_box_coder_op.py,
+test_prior_box_op.py, test_yolo_box_op.py, test_multiclass_nms_op.py,
+test_roi_align_op.py (numpy-reference comparison, OpTest style) under
+/root/reference/python/paddle/fluid/tests/unittests/.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.ops import detection as det
+from paddle_tpu.optimizer import (Adam, ExponentialMovingAverage,
+                                  GradientMerge, Lookahead, ModelAverage,
+                                  Momentum, SGD)
+from paddle_tpu import slim
+
+
+class TestIoU:
+    def test_identity(self):
+        b = jnp.asarray([[0., 0., 10., 10.], [5., 5., 15., 15.]])
+        iou = det.iou_similarity(b, b)
+        np.testing.assert_allclose(np.diag(np.asarray(iou)), [1.0, 1.0])
+
+    def test_known_overlap(self):
+        a = jnp.asarray([[0., 0., 10., 10.]])
+        b = jnp.asarray([[5., 0., 15., 10.]])
+        # inter = 5*10=50, union = 100+100-50=150
+        np.testing.assert_allclose(
+            np.asarray(det.iou_similarity(a, b))[0, 0], 50 / 150,
+            rtol=1e-6)
+
+    def test_disjoint(self):
+        a = jnp.asarray([[0., 0., 1., 1.]])
+        b = jnp.asarray([[5., 5., 6., 6.]])
+        assert float(det.iou_similarity(a, b)[0, 0]) == 0.0
+
+
+class TestBoxCoder:
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.default_rng(0)
+        priors = jnp.asarray(
+            np.sort(rng.uniform(0, 1, (5, 4)).astype(np.float32), axis=-1))
+        var = jnp.asarray([0.1, 0.1, 0.2, 0.2], jnp.float32)
+        targets = jnp.asarray(
+            np.sort(rng.uniform(0, 1, (5, 4)).astype(np.float32), axis=-1))
+        enc = det.box_coder(priors, var, targets, "encode_center_size")
+        # decode the diagonal (each target vs its own prior)
+        diag = enc[jnp.arange(5), jnp.arange(5)]
+        dec = det.box_coder(priors, var, diag[:, None, :].repeat(5, 1),
+                            "decode_center_size")
+        dec_diag = dec[jnp.arange(5), jnp.arange(5)]
+        np.testing.assert_allclose(np.asarray(dec_diag),
+                                   np.asarray(targets), atol=1e-4)
+
+
+class TestPriorAnchor:
+    def test_prior_box_shapes_and_range(self):
+        boxes, var = det.prior_box((4, 4), (64, 64), min_sizes=[16.0],
+                                   max_sizes=[32.0],
+                                   aspect_ratios=[1.0, 2.0], clip=True)
+        assert boxes.shape[:2] == (4, 4) and boxes.shape[-1] == 4
+        assert var.shape == boxes.shape
+        b = np.asarray(boxes)
+        assert b.min() >= 0.0 and b.max() <= 1.0
+        # centers ascend with the grid
+        cx = (b[..., 0] + b[..., 2]) / 2
+        assert (np.diff(cx[0, :, 0]) > 0).all()
+
+    def test_anchor_generator(self):
+        a, v = det.anchor_generator((2, 3), anchor_sizes=[32, 64],
+                                    aspect_ratios=[0.5, 1.0],
+                                    stride=[16.0, 16.0])
+        assert a.shape == (2, 3, 4, 4)
+        ws = np.asarray(a[..., 2] - a[..., 0])
+        hs = np.asarray(a[..., 3] - a[..., 1])
+        # anchor area is size^2 regardless of aspect ratio; h/w == ratio
+        np.testing.assert_allclose((ws * hs)[0, 0],
+                                   [32 * 32, 64 * 64, 32 * 32, 64 * 64],
+                                   rtol=1e-4)
+        np.testing.assert_allclose((hs / ws)[0, 0], [0.5, 0.5, 1.0, 1.0],
+                                   rtol=1e-5)
+
+    def test_density_prior_box(self):
+        b, v = det.density_prior_box((2, 2), (32, 32), fixed_sizes=[8.0],
+                                     fixed_ratios=[1.0], densities=[2])
+        assert b.shape == (2, 2, 4, 4)
+
+
+class TestNMS:
+    def test_suppresses_overlaps(self):
+        boxes = jnp.asarray([[0, 0, 10, 10], [1, 1, 11, 11],
+                             [50, 50, 60, 60]], jnp.float32)
+        scores = jnp.asarray([0.9, 0.8, 0.7])
+        idx, valid = det.nms(boxes, scores, iou_threshold=0.5, max_out=3)
+        kept = np.asarray(idx)[np.asarray(valid)]
+        assert kept.tolist() == [0, 2]
+
+    def test_multiclass_nms(self):
+        boxes = jnp.asarray([[0, 0, 10, 10], [0, 0, 10.5, 10],
+                             [50, 50, 60, 60]], jnp.float32)
+        scores = jnp.asarray([[0.9, 0.85, 0.1],    # class 0
+                              [0.2, 0.1, 0.95]])   # class 1
+        out, valid = det.multiclass_nms(boxes, scores,
+                                        score_threshold=0.3,
+                                        nms_threshold=0.5, keep_top_k=4)
+        o = np.asarray(out)[np.asarray(valid)]
+        # class1 box2 (0.95), class0 box0 (0.9); box1 suppressed by box0
+        assert len(o) == 2
+        assert o[0][0] == 1.0 and abs(o[0][1] - 0.95) < 1e-6
+        assert o[1][0] == 0.0 and abs(o[1][1] - 0.9) < 1e-6
+
+    def test_jit_compatible(self):
+        f = jax.jit(lambda b, s: det.nms(b, s, 0.5, max_out=4))
+        boxes = jnp.asarray(np.random.rand(16, 4).astype(np.float32))
+        idx, valid = f(boxes * 100, jnp.linspace(1, 0, 16))
+        assert idx.shape == (4,)
+
+
+class TestRoiOps:
+    def test_roi_align_uniform_feature(self):
+        # constant feature map -> every aligned output equals the constant
+        feat = jnp.full((1, 3, 16, 16), 2.5, jnp.float32)
+        rois = jnp.asarray([[2.0, 2.0, 10.0, 10.0]], jnp.float32)
+        out = det.roi_align(feat, rois, (4, 4))
+        assert out.shape == (1, 3, 4, 4)
+        np.testing.assert_allclose(np.asarray(out), 2.5, rtol=1e-6)
+
+    def test_roi_align_gradient_flows(self):
+        feat = jnp.asarray(np.random.rand(1, 2, 8, 8).astype(np.float32))
+        rois = jnp.asarray([[1.0, 1.0, 6.0, 6.0]], jnp.float32)
+        g = jax.grad(lambda f: det.roi_align(f, rois, (2, 2)).sum())(feat)
+        assert float(jnp.abs(g).sum()) > 0
+
+    def test_roi_pool_max(self):
+        feat = jnp.zeros((1, 1, 8, 8), jnp.float32).at[0, 0, 3, 3].set(9.0)
+        rois = jnp.asarray([[0.0, 0.0, 7.0, 7.0]], jnp.float32)
+        out = det.roi_pool(feat, rois, (2, 2))
+        assert float(out.max()) == 9.0
+
+    def test_yolo_box_shapes(self):
+        n, na, c, h, w = 2, 3, 5, 4, 4
+        x = jnp.asarray(np.random.randn(
+            n, na * (5 + c), h, w).astype(np.float32))
+        img = jnp.asarray([[64, 64], [32, 48]], jnp.int32)
+        boxes, scores = det.yolo_box(x, img, anchors=[10, 13, 16, 30,
+                                                      33, 23],
+                                     class_num=c, conf_thresh=0.01,
+                                     downsample_ratio=8)
+        assert boxes.shape == (n, na * h * w, 4)
+        assert scores.shape == (n, na * h * w, c)
+
+    def test_bipartite_match(self):
+        d = jnp.asarray([[0.9, 0.1], [0.2, 0.8]])
+        idx, val = det.bipartite_match(d)
+        assert np.asarray(idx).tolist() == [0, 1]
+        np.testing.assert_allclose(np.asarray(val), [0.9, 0.8])
+
+    def test_distribute_fpn(self):
+        rois = jnp.asarray([[0, 0, 10, 10], [0, 0, 224, 224],
+                            [0, 0, 1000, 1000]], jnp.float32)
+        lvl = det.distribute_fpn_proposals(rois, 2, 5, 4, 224.0)
+        # tiny -> clipped to min; refer_scale -> refer_level; huge -> max
+        assert np.asarray(lvl).tolist() == [2, 4, 5]
+
+
+def _fit(opt_ctor, steps=40, lr=0.1):
+    pt.seed(0)
+    model = pt.nn.Linear(6, 3)
+    rng = np.random.default_rng(0)
+    w = rng.normal(0, 1, (6, 3)).astype(np.float32)
+    x = rng.normal(0, 1, (64, 6)).astype(np.float32)
+    y = x @ w
+    opt = opt_ctor()
+    step = pt.static.TrainStep(model, opt,
+                               lambda o, t: pt.nn.functional.mse_loss(o, t))
+    losses = [float(step(x, labels=(y,))["loss"]) for _ in range(steps)]
+    return losses, step, opt
+
+
+class TestOptimizerExtras:
+    def test_ema_tracks_params(self):
+        losses, step, opt = _fit(
+            lambda: ExponentialMovingAverage(Adam(learning_rate=0.05),
+                                             decay=0.9))
+        assert losses[-1] < 0.1 * losses[0]
+        ema = ExponentialMovingAverage.shadow_params(step.state)
+        for k, v in step.state["params"].items():
+            e = ema[k]
+            assert e.shape == v.shape
+            # ema lags but is in the same ballpark after many steps
+            assert float(jnp.max(jnp.abs(e - v))) < 1.0
+
+    def test_ema_apply_swaps(self):
+        losses, step, opt = _fit(
+            lambda: ExponentialMovingAverage(Adam(learning_rate=0.05)))
+        real = jax.tree.map(np.asarray, step.state["params"])
+        with opt.apply(step):
+            inside = jax.tree.map(np.asarray, step.state["params"])
+        after = jax.tree.map(np.asarray, step.state["params"])
+        for k in real:
+            np.testing.assert_array_equal(real[k], after[k])
+        assert any(not np.array_equal(real[k], inside[k]) for k in real)
+
+    def test_model_average(self):
+        losses, step, opt = _fit(
+            lambda: ModelAverage(Adam(learning_rate=0.05),
+                                 max_average_window=100))
+        assert losses[-1] < 0.1 * losses[0]
+        avg = ModelAverage.averaged_params(step.state)
+        assert all(avg[k].shape == v.shape
+                   for k, v in step.state["params"].items())
+
+    def test_lookahead_converges(self):
+        losses, _, _ = _fit(
+            lambda: Lookahead(SGD(learning_rate=0.1), alpha=0.5, k=5),
+            steps=60)
+        assert losses[-1] < 0.1 * losses[0]
+
+    def test_gradient_merge_matches_big_batch(self):
+        """k micro-steps of GradientMerge == one step on the summed grad."""
+        pt.seed(3)
+        model_a = pt.nn.Linear(4, 2)
+        pt.seed(3)
+        model_b = pt.nn.Linear(4, 2)
+        x = np.random.default_rng(1).normal(
+            0, 1, (8, 4)).astype(np.float32)
+        y = np.zeros((8, 2), np.float32)
+        loss = lambda o, t: pt.nn.functional.mse_loss(o, t)
+
+        merged = pt.static.TrainStep(
+            model_a, GradientMerge(SGD(learning_rate=0.1), k_steps=2),
+            loss)
+        plain = pt.static.TrainStep(model_b, SGD(learning_rate=0.1), loss)
+        merged(x[:4], labels=(y[:4],))
+        merged(x[4:], labels=(y[4:],))
+        plain(x, labels=(y,))
+        for k, v in plain.state["params"].items():
+            np.testing.assert_allclose(
+                np.asarray(merged.state["params"][k]), np.asarray(v),
+                rtol=1e-5)
+
+
+class TestSlim:
+    def test_fake_quant_abs_max_grid(self):
+        x = jnp.asarray([-1.0, -0.5, 0.0, 0.5, 1.0])
+        out, scale = slim.fake_quantize_abs_max(x, bits=8)
+        assert float(scale) == 1.0
+        grid = np.asarray(out) * 127
+        np.testing.assert_allclose(grid, np.round(grid), atol=1e-5)
+
+    def test_ste_gradient(self):
+        g = jax.grad(lambda x: slim.fake_quantize_abs_max(x)[0].sum())(
+            jnp.asarray([0.3, -0.7]))
+        assert float(jnp.abs(g).sum()) > 0  # STE lets grads through
+
+    def test_channel_wise_scales(self):
+        w = jnp.asarray(np.array([[1.0, 10.0], [2.0, 20.0]], np.float32))
+        wq, scales = slim.fake_channel_wise_quantize_abs_max(w, axis=1)
+        np.testing.assert_allclose(np.asarray(scales), [2.0, 20.0])
+
+    def test_qat_trains(self):
+        pt.seed(0)
+        model = pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.ReLU(),
+                                 pt.nn.Linear(16, 4))
+        slim.quantize_model(model)
+        assert any(isinstance(l, slim.QuantizedLinear)
+                   for _, l in model.named_sublayers())
+        rng = np.random.default_rng(0)
+        x = rng.normal(0, 1, (32, 8)).astype(np.float32)
+        w = rng.normal(0, 1, (8, 4)).astype(np.float32)
+        y = x @ w
+        step = pt.static.TrainStep(
+            model, Adam(learning_rate=0.01),
+            lambda o, t: pt.nn.functional.mse_loss(o, t))
+        losses = [float(step(x, labels=(y,))["loss"]) for _ in range(40)]
+        assert losses[-1] < 0.5 * losses[0]
+
+    def test_post_training_quantization(self):
+        pt.seed(0)
+        model = pt.nn.Linear(8, 4)
+        before = np.asarray(model.weight).copy()
+        ptq = slim.PostTrainingQuantization(model)
+        batches = [np.random.rand(4, 8).astype(np.float32)
+                   for _ in range(3)]
+        ptq.calibrate(batches).quantize()
+        after = np.asarray(model.weight)
+        assert not np.array_equal(before, after)
+        # outputs close to original (8-bit grid)
+        x = batches[0]
+        np.testing.assert_allclose(x @ after, x @ before, atol=0.1)
