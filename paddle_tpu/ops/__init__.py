@@ -12,9 +12,10 @@ random_ops, sequence (ragged/LoD analogue), control_flow, sparse
 (SelectedRows analogue), metrics_ops.
 """
 
-from . import (activation, control_flow, detection, loss, manipulation,
-               math, metrics_ops, nn_functional, random_ops, reduction,
-               search, sequence, sparse)
+from . import (activation, beam, control_flow, conv_extra, crf, detection,
+               loss, manipulation, math, metrics_ops, nn_functional,
+               random_ops, reduction, sampling, search, sequence, sparse,
+               tensor_array)
 
 from .activation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
@@ -35,3 +36,14 @@ from .metrics_ops import (accuracy, auc_from_stats,  # noqa: F401
                           precision_recall_stats)
 from .sparse import RowSlices, embedding_grad, merge_rows  # noqa: F401
 from .sparse import scatter_apply, to_dense  # noqa: F401
+from .crf import chunk_eval, crf_decoding, linear_chain_crf  # noqa: F401
+from .beam import (beam_search, beam_search_decode,  # noqa: F401
+                   beam_search_step, gather_tree)
+from .sampling import (hsigmoid_loss, nce_loss,  # noqa: F401
+                       sampled_softmax_with_cross_entropy)
+from .conv_extra import *  # noqa: F401,F403
+from .tensor_array import (TensorArray, array_length,  # noqa: F401
+                           array_read, array_to_lod_tensor, array_write,
+                           create_array, lod_tensor_to_array,
+                           tensor_array_to_tensor)
+from .control_flow import print_op, py_func  # noqa: F401

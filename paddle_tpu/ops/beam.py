@@ -1,0 +1,137 @@
+"""Beam-search decoding ops.
+
+TPU-native redesign of the reference's beam-search operator family
+(/root/reference/paddle/fluid/operators/beam_search_op.cc,
+beam_search_decode_op.cc, gather_tree_op.cc and math/beam_search.cc). The
+reference grows LoD tensors step-by-step with dynamic shapes inside a
+``while_op``; XLA needs static shapes, so here the beam state is dense
+``[batch, beam]`` arrays, the decode loop is a ``lax.scan`` / ``while_loop``
+over a fixed ``max_len``, and finished beams are masked rather than pruned.
+Backtracking (= beam_search_decode) is :func:`gather_tree`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["beam_search_step", "gather_tree", "beam_search_decode",
+           "beam_search"]
+
+_NEG_INF = -1e9
+
+
+def beam_search_step(log_probs, beam_scores, is_finished, beam_size: int,
+                     end_id: int):
+    """One beam-search step (ref: beam_search_op.cc).
+
+    Args: log_probs ``[batch, beam, vocab]`` for the current step,
+    beam_scores ``[batch, beam]`` cumulative, is_finished ``[batch, beam]``
+    bool. Returns (token_ids, parent_ids, new_scores, new_finished), each
+    ``[batch, beam]``.
+
+    Finished beams only propose ``end_id`` at unchanged score (the
+    reference keeps ended hypotheses in the beam the same way).
+    """
+    batch, beam, vocab = log_probs.shape
+    # finished beams: force a single end_id continuation with score kept
+    fin_row = jnp.full((vocab,), _NEG_INF).at[end_id].set(0.0)
+    step = jnp.where(is_finished[:, :, None], fin_row[None, None, :],
+                     log_probs)
+    total = beam_scores[:, :, None] + step  # [batch, beam, vocab]
+    flat = total.reshape(batch, beam * vocab)
+    new_scores, idx = lax.top_k(flat, beam_size)  # [batch, beam_size]
+    parent = (idx // vocab).astype(jnp.int32)
+    token = (idx % vocab).astype(jnp.int32)
+    parent_fin = jnp.take_along_axis(is_finished, parent, axis=1)
+    new_finished = parent_fin | (token == end_id)
+    return token, parent, new_scores, new_finished
+
+
+def gather_tree(ids, parents):
+    """Backtrack a beam tree into full sequences (ref: gather_tree_op.cc).
+
+    Args: ids, parents ``[max_len, batch, beam]``. Returns the same shape
+    with each beam's full token path realigned so row ``t`` holds the
+    token actually on the path of the final beam slot.
+    """
+    max_len, batch, beam = ids.shape
+    beam_idx0 = jnp.broadcast_to(jnp.arange(beam, dtype=parents.dtype),
+                                 (batch, beam))
+
+    def back(beam_idx, xs):
+        ids_t, parents_t = xs  # [batch, beam]
+        tok = jnp.take_along_axis(ids_t, beam_idx, axis=1)
+        prev = jnp.take_along_axis(parents_t, beam_idx, axis=1)
+        return prev, tok
+
+    _, toks = lax.scan(back, beam_idx0, (ids, parents), reverse=True)
+    return toks
+
+
+class BeamState(NamedTuple):
+    tokens: jnp.ndarray      # [batch, beam]
+    scores: jnp.ndarray      # [batch, beam]
+    finished: jnp.ndarray    # [batch, beam] bool
+    cell: object             # arbitrary pytree of decoder state
+
+
+def beam_search(step_fn: Callable, init_cell, batch: int, beam_size: int,
+                max_len: int, bos_id: int, end_id: int,
+                length_penalty: float = 0.0):
+    """Full static-shape beam-search decode loop.
+
+    ``step_fn(tokens, cell) -> (log_probs, new_cell)`` where tokens is
+    ``[batch, beam]`` and log_probs ``[batch, beam, vocab]``; the cell
+    pytree must keep a ``[batch, beam, ...]`` leading layout so parent
+    reselection can gather it. Covers the reference's
+    while_op + beam_search + beam_search_decode composition
+    (ref: beam_search_op.cc, beam_search_decode_op.cc) as one scan.
+
+    Returns (sequences ``[batch, beam, max_len]``, scores ``[batch, beam]``).
+    """
+    tokens0 = jnp.full((batch, beam_size), bos_id, jnp.int32)
+    # first expansion starts from beam 0 only: others at -inf
+    scores0 = jnp.tile(
+        jnp.concatenate([jnp.zeros((1,)),
+                         jnp.full((beam_size - 1,), _NEG_INF)])[None, :],
+        (batch, 1)).astype(jnp.float32)
+    fin0 = jnp.zeros((batch, beam_size), bool)
+    state = BeamState(tokens0, scores0, fin0, init_cell)
+
+    def one_step(state, _):
+        log_probs, cell = step_fn(state.tokens, state.cell)
+        tok, parent, scores, fin = beam_search_step(
+            log_probs, state.scores, state.finished, beam_size, end_id)
+        cell = jax.tree_util.tree_map(
+            lambda leaf: jnp.take_along_axis(
+                leaf, parent.reshape(parent.shape + (1,) * (leaf.ndim - 2)),
+                axis=1), cell)
+        return BeamState(tok, scores, fin, cell), (tok, parent)
+
+    state, (ids, parents) = lax.scan(one_step, state, None, length=max_len)
+    seqs = gather_tree(ids, parents)  # [max_len, batch, beam]
+    seqs = jnp.moveaxis(seqs, 0, 2)  # [batch, beam, max_len]
+    scores = state.scores
+    if length_penalty > 0.0:
+        lengths = jnp.sum(seqs != end_id, axis=2).astype(jnp.float32)
+        scores = scores / ((5.0 + lengths) / 6.0) ** length_penalty
+    order = jnp.argsort(-scores, axis=1)
+    seqs = jnp.take_along_axis(seqs, order[:, :, None], axis=1)
+    scores = jnp.take_along_axis(scores, order, axis=1)
+    return seqs, scores
+
+
+def beam_search_decode(ids, parents, end_id: int):
+    """(ref: beam_search_decode_op.cc) — backtrack stacked per-step ids and
+    parents into final sequences; entries after the first end_id are set to
+    end_id."""
+    seqs = gather_tree(ids, parents)  # [max_len, batch, beam]
+    seqs = jnp.moveaxis(seqs, 0, 2)
+    ended = jnp.cumsum((seqs == end_id).astype(jnp.int32), axis=2)
+    # keep the first end token, pad the rest
+    keep = ended - (seqs == end_id).astype(jnp.int32) == 0
+    return jnp.where(keep, seqs, end_id)

@@ -95,3 +95,40 @@ def static_rnn(cell: Callable, inputs, initial_states, time_major: bool = False)
     if not time_major:
         outs = jax.tree.map(lambda o: jnp.swapaxes(o, 0, 1), outs)
     return outs, final
+
+
+def py_func(func: Callable, x, out_shape_dtype, grad_func: Callable = None):
+    """Host-callback op (ref: py_func_op.cc). Runs a Python/numpy function
+    inside a traced program via jax.pure_callback. ``out_shape_dtype`` is a
+    jax.ShapeDtypeStruct (or pytree of them). Optionally differentiable
+    through a user-supplied ``grad_func(dy, *xs)``."""
+    if grad_func is None:
+        return jax.pure_callback(func, out_shape_dtype, x, vmap_method="sequential")
+
+    @jax.custom_vjp
+    def _call(x):
+        return jax.pure_callback(func, out_shape_dtype, x,
+                                 vmap_method="sequential")
+
+    def fwd(x):
+        return _call(x), x
+
+    def bwd(x, dy):
+        gshape = jax.tree_util.tree_map(
+            lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), x)
+        return (jax.pure_callback(grad_func, gshape, dy, x,
+                                  vmap_method="sequential"),)
+
+    _call.defvjp(fwd, bwd)
+    return _call(x)
+
+
+def print_op(x, message: str = "", summarize: int = 20,
+             print_tensor_name: bool = True):
+    """Debug-print op (ref: print_op.cc / layers.Print). Under jit this is
+    jax.debug.print (host callback at run time); returns x unchanged so it
+    can be threaded into the graph like the reference's forward-print."""
+    del summarize, print_tensor_name
+    safe = message.replace("{", "{{").replace("}", "}}")
+    jax.debug.print(safe + "{x}", x=x)
+    return x

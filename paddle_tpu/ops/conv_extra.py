@@ -1,0 +1,341 @@
+"""Extended convolution & feature-interaction ops.
+
+TPU-native lowerings for the reference's long tail of conv-family and
+recommendation/matching operators
+(/root/reference/paddle/fluid/operators/: conv_transpose_op.cc (3D),
+deformable_conv_op.cc, deformable_conv_v1_op.cc, row_conv_op.cc,
+var_conv_2d_op.cc, tree_conv_op.cc, spp_op.cc, fsp_op.cc,
+partial_sum_op.cc, partial_concat_op.cc, batch_fc_op.cc,
+rank_attention_op.cc, cvm_op.cc, match_matrix_tensor_op.cc,
+pyramid_hash_op.cc). All are static-shape XLA designs: irregular gathers
+become dense `take`/one-hot matmuls, ragged (LoD) inputs use the padded
+``(x, length)`` layout from ops/sequence.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .nn_functional import _conv_padding, _pair, adaptive_avg_pool2d, \
+    adaptive_max_pool2d, conv2d
+
+__all__ = ["conv3d_transpose", "depthwise_conv2d_transpose",
+           "deformable_conv", "row_conv", "var_conv_2d", "tree_conv",
+           "spp", "fsp_matrix", "partial_sum", "partial_concat",
+           "batch_fc", "rank_attention", "cvm", "match_matrix_tensor",
+           "pyramid_hash"]
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups: int = 1):
+    """(ref: conv_transpose_op.cc 3D path). weight [in, out//g, kd, kh, kw]."""
+    stride = _pair(stride, 3)
+    pad = _conv_padding(padding, 3)
+    if isinstance(pad, str):
+        raise ValueError("string padding unsupported for transpose conv")
+    opad = _pair(output_padding, 3)
+    dilation = _pair(dilation, 3)
+    k = [(weight.shape[2 + i] - 1) * dilation[i] + 1 for i in range(3)]
+    pads = [(k[i] - 1 - pad[i][0], k[i] - 1 - pad[i][1] + opad[i])
+            for i in range(3)]
+    w = jnp.flip(weight, axis=(2, 3, 4))
+    if groups > 1:
+        i, og, kd, kh, kw = w.shape
+        w = w.reshape(groups, i // groups, og, kd, kh, kw)
+        w = jnp.swapaxes(w, 1, 2).reshape(groups * og, i // groups,
+                                          kd, kh, kw)
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NCDHW", "OIDHW", "NCDHW"))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1, 1), padding=pads, lhs_dilation=stride,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1, 1)
+    return out
+
+
+def depthwise_conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                               output_padding=0, dilation=1):
+    """(ref: conv_transpose_op.cc depthwise registration)."""
+    from .nn_functional import conv2d_transpose
+    return conv2d_transpose(x, weight, bias, stride, padding,
+                            output_padding, dilation,
+                            groups=x.shape[1])
+
+
+def _bilinear_gather(x, yy, xx):
+    """Sample x [C, H, W] at fractional (yy, xx) [...]; zeros outside."""
+    c, h, w = x.shape
+    y0 = jnp.floor(yy)
+    x0 = jnp.floor(xx)
+    wy = yy - y0
+    wx = xx - x0
+    out = 0.0
+    for dy, sy in ((0, 1 - wy), (1, wy)):
+        for dx, sx in ((0, 1 - wx), (1, wx)):
+            yi = (y0 + dy).astype(jnp.int32)
+            xi = (x0 + dx).astype(jnp.int32)
+            valid = ((yi >= 0) & (yi < h) & (xi >= 0) & (xi < w))
+            yc = jnp.clip(yi, 0, h - 1)
+            xc = jnp.clip(xi, 0, w - 1)
+            v = x[:, yc, xc]  # [C, ...]
+            out = out + v * (sy * sx * valid.astype(x.dtype))[None]
+    return out
+
+
+def deformable_conv(x, offset, weight, mask=None, bias=None, stride=1,
+                    padding=0, dilation=1, groups: int = 1,
+                    deformable_groups: int = 1):
+    """Deformable convolution v1/v2 (ref: deformable_conv_op.cc /
+    deformable_conv_v1_op.cc; v2 when ``mask`` given).
+
+    x [N,C,H,W]; offset [N, 2*dg*kh*kw, Ho, Wo] ordered (y,x) per kernel
+    point; mask [N, dg*kh*kw, Ho, Wo]. The CUDA im2col-with-offsets kernel
+    becomes a vectorized bilinear gather + one dot_general on the MXU.
+    """
+    n, c, h, w = x.shape
+    oc, icg, kh, kw = weight.shape
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    pad = _conv_padding(padding, 2)
+    ho = (h + pad[0][0] + pad[0][1] - (kh - 1) * dilation[0] - 1) \
+        // stride[0] + 1
+    wo = (w + pad[1][0] + pad[1][1] - (kw - 1) * dilation[1] - 1) \
+        // stride[1] + 1
+    dg = deformable_groups
+    offset = offset.reshape(n, dg, kh * kw, 2, ho, wo)
+    if mask is not None:
+        mask = mask.reshape(n, dg, kh * kw, ho, wo)
+
+    base_y = (jnp.arange(ho) * stride[0] - pad[0][0])[:, None]
+    base_x = (jnp.arange(wo) * stride[1] - pad[1][0])[None, :]
+    ky, kx = jnp.meshgrid(jnp.arange(kh) * dilation[0],
+                          jnp.arange(kw) * dilation[1], indexing="ij")
+    kpos = jnp.stack([ky.ravel(), kx.ravel()], axis=1)  # [kh*kw, 2]
+
+    cg = c // dg  # channels per deformable group
+
+    def per_image(xi, off_i, mask_i):
+        def per_dg(xg, off_g, mask_g):
+            # off_g: [kh*kw, 2, Ho, Wo]
+            yy = base_y[None] + kpos[:, 0, None, None] + off_g[:, 0]
+            xx = base_x[None] + kpos[:, 1, None, None] + off_g[:, 1]
+            samp = _bilinear_gather(xg, yy, xx)  # [cg, kh*kw, Ho, Wo]
+            if mask_g is not None:
+                samp = samp * mask_g[None]
+            return samp
+        if mask_i is None:
+            cols = jax.vmap(per_dg, in_axes=(0, 0, None))(
+                xi.reshape(dg, cg, h, w), off_i, None)
+        else:
+            cols = jax.vmap(per_dg)(xi.reshape(dg, cg, h, w), off_i,
+                                    mask_i)
+        return cols.reshape(c, kh * kw, ho, wo)
+
+    if mask is None:
+        cols = jax.vmap(per_image, in_axes=(0, 0, None))(x, offset, None)
+    else:
+        cols = jax.vmap(per_image)(x, offset, mask)
+    # cols: [N, C, kh*kw, Ho, Wo] → group matmul with weight
+    cols = cols.reshape(n, groups, c // groups, kh * kw, ho, wo)
+    wg = weight.reshape(groups, oc // groups, icg, kh * kw)
+    out = jnp.einsum("ngckhw,gock->ngohw", cols, wg,
+                     preferred_element_type=jnp.float32)
+    out = out.reshape(n, oc, ho, wo).astype(x.dtype)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def row_conv(x, weight, length=None):
+    """Lookahead (row) convolution (ref: row_conv_op.cc): x [B, T, D],
+    weight [future_context, D]; out[t] = Σ_i w[i]·x[t+i]."""
+    k = weight.shape[0]
+    pad = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
+    out = sum(pad[:, i:i + x.shape[1]] * weight[i][None, None]
+              for i in range(k))
+    if length is not None:
+        m = jnp.arange(x.shape[1])[None, :] < length.reshape(-1, 1)
+        out = out * m[:, :, None].astype(out.dtype)
+    return out
+
+
+def var_conv_2d(x, row_length, col_length, weight, out_channels: int,
+                stride=1):
+    """Variable-size 2D conv over per-example (H_i, W_i) images stored
+    padded (ref: var_conv_2d_op.cc). Masked dense conv: positions past
+    each example's (row, col) extent are zeroed before and after."""
+    n, c, h, w = x.shape
+    rm = jnp.arange(h)[None, :] < row_length.reshape(-1, 1)
+    cm = jnp.arange(w)[None, :] < col_length.reshape(-1, 1)
+    m = (rm[:, None, :, None] & cm[:, None, None, :]).astype(x.dtype)
+    out = conv2d(x * m, weight, stride=stride,
+                 padding=(weight.shape[2] // 2, weight.shape[3] // 2))
+    oh, ow = out.shape[2], out.shape[3]
+    s = _pair(stride)
+    rom = jnp.arange(oh)[None, :] < (
+        (row_length + s[0] - 1) // s[0]).reshape(-1, 1)
+    com = jnp.arange(ow)[None, :] < (
+        (col_length + s[1] - 1) // s[1]).reshape(-1, 1)
+    om = (rom[:, None, :, None] & com[:, None, None, :]).astype(out.dtype)
+    return out * om
+
+
+def tree_conv(nodes, edges, weight, max_depth: Optional[int] = None):
+    """Tree-based convolution (TBCNN, ref: tree_conv_op.cc). nodes
+    [B, N, D]; edges [B, E, 2] (parent, child) int pairs (−1 padded);
+    weight [D, 3, out]. Continuous binary-tree position weights η_t/η_l/η_r
+    from the paper, computed over each node's children."""
+    b, n, d = nodes.shape
+    out_dim = weight.shape[2]
+    parent = edges[..., 0]
+    child = edges[..., 1]
+    valid = (parent >= 0) & (child >= 0)
+    p = jnp.where(valid, parent, 0)
+    ch = jnp.where(valid, child, 0)
+    # children count per parent → position of each child among siblings
+    onehot_p = jax.nn.one_hot(p, n, dtype=nodes.dtype) \
+        * valid[..., None].astype(nodes.dtype)
+    n_children = jnp.einsum("ben->bn", onehot_p)  # [B, N]
+    order = jnp.cumsum(onehot_p, axis=1)  # running index per edge
+    pos = jnp.einsum("ben,ben->be", order, onehot_p)  # 1-based child pos
+    nc_e = jnp.take_along_axis(n_children, p, axis=1)  # [B, E]
+    # eta weights (self: t=1; children: t=0, l/r by position)
+    eta_r = jnp.where(nc_e > 1, (pos - 1) / jnp.maximum(nc_e - 1, 1), 0.5)
+    eta_l = 1.0 - eta_r
+    w_t, w_l, w_r = weight[:, 0], weight[:, 1], weight[:, 2]  # [D, out]
+    child_feat = jnp.take_along_axis(
+        nodes, ch[..., None].astype(jnp.int32), axis=1)  # [B, E, D]
+    contrib = (jnp.einsum("bed,do->beo", child_feat, w_l)
+               * eta_l[..., None]
+               + jnp.einsum("bed,do->beo", child_feat, w_r)
+               * eta_r[..., None]) * valid[..., None]
+    agg = jnp.einsum("beo,ben->bno", contrib, onehot_p)
+    self_term = jnp.einsum("bnd,do->bno", nodes, w_t)
+    return jax.nn.tanh(self_term + agg)
+
+
+def spp(x, pyramid_height: int = 3, pool_type: str = "max"):
+    """Spatial pyramid pooling (ref: spp_op.cc): adaptive pools to
+    1×1 … 2^(L−1)×2^(L−1) bins, flattened and concatenated."""
+    outs = []
+    pool = adaptive_max_pool2d if pool_type == "max" \
+        else adaptive_avg_pool2d
+    for level in range(pyramid_height):
+        bins = 2 ** level
+        p = pool(x, (bins, bins))
+        outs.append(p.reshape(x.shape[0], -1))
+    return jnp.concatenate(outs, axis=1)
+
+
+def fsp_matrix(x, y):
+    """Flow-of-solution-procedure matrix for distillation
+    (ref: fsp_op.cc): [B,C1,H,W]×[B,C2,H,W] → [B,C1,C2] / (H·W)."""
+    h, w = x.shape[2], x.shape[3]
+    return jnp.einsum("bihw,bjhw->bij", x, y) / (h * w)
+
+
+def partial_sum(inputs: Sequence, start_index: int = 0,
+                length: int = -1):
+    """(ref: partial_sum_op.cc) sum of the [start:start+length] column
+    slice of each input [B, D]."""
+    stop = None if length < 0 else start_index + length
+    return sum(x[:, start_index:stop] for x in inputs)
+
+
+def partial_concat(inputs: Sequence, start_index: int = 0,
+                   length: int = -1):
+    """(ref: partial_concat_op.cc)."""
+    stop = None if length < 0 else start_index + length
+    return jnp.concatenate([x[:, start_index:stop] for x in inputs],
+                           axis=1)
+
+
+def batch_fc(x, weight, bias=None):
+    """Per-slot batch FC (ref: batch_fc_op.cc): x [S, B, Din],
+    weight [S, Din, Dout], bias [S, Dout]."""
+    out = jnp.einsum("sbi,sio->sbo", x, weight)
+    if bias is not None:
+        out = out + bias[:, None, :]
+    return out
+
+
+def rank_attention(x, rank_offset, rank_param, max_rank: int):
+    """Rank attention for ranking models (ref: rank_attention_op.cc).
+
+    x [B, D]; rank_offset [B, 2*max_rank+1] int: column 0 is the
+    instance's own rank (1-based, 0 = missing), and column 2k+1 the
+    1-based rank of candidate k (0 = absent) — matching the reference's
+    rank_offset encoding (columns 2k+2 hold batch indices, unused here).
+    rank_param [max_rank*max_rank, D, out]: block (i, j) transforms an
+    instance of rank i+1 against a candidate of rank j+1. Output averages
+    x @ block over the PRESENT candidates only; all-absent rows give 0.
+    Dense one-hot selection keeps the contraction on the MXU."""
+    b, d = x.shape
+    out_dim = rank_param.shape[-1]
+    blocks = rank_param.reshape(max_rank, max_rank, d, out_dim)
+    ins_rank = rank_offset[:, 0].astype(jnp.int32)  # 1-based, 0 missing
+    cand_rank = rank_offset[:, 1::2][:, :max_rank].astype(jnp.int32)
+    present = (cand_rank > 0) & (ins_rank > 0)[:, None]  # [B, max_rank]
+    row = jnp.clip(ins_rank - 1, 0, max_rank - 1)
+    col = jnp.clip(cand_rank - 1, 0, max_rank - 1)
+    sel = blocks[row[:, None], col]  # [B, max_rank, D, out]
+    w = present.astype(x.dtype)
+    denom = jnp.maximum(jnp.sum(w, axis=1), 1.0)
+    avg_block = jnp.einsum("brdo,br->bdo", sel, w) / denom[:, None, None]
+    return jnp.einsum("bd,bdo->bo", x, avg_block)
+
+
+def cvm(x, use_cvm: bool = True):
+    """Click-value-model feature op (ref: cvm_op.cc). x [B, D] with
+    columns 0/1 = show/click counts. use_cvm: log-transform those columns;
+    else drop them."""
+    show = jnp.log(x[:, 0:1] + 1.0)
+    ctr = jnp.log(x[:, 1:2] + 1.0) - jnp.log(x[:, 0:1] + 1.0)
+    if use_cvm:
+        return jnp.concatenate([show, ctr, x[:, 2:]], axis=1)
+    return x[:, 2:]
+
+
+def match_matrix_tensor(x, x_len, y, y_len, weight):
+    """Semantic matching tensor (ref: match_matrix_tensor_op.cc):
+    x [B, Tx, D], y [B, Ty, D], weight [D, dim_t, D] →
+    out [B, dim_t, Tx, Ty], masked past lengths."""
+    out = jnp.einsum("bxd,dte,bye->btxy", x, weight, y)
+    mx = jnp.arange(x.shape[1])[None, :] < x_len.reshape(-1, 1)
+    my = jnp.arange(y.shape[1])[None, :] < y_len.reshape(-1, 1)
+    m = (mx[:, None, :, None] & my[:, None, None, :])
+    return out * m.astype(out.dtype)
+
+
+def pyramid_hash(ids, length, embedding, num_buckets: int,
+                 min_win: int = 2, max_win: int = 4,
+                 mul: int = 0x9E3779B1):
+    """Hashed n-gram pyramid embedding (ref: pyramid_hash_op.cc).
+    ids [B, T] int tokens; for every window size in [min_win, max_win]
+    each n-gram hashes into ``embedding [num_buckets, D]``; all gram
+    embeddings are summed per sequence (dense masked form of the
+    reference's per-LoD accumulation)."""
+    b, t = ids.shape
+    d = embedding.shape[1]
+    mask = jnp.arange(t)[None, :] < length.reshape(-1, 1)
+    total = jnp.zeros((b, d), embedding.dtype)
+    ids64 = ids.astype(jnp.uint32)
+    for win in range(min_win, max_win + 1):
+        if win > t:
+            break
+        h = jnp.zeros((b, t - win + 1), jnp.uint32)
+        for i in range(win):
+            h = h * jnp.uint32(mul) + ids64[:, i:t - win + 1 + i]
+        idx = (h % jnp.uint32(num_buckets)).astype(jnp.int32)
+        gram_valid = mask[:, win - 1:]  # window fully inside sequence
+        emb = embedding[idx] * gram_valid[..., None].astype(embedding.dtype)
+        total = total + jnp.sum(emb, axis=1)
+    return total

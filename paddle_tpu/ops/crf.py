@@ -1,0 +1,204 @@
+"""Linear-chain CRF ops.
+
+TPU-native redesign of the reference's CRF operator family
+(/root/reference/paddle/fluid/operators/linear_chain_crf_op.cc,
+crf_decoding_op.cc, chunk_eval_op.cc). The reference walks LoD sequences
+one-by-one on CPU; here sequences are the dense padded ``[B, T, ...]`` +
+lengths layout (ops/sequence.py) and the time recursions are ``lax.scan``
+so the whole batch runs vectorized on TPU, with gradients by autodiff
+instead of the hand-written backward kernel.
+
+Transition parameter layout matches the reference (linear_chain_crf_op.cc
+comment block): ``transition[0]`` = start weights, ``transition[1]`` = end
+weights, ``transition[2:]`` = square tag-to-tag matrix ``a[i][j]`` scoring
+tag ``i`` → tag ``j``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["linear_chain_crf", "crf_decoding", "chunk_eval"]
+
+
+def _split_transition(transition):
+    start = transition[0]
+    end = transition[1]
+    trans = transition[2:]
+    return start, end, trans
+
+
+def linear_chain_crf(emission, transition, label, length):
+    """Negative log-likelihood of tag sequences under a linear-chain CRF.
+
+    (ref: linear_chain_crf_op.cc). Args: emission ``[B, T, D]`` unnormalized
+    scores, transition ``[D+2, D]``, label ``[B, T]`` int tags, length
+    ``[B]``. Returns per-sequence negative log-likelihood ``[B]``
+    (the reference's ``LogLikelihood`` output is also the NLL).
+    """
+    emission = emission.astype(jnp.float32)
+    b, t, d = emission.shape
+    start, end, trans = _split_transition(transition.astype(jnp.float32))
+    label = label.astype(jnp.int32)
+    steps = jnp.arange(t)
+    mask = (steps[None, :] < length.reshape(-1, 1))  # [B, T]
+
+    # --- partition function: alpha recursion in log space ---
+    alpha0 = start[None, :] + emission[:, 0, :]  # [B, D]
+
+    def fwd(alpha, xs):
+        emit_t, mask_t = xs  # [B, D], [B]
+        # alpha'[j] = logsumexp_i(alpha[i] + trans[i, j]) + emit[j]
+        scores = alpha[:, :, None] + trans[None, :, :]  # [B, D, D]
+        new = jax.nn.logsumexp(scores, axis=1) + emit_t
+        alpha = jnp.where(mask_t[:, None], new, alpha)
+        return alpha, None
+
+    xs = (jnp.moveaxis(emission, 1, 0)[1:], mask.T[1:])
+    alpha, _ = lax.scan(fwd, alpha0, xs)
+    log_z = jax.nn.logsumexp(alpha + end[None, :], axis=1)  # [B]
+
+    # --- gold path score ---
+    emit_gold = jnp.take_along_axis(emission, label[:, :, None],
+                                    axis=2)[..., 0]  # [B, T]
+    emit_score = jnp.sum(emit_gold * mask, axis=1)
+    trans_gold = trans[label[:, :-1], label[:, 1:]]  # [B, T-1]
+    trans_score = jnp.sum(trans_gold * mask[:, 1:], axis=1)
+    last = jnp.maximum(length - 1, 0).astype(jnp.int32)
+    last_tag = jnp.take_along_axis(label, last[:, None], axis=1)[:, 0]
+    gold = (start[label[:, 0]] + emit_score + trans_score + end[last_tag])
+    return log_z - gold
+
+
+def crf_decoding(emission, transition, length):
+    """Viterbi decode: most-likely tag path per sequence.
+
+    (ref: crf_decoding_op.cc). Returns ``[B, T]`` int32 tags (entries past
+    ``length`` are 0, matching the padded layout).
+    """
+    emission = emission.astype(jnp.float32)
+    b, t, d = emission.shape
+    start, end, trans = _split_transition(transition.astype(jnp.float32))
+    steps = jnp.arange(t)
+    mask = (steps[None, :] < length.reshape(-1, 1))
+
+    v0 = start[None, :] + emission[:, 0, :]
+
+    def fwd(v, xs):
+        emit_t, mask_t = xs
+        scores = v[:, :, None] + trans[None, :, :]  # [B, i, j]
+        best_prev = jnp.argmax(scores, axis=1)  # [B, D]
+        new = jnp.max(scores, axis=1) + emit_t
+        v_next = jnp.where(mask_t[:, None], new, v)
+        # inactive steps point to themselves so backtracking is identity
+        ptr = jnp.where(mask_t[:, None], best_prev,
+                        jnp.arange(d)[None, :])
+        return v_next, ptr
+
+    xs = (jnp.moveaxis(emission, 1, 0)[1:], mask.T[1:])
+    v_last, ptrs = lax.scan(fwd, v0, xs)  # ptrs: [T-1, B, D]
+    last_tag = jnp.argmax(v_last + end[None, :], axis=1)  # [B]
+
+    def back(tag, ptr):
+        prev = jnp.take_along_axis(ptr, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    first_tag, tags_rev = lax.scan(back, last_tag, ptrs, reverse=True)
+    path = jnp.concatenate([first_tag[None, :], tags_rev], axis=0)  # [T, B]
+    path = jnp.moveaxis(path, 0, 1).astype(jnp.int32)
+    return jnp.where(mask, path, 0)
+
+
+def _chunk_starts_ends(tags, mask, num_chunk_types, scheme="IOB"):
+    """Per-position (is_chunk_start, is_chunk_end, chunk_type) for tagged
+    sequences. Tag encoding follows chunk_eval_op.cc: for IOB,
+    tag = chunk_type * 2 (B) or chunk_type * 2 + 1 (I); the ``O`` tag is
+    ``num_chunk_types * 2`` (any tag >= that is outside)."""
+    if scheme == "IOB":
+        tags_per_type = 2
+        is_begin = (tags % 2 == 0)
+        inside = (tags % 2 == 1)
+    elif scheme == "IOE":
+        tags_per_type = 2
+        is_end_tag = (tags % 2 == 1)
+        inside = (tags % 2 == 0)
+    else:
+        raise ValueError(f"unsupported chunk scheme {scheme}")
+    ctype = tags // tags_per_type
+    valid = mask & (tags < num_chunk_types * tags_per_type)
+    prev_valid = jnp.concatenate(
+        [jnp.zeros_like(valid[:, :1]), valid[:, :-1]], axis=1)
+    prev_type = jnp.concatenate([ctype[:, :1] * 0 - 1, ctype[:, :-1]],
+                                axis=1)
+    next_valid = jnp.concatenate(
+        [valid[:, 1:], jnp.zeros_like(valid[:, :1])], axis=1)
+    next_type = jnp.concatenate([ctype[:, 1:], ctype[:, :1] * 0 - 1],
+                                axis=1)
+    if scheme == "IOB":
+        starts = valid & (is_begin | ~prev_valid | (prev_type != ctype))
+        if_next_cont = next_valid & (next_type == ctype)
+        next_tags = jnp.concatenate(
+            [tags[:, 1:], jnp.zeros_like(tags[:, :1])], axis=1)
+        next_inside = if_next_cont & (next_tags % 2 == 1)
+        ends = valid & ~next_inside
+    else:  # IOE
+        ends = valid & (is_end_tag | ~next_valid | (next_type != ctype))
+        if_prev_cont = prev_valid & (prev_type == ctype)
+        prev_tags = jnp.concatenate(
+            [tags[:, :1] * 0, tags[:, :-1]], axis=1)
+        prev_inside = if_prev_cont & (prev_tags % 2 == 0)
+        starts = valid & ~prev_inside
+    return starts, ends, ctype, valid
+
+
+def chunk_eval(inference, label, length, num_chunk_types,
+               chunk_scheme: str = "IOB"):
+    """Chunk-level precision/recall/F1 counts (ref: chunk_eval_op.cc).
+
+    Returns dict with num_infer_chunks, num_label_chunks,
+    num_correct_chunks, precision, recall, f1.
+    """
+    inference = inference.astype(jnp.int32)
+    label = label.astype(jnp.int32)
+    t = inference.shape[1]
+    mask = jnp.arange(t)[None, :] < length.reshape(-1, 1)
+
+    i_s, i_e, i_t, i_v = _chunk_starts_ends(inference, mask,
+                                            num_chunk_types, chunk_scheme)
+    l_s, l_e, l_t, l_v = _chunk_starts_ends(label, mask,
+                                            num_chunk_types, chunk_scheme)
+    n_infer = jnp.sum(i_s)
+    n_label = jnp.sum(l_s)
+
+    # A chunk is correct when start pos, end pos and type all match —
+    # realized tags may differ (e.g. B- vs I- spelling of the same span),
+    # so agreement is on chunk STRUCTURE: both inside, same type, and
+    # boundaries aligned at every position of the span.
+    same = i_v & l_v & (i_t == l_t) & (i_s == l_s) & (i_e == l_e)
+    # running flag: inside a chunk where both agree since the common start
+    def scan_correct(carry, xs):
+        ok = carry
+        both_start, agree, both_end = xs
+        ok = jnp.where(both_start, agree, ok & agree)
+        emit = ok & both_end
+        return ok, emit
+
+    both_start = (i_s & l_s)
+    both_end = (i_e & l_e)
+    ok0 = jnp.zeros(inference.shape[0], dtype=bool)
+    _, emits = lax.scan(scan_correct, ok0,
+                        (both_start.T, same.T, both_end.T))
+    n_correct = jnp.sum(emits)
+
+    precision = jnp.where(n_infer > 0, n_correct / jnp.maximum(n_infer, 1),
+                          0.0)
+    recall = jnp.where(n_label > 0, n_correct / jnp.maximum(n_label, 1),
+                       0.0)
+    f1 = jnp.where(precision + recall > 0,
+                   2 * precision * recall
+                   / jnp.maximum(precision + recall, 1e-12), 0.0)
+    return {"num_infer_chunks": n_infer, "num_label_chunks": n_label,
+            "num_correct_chunks": n_correct, "precision": precision,
+            "recall": recall, "f1": f1}

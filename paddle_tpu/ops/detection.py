@@ -507,3 +507,117 @@ def generate_proposals(scores, bbox_deltas, anchors, variances, im_shape,
     out_boxes = boxes[jnp.maximum(idx, 0)] * valid[:, None]
     out_scores = jnp.where(valid, sc[jnp.maximum(idx, 0)], 0.0)
     return out_boxes, out_scores, valid
+
+
+def psroi_pool(feat, rois, output_size: Tuple[int, int],
+               output_channels: int, spatial_scale: float = 1.0,
+               roi_batch_indices=None):
+    """Position-sensitive ROI pooling (ref: detection/psroi_pool_op.cu,
+    R-FCN). feat [B, C, H, W] with C = output_channels*ph*pw; each output
+    bin (i,j,c) average-pools its own channel slice c*ph*pw + i*pw + j."""
+    ph, pw = output_size
+    h, w = feat.shape[-2:]
+    if roi_batch_indices is None:
+        roi_batch_indices = jnp.zeros((rois.shape[0],), jnp.int32)
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def one_roi(roi, bidx):
+        x1 = jnp.round(roi[0] * spatial_scale)
+        y1 = jnp.round(roi[1] * spatial_scale)
+        x2 = jnp.round(roi[2] * spatial_scale)
+        y2 = jnp.round(roi[3] * spatial_scale)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bh, bw = rh / ph, rw / pw
+        py = jnp.arange(ph, dtype=jnp.float32)
+        px = jnp.arange(pw, dtype=jnp.float32)
+        ys_lo = jnp.clip(jnp.floor(y1 + py * bh), 0, h)
+        ys_hi = jnp.clip(jnp.ceil(y1 + (py + 1) * bh), 0, h)
+        xs_lo = jnp.clip(jnp.floor(x1 + px * bw), 0, w)
+        xs_hi = jnp.clip(jnp.ceil(x1 + (px + 1) * bw), 0, w)
+        ym = (ys[None, :] >= ys_lo[:, None]) & (ys[None, :] < ys_hi[:, None])
+        xm = (xs[None, :] >= xs_lo[:, None]) & (xs[None, :] < xs_hi[:, None])
+        m = (ym[:, None, :, None] & xm[None, :, None, :]).astype(feat.dtype)
+        f = feat[bidx].reshape(output_channels, ph, pw, h, w)
+        s = jnp.einsum("cijhw,ijhw->cij", f, m)
+        cnt = jnp.maximum(m.sum(axis=(-2, -1)), 1.0)
+        return s / cnt[None]
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32), roi_batch_indices)
+
+
+def prroi_pool(feat, rois, output_size: Tuple[int, int],
+               spatial_scale: float = 1.0, roi_batch_indices=None,
+               samples_per_bin: int = 4):
+    """Precise ROI pooling (ref: prroi_pool_op.cc). The exact-integral CUDA
+    kernel is approximated by dense bilinear sampling (samples_per_bin² per
+    bin) — continuous, fully differentiable w.r.t. both features and ROI
+    coordinates, which is the property PrRoIPool exists for."""
+    ph, pw = output_size
+    sr = samples_per_bin
+    if roi_batch_indices is None:
+        roi_batch_indices = jnp.zeros((rois.shape[0],), jnp.int32)
+
+    def one_roi(roi, bidx):
+        x1, y1, x2, y2 = roi * spatial_scale
+        rh = jnp.maximum(y2 - y1, 1e-6)
+        rw = jnp.maximum(x2 - x1, 1e-6)
+        bh, bw = rh / ph, rw / pw
+        frac = (jnp.arange(sr, dtype=jnp.float32) + 0.5) / sr
+        gy = y1 + jnp.arange(ph, dtype=jnp.float32)[:, None] * bh \
+            + frac[None, :] * bh
+        gx = x1 + jnp.arange(pw, dtype=jnp.float32)[:, None] * bw \
+            + frac[None, :] * bw
+        yy = jnp.broadcast_to(gy[:, None, :, None], (ph, pw, sr, sr))
+        xx = jnp.broadcast_to(gx[None, :, None, :], (ph, pw, sr, sr))
+        sampled = _bilinear_sample(feat[bidx], yy, xx)
+        return sampled.mean(axis=(-2, -1))
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32), roi_batch_indices)
+
+
+def roi_perspective_transform(feat, rois, transformed_height: int,
+                              transformed_width: int,
+                              spatial_scale: float = 1.0,
+                              roi_batch_indices=None):
+    """Perspective-warp quadrilateral ROIs to a fixed size (ref:
+    detection/roi_perspective_transform_op.cc, OCR text rectification).
+    rois [R, 8]: quad corners (x1..x4, y1..y4) clockwise from top-left.
+    Output [R, C, th, tw] by bilinear sampling the inverse homography."""
+    th, tw = transformed_height, transformed_width
+    if roi_batch_indices is None:
+        roi_batch_indices = jnp.zeros((rois.shape[0],), jnp.int32)
+
+    def homography(quad):
+        # map unit square corners (0,0),(1,0),(1,1),(0,1) → quad pts
+        x = quad[0:4] * spatial_scale
+        y = quad[4:8] * spatial_scale
+        sx = jnp.array([0.0, 1.0, 1.0, 0.0])
+        sy = jnp.array([0.0, 0.0, 1.0, 1.0])
+        # build 8x8 system for projective transform coefficients
+        a = []
+        b = []
+        for i in range(4):
+            a.append(jnp.stack([sx[i], sy[i], 1.0, 0.0, 0.0, 0.0,
+                                -sx[i] * x[i], -sy[i] * x[i]]))
+            b.append(x[i])
+            a.append(jnp.stack([0.0, 0.0, 0.0, sx[i], sy[i], 1.0,
+                                -sx[i] * y[i], -sy[i] * y[i]]))
+            b.append(y[i])
+        A = jnp.stack(a)
+        B = jnp.stack(b)
+        coef = jnp.linalg.solve(A, B)
+        return coef  # [8]
+
+    def one_roi(roi, bidx):
+        c = homography(roi.astype(jnp.float32))
+        u = (jnp.arange(tw, dtype=jnp.float32) + 0.5) / tw
+        v = (jnp.arange(th, dtype=jnp.float32) + 0.5) / th
+        uu, vv = jnp.meshgrid(u, v)  # [th, tw]
+        denom = c[6] * uu + c[7] * vv + 1.0
+        xs = (c[0] * uu + c[1] * vv + c[2]) / denom
+        ys = (c[3] * uu + c[4] * vv + c[5]) / denom
+        return _bilinear_sample(feat[bidx], ys, xs)  # [C, th, tw]
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32), roi_batch_indices)

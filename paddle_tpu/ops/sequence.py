@@ -202,3 +202,138 @@ def sequence_first_step(x, length):
 
 def sequence_last_step(x, length):
     return sequence_pool(x, length, "last")
+
+
+def sequence_conv(x, length, weight, context_length: int,
+                  context_start: int = 0, bias=None):
+    """(ref: sequence_conv_op.cc) 1-D context-window conv over time:
+    x [B, T, D], weight [context_length * D, out]. Positions outside the
+    sequence contribute zeros, matching the reference's context padding."""
+    b, t, d = x.shape
+    cols = []
+    for i in range(context_length):
+        shift = context_start + i
+        idx = jnp.clip(jnp.arange(t) + shift, 0, t - 1)
+        col = x[:, idx]
+        valid = ((jnp.arange(t) + shift >= 0)[None, :]
+                 & ((jnp.arange(t) + shift) < length.reshape(-1, 1)))
+        cols.append(col * valid[:, :, None].astype(x.dtype))
+    ctx = jnp.concatenate(cols, axis=2)  # [B, T, ctx*D]
+    out = jnp.einsum("btc,co->bto", ctx, weight)
+    if bias is not None:
+        out = out + bias
+    m = (jnp.arange(t)[None, :] < length.reshape(-1, 1))
+    return out * m[:, :, None].astype(out.dtype)
+
+
+def sequence_expand_as(x, y_length):
+    """(ref: sequence_expand_as_op.cc) repeat row i of x y_length[i]
+    times along time: x [B, D] → [B, max_T, D] masked."""
+    t = int(jnp.max(y_length)) if not isinstance(y_length, jax.core.Tracer) \
+        else None
+    if t is None:
+        raise ValueError("sequence_expand_as needs concrete lengths or use "
+                         "sequence_expand_dense under jit")
+    out = jnp.repeat(x[:, None], t, axis=1)
+    m = jnp.arange(t)[None, :] < y_length.reshape(-1, 1)
+    return out * m.reshape(m.shape + (1,) * (x.ndim - 1)).astype(x.dtype)
+
+
+def sequence_reshape(x, length, new_dim: int):
+    """(ref: sequence_reshape_op.cc) refold each row's valid region into
+    width new_dim; returns (x', new_length). The reference enforces
+    len*D % new_dim == 0 per row; with concrete lengths that check raises
+    here too, and under tracing new_length rounds UP so a partial final
+    group is zero-padded rather than silently dropped."""
+    import numpy as _np
+    b, t, d = x.shape
+    if t * d % new_dim != 0:
+        raise ValueError(
+            f"sequence_reshape: padded row size {t}*{d} not divisible by "
+            f"new_dim {new_dim}")
+    if not isinstance(length, jax.core.Tracer):
+        lens = _np.asarray(length)
+        if _np.any(lens * d % new_dim):
+            raise ValueError(
+                f"sequence_reshape: row lengths {lens.tolist()} * dim {d} "
+                f"not divisible by new_dim {new_dim} "
+                "(ref sequence_reshape_op.cc enforces this)")
+    flat = x.reshape(b, t * d)
+    nt = t * d // new_dim
+    out = flat.reshape(b, nt, new_dim)
+    new_len = -((length * d) // -new_dim)  # ceil: keep partial groups
+    m = jnp.arange(nt)[None, :] < new_len.reshape(-1, 1)
+    return out * m[:, :, None].astype(x.dtype), new_len.astype(jnp.int32)
+
+
+def sequence_scatter(x, index, updates, updates_length):
+    """(ref: sequence_scatter_op.cc) per-row scatter-add of ragged
+    updates: x [B, D], index [B, U] positions, updates [B, U]."""
+    b, u = index.shape
+    m = (jnp.arange(u)[None, :] < updates_length.reshape(-1, 1))
+    upd = updates * m.astype(updates.dtype)
+    idx = jnp.clip(index, 0, x.shape[1] - 1).astype(jnp.int32)
+    onehot = jax.nn.one_hot(idx, x.shape[1], dtype=x.dtype)  # [B, U, D]
+    return x + jnp.einsum("bud,bu->bd", onehot, upd)
+
+
+def sequence_topk_avg_pooling(x, row_length, col_length, topks,
+                              channel_num: int):
+    """(ref: sequence_topk_avg_pooling_op.cc) x [B, C, R, Cc] match
+    matrices: per row, average of top-k column scores for each k in
+    ``topks``; output [B, R, C*len(topks)] masked by row/col lengths."""
+    b, c, r, cc = x.shape
+    cm = jnp.arange(cc)[None, :] < col_length.reshape(-1, 1)  # [B, Cc]
+    neg = jnp.finfo(x.dtype).min
+    masked = jnp.where(cm[:, None, None, :], x, neg)
+    k_max = max(topks)
+    vals = jax.lax.top_k(masked, min(k_max, cc))[0]  # [B, C, R, k]
+    outs = []
+    for k in topks:
+        kk = min(k, cc)
+        avail = jnp.minimum(col_length, kk).reshape(-1, 1, 1)
+        take = vals[..., :kk]
+        pos_ok = jnp.arange(kk)[None, None, None, :] < avail[..., None]
+        s = jnp.sum(jnp.where(pos_ok, take, 0.0), axis=-1)
+        outs.append(s / jnp.maximum(avail, 1))  # [B, C, R]
+    out = jnp.stack(outs, axis=-1).reshape(b, c, r, len(topks))
+    out = jnp.moveaxis(out, 1, 2).reshape(b, r, c * len(topks))
+    rm = jnp.arange(r)[None, :] < row_length.reshape(-1, 1)
+    return out * rm[:, :, None].astype(out.dtype)
+
+
+def lod_reset(x, length, new_length):
+    """(ref: lod_reset_op.cc) re-segment the flat concatenated timeline
+    under new per-row lengths. The reference reassigns LoD offsets over
+    the same flat buffer; in the dense padded layout that means
+    left-packing the valid elements of ``x`` and re-splitting them by
+    ``new_length``. Needs concrete (host) lengths — re-segmentation
+    changes the padded output shape."""
+    import numpy as _np
+    if isinstance(length, jax.core.Tracer) \
+            or isinstance(new_length, jax.core.Tracer):
+        raise ValueError("lod_reset re-segments rows and therefore needs "
+                         "concrete lengths (host-side, not under jit)")
+    lens = _np.asarray(length).astype(_np.int64)
+    new_lens = _np.asarray(new_length).astype(_np.int64)
+    if lens.sum() != new_lens.sum():
+        raise ValueError(
+            f"lod_reset: old lengths sum {lens.sum()} != new lengths sum "
+            f"{new_lens.sum()}")
+    b, t = x.shape[0], x.shape[1]
+    tail = x.shape[2:]
+    # left-pack valid steps into the flat timeline
+    flat = x.reshape(b * t, *tail)
+    valid = (_np.arange(t)[None, :] < lens[:, None]).reshape(-1)
+    packed = flat[_np.nonzero(valid)[0]]
+    # re-split by the new segmentation
+    nb = len(new_lens)
+    nt = int(new_lens.max()) if nb else 0
+    out = jnp.zeros((nb, nt) + tail, x.dtype)
+    off = 0
+    for i, ln in enumerate(new_lens):
+        ln = int(ln)
+        if ln:
+            out = out.at[i, :ln].set(packed[off:off + ln])
+        off += ln
+    return out, jnp.asarray(new_lens, jnp.int32)

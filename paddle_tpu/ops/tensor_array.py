@@ -1,0 +1,116 @@
+"""TensorArray — the LoDTensorArray analogue.
+
+TPU-native redesign of the reference's tensor-array machinery
+(/root/reference/paddle/fluid/operators/controlflow/: write_to_array,
+read_from_array ops; lod_tensor_array ops array_to_lod_tensor_op.cc,
+lod_tensor_to_array_op.cc, tensor_array_to_tensor_op.cc; and the RNN
+memory helpers rnn_memory_helper_op.cc, shrink_rnn_memory_op.cc). The
+reference mutates a vector<LoDTensor> inside the executor; under XLA the
+array is a **fixed-capacity stacked buffer + dynamic writes** so it works
+both eagerly and as a ``lax.scan``/``while_loop`` carry.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["TensorArray", "create_array", "array_write", "array_read",
+           "array_length", "tensor_array_to_tensor",
+           "lod_tensor_to_array", "array_to_lod_tensor"]
+
+
+@jax.tree_util.register_pytree_node_class
+class TensorArray:
+    """Fixed-capacity stacked tensor array usable as a jit/scan carry.
+
+    ``data`` is ``[capacity, *elem_shape]``; ``size`` a scalar int32
+    tracking the high-water mark (write index + 1).
+    """
+
+    def __init__(self, data, size):
+        self.data = data
+        self.size = size
+
+    @classmethod
+    def empty(cls, capacity: int, elem_shape: Sequence[int],
+              dtype="float32"):
+        return cls(jnp.zeros((capacity,) + tuple(elem_shape),
+                             jnp.dtype(dtype)),
+                   jnp.zeros((), jnp.int32))
+
+    def write(self, index, value) -> "TensorArray":
+        index = jnp.asarray(index, jnp.int32)
+        data = lax.dynamic_update_index_in_dim(
+            self.data, value.astype(self.data.dtype), index, axis=0)
+        size = jnp.maximum(self.size, index + 1)
+        return TensorArray(data, size)
+
+    def read(self, index):
+        return lax.dynamic_index_in_dim(
+            self.data, jnp.asarray(index, jnp.int32), axis=0,
+            keepdims=False)
+
+    def __len__(self):
+        return int(self.size)
+
+    def stack(self):
+        """All written elements as one tensor (zeros past ``size``)."""
+        return self.data
+
+    def tree_flatten(self):
+        return (self.data, self.size), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def create_array(capacity: int, elem_shape: Sequence[int],
+                 dtype="float32") -> TensorArray:
+    """(ref: fill_constant_array / create LOD_TENSOR_ARRAY var)."""
+    return TensorArray.empty(capacity, elem_shape, dtype)
+
+
+def array_write(array: TensorArray, i, x) -> TensorArray:
+    """(ref: controlflow write_to_array op)."""
+    return array.write(i, x)
+
+
+def array_read(array: TensorArray, i):
+    """(ref: controlflow read_from_array op)."""
+    return array.read(i)
+
+
+def array_length(array: TensorArray):
+    """(ref: lod_array_length_op.cc)."""
+    return array.size
+
+
+def tensor_array_to_tensor(array: TensorArray, axis: int = 0,
+                           use_stack: bool = True):
+    """(ref: tensor_array_to_tensor_op.cc). With use_stack the result is
+    ``[capacity, ...]`` (entries past size are zeros — capacity is the
+    static bound); otherwise elements are concatenated along ``axis``."""
+    if use_stack:
+        return jnp.moveaxis(array.data, 0, axis)
+    parts = [array.data[i] for i in range(array.data.shape[0])]
+    return jnp.concatenate(parts, axis=axis)
+
+
+def lod_tensor_to_array(x, length, max_len: Optional[int] = None):
+    """(ref: lod_tensor_to_array_op.cc). Padded batch [B, T, ...] →
+    TensorArray of T timesteps each [B, ...] (the RNN layout), with the
+    per-step valid-row count implied by ``length``."""
+    t = x.shape[1] if max_len is None else max_len
+    data = jnp.moveaxis(x[:, :t], 1, 0)
+    return TensorArray(data, jnp.asarray(t, jnp.int32))
+
+
+def array_to_lod_tensor(array: TensorArray):
+    """(ref: array_to_lod_tensor_op.cc). Inverse: [T, B, ...] steps back
+    to the padded [B, T, ...] batch."""
+    return jnp.moveaxis(array.data, 0, 1)

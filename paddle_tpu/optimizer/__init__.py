@@ -487,3 +487,63 @@ LarsMomentumOptimizer = LarsMomentum
 
 from .extras import (ExponentialMovingAverage, GradientMerge,  # noqa: E402
                      Lookahead, ModelAverage)  # noqa: F401
+
+
+class DecayedAdagrad(Optimizer):
+    """(ref: decayed_adagrad_op.cc)."""
+
+    def __init__(self, learning_rate=0.001, decay: float = 0.95,
+                 epsilon: float = 1e-6, **kw) -> None:
+        super().__init__(learning_rate, **kw)
+        self.decay, self.epsilon = decay, epsilon
+
+    def init_slots(self, p):
+        return {"moment": jnp.zeros_like(p)}
+
+    def update(self, p, g, slots, lr_t, step):
+        g = g.astype(p.dtype)
+        moment = self.decay * slots["moment"] \
+            + (1 - self.decay) * jnp.square(g)
+        return p - lr_t * g / (jnp.sqrt(moment) + self.epsilon), \
+            {"moment": moment}
+
+
+class ProximalGD(Optimizer):
+    """(ref: proximal_gd_op.cc) SGD with L1/L2 proximal projection."""
+
+    def __init__(self, learning_rate=0.001, l1: float = 0.0,
+                 l2: float = 0.0, **kw) -> None:
+        super().__init__(learning_rate, **kw)
+        self.l1, self.l2 = l1, l2
+
+    def init_slots(self, p):
+        return {}
+
+    def update(self, p, g, slots, lr_t, step):
+        g = g.astype(p.dtype)
+        prox = p - lr_t * g
+        new_p = jnp.sign(prox) * jnp.maximum(
+            jnp.abs(prox) - lr_t * self.l1, 0.0) / (1.0 + lr_t * self.l2)
+        return new_p, {}
+
+
+class ProximalAdagrad(Optimizer):
+    """(ref: proximal_adagrad_op.cc)."""
+
+    def __init__(self, learning_rate=0.001, l1: float = 0.0,
+                 l2: float = 0.0, epsilon: float = 1e-10, **kw) -> None:
+        super().__init__(learning_rate, **kw)
+        self.l1, self.l2, self.epsilon = l1, l2, epsilon
+
+    def init_slots(self, p):
+        return {"moment": jnp.zeros_like(p)}
+
+    def update(self, p, g, slots, lr_t, step):
+        g = g.astype(p.dtype)
+        moment = slots["moment"] + jnp.square(g)
+        adapted_lr = lr_t / (jnp.sqrt(moment) + self.epsilon)
+        prox = p - adapted_lr * g
+        new_p = jnp.sign(prox) * jnp.maximum(
+            jnp.abs(prox) - adapted_lr * self.l1, 0.0) \
+            / (1.0 + adapted_lr * self.l2)
+        return new_p, {"moment": moment}

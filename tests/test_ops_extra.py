@@ -1,0 +1,555 @@
+"""Tests for the extended op families: CRF, beam search, sampled
+classifiers, conv extras, tensor array, new sequence ops, new optimizers.
+
+Mirrors the reference's OpTest methodology (SURVEY.md §4): numpy
+reference implementations / brute-force checks against the XLA lowering.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import ops
+
+
+# ---------------------------------------------------------------------------
+# CRF
+# ---------------------------------------------------------------------------
+
+def _crf_brute(emission, transition, length):
+    """Enumerate all paths for tiny cases."""
+    import itertools
+    start, end, trans = transition[0], transition[1], transition[2:]
+    d = emission.shape[1]
+    scores = {}
+    for path in itertools.product(range(d), repeat=length):
+        s = start[path[0]] + emission[0][path[0]]
+        for t in range(1, length):
+            s += trans[path[t - 1]][path[t]] + emission[t][path[t]]
+        s += end[path[-1]]
+        scores[path] = s
+    return scores
+
+
+def test_linear_chain_crf_matches_bruteforce(rng):
+    d, t = 3, 4
+    em = rng.normal(size=(2, t, d)).astype(np.float32)
+    trans = rng.normal(size=(d + 2, d)).astype(np.float32)
+    label = rng.integers(0, d, size=(2, t))
+    lengths = np.array([4, 3], np.int32)
+    nll = ops.linear_chain_crf(jnp.asarray(em), jnp.asarray(trans),
+                               jnp.asarray(label), jnp.asarray(lengths))
+    for b in range(2):
+        scores = _crf_brute(em[b], trans, int(lengths[b]))
+        gold = scores[tuple(label[b][:lengths[b]])]
+        log_z = np.log(sum(np.exp(s) for s in scores.values()))
+        np.testing.assert_allclose(float(nll[b]), log_z - gold, rtol=1e-4)
+
+
+def test_crf_decoding_matches_bruteforce(rng):
+    d, t = 3, 4
+    em = rng.normal(size=(2, t, d)).astype(np.float32)
+    trans = rng.normal(size=(d + 2, d)).astype(np.float32)
+    lengths = np.array([4, 2], np.int32)
+    path = ops.crf_decoding(jnp.asarray(em), jnp.asarray(trans),
+                            jnp.asarray(lengths))
+    for b in range(2):
+        scores = _crf_brute(em[b], trans, int(lengths[b]))
+        best = max(scores, key=scores.get)
+        assert tuple(np.asarray(path[b][:lengths[b]])) == best
+        assert np.all(np.asarray(path[b][lengths[b]:]) == 0)
+
+
+def test_linear_chain_crf_grad_finite(rng):
+    d, t = 4, 5
+    em = jnp.asarray(rng.normal(size=(3, t, d)), jnp.float32)
+    trans = jnp.asarray(rng.normal(size=(d + 2, d)), jnp.float32)
+    label = jnp.asarray(rng.integers(0, d, size=(3, t)))
+    lengths = jnp.asarray([5, 3, 1], jnp.int32)
+
+    def loss(trans):
+        return jnp.sum(ops.linear_chain_crf(em, trans, label, lengths))
+
+    g = jax.grad(loss)(trans)
+    assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_chunk_eval_iob():
+    # tags: B-type0=0, I-type0=1, B-type1=2, I-type1=3, O=4
+    label = jnp.asarray([[0, 1, 4, 2, 3, 4]])
+    infer = jnp.asarray([[0, 1, 4, 2, 4, 4]])  # second chunk wrong end
+    out = ops.chunk_eval(infer, label, jnp.asarray([6]), num_chunk_types=2)
+    assert int(out["num_label_chunks"]) == 2
+    assert int(out["num_infer_chunks"]) == 2
+    assert int(out["num_correct_chunks"]) == 1
+    np.testing.assert_allclose(float(out["precision"]), 0.5)
+
+
+def test_chunk_eval_boundary_match_not_tag_match():
+    """A chunk realized with different tags (B-0 vs leading I-0) but the
+    same (start, end, type) counts as correct (ref chunk_eval_op.cc)."""
+    label = jnp.asarray([[4, 1, 1]])   # O, I-0, I-0 → chunk (1..2, type 0)
+    infer = jnp.asarray([[4, 0, 1]])   # O, B-0, I-0 → same span
+    out = ops.chunk_eval(infer, label, jnp.asarray([3]), num_chunk_types=2)
+    assert int(out["num_correct_chunks"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# beam search
+# ---------------------------------------------------------------------------
+
+def test_beam_search_step_selects_topk():
+    lp = jnp.log(jnp.asarray([[[0.1, 0.6, 0.3], [0.4, 0.4, 0.2]]]))
+    scores = jnp.zeros((1, 2))
+    fin = jnp.zeros((1, 2), bool)
+    tok, parent, new_scores, new_fin = ops.beam_search_step(
+        lp, scores, fin, beam_size=2, end_id=0)
+    # best two: beam0-tok1 (0.6), beam1-tok0 (0.4) tie beam1-tok1
+    assert int(tok[0, 0]) == 1 and int(parent[0, 0]) == 0
+    assert float(new_scores[0, 0]) == pytest.approx(np.log(0.6), rel=1e-5)
+
+
+def test_gather_tree():
+    ids = jnp.asarray([[[2, 5]], [[6, 3]], [[9, 1]]])  # [T=3, B=1, beam=2]
+    parents = jnp.asarray([[[0, 0]], [[1, 0]], [[0, 1]]])
+    out = ops.gather_tree(ids, parents)
+    # beam 0 final: t2 tok 9 parent 0 → t1 tok 6 parent 1 → t0 tok 5
+    assert list(np.asarray(out[:, 0, 0])) == [5, 6, 9]
+    # beam 1 final: t2 tok 1 parent 1 → t1 tok 3 parent 0 → t0 tok 2
+    assert list(np.asarray(out[:, 0, 1])) == [2, 3, 1]
+
+
+def test_beam_search_full_greedy_agrees():
+    """With beam 1 the scan must reproduce greedy decoding."""
+    vocab, d = 7, 4
+    rng = np.random.default_rng(3)
+    emb = jnp.asarray(rng.normal(size=(vocab, d)), jnp.float32)
+    proj = jnp.asarray(rng.normal(size=(d, vocab)), jnp.float32)
+
+    def step_fn(tokens, cell):
+        logits = emb[tokens] @ proj  # [B, beam, vocab]
+        return jax.nn.log_softmax(logits), cell
+
+    seqs, scores = ops.beam_search(step_fn, {}, batch=2, beam_size=1,
+                                   max_len=5, bos_id=1, end_id=0)
+    # greedy reference
+    toks = np.full((2, 1), 1)
+    out = []
+    for _ in range(5):
+        lp = np.asarray(jax.nn.log_softmax(emb[toks] @ proj))
+        toks = lp.argmax(-1)
+        out.append(toks[:, 0])
+    greedy = np.stack(out, 1)
+    np.testing.assert_array_equal(np.asarray(seqs[:, 0, :]), greedy)
+
+
+def test_beam_search_decode_pads_after_end():
+    ids = jnp.asarray([[[4, 4]], [[0, 2]], [[3, 0]]])
+    parents = jnp.zeros((3, 1, 2), jnp.int32)
+    out = ops.beam_search_decode(ids, parents, end_id=0)
+    seq0 = list(np.asarray(out[0, 0]))
+    assert seq0[1] == 0 and seq0[2] == 0  # ended at t=1
+
+
+# ---------------------------------------------------------------------------
+# sampled classifiers
+# ---------------------------------------------------------------------------
+
+def test_hsigmoid_loss_decreases_with_training(rng):
+    b, d, n_cls = 16, 8, 10
+    x = jnp.asarray(rng.normal(size=(b, d)), jnp.float32)
+    label = jnp.asarray(rng.integers(0, n_cls, size=(b,)))
+    w = jnp.asarray(rng.normal(size=(n_cls, d)) * 0.1, jnp.float32)
+
+    def loss_fn(w):
+        return jnp.mean(ops.hsigmoid_loss(x, w, label, num_classes=n_cls))
+
+    l0 = loss_fn(w)
+    g = jax.grad(loss_fn)(w)
+    l1 = loss_fn(w - 0.5 * g)
+    assert float(l1) < float(l0)
+    assert float(l0) > 0
+
+
+def test_hsigmoid_custom_path(rng):
+    b, d = 4, 6
+    x = jnp.asarray(rng.normal(size=(b, d)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(7, d)), jnp.float32)
+    table = jnp.asarray(rng.integers(0, 7, size=(b, 3)))
+    code = jnp.asarray(rng.integers(0, 2, size=(b, 3)))
+    out = ops.hsigmoid_loss(x, w, None, path_table=table, path_code=code)
+    assert out.shape == (b,) and np.all(np.asarray(out) > 0)
+
+
+def test_nce_loss_trains_toward_true_class(rng):
+    b, d, n_cls = 32, 16, 50
+    x = jnp.asarray(rng.normal(size=(b, d)), jnp.float32)
+    label = jnp.asarray(rng.integers(0, n_cls, size=(b,)))
+    w = jnp.zeros((n_cls, d), jnp.float32)
+
+    def loss_fn(w):
+        return jnp.mean(ops.nce_loss(x, w, label, n_cls,
+                                     num_neg_samples=8))
+
+    g = jax.grad(loss_fn)(w)
+    assert np.all(np.isfinite(np.asarray(g)))
+    l0, l1 = float(loss_fn(w)), float(loss_fn(w - 1.0 * g))
+    assert l1 < l0
+
+
+def test_sampled_softmax(rng):
+    b, d, n_cls = 8, 4, 100
+    x = jnp.asarray(rng.normal(size=(b, d)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(n_cls, d)) * 0.01, jnp.float32)
+    label = jnp.asarray(rng.integers(0, n_cls, size=(b,)))
+    out = ops.sampled_softmax_with_cross_entropy(x, w, label, n_cls,
+                                                 num_samples=20)
+    assert out.shape == (b,) and np.all(np.asarray(out) > 0)
+
+
+# ---------------------------------------------------------------------------
+# conv extras
+# ---------------------------------------------------------------------------
+
+def test_conv3d_transpose_inverts_stride_shape(rng):
+    x = jnp.asarray(rng.normal(size=(2, 3, 4, 5, 6)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(3, 4, 3, 3, 3)), jnp.float32)
+    out = ops.conv3d_transpose(x, w, stride=2, padding=1)
+    assert out.shape == (2, 4, 7, 9, 11)
+
+
+def test_conv3d_transpose_is_conv3d_gradient(rng):
+    """transpose-conv == vjp of forward conv w.r.t. input."""
+    x = jnp.asarray(rng.normal(size=(1, 2, 5, 5, 5)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(3, 2, 3, 3, 3)), jnp.float32)
+    dy = jnp.asarray(rng.normal(size=(1, 3, 5, 5, 5)), jnp.float32)
+    _, vjp = jax.vjp(lambda x: ops.conv3d(x, w, padding=1), x)
+    expect = vjp(dy)[0]
+    # transpose conv with swapped io: weight [in=3, out=2, ...]
+    got = ops.conv3d_transpose(dy, w.transpose(0, 1, 2, 3, 4),
+                               stride=1, padding=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_deformable_conv_zero_offset_equals_conv(rng):
+    x = jnp.asarray(rng.normal(size=(2, 4, 8, 8)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(6, 4, 3, 3)), jnp.float32)
+    off = jnp.zeros((2, 2 * 9, 8, 8), jnp.float32)
+    out = ops.deformable_conv(x, off, w, padding=1)
+    ref = ops.conv2d(x, w, padding=1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_deformable_conv_v2_mask(rng):
+    x = jnp.asarray(rng.normal(size=(1, 2, 6, 6)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(3, 2, 3, 3)), jnp.float32)
+    off = jnp.zeros((1, 18, 6, 6), jnp.float32)
+    mask = jnp.full((1, 9, 6, 6), 0.5, jnp.float32)
+    out = ops.deformable_conv(x, off, w, mask=mask, padding=1)
+    ref = ops.conv2d(x, w, padding=1)
+    np.testing.assert_allclose(np.asarray(out), 0.5 * np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_row_conv(rng):
+    x = jnp.asarray(rng.normal(size=(2, 5, 3)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(2, 3)), jnp.float32)
+    out = ops.row_conv(x, w)
+    expect = np.asarray(x[:, 3] * w[0] + x[:, 4] * w[1])
+    np.testing.assert_allclose(np.asarray(out[:, 3]), expect, rtol=1e-5)
+    # last step only sees itself
+    np.testing.assert_allclose(np.asarray(out[:, 4]),
+                               np.asarray(x[:, 4] * w[0]), rtol=1e-5)
+
+
+def test_spp_shapes(rng):
+    x = jnp.asarray(rng.normal(size=(2, 3, 9, 9)), jnp.float32)
+    out = ops.spp(x, pyramid_height=3)
+    assert out.shape == (2, 3 * (1 + 4 + 16))
+
+
+def test_fsp_matrix(rng):
+    a = jnp.asarray(rng.normal(size=(2, 3, 4, 4)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(2, 5, 4, 4)), jnp.float32)
+    out = ops.fsp_matrix(a, b)
+    expect = np.einsum("bihw,bjhw->bij", a, b) / 16
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-4)
+
+
+def test_partial_sum_concat(rng):
+    xs = [jnp.asarray(rng.normal(size=(3, 6)), jnp.float32)
+          for _ in range(2)]
+    s = ops.partial_sum(xs, 1, 3)
+    np.testing.assert_allclose(np.asarray(s),
+                               np.asarray(xs[0][:, 1:4] + xs[1][:, 1:4]),
+                               rtol=1e-5)
+    c = ops.partial_concat(xs, 0, 2)
+    assert c.shape == (3, 4)
+
+
+def test_batch_fc(rng):
+    x = jnp.asarray(rng.normal(size=(2, 3, 4)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(2, 4, 5)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(2, 5)), jnp.float32)
+    out = ops.batch_fc(x, w, b)
+    expect = np.einsum("sbi,sio->sbo", x, w) + np.asarray(b)[:, None]
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_rank_attention_selects_present_blocks(rng):
+    b, d, out_d, mr = 2, 3, 4, 3
+    x = jnp.asarray(rng.normal(size=(b, d)), jnp.float32)
+    param = jnp.asarray(rng.normal(size=(mr * mr, d, out_d)), jnp.float32)
+    # ins 0: rank 1, single candidate rank 2 → block (0, 1) exactly
+    # ins 1: rank 0 (missing) → zeros
+    ro = jnp.asarray([[1, 2, 0, 0, 0, 0, 0],
+                      [0, 1, 0, 0, 0, 0, 0]], jnp.int32)
+    out = ops.rank_attention(x, ro, param, max_rank=mr)
+    blocks = np.asarray(param).reshape(mr, mr, d, out_d)
+    np.testing.assert_allclose(np.asarray(out[0]),
+                               np.asarray(x[0]) @ blocks[0, 1], rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out[1]), np.zeros(out_d),
+                               atol=1e-6)
+
+
+def test_cvm():
+    x = jnp.asarray([[4.0, 1.0, 0.5, 0.25]])
+    out = ops.cvm(x, use_cvm=True)
+    np.testing.assert_allclose(
+        np.asarray(out[0, :2]),
+        [np.log(5.0), np.log(2.0) - np.log(5.0)], rtol=1e-5)
+    out2 = ops.cvm(x, use_cvm=False)
+    assert out2.shape == (1, 2)
+
+
+def test_match_matrix_tensor(rng):
+    x = jnp.asarray(rng.normal(size=(2, 3, 4)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(2, 5, 4)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(4, 2, 4)), jnp.float32)
+    xl = jnp.asarray([3, 2])
+    yl = jnp.asarray([5, 1])
+    out = ops.match_matrix_tensor(x, xl, y, yl, w)
+    assert out.shape == (2, 2, 3, 5)
+    assert np.all(np.asarray(out[1, :, 2:, :]) == 0)
+    assert np.all(np.asarray(out[1, :, :, 1:]) == 0)
+
+
+def test_pyramid_hash(rng):
+    ids = jnp.asarray(rng.integers(1, 100, size=(2, 6)))
+    emb = jnp.asarray(rng.normal(size=(64, 8)), jnp.float32)
+    out = ops.pyramid_hash(ids, jnp.asarray([6, 3]), emb, num_buckets=64)
+    assert out.shape == (2, 8)
+    # shorter sequence has fewer grams → generally different result
+    out2 = ops.pyramid_hash(ids, jnp.asarray([6, 6]), emb, num_buckets=64)
+    assert not np.allclose(np.asarray(out[1]), np.asarray(out2[1]))
+
+
+def test_var_conv_2d_masks(rng):
+    x = jnp.asarray(rng.normal(size=(2, 1, 6, 6)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(3, 1, 3, 3)), jnp.float32)
+    out = ops.var_conv_2d(x, jnp.asarray([6, 3]), jnp.asarray([6, 2]), w, 3)
+    assert np.all(np.asarray(out[1, :, 3:, :]) == 0)
+    assert np.all(np.asarray(out[1, :, :, 2:]) == 0)
+
+
+def test_tree_conv_shapes(rng):
+    nodes = jnp.asarray(rng.normal(size=(1, 5, 4)), jnp.float32)
+    edges = jnp.asarray([[[0, 1], [0, 2], [1, 3], [-1, -1]]])
+    w = jnp.asarray(rng.normal(size=(4, 3, 6)), jnp.float32)
+    out = ops.tree_conv(nodes, edges, w)
+    assert out.shape == (1, 5, 6)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+# ---------------------------------------------------------------------------
+# ROI extras
+# ---------------------------------------------------------------------------
+
+def test_psroi_pool(rng):
+    ph = pw = 2
+    c_out = 3
+    feat = jnp.asarray(rng.normal(size=(1, c_out * ph * pw, 8, 8)),
+                       jnp.float32)
+    rois = jnp.asarray([[0.0, 0.0, 7.0, 7.0]])
+    out = ops.detection.psroi_pool(feat, rois, (ph, pw), c_out)
+    assert out.shape == (1, c_out, ph, pw)
+    # bin (0,0) of channel c pools channel c*4 over the top-left quadrant
+    expect = np.asarray(feat[0, 0, 0:4, 0:4]).mean()
+    np.testing.assert_allclose(float(out[0, 0, 0, 0]), expect, rtol=1e-4)
+
+
+def test_prroi_pool_differentiable_wrt_rois(rng):
+    feat = jnp.asarray(rng.normal(size=(1, 2, 8, 8)), jnp.float32)
+
+    def f(rois):
+        return jnp.sum(ops.detection.prroi_pool(feat, rois, (2, 2)))
+
+    g = jax.grad(f)(jnp.asarray([[1.0, 1.0, 6.0, 6.0]]))
+    assert np.any(np.asarray(g) != 0)
+
+
+def test_roi_perspective_transform_identity(rng):
+    feat = jnp.asarray(rng.normal(size=(1, 1, 8, 8)), jnp.float32)
+    # quad = whole image corners
+    rois = jnp.asarray([[0.0, 7.99, 7.99, 0.0, 0.0, 0.0, 7.99, 7.99]])
+    out = ops.detection.roi_perspective_transform(feat, rois, 8, 8)
+    assert out.shape == (1, 1, 8, 8)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+# ---------------------------------------------------------------------------
+# tensor array
+# ---------------------------------------------------------------------------
+
+def test_tensor_array_roundtrip():
+    ta = ops.create_array(4, (2, 3))
+    x0 = jnp.ones((2, 3))
+    x1 = jnp.full((2, 3), 2.0)
+    ta = ops.array_write(ta, 0, x0)
+    ta = ops.array_write(ta, 1, x1)
+    assert int(ops.array_length(ta)) == 2
+    np.testing.assert_allclose(np.asarray(ops.array_read(ta, 1)),
+                               np.asarray(x1))
+    stacked = ops.tensor_array_to_tensor(ta, axis=0)
+    assert stacked.shape == (4, 2, 3)
+
+
+def test_tensor_array_in_scan():
+    def body(ta, i):
+        ta = ops.array_write(ta, i, jnp.full((2,), i, jnp.float32))
+        return ta, None
+
+    ta = ops.create_array(5, (2,))
+    ta, _ = jax.lax.scan(body, ta, jnp.arange(5))
+    np.testing.assert_allclose(np.asarray(ta.data[:, 0]),
+                               np.arange(5, dtype=np.float32))
+
+
+def test_lod_tensor_array_conversion(rng):
+    x = jnp.asarray(rng.normal(size=(3, 4, 2)), jnp.float32)
+    ta = ops.lod_tensor_to_array(x, jnp.asarray([4, 2, 3]))
+    back = ops.array_to_lod_tensor(ta)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x))
+
+
+# ---------------------------------------------------------------------------
+# new sequence ops
+# ---------------------------------------------------------------------------
+
+def test_sequence_conv(rng):
+    b, t, d, out_d, ctx = 2, 5, 3, 4, 3
+    x = jnp.asarray(rng.normal(size=(b, t, d)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(ctx * d, out_d)), jnp.float32)
+    length = jnp.asarray([5, 3])
+    out = ops.sequence_conv(x, length, w, context_length=ctx,
+                            context_start=-1)
+    assert out.shape == (b, t, out_d)
+    # masked rows are zero
+    assert np.all(np.asarray(out[1, 3:]) == 0)
+    # middle position of row 0: full context [x0,x1,x2] @ w
+    ctx_vec = np.concatenate([np.asarray(x[0, 0]), np.asarray(x[0, 1]),
+                              np.asarray(x[0, 2])])
+    np.testing.assert_allclose(np.asarray(out[0, 1]),
+                               ctx_vec @ np.asarray(w), rtol=1e-4)
+
+
+def test_sequence_reshape(rng):
+    x = jnp.asarray(rng.normal(size=(2, 4, 6)), jnp.float32)
+    out, new_len = ops.sequence_reshape(x, np.asarray([4, 2]), 12)
+    assert out.shape == (2, 2, 12)
+    assert list(np.asarray(new_len)) == [2, 1]
+    with pytest.raises(ValueError):  # 3*6=18 not divisible by 12
+        ops.sequence_reshape(x, np.asarray([3, 2]), 12)
+
+
+def test_sequence_scatter():
+    x = jnp.zeros((2, 5))
+    idx = jnp.asarray([[0, 2, 2], [1, 0, 0]])
+    upd = jnp.asarray([[1.0, 2.0, 3.0], [5.0, 7.0, 9.0]])
+    out = ops.sequence_scatter(x, idx, upd, jnp.asarray([3, 1]))
+    np.testing.assert_allclose(np.asarray(out[0]), [1, 0, 5, 0, 0])
+    np.testing.assert_allclose(np.asarray(out[1]), [0, 5, 0, 0, 0])
+
+
+def test_sequence_topk_avg_pooling(rng):
+    x = jnp.asarray(rng.normal(size=(1, 2, 3, 6)), jnp.float32)
+    out = ops.sequence_topk_avg_pooling(
+        x, jnp.asarray([3]), jnp.asarray([6]), topks=[1, 3], channel_num=2)
+    assert out.shape == (1, 3, 4)
+    top1 = np.asarray(x[0, 0, 0]).max()
+    np.testing.assert_allclose(float(out[0, 0, 0]), top1, rtol=1e-5)
+
+
+def test_lod_reset_resegments():
+    x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    x2, nl = ops.lod_reset(x, [2, 2], [1, 3])
+    assert list(np.asarray(nl)) == [1, 3]
+    np.testing.assert_allclose(np.asarray(x2[0]), [1.0, 0.0, 0.0])
+    np.testing.assert_allclose(np.asarray(x2[1]), [2.0, 3.0, 4.0])
+    with pytest.raises(ValueError):
+        ops.lod_reset(x, [2, 2], [1, 2])  # sums differ
+
+
+# ---------------------------------------------------------------------------
+# py_func / print
+# ---------------------------------------------------------------------------
+
+def test_py_func_roundtrip():
+    x = jnp.arange(6.0).reshape(2, 3)
+
+    def np_fn(v):
+        return np.asarray(v) * 2
+
+    out = jax.jit(lambda x: ops.py_func(
+        np_fn, x, jax.ShapeDtypeStruct((2, 3), jnp.float32)))(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) * 2)
+
+
+def test_py_func_custom_grad():
+    x = jnp.asarray([1.0, 2.0])
+
+    def np_fn(v):
+        return np.square(np.asarray(v))
+
+    def np_grad(dy, v):
+        return np.asarray(dy) * 2 * np.asarray(v)
+
+    f = lambda x: jnp.sum(ops.py_func(
+        np_fn, x, jax.ShapeDtypeStruct((2,), jnp.float32),
+        grad_func=np_grad))
+    g = jax.grad(f)(x)
+    np.testing.assert_allclose(np.asarray(g), [2.0, 4.0])
+
+
+# ---------------------------------------------------------------------------
+# new optimizers
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("opt_cls,kw", [
+    (pt.optimizer.DecayedAdagrad, {}),
+    (pt.optimizer.ProximalGD, {"l1": 0.01, "l2": 0.01}),
+    (pt.optimizer.ProximalAdagrad, {"l1": 0.01, "l2": 0.01}),
+])
+def test_new_optimizers_reduce_quadratic(opt_cls, kw):
+    opt = opt_cls(learning_rate=0.2, **kw)
+    p = {"w": jnp.asarray([3.0, -2.0])}
+    state = opt.init(p)
+    for _ in range(200):
+        g = {"w": 2 * p["w"]}
+        p, state = opt.apply_gradients(p, g, state)
+    assert float(jnp.sum(jnp.abs(p["w"]))) < 1.0
+
+
+def test_proximal_gd_l1_sparsifies():
+    opt = pt.optimizer.ProximalGD(learning_rate=0.1, l1=1.0)
+    p = {"w": jnp.asarray([0.05, 5.0])}
+    state = opt.init(p)
+    g = {"w": jnp.asarray([0.0, 0.0])}
+    p, state = opt.apply_gradients(p, g, state)
+    assert float(p["w"][0]) == 0.0  # small weight clipped to zero by L1
