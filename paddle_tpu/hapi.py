@@ -123,6 +123,7 @@ class Model:
         self._metrics = _as_metric_list(metrics)
         self._train_step: Optional[TrainStep] = None
         self._eval_step: Optional[EvalStep] = None
+        self._fitting = False
 
     def prepare(self, optimizer: Optional[Optimizer] = None,
                 loss: Optional[Callable] = None,
@@ -159,42 +160,71 @@ class Model:
         step = self._get_train_step()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         labels = labels if isinstance(labels, (list, tuple)) else [labels]
-        metrics = step(*inputs, labels=tuple(labels))
+        if not self._fitting:
+            # standalone train_batch: the eager model is authoritative on
+            # both sides of the call (user may have mutated weights). The
+            # finally matters: the step donates (deletes) the model's own
+            # arrays, so even on error the state must be pushed back.
+            step.reset_from_model()
+            try:
+                metrics = step(*inputs, labels=tuple(labels))
+            finally:
+                step.sync_to_model()
+        else:
+            metrics = step(*inputs, labels=tuple(labels))
         return {k: float(v) for k, v in metrics.items()}
 
     def fit(self, train_loader, eval_loader=None, epochs: int = 1,
             callbacks: Optional[List[Callback]] = None,
-            verbose: int = 1, log_freq: int = 10) -> None:
+            verbose: int = 1, log_freq: int = 10) -> Dict[str, List[float]]:
+        """Train; returns per-epoch history {metric: [v_epoch0, ...]}."""
         callbacks = list(callbacks or [])
         if verbose:
             callbacks.append(ProgBarLogger(log_freq, verbose))
-        for cb in callbacks:
-            cb.on_train_begin()
-        for epoch in range(epochs):
-            for cb in callbacks:
-                cb.on_epoch_begin(epoch)
-            logs: Dict[str, float] = {}
-            for i, batch in enumerate(train_loader):
-                *inputs, label = batch
-                logs = self.train_batch(inputs, [label])
-                for cb in callbacks:
-                    cb.on_batch_end(i, logs)
-            if eval_loader is not None:
-                logs.update(self.evaluate(eval_loader, verbose=0))
-            for cb in callbacks:
-                cb.on_epoch_end(epoch, logs)
-            if any(getattr(cb, "stop_training", False)
-                   for cb in callbacks):
-                break
-        for cb in callbacks:
-            cb.on_train_end()
+        history: Dict[str, List[float]] = {}
         if self._train_step is not None:
-            self._train_step.sync_to_model()
+            # weights may have been set_value'd/loaded since the last fit
+            self._train_step.reset_from_model()
+        self._fitting = True
+        try:
+            for cb in callbacks:
+                cb.on_train_begin()
+            for epoch in range(epochs):
+                for cb in callbacks:
+                    cb.on_epoch_begin(epoch)
+                logs: Dict[str, float] = {}
+                for i, batch in enumerate(train_loader):
+                    *inputs, label = batch
+                    logs = self.train_batch(inputs, [label])
+                    for cb in callbacks:
+                        cb.on_batch_end(i, logs)
+                if eval_loader is not None:
+                    logs.update(self.evaluate(eval_loader, verbose=0))
+                for k, v in logs.items():
+                    history.setdefault(k, []).append(v)
+                for cb in callbacks:
+                    cb.on_epoch_end(epoch, logs)
+                if any(getattr(cb, "stop_training", False)
+                       for cb in callbacks):
+                    break
+            for cb in callbacks:
+                cb.on_train_end()
+        finally:
+            self._fitting = False
+            # Must run even on an interrupted fit: the jitted step donated
+            # (deleted) the network's own arrays into the training state, so
+            # skipping the sync-back would leave the eager model holding
+            # dead buffers.
+            if self._train_step is not None:
+                self._train_step.sync_to_model()
+        return history
 
     def _current_state(self):
-        if self._optimizer is not None and self._train_step is not None:
+        if self._fitting and self._train_step is not None:
+            # mid-fit: live (donated) training state
             st = self._train_step.state
             return st["params"], st["buffers"]
+        # outside fit the eager network is the source of truth
         return self.network.param_dict(), self.network.buffer_dict()
 
     def _get_eval_step(self) -> EvalStep:
@@ -239,7 +269,10 @@ class Model:
                                               else b)) for b in loader]
 
     def save(self, path: str) -> None:
-        if self._train_step is not None:
+        # Mid-fit (ModelCheckpoint callback) the live training state must be
+        # pulled back first; outside fit the eager network is authoritative
+        # and syncing would clobber user weight mutations.
+        if self._fitting and self._train_step is not None:
             self._train_step.sync_to_model()
         io_mod.save(self.network.state_dict(), path + ".pdparams")
 

@@ -6,12 +6,17 @@ skip_layernorm/embedding_eltwise_layernorm fusions in operators/fused/).
 One pass over rows resident in VMEM: mean/var/normalize/affine fused, no
 HBM round-trips between the stages. Grid tiles the row dimension; the
 feature dimension stays whole (lane-dim 128-aligned models: 768/1024/...).
+
+Reverse mode: ``_ln_core`` is a ``jax.custom_vjp``. The backward recomputes
+the per-row mean/rstd from the saved input (avoids 1-D tiled kernel outputs,
+which Mosaic lays out incompatibly with XLA) and applies the standard fused
+three-term formula in fp32 XLA ops — the stat recompute fuses into the same
+HBM pass as the dx computation.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -21,38 +26,64 @@ from jax.experimental.pallas import tpu as pltpu
 _ROW_BLOCK = 256
 
 
-def _ln_kernel(x_ref, w_ref, b_ref, o_ref, *, eps: float, has_affine: bool):
+def _ln_kernel(x_ref, w_ref, b_ref, o_ref, *, eps: float):
     x = x_ref[:].astype(jnp.float32)
     mean = jnp.mean(x, axis=-1, keepdims=True)
     xc = x - mean
     var = jnp.mean(xc * xc, axis=-1, keepdims=True)
     y = xc * jax.lax.rsqrt(var + eps)
-    if has_affine:
-        y = y * w_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)
+    y = y * w_ref[:].astype(jnp.float32) + b_ref[:].astype(jnp.float32)
     o_ref[:] = y.astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("eps",))
-def _layer_norm_2d(x, weight, bias, eps: float):
+def _ln_forward(x, w, b, eps: float, interpret: bool):
     rows, cols = x.shape
     block = min(_ROW_BLOCK, rows)
     grid = (pl.cdiv(rows, block),)
-    kernel = functools.partial(_ln_kernel, eps=eps, has_affine=True)
+    kernel = functools.partial(_ln_kernel, eps=eps)
+    ms = {} if interpret else {"memory_space": pltpu.VMEM}
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((block, cols), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((cols,), lambda i: (0,),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((cols,), lambda i: (0,),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block, cols), lambda i: (i, 0), **ms),
+            pl.BlockSpec((cols,), lambda i: (0,), **ms),
+            pl.BlockSpec((cols,), lambda i: (0,), **ms),
         ],
-        out_specs=pl.BlockSpec((block, cols), lambda i: (i, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=pl.BlockSpec((block, cols), lambda i: (i, 0), **ms),
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
-    )(x, weight, bias)
+        interpret=interpret,
+    )(x, w, b)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ln_core(x, w, b, eps: float, interpret: bool):
+    return _ln_forward(x, w, b, eps, interpret)
+
+
+def _ln_fwd(x, w, b, eps, interpret):
+    return _ln_forward(x, w, b, eps, interpret), (x, w, b)
+
+
+def _ln_bwd(eps, interpret, res, g):
+    x, w, b = res
+    xf = x.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    xc = xf - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = xc * rstd
+    dy = gf * w.astype(jnp.float32)
+    db = jnp.sum(gf, axis=0).astype(b.dtype)
+    dw = jnp.sum(gf * xhat, axis=0).astype(w.dtype)
+    m1 = jnp.mean(dy, axis=-1, keepdims=True)
+    m2 = jnp.mean(dy * xhat, axis=-1, keepdims=True)
+    dx = (rstd * (dy - m1 - xhat * m2)).astype(x.dtype)
+    return dx, dw, db
+
+
+_ln_core.defvjp(_ln_fwd, _ln_bwd)
 
 
 def layer_norm_pallas(x, weight=None, bias=None, epsilon: float = 1e-5,
@@ -67,22 +98,5 @@ def layer_norm_pallas(x, weight=None, bias=None, epsilon: float = 1e-5,
         else jnp.ones((cols,), jnp.float32)
     b = bias.reshape(cols) if bias is not None \
         else jnp.zeros((cols,), jnp.float32)
-    if interpret:
-        kernel = functools.partial(_ln_kernel, eps=epsilon, has_affine=True)
-        rows = x2.shape[0]
-        block = min(_ROW_BLOCK, rows)
-        out = pl.pallas_call(
-            kernel,
-            grid=(pl.cdiv(rows, block),),
-            in_specs=[
-                pl.BlockSpec((block, cols), lambda i: (i, 0)),
-                pl.BlockSpec((cols,), lambda i: (0,)),
-                pl.BlockSpec((cols,), lambda i: (0,)),
-            ],
-            out_specs=pl.BlockSpec((block, cols), lambda i: (i, 0)),
-            out_shape=jax.ShapeDtypeStruct(x2.shape, x2.dtype),
-            interpret=True,
-        )(x2, w, b)
-    else:
-        out = _layer_norm_2d(x2, w, b, epsilon)
+    out = _ln_core(x2, w, b, epsilon, interpret)
     return out.reshape(orig_shape)

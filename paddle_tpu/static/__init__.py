@@ -23,6 +23,7 @@ executor.py Executor.run :900). The mapping:
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -283,11 +284,31 @@ class TrainStep:
         self.state, metrics = self._jitted(self.state, batch)
         return metrics
 
+    def reset_from_model(self) -> None:
+        """Re-pull params/buffers from the eager model (the model is the
+        source of truth at program boundaries; users may have set_value'd
+        or loaded weights since the last compile).
+
+        Optimizer slots (momenta etc.) are intentionally carried over so
+        fit(); fit() continues training; for a fresh optimizer pair this
+        with ``self.state["opt"] = self.optimizer.init(params)``."""
+        self.state["params"] = self.model.param_dict()
+        self.state["buffers"] = self.model.buffer_dict()
+
     # sync trained state back into the eager model
     def sync_to_model(self) -> None:
-        params = jax.tree.map(lambda x: x, self.state["params"])
-        self.model.set_state_dict({**params, **self.state["buffers"]},
-                                  strict=False)
+        state = {**self.state["params"], **self.state["buffers"]}
+        # A step that failed mid-execution may have consumed (deleted) the
+        # donated buffers with no result to replace them; those weights are
+        # unrecoverable — skip them rather than raise from cleanup paths.
+        alive = {k: v for k, v in state.items()
+                 if not (hasattr(v, "is_deleted") and v.is_deleted())}
+        if len(alive) < len(state):
+            warnings.warn(
+                f"sync_to_model: {len(state) - len(alive)} donated buffers "
+                "were lost to a failed step; those weights keep their "
+                "previous values in the eager model")
+        self.model.set_state_dict(alive, strict=False)
 
     @property
     def params(self):

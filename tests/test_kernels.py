@@ -37,6 +37,30 @@ class TestLayerNormKernel:
                                    rtol=2e-5, atol=2e-5)
 
 
+    def test_backward_matches_reference(self, rng):
+        from paddle_tpu.kernels.layer_norm import layer_norm_pallas
+        from paddle_tpu.ops.nn_functional import layer_norm
+
+        x = rng.standard_normal((16, 128)).astype(np.float32)
+        w = rng.standard_normal((128,)).astype(np.float32)
+        b = rng.standard_normal((128,)).astype(np.float32)
+
+        def loss_pallas(x_, w_, b_):
+            return jnp.sum(layer_norm_pallas(x_, w_, b_, 1e-5,
+                                             interpret=True) ** 2)
+
+        def loss_ref(x_, w_, b_):
+            return jnp.sum(layer_norm(x_, w_, b_, 1e-5, -1) ** 2)
+
+        gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(
+            jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(
+            jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+        for a, r in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                       rtol=2e-4, atol=2e-4)
+
+
 class TestFlashAttention:
     def _reference(self, q, k, v, causal=False):
         from paddle_tpu.ops.attention import scaled_dot_product_attention
