@@ -1,10 +1,10 @@
 """Benchmark driver: BERT-base pretraining tokens/sec/chip on one TPU chip.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-vs_baseline is measured MFU / 0.80-of-A100-MFU-equivalent target
-(BASELINE.json: ≥80% A100-MFU-equivalent). A100 bf16 peak ≈ 312 TFLOPs;
-v5e chip bf16 peak ≈ 394 TFLOPs ⇒ the target throughput for this chip is
-0.8 * 394 = 315 TFLOPs effective; vs_baseline = achieved_TFLOPs / 315.
+vs_baseline = achieved effective TFLOPs / target, where target = 0.80 x
+v5e bf16 peak (197 TFLOPs) per BASELINE.json's ">=80% of A100 MFU" north
+star (A100 bf16 peak 312 and v5e 197 make per-chip MFU the comparable
+quantity). Effective FLOPs use the standard 6 * params * tokens estimate.
 """
 
 from __future__ import annotations
@@ -15,6 +15,11 @@ import time
 
 def main() -> None:
     import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      "/root/repo/.jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
     import jax.numpy as jnp
     import numpy as np
 
@@ -64,7 +69,7 @@ def main() -> None:
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     flops_per_token = 6 * n_params
     achieved_tflops = tokens_per_sec * flops_per_token / 1e12
-    target_tflops = 0.8 * 394.0  # 80% of v5e bf16 peak (A100-MFU-equiv)
+    target_tflops = 0.8 * 197.0  # 80% of v5e bf16 peak
     print(json.dumps({
         "metric": "BERT-base pretrain tokens/sec/chip",
         "value": round(tokens_per_sec, 1),

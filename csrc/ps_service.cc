@@ -1,0 +1,761 @@
+// Parameter-server service: dense + sparse tables over TCP with
+// server-side optimizers.
+//
+// TPU-native replacement for the reference's parameter-server runtime:
+//  - listen_and_serv op (paddle/fluid/operators/distributed_ops/
+//    listen_and_serv_op.cc:127 RunSyncLoop, :244 RunAsyncLoop) — here the
+//    server's "optimize block per grad" is a built-in C++ optimizer applied
+//    on push, instead of re-entering a graph executor;
+//  - the gRPC/BRPC transport (operators/distributed/grpc/grpc_server.h:46)
+//    — replaced by the same minimal length-prefixed TCP framing the control
+//    plane uses (the data path between chips stays on ICI/DCN; this server
+//    only carries host-side PS traffic);
+//  - large_scale_kv.h sparse tables — the SparseTable below with
+//    lazily-initialized rows and per-row optimizer slots.
+//
+// Sync mode mirrors the reference's fetch_barrier/send_barrier protocol
+// (distribute_transpiler.py:545 inserts them around send/recv): a dense
+// table with sync_world=N accumulates N pushes, applies the optimizer
+// once, and bumps a version; pull(min_version) blocks on that version.
+
+#include "ptnative.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cmath>
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum PsOp : uint8_t {
+  kDenseInit = 1,
+  kDensePull = 2,
+  kDensePush = 3,
+  kSparseInit = 4,
+  kSparsePull = 5,
+  kSparsePush = 6,
+  kSparseSize = 7,
+  kSave = 8,
+  kLoad = 9,
+};
+
+enum Optim : int32_t { kSgd = 0, kAdagrad = 1, kAdam = 2, kSum = 3 };
+
+bool ReadFull(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool WriteFull(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct Hyper {
+  float lr = 0.01f;
+  float b1 = 0.9f;   // beta1 / adagrad-unused
+  float b2 = 0.999f;
+  float eps = 1e-8f;
+};
+
+// Applies `opt` in place on a contiguous span. Slots sized on demand.
+struct OptimState {
+  std::vector<float> m;  // adagrad accum / adam m
+  std::vector<float> v;  // adam v
+  int64_t step = 0;
+};
+
+void ApplyOptim(Optim opt, const Hyper& hp, float* p, const float* g,
+                int64_t n, OptimState* st) {
+  switch (opt) {
+    case kSum:
+      for (int64_t i = 0; i < n; ++i) p[i] += g[i];
+      return;
+    case kSgd:
+      for (int64_t i = 0; i < n; ++i) p[i] -= hp.lr * g[i];
+      return;
+    case kAdagrad: {
+      if (st->m.size() != static_cast<size_t>(n)) st->m.assign(n, 0.f);
+      for (int64_t i = 0; i < n; ++i) {
+        st->m[i] += g[i] * g[i];
+        p[i] -= hp.lr * g[i] / (std::sqrt(st->m[i]) + hp.eps);
+      }
+      return;
+    }
+    case kAdam: {
+      if (st->m.size() != static_cast<size_t>(n)) st->m.assign(n, 0.f);
+      if (st->v.size() != static_cast<size_t>(n)) st->v.assign(n, 0.f);
+      st->step += 1;
+      float bc1 = 1.f - std::pow(hp.b1, static_cast<float>(st->step));
+      float bc2 = 1.f - std::pow(hp.b2, static_cast<float>(st->step));
+      float lr_t = hp.lr * std::sqrt(bc2) / bc1;
+      for (int64_t i = 0; i < n; ++i) {
+        st->m[i] = hp.b1 * st->m[i] + (1.f - hp.b1) * g[i];
+        st->v[i] = hp.b2 * st->v[i] + (1.f - hp.b2) * g[i] * g[i];
+        p[i] -= lr_t * st->m[i] / (std::sqrt(st->v[i]) + hp.eps);
+      }
+      return;
+    }
+  }
+}
+
+struct DenseTable {
+  std::vector<float> values;
+  Optim opt = kSgd;
+  Hyper hyper;
+  int sync_world = 0;
+  // sync accumulation
+  std::vector<float> accum;
+  int pending = 0;
+  int64_t version = 0;
+  OptimState state;
+};
+
+struct SparseTable {
+  int dim = 0;
+  Optim opt = kSgd;
+  Hyper hyper;
+  float init_scale = 0.f;
+  std::unordered_map<int64_t, std::vector<float>> rows;  // dim + slots
+  std::unordered_map<int64_t, OptimState> states;
+  std::mutex mu;
+
+  std::vector<float>& Row(int64_t id) {
+    auto it = rows.find(id);
+    if (it != rows.end()) return it->second;
+    std::vector<float> r(dim);
+    if (init_scale != 0.f) {
+      // deterministic per-id init: splitmix64 bits -> uniform(-s, s)
+      uint64_t x = static_cast<uint64_t>(id) + 0x9e3779b97f4a7c15ull;
+      for (int i = 0; i < dim; ++i) {
+        x += 0x9e3779b97f4a7c15ull;
+        uint64_t z = x;
+        z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+        z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+        z ^= z >> 31;
+        float u = static_cast<float>(z >> 40) /
+                  static_cast<float>(1ull << 24);  // [0,1)
+        r[i] = (2.f * u - 1.f) * init_scale;
+      }
+    }
+    return rows.emplace(id, std::move(r)).first->second;
+  }
+};
+
+class PsServer {
+ public:
+  explicit PsServer(int port) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+            0 ||
+        ::listen(listen_fd_, 128) < 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return;
+    }
+    socklen_t alen = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port_ = ntohs(addr.sin_port);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+  }
+
+  ~PsServer() { Stop(); }
+  bool ok() const { return listen_fd_ >= 0; }
+  int port() const { return port_; }
+
+  void Stop() {
+    bool expected = false;
+    if (!stopped_.compare_exchange_strong(expected, true)) return;
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    if (accept_thread_.joinable()) accept_thread_.join();
+    std::vector<std::thread> workers;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      workers.swap(workers_);
+      for (int fd : client_fds_) ::shutdown(fd, SHUT_RDWR);
+      cv_.notify_all();
+    }
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+  }
+
+ private:
+  void AcceptLoop() {
+    while (!stopped_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(mu_);
+      client_fds_.push_back(fd);
+      workers_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  void Serve(int fd) {
+    while (!stopped_.load()) {
+      uint8_t op;
+      uint32_t klen;
+      if (!ReadFull(fd, &op, 1) || !ReadFull(fd, &klen, 4)) break;
+      if (klen > (1u << 16)) break;
+      std::string key(klen, '\0');
+      if (!ReadFull(fd, key.data(), klen)) break;
+      if (!Dispatch(fd, static_cast<PsOp>(op), key)) break;
+    }
+    ::close(fd);
+    std::lock_guard<std::mutex> lk(mu_);
+    client_fds_.erase(std::remove(client_fds_.begin(), client_fds_.end(), fd),
+                      client_fds_.end());
+  }
+
+  bool Status(int fd, int64_t st) { return WriteFull(fd, &st, 8); }
+
+  bool Dispatch(int fd, PsOp op, const std::string& key) {
+    switch (op) {
+      case kDenseInit: {
+        int64_t n;
+        int32_t optc, sync_world;
+        uint8_t has_init;
+        Hyper hp;
+        if (!ReadFull(fd, &n, 8) || !ReadFull(fd, &optc, 4) ||
+            !ReadFull(fd, &sync_world, 4) || !ReadFull(fd, &hp, 16) ||
+            !ReadFull(fd, &has_init, 1))
+          return false;
+        std::vector<float> init;
+        if (has_init) {
+          init.resize(n);
+          if (!ReadFull(fd, init.data(), n * 4)) return false;
+        }
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          if (!dense_.count(key)) {
+            auto& t = dense_[key];
+            t.values = has_init ? std::move(init)
+                                : std::vector<float>(n, 0.f);
+            t.opt = static_cast<Optim>(optc);
+            t.hyper = hp;
+            t.sync_world = sync_world;
+          }
+        }
+        return Status(fd, 0);
+      }
+      case kDensePull: {
+        int64_t n, min_version;
+        uint32_t timeout_ms;
+        if (!ReadFull(fd, &n, 8) || !ReadFull(fd, &min_version, 8) ||
+            !ReadFull(fd, &timeout_ms, 4))
+          return false;
+        std::vector<float> snapshot;
+        int64_t version = -1;
+        {
+          std::unique_lock<std::mutex> lk(mu_);
+          bool ok = cv_.wait_for(
+              lk, std::chrono::milliseconds(timeout_ms), [&] {
+                auto it = dense_.find(key);
+                return stopped_.load() ||
+                       (it != dense_.end() &&
+                        it->second.version >= min_version);
+              });
+          auto it = dense_.find(key);
+          if (ok && !stopped_.load() && it != dense_.end() &&
+              static_cast<int64_t>(it->second.values.size()) == n) {
+            snapshot = it->second.values;
+            version = it->second.version;
+          }
+        }
+        if (version < 0) return Status(fd, -1);
+        if (!Status(fd, version)) return false;
+        return WriteFull(fd, snapshot.data(), n * 4);
+      }
+      case kDensePush: {
+        int64_t n;
+        if (!ReadFull(fd, &n, 8)) return false;
+        std::vector<float> grad(n);
+        if (!ReadFull(fd, grad.data(), n * 4)) return false;
+        int64_t version = -1;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          auto it = dense_.find(key);
+          if (it != dense_.end() &&
+              static_cast<int64_t>(it->second.values.size()) == n) {
+            DenseTable& t = it->second;
+            if (t.sync_world > 0) {
+              if (t.accum.size() != static_cast<size_t>(n))
+                t.accum.assign(n, 0.f);
+              for (int64_t i = 0; i < n; ++i) t.accum[i] += grad[i];
+              if (++t.pending >= t.sync_world) {
+                // averaged sync update (reference scales by 1/trainers
+                // in the trainer program; server-side here)
+                float inv = 1.f / static_cast<float>(t.sync_world);
+                for (auto& a : t.accum) a *= inv;
+                ApplyOptim(t.opt, t.hyper, t.values.data(), t.accum.data(),
+                           n, &t.state);
+                t.accum.assign(n, 0.f);
+                t.pending = 0;
+                t.version++;
+              }
+            } else {
+              ApplyOptim(t.opt, t.hyper, t.values.data(), grad.data(), n,
+                         &t.state);
+              t.version++;
+            }
+            version = t.version;
+          }
+        }
+        cv_.notify_all();
+        return Status(fd, version);
+      }
+      case kSparseInit: {
+        int32_t dim, optc;
+        Hyper hp;
+        float scale;
+        if (!ReadFull(fd, &dim, 4) || !ReadFull(fd, &optc, 4) ||
+            !ReadFull(fd, &hp, 16) || !ReadFull(fd, &scale, 4))
+          return false;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          if (!sparse_.count(key)) {
+            auto t = std::make_unique<SparseTable>();
+            t->dim = dim;
+            t->opt = static_cast<Optim>(optc);
+            t->hyper = hp;
+            t->init_scale = scale;
+            sparse_[key] = std::move(t);
+          }
+        }
+        return Status(fd, 0);
+      }
+      case kSparsePull: {
+        int64_t n;
+        if (!ReadFull(fd, &n, 8)) return false;
+        std::vector<int64_t> ids(n);
+        if (!ReadFull(fd, ids.data(), n * 8)) return false;
+        SparseTable* t = FindSparse(key);
+        if (!t) return Status(fd, -1);
+        std::vector<float> out;
+        {
+          std::lock_guard<std::mutex> lk(t->mu);
+          out.resize(n * t->dim);
+          for (int64_t i = 0; i < n; ++i) {
+            auto& row = t->Row(ids[i]);
+            std::memcpy(out.data() + i * t->dim, row.data(), t->dim * 4);
+          }
+        }
+        if (!Status(fd, 0)) return false;
+        return WriteFull(fd, out.data(), out.size() * 4);
+      }
+      case kSparsePush: {
+        int64_t n;
+        if (!ReadFull(fd, &n, 8)) return false;
+        std::vector<int64_t> ids(n);
+        if (!ReadFull(fd, ids.data(), n * 8)) return false;
+        SparseTable* t = FindSparse(key);
+        int64_t dim = t ? t->dim : 0;
+        std::vector<float> grad(n * dim);
+        if (dim && !ReadFull(fd, grad.data(), grad.size() * 4)) return false;
+        if (!t) return Status(fd, -1);
+        {
+          std::lock_guard<std::mutex> lk(t->mu);
+          for (int64_t i = 0; i < n; ++i) {
+            auto& row = t->Row(ids[i]);
+            ApplyOptim(t->opt, t->hyper, row.data(), grad.data() + i * dim,
+                       dim, &t->states[ids[i]]);
+          }
+        }
+        return Status(fd, 0);
+      }
+      case kSparseSize: {
+        SparseTable* t = FindSparse(key);
+        int64_t sz = -1;
+        if (t) {
+          std::lock_guard<std::mutex> lk(t->mu);
+          sz = static_cast<int64_t>(t->rows.size());
+        }
+        return Status(fd, sz);
+      }
+      case kSave:
+        return Status(fd, SaveTo(key) ? 0 : -1);
+      case kLoad:
+        return Status(fd, LoadFrom(key) ? 0 : -1);
+    }
+    return false;
+  }
+
+  SparseTable* FindSparse(const std::string& key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = sparse_.find(key);
+    return it == sparse_.end() ? nullptr : it->second.get();
+  }
+
+  bool SaveTo(const std::string& path) {
+    std::lock_guard<std::mutex> lk(mu_);
+    FILE* f = std::fopen(path.c_str(), "wb");
+    if (!f) return false;
+    auto w64 = [&](int64_t v) { std::fwrite(&v, 8, 1, f); };
+    auto wstr = [&](const std::string& s) {
+      w64(static_cast<int64_t>(s.size()));
+      std::fwrite(s.data(), 1, s.size(), f);
+    };
+    w64(static_cast<int64_t>(dense_.size()));
+    for (auto& [name, t] : dense_) {
+      wstr(name);
+      w64(static_cast<int64_t>(t.values.size()));
+      std::fwrite(t.values.data(), 4, t.values.size(), f);
+      w64(t.version);
+    }
+    w64(static_cast<int64_t>(sparse_.size()));
+    for (auto& [name, tp] : sparse_) {
+      std::lock_guard<std::mutex> tlk(tp->mu);
+      wstr(name);
+      w64(tp->dim);
+      w64(static_cast<int64_t>(tp->rows.size()));
+      for (auto& [id, row] : tp->rows) {
+        w64(id);
+        std::fwrite(row.data(), 4, tp->dim, f);
+      }
+    }
+    std::fclose(f);
+    return true;
+  }
+
+  bool LoadFrom(const std::string& path) {
+    std::lock_guard<std::mutex> lk(mu_);
+    FILE* f = std::fopen(path.c_str(), "rb");
+    if (!f) return false;
+    auto r64 = [&](int64_t* v) { return std::fread(v, 8, 1, f) == 1; };
+    auto rstr = [&](std::string* s) {
+      int64_t n;
+      if (!r64(&n) || n < 0 || n > (1 << 16)) return false;
+      s->resize(n);
+      return std::fread(s->data(), 1, n, f) == static_cast<size_t>(n);
+    };
+    bool ok = true;
+    int64_t nd = 0;
+    ok = ok && r64(&nd);
+    for (int64_t i = 0; ok && i < nd; ++i) {
+      std::string name;
+      int64_t n = 0;
+      ok = rstr(&name) && r64(&n);
+      if (!ok) break;
+      auto& t = dense_[name];
+      t.values.resize(n);
+      ok = std::fread(t.values.data(), 4, n, f) == static_cast<size_t>(n) &&
+           r64(&t.version);
+    }
+    int64_t ns = 0;
+    ok = ok && r64(&ns);
+    for (int64_t i = 0; ok && i < ns; ++i) {
+      std::string name;
+      int64_t dim = 0, rows = 0;
+      ok = rstr(&name) && r64(&dim) && r64(&rows);
+      if (!ok) break;
+      if (!sparse_.count(name)) {
+        auto t = std::make_unique<SparseTable>();
+        t->dim = static_cast<int>(dim);
+        sparse_[name] = std::move(t);
+      }
+      SparseTable* t = sparse_[name].get();
+      std::lock_guard<std::mutex> tlk(t->mu);
+      for (int64_t r = 0; ok && r < rows; ++r) {
+        int64_t id;
+        ok = r64(&id);
+        if (!ok) break;
+        std::vector<float> row(dim);
+        ok = std::fread(row.data(), 4, dim, f) == static_cast<size_t>(dim);
+        t->rows[id] = std::move(row);
+      }
+    }
+    std::fclose(f);
+    cv_.notify_all();
+    return ok;
+  }
+
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::atomic<bool> stopped_{false};
+  std::thread accept_thread_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, DenseTable> dense_;
+  std::map<std::string, std::unique_ptr<SparseTable>> sparse_;
+  std::vector<std::thread> workers_;
+  std::vector<int> client_fds_;
+};
+
+class PsClient {
+ public:
+  PsClient(const char* host, int port, int timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    while (std::chrono::steady_clock::now() < deadline) {
+      fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(static_cast<uint16_t>(port));
+      ::inet_pton(AF_INET, host, &addr.sin_addr);
+      if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) ==
+          0) {
+        int one = 1;
+        ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return;
+      }
+      ::close(fd_);
+      fd_ = -1;
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+  }
+  ~PsClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+  void Shutdown() {
+    if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
+  }
+  bool ok() const { return fd_ >= 0; }
+  std::mutex& mu() { return mu_; }
+  int fd() const { return fd_; }
+
+ private:
+  int fd_ = -1;
+  std::mutex mu_;
+};
+
+std::mutex g_ps_mu;
+std::map<int64_t, std::unique_ptr<PsServer>> g_ps_servers;
+std::map<int64_t, std::shared_ptr<PsClient>> g_ps_clients;
+int64_t g_ps_next = 1;
+
+std::shared_ptr<PsClient> PsGet(int64_t h) {
+  std::lock_guard<std::mutex> lk(g_ps_mu);
+  auto it = g_ps_clients.find(h);
+  return it == g_ps_clients.end() ? nullptr : it->second;
+}
+
+bool PsSend(PsClient* c, PsOp op, const char* key,
+            const std::string& payload) {
+  uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+  std::string msg;
+  msg.reserve(5 + klen + payload.size());
+  msg.push_back(static_cast<char>(op));
+  msg.append(reinterpret_cast<char*>(&klen), 4);
+  msg.append(key, klen);
+  msg.append(payload);
+  return WriteFull(c->fd(), msg.data(), msg.size());
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t pt_ps_server_start(int port) {
+  auto s = std::make_unique<PsServer>(port);
+  if (!s->ok()) return -1;
+  std::lock_guard<std::mutex> lk(g_ps_mu);
+  int64_t h = g_ps_next++;
+  g_ps_servers[h] = std::move(s);
+  return h;
+}
+
+int pt_ps_server_port(int64_t h) {
+  std::lock_guard<std::mutex> lk(g_ps_mu);
+  auto it = g_ps_servers.find(h);
+  return it == g_ps_servers.end() ? -1 : it->second->port();
+}
+
+void pt_ps_server_stop(int64_t h) {
+  std::unique_ptr<PsServer> s;
+  {
+    std::lock_guard<std::mutex> lk(g_ps_mu);
+    auto it = g_ps_servers.find(h);
+    if (it == g_ps_servers.end()) return;
+    s = std::move(it->second);
+    g_ps_servers.erase(it);
+  }
+  s->Stop();
+}
+
+int64_t pt_ps_connect(const char* host, int port, int timeout_ms) {
+  auto c = std::make_shared<PsClient>(host, port, timeout_ms);
+  if (!c->ok()) return -1;
+  std::lock_guard<std::mutex> lk(g_ps_mu);
+  int64_t h = g_ps_next++;
+  g_ps_clients[h] = std::move(c);
+  return h;
+}
+
+void pt_ps_disconnect(int64_t h) {
+  std::shared_ptr<PsClient> c;
+  {
+    std::lock_guard<std::mutex> lk(g_ps_mu);
+    auto it = g_ps_clients.find(h);
+    if (it == g_ps_clients.end()) return;
+    c = std::move(it->second);
+    g_ps_clients.erase(it);
+  }
+  c->Shutdown();
+}
+
+int pt_ps_dense_init(int64_t h, const char* name, int64_t n,
+                     const float* init, int opt, const float* hyper,
+                     int sync_world) {
+  auto c = PsGet(h);
+  if (!c) return -4;
+  std::lock_guard<std::mutex> lk(c->mu());
+  std::string payload;
+  payload.append(reinterpret_cast<char*>(&n), 8);
+  int32_t o = opt, sw = sync_world;
+  payload.append(reinterpret_cast<char*>(&o), 4);
+  payload.append(reinterpret_cast<char*>(&sw), 4);
+  Hyper hp;
+  if (hyper) std::memcpy(&hp, hyper, 16);
+  payload.append(reinterpret_cast<char*>(&hp), 16);
+  uint8_t has_init = init != nullptr;
+  payload.append(reinterpret_cast<char*>(&has_init), 1);
+  if (init) payload.append(reinterpret_cast<const char*>(init), n * 4);
+  if (!PsSend(c.get(), kDenseInit, name, payload)) return -4;
+  int64_t st;
+  return ReadFull(c->fd(), &st, 8) ? static_cast<int>(st) : -4;
+}
+
+int64_t pt_ps_dense_pull(int64_t h, const char* name, float* buf, int64_t n,
+                         int64_t min_version, int timeout_ms) {
+  auto c = PsGet(h);
+  if (!c) return -4;
+  std::lock_guard<std::mutex> lk(c->mu());
+  std::string payload;
+  payload.append(reinterpret_cast<char*>(&n), 8);
+  payload.append(reinterpret_cast<char*>(&min_version), 8);
+  uint32_t t = static_cast<uint32_t>(timeout_ms);
+  payload.append(reinterpret_cast<char*>(&t), 4);
+  if (!PsSend(c.get(), kDensePull, name, payload)) return -4;
+  int64_t st;
+  if (!ReadFull(c->fd(), &st, 8)) return -4;
+  if (st < 0) return st;
+  if (!ReadFull(c->fd(), buf, n * 4)) return -4;
+  return st;
+}
+
+int64_t pt_ps_dense_push(int64_t h, const char* name, const float* grad,
+                         int64_t n) {
+  auto c = PsGet(h);
+  if (!c) return -4;
+  std::lock_guard<std::mutex> lk(c->mu());
+  std::string payload;
+  payload.append(reinterpret_cast<char*>(&n), 8);
+  payload.append(reinterpret_cast<const char*>(grad), n * 4);
+  if (!PsSend(c.get(), kDensePush, name, payload)) return -4;
+  int64_t st;
+  return ReadFull(c->fd(), &st, 8) ? st : -4;
+}
+
+int pt_ps_sparse_init(int64_t h, const char* name, int dim, int opt,
+                      const float* hyper, float init_scale) {
+  auto c = PsGet(h);
+  if (!c) return -4;
+  std::lock_guard<std::mutex> lk(c->mu());
+  std::string payload;
+  int32_t d = dim, o = opt;
+  payload.append(reinterpret_cast<char*>(&d), 4);
+  payload.append(reinterpret_cast<char*>(&o), 4);
+  Hyper hp;
+  if (hyper) std::memcpy(&hp, hyper, 16);
+  payload.append(reinterpret_cast<char*>(&hp), 16);
+  payload.append(reinterpret_cast<char*>(&init_scale), 4);
+  if (!PsSend(c.get(), kSparseInit, name, payload)) return -4;
+  int64_t st;
+  return ReadFull(c->fd(), &st, 8) ? static_cast<int>(st) : -4;
+}
+
+int pt_ps_sparse_pull(int64_t h, const char* name, const int64_t* ids,
+                      int64_t n, int dim, float* buf) {
+  auto c = PsGet(h);
+  if (!c) return -4;
+  std::lock_guard<std::mutex> lk(c->mu());
+  std::string payload;
+  payload.append(reinterpret_cast<char*>(&n), 8);
+  payload.append(reinterpret_cast<const char*>(ids), n * 8);
+  if (!PsSend(c.get(), kSparsePull, name, payload)) return -4;
+  int64_t st;
+  if (!ReadFull(c->fd(), &st, 8)) return -4;
+  if (st < 0) return static_cast<int>(st);
+  if (!ReadFull(c->fd(), buf, n * dim * 4)) return -4;
+  return 0;
+}
+
+int pt_ps_sparse_push(int64_t h, const char* name, const int64_t* ids,
+                      int64_t n, int dim, const float* grad) {
+  auto c = PsGet(h);
+  if (!c) return -4;
+  std::lock_guard<std::mutex> lk(c->mu());
+  std::string payload;
+  payload.append(reinterpret_cast<char*>(&n), 8);
+  payload.append(reinterpret_cast<const char*>(ids), n * 8);
+  payload.append(reinterpret_cast<const char*>(grad), n * dim * 4);
+  if (!PsSend(c.get(), kSparsePush, name, payload)) return -4;
+  int64_t st;
+  return ReadFull(c->fd(), &st, 8) ? static_cast<int>(st) : -4;
+}
+
+int64_t pt_ps_sparse_size(int64_t h, const char* name) {
+  auto c = PsGet(h);
+  if (!c) return -4;
+  std::lock_guard<std::mutex> lk(c->mu());
+  if (!PsSend(c.get(), kSparseSize, name, "")) return -4;
+  int64_t st;
+  return ReadFull(c->fd(), &st, 8) ? st : -4;
+}
+
+int pt_ps_save(int64_t h, const char* path) {
+  auto c = PsGet(h);
+  if (!c) return -4;
+  std::lock_guard<std::mutex> lk(c->mu());
+  if (!PsSend(c.get(), kSave, path, "")) return -4;
+  int64_t st;
+  return ReadFull(c->fd(), &st, 8) ? static_cast<int>(st) : -4;
+}
+
+int pt_ps_load(int64_t h, const char* path) {
+  auto c = PsGet(h);
+  if (!c) return -4;
+  std::lock_guard<std::mutex> lk(c->mu());
+  if (!PsSend(c.get(), kLoad, path, "")) return -4;
+  int64_t st;
+  return ReadFull(c->fd(), &st, 8) ? static_cast<int>(st) : -4;
+}
+
+}  // extern "C"

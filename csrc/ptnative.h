@@ -78,6 +78,61 @@ void pt_df_clear_memory(int64_t h);
 int pt_df_next(int64_t h, float** dense_bufs, int64_t** sparse_bufs,
                int64_t** len_bufs);
 
+// ---------------- parameter server ----------------
+// In-process PS service over TCP (replaces the reference's
+// listen_and_serv gRPC server, paddle/fluid/operators/distributed_ops/
+// listen_and_serv_op.cc:352, and the large_scale_kv sparse table,
+// operators/distributed/large_scale_kv.h). Dense tables apply the
+// configured optimizer server-side on push (the reference runs per-grad
+// optimize sub-blocks on the pserver); sparse tables hold
+// lazily-initialized embedding rows keyed by int64 id.
+//
+// Optimizer codes: 0=sgd 1=adagrad 2=adam 3=sum (geo delta merge).
+// Sync semantics: sync_world>0 means a dense push ACCUMULATES and the
+// optimizer applies once sync_world pushes arrive (one "step"); the
+// table version then increments. pull(min_version) blocks until the
+// table version reaches min_version (0 = don't wait). sync_world==0 is
+// fully async: every push applies immediately (hogwild, like the
+// reference's async RunAsyncLoop listen_and_serv_op.cc:244).
+
+int64_t pt_ps_server_start(int port);
+int pt_ps_server_port(int64_t h);
+void pt_ps_server_stop(int64_t h);
+
+int64_t pt_ps_connect(const char* host, int port, int timeout_ms);
+void pt_ps_disconnect(int64_t h);
+
+// Create-or-get a dense table of n floats. init may be null (zeros).
+// hyper: [lr, beta1/rho, beta2, eps] (unused trailing entries ignored).
+int pt_ps_dense_init(int64_t h, const char* name, int64_t n,
+                     const float* init, int opt, const float* hyper,
+                     int sync_world);
+// Pull values. Blocks until version >= min_version (timeout_ms). Returns
+// current version (>=0) or -1 timeout / -4 transport error.
+int64_t pt_ps_dense_pull(int64_t h, const char* name, float* buf, int64_t n,
+                         int64_t min_version, int timeout_ms);
+// Push a gradient (or delta for opt=sum). Returns table version after the
+// push is recorded (>=0), -4 transport error.
+int64_t pt_ps_dense_push(int64_t h, const char* name, const float* grad,
+                         int64_t n);
+
+// Sparse table of `dim`-wide rows. Rows initialize uniform(-scale, scale)
+// deterministically per id (scale=0 -> zeros).
+int pt_ps_sparse_init(int64_t h, const char* name, int dim, int opt,
+                      const float* hyper, float init_scale);
+// Pull rows for ids[0..n): writes n*dim floats (dim sizes the wire read).
+int pt_ps_sparse_pull(int64_t h, const char* name, const int64_t* ids,
+                      int64_t n, int dim, float* buf);
+// Push per-row grads (n*dim floats); applies optimizer per row.
+int pt_ps_sparse_push(int64_t h, const char* name, const int64_t* ids,
+                      int64_t n, int dim, const float* grad);
+// Number of materialized rows (for tests/metrics).
+int64_t pt_ps_sparse_size(int64_t h, const char* name);
+
+// Persist / restore all tables (binary file). 0 ok, -1 error.
+int pt_ps_save(int64_t h, const char* path);
+int pt_ps_load(int64_t h, const char* path);
+
 // ---------------- monitor ----------------
 void pt_mon_add(const char* name, int64_t v);
 int64_t pt_mon_get(const char* name);

@@ -5,3 +5,4 @@ from ..parallel import (all_gather, all_reduce, barrier, broadcast,
                         new_group, reduce, scatter)
 from ..parallel.env import ParallelEnv
 from . import fleet
+from . import ps

@@ -1,0 +1,275 @@
+"""Parameter-server training (sync / async / geo).
+
+Python orchestration over the native PS service (csrc/ps_service.cc),
+covering the reference's PS capability stack:
+
+- DistributeTranspiler program rewriting (transpiler/
+  distribute_transpiler.py:545): params are split into blocks and spread
+  across pserver shards (`_split_blocks` ≈ _init_splited_vars :1678);
+  trainer steps push grads / pull params instead of running optimizer ops.
+- listen_and_serv optimize blocks (distributed_ops/listen_and_serv_op.cc)
+  run as C++ server-side optimizers.
+- Communicator modes (operators/distributed/communicator.h:253): sync
+  (barriered per-step apply), async (hogwild immediate apply), and geo
+  (communicator.h:396 GeoCommunicator: trainers train locally and
+  exchange parameter deltas every k steps).
+- distributed_lookup_table / large_scale_kv sparse tables
+  (operators/distributed/large_scale_kv.h): `SparseEmbeddingPS` pulls
+  rows by id before forward and pushes row grads after backward.
+
+On TPU the data path of real jobs should be ICI collectives; this stack
+exists for capability parity where a host-side parameter service is
+genuinely wanted (giant embeddings, heterogeneous clusters).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as _random
+from ..native import PsClient, PsServer
+from ..nn.layer import Layer, functional_call
+
+__all__ = [
+    "PsServer", "PSCluster", "DensePSAdapter", "SparseEmbeddingPS",
+    "PSTrainStep", "run_server",
+]
+
+
+class PSCluster:
+    """Connections to every pserver shard."""
+
+    def __init__(self, endpoints: Sequence[str], timeout_ms: int = 30000):
+        self.endpoints = list(endpoints)
+        self.clients: List[PsClient] = []
+        for ep in self.endpoints:
+            host, port = ep.rsplit(":", 1)
+            self.clients.append(PsClient(host, int(port), timeout_ms))
+
+    def __len__(self) -> int:
+        return len(self.clients)
+
+    def close(self) -> None:
+        for c in self.clients:
+            c.close()
+
+
+def _split_blocks(name: str, size: int, n_servers: int,
+                  min_block: int = 8192) -> List[Tuple[int, str, int, int]]:
+    """Split a flat param into ≤n_servers blocks: (server, key, start, stop).
+
+    Mirrors the reference's even block split across pservers
+    (distribute_transpiler.py:1678 _init_splited_vars); small params stay
+    whole on one shard (chosen by name hash for balance).
+    """
+    if size <= min_block or n_servers == 1:
+        server = hash(name) % n_servers
+        return [(server, f"{name}.block0", 0, size)]
+    n_blocks = min(n_servers, (size + min_block - 1) // min_block)
+    per = (size + n_blocks - 1) // n_blocks
+    blocks = []
+    for b in range(n_blocks):
+        start, stop = b * per, min((b + 1) * per, size)
+        if start >= stop:
+            break
+        blocks.append((b % n_servers, f"{name}.block{b}", start, stop))
+    return blocks
+
+
+class DensePSAdapter:
+    """Dense-parameter bridge: local param dict <-> sharded PS tables."""
+
+    def __init__(self, cluster: PSCluster, params: Dict[str, np.ndarray],
+                 optimizer: str = "sgd", lr: float = 0.01,
+                 beta1: float = 0.9, beta2: float = 0.999, eps: float = 1e-8,
+                 sync_world: int = 0):
+        self.cluster = cluster
+        self.shapes = {k: np.asarray(v).shape for k, v in params.items()}
+        self.blocks: Dict[str, List[Tuple[int, str, int, int]]] = {}
+        for name, value in params.items():
+            flat = np.ascontiguousarray(value, np.float32).reshape(-1)
+            blocks = _split_blocks(name, flat.size, len(cluster))
+            self.blocks[name] = blocks
+            for server, key, start, stop in blocks:
+                cluster.clients[server].dense_init(
+                    key, flat[start:stop], stop - start, optimizer=optimizer,
+                    lr=lr, beta1=beta1, beta2=beta2, eps=eps,
+                    sync_world=sync_world)
+
+    def push(self, grads: Dict[str, np.ndarray]) -> int:
+        version = 0
+        for name, g in grads.items():
+            flat = np.ascontiguousarray(g, np.float32).reshape(-1)
+            for server, key, start, stop in self.blocks[name]:
+                version = self.cluster.clients[server].dense_push(
+                    key, flat[start:stop])
+        return version
+
+    def pull(self, min_version: int = 0,
+             timeout_ms: int = 60000) -> Dict[str, np.ndarray]:
+        out = {}
+        for name, blocks in self.blocks.items():
+            size = int(np.prod(self.shapes[name])) if self.shapes[name] \
+                else 1
+            flat = np.empty(size, np.float32)
+            for server, key, start, stop in blocks:
+                vals, _ = self.cluster.clients[server].dense_pull(
+                    key, stop - start, min_version, timeout_ms)
+                flat[start:stop] = vals
+            out[name] = flat.reshape(self.shapes[name])
+        return out
+
+
+class SparseEmbeddingPS:
+    """Embedding whose rows live on the PS (distributed_lookup_table).
+
+    forward: pull rows for the batch's ids -> jnp table slice;
+    backward: push per-row grads (optimizer applies server-side).
+    Rows shard across servers by id modulo.
+    """
+
+    def __init__(self, cluster: PSCluster, name: str, dim: int,
+                 optimizer: str = "sgd", lr: float = 0.01,
+                 init_scale: float = 0.05):
+        self.cluster = cluster
+        self.name = name
+        self.dim = dim
+        for c in cluster.clients:
+            c.sparse_init(name, dim, optimizer=optimizer, lr=lr,
+                          init_scale=init_scale)
+
+    def _shard(self, ids: np.ndarray) -> List[np.ndarray]:
+        n = len(self.cluster)
+        return [np.where(ids % n == s)[0] for s in range(n)]
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        out = np.empty((ids.size, self.dim), np.float32)
+        for s, idx in enumerate(self._shard(ids)):
+            if idx.size:
+                out[idx] = self.cluster.clients[s].sparse_pull(
+                    self.name, ids[idx], self.dim)
+        return out
+
+    def push(self, ids: np.ndarray, grads: np.ndarray) -> None:
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        grads = np.ascontiguousarray(grads, np.float32).reshape(
+            ids.size, self.dim)
+        for s, idx in enumerate(self._shard(ids)):
+            if idx.size:
+                self.cluster.clients[s].sparse_push(
+                    self.name, ids[idx], grads[idx], self.dim)
+
+    def size(self) -> int:
+        return sum(c.sparse_size(self.name) for c in self.cluster.clients)
+
+
+class PSTrainStep:
+    """Trainer-side step for PS training.
+
+    mode="sync":  push grad, pull params at version=step (barriered like
+                  the reference's fetch_barrier/send_barrier protocol).
+    mode="async": push grad (applies immediately), pull latest (hogwild).
+    mode="geo":   run `geo_k` local optimizer steps, then push the param
+                  delta to 'sum' tables and adopt the merged value
+                  (GeoCommunicator semantics).
+    """
+
+    def __init__(self, model: Layer, loss_fn: Callable, cluster: PSCluster,
+                 mode: str = "sync", n_trainers: int = 1,
+                 optimizer: str = "sgd", lr: float = 0.01,
+                 geo_k: int = 8, local_optimizer=None, seed: int = 0):
+        if mode not in ("sync", "async", "geo"):
+            raise ValueError(f"unknown PS mode {mode!r}")
+        self.model = model
+        self.loss_fn = loss_fn
+        self.mode = mode
+        self.geo_k = geo_k
+        self._step_no = 0
+        self._rng_key = jax.random.key(seed)
+        params = {k: np.asarray(v, np.float32)
+                  for k, v in model.param_dict().items()}
+        self._buffers = model.buffer_dict()
+
+        if mode == "geo":
+            if local_optimizer is None:
+                raise ValueError("geo mode needs local_optimizer")
+            self.local_opt = local_optimizer
+            self._opt_state = local_optimizer.init(params)
+            self.adapter = DensePSAdapter(cluster, params, optimizer="sum")
+            self._base = {k: v.copy() for k, v in params.items()}
+        else:
+            sync_world = n_trainers if mode == "sync" else 0
+            self.adapter = DensePSAdapter(
+                cluster, params, optimizer=optimizer, lr=lr,
+                sync_world=sync_world)
+        self._params = params
+        self._grad_fn = None
+
+    def _build_grad_fn(self):
+        def loss_of(p, key, args, labels):
+            with _random.rng_scope(default=key, dropout=key):
+                out, _ = functional_call(self.model, p, self._buffers,
+                                         *args, capture_buffers=True)
+                return self.loss_fn(out, *labels)
+
+        return jax.jit(jax.value_and_grad(loss_of))
+
+    def __call__(self, *args, labels=()) -> Dict[str, float]:
+        if self._grad_fn is None:
+            self._grad_fn = self._build_grad_fn()
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        loss, grads = self._grad_fn(self._params, sub, tuple(args),
+                                    tuple(labels))
+        grads = {k: np.asarray(v, np.float32) for k, v in grads.items()}
+        self._step_no += 1
+
+        if self.mode == "geo":
+            new_p, self._opt_state = self.local_opt.apply_gradients(
+                self._params, grads, self._opt_state)
+            self._params = {k: np.asarray(v, np.float32)
+                            for k, v in new_p.items()}
+            if self._step_no % self.geo_k == 0:
+                deltas = {k: self._params[k] - self._base[k]
+                          for k in self._params}
+                self.adapter.push(deltas)
+                merged = self.adapter.pull()
+                self._params = merged
+                self._base = {k: v.copy() for k, v in merged.items()}
+        else:
+            self.adapter.push(grads)
+            min_version = self._step_no if self.mode == "sync" else 0
+            self._params = self.adapter.pull(min_version=min_version)
+        return {"loss": float(loss)}
+
+    @property
+    def params(self) -> Dict[str, np.ndarray]:
+        return self._params
+
+    def sync_to_model(self) -> None:
+        self.model.set_state_dict(dict(self._params), strict=False)
+
+
+def run_server(port: int = 0, ready_callback: Optional[Callable] = None,
+               stop_event: Optional[threading.Event] = None) -> PsServer:
+    """Start a PS shard; blocks until stop_event (if given) else returns.
+
+    The reference blocks inside ListenAndServOp::RunImpl; here the server
+    runs on background threads, so blocking is optional.
+    """
+    server = PsServer(port)
+    if ready_callback is not None:
+        ready_callback(server)
+    if stop_event is not None:
+        try:
+            while not stop_event.wait(0.2):
+                pass
+        finally:
+            server.stop()
+    return server

@@ -134,6 +134,15 @@ define_flag("matmul_precision", "default",
 define_flag("use_pallas_kernels", True,
             "Route hot ops (attention, layer_norm, adam) through Pallas "
             "kernels when on TPU.")
+define_flag("flash_attention_min_seq", 4096,
+            "Key-sequence length at or above which attention routes to the "
+            "Pallas flash kernel (below it XLA's fused attention is faster "
+            "on v5e; the flash kernel is always O(T) memory).")
+define_flag("use_fast_rng", True,
+            "On TPU, use the hardware RngBitGenerator PRNG ('rbg') for "
+            "jax.random keys instead of threefry. Dropout-heavy training "
+            "is ~1.5x faster; streams are still splittable/foldable but "
+            "not bit-identical to threefry.")
 define_flag("profile_dir", "",
             "If set, write xplane profiler traces under this directory.")
 define_flag("log_level", 0, "Framework VLOG level (0 = off).")

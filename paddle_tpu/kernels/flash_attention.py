@@ -80,7 +80,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     acc, m_fin, l_fin = jax.lax.fori_loop(0, upper, body, (acc0, m0, l0))
     safe_l = jnp.maximum(l_fin, 1e-30)
     o_ref[0] = (acc / safe_l).astype(o_ref.dtype)
-    lse_ref[0] = (m_fin + jnp.log(safe_l))[:, 0]
+    lse_ref[0] = m_fin + jnp.log(safe_l)  # [BQ, 1]
 
 
 def _flash_forward(q, k, v, scale: float, causal: bool,
@@ -122,17 +122,20 @@ def _flash_forward(q, k, v, scale: float, causal: bool,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda g, i: (g, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq), lambda g, i: (g, i),
+            # lse as [bh, tq, 1]: a trailing unit dim (equal to the array
+            # dim) satisfies Mosaic's (8,128) block tiling rule, which a
+            # 2-D (1, bq) block does not
+            pl.BlockSpec((1, bq, 1), lambda g, i: (g, i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, tq_p), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, tq_p, 1), jnp.float32),
         ],
         interpret=interpret,
     )(qr, kr, vr)
     return (out[:, :tq].reshape(b, h, tq, d),
-            lse[:, :tq].reshape(b, h, tq))
+            lse[:, :tq, 0].reshape(b, h, tq))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))

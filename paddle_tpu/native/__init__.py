@@ -111,6 +111,32 @@ def _load():
             "pt_df_next": ([c.c_int64, c.POINTER(c.c_void_p),
                             c.POINTER(c.c_void_p), c.POINTER(c.c_void_p)],
                            c.c_int),
+            "pt_ps_server_start": ([c.c_int], c.c_int64),
+            "pt_ps_server_port": ([c.c_int64], c.c_int),
+            "pt_ps_server_stop": ([c.c_int64], None),
+            "pt_ps_connect": ([c.c_char_p, c.c_int, c.c_int], c.c_int64),
+            "pt_ps_disconnect": ([c.c_int64], None),
+            "pt_ps_dense_init": ([c.c_int64, c.c_char_p, c.c_int64,
+                                  c.POINTER(c.c_float), c.c_int,
+                                  c.POINTER(c.c_float), c.c_int], c.c_int),
+            "pt_ps_dense_pull": ([c.c_int64, c.c_char_p,
+                                  c.POINTER(c.c_float), c.c_int64, c.c_int64,
+                                  c.c_int], c.c_int64),
+            "pt_ps_dense_push": ([c.c_int64, c.c_char_p,
+                                  c.POINTER(c.c_float), c.c_int64],
+                                 c.c_int64),
+            "pt_ps_sparse_init": ([c.c_int64, c.c_char_p, c.c_int, c.c_int,
+                                   c.POINTER(c.c_float), c.c_float],
+                                  c.c_int),
+            "pt_ps_sparse_pull": ([c.c_int64, c.c_char_p,
+                                   c.POINTER(c.c_int64), c.c_int64, c.c_int,
+                                   c.POINTER(c.c_float)], c.c_int),
+            "pt_ps_sparse_push": ([c.c_int64, c.c_char_p,
+                                   c.POINTER(c.c_int64), c.c_int64, c.c_int,
+                                   c.POINTER(c.c_float)], c.c_int),
+            "pt_ps_sparse_size": ([c.c_int64, c.c_char_p], c.c_int64),
+            "pt_ps_save": ([c.c_int64, c.c_char_p], c.c_int),
+            "pt_ps_load": ([c.c_int64, c.c_char_p], c.c_int),
             "pt_mon_add": ([c.c_char_p, c.c_int64], None),
             "pt_mon_get": ([c.c_char_p], c.c_int64),
             "pt_mon_reset": ([c.c_char_p], None),
@@ -348,6 +374,157 @@ class NativeDataFeed:
             self.close()
         except Exception:
             pass
+
+
+# ------------------------------------------------------------ parameter server
+
+_OPT_CODES = {"sgd": 0, "adagrad": 1, "adam": 2, "sum": 3}
+
+
+def _hyper_array(lr: float, beta1: float = 0.9, beta2: float = 0.999,
+                 eps: float = 1e-8):
+    return (ctypes.c_float * 4)(lr, beta1, beta2, eps)
+
+
+class PsServer:
+    """Native parameter-server (dense + sparse tables, server-side optimize).
+
+    Replaces the reference's listen_and_serv op
+    (operators/distributed_ops/listen_and_serv_op.cc:352) — the per-grad
+    optimize sub-blocks become built-in C++ optimizers applied on push.
+    """
+
+    def __init__(self, port: int = 0):
+        lib = _load()
+        self._h = lib.pt_ps_server_start(port)
+        if self._h < 0:
+            raise RuntimeError(f"ps server failed on port {port}")
+        self.port = lib.pt_ps_server_port(self._h)
+
+    def stop(self) -> None:
+        if self._h > 0:
+            _load().pt_ps_server_stop(self._h)
+            self._h = -1
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class PsClient:
+    """Client of one PS shard; thread-safe per connection."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 timeout_ms: int = 30000):
+        self._h = _load().pt_ps_connect(host.encode(), port, timeout_ms)
+        if self._h < 0:
+            raise RuntimeError(f"connect to ps {host}:{port} failed")
+
+    def close(self) -> None:
+        if self._h > 0:
+            _load().pt_ps_disconnect(self._h)
+            self._h = -1
+
+    # dense -----------------------------------------------------------------
+    def dense_init(self, name: str, values: Optional[np.ndarray], n: int,
+                   optimizer: str = "sgd", lr: float = 0.01,
+                   beta1: float = 0.9, beta2: float = 0.999,
+                   eps: float = 1e-8, sync_world: int = 0) -> None:
+        init_ptr = None
+        if values is not None:
+            values = np.ascontiguousarray(values, np.float32).reshape(-1)
+            assert values.size == n
+            init_ptr = values.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        rc = _load().pt_ps_dense_init(
+            self._h, name.encode(), n, init_ptr, _OPT_CODES[optimizer],
+            _hyper_array(lr, beta1, beta2, eps), sync_world)
+        if rc != 0:
+            raise RuntimeError(f"ps dense_init({name!r}) failed ({rc})")
+
+    def dense_pull(self, name: str, n: int, min_version: int = 0,
+                   timeout_ms: int = 60000) -> Tuple[np.ndarray, int]:
+        out = np.empty(n, np.float32)
+        ver = _load().pt_ps_dense_pull(
+            self._h, name.encode(),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n,
+            min_version, timeout_ms)
+        if ver < 0:
+            raise TimeoutError(
+                f"ps dense_pull({name!r}, min_version={min_version}) "
+                f"failed ({ver})")
+        return out, int(ver)
+
+    def dense_push(self, name: str, grad: np.ndarray) -> int:
+        grad = np.ascontiguousarray(grad, np.float32).reshape(-1)
+        ver = _load().pt_ps_dense_push(
+            self._h, name.encode(),
+            grad.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), grad.size)
+        if ver < 0:
+            raise RuntimeError(f"ps dense_push({name!r}) failed ({ver})")
+        return int(ver)
+
+    # sparse ----------------------------------------------------------------
+    def sparse_init(self, name: str, dim: int, optimizer: str = "sgd",
+                    lr: float = 0.01, beta1: float = 0.9,
+                    beta2: float = 0.999, eps: float = 1e-8,
+                    init_scale: float = 0.0) -> None:
+        rc = _load().pt_ps_sparse_init(
+            self._h, name.encode(), dim, _OPT_CODES[optimizer],
+            _hyper_array(lr, beta1, beta2, eps), init_scale)
+        if rc != 0:
+            raise RuntimeError(f"ps sparse_init({name!r}) failed ({rc})")
+
+    def sparse_pull(self, name: str, ids: np.ndarray,
+                    dim: int) -> np.ndarray:
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        out = np.empty((ids.size, dim), np.float32)
+        rc = _load().pt_ps_sparse_pull(
+            self._h, name.encode(),
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), ids.size,
+            dim, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc != 0:
+            raise RuntimeError(f"ps sparse_pull({name!r}) failed ({rc})")
+        return out
+
+    def sparse_push(self, name: str, ids: np.ndarray, grads: np.ndarray,
+                    dim: int) -> None:
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        grads = np.ascontiguousarray(grads, np.float32).reshape(-1)
+        assert grads.size == ids.size * dim
+        rc = _load().pt_ps_sparse_push(
+            self._h, name.encode(),
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), ids.size,
+            dim, grads.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc != 0:
+            raise RuntimeError(f"ps sparse_push({name!r}) failed ({rc})")
+
+    def sparse_size(self, name: str) -> int:
+        v = _load().pt_ps_sparse_size(self._h, name.encode())
+        if v < 0:
+            raise RuntimeError(f"ps sparse_size({name!r}) failed ({v})")
+        return int(v)
+
+    def save(self, path: str) -> None:
+        if _load().pt_ps_save(self._h, path.encode()) != 0:
+            raise RuntimeError(f"ps save({path!r}) failed")
+
+    def load(self, path: str) -> None:
+        if _load().pt_ps_load(self._h, path.encode()) != 0:
+            raise RuntimeError(f"ps load({path!r}) failed")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 # --------------------------------------------------------------------- monitor

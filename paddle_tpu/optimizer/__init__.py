@@ -59,7 +59,12 @@ class Optimizer:
     # functional API
     # ------------------------------------------------------------------
     def init(self, params) -> Dict[str, Any]:
-        slots = _tree_map(lambda p: self.init_slots(p), params)
+        # Slots always live in fp32 regardless of param dtype (bf16 moment
+        # buffers diverge); update math runs in fp32 and the new param is
+        # cast back to its own dtype — see apply_gradients. This also keeps
+        # the train state's dtypes fixed across steps (a dtype that drifts
+        # bf16->fp32 between calls forces jit recompiles).
+        slots = _tree_map(lambda p: self.init_slots(_as_f32(p)), params)
         return {"step": jnp.zeros((), jnp.int32), "slots": slots}
 
     def init_slots(self, p) -> Dict[str, jax.Array]:
@@ -86,14 +91,19 @@ class Optimizer:
             if g is None:
                 new_p.append(p)
                 new_s.append(s)
-            elif isinstance(g, RowSlices):
-                np_, ns_ = self.update_sparse(p, g, s, lr_t, step)
-                new_p.append(np_)
-                new_s.append(ns_)
+                continue
+            out_dtype = getattr(p, "dtype", None)
+            if isinstance(g, RowSlices):
+                np_, ns_ = self.update_sparse(
+                    _as_f32(p), RowSlices(g.rows, _as_f32(g.values)),
+                    s, lr_t, step)
             else:
-                np_, ns_ = self.update(p, g, s, lr_t, step)
-                new_p.append(np_)
-                new_s.append(ns_)
+                np_, ns_ = self.update(_as_f32(p), _as_f32(g), s, lr_t,
+                                       step)
+            if out_dtype is not None and np_.dtype != out_dtype:
+                np_ = np_.astype(out_dtype)
+            new_p.append(np_)
+            new_s.append(ns_)
         return (jax.tree.unflatten(treedef, new_p),
                 {"step": step, "slots": jax.tree.unflatten(treedef, new_s)})
 
