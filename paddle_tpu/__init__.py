@@ -15,24 +15,11 @@ from . import errors, flags
 from .flags import get_flags, set_flags
 from .version import __version__
 
-# Fast hardware PRNG on TPU (FLAGS_use_fast_rng): must be configured before
-# the first jax.random key is created anywhere in the package. Dropout-mask
-# generation with threefry costs ~35% of a BERT-base train step on v5e;
-# the RngBitGenerator impl reclaims most of it.
-def _configure_fast_rng() -> None:
-    import jax
-
-    if not flags.GLOBAL_FLAGS.get("use_fast_rng"):
-        return
-    try:
-        backend = jax.default_backend()
-    except Exception:
-        return
-    if backend in ("tpu", "axon"):
-        jax.config.update("jax_default_prng_impl", "rbg")
-
-
-_configure_fast_rng()
+# NOTE: nothing in this module may touch a JAX backend (jax.devices/
+# jax.default_backend/key creation) at import time — a slow or contended
+# accelerator plugin would hang `import paddle_tpu`. Backend decisions
+# (incl. the fast TPU RngBitGenerator PRNG, FLAGS_use_fast_rng) are made
+# lazily at first use — see core/random.py:_configure_fast_rng_once.
 
 from .core import (CPUPlace, Place, TPUPlace, convert_dtype,
                    get_default_dtype, get_device, is_compiled_with_tpu, seed,

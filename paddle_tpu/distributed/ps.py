@@ -192,7 +192,7 @@ class PSTrainStep:
         self.mode = mode
         self.geo_k = geo_k
         self._step_no = 0
-        self._rng_key = jax.random.key(seed)
+        self._rng_key = _random.make_key(seed)
         params = {k: np.asarray(v, np.float32)
                   for k, v in model.param_dict().items()}
         self._buffers = model.buffer_dict()

@@ -23,6 +23,7 @@ from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..core.random import make_key
 from ..nn.layer import Layer, Parameter
 from ..ops.sparse import RowSlices, scatter_apply, to_dense
 from . import lr as lr_module
@@ -32,6 +33,20 @@ from .lr import LRScheduler, resolve_lr
 def _tree_map(fn, *trees):
     return jax.tree.map(fn, *trees,
                         is_leaf=lambda x: isinstance(x, RowSlices))
+
+
+def _as_f32(x):
+    """Upcast a low-precision leaf to fp32 for optimizer math.
+
+    Master-weight semantics of the reference's AMP path
+    (/root/reference/python/paddle/fluid/contrib/mixed_precision/decorator.py):
+    update math always runs in fp32 even when params/grads are bf16/fp16;
+    apply_gradients casts the result back to the param's own dtype.
+    """
+    dtype = getattr(x, "dtype", None)
+    if dtype in (jnp.bfloat16, jnp.float16):
+        return x.astype(jnp.float32)
+    return x
 
 
 class Optimizer:
@@ -75,12 +90,22 @@ class Optimizer:
         step = state["step"] + 1
         lr_t = lr_override if lr_override is not None \
             else resolve_lr(self.learning_rate, step)
+        # Upcast grads BEFORE clip/decay: a global-norm clip in fp16
+        # overflows (sum of squares vs fp16 max 65504) and silently zeroes
+        # every grad; all optimizer math is fp32 (master weights). This is
+        # the single upcast site for grads — the loop below only upcasts p.
+        def _g32(g):
+            if g is None:
+                return None
+            if isinstance(g, RowSlices):
+                return RowSlices(g.rows, _as_f32(g.values))
+            return _as_f32(g)
+
+        grads = jax.tree.map(
+            _g32, grads,
+            is_leaf=lambda x: x is None or isinstance(x, RowSlices))
         if self.grad_clip is not None:
             grads = self.grad_clip(grads)
-        if self.weight_decay:
-            grads = _tree_map(
-                lambda g, p: g + self.weight_decay * p
-                if not isinstance(g, RowSlices) else g, grads, params)
 
         flat_p, treedef = jax.tree.flatten(
             params, is_leaf=lambda x: isinstance(x, RowSlices))
@@ -94,12 +119,11 @@ class Optimizer:
                 continue
             out_dtype = getattr(p, "dtype", None)
             if isinstance(g, RowSlices):
-                np_, ns_ = self.update_sparse(
-                    _as_f32(p), RowSlices(g.rows, _as_f32(g.values)),
-                    s, lr_t, step)
+                np_, ns_ = self.update_sparse(_as_f32(p), g, s, lr_t, step)
             else:
-                np_, ns_ = self.update(_as_f32(p), _as_f32(g), s, lr_t,
-                                       step)
+                if self.weight_decay:
+                    g = g + self.weight_decay * _as_f32(p)
+                np_, ns_ = self.update(_as_f32(p), g, s, lr_t, step)
             if out_dtype is not None and np_.dtype != out_dtype:
                 np_ = np_.astype(out_dtype)
             new_p.append(np_)
@@ -477,7 +501,7 @@ class Dpsgd(Optimizer):
         g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
         scale = jnp.minimum(1.0, self.clip / jnp.maximum(g_norm, 1e-12))
         g = g * scale
-        key = jax.random.fold_in(jax.random.key(self.seed), step)
+        key = jax.random.fold_in(make_key(self.seed), step)
         noise = self.sigma * self.clip / self.batch_size \
             * jax.random.normal(key, g.shape, g.dtype)
         return p - lr_t * (g + noise), slots

@@ -86,7 +86,7 @@ class DGCTrainStep:
             "buffers": buffers,
             "opt": opt_state,
             "residual": jax.tree.map(jnp.zeros_like, params),
-            "rng": jax.random.key(seed),
+            "rng": _random.make_key(seed),
             "step_count": jnp.zeros((), jnp.int32),
         }
 

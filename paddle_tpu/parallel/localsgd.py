@@ -62,7 +62,7 @@ class LocalSGDStep:
             "buffers": stack(buffers),
             "opt": {"step": opt_state["step"],
                     "slots": stack(opt_state["slots"])},
-            "rng": jax.random.split(jax.random.key(seed), n),
+            "rng": jax.random.split(_random.make_key(seed), n),
         }
 
         def rep_spec(tree):

@@ -21,6 +21,8 @@ from __future__ import annotations
 from typing import Any, Callable, Optional, Sequence
 
 import jax
+
+from ..core import random as _random
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -150,7 +152,7 @@ class GPipeTrainStep:
         self.state_specs = {"params": self.param_specs,
                             "opt": opt_slot_specs, "rng": P()}
         state = {"params": params, "opt": opt_state,
-                 "rng": jax.random.key(seed)}
+                 "rng": _random.make_key(seed)}
         shardings = jax.tree.map(lambda s: NamedSharding(mesh, s),
                                  self.state_specs,
                                  is_leaf=lambda s: isinstance(s, P))

@@ -123,7 +123,7 @@ class ShardedTrainStep:
             "rng": P(),
         }
         state = {"params": params, "buffers": buffers, "opt": opt_state,
-                 "rng": jax.random.key(seed)}
+                 "rng": _random.make_key(seed)}
         # place initial state according to specs
         self.state = jax.device_put(
             state, jax.tree.map(

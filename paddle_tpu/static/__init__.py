@@ -252,7 +252,7 @@ class TrainStep:
             "params": params,
             "buffers": buffers,
             "opt": optimizer.init(params),
-            "rng": jax.random.key(seed),
+            "rng": _random.make_key(seed),
         }
         self._jitted = jax.jit(self._step, donate_argnums=(0,))
 
