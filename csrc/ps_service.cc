@@ -21,6 +21,7 @@
 #include "ptnative.h"
 
 #include <arpa/inet.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
@@ -246,6 +247,16 @@ class PsServer {
 
   bool Status(int fd, int64_t st) { return WriteFull(fd, &st, 8); }
 
+  // Cap on wire-supplied lengths, in BYTES (1 GiB): a corrupt/malicious
+  // length would otherwise throw bad_alloc/length_error out of the worker
+  // thread and std::terminate() the whole host process.
+  static constexpr int64_t kMaxWireBytes = int64_t{1} << 30;
+  static bool SaneCount(int64_t n, int64_t elem_bytes) {
+    return n >= 0 && n <= kMaxWireBytes / elem_bytes;
+  }
+  static bool SaneLen(int64_t n) { return SaneCount(n, 4); }
+  static bool SaneDim(int64_t d) { return d >= 0 && d <= (1 << 16); }
+
   bool Dispatch(int fd, PsOp op, const std::string& key) {
     switch (op) {
       case kDenseInit: {
@@ -255,7 +266,7 @@ class PsServer {
         Hyper hp;
         if (!ReadFull(fd, &n, 8) || !ReadFull(fd, &optc, 4) ||
             !ReadFull(fd, &sync_world, 4) || !ReadFull(fd, &hp, 16) ||
-            !ReadFull(fd, &has_init, 1))
+            !ReadFull(fd, &has_init, 1) || !SaneLen(n))
           return false;
         std::vector<float> init;
         if (has_init) {
@@ -279,7 +290,7 @@ class PsServer {
         int64_t n, min_version;
         uint32_t timeout_ms;
         if (!ReadFull(fd, &n, 8) || !ReadFull(fd, &min_version, 8) ||
-            !ReadFull(fd, &timeout_ms, 4))
+            !ReadFull(fd, &timeout_ms, 4) || !SaneLen(n))
           return false;
         std::vector<float> snapshot;
         int64_t version = -1;
@@ -305,7 +316,7 @@ class PsServer {
       }
       case kDensePush: {
         int64_t n;
-        if (!ReadFull(fd, &n, 8)) return false;
+        if (!ReadFull(fd, &n, 8) || !SaneLen(n)) return false;
         std::vector<float> grad(n);
         if (!ReadFull(fd, grad.data(), n * 4)) return false;
         int64_t version = -1;
@@ -348,10 +359,11 @@ class PsServer {
         if (!ReadFull(fd, &dim, 4) || !ReadFull(fd, &optc, 4) ||
             !ReadFull(fd, &hp, 16) || !ReadFull(fd, &scale, 4))
           return false;
+        if (!SaneDim(dim)) return Status(fd, -1);
         {
           std::lock_guard<std::mutex> lk(mu_);
           if (!sparse_.count(key)) {
-            auto t = std::make_unique<SparseTable>();
+            auto t = std::make_shared<SparseTable>();
             t->dim = dim;
             t->opt = static_cast<Optim>(optc);
             t->hyper = hp;
@@ -362,12 +374,17 @@ class PsServer {
         return Status(fd, 0);
       }
       case kSparsePull: {
+        // Client sends its dim so a missing/mismatched table is an error
+        // Status, never a response the client would mis-size.
         int64_t n;
-        if (!ReadFull(fd, &n, 8)) return false;
+        int32_t dim;
+        if (!ReadFull(fd, &n, 8) || !ReadFull(fd, &dim, 4) ||
+            !SaneCount(n, 8) || !SaneDim(dim) || !SaneCount(n * dim, 4))
+          return false;
         std::vector<int64_t> ids(n);
         if (!ReadFull(fd, ids.data(), n * 8)) return false;
-        SparseTable* t = FindSparse(key);
-        if (!t) return Status(fd, -1);
+        auto t = FindSparse(key);
+        if (!t || t->dim != dim) return Status(fd, -1);
         std::vector<float> out;
         {
           std::lock_guard<std::mutex> lk(t->mu);
@@ -381,15 +398,19 @@ class PsServer {
         return WriteFull(fd, out.data(), out.size() * 4);
       }
       case kSparsePush: {
+        // Client sends its dim so the payload is always fully consumed —
+        // a push to a missing table must not desynchronize the protocol.
         int64_t n;
-        if (!ReadFull(fd, &n, 8)) return false;
+        int32_t dim;
+        if (!ReadFull(fd, &n, 8) || !ReadFull(fd, &dim, 4) ||
+            !SaneCount(n, 8) || !SaneDim(dim) || !SaneCount(n * dim, 4))
+          return false;
         std::vector<int64_t> ids(n);
         if (!ReadFull(fd, ids.data(), n * 8)) return false;
-        SparseTable* t = FindSparse(key);
-        int64_t dim = t ? t->dim : 0;
         std::vector<float> grad(n * dim);
         if (dim && !ReadFull(fd, grad.data(), grad.size() * 4)) return false;
-        if (!t) return Status(fd, -1);
+        auto t = FindSparse(key);
+        if (!t || t->dim != dim) return Status(fd, -1);
         {
           std::lock_guard<std::mutex> lk(t->mu);
           for (int64_t i = 0; i < n; ++i) {
@@ -401,7 +422,7 @@ class PsServer {
         return Status(fd, 0);
       }
       case kSparseSize: {
-        SparseTable* t = FindSparse(key);
+        auto t = FindSparse(key);
         int64_t sz = -1;
         if (t) {
           std::lock_guard<std::mutex> lk(t->mu);
@@ -417,12 +438,17 @@ class PsServer {
     return false;
   }
 
-  SparseTable* FindSparse(const std::string& key) {
+  std::shared_ptr<SparseTable> FindSparse(const std::string& key) {
     std::lock_guard<std::mutex> lk(mu_);
     auto it = sparse_.find(key);
-    return it == sparse_.end() ? nullptr : it->second.get();
+    return it == sparse_.end() ? nullptr : it->second;
   }
 
+  // Checkpoint format v2: persists table config (opt, hyper, sync_world,
+  // init_scale) and optimizer state (m/v/step, dense and per-row sparse)
+  // so resume does not silently reset slots to default-SGD tables — the
+  // reference checkpoints optimizer slot vars together with params
+  // (save_persistables; large_scale_kv tables save their slots).
   bool SaveTo(const std::string& path) {
     std::lock_guard<std::mutex> lk(mu_);
     FILE* f = std::fopen(path.c_str(), "wb");
@@ -432,26 +458,45 @@ class PsServer {
       w64(static_cast<int64_t>(s.size()));
       std::fwrite(s.data(), 1, s.size(), f);
     };
+    auto wvec = [&](const std::vector<float>& v) {
+      w64(static_cast<int64_t>(v.size()));
+      std::fwrite(v.data(), 4, v.size(), f);
+    };
+    auto wstate = [&](const OptimState& st) {
+      w64(st.step);
+      wvec(st.m);
+      wvec(st.v);
+    };
+    std::fwrite(kCkptMagic, 1, 8, f);
     w64(static_cast<int64_t>(dense_.size()));
     for (auto& [name, t] : dense_) {
       wstr(name);
-      w64(static_cast<int64_t>(t.values.size()));
-      std::fwrite(t.values.data(), 4, t.values.size(), f);
+      w64(static_cast<int64_t>(t.opt));
+      w64(t.sync_world);
+      std::fwrite(&t.hyper, sizeof(Hyper), 1, f);
+      wvec(t.values);
       w64(t.version);
+      wstate(t.state);
     }
     w64(static_cast<int64_t>(sparse_.size()));
     for (auto& [name, tp] : sparse_) {
       std::lock_guard<std::mutex> tlk(tp->mu);
       wstr(name);
       w64(tp->dim);
+      w64(static_cast<int64_t>(tp->opt));
+      std::fwrite(&tp->hyper, sizeof(Hyper), 1, f);
+      std::fwrite(&tp->init_scale, 4, 1, f);
       w64(static_cast<int64_t>(tp->rows.size()));
       for (auto& [id, row] : tp->rows) {
         w64(id);
         std::fwrite(row.data(), 4, tp->dim, f);
+        auto it = tp->states.find(id);
+        wstate(it == tp->states.end() ? OptimState{} : it->second);
       }
     }
+    bool ok = std::fflush(f) == 0 && !std::ferror(f);
     std::fclose(f);
-    return true;
+    return ok;
   }
 
   bool LoadFrom(const std::string& path) {
@@ -465,46 +510,80 @@ class PsServer {
       s->resize(n);
       return std::fread(s->data(), 1, n, f) == static_cast<size_t>(n);
     };
+    auto rvec = [&](std::vector<float>* v) {
+      int64_t n;
+      if (!r64(&n) || !SaneLen(n)) return false;
+      v->resize(n);
+      return std::fread(v->data(), 4, n, f) == static_cast<size_t>(n);
+    };
+    auto rstate = [&](OptimState* st) {
+      return r64(&st->step) && rvec(&st->m) && rvec(&st->v);
+    };
+    char magic[8] = {};
+    if (std::fread(magic, 1, 8, f) != 8 ||
+        std::memcmp(magic, kCkptMagic, 8) != 0) {
+      std::fclose(f);
+      return false;
+    }
+    // Load into fresh maps and swap only on full success: a truncated or
+    // corrupt checkpoint must not leave half-initialized live tables, and
+    // restore replaces ALL state (rows pushed after the save are dropped).
+    std::map<std::string, DenseTable> new_dense;
+    std::map<std::string, std::shared_ptr<SparseTable>> new_sparse;
     bool ok = true;
     int64_t nd = 0;
     ok = ok && r64(&nd);
     for (int64_t i = 0; ok && i < nd; ++i) {
       std::string name;
-      int64_t n = 0;
-      ok = rstr(&name) && r64(&n);
+      int64_t optc = 0, sync_world = 0;
+      ok = rstr(&name) && r64(&optc) && r64(&sync_world);
       if (!ok) break;
-      auto& t = dense_[name];
-      t.values.resize(n);
-      ok = std::fread(t.values.data(), 4, n, f) == static_cast<size_t>(n) &&
-           r64(&t.version);
+      auto& t = new_dense[name];
+      t.opt = static_cast<Optim>(optc);
+      t.sync_world = static_cast<int>(sync_world);
+      ok = std::fread(&t.hyper, sizeof(Hyper), 1, f) == 1 &&
+           rvec(&t.values) && r64(&t.version) && rstate(&t.state);
     }
     int64_t ns = 0;
     ok = ok && r64(&ns);
     for (int64_t i = 0; ok && i < ns; ++i) {
       std::string name;
-      int64_t dim = 0, rows = 0;
-      ok = rstr(&name) && r64(&dim) && r64(&rows);
-      if (!ok) break;
-      if (!sparse_.count(name)) {
-        auto t = std::make_unique<SparseTable>();
-        t->dim = static_cast<int>(dim);
-        sparse_[name] = std::move(t);
+      int64_t dim = 0, optc = 0, rows = 0;
+      float init_scale = 0.f;
+      Hyper hp;
+      ok = rstr(&name) && r64(&dim) && r64(&optc) &&
+           std::fread(&hp, sizeof(Hyper), 1, f) == 1 &&
+           std::fread(&init_scale, 4, 1, f) == 1 && r64(&rows);
+      if (!ok || !SaneDim(dim) || !SaneCount(rows, 8)) {
+        ok = false;
+        break;
       }
-      SparseTable* t = sparse_[name].get();
-      std::lock_guard<std::mutex> tlk(t->mu);
+      auto tp = std::make_shared<SparseTable>();
+      SparseTable* t = tp.get();
+      t->dim = static_cast<int>(dim);
+      t->opt = static_cast<Optim>(optc);
+      t->hyper = hp;
+      t->init_scale = init_scale;
       for (int64_t r = 0; ok && r < rows; ++r) {
         int64_t id;
         ok = r64(&id);
         if (!ok) break;
         std::vector<float> row(dim);
-        ok = std::fread(row.data(), 4, dim, f) == static_cast<size_t>(dim);
+        ok = std::fread(row.data(), 4, dim, f) == static_cast<size_t>(dim) &&
+             rstate(&t->states[id]);
         t->rows[id] = std::move(row);
       }
+      new_sparse[name] = std::move(tp);
     }
     std::fclose(f);
+    if (!ok) return false;
+    dense_.swap(new_dense);
+    sparse_.swap(new_sparse);
     cv_.notify_all();
-    return ok;
+    return true;
   }
+
+  static constexpr char kCkptMagic[9] = "PTPSCK02";
 
   int listen_fd_ = -1;
   int port_ = 0;
@@ -513,7 +592,9 @@ class PsServer {
   std::mutex mu_;
   std::condition_variable cv_;
   std::map<std::string, DenseTable> dense_;
-  std::map<std::string, std::unique_ptr<SparseTable>> sparse_;
+  // shared_ptr: LoadFrom swaps the map while workers may still hold a
+  // table reference from FindSparse — the old table must outlive them.
+  std::map<std::string, std::shared_ptr<SparseTable>> sparse_;
   std::vector<std::thread> workers_;
   std::vector<int> client_fds_;
 };
@@ -521,14 +602,30 @@ class PsServer {
 class PsClient {
  public:
   PsClient(const char* host, int port, int timeout_ms) {
+    // Resolve numeric OR hostname endpoints. inet_pton alone silently
+    // leaves sin_addr zeroed for hostnames ("ps0:6174"), misrouting all
+    // PS traffic to 0.0.0.0 (the local machine) instead of failing.
+    sockaddr_in resolved{};
+    resolved.sin_family = AF_INET;
+    resolved.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &resolved.sin_addr) != 1) {
+      addrinfo hints{};
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_STREAM;
+      addrinfo* res = nullptr;
+      if (::getaddrinfo(host, nullptr, &hints, &res) != 0 || !res) {
+        fd_ = -1;
+        return;  // unresolvable endpoint: fail, don't dial 0.0.0.0
+      }
+      resolved.sin_addr =
+          reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+      ::freeaddrinfo(res);
+    }
     auto deadline = std::chrono::steady_clock::now() +
                     std::chrono::milliseconds(timeout_ms);
     while (std::chrono::steady_clock::now() < deadline) {
       fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
-      sockaddr_in addr{};
-      addr.sin_family = AF_INET;
-      addr.sin_port = htons(static_cast<uint16_t>(port));
-      ::inet_pton(AF_INET, host, &addr.sin_addr);
+      sockaddr_in addr = resolved;
       if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) ==
           0) {
         int one = 1;
@@ -708,6 +805,8 @@ int pt_ps_sparse_pull(int64_t h, const char* name, const int64_t* ids,
   std::lock_guard<std::mutex> lk(c->mu());
   std::string payload;
   payload.append(reinterpret_cast<char*>(&n), 8);
+  int32_t d = dim;
+  payload.append(reinterpret_cast<char*>(&d), 4);
   payload.append(reinterpret_cast<const char*>(ids), n * 8);
   if (!PsSend(c.get(), kSparsePull, name, payload)) return -4;
   int64_t st;
@@ -724,6 +823,8 @@ int pt_ps_sparse_push(int64_t h, const char* name, const int64_t* ids,
   std::lock_guard<std::mutex> lk(c->mu());
   std::string payload;
   payload.append(reinterpret_cast<char*>(&n), 8);
+  int32_t d = dim;
+  payload.append(reinterpret_cast<char*>(&d), 4);
   payload.append(reinterpret_cast<const char*>(ids), n * 8);
   payload.append(reinterpret_cast<const char*>(grad), n * dim * 4);
   if (!PsSend(c.get(), kSparsePush, name, payload)) return -4;

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import threading
 import time
+import zlib
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -69,7 +70,10 @@ def _split_blocks(name: str, size: int, n_servers: int,
     whole on one shard (chosen by name hash for balance).
     """
     if size <= min_block or n_servers == 1:
-        server = hash(name) % n_servers
+        # crc32, not builtin hash(): hash() is salted per process, so two
+        # trainer processes would map the same param to different shards
+        # (sync accumulation never completes; async trains disjoint copies).
+        server = zlib.crc32(name.encode("utf-8")) % n_servers
         return [(server, f"{name}.block0", 0, size)]
     n_blocks = min(n_servers, (size + min_block - 1) // min_block)
     per = (size + n_blocks - 1) // n_blocks
@@ -161,6 +165,16 @@ class SparseEmbeddingPS:
         ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
         grads = np.ascontiguousarray(grads, np.float32).reshape(
             ids.size, self.dim)
+        # Merge duplicate ids before pushing (reference merge_sparse_grad
+        # semantics): the server applies its per-row optimizer once per
+        # received row, so duplicates would take multiple adagrad/adam slot
+        # steps for one batch.
+        if ids.size:
+            uniq, inv = np.unique(ids, return_inverse=True)
+            if uniq.size != ids.size:
+                summed = np.zeros((uniq.size, self.dim), np.float32)
+                np.add.at(summed, inv, grads)
+                ids, grads = uniq, summed
         for s, idx in enumerate(self._shard(ids)):
             if idx.size:
                 self.cluster.clients[s].sparse_push(

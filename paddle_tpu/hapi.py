@@ -124,16 +124,38 @@ class Model:
         self._train_step: Optional[TrainStep] = None
         self._eval_step: Optional[EvalStep] = None
         self._fitting = False
+        self._mesh = None
+        self._mesh_kwargs: Dict = {}
 
     def prepare(self, optimizer: Optional[Optimizer] = None,
                 loss: Optional[Callable] = None,
-                metrics: Optional[Sequence[Metric]] = None) -> "Model":
+                metrics: Optional[Sequence[Metric]] = None,
+                mesh=None, **mesh_kwargs) -> "Model":
+        """Configure training. With ``mesh=`` the same Model API trains
+        distributed — fit() routes to a ShardedTrainStep over the mesh
+        (the reference's "same Model, ParallelExecutor underneath":
+        hapi/model.py adapters picking CompiledProgram.with_data_parallel).
+        Extra kwargs (batch_spec, param_rule, zero_stage, dp_axis) pass
+        through to ShardedTrainStep.
+        """
         if optimizer is not None:
             self._optimizer = optimizer
         if loss is not None:
             self._loss = loss
         if metrics is not None:
             self._metrics = _as_metric_list(metrics)
+        allowed = {"batch_spec", "param_rule", "zero_stage", "dp_axis",
+                   "seed"}
+        unknown = set(mesh_kwargs) - allowed
+        if unknown or (mesh_kwargs and mesh is None):
+            raise TypeError(
+                f"prepare() got unexpected keyword arguments "
+                f"{sorted(unknown or mesh_kwargs)}; mesh options "
+                f"({sorted(allowed)}) require mesh=")
+        if mesh is not None:
+            self._mesh = mesh
+            self._mesh_kwargs = dict(mesh_kwargs)
+            self._train_step = None
         return self
 
     def _get_train_step(self) -> TrainStep:
@@ -152,8 +174,14 @@ class Model:
                     from .ops.metrics_ops import accuracy as acc_fn
                     extra["acc"] = (lambda out, *ls:
                                     acc_fn(out, ls[0]))
-            self._train_step = TrainStep(self.network, self._optimizer,
-                                         loss_call, extra_metrics=extra)
+            if self._mesh is not None:
+                from .parallel import ShardedTrainStep
+                self._train_step = ShardedTrainStep(
+                    self.network, self._optimizer, loss_call, self._mesh,
+                    extra_metrics=extra, **self._mesh_kwargs)
+            else:
+                self._train_step = TrainStep(self.network, self._optimizer,
+                                             loss_call, extra_metrics=extra)
         return self._train_step
 
     def train_batch(self, inputs, labels) -> Dict[str, float]:
@@ -189,15 +217,32 @@ class Model:
         try:
             for cb in callbacks:
                 cb.on_train_begin()
+            step = self._get_train_step()
             for epoch in range(epochs):
                 for cb in callbacks:
                     cb.on_epoch_begin(epoch)
+                # HOT LOOP: no host sync per step. Metrics stay device
+                # arrays (callbacks that float() them sync only when they
+                # do, e.g. ProgBarLogger every log_freq); the epoch mean is
+                # fetched once at epoch end. The reference keeps Python out
+                # of the loop entirely (hogwild_worker.cc:191) — here the
+                # loop is Python but every iteration is one async XLA
+                # dispatch.
+                totals: Dict[str, jnp.ndarray] = {}
+                count = 0
                 logs: Dict[str, float] = {}
                 for i, batch in enumerate(train_loader):
                     *inputs, label = batch
-                    logs = self.train_batch(inputs, [label])
+                    metrics = step(*inputs, labels=(label,))
+                    for k, v in metrics.items():
+                        # running device-side sum: O(1) buffers, still one
+                        # async dispatch per step (no host sync)
+                        totals[k] = v if k not in totals else totals[k] + v
+                    count += 1
                     for cb in callbacks:
-                        cb.on_batch_end(i, logs)
+                        cb.on_batch_end(i, metrics)
+                logs = {k: float(v) / max(count, 1)
+                        for k, v in totals.items()}
                 if eval_loader is not None:
                     logs.update(self.evaluate(eval_loader, verbose=0))
                 for k, v in logs.items():

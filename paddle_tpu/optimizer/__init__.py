@@ -79,7 +79,21 @@ class Optimizer:
         # cast back to its own dtype — see apply_gradients. This also keeps
         # the train state's dtypes fixed across steps (a dtype that drifts
         # bf16->fp32 between calls forces jit recompiles).
-        slots = _tree_map(lambda p: self.init_slots(_as_f32(p)), params)
+        #
+        # Low-precision params additionally get a persistent fp32 MASTER
+        # copy in their slots: without it, p32 - lr*u rounds back to the
+        # old bf16 value whenever the update is below half an ulp (~0.4%
+        # relative for bf16), silently freezing training. The master
+        # accumulates sub-ulp updates; the bf16 param is its cast-down view
+        # (reference AMP master weights: contrib/mixed_precision/
+        # decorator.py _create_master_weight).
+        def mk(p):
+            slots = dict(self.init_slots(_as_f32(p)))
+            if getattr(p, "dtype", None) in (jnp.bfloat16, jnp.float16):
+                slots["master"] = jnp.asarray(p, jnp.float32)
+            return slots
+
+        slots = _tree_map(mk, params)
         return {"step": jnp.zeros((), jnp.int32), "slots": slots}
 
     def init_slots(self, p) -> Dict[str, jax.Array]:
@@ -118,12 +132,20 @@ class Optimizer:
                 new_s.append(s)
                 continue
             out_dtype = getattr(p, "dtype", None)
+            # fp32 master copy (see init): the update reads and writes the
+            # master; the low-precision param is its cast-down view.
+            has_master = isinstance(s, dict) and "master" in s
+            p32 = s["master"] if has_master else _as_f32(p)
+            s_upd = {k: v for k, v in s.items() if k != "master"} \
+                if has_master else s
             if isinstance(g, RowSlices):
-                np_, ns_ = self.update_sparse(_as_f32(p), g, s, lr_t, step)
+                np_, ns_ = self.update_sparse(p32, g, s_upd, lr_t, step)
             else:
                 if self.weight_decay:
-                    g = g + self.weight_decay * _as_f32(p)
-                np_, ns_ = self.update(_as_f32(p), g, s, lr_t, step)
+                    g = g + self.weight_decay * p32
+                np_, ns_ = self.update(p32, g, s_upd, lr_t, step)
+            if has_master:
+                ns_ = dict(ns_, master=np_)
             if out_dtype is not None and np_.dtype != out_dtype:
                 np_ = np_.astype(out_dtype)
             new_p.append(np_)

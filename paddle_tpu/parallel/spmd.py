@@ -124,15 +124,12 @@ class ShardedTrainStep:
         }
         state = {"params": params, "buffers": buffers, "opt": opt_state,
                  "rng": _random.make_key(seed)}
-        # place initial state according to specs
-        self.state = jax.device_put(
-            state, jax.tree.map(
-                lambda s: NamedSharding(mesh, s), self.state_specs,
-                is_leaf=lambda x: isinstance(x, P)))
-
         state_shardings = jax.tree.map(
             lambda s: NamedSharding(mesh, s), self.state_specs,
             is_leaf=lambda x: isinstance(x, P))
+        self._state_shardings = state_shardings
+        # place initial state according to specs
+        self.state = jax.device_put(state, state_shardings)
         self.batch_sharding = NamedSharding(mesh, batch_spec)
 
         self._jitted = jax.jit(
@@ -180,10 +177,30 @@ class ShardedTrainStep:
         return self.state["params"]
 
     def sync_to_model(self) -> None:
-        host = jax.tree.map(lambda x: jax.device_get(x),
-                            {**self.state["params"],
-                             **self.state["buffers"]})
+        state = {**self.state["params"], **self.state["buffers"]}
+        # A step that failed mid-execution may have consumed (deleted) the
+        # donated buffers with no result to replace them; skip those rather
+        # than raise from cleanup paths (same contract as TrainStep).
+        alive = {k: v for k, v in state.items()
+                 if not (hasattr(v, "is_deleted") and v.is_deleted())}
+        if len(alive) < len(state):
+            import warnings
+            warnings.warn(
+                f"sync_to_model: {len(state) - len(alive)} donated buffers "
+                "were lost to a failed step; those weights keep their "
+                "previous values in the eager model")
+        host = jax.tree.map(jax.device_get, alive)
         self.model.set_state_dict(host, strict=False)
+
+    def reset_from_model(self) -> None:
+        """Re-shard the eager model's (possibly mutated) weights into the
+        training state — same contract as TrainStep.reset_from_model."""
+        self.state = dict(
+            self.state,
+            params=jax.device_put(self.model.param_dict(),
+                                  self._state_shardings["params"]),
+            buffers=jax.device_put(self.model.buffer_dict(),
+                                   self._state_shardings["buffers"]))
 
 
 def megatron_param_rule(mp_axis: str = "mp"):

@@ -178,7 +178,12 @@ class Executor:
         history: List[float] = []
         step_idx = 0
         for _ in range(int(epochs)):
-            total, count = 0.0, 0
+            # HOT LOOP: no host sync per step — the loss stays a device
+            # array in a running sum fetched once per epoch (the reference
+            # keeps Python out of the loop entirely: hogwild_worker.cc:191;
+            # forcing float(loss) each step would block async dispatch).
+            total = None
+            count = 0
             for batch in dataset:
                 rows = batch[names[0]].shape[0]
                 if drop_last and rows < dataset._batch_size:
@@ -186,15 +191,16 @@ class Executor:
                 args = tuple(batch[n] for n in input_slots)
                 labels = tuple(batch[n] for n in label_slots)
                 metrics = program(*args, labels=labels)
-                loss = float(metrics["loss"])
-                total += loss
+                total = metrics["loss"] if total is None \
+                    else total + metrics["loss"]
                 count += 1
                 step_idx += 1
                 if print_period and step_idx % print_period == 0:
-                    print(f"step {step_idx}: loss={loss:.6f}")
+                    print(f"step {step_idx}: "
+                          f"loss={float(metrics['loss']):.6f}")
                 if fetch_handler is not None:
                     fetch_handler(metrics)
-            history.append(total / max(count, 1))
+            history.append(float(total) / count if count else 0.0)
         return history
 
     def infer_from_dataset(self, program, dataset,

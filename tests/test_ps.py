@@ -115,6 +115,94 @@ class TestDenseTable:
         np.testing.assert_array_equal(out, np.arange(5, dtype=np.float32))
         assert client.sparse_size("emb") == 2
 
+    def test_save_load_preserves_optimizer_state(self, client, tmp_path):
+        """Resume must continue the adagrad/adam trajectory, not restart it.
+
+        Uninterrupted: push g three times. Interrupted: push, save, push
+        (discarded), load, push twice. Trajectories must match exactly —
+        they only do if m/v/step slots are in the checkpoint.
+        """
+        g = np.full(4, 2.0, np.float32)
+        client.dense_init("ref", np.zeros(4, np.float32), 4,
+                          optimizer="adagrad", lr=0.5)
+        for _ in range(3):
+            client.dense_push("ref", g)
+        expect, _ = client.dense_pull("ref", 4)
+
+        client.dense_init("w", np.zeros(4, np.float32), 4,
+                          optimizer="adagrad", lr=0.5)
+        client.dense_push("w", g)
+        path = str(tmp_path / "ps.bin")
+        client.save(path)
+        client.dense_push("w", g)  # will be discarded by load
+        client.load(path)
+        client.dense_push("w", g)
+        client.dense_push("w", g)
+        out, _ = client.dense_pull("w", 4)
+        np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+    def test_load_into_fresh_server_restores_config(self, client, tmp_path):
+        """A fresh server must recover opt/hyper from the checkpoint, not
+        default-SGD tables, and sparse per-row slots must survive."""
+        client.dense_init("w", np.zeros(2, np.float32), 2,
+                          optimizer="adam", lr=0.1)
+        client.sparse_init("emb", 2, optimizer="adagrad", lr=0.5,
+                           init_scale=0.0)
+        ids = np.array([3])
+        client.sparse_push("emb", ids, np.array([[2.0, 2.0]], np.float32), 2)
+        path = str(tmp_path / "ps.bin")
+        client.save(path)
+
+        # expected continuation on the original server
+        client.dense_push("w", np.ones(2, np.float32))
+        expect_w, _ = client.dense_pull("w", 2)
+        client.sparse_push("emb", ids, np.array([[2.0, 2.0]], np.float32), 2)
+        expect_row = client.sparse_pull("emb", ids, 2)
+
+        s2 = native.PsServer()
+        try:
+            c2 = native.PsClient("127.0.0.1", s2.port)
+            c2.load(path)
+            c2.dense_push("w", np.ones(2, np.float32))
+            out, _ = c2.dense_pull("w", 2)
+            np.testing.assert_allclose(out, expect_w, rtol=1e-6)
+            c2.sparse_push("emb", ids,
+                           np.array([[2.0, 2.0]], np.float32), 2)
+            row = c2.sparse_pull("emb", ids, 2)
+            np.testing.assert_allclose(row, expect_row, rtol=1e-6)
+            c2.close()
+        finally:
+            s2.stop()
+
+    def test_hostname_endpoint_resolves(self, server):
+        c = native.PsClient("localhost", server.port)
+        try:
+            c.dense_init("w", np.ones(2, np.float32), 2)
+            out, _ = c.dense_pull("w", 2)
+            np.testing.assert_array_equal(out, np.ones(2, np.float32))
+        finally:
+            c.close()
+
+    def test_bogus_wire_length_rejected(self, server, client):
+        """A corrupt/hostile length must drop that connection, not
+        std::terminate() the server process."""
+        import socket
+        import struct
+        raw = socket.create_connection(("127.0.0.1", server.port))
+        try:
+            key = b"w"
+            # kDensePush=3, then an absurd element count
+            raw.sendall(struct.pack("<BI", 3, len(key)) + key
+                        + struct.pack("<q", 1 << 60))
+            raw.settimeout(5)
+            assert raw.recv(8) == b""  # server closed the connection
+        finally:
+            raw.close()
+        # server still serves other clients
+        client.dense_init("ok", np.ones(2, np.float32), 2)
+        out, _ = client.dense_pull("ok", 2)
+        np.testing.assert_array_equal(out, np.ones(2, np.float32))
+
 
 class TestSparseTable:
     def test_lazy_init_deterministic(self, client):
@@ -133,6 +221,21 @@ class TestSparseTable:
         client.sparse_push("emb", ids, g, 2)
         out = client.sparse_pull("emb", ids, 2)
         np.testing.assert_allclose(out, -0.5 * g)
+
+    def test_duplicate_ids_merged_per_batch(self, server):
+        """Duplicate ids in one batch take ONE slot step with the summed
+        grad (reference merge_sparse_grad), not one step per occurrence."""
+        from paddle_tpu.distributed.ps import PSCluster, SparseEmbeddingPS
+        cluster = PSCluster([f"127.0.0.1:{server.port}"])
+        emb = SparseEmbeddingPS(cluster, "e", 2, optimizer="adagrad",
+                                lr=0.5, init_scale=0.0)
+        emb.push(np.array([7, 7]),
+                 np.ones((2, 2), np.float32))
+        row = emb.pull(np.array([7]))
+        # merged: one adagrad step, g=2, m=4 -> -0.5 * 2/2 = -0.5
+        # unmerged would give -0.5 - 0.354 = -0.854
+        np.testing.assert_allclose(row, -0.5, rtol=1e-5)
+        cluster.close()
 
 
 class TestPSCluster:

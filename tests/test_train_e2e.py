@@ -164,3 +164,29 @@ def test_optimizer_variants_converge():
                 first = float(m["loss"])
         assert float(m["loss"]) < first * threshold, \
             f"{make_opt().__class__.__name__}: {first} → {float(m['loss'])}"
+
+
+def test_bf16_master_weights_accumulate_sub_ulp_updates():
+    """A bf16 param near 1.0 (ulp ~0.0078) trained with updates of ~1e-4
+    must still move: the fp32 master copy accumulates what bf16 rounding
+    would discard every step (ref AMP master weights,
+    contrib/mixed_precision/decorator.py)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+
+    p0 = jnp.full((4,), 1.0, jnp.bfloat16)
+    params = {"w": p0}
+    opt = pt.optimizer.SGD(learning_rate=1e-4)
+    state = opt.init(params)
+    assert state["slots"]["w"]["master"].dtype == jnp.float32
+    g = {"w": jnp.ones((4,), jnp.bfloat16)}
+    for _ in range(60):
+        params, state = opt.apply_gradients(params, g, state)
+    assert params["w"].dtype == jnp.bfloat16
+    # 60 * 1e-4 = 0.006 total: below one bf16 ulp per step, but ~his
+    # accumulated drop must be visible after 60 steps
+    assert float(params["w"][0]) < 1.0
+    np.testing.assert_allclose(
+        np.asarray(state["slots"]["w"]["master"]),
+        1.0 - 60 * 1e-4, rtol=1e-5)
