@@ -5,3 +5,14 @@ from .dtype import convert_dtype, get_default_dtype, set_default_dtype
 from .place import (CPUPlace, Place, TPUPlace, get_device, is_compiled_with_tpu,
                     set_device)
 from .random import Generator, default_generator, next_key, rng_scope, seed
+
+
+def as_label_tuple(labels):
+    """Normalize a ``labels=`` argument to a tuple of arrays.
+
+    A bare array is ONE label, not a sequence to unpack — ``tuple(arr)``
+    would shred it into per-row scalars and break batch sharding.
+    """
+    if isinstance(labels, (tuple, list)):
+        return tuple(labels)
+    return (labels,)

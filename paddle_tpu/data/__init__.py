@@ -17,8 +17,6 @@ jax.device_put (the BufferedReader.ReadAsync role).
 from __future__ import annotations
 
 import itertools
-import queue
-import threading
 from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
 
 import jax
@@ -167,18 +165,26 @@ def default_collate_fn(batch: Sequence[Any]):
 
 
 class DataLoader:
-    """(ref: reader.py:123). Threaded prefetch; worker parsing runs in a
-    thread pool (numpy releases the GIL for the heavy stacking)."""
+    """(ref: reader.py:123, dataloader_iter.py:237,335).
+
+    ``num_workers=0``: batches are produced inline in the calling thread.
+    ``num_workers>0``: that many **worker processes** parse and collate
+    batches, shipping them to the parent through shared-memory segments
+    (see data/worker.py); batch order matches the sampler regardless of
+    worker count, and a dead worker raises instead of hanging.
+    """
 
     def __init__(self, dataset, batch_size: int = 1, shuffle: bool = False,
                  drop_last: bool = False, collate_fn: Optional[Callable]
                  = None, num_workers: int = 0, batch_sampler=None,
                  prefetch_factor: int = 2, places=None,
-                 return_list: bool = True) -> None:
+                 return_list: bool = True,
+                 mp_start_method: str = "fork") -> None:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 1)
+        self.mp_start_method = mp_start_method
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
         elif isinstance(dataset, IterableDataset):
@@ -209,24 +215,21 @@ class DataLoader:
         if self.num_workers <= 0:
             yield from self._iter_batches()
             return
-        q: "queue.Queue" = queue.Queue(
-            maxsize=self.num_workers * self.prefetch_factor)
-        stop = object()
-
-        def producer():
-            try:
-                for b in self._iter_batches():
-                    q.put(b)
-            finally:
-                q.put(stop)
-
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
-        while True:
-            item = q.get()
-            if item is stop:
-                break
-            yield item
+        from .worker import IterableMultiprocessIter, MultiprocessIter
+        if self.batch_sampler is None:
+            it = IterableMultiprocessIter(
+                self.dataset, self.collate_fn, self.batch_size,
+                self.drop_last, self.num_workers,
+                mp_start_method=self.mp_start_method)
+        else:
+            it = MultiprocessIter(
+                self.dataset, self.collate_fn, list(self.batch_sampler),
+                self.num_workers, prefetch_factor=self.prefetch_factor,
+                mp_start_method=self.mp_start_method)
+        try:
+            yield from it
+        finally:
+            it.shutdown()
 
     def __len__(self):
         if self.batch_sampler is None:

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from typing import Callable, Dict, Optional
 
+from ..core import as_label_tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -145,7 +146,7 @@ class LocalSGDStep:
             donate_argnums=(0,))
 
     def __call__(self, *args, labels=()):
-        batch = {"args": args, "labels": tuple(labels)}
+        batch = {"args": args, "labels": as_label_tuple(labels)}
         with self.mesh:
             self.state, metrics = self._local(self.state, batch)
             self._calls += 1

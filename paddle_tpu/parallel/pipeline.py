@@ -20,6 +20,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional, Sequence
 
+from ..core import as_label_tuple
 import jax
 
 from ..core import random as _random
@@ -190,5 +191,5 @@ class GPipeTrainStep:
     def __call__(self, x, labels=()):
         with self.mesh:
             self.state, metrics = self._jitted(
-                self.state, {"x": x, "labels": tuple(labels)})
+                self.state, {"x": x, "labels": as_label_tuple(labels)})
         return metrics

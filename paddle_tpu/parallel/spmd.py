@@ -19,6 +19,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ..core import as_label_tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -137,6 +138,34 @@ class ShardedTrainStep:
             in_shardings=(state_shardings, self.batch_sharding),
             out_shardings=(state_shardings, None),
             donate_argnums=(0,))
+        # Fallback for batches the batch_spec can't shard (tail batches
+        # not divisible by the axis size, rank-0 leaves): replicate the
+        # batch. Same math, one extra compile, only that call's input
+        # parallelism is lost. The reference's ParallelExecutor simply
+        # rejects such batches (it splits feed by device count).
+        self._jitted_replicated = jax.jit(
+            self._step,
+            in_shardings=(state_shardings, NamedSharding(mesh, P())),
+            out_shardings=(state_shardings, None),
+            donate_argnums=(0,))
+
+    def _batch_shardable(self, batch) -> bool:
+        spec = tuple(self.batch_spec)
+        sizes = self.mesh.shape
+        for x in jax.tree.leaves(batch):
+            ndim = getattr(x, "ndim", None)
+            if ndim is None:
+                continue
+            for d, entry in enumerate(spec):
+                if entry is None:
+                    continue
+                axes = entry if isinstance(entry, tuple) else (entry,)
+                n = int(np.prod([sizes[a] for a in axes]))
+                if n <= 1:
+                    continue
+                if ndim <= d or x.shape[d] % n != 0:
+                    return False
+        return True
 
     def _step(self, state, batch):
         params = state["params"]
@@ -167,9 +196,11 @@ class ShardedTrainStep:
                      for a in arrays)
 
     def __call__(self, *args, labels=()):
-        batch = {"args": args, "labels": tuple(labels)}
+        batch = {"args": args, "labels": as_label_tuple(labels)}
+        fn = (self._jitted if self._batch_shardable(batch)
+              else self._jitted_replicated)
         with self.mesh:
-            self.state, metrics = self._jitted(self.state, batch)
+            self.state, metrics = fn(self.state, batch)
         return metrics
 
     @property

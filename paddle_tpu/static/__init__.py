@@ -26,6 +26,7 @@ import functools
 import warnings
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..core import as_label_tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -286,7 +287,7 @@ class TrainStep:
                  "opt": new_opt, "rng": rng}, metrics)
 
     def __call__(self, *args, labels=(), **kwargs):
-        batch = {"args": args, "labels": tuple(labels), "kwargs": kwargs}
+        batch = {"args": args, "labels": as_label_tuple(labels), "kwargs": kwargs}
         self.state, metrics = self._jitted(self.state, batch)
         return metrics
 
@@ -345,7 +346,7 @@ class EvalStep:
 
     def __call__(self, params, buffers, *args, labels=()):
         return self._jitted(params, buffers,
-                            {"args": args, "labels": tuple(labels)})
+                            {"args": args, "labels": as_label_tuple(labels)})
 
 
 # ---------------------------------------------------------------------------
