@@ -179,12 +179,14 @@ class DataLoader:
                  = None, num_workers: int = 0, batch_sampler=None,
                  prefetch_factor: int = 2, places=None,
                  return_list: bool = True,
-                 mp_start_method: str = "fork") -> None:
+                 mp_start_method: str = "fork",
+                 worker_auto_shard: bool = True) -> None:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 1)
         self.mp_start_method = mp_start_method
+        self.worker_auto_shard = worker_auto_shard
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
         elif isinstance(dataset, IterableDataset):
@@ -220,7 +222,9 @@ class DataLoader:
             it = IterableMultiprocessIter(
                 self.dataset, self.collate_fn, self.batch_size,
                 self.drop_last, self.num_workers,
-                mp_start_method=self.mp_start_method)
+                mp_start_method=self.mp_start_method,
+                prefetch_factor=self.prefetch_factor,
+                auto_shard=self.worker_auto_shard)
         else:
             it = MultiprocessIter(
                 self.dataset, self.collate_fn, list(self.batch_sampler),

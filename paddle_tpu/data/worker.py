@@ -22,9 +22,8 @@ reference, on purpose:
 from __future__ import annotations
 
 import itertools
-import os
 import queue
-import threading
+import time
 import traceback
 from multiprocessing import get_context, resource_tracker
 from multiprocessing.shared_memory import SharedMemory
@@ -103,6 +102,46 @@ def _decode(obj):
     return obj
 
 
+def _drain_and_reap(result_q, workers, leftovers, timeout: float = 10.0):
+    """Decode (and so unlink) every in-flight shm payload, then reap the
+    workers. Runs until the workers have exited AND the queue is empty,
+    so a worker that was mid-batch at shutdown can't strand segments in
+    /dev/shm."""
+    for payload in leftovers:
+        try:
+            _decode(payload)
+        except Exception:
+            pass
+    deadline = time.monotonic() + timeout
+    while (any(w.is_alive() for w in workers)
+           and time.monotonic() < deadline):
+        try:
+            item = result_q.get(timeout=0.1)
+        except queue.Empty:
+            continue
+        if item[2] is None:
+            try:
+                _decode(item[1])
+            except Exception:
+                pass
+    for w in workers:
+        w.join(timeout=2.0)
+        if w.is_alive():
+            w.terminate()
+            w.join(timeout=1.0)
+    # final sweep: nothing can be producing anymore
+    while True:
+        try:
+            item = result_q.get(timeout=0.05)
+        except queue.Empty:
+            break
+        if item[2] is None:
+            try:
+                _decode(item[1])
+            except Exception:
+                pass
+
+
 def _map_worker_loop(dataset, collate_fn, index_q, result_q,
                      worker_id: int, num_workers: int, seed: int) -> None:
     global _worker_info
@@ -125,24 +164,46 @@ def _map_worker_loop(dataset, collate_fn, index_q, result_q,
 
 def _iterable_worker_loop(dataset, collate_fn, batch_size: int,
                           drop_last: bool, result_q, worker_id: int,
-                          num_workers: int, seed: int) -> None:
-    """Each worker owns a strided shard of the sample stream; batches are
-    tagged (worker_id, local_seq) and merged round-robin in the parent."""
+                          num_workers: int, seed: int,
+                          auto_shard: bool, stop_event) -> None:
+    """Each worker reads the stream; with ``auto_shard`` the loop strides
+    so worker w sees samples w, w+n, w+2n… Batches are tagged
+    (worker_id, local_seq) and merged round-robin in the parent. Datasets
+    that shard themselves via :func:`get_worker_info` (the reference's
+    convention) must be run with auto_shard=False or they'd be strided
+    twice."""
     global _worker_info
     _worker_info = WorkerInfo(worker_id, num_workers, seed + worker_id)
     try:
         it = iter(dataset)
-        if get_worker_info() is not None and num_workers > 1:
+        if auto_shard and num_workers > 1:
             it = itertools.islice(it, worker_id, None, num_workers)
         local_seq = 0
-        while True:
+        while not stop_event.is_set():
             samples = list(itertools.islice(it, batch_size))
             if not samples or (len(samples) < batch_size and drop_last):
                 break
             batch = collate_fn(samples)
             segments: List[SharedMemory] = []
             payload = _encode(batch, segments)
-            result_q.put(((worker_id, local_seq), payload, None))
+            posted = False
+            while not stop_event.is_set():
+                try:
+                    result_q.put(((worker_id, local_seq), payload, None),
+                                 timeout=0.2)
+                    posted = True
+                    break
+                except queue.Full:
+                    continue
+            if not posted:
+                # parent never saw this payload: unlink it here
+                for shm in segments:
+                    shm.close()
+                    try:
+                        shm.unlink()
+                    except Exception:
+                        pass
+                break
             for shm in segments:
                 shm.close()
             local_seq += 1
@@ -242,21 +303,9 @@ class MultiprocessIter:
                 q.put(None)
             except Exception:
                 pass
-        # Drain stragglers so shm segments aren't leaked, then reap.
-        deadline = max(20, self._max_outstanding + len(self._workers))
-        while deadline > 0:
-            try:
-                _, payload, err = self._result_q.get(timeout=0.05)
-                if err is None:
-                    _decode(payload)  # copies + unlinks
-            except queue.Empty:
-                break
-            deadline -= 1
-        for w in self._workers:
-            w.join(timeout=2.0)
-            if w.is_alive():
-                w.terminate()
-                w.join(timeout=1.0)
+        leftovers = list(self._reorder.values())
+        self._reorder.clear()
+        _drain_and_reap(self._result_q, self._workers, leftovers)
         for q in self._index_qs + [self._result_q]:
             try:
                 q.close()
@@ -279,15 +328,22 @@ class IterableMultiprocessIter:
 
     def __init__(self, dataset, collate_fn: Callable, batch_size: int,
                  drop_last: bool, num_workers: int,
-                 mp_start_method: str = "fork", seed: int = 0) -> None:
+                 mp_start_method: str = "fork", seed: int = 0,
+                 prefetch_factor: int = 2, auto_shard: bool = True) -> None:
         ctx = get_context(mp_start_method)
-        self._result_q = ctx.Queue()
+        # Bounded queue = backpressure: a worker racing ahead of the
+        # consumer blocks on put instead of filling /dev/shm with the
+        # whole stream.
+        self._result_q = ctx.Queue(
+            maxsize=max(1, num_workers * max(prefetch_factor, 1)))
+        self._stop_event = ctx.Event()
         self._workers = []
         for wid in range(num_workers):
             w = ctx.Process(
                 target=_iterable_worker_loop,
                 args=(dataset, collate_fn, batch_size, drop_last,
-                      self._result_q, wid, num_workers, seed),
+                      self._result_q, wid, num_workers, seed, auto_shard,
+                      self._stop_event),
                 daemon=True)
             w.start()
             self._workers.append(w)
@@ -347,18 +403,10 @@ class IterableMultiprocessIter:
         if self._finished:
             return
         self._finished = True
-        for _ in range(20):
-            try:
-                _, payload, err = self._result_q.get(timeout=0.05)
-                if err is None:
-                    _decode(payload)
-            except queue.Empty:
-                break
-        for w in self._workers:
-            w.join(timeout=2.0)
-            if w.is_alive():
-                w.terminate()
-                w.join(timeout=1.0)
+        self._stop_event.set()
+        leftovers = list(self._buffer.values())
+        self._buffer.clear()
+        _drain_and_reap(self._result_q, self._workers, leftovers)
 
     def __del__(self):
         try:

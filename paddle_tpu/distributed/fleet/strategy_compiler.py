@@ -26,7 +26,9 @@ from jax.sharding import PartitionSpec as P
 
 from ...nn.layer import Layer, functional_call
 from ...optimizer import Lamb, LarsMomentum, Momentum, Optimizer
-from ...parallel.mesh import create_mesh, data_parallel_mesh
+from ...parallel.mesh import (create_mesh, create_multislice_mesh,
+                              data_parallel_mesh, multislice_data_spec,
+                              num_slices)
 from ...parallel.spmd import ShardedTrainStep, megatron_param_rule
 
 
@@ -34,9 +36,24 @@ def apply_strategy(strategy, model: Layer, optimizer: Optimizer,
                    loss_fn: Callable, mesh=None, seed: int = 0,
                    param_rule=None, batch_spec: P = P("dp")):
     if mesh is None:
-        if strategy.tensor_parallel:
-            tp = strategy.tensor_parallel_configs.get(
-                "tensor_parallel_degree", 1)
+        tp = strategy.tensor_parallel_configs.get(
+            "tensor_parallel_degree", 1) if strategy.tensor_parallel else 1
+        if strategy.hierarchical_allreduce and (strategy.dgc
+                                                or strategy.localsgd):
+            # DGC/LocalSGD sync over a single dp axis; a (dcn, dp) hybrid
+            # mesh would leave the dcn replicas unsynced. Use a flat dp
+            # mesh — XLA still decomposes the allreduce across slice
+            # boundaries from the physical topology.
+            mesh = data_parallel_mesh()
+        elif strategy.hierarchical_allreduce:
+            # two-level reduction: intra-slice over ICI, inter-slice over
+            # DCN (ref: distributed_strategy.proto:110, nccl_helper.h:185)
+            slices = max(num_slices(), 1)
+            ici = {"dp": -1, "mp": tp} if tp > 1 else {"dp": -1}
+            mesh = create_multislice_mesh({"dcn": slices}, ici)
+            if batch_spec == P("dp"):
+                batch_spec = multislice_data_spec(mesh)
+        elif strategy.tensor_parallel:
             mesh = create_mesh({"dp": -1, "mp": tp})
         else:
             mesh = data_parallel_mesh()

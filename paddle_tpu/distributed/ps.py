@@ -29,6 +29,7 @@ import time
 import zlib
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..core import as_label_tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -240,7 +241,7 @@ class PSTrainStep:
             self._grad_fn = self._build_grad_fn()
         self._rng_key, sub = jax.random.split(self._rng_key)
         loss, grads = self._grad_fn(self._params, sub, tuple(args),
-                                    tuple(labels))
+                                    as_label_tuple(labels))
         grads = {k: np.asarray(v, np.float32) for k, v in grads.items()}
         self._step_no += 1
 

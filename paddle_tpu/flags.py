@@ -133,7 +133,17 @@ define_flag("matmul_precision", "default",
             "highest. bf16 MXU passes use 'default'.")
 define_flag("use_pallas_kernels", True,
             "Route hot ops (attention, layer_norm, adam) through Pallas "
-            "kernels when on TPU.")
+            "kernels when on TPU (master switch; per-kernel flags below).")
+define_flag("use_pallas_adam", False,
+            "Use the Pallas fused-adam kernel. Off by default: measured on "
+            "v5e the flatten/unflatten layout copies it forces on 2-D "
+            "params cost more than the fusion saves (XLA fuses the "
+            "elementwise adam chain itself; 34.4 vs 39.6 ms/step on "
+            "BERT-base b8xs512). Useful again only if params are kept in "
+            "a 1-D flat buffer.")
+define_flag("use_pallas_layer_norm", True,
+            "Use the Pallas layer_norm kernel (subject to the master "
+            "switch).")
 define_flag("flash_attention_min_seq", 4096,
             "Key-sequence length at or above which attention routes to the "
             "Pallas flash kernel (below it XLA's fused attention is faster "

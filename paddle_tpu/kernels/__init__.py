@@ -33,7 +33,8 @@ def pallas_enabled() -> bool:
 
 def maybe_layer_norm(x, weight, bias, epsilon: float, begin_norm_axis: int):
     from ..ops.nn_functional import layer_norm as ref_impl
-    if pallas_enabled() and begin_norm_axis == x.ndim - 1 and x.ndim >= 2:
+    if pallas_enabled() and GLOBAL_FLAGS.get("use_pallas_layer_norm") \
+            and begin_norm_axis == x.ndim - 1 and x.ndim >= 2:
         try:
             from .layer_norm import layer_norm_pallas
             return layer_norm_pallas(x, weight, bias, epsilon)

@@ -301,8 +301,10 @@ class Adam(Optimizer):
 
     def update(self, p, g, slots, lr_t, step):
         g = g.astype(p.dtype)
+        from ..flags import GLOBAL_FLAGS
         from ..kernels import pallas_enabled
-        if (pallas_enabled() and p.dtype == jnp.float32
+        if (pallas_enabled() and GLOBAL_FLAGS.get("use_pallas_adam")
+                and p.dtype == jnp.float32
                 and slots["m"].dtype == jnp.float32 and p.size >= 1024):
             from ..kernels.fused_adam import fused_adam_flat
             lr_c = self._bias_correct_lr(lr_t, step)

@@ -12,8 +12,9 @@ from .collective import (all_gather, all_reduce, all_to_all, barrier,
                          reduce, reduce_scatter, scatter)
 from .data_parallel import DataParallel
 from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env
-from .mesh import (batch_sharding, create_mesh, data_parallel_mesh,
-                   named_sharding, replicated)
+from .mesh import (batch_sharding, create_mesh, create_multislice_mesh,
+                   data_parallel_mesh, multislice_data_spec, named_sharding,
+                   num_slices, replicated)
 from .spmd import ShardedTrainStep, make_param_specs, megatron_param_rule
 from .localsgd import LocalSGDStep  # noqa: E402,F401
 from .dgc import DGCTrainStep, dgc_allreduce, topk_sparsify  # noqa: E402,F401

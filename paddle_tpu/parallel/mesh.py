@@ -57,6 +57,84 @@ def create_mesh(axes: Optional[Dict[str, int]] = None,
     return Mesh(arr, tuple(axes))
 
 
+def num_slices(devices: Optional[Sequence] = None) -> int:
+    """Number of distinct TPU slices among ``devices`` (1 on CPU/GPU or a
+    single slice). Multi-slice topologies expose ``slice_index`` on each
+    device; collectives between different slice_index values ride DCN."""
+    devices = list(devices) if devices is not None else jax.devices()
+    idx = {getattr(d, "slice_index", 0) for d in devices}
+    return len(idx)
+
+
+def create_multislice_mesh(dcn_axes: Dict[str, int],
+                           ici_axes: Dict[str, int],
+                           devices: Optional[Sequence] = None) -> Mesh:
+    """Slice-aware mesh: ``dcn_axes`` (outermost) cross slice boundaries
+    and ride DCN; ``ici_axes`` stay within a slice and ride ICI.
+
+    TPU-native equivalent of the reference's hierarchical allreduce
+    (/root/reference/paddle/fluid/platform/nccl_helper.h:185
+    NCCLCommunicator inter/exter rings;
+    framework/distributed_strategy.proto:110 use_hierarchical_allreduce).
+    Where the reference builds explicit two-level NCCL rings, here the
+    mesh layout makes XLA emit the two-level reduction: sharding a batch
+    over ``P(("dcn", "dp"))`` produces an intra-slice (ICI) reduce
+    followed by an inter-slice (DCN) allreduce of the partial sums.
+
+    On real multi-slice hardware the device→coordinate assignment comes
+    from ``mesh_utils.create_hybrid_device_mesh`` (slice_index-aware); on
+    a single slice or the virtual CPU backend, devices are grouped into
+    ``prod(dcn_axes)`` contiguous synthetic slices so the same program
+    (and tests) run anywhere. One ici axis may be -1 to absorb the
+    remaining per-slice devices.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    dcn_axes = dict(dcn_axes)
+    ici_axes = dict(ici_axes)
+    n = len(devices)
+    n_dcn = int(np.prod(list(dcn_axes.values())))
+    if n_dcn <= 0 or n % n_dcn != 0:
+        raise ValueError(
+            f"dcn axes {dcn_axes} do not divide {n} devices")
+    per_slice = n // n_dcn
+    wild = [k for k, v in ici_axes.items() if v == -1]
+    if len(wild) > 1:
+        raise ValueError("at most one ici axis may be -1")
+    if wild:
+        known = int(np.prod([v for v in ici_axes.values() if v != -1]))
+        if known <= 0 or per_slice % known != 0:
+            raise ValueError(
+                f"ici axes {ici_axes}: {per_slice} per-slice devices not "
+                f"divisible by {known}")
+        ici_axes[wild[0]] = per_slice // known
+    if int(np.prod(list(ici_axes.values()))) != per_slice:
+        raise ValueError(
+            f"ici axes {ici_axes} must cover {per_slice} devices/slice")
+
+    names = tuple(dcn_axes) + tuple(ici_axes)
+    shape = tuple(dcn_axes.values()) + tuple(ici_axes.values())
+    if num_slices(devices) == n_dcn and n_dcn > 1:
+        from jax.experimental import mesh_utils
+        # same-length shape vectors: each dim is either a DCN or ICI dim
+        ici_shape = (1,) * len(dcn_axes) + tuple(ici_axes.values())
+        dcn_shape = tuple(dcn_axes.values()) + (1,) * len(ici_axes)
+        arr = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices)
+        return Mesh(arr, names)
+    # synthetic slices: contiguous groups (device order is host order,
+    # which keeps intra-group collectives local on multi-process CPU too)
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, names)
+
+
+def multislice_data_spec(mesh: Mesh, dcn_axis: str = "dcn",
+                         dp_axis: str = DP) -> PartitionSpec:
+    """Batch spec sharding over (dcn, dp) jointly — the hierarchical
+    data-parallel layout."""
+    axes = tuple(a for a in (dcn_axis, dp_axis) if a in mesh.shape)
+    return PartitionSpec(axes if len(axes) > 1 else axes[0])
+
+
 def data_parallel_mesh(n: Optional[int] = None) -> Mesh:
     devs = jax.devices()[:n] if n else jax.devices()
     return create_mesh({DP: len(devs)}, devs)

@@ -133,39 +133,43 @@ class ShardedTrainStep:
         self.state = jax.device_put(state, state_shardings)
         self.batch_sharding = NamedSharding(mesh, batch_spec)
 
+        # Batch shardings are decided per leaf at call time (committed
+        # arrays carry their sharding into jit): a leaf the batch_spec
+        # can't shard — rank-0 sample weight, tail batch not divisible by
+        # the axis size — is replicated alone instead of silently turning
+        # off data parallelism for the whole batch. The reference's
+        # ParallelExecutor simply rejects such feeds (it splits by device
+        # count).
         self._jitted = jax.jit(
             self._step,
-            in_shardings=(state_shardings, self.batch_sharding),
+            in_shardings=(state_shardings, None),
             out_shardings=(state_shardings, None),
             donate_argnums=(0,))
-        # Fallback for batches the batch_spec can't shard (tail batches
-        # not divisible by the axis size, rank-0 leaves): replicate the
-        # batch. Same math, one extra compile, only that call's input
-        # parallelism is lost. The reference's ParallelExecutor simply
-        # rejects such batches (it splits feed by device count).
-        self._jitted_replicated = jax.jit(
-            self._step,
-            in_shardings=(state_shardings, NamedSharding(mesh, P())),
-            out_shardings=(state_shardings, None),
-            donate_argnums=(0,))
+        self._replicated_sharding = NamedSharding(mesh, P())
 
-    def _batch_shardable(self, batch) -> bool:
+    def _leaf_shardable(self, x) -> bool:
         spec = tuple(self.batch_spec)
         sizes = self.mesh.shape
-        for x in jax.tree.leaves(batch):
-            ndim = getattr(x, "ndim", None)
-            if ndim is None:
+        ndim = getattr(x, "ndim", None)
+        if ndim is None:
+            return False
+        for d, entry in enumerate(spec):
+            if entry is None:
                 continue
-            for d, entry in enumerate(spec):
-                if entry is None:
-                    continue
-                axes = entry if isinstance(entry, tuple) else (entry,)
-                n = int(np.prod([sizes[a] for a in axes]))
-                if n <= 1:
-                    continue
-                if ndim <= d or x.shape[d] % n != 0:
-                    return False
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            n = int(np.prod([sizes[a] for a in axes]))
+            if n <= 1:
+                continue
+            if ndim <= d or x.shape[d] % n != 0:
+                return False
         return True
+
+    def _place_batch(self, batch):
+        def put(x):
+            dst = (self.batch_sharding if self._leaf_shardable(x)
+                   else self._replicated_sharding)
+            return jax.device_put(jnp.asarray(x), dst)
+        return jax.tree.map(put, batch)
 
     def _step(self, state, batch):
         params = state["params"]
@@ -196,11 +200,10 @@ class ShardedTrainStep:
                      for a in arrays)
 
     def __call__(self, *args, labels=()):
-        batch = {"args": args, "labels": as_label_tuple(labels)}
-        fn = (self._jitted if self._batch_shardable(batch)
-              else self._jitted_replicated)
+        batch = self._place_batch(
+            {"args": args, "labels": as_label_tuple(labels)})
         with self.mesh:
-            self.state, metrics = fn(self.state, batch)
+            self.state, metrics = self._jitted(self.state, batch)
         return metrics
 
     @property

@@ -96,6 +96,25 @@ def test_mp_iterable_dataset_covers_stream():
         np.testing.assert_array_equal(a, b)
 
 
+def test_mp_iterable_self_sharding_dataset():
+    """Dataset that shards itself via get_worker_info (the reference's
+    convention) runs with worker_auto_shard=False and must not be strided
+    twice."""
+
+    class SelfSharding(IterableDataset):
+        def __iter__(self):
+            info = get_worker_info()
+            wid = info.id if info else 0
+            n = info.num_workers if info else 1
+            for i in range(wid, 40, n):
+                yield np.full((4,), float(i), np.float32)
+
+    got = [b for b in DataLoader(SelfSharding(), batch_size=4,
+                                 num_workers=2, worker_auto_shard=False)]
+    vals = sorted(float(v) for b in got for v in b[:, 0])
+    assert vals == [float(v) for v in range(40)]
+
+
 def test_mp_speedup_on_parse_heavy_dataset():
     ds = SlowDataset(n=32, work=400000)
 

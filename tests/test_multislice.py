@@ -1,0 +1,110 @@
+"""Multi-slice / DCN mesh: hierarchical data parallelism parity.
+
+Capability ref: /root/reference/paddle/fluid/platform/nccl_helper.h:185
+(NCCLCommunicator inter/exter rings) and
+framework/distributed_strategy.proto:110 (use_hierarchical_allreduce).
+On the 8-device virtual CPU mesh, a {"dcn":2} x {"dp":4} hybrid mesh
+must train identically to a flat {"dp":8} mesh and to a single device.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu.parallel import (ShardedTrainStep, create_mesh,
+                                 create_multislice_mesh,
+                                 multislice_data_spec, num_slices)
+
+
+def _make_model():
+    pt.seed(7)
+    return pt.nn.Sequential(pt.nn.Linear(16, 32), pt.nn.ReLU(),
+                            pt.nn.Linear(32, 4))
+
+
+def _data(rng):
+    x = rng.normal(0, 1, (16, 16)).astype(np.float32)
+    y = rng.integers(0, 4, (16,)).astype(np.int64)
+    return x, y
+
+
+def _train(step, x, y, steps=5):
+    return [float(step(x, labels=y)["loss"]) for _ in range(steps)]
+
+
+def test_multislice_mesh_shape():
+    mesh = create_multislice_mesh({"dcn": 2}, {"dp": -1})
+    assert dict(mesh.shape) == {"dcn": 2, "dp": 4}
+    assert multislice_data_spec(mesh) == P(("dcn", "dp"))
+
+
+def test_multislice_matches_flat_and_single():
+    rng = np.random.default_rng(0)
+    x, y = _data(rng)
+    loss_fn = lambda out, t: pt.nn.functional.cross_entropy(out, t)
+
+    hybrid = create_multislice_mesh({"dcn": 2}, {"dp": 4})
+    step_h = ShardedTrainStep(_make_model(), pt.optimizer.SGD(0.1), loss_fn,
+                              hybrid,
+                              batch_spec=multislice_data_spec(hybrid))
+    losses_h = _train(step_h, x, y)
+
+    flat = create_mesh({"dp": 8})
+    step_f = ShardedTrainStep(_make_model(), pt.optimizer.SGD(0.1), loss_fn,
+                              flat, batch_spec=P("dp"))
+    losses_f = _train(step_f, x, y)
+
+    from paddle_tpu.static import TrainStep
+    step_1 = TrainStep(_make_model(), pt.optimizer.SGD(0.1), loss_fn)
+    losses_1 = _train(step_1, x, y)
+
+    np.testing.assert_allclose(losses_h, losses_f, rtol=2e-5)
+    np.testing.assert_allclose(losses_h, losses_1, rtol=2e-5)
+    assert losses_h[-1] < losses_h[0]
+
+
+def test_multislice_with_tensor_parallel_inside_slice():
+    # mp stays inside a slice (ICI); dcn is pure data parallel
+    mesh = create_multislice_mesh({"dcn": 2}, {"dp": -1, "mp": 2})
+    assert dict(mesh.shape) == {"dcn": 2, "dp": 2, "mp": 2}
+    rng = np.random.default_rng(0)
+    x, y = _data(rng)
+
+    def rule(name, v):
+        if "0.weight" in name:
+            return P(None, "mp")
+        return P()
+
+    step = ShardedTrainStep(
+        _make_model(), pt.optimizer.SGD(0.1),
+        lambda out, t: pt.nn.functional.cross_entropy(out, t),
+        mesh, batch_spec=multislice_data_spec(mesh), param_rule=rule)
+    losses = _train(step, x, y)
+    assert losses[-1] < losses[0]
+
+
+def test_strategy_hierarchical_allreduce_routes_to_hybrid_mesh():
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.distributed.fleet.strategy_compiler import apply_strategy
+
+    s = DistributedStrategy()
+    s.hierarchical_allreduce = True
+    rng = np.random.default_rng(0)
+    x, y = _data(rng)
+    step = apply_strategy(
+        s, _make_model(), pt.optimizer.SGD(0.1),
+        lambda out, t: pt.nn.functional.cross_entropy(out, t))
+    # on the single-slice CPU backend this degenerates to dcn=1 — the
+    # point is the routing and that training still works
+    assert "dcn" in step.mesh.shape
+    losses = _train(step, x, y)
+    assert losses[-1] < losses[0]
+
+
+def test_bad_axis_sizes_raise():
+    with pytest.raises(ValueError):
+        create_multislice_mesh({"dcn": 3}, {"dp": -1})  # 8 % 3 != 0
+    with pytest.raises(ValueError):
+        create_multislice_mesh({"dcn": 2}, {"dp": 3})  # 3 != 4/slice
