@@ -1,26 +1,143 @@
-"""Benchmark driver: BERT-base pretraining tokens/sec/chip on one TPU chip.
+"""Benchmark driver.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Default: BERT-base pretraining tokens/sec on one TPU chip — prints ONE
+JSON line {"metric", "value", "unit", "vs_baseline"}.
+``python bench.py resnet50`` instead benches ResNet-50 images/sec
+(BASELINE configs 2/4).
+
 vs_baseline = achieved effective TFLOPs / target, where target = 0.80 x
 v5e bf16 peak (197 TFLOPs) per BASELINE.json's ">=80% of A100 MFU" north
 star (A100 bf16 peak 312 and v5e 197 make per-chip MFU the comparable
-quantity). Effective FLOPs use the standard 6 * params * tokens estimate.
+quantity). BERT effective FLOPs use the standard 6 * params * tokens
+estimate; ResNet uses the analytic per-image conv+fc FLOP count.
+
+Before timing, when on a real TPU, a kernel-validation stage runs the
+Pallas kernels in compiled (non-interpret) mode against their XLA
+reference compositions — Mosaic layout bugs surface here mechanically
+instead of mid-training (VERDICT r1 weak #6).
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 
-def main() -> None:
+def log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def validate_kernels_on_tpu() -> None:
+    """Compiled-mode Pallas kernel checks vs XLA reference compositions."""
     import jax
-
-    jax.config.update("jax_compilation_cache_dir",
-                      "/root/repo/.jax_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-
     import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    failures = []
+
+    # layer_norm fwd + bwd
+    try:
+        from paddle_tpu.kernels.layer_norm import layer_norm_pallas
+        from paddle_tpu.ops.nn_functional import layer_norm as ln_ref
+        x = jnp.asarray(rng.normal(0, 1, (64, 256)), jnp.float32)
+        w = jnp.asarray(rng.normal(1, 0.1, (256,)), jnp.float32)
+        b = jnp.asarray(rng.normal(0, 0.1, (256,)), jnp.float32)
+
+        def f_pallas(x, w, b):
+            return jnp.sum(layer_norm_pallas(x, w, b, 1e-5) ** 2)
+
+        def f_ref(x, w, b):
+            return jnp.sum(ln_ref(x, w, b, 1e-5, x.ndim - 1) ** 2)
+
+        vp, gp = jax.value_and_grad(f_pallas, argnums=(0, 1, 2))(x, w, b)
+        vr, gr = jax.value_and_grad(f_ref, argnums=(0, 1, 2))(x, w, b)
+        np.testing.assert_allclose(float(vp), float(vr), rtol=2e-4)
+        for a, c in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                       rtol=2e-3, atol=2e-3)
+        log("kernel-validate layer_norm: OK")
+    except Exception as e:  # noqa: BLE001
+        failures.append(f"layer_norm: {e}")
+
+    # flash attention fwd + bwd
+    try:
+        from paddle_tpu.kernels.flash_attention import flash_attention
+        from paddle_tpu.ops.attention import scaled_dot_product_attention
+        q = jnp.asarray(rng.normal(0, 1, (1, 2, 256, 128)), jnp.float32)
+        k = jnp.asarray(rng.normal(0, 1, (1, 2, 256, 128)), jnp.float32)
+        v = jnp.asarray(rng.normal(0, 1, (1, 2, 256, 128)), jnp.float32)
+
+        def a_pallas(q, k, v):
+            return jnp.sum(flash_attention(q, k, v) ** 2)
+
+        def a_ref(q, k, v):
+            return jnp.sum(scaled_dot_product_attention(q, k, v) ** 2)
+
+        vp, gp = jax.value_and_grad(a_pallas, argnums=(0, 1, 2))(q, k, v)
+        vr, gr = jax.value_and_grad(a_ref, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(float(vp), float(vr), rtol=2e-3)
+        for a, c in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                       rtol=5e-3, atol=5e-3)
+        log("kernel-validate flash_attention: OK")
+    except Exception as e:  # noqa: BLE001
+        failures.append(f"flash_attention: {e}")
+
+    # fused adam vs elementwise composition
+    try:
+        from paddle_tpu.kernels.fused_adam import fused_adam_flat
+        n = 8192
+        p = jnp.asarray(rng.normal(0, 1, (n,)), jnp.float32)
+        g = jnp.asarray(rng.normal(0, 0.1, (n,)), jnp.float32)
+        m = jnp.asarray(rng.normal(0, 0.01, (n,)), jnp.float32)
+        v = jnp.abs(jnp.asarray(rng.normal(0, 0.01, (n,)), jnp.float32))
+        lr, b1, b2, eps = 1e-3, 0.9, 0.999, 1e-8
+        p2, m2, v2 = jax.jit(
+            lambda p, g, m, v: fused_adam_flat(p, g, m, v, lr, b1, b2, eps)
+        )(p, g, m, v)
+        m_ref = b1 * m + (1 - b1) * g
+        v_ref = b2 * v + (1 - b2) * g * g
+        p_ref = p - lr * m_ref / (jnp.sqrt(v_ref) + eps)
+        import numpy as _np
+        _np.testing.assert_allclose(_np.asarray(p2), _np.asarray(p_ref),
+                                    rtol=1e-5, atol=1e-6)
+        _np.testing.assert_allclose(_np.asarray(m2), _np.asarray(m_ref),
+                                    rtol=1e-5, atol=1e-6)
+        _np.testing.assert_allclose(_np.asarray(v2), _np.asarray(v_ref),
+                                    rtol=1e-5, atol=1e-6)
+        log("kernel-validate fused_adam: OK")
+    except Exception as e:  # noqa: BLE001
+        failures.append(f"fused_adam: {e}")
+
+    if failures:
+        for f in failures:
+            log(f"KERNEL VALIDATION FAILED: {f}")
+        # Benchmarks run on XLA paths regardless; fail loudly but proceed.
+
+
+def warmup_and_time(step_once, iters: int):
+    """Warm up until compiles settle (donated-state layouts reach their
+    fixpoint after a few calls), then time ``iters`` calls. Syncs by
+    fetching the loss value — block_until_ready is not a reliable sync
+    over remote-dispatch backends. Returns seconds per iteration."""
+    for i in range(6):
+        t0 = time.perf_counter()
+        float(step_once()["loss"])
+        dt = time.perf_counter() - t0
+        log(f"warmup {i}: {dt:.2f}s")
+        if dt < 1.0:
+            break
+    log(f"timing {iters} steps...")
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        m = step_once()
+    float(m["loss"])
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_bert(on_accel: bool) -> None:
     import numpy as np
 
     import paddle_tpu as pt
@@ -28,15 +145,13 @@ def main() -> None:
                                    pretraining_loss)
     from paddle_tpu.static import TrainStep
 
-    on_accel = any(d.platform in ("tpu", "axon") for d in jax.devices())
-    # BERT-base, seq 512, bf16 compute
     config = BertConfig()
     batch, seq = (8, 512) if on_accel else (2, 128)
+    log(f"BERT-base pretrain, batch={batch} seq={seq}")
 
     pt.seed(0)
     model = BertForPretraining(config)
-    # bf16 params for MXU; LN/softmax stay fp32 inside ops
-    model.to(dtype="bfloat16")
+    model.to(dtype="bfloat16")  # LN/softmax/xent reductions stay fp32
     opt = pt.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01)
     step = TrainStep(model, opt,
                      lambda out, mlm, nsp: pretraining_loss(out, mlm, nsp))
@@ -46,36 +161,81 @@ def main() -> None:
     mlm = rng.integers(0, config.vocab_size, (batch, seq)).astype(np.int64)
     nsp = rng.integers(0, 2, (batch,)).astype(np.int64)
 
-    # Warmup until compiles settle: donated-state layouts reach a fixpoint
-    # only after a few calls (each new input layout triggers a recompile),
-    # and block_until_ready is not a reliable sync over remote-dispatch
-    # backends — fetch the loss value instead.
-    for _ in range(6):
-        t0 = time.perf_counter()
-        m = step(ids, labels=(mlm, nsp))
-        float(m["loss"])
-        if time.perf_counter() - t0 < 1.0:
-            break
-
-    iters = 30 if on_accel else 3
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        m = step(ids, labels=(mlm, nsp))
-    float(m["loss"])
-    dt = time.perf_counter() - t0
-
-    tokens_per_sec = batch * seq * iters / dt
-    # BERT-base fwd+bwd ≈ 3 × 2 × params × tokens FLOPs (params ≈ 110e6)
+    dt = warmup_and_time(lambda: step(ids, labels=(mlm, nsp)),
+                         30 if on_accel else 3)
+    tokens_per_sec = batch * seq / dt
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
-    flops_per_token = 6 * n_params
-    achieved_tflops = tokens_per_sec * flops_per_token / 1e12
+    achieved_tflops = tokens_per_sec * 6 * n_params / 1e12
     target_tflops = 0.8 * 197.0  # 80% of v5e bf16 peak
+    log(f"{tokens_per_sec:.0f} tok/s = {achieved_tflops:.1f} TFLOPs "
+        f"({achieved_tflops / 197.0 * 100:.1f}% v5e MFU)")
     print(json.dumps({
         "metric": "BERT-base pretrain tokens/sec/chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec",
         "vs_baseline": round(achieved_tflops / target_tflops, 4),
     }))
+
+
+def bench_resnet(on_accel: bool) -> None:
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.resnet import resnet50
+    from paddle_tpu.static import TrainStep
+
+    batch, hw = (64, 224) if on_accel else (4, 64)
+    log(f"ResNet-50 train, batch={batch} image={hw}x{hw}")
+
+    pt.seed(0)
+    model = resnet50()
+    model.to(dtype="bfloat16")
+    opt = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+    step = TrainStep(model, opt,
+                     lambda out, y: pt.nn.functional.cross_entropy(out, y))
+
+    import jax.numpy as jnp
+    rng = np.random.default_rng(0)
+    # bf16 images to match the bf16 conv weights (strict dtypes, like the
+    # reference's fp16 AMP path casts inputs)
+    x = jnp.asarray(rng.normal(0, 1, (batch, 3, hw, hw)), jnp.bfloat16)
+    y = rng.integers(0, 1000, (batch,)).astype(np.int64)
+
+    dt = warmup_and_time(lambda: step(x, labels=y),
+                         20 if on_accel else 3)
+    images_per_sec = batch / dt
+    # ResNet-50 fwd ≈ 4.1 GFLOPs/image at 224x224; train ≈ 3x fwd
+    fwd_gflops = 4.1 * (hw / 224.0) ** 2
+    achieved_tflops = images_per_sec * 3 * fwd_gflops / 1e3
+    target_tflops = 0.8 * 197.0
+    log(f"{images_per_sec:.1f} images/s = {achieved_tflops:.1f} TFLOPs")
+    print(json.dumps({
+        "metric": "ResNet-50 train images/sec/chip",
+        "value": round(images_per_sec, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(achieved_tflops / target_tflops, 4),
+    }))
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      "/root/repo/.jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    on_accel = any(d.platform in ("tpu", "axon") for d in jax.devices())
+    log(f"backend={jax.default_backend()} devices={jax.devices()}")
+
+    if on_accel:
+        log("validating Pallas kernels in compiled mode...")
+        validate_kernels_on_tpu()
+
+    which = sys.argv[1] if len(sys.argv) > 1 else "bert"
+    if which == "resnet50":
+        bench_resnet(on_accel)
+    else:
+        bench_bert(on_accel)
 
 
 if __name__ == "__main__":

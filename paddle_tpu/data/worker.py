@@ -102,11 +102,29 @@ def _decode(obj):
     return obj
 
 
-def _drain_and_reap(result_q, workers, leftovers, timeout: float = 10.0):
+def _drain_and_reap(result_qs, workers, leftovers, timeout: float = 10.0):
     """Decode (and so unlink) every in-flight shm payload, then reap the
-    workers. Runs until the workers have exited AND the queue is empty,
+    workers. Runs until the workers have exited AND the queues are empty,
     so a worker that was mid-batch at shutdown can't strand segments in
     /dev/shm."""
+    if not isinstance(result_qs, (list, tuple)):
+        result_qs = [result_qs]
+
+    def sweep(block_s: float) -> bool:
+        got = False
+        for q in result_qs:
+            try:
+                item = q.get(timeout=block_s)
+            except queue.Empty:
+                continue
+            got = True
+            if item[2] is None:
+                try:
+                    _decode(item[1])
+                except Exception:
+                    pass
+        return got
+
     for payload in leftovers:
         try:
             _decode(payload)
@@ -115,31 +133,15 @@ def _drain_and_reap(result_q, workers, leftovers, timeout: float = 10.0):
     deadline = time.monotonic() + timeout
     while (any(w.is_alive() for w in workers)
            and time.monotonic() < deadline):
-        try:
-            item = result_q.get(timeout=0.1)
-        except queue.Empty:
-            continue
-        if item[2] is None:
-            try:
-                _decode(item[1])
-            except Exception:
-                pass
+        sweep(0.1)
     for w in workers:
         w.join(timeout=2.0)
         if w.is_alive():
             w.terminate()
             w.join(timeout=1.0)
-    # final sweep: nothing can be producing anymore
-    while True:
-        try:
-            item = result_q.get(timeout=0.05)
-        except queue.Empty:
-            break
-        if item[2] is None:
-            try:
-                _decode(item[1])
-            except Exception:
-                pass
+    # final sweeps: nothing can be producing anymore
+    while sweep(0.05):
+        pass
 
 
 def _map_worker_loop(dataset, collate_fn, index_q, result_q,
@@ -166,19 +168,20 @@ def _iterable_worker_loop(dataset, collate_fn, batch_size: int,
                           drop_last: bool, result_q, worker_id: int,
                           num_workers: int, seed: int,
                           auto_shard: bool, stop_event) -> None:
-    """Each worker reads the stream; with ``auto_shard`` the loop strides
-    so worker w sees samples w, w+n, w+2n… Batches are tagged
-    (worker_id, local_seq) and merged round-robin in the parent. Datasets
-    that shard themselves via :func:`get_worker_info` (the reference's
-    convention) must be run with auto_shard=False or they'd be strided
-    twice."""
+    """Each worker reads the stream into its OWN bounded queue; with
+    ``auto_shard`` the loop strides so worker w sees samples w, w+n,
+    w+2n… The parent merges the queues round-robin, so order is
+    deterministic and backpressure is per worker: a fast worker blocks
+    once its own queue fills, it cannot race ahead on the others'
+    slots or pile batches into parent memory. Datasets that shard
+    themselves via :func:`get_worker_info` (the reference's convention)
+    must be run with auto_shard=False or they'd be strided twice."""
     global _worker_info
     _worker_info = WorkerInfo(worker_id, num_workers, seed + worker_id)
     try:
         it = iter(dataset)
         if auto_shard and num_workers > 1:
             it = itertools.islice(it, worker_id, None, num_workers)
-        local_seq = 0
         while not stop_event.is_set():
             samples = list(itertools.islice(it, batch_size))
             if not samples or (len(samples) < batch_size and drop_last):
@@ -189,8 +192,7 @@ def _iterable_worker_loop(dataset, collate_fn, batch_size: int,
             posted = False
             while not stop_event.is_set():
                 try:
-                    result_q.put(((worker_id, local_seq), payload, None),
-                                 timeout=0.2)
+                    result_q.put((None, payload, None), timeout=0.2)
                     posted = True
                     break
                 except queue.Full:
@@ -206,10 +208,9 @@ def _iterable_worker_loop(dataset, collate_fn, batch_size: int,
                 break
             for shm in segments:
                 shm.close()
-            local_seq += 1
-        result_q.put(((worker_id, local_seq), None, "__done__"))
+        result_q.put((None, None, "__done__"))
     except Exception:
-        result_q.put(((worker_id, -1), None, traceback.format_exc()))
+        result_q.put((None, None, traceback.format_exc()))
 
 
 class MultiprocessIter:
@@ -320,9 +321,13 @@ class MultiprocessIter:
 
 
 class IterableMultiprocessIter:
-    """Multiprocess iterator over an IterableDataset: each worker reads a
-    strided shard of the stream; the parent merges batches round-robin by
-    worker so the merged order is deterministic."""
+    """Multiprocess iterator over an IterableDataset.
+
+    One bounded queue PER worker (maxsize=prefetch_factor): the parent
+    pops the next batch from worker 0, then 1, … — a deterministic merge
+    with hard per-worker backpressure and zero parent-side buffering (a
+    slow shard stalls the merge at its turn instead of letting the fast
+    workers fill /dev/shm behind it)."""
 
     _GET_TIMEOUT = 5.0
 
@@ -331,82 +336,63 @@ class IterableMultiprocessIter:
                  mp_start_method: str = "fork", seed: int = 0,
                  prefetch_factor: int = 2, auto_shard: bool = True) -> None:
         ctx = get_context(mp_start_method)
-        # Bounded queue = backpressure: a worker racing ahead of the
-        # consumer blocks on put instead of filling /dev/shm with the
-        # whole stream.
-        self._result_q = ctx.Queue(
-            maxsize=max(1, num_workers * max(prefetch_factor, 1)))
+        self._result_qs = [ctx.Queue(maxsize=max(1, prefetch_factor))
+                           for _ in range(num_workers)]
         self._stop_event = ctx.Event()
         self._workers = []
         for wid in range(num_workers):
             w = ctx.Process(
                 target=_iterable_worker_loop,
                 args=(dataset, collate_fn, batch_size, drop_last,
-                      self._result_q, wid, num_workers, seed, auto_shard,
-                      self._stop_event),
+                      self._result_qs[wid], wid, num_workers, seed,
+                      auto_shard, self._stop_event),
                 daemon=True)
             w.start()
             self._workers.append(w)
         self._n = num_workers
         self._next_worker = 0
-        self._next_local = [0] * num_workers
-        # total batches each worker will produce; None until its __done__
-        self._total: List[Optional[int]] = [None] * num_workers
-        self._buffer: dict = {}
+        self._done = [False] * num_workers
         self._finished = False
 
     def __iter__(self):
         return self
 
-    def _drained(self, wid: int) -> bool:
-        return (self._total[wid] is not None
-                and self._next_local[wid] >= self._total[wid])
-
     def __next__(self):
         while True:
-            if all(self._drained(w) for w in range(self._n)):
+            if all(self._done):
                 self.shutdown()
                 raise StopIteration
-            while self._drained(self._next_worker):
+            while self._done[self._next_worker]:
                 self._next_worker = (self._next_worker + 1) % self._n
-            want = (self._next_worker, self._next_local[self._next_worker])
-            if want in self._buffer:
-                payload = self._buffer.pop(want)
-                self._next_local[self._next_worker] += 1
-                self._next_worker = (self._next_worker + 1) % self._n
-                return _decode(payload)
+            wid = self._next_worker
             try:
-                (wid, local), payload, err = self._result_q.get(
+                _, payload, err = self._result_qs[wid].get(
                     timeout=self._GET_TIMEOUT)
             except queue.Empty:
-                self._check_workers_alive()
+                w = self._workers[wid]
+                if not w.is_alive() and self._result_qs[wid].empty():
+                    code = w.exitcode
+                    self.shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker pid={w.pid} died unexpectedly "
+                        f"(exitcode={code}); batch stream is broken.")
                 continue
             if err == "__done__":
-                self._total[wid] = local  # batches 0..local-1 were posted
+                self._done[wid] = True
+                self._next_worker = (wid + 1) % self._n
                 continue
             if err is not None:
                 self.shutdown()
                 raise RuntimeError(f"DataLoader worker failed:\n{err}")
-            self._buffer[(wid, local)] = payload
-
-    def _check_workers_alive(self) -> None:
-        for wid, w in enumerate(self._workers):
-            if not w.is_alive() and self._total[wid] is None \
-                    and self._result_q.empty():
-                code = w.exitcode
-                self.shutdown()
-                raise RuntimeError(
-                    f"DataLoader worker pid={w.pid} died unexpectedly "
-                    f"(exitcode={code}); batch stream is broken.")
+            self._next_worker = (wid + 1) % self._n
+            return _decode(payload)
 
     def shutdown(self) -> None:
         if self._finished:
             return
         self._finished = True
         self._stop_event.set()
-        leftovers = list(self._buffer.values())
-        self._buffer.clear()
-        _drain_and_reap(self._result_q, self._workers, leftovers)
+        _drain_and_reap(self._result_qs, self._workers, [])
 
     def __del__(self):
         try:

@@ -44,7 +44,8 @@ def scaled_dot_product_attention(q, k, v, mask=None,
     if dropout_p > 0.0 and training:
         if key is None:
             key = _random.next_key("dropout")
-        keep = jax.random.bernoulli(key, 1.0 - dropout_p, weights.shape)
+        from .nn_functional import dropout_keep_mask
+        keep = dropout_keep_mask(key, 1.0 - dropout_p, weights.shape)
         weights = jnp.where(keep, weights / (1.0 - dropout_p), 0.0)
     return jnp.einsum("...qk,...kd->...qd", weights, v)
 

@@ -35,21 +35,36 @@ def _reduce(loss, reduction: str):
 def softmax_with_cross_entropy(logits, label, soft_label: bool = False,
                                ignore_index: int = -100, axis: int = -1,
                                return_softmax: bool = False):
-    log_p = log_softmax(logits, axis=axis)
     if soft_label:
+        log_p = log_softmax(logits, axis=axis)
         loss = -jnp.sum(label * log_p, axis=axis, keepdims=True)
-    else:
+        if return_softmax:
+            return loss, jnp.exp(log_p)
+        return loss
+    if return_softmax:
+        log_p = log_softmax(logits, axis=axis)
         lbl = label
         if lbl.ndim == logits.ndim:
             lbl = jnp.squeeze(lbl, axis=axis)
         picked = jnp.take_along_axis(
             log_p, jnp.expand_dims(lbl, axis).astype(jnp.int32), axis=axis)
-        loss = -picked
         mask = jnp.expand_dims(lbl, axis) != ignore_index
-        loss = jnp.where(mask, loss, 0.0)
-    if return_softmax:
-        return loss, jnp.exp(log_p)
-    return loss
+        return jnp.where(mask, -picked, 0.0), jnp.exp(log_p)
+    # Hot path: loss = logsumexp(logits) - logits[label]. Never
+    # materializes the [.., V] log-prob tensor (for BERT's 30k vocab
+    # that tensor is the biggest array in the step — 300MB at b8xs512);
+    # the backward recomputes softmax from logits in one fused pass.
+    # Reductions run in f32 regardless of logit dtype (bf16-safe).
+    lbl = label
+    if lbl.ndim == logits.ndim:
+        lbl = jnp.squeeze(lbl, axis=axis)
+    lg32 = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(lg32, axis=axis, keepdims=True)
+    picked = jnp.take_along_axis(
+        lg32, jnp.expand_dims(lbl, axis).astype(jnp.int32), axis=axis)
+    loss = lse - picked
+    mask = jnp.expand_dims(lbl, axis) != ignore_index
+    return jnp.where(mask, loss, 0.0)
 
 
 def cross_entropy(input, label, soft_label: bool = False,

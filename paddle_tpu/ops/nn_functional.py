@@ -481,6 +481,16 @@ def spectral_norm(weight, u, v, power_iters: int = 1, epsilon: float = 1e-12,
 # dropout & friends (ref: dropout_op.cc)
 # ---------------------------------------------------------------------------
 
+def dropout_keep_mask(key, keep_prob: float, shape):
+    """Bernoulli(keep_prob) mask via an integer threshold on raw PRNG
+    bits — skips the bits→float-uniform conversion jax.random.bernoulli
+    does, which on big masks (attention probs are [B,H,T,T]) is pure
+    memory traffic."""
+    bits = jax.random.bits(key, shape, dtype=jnp.uint32)
+    thresh = jnp.uint32(min(int(keep_prob * (2.0 ** 32)), 2 ** 32 - 1))
+    return bits < thresh
+
+
 def dropout(x, p: float = 0.5, training: bool = True,
             mode: str = "upscale_in_train", key=None):
     if not training or p == 0.0:
@@ -489,7 +499,7 @@ def dropout(x, p: float = 0.5, training: bool = True,
         return x
     if key is None:
         key = _random.next_key("dropout")
-    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    keep = dropout_keep_mask(key, 1.0 - p, x.shape)
     if mode == "upscale_in_train":
         return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
     return jnp.where(keep, x, 0.0).astype(x.dtype)

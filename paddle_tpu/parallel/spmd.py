@@ -44,6 +44,28 @@ def make_param_specs(params: Dict[str, Any],
     return out
 
 
+def _global_put(value, sharding: NamedSharding):
+    """device_put that also works on a multi-process mesh.
+
+    Single-process: plain device_put. Multi-process (jax.distributed,
+    mesh spans non-addressable devices — the reference's multi-node NCCL
+    ring case): each process supplies its addressable shards from the
+    (identical) host value via make_array_from_callback.
+    """
+    if isinstance(value, jax.Array) and value.sharding == sharding:
+        return value
+    if sharding.is_fully_addressable:
+        return jax.device_put(value, sharding)
+    if hasattr(value, "dtype") and jnp.issubdtype(value.dtype,
+                                                  jax.dtypes.prng_key):
+        raw = _global_put(jax.random.key_data(value), sharding)
+        return jax.random.wrap_key_data(
+            raw, impl=jax.random.key_impl(value))
+    arr = np.asarray(value)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
+
+
 def _zero_shard_spec(base: P, value, mesh: Mesh, axis: str) -> P:
     """ZeRO-style spec: extend `base` by sharding the largest still-
     replicated dimension of `value` over `axis` (if divisible)."""
@@ -129,8 +151,11 @@ class ShardedTrainStep:
             lambda s: NamedSharding(mesh, s), self.state_specs,
             is_leaf=lambda x: isinstance(x, P))
         self._state_shardings = state_shardings
-        # place initial state according to specs
-        self.state = jax.device_put(state, state_shardings)
+        # place initial state according to specs (multi-controller safe:
+        # on a mesh spanning multiple processes every process holds the
+        # same host value — same seed — and contributes its addressable
+        # shards)
+        self.state = jax.tree.map(_global_put, state, state_shardings)
         self.batch_sharding = NamedSharding(mesh, batch_spec)
 
         # Batch shardings are decided per leaf at call time (committed
@@ -168,7 +193,16 @@ class ShardedTrainStep:
         def put(x):
             dst = (self.batch_sharding if self._leaf_shardable(x)
                    else self._replicated_sharding)
-            return jax.device_put(jnp.asarray(x), dst)
+            if not dst.is_fully_addressable and not isinstance(x, jax.Array):
+                # A host array here would be each process's LOCAL batch
+                # masquerading as the global one — half of every rank's
+                # rows silently dropped. Make the contract explicit.
+                raise ValueError(
+                    "on a multi-process mesh, feed ShardedTrainStep "
+                    "global jax.Arrays (jax.make_array_from_process_"
+                    "local_data(sharding, local_batch, global_shape)); "
+                    f"got {type(x).__name__} for sharding {dst}")
+            return _global_put(jnp.asarray(x), dst)
         return jax.tree.map(put, batch)
 
     def _step(self, state, batch):

@@ -1,0 +1,81 @@
+"""Trainer script for the end-to-end multi-process distributed test.
+
+Launched by paddle_tpu.distributed.launch (one process per "node") on
+localhost CPU devices — the reference's test pattern
+(/root/reference/python/paddle/fluid/tests/unittests/test_dist_base.py:506
+spawns real subprocesses on 127.0.0.1; :847 NCCL2 mode). The control
+plane (csrc/control_plane.cc) plays the c_gen_nccl_id role: rank 0
+publishes the jax.distributed coordinator address through it.
+"""
+
+import json
+import os
+import socket
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu import native
+from paddle_tpu.parallel import ShardedTrainStep, create_mesh
+from paddle_tpu.parallel.env import init_parallel_env
+
+
+def main() -> None:
+    rank = int(os.environ["PT_TRAINER_ID"])
+    world = int(os.environ["PT_TRAINERS_NUM"])
+    cp_host, cp_port = os.environ["PT_CP_ENDPOINT"].split(":")
+    out_path = sys.argv[1]
+
+    cp = native.ControlPlaneClient(cp_host, int(cp_port))
+    if rank == 0:
+        with socket.socket() as s:  # pick a free port for the coordinator
+            s.bind(("127.0.0.1", 0))
+            coord = f"127.0.0.1:{s.getsockname()[1]}"
+        cp.set("jax_coordinator", coord.encode())
+    else:
+        coord = cp.get("jax_coordinator", block=True).decode()
+
+    init_parallel_env(coordinator_address=coord, num_processes=world,
+                      process_id=rank)
+    assert jax.device_count() == world, jax.devices()
+
+    mesh = create_mesh({"dp": world})
+    pt.seed(7)
+    model = pt.nn.Sequential(pt.nn.Linear(16, 32), pt.nn.ReLU(),
+                             pt.nn.Linear(32, 4))
+    step = ShardedTrainStep(
+        model, pt.optimizer.SGD(learning_rate=0.1),
+        lambda out, y: pt.nn.functional.cross_entropy(out, y),
+        mesh, batch_spec=P("dp"))
+
+    # deterministic global batch; each process feeds only its local rows
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (8, 16)).astype(np.float32)
+    y = rng.integers(0, 4, (8,)).astype(np.int64)
+    per = len(x) // world
+    x_local = x[rank * per:(rank + 1) * per]
+    y_local = y[rank * per:(rank + 1) * per]
+    batch_sh = NamedSharding(mesh, P("dp"))
+    gx = jax.make_array_from_process_local_data(batch_sh, x_local, x.shape)
+    gy = jax.make_array_from_process_local_data(batch_sh, y_local, y.shape)
+
+    losses = []
+    for _ in range(6):
+        m = step(gx, labels=gy)
+        losses.append(float(m["loss"]))
+
+    cp.barrier("done", world)
+    if rank == 0:
+        with open(out_path, "w") as f:
+            json.dump(losses, f)
+    cp.close()
+
+
+if __name__ == "__main__":
+    main()
