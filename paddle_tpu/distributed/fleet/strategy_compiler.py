@@ -177,7 +177,7 @@ class _ComposedTrainStep(ShardedTrainStep):
                 lf, has_aux=True)(params)
 
         new_params, new_opt = self.optimizer.apply_gradients(
-            params, grads, state["opt"])
+            params, grads, state["opt"], lr_override=batch.get("lr"))
 
         return ({"params": new_params, "buffers": new_buffers,
                  "opt": new_opt, "rng": rng}, {"loss": loss})

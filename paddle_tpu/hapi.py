@@ -103,6 +103,30 @@ class EarlyStopping(Callback):
                 self.stop_training = True
 
 
+class LRSchedulerCallback(Callback):
+    """(ref: hapi/callbacks.py LRScheduler callback). Feeds the epoch
+    metric to a host-driven scheduler (ReduceOnPlateau) — the compiled
+    TrainStep picks the new LR up as a runtime input.
+
+    In-graph schedulers need no callback: their lr_at(step) is compiled
+    into the train step over the per-batch step counter (by design —
+    SURVEY §7 'optimizer as ops in the program'), so host-side .step()
+    would only desynchronize get_lr() from the LR actually applied.
+    """
+
+    def __init__(self, optimizer: Optimizer,
+                 monitor: str = "loss") -> None:
+        self.optimizer = optimizer
+        self.monitor = monitor
+
+    def on_epoch_end(self, epoch, logs=None):
+        sched = getattr(self.optimizer, "learning_rate", None)
+        if getattr(sched, "host_driven", False):
+            val = (logs or {}).get(self.monitor)
+            if val is not None:
+                sched.step(float(val))
+
+
 def _as_metric_list(metrics) -> List[Metric]:
     if metrics is None:
         return []
@@ -209,6 +233,11 @@ class Model:
         callbacks = list(callbacks or [])
         if verbose:
             callbacks.append(ProgBarLogger(log_freq, verbose))
+        if self._optimizer is not None and not any(
+                isinstance(cb, LRSchedulerCallback) for cb in callbacks):
+            if getattr(getattr(self._optimizer, "learning_rate", None),
+                       "host_driven", False):
+                callbacks.append(LRSchedulerCallback(self._optimizer))
         history: Dict[str, List[float]] = {}
         if self._train_step is not None:
             # weights may have been set_value'd/loaded since the last fit

@@ -47,10 +47,10 @@ class _WrappedOptimizer(Optimizer):
     def wrap_init(self, params):
         return {}
 
-    def apply_gradients(self, params, grads, state, learning_rate=None):
+    def apply_gradients(self, params, grads, state, lr_override=None):
         inner_state = {k: v for k, v in state.items() if k != "wrap"}
         new_params, new_inner = self.inner.apply_gradients(
-            params, grads, inner_state, learning_rate)
+            params, grads, inner_state, lr_override)
         new_params, wrap = self.wrap_update(params, new_params,
                                             state["wrap"],
                                             new_inner["step"])
@@ -189,7 +189,7 @@ class GradientMerge(_WrappedOptimizer):
         }
         return state
 
-    def apply_gradients(self, params, grads, state, learning_rate=None):
+    def apply_gradients(self, params, grads, state, lr_override=None):
         wrap = state["wrap"]
         acc = jax.tree.map(jnp.add, wrap["acc"], grads)
         micro = wrap["micro"] + 1
@@ -199,7 +199,7 @@ class GradientMerge(_WrappedOptimizer):
         inner_state = {k: v for k, v in state.items() if k != "wrap"}
         upd_params, upd_inner = self.inner.apply_gradients(
             params, jax.tree.map(lambda a: a * scale, acc), inner_state,
-            learning_rate)
+            lr_override)
         new_params = jax.tree.map(
             lambda u, p: jnp.where(do_update, u, p), upd_params, params)
         new_inner = jax.tree.map(

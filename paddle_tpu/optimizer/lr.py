@@ -14,11 +14,20 @@ from __future__ import annotations
 import math
 from typing import Callable, List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 
 
 class LRScheduler:
-    """Base: subclasses implement lr_at(step) with jnp-traceable math."""
+    """Base: subclasses implement lr_at(step) with jnp-traceable math.
+
+    ``host_driven = True`` subclasses (metric-driven schedules like
+    ReduceOnPlateau) cannot be traced — their current LR is fed into the
+    compiled step as a runtime scalar input by TrainStep instead of
+    being baked in at trace time.
+    """
+
+    host_driven = False
 
     def __init__(self, learning_rate: float = 0.1,
                  last_epoch: int = -1, verbose: bool = False) -> None:
@@ -208,7 +217,10 @@ class LambdaDecay(LRScheduler):
 
 class ReduceOnPlateau(LRScheduler):
     """Host-side stateful schedule (metric-driven; not jit-traceable —
-    call .step(metric) per epoch like the reference)."""
+    call .step(metric) per epoch like the reference). TrainStep feeds
+    current_lr into the compiled step as a runtime input."""
+
+    host_driven = True
 
     def __init__(self, learning_rate: float, mode: str = "min",
                  factor: float = 0.1, patience: int = 10,
@@ -227,6 +239,11 @@ class ReduceOnPlateau(LRScheduler):
         self.base_lr = learning_rate
         self.last_epoch = 0
         self.verbose = verbose
+
+    def get_lr(self):
+        # pure host state: the step classes read this every call — no
+        # device array / sync in the hot loop
+        return float(self.current_lr)
 
     def lr_at(self, step):
         return jnp.asarray(self.current_lr)
@@ -280,7 +297,23 @@ class OneCycleLR(LRScheduler):
 
 
 def resolve_lr(lr, step):
-    """Evaluate a float or scheduler at a (possibly traced) step."""
+    """Evaluate a float or scheduler at a (possibly traced) step.
+
+    A host-driven scheduler under tracing would bake its current LR into
+    the compiled program as a constant — .step(metric) would silently
+    never change the training LR. Refuse instead; step classes feed the
+    live value via apply_gradients(lr_override=...). Eager callers (the
+    PS trainer updates on host) re-read the host state each call, which
+    is correct.
+    """
     if isinstance(lr, LRScheduler):
+        if getattr(lr, "host_driven", False) and isinstance(
+                step, jax.core.Tracer):
+            raise RuntimeError(
+                f"{type(lr).__name__} is host-driven (metric-dependent) "
+                "and cannot be traced into a compiled step; pass its "
+                "current value via apply_gradients(lr_override=...) "
+                "(TrainStep/ShardedTrainStep and the mesh steps do this "
+                "automatically).")
         return lr.lr_at(step)
     return jnp.asarray(lr, jnp.float32)

@@ -133,7 +133,8 @@ class DGCTrainStep:
                 new_res[name] = jnp.where(use_dgc, cr,
                                           jnp.zeros_like(r))
             new_params, new_opt = self.optimizer.apply_gradients(
-                params, new_grads, state["opt"])
+                params, new_grads, state["opt"],
+                lr_override=batch.get("lr"))
             loss = lax.pmean(loss, dp_axis)
             return ({"params": new_params, "buffers": new_buffers,
                      "opt": new_opt, "residual": new_res, "rng": rng,
@@ -149,6 +150,11 @@ class DGCTrainStep:
 
     def __call__(self, *args, labels=()):
         batch = {"args": args, "labels": as_label_tuple(labels)}
+        from .spmd import host_lr_of
+        lr = host_lr_of(self.optimizer)
+        if lr is not None:
+            import jax.numpy as _jnp
+            batch["lr"] = _jnp.float32(lr)
         with self.mesh:
             self.state, metrics = self._jitted(self.state, batch)
         return metrics

@@ -111,7 +111,8 @@ class LocalSGDStep:
                 loss_of, has_aux=True)(params)
             new_params, new_opt = self.optimizer.apply_gradients(
                 params, grads, {"step": state["opt"]["step"],
-                                "slots": slots})
+                                "slots": slots},
+                lr_override=batch.get("lr"))
             # mean loss across replicas for reporting only
             loss = lax.pmean(loss, dp_axis)
             return ({"params": restack(new_params),
@@ -147,6 +148,11 @@ class LocalSGDStep:
 
     def __call__(self, *args, labels=()):
         batch = {"args": args, "labels": as_label_tuple(labels)}
+        from .spmd import host_lr_of
+        lr = host_lr_of(self.optimizer)
+        if lr is not None:
+            import jax.numpy as _jnp
+            batch["lr"] = _jnp.float32(lr)
         with self.mesh:
             self.state, metrics = self._local(self.state, batch)
             self._calls += 1

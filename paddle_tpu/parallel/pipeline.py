@@ -184,12 +184,18 @@ class GPipeTrainStep:
 
         loss, grads = jax.value_and_grad(loss_of)(state["params"])
         new_params, new_opt = self.optimizer.apply_gradients(
-            state["params"], grads, state["opt"])
+            state["params"], grads, state["opt"],
+            lr_override=batch.get("lr"))
         return ({"params": new_params, "opt": new_opt, "rng": rng},
                 {"loss": loss})
 
     def __call__(self, x, labels=()):
+        batch = {"x": x, "labels": as_label_tuple(labels)}
+        from .spmd import host_lr_of
+        lr = host_lr_of(self.optimizer)
+        if lr is not None:
+            import jax.numpy as _jnp
+            batch["lr"] = _jnp.float32(lr)
         with self.mesh:
-            self.state, metrics = self._jitted(
-                self.state, {"x": x, "labels": as_label_tuple(labels)})
+            self.state, metrics = self._jitted(self.state, batch)
         return metrics

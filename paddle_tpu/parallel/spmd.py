@@ -44,6 +44,16 @@ def make_param_specs(params: Dict[str, Any],
     return out
 
 
+def host_lr_of(optimizer) -> Optional[float]:
+    """Current LR of a host-driven scheduler (ReduceOnPlateau), else
+    None. Pure host state — no device sync (get_lr is overridden to
+    return the python float)."""
+    sched = getattr(optimizer, "learning_rate", None)
+    if getattr(sched, "host_driven", False):
+        return float(sched.get_lr())
+    return None
+
+
 def _global_put(value, sharding: NamedSharding):
     """device_put that also works on a multi-process mesh.
 
@@ -221,7 +231,7 @@ class ShardedTrainStep:
         (loss, (new_buffers, out)), grads = jax.value_and_grad(
             loss_of, has_aux=True)(params)
         new_params, new_opt = self.optimizer.apply_gradients(
-            params, grads, state["opt"])
+            params, grads, state["opt"], lr_override=batch.get("lr"))
         metrics = {"loss": loss}
         for name, fn in self.extra_metrics.items():
             metrics[name] = fn(out, *batch["labels"])
@@ -234,8 +244,14 @@ class ShardedTrainStep:
                      for a in arrays)
 
     def __call__(self, *args, labels=()):
-        batch = self._place_batch(
-            {"args": args, "labels": as_label_tuple(labels)})
+        batch = {"args": args, "labels": as_label_tuple(labels)}
+        batch = self._place_batch(batch)
+        lr = host_lr_of(self.optimizer)
+        if lr is not None:
+            # placed here (replicated) so the multi-process host-array
+            # guard in _place_batch never sees this internal leaf
+            batch["lr"] = _global_put(jnp.float32(lr),
+                                      self._replicated_sharding)
         with self.mesh:
             self.state, metrics = self._jitted(self.state, batch)
         return metrics

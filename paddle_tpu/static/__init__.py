@@ -279,15 +279,28 @@ class TrainStep:
         (loss, (new_buffers, out)), grads = jax.value_and_grad(
             loss_of, has_aux=True)(params)
         new_params, new_opt = self.optimizer.apply_gradients(
-            params, grads, state["opt"])
+            params, grads, state["opt"], lr_override=batch.get("lr"))
         metrics = {"loss": loss}
         for name, fn in self.extra_metrics.items():
             metrics[name] = fn(out, *batch["labels"])
         return ({"params": new_params, "buffers": new_buffers,
                  "opt": new_opt, "rng": rng}, metrics)
 
+    def _host_lr(self):
+        """Host-driven schedulers (ReduceOnPlateau) can't be traced:
+        their current LR rides into the compiled step as a runtime
+        scalar input (same shape/dtype each call — no recompiles)."""
+        sched = getattr(self.optimizer, "learning_rate", None)
+        if getattr(sched, "host_driven", False):
+            return np.float32(sched.get_lr())
+        return None
+
     def __call__(self, *args, labels=(), **kwargs):
-        batch = {"args": args, "labels": as_label_tuple(labels), "kwargs": kwargs}
+        batch = {"args": args, "labels": as_label_tuple(labels),
+                 "kwargs": kwargs}
+        lr = self._host_lr()
+        if lr is not None:
+            batch["lr"] = lr
         self.state, metrics = self._jitted(self.state, batch)
         return metrics
 

@@ -1,0 +1,4 @@
+from . import transforms  # noqa: F401
+from ..models.lenet import LeNet  # noqa: F401
+from ..models.resnet import (ResNet, resnet18, resnet34, resnet50,  # noqa
+                             resnet101, resnet152)

@@ -1,0 +1,61 @@
+"""Host-driven LR schedules (ReduceOnPlateau) under the compiled step.
+
+r1 latent bug class: lr_at() of a host-driven scheduler was baked into
+the jitted program at trace time, so .step(metric) silently never
+changed the training LR. Now the LR rides in as a runtime input.
+"""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.optimizer.lr import ReduceOnPlateau
+from paddle_tpu.static import TrainStep
+
+
+def test_reduce_on_plateau_changes_compiled_step_lr():
+    sched = ReduceOnPlateau(learning_rate=0.5, patience=0, factor=0.1,
+                            threshold=0.0)
+    pt.seed(0)
+    net = pt.nn.Linear(4, 1, bias_attr=False)
+    opt = pt.optimizer.SGD(learning_rate=sched)
+    step = TrainStep(net, opt, lambda out, y: ((out - y) ** 2).mean())
+
+    x = np.ones((2, 4), np.float32)
+    y = np.zeros((2, 1), np.float32)
+
+    def w():
+        return np.asarray(step.state["params"]["weight"]).copy()
+
+    w0 = w()
+    step(x, labels=y)
+    d1 = np.abs(w() - w0).sum()
+
+    # two non-improving metrics -> factor 0.1 kicks in
+    sched.step(1.0)
+    sched.step(1.0)
+    assert abs(sched.get_lr() - 0.05) < 1e-9
+
+    w1 = w()
+    step(x, labels=y)
+    d2 = np.abs(w() - w1).sum()
+    # same-ish gradient magnitude, 10x smaller lr -> much smaller update
+    assert d2 < d1 * 0.5, (d1, d2)
+
+
+def test_hapi_lr_callback_steps_plateau():
+    from paddle_tpu.data import DataLoader, TensorDataset
+
+    sched = ReduceOnPlateau(learning_rate=0.1, patience=0, factor=0.5,
+                            threshold=10.0)  # huge threshold: never improves
+    pt.seed(0)
+    net = pt.nn.Sequential(pt.nn.Linear(8, 8), pt.nn.ReLU(),
+                           pt.nn.Linear(8, 2))
+    model = pt.hapi.Model(net)
+    model.prepare(optimizer=pt.optimizer.SGD(learning_rate=sched),
+                  loss=pt.nn.functional.cross_entropy)
+    rng = np.random.default_rng(0)
+    ds = TensorDataset(rng.normal(0, 1, (32, 8)).astype(np.float32),
+                       rng.integers(0, 2, (32,)).astype(np.int64))
+    model.fit(DataLoader(ds, batch_size=16), epochs=3, verbose=0)
+    # 3 epochs of "no improvement" -> at least two halvings
+    assert sched.get_lr() <= 0.1 * 0.5 * 0.5 + 1e-6
