@@ -1,0 +1,11 @@
+"""dygraph→static AST conversion (the reference's @declarative path).
+
+See transpiler.py for the rewrite rules and convert_ops.py for the
+runtime lax lowering.
+"""
+
+from .convert_ops import (UNDEFINED, convert_for_range,  # noqa: F401
+                          convert_ifelse_stmt, convert_logical_and,
+                          convert_logical_not, convert_logical_or,
+                          convert_while, is_traced)
+from .transpiler import convert_control_flow  # noqa: F401

@@ -1,0 +1,252 @@
+"""Runtime dispatchers for AST-converted control flow.
+
+TPU-native counterpart of the reference's convert_operators
+(/root/reference/python/paddle/fluid/dygraph/dygraph_to_static/
+convert_operators.py: convert_ifelse :202, convert_while_loop :38,
+convert_logical_and/or/not). The transpiler rewrites Python `if`/
+`while`/`for`/`and`/`or`/`not` into calls here; each dispatcher checks
+at RUN time whether the condition depends on a traced value — plain
+Python control flow stays plain (exact semantics, zero overhead in
+eager mode), traced control flow lowers to lax.cond / lax.while_loop /
+lax.fori_loop (the reference lowers to conditional_block / while_op).
+
+State passes through get_args/set_args closures over the enclosing
+function's locals (``nonlocal`` write-back), mirroring the reference's
+design: under tracing each branch/iteration starts with set_args() of
+the operand tracers, so both lax.cond branches trace from identical
+state.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import core as jax_core
+from jax import lax
+
+
+class _Undefined:
+    """Placeholder for names that may be unbound before a converted
+    statement (ref: dygraph_to_static undefined-var placeholders)."""
+
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+UNDEFINED = _Undefined()
+
+
+def is_traced(x) -> bool:
+    return isinstance(x, jax_core.Tracer)
+
+
+def _any_traced(tree) -> bool:
+    return any(isinstance(l, jax_core.Tracer)
+               for l in jax.tree.leaves(tree))
+
+
+def _check_defined(init, kind: str) -> None:
+    if any(v is UNDEFINED for v in init):
+        raise ValueError(
+            f"a variable assigned inside a traced `{kind}` is read "
+            f"before being defined on all paths; initialize it before "
+            f"the `{kind}` (XLA structured control flow requires every "
+            f"carried value to exist on entry — same constraint as the "
+            f"reference's while_op/conditional_block)")
+
+
+def _defined_ops(init):
+    """Split the carry into (defined operand values, rebuild fn).
+
+    A name first assigned INSIDE both branches needs no initial value —
+    lax.cond does not require matching in/out structure — so UNDEFINED
+    slots are held out of the operands and re-inserted on entry."""
+    mask = [v is not UNDEFINED for v in init]
+    ops = tuple(v for v, m in zip(init, mask) if m)
+
+    def rebuild(vals):
+        it = iter(vals)
+        return tuple(next(it) if m else UNDEFINED for m in mask)
+
+    return ops, rebuild
+
+
+_BRANCH_MISMATCH_HINT = (
+    "; a variable assigned in only one branch of a traced `if` (or left "
+    "undefined on one path) cannot be used after it — assign it on both "
+    "paths (lax.cond requires matching branch outputs, the same "
+    "constraint as the reference's conditional_block)")
+
+
+def _placeholder_like(x):
+    """Dead-slot placeholder (the reference's RETURN_NO_VALUE magic
+    number, convert_operators.py). NaN-filled for floats so that if a
+    traced function CAN fall through without returning — the one case
+    where the placeholder escapes through the final `return __pt_ret` —
+    the result is loudly wrong (NaN propagates) instead of plausible
+    zeros. Eager calls are unaffected (they return None exactly)."""
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        return jnp.full(x.shape, jnp.nan, x.dtype)
+    return jnp.full(x.shape, jnp.iinfo(x.dtype).min
+                    if jnp.issubdtype(x.dtype, jnp.signedinteger)
+                    else 0, x.dtype)
+
+
+def convert_ifelse_stmt(pred, true_fn: Callable, false_fn: Callable,
+                        get_args: Callable, set_args: Callable) -> None:
+    """`if` with no return statements: pure state mutation
+    (ref: convert_operators.py:202).
+
+    One-sided carries — a slot that one branch leaves as None/UNDEFINED
+    while the other assigns an array (the return-flag rewrite's
+    ``__pt_ret``, or a name first assigned in a single branch) — are
+    repaired with a zero placeholder on the unassigned side, the
+    reference's RETURN_NO_VALUE mechanism (convert_operators.py). The
+    placeholder is dead by construction: the ``__pt_did`` flag (or the
+    user's own control flow) guards any later read.
+    """
+    if not is_traced(pred):
+        if pred:
+            true_fn()
+        else:
+            false_fn()
+        return
+    init = get_args()
+    ops, rebuild = _defined_ops(init)
+
+    def make(branch):
+        def run(args):
+            set_args(rebuild(args))
+            branch()
+            return get_args()
+        return run
+
+    tf, ff = make(true_fn), make(false_fn)
+
+    # Probe output structures abstractly (restoring the enclosing locals
+    # afterwards — set_args mutates them during the probe). A branch
+    # whose output contains UNDEFINED (user variable assigned on one
+    # path, read later) fails the probe; no repair then — the cond
+    # below raises with the mismatch hint.
+    snapshot = get_args()
+    try:
+        t_out = jax.eval_shape(tf, ops)
+        f_out = jax.eval_shape(ff, ops)
+    except TypeError:
+        t_out = f_out = None
+    finally:
+        set_args(snapshot)
+
+    if t_out is not None and f_out is not None:
+        # repair None-holes only: the return-flag rewrite's __pt_ret is
+        # None on the non-returning side and provably dead there
+        holes_t = [i for i, (t, f) in enumerate(zip(t_out, f_out))
+                   if t is None and f is not None]
+        holes_f = [i for i, (t, f) in enumerate(zip(t_out, f_out))
+                   if f is None and t is not None]
+
+        def patch(run, holes, other_out):
+            if not holes:
+                return run
+
+            def patched(args):
+                out = list(run(args))
+                for i in holes:
+                    out[i] = _placeholder_like(other_out[i])
+                return tuple(out)
+            return patched
+
+        tf = patch(tf, holes_t, f_out)
+        ff = patch(ff, holes_f, t_out)
+
+    try:
+        out = lax.cond(pred, tf, ff, ops)
+    except TypeError as e:
+        raise ValueError(str(e) + _BRANCH_MISMATCH_HINT) from e
+    set_args(out)
+
+
+def convert_while(cond_fn: Callable, body_fn: Callable,
+                  get_args: Callable, set_args: Callable) -> None:
+    """(ref: convert_operators.py:38 convert_while_loop)."""
+    probe = cond_fn()
+    if not (is_traced(probe) or _any_traced(get_args())):
+        # plain Python do-while on the probe result: the condition is
+        # evaluated exactly once per iteration (a side-effecting
+        # condition must not be re-probed)
+        ok = bool(probe)
+        while ok:
+            body_fn()
+            ok = bool(cond_fn())
+        return
+
+    init = get_args()
+    _check_defined(init, "while")
+
+    def cond(args):
+        set_args(args)
+        return jnp.asarray(cond_fn(), bool)
+
+    def body(args):
+        set_args(args)
+        body_fn()
+        return get_args()
+
+    set_args(lax.while_loop(cond, body, init))
+
+
+def convert_for_range(start, stop, step, body_fn: Callable,
+                      get_args: Callable, set_args: Callable) -> None:
+    """`for i in range(...)` — lax.fori_loop when the bounds or carried
+    state are traced, plain Python range otherwise."""
+    traced = any(map(is_traced, (start, stop, step))) \
+        or _any_traced(get_args())
+    if not traced:
+        for i in range(start, stop, step):
+            body_fn(i)
+        return
+    init = get_args()
+    _check_defined(init, "for")
+    start = jnp.asarray(start, jnp.int32)
+    stop = jnp.asarray(stop, jnp.int32)
+    step = jnp.asarray(step, jnp.int32)
+    n = jnp.maximum((stop - start + step - jnp.sign(step))
+                    // jnp.where(step == 0, 1, step), 0)
+
+    def body(k, args):
+        set_args(args)
+        body_fn(start + k * step)
+        return get_args()
+
+    set_args(lax.fori_loop(0, n, body, init))
+
+
+def convert_logical_and(lhs: Callable, rhs: Callable):
+    """`a and b` — short-circuit preserved for Python values
+    (ref: convert_operators.py convert_logical_and)."""
+    a = lhs()
+    if not is_traced(a):
+        return a and rhs()
+    return jnp.logical_and(a, rhs())
+
+
+def convert_logical_or(lhs: Callable, rhs: Callable):
+    a = lhs()
+    if not is_traced(a):
+        return a or rhs()
+    return jnp.logical_or(a, rhs())
+
+
+def convert_logical_not(x):
+    if not is_traced(x):
+        return not x
+    return jnp.logical_not(x)

@@ -1,0 +1,499 @@
+"""AST transpiler: Python control flow → converted (traceable) calls.
+
+TPU-native rebuild of the reference's dygraph_to_static program
+translator (/root/reference/python/paddle/fluid/dygraph/
+dygraph_to_static/program_translator.py + the 23 transformer files:
+ifelse_transformer.py, loop_transformer.py, logical_transformer.py,
+return_transformer.py…). The reference rewrites Python source into
+calls that build ProgramDesc while/conditional_block ops; here the
+rewrite targets the runtime dispatchers in convert_ops.py, which lower
+to lax.cond/while_loop/fori_loop only when the condition is traced —
+eager calls keep exact Python semantics.
+
+Rewrites:
+- returns inside `if` → flag rewrite: `__pt_ret/__pt_did` assignments,
+                      trailing statements guarded by `if not __pt_did`,
+                      one final return (ref: return_transformer.py)
+- ``if``            → convert_ifelse_stmt
+- ``while``         → convert_while      (break/continue/return: left
+                      as Python; traced carries then raise in jax)
+- ``for i in range``→ convert_for_range
+- ``and/or/not``    → convert_logical_*  (short-circuit kept in eager)
+
+State crosses the boundary via generated get/set closures using
+``nonlocal``; names that may be unbound get an UNDEFINED preamble (the
+reference's undefined-var placeholders).
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import List, Optional, Set
+
+from . import convert_ops
+
+_JST = "_pt_jst"
+_UNDEF = "_PT_UNDEF"
+
+
+def _assigned_names(stmts: List[ast.stmt]) -> Set[str]:
+    """Names stored by these statements, not descending into nested
+    function/class definitions."""
+    names: Set[str] = set()
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            names.add(node.name)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_ClassDef(self, node):
+            names.add(node.name)
+
+        def visit_Lambda(self, node):
+            pass
+
+        def visit_Name(self, node):
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                names.add(node.id)
+
+        def visit_For(self, node):
+            self.generic_visit(node)
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return names
+
+
+def _has_toplevel_return(stmts: List[ast.stmt]) -> bool:
+    return any(isinstance(s, ast.Return) for s in stmts)
+
+
+def _contains_return(stmts: List[ast.stmt]) -> bool:
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_Return(self, node):
+            self.found = True
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+def _contains_break_or_continue(stmts: List[ast.stmt]) -> bool:
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_Break(self, node):
+            self.found = True
+
+        def visit_Continue(self, node):
+            self.found = True
+
+        def visit_For(self, node):  # their break belongs to them
+            pass
+
+        def visit_While(self, node):
+            pass
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+_RET = "__pt_ret"
+_DID = "__pt_did"
+
+# generated helper functions (never carried as state; __pt_ret/__pt_did
+# ARE carried)
+_HELPER_RE = None  # set below
+
+
+def _is_helper_name(n: str) -> bool:
+    import re
+    global _HELPER_RE
+    if _HELPER_RE is None:
+        _HELPER_RE = re.compile(
+            r"^__pt_(tf|ff|get|set|cond|body|outer|unused|v)(_\d+)?$")
+    return bool(_HELPER_RE.match(n))
+
+
+def _needs_return_rewrite(stmts: List[ast.stmt]) -> bool:
+    """True if any `if` OUTSIDE loops/with/try contains a return."""
+    for s in stmts:
+        if isinstance(s, ast.If):
+            if _contains_return(s.body) or _contains_return(s.orelse):
+                return True
+            if _needs_return_rewrite(s.body) \
+                    or _needs_return_rewrite(s.orelse):
+                return True
+    return False
+
+
+def _rewrite_returns(fdef: ast.FunctionDef) -> None:
+    """The reference return_transformer's capability, flag-based:
+    `return X` inside an `if` becomes `__pt_ret = X; __pt_did = True`;
+    statements following a maybe-returning `if` are wrapped in
+    `if not __pt_did:`; the function ends with `return __pt_ret`.
+    Loop/with/try bodies keep their real returns (the statement
+    converter leaves such constructs as Python)."""
+    if not _needs_return_rewrite(fdef.body):
+        return
+    body, _ = _rewrite_block(fdef.body)
+    pre = [
+        ast.Assign(targets=[_name(_RET, ast.Store())],
+                   value=ast.Constant(value=None)),
+        ast.Assign(targets=[_name(_DID, ast.Store())],
+                   value=ast.Constant(value=False)),
+    ]
+    fdef.body = pre + body + [ast.Return(value=_name(_RET))]
+
+
+def _rewrite_block(stmts: List[ast.stmt]):
+    """Returns (rewritten statements, may_have_set_did)."""
+    out: List[ast.stmt] = []
+    for i, s in enumerate(stmts):
+        if isinstance(s, ast.Return):
+            out.append(ast.Assign(
+                targets=[_name(_RET, ast.Store())],
+                value=s.value if s.value is not None
+                else ast.Constant(value=None)))
+            out.append(ast.Assign(targets=[_name(_DID, ast.Store())],
+                                  value=ast.Constant(value=True)))
+            return out, True  # rest of this block is unreachable
+        if isinstance(s, ast.If):
+            s.body, b1 = _rewrite_block(s.body)
+            s.orelse, b2 = _rewrite_block(s.orelse)
+            if not s.body:
+                s.body = [ast.Pass()]
+            out.append(s)
+            if b1 or b2:
+                rest, _ = _rewrite_block(stmts[i + 1:])
+                if rest:
+                    out.append(ast.If(
+                        test=ast.UnaryOp(op=ast.Not(),
+                                         operand=_name(_DID)),
+                        body=rest, orelse=[]))
+                return out, True
+            continue
+        # loops / with / try keep real returns; eager semantics exact,
+        # and the statement converter leaves them as Python
+        out.append(s)
+    return out, False
+
+
+class _LogicalTransformer(ast.NodeTransformer):
+    """and/or/not → convert_logical_* with lambda-wrapped operands
+    (ref: logical_transformer.py)."""
+
+    def visit_BoolOp(self, node: ast.BoolOp):
+        self.generic_visit(node)
+        fn = ("convert_logical_and" if isinstance(node.op, ast.And)
+              else "convert_logical_or")
+        expr = node.values[-1]
+        for left in reversed(node.values[:-1]):
+            expr = ast.Call(
+                func=ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
+                                   attr=fn, ctx=ast.Load()),
+                args=[ast.Lambda(args=_empty_args(), body=left),
+                      ast.Lambda(args=_empty_args(), body=expr)],
+                keywords=[])
+        return expr
+
+    def visit_UnaryOp(self, node: ast.UnaryOp):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(
+                func=ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
+                                   attr="convert_logical_not",
+                                   ctx=ast.Load()),
+                args=[node.operand], keywords=[])
+        return node
+
+
+def _empty_args() -> ast.arguments:
+    return ast.arguments(posonlyargs=[], args=[], vararg=None,
+                         kwonlyargs=[], kw_defaults=[], kwarg=None,
+                         defaults=[])
+
+
+def _name(id_: str, ctx=None) -> ast.Name:
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _jst_call(attr: str, args: List[ast.expr]) -> ast.Call:
+    return ast.Call(
+        func=ast.Attribute(value=_name(_JST), attr=attr, ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+def _tuple_of(names: List[str], ctx) -> ast.expr:
+    return ast.Tuple(elts=[ast.Name(id=n, ctx=ctx) for n in names],
+                     ctx=ctx)
+
+
+class _ControlFlowTransformer:
+    """Statement-level rewriting with bound-name tracking."""
+
+    def __init__(self) -> None:
+        self._uid = 0
+
+    def _fresh(self, kind: str) -> str:
+        self._uid += 1
+        return f"__pt_{kind}_{self._uid}"
+
+    def transform_function(self, fdef: ast.FunctionDef) -> None:
+        bound = {a.arg for a in (fdef.args.posonlyargs + fdef.args.args
+                                 + fdef.args.kwonlyargs)}
+        if fdef.args.vararg:
+            bound.add(fdef.args.vararg.arg)
+        if fdef.args.kwarg:
+            bound.add(fdef.args.kwarg.arg)
+        _rewrite_returns(fdef)
+        fdef.body = self._block(fdef.body, bound)
+
+    def _helpers(self, names: List[str], carry_defs: List[ast.stmt],
+                 bound: Set[str]) -> (str, str, List[ast.stmt]):
+        """Emit UNDEF preambles + get/set helper defs for `names`."""
+        pre: List[ast.stmt] = []
+        for n in names:
+            if n not in bound:
+                pre.append(ast.Assign(targets=[_name(n, ast.Store())],
+                                      value=_name(_UNDEF)))
+        get = self._fresh("get")
+        set_ = self._fresh("set")
+        get_def = ast.FunctionDef(
+            name=get, args=_empty_args(),
+            body=[ast.Return(value=_tuple_of(names, ast.Load()))],
+            decorator_list=[])
+        vparam = "__pt_v"
+        set_body: List[ast.stmt] = []
+        if names:
+            set_body.append(ast.Nonlocal(names=list(names)))
+        set_body.append(ast.Assign(
+            targets=[_tuple_of(names, ast.Store())]
+            if names else [ast.Name(id="__pt_unused", ctx=ast.Store())],
+            value=_name(vparam)))
+        set_def = ast.FunctionDef(
+            name=set_, args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=vparam)], vararg=None,
+                kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[]),
+            body=set_body, decorator_list=[])
+        carry_defs.extend(pre + [get_def, set_def])
+        return get, set_
+
+    def _branch_fn(self, kind: str, body: List[ast.stmt],
+                   nonlocals: List[str],
+                   params: Optional[List[str]] = None) -> (str, ast.stmt):
+        name = self._fresh(kind)
+        stmts: List[ast.stmt] = []
+        if nonlocals:
+            stmts.append(ast.Nonlocal(names=list(nonlocals)))
+        stmts.extend(body if body else [ast.Pass()])
+        args = _empty_args()
+        if params:
+            args = ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=p) for p in params],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[])
+        return name, ast.FunctionDef(name=name, args=args, body=stmts,
+                                     decorator_list=[])
+
+    def _block(self, stmts: List[ast.stmt], bound: Set[str]) \
+            -> List[ast.stmt]:
+        out: List[ast.stmt] = []
+        for s in stmts:
+            out.extend(self._stmt(s, bound))
+        return out
+
+    def _stmt(self, s: ast.stmt, bound: Set[str]) -> List[ast.stmt]:
+        if isinstance(s, ast.If):
+            return self._convert_if(s, bound)
+        if isinstance(s, ast.While):
+            return self._convert_while(s, bound)
+        if isinstance(s, ast.For):
+            return self._convert_for(s, bound)
+        if isinstance(s, (ast.With, ast.Try)):
+            for attr in ("body", "orelse", "finalbody"):
+                if hasattr(s, attr) and getattr(s, attr):
+                    setattr(s, attr, self._block(getattr(s, attr), bound))
+        bound |= _assigned_names([s])
+        return [s]
+
+    def _convert_if(self, s: ast.If, bound: Set[str]) -> List[ast.stmt]:
+        if _contains_return(s.body) or _contains_return(s.orelse) \
+                or _contains_break_or_continue(s.body) \
+                or _contains_break_or_continue(s.orelse):
+            # only reachable inside a Python-kept loop/with/try (the
+            # return rewrite handled every other return): leave the `if`
+            # as Python so return/break/continue keep their meaning
+            inner_t, inner_f = set(bound), set(bound)
+            s.body = self._block(s.body, inner_t)
+            s.orelse = self._block(s.orelse, inner_f)
+            bound |= _assigned_names([s])
+            return [s]
+        inner_bound_t = set(bound)
+        inner_bound_f = set(bound)
+        body = self._block(s.body, inner_bound_t)
+        orelse = self._block(s.orelse, inner_bound_f)
+        names = sorted((_assigned_names(s.body)
+                        | _assigned_names(s.orelse)) - {"_"})
+        names = [n for n in names if not _is_helper_name(n)]
+        defs: List[ast.stmt] = []
+        get, set_ = self._helpers(names, defs, bound)
+        tname, tdef = self._branch_fn("tf", body, names)
+        fname, fdef = self._branch_fn("ff", orelse, names)
+        call = _jst_call(
+            "convert_ifelse_stmt",
+            [s.test, _name(tname), _name(fname), _name(get), _name(set_)])
+        bound |= set(names)
+        return defs + [tdef, fdef, ast.Expr(value=call)]
+
+    def _convert_while(self, s: ast.While, bound: Set[str]) \
+            -> List[ast.stmt]:
+        if _contains_break_or_continue(s.body) \
+                or _contains_return(s.body) or s.orelse:
+            # leave as Python (break/continue/else unsupported in
+            # lax.while_loop; eager semantics preserved)
+            inner = set(bound)
+            s.body = self._block(s.body, inner)
+            bound |= _assigned_names([s])
+            return [s]
+        inner = set(bound) | _assigned_names(s.body)
+        body = self._block(s.body, set(inner))
+        names = sorted(_assigned_names(s.body) - {"_"})
+        names = [n for n in names if not _is_helper_name(n)]
+        defs: List[ast.stmt] = []
+        get, set_ = self._helpers(names, defs, bound)
+        cname, cdef = self._branch_fn(
+            "cond", [ast.Return(value=s.test)], [])
+        bname, bdef = self._branch_fn("body", body, names)
+        call = _jst_call("convert_while",
+                         [_name(cname), _name(bname), _name(get),
+                          _name(set_)])
+        bound |= set(names)
+        return defs + [cdef, bdef, ast.Expr(value=call)]
+
+    def _convert_for(self, s: ast.For, bound: Set[str]) -> List[ast.stmt]:
+        is_range = (isinstance(s.iter, ast.Call)
+                    and isinstance(s.iter.func, ast.Name)
+                    and s.iter.func.id == "range"
+                    and not s.iter.keywords
+                    and 1 <= len(s.iter.args) <= 3
+                    and isinstance(s.target, ast.Name))
+        if (not is_range or _contains_break_or_continue(s.body)
+                or _contains_return(s.body) or s.orelse):
+            inner = set(bound) | {s.target.id} \
+                if isinstance(s.target, ast.Name) else set(bound)
+            s.body = self._block(s.body, inner)
+            bound |= _assigned_names([s])
+            return [s]
+        inner = set(bound) | {s.target.id} | _assigned_names(s.body)
+        body = self._block(s.body, set(inner))
+        names = sorted(_assigned_names(s.body) - {"_", s.target.id})
+        names = [n for n in names if not _is_helper_name(n)]
+        defs: List[ast.stmt] = []
+        get, set_ = self._helpers(names, defs, bound)
+        bname, bdef = self._branch_fn("body", body, names,
+                                      params=[s.target.id])
+        a = s.iter.args
+        if len(a) == 1:
+            start, stop, step = ast.Constant(0), a[0], ast.Constant(1)
+        elif len(a) == 2:
+            start, stop, step = a[0], a[1], ast.Constant(1)
+        else:
+            start, stop, step = a
+        call = _jst_call("convert_for_range",
+                         [start, stop, step, _name(bname), _name(get),
+                          _name(set_)])
+        bound |= set(names)
+        return defs + [bdef, ast.Expr(value=call)]
+
+
+def convert_control_flow(fn):
+    """Return `fn` rewritten so data-dependent Python control flow
+    lowers to lax.cond/while/fori under tracing (the reference's
+    @declarative AST path). Falls back to `fn` unchanged (with the
+    reason) when the source is unavailable."""
+    try:
+        source = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError) as e:
+        return fn, f"source unavailable: {e}"
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return fn, f"unparsable source: {e}"
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn, "not a function definition"
+    fdef.decorator_list = []
+
+    _ControlFlowTransformer().transform_function(fdef)
+    new_tree = _LogicalTransformer().visit(tree)
+    ast.fix_missing_locations(new_tree)
+
+    glb = dict(fn.__globals__)
+    glb[_JST] = convert_ops
+    glb[_UNDEF] = convert_ops.UNDEFINED
+
+    freevars = fn.__code__.co_freevars
+    if freevars:
+        # Compile inside a synthetic outer function whose parameters are
+        # the original freevars — the inner def then has real freevars —
+        # and rebind the ORIGINAL closure cells onto the inner code
+        # object, so the converted function reads the live cells (a
+        # later `nonlocal` write in the enclosing scope stays visible),
+        # not a value snapshot.
+        import types
+        outer = ast.FunctionDef(
+            name="__pt_outer",
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=v) for v in freevars],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[]),
+            body=[new_tree.body[0],
+                  ast.Return(value=_name(fdef.name))],
+            decorator_list=[])
+        module = ast.Module(body=[outer], type_ignores=[])
+        ast.fix_missing_locations(module)
+        code = compile(module, f"<dy2static {fn.__qualname__}>", "exec")
+        outer_code = next(
+            c for c in code.co_consts
+            if isinstance(c, types.CodeType)
+            and c.co_name == "__pt_outer")
+        inner_code = next(
+            c for c in outer_code.co_consts
+            if isinstance(c, types.CodeType) and c.co_name == fdef.name)
+        cell_by_name = dict(zip(fn.__code__.co_freevars,
+                                fn.__closure__ or ()))
+        closure = tuple(cell_by_name[v] for v in inner_code.co_freevars)
+        new_fn = types.FunctionType(inner_code, glb, fdef.name,
+                                    fn.__defaults__, closure)
+    else:
+        code = compile(new_tree, f"<dy2static {fn.__qualname__}>", "exec")
+        ns = {}
+        exec(code, glb, ns)
+        new_fn = ns[fdef.name]
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    new_fn.__wrapped__ = fn
+    return new_fn, None

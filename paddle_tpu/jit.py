@@ -32,7 +32,8 @@ import numpy as np
 from . import io as io_mod
 from .nn.layer import Layer, functional_call
 
-__all__ = ["to_static", "not_to_static", "StaticFunction", "TracedLayer",
+__all__ = ["to_static", "declarative", "not_to_static", "StaticFunction",
+           "TracedLayer",
            "save", "load", "TranslatedLayer", "InputSpec"]
 
 
@@ -65,12 +66,32 @@ class InputSpec:
 
 class StaticFunction:
     """A callable captured for compilation (ref: jit.py @declarative →
-    StaticFunction in dygraph_to_static/program_translator.py)."""
+    StaticFunction in dygraph_to_static/program_translator.py).
 
-    def __init__(self, fn: Callable, input_spec=None) -> None:
+    Data-dependent Python control flow (``if tensor:``, ``while
+    tensor:``, ``for i in range(tensor)``) is AST-converted to
+    lax.cond/while/fori first (dy2static/transpiler.py — the
+    reference's 23-transformer @declarative pipeline); if the source is
+    unavailable (C callables, REPL lambdas) the function compiles
+    trace-only, like the reference's TracedLayer path.
+    """
+
+    def __init__(self, fn: Callable, input_spec=None,
+                 convert_cf: bool = True) -> None:
         self._fn = fn
         self._input_spec = input_spec
-        self._jitted = jax.jit(fn)
+        self.conversion_note = None
+        run = fn
+        if convert_cf and not getattr(fn, "__pt_not_to_static__", False):
+            from .dy2static import convert_control_flow
+            try:
+                run, self.conversion_note = convert_control_flow(fn)
+            except NotImplementedError as e:
+                raise  # explicit unsupported pattern: surface it
+            except Exception as e:  # noqa: BLE001
+                run, self.conversion_note = fn, f"conversion failed: {e}"
+        self._converted = run
+        self._jitted = jax.jit(run)
         self.__wrapped__ = fn
 
     def __call__(self, *args, **kwargs):
@@ -84,10 +105,15 @@ class StaticFunction:
             raise ValueError("concrete_program needs input_spec")
         sds = [s.to_sds() if isinstance(s, InputSpec) else s
                for s in self._input_spec]
-        return jax.make_jaxpr(self._fn)(*sds)
+        return jax.make_jaxpr(self._converted)(*sds)
 
     def rollback(self) -> Callable:
-        """Return the original eager function (ref: jit.py rollback)."""
+        """Return the original eager function, undoing any in-place
+        forward conversion on a wrapped Layer (ref: jit.py rollback —
+        the reference restores the dygraph forward the same way)."""
+        restore = getattr(self, "_restore", None)
+        if restore is not None:
+            restore()
         return self._fn
 
 
@@ -98,18 +124,47 @@ def to_static(function=None, input_spec=None):
     def wrap(fn):
         if isinstance(fn, Layer):
             layer = fn
+            # convert the forward METHOD's control flow, then drive the
+            # layer through its normal __call__ (hooks intact)
+            from .dy2static import convert_control_flow
+            import types
+            note = None
+            orig_forward = layer.forward
+            try:
+                conv, note = convert_control_flow(
+                    orig_forward.__func__
+                    if hasattr(orig_forward, "__func__")
+                    else orig_forward)
+                if note is None:
+                    layer.forward = types.MethodType(conv, layer)
+            except NotImplementedError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                note = f"conversion failed: {e}"
 
             def call(*args, **kwargs):
                 return layer(*args, **kwargs)
 
-            sf = StaticFunction(call, input_spec)
+            sf = StaticFunction(call, input_spec, convert_cf=False)
+            sf.conversion_note = note
             sf.layer = layer
+
+            def _restore():
+                if layer.forward is not orig_forward:
+                    try:
+                        del layer.forward  # uncover the class method
+                    except AttributeError:
+                        layer.forward = orig_forward
+            sf._restore = _restore
             return sf
         return StaticFunction(fn, input_spec)
 
     if function is None:
         return wrap
     return wrap(function)
+
+
+declarative = to_static  # reference alias (@fluid.dygraph.declarative)
 
 
 def not_to_static(fn: Callable) -> Callable:

@@ -105,7 +105,10 @@ class DGCTrainStep:
         self.state = jax.device_put(state, shardings)
         self.batch_sharding = NamedSharding(mesh, P(dp_axis))
 
-        def step(state, batch):
+        from .spmd import host_lr_of
+        self._host_lr_active = host_lr_of(optimizer) is not None
+
+        def step(state, batch, lr):
             params = state["params"]
             buffers = state["buffers"]
             rng, step_key = jax.random.split(state["rng"])
@@ -134,29 +137,29 @@ class DGCTrainStep:
                                           jnp.zeros_like(r))
             new_params, new_opt = self.optimizer.apply_gradients(
                 params, new_grads, state["opt"],
-                lr_override=batch.get("lr"))
+                lr_override=lr if self._host_lr_active else None)
             loss = lax.pmean(loss, dp_axis)
             return ({"params": new_params, "buffers": new_buffers,
                      "opt": new_opt, "residual": new_res, "rng": rng,
                      "step_count": state["step_count"] + 1},
                     {"loss": loss})
 
+        # host-driven LR rides as its own replicated scalar argument — a
+        # rank-0 leaf can't satisfy the batch's P(dp_axis) shard_map spec
         self._jitted = jax.jit(
             jax.shard_map(step, mesh=mesh,
-                          in_specs=(self.state_specs, P(dp_axis)),
+                          in_specs=(self.state_specs, P(dp_axis), P()),
                           out_specs=(self.state_specs, P()),
                           check_vma=False),
             donate_argnums=(0,))
 
     def __call__(self, *args, labels=()):
-        batch = {"args": args, "labels": as_label_tuple(labels)}
         from .spmd import host_lr_of
-        lr = host_lr_of(self.optimizer)
-        if lr is not None:
-            import jax.numpy as _jnp
-            batch["lr"] = _jnp.float32(lr)
+        batch = {"args": args, "labels": as_label_tuple(labels)}
+        lr = host_lr_of(self.optimizer) if self._host_lr_active else 0.0
         with self.mesh:
-            self.state, metrics = self._jitted(self.state, batch)
+            self.state, metrics = self._jitted(self.state, batch,
+                                               jnp.float32(lr))
         return metrics
 
     @property

@@ -83,7 +83,10 @@ class LocalSGDStep:
         self.state = jax.device_put(state, shardings)
         self.batch_sharding = NamedSharding(mesh, P(dp_axis))
 
-        def local_step(state, batch):
+        from .spmd import host_lr_of
+        self._host_lr_active = host_lr_of(optimizer) is not None
+
+        def local_step(state, batch, lr):
             # inside shard_map: leading replica axis is size 1 locally
             def unstack(tree):
                 return jax.tree.map(
@@ -112,7 +115,7 @@ class LocalSGDStep:
             new_params, new_opt = self.optimizer.apply_gradients(
                 params, grads, {"step": state["opt"]["step"],
                                 "slots": slots},
-                lr_override=batch.get("lr"))
+                lr_override=lr if self._host_lr_active else None)
             # mean loss across replicas for reporting only
             loss = lax.pmean(loss, dp_axis)
             return ({"params": restack(new_params),
@@ -136,9 +139,11 @@ class LocalSGDStep:
                             "slots": avg(state["opt"]["slots"])}}
 
         smap = dict(mesh=mesh, check_vma=False)
+        # host-driven LR rides as its own replicated scalar argument — a
+        # rank-0 leaf can't satisfy the batch's P(dp_axis) shard_map spec
         self._local = jax.jit(
             jax.shard_map(local_step,
-                          in_specs=(self.state_specs, P(dp_axis)),
+                          in_specs=(self.state_specs, P(dp_axis), P()),
                           out_specs=(self.state_specs, P()), **smap),
             donate_argnums=(0,))
         self._sync = jax.jit(
@@ -147,14 +152,12 @@ class LocalSGDStep:
             donate_argnums=(0,))
 
     def __call__(self, *args, labels=()):
-        batch = {"args": args, "labels": as_label_tuple(labels)}
         from .spmd import host_lr_of
-        lr = host_lr_of(self.optimizer)
-        if lr is not None:
-            import jax.numpy as _jnp
-            batch["lr"] = _jnp.float32(lr)
+        batch = {"args": args, "labels": as_label_tuple(labels)}
+        lr = host_lr_of(self.optimizer) if self._host_lr_active else 0.0
         with self.mesh:
-            self.state, metrics = self._local(self.state, batch)
+            self.state, metrics = self._local(self.state, batch,
+                                              jnp.float32(lr))
             self._calls += 1
             if self._calls % self.k_steps == 0:
                 self.state = self._sync(self.state)

@@ -190,12 +190,9 @@ class GPipeTrainStep:
                 {"loss": loss})
 
     def __call__(self, x, labels=()):
-        batch = {"x": x, "labels": as_label_tuple(labels)}
-        from .spmd import host_lr_of
-        lr = host_lr_of(self.optimizer)
-        if lr is not None:
-            import jax.numpy as _jnp
-            batch["lr"] = _jnp.float32(lr)
+        from .spmd import inject_host_lr
+        batch = inject_host_lr({"x": x, "labels": as_label_tuple(labels)},
+                               self.optimizer)
         with self.mesh:
             self.state, metrics = self._jitted(self.state, batch)
         return metrics

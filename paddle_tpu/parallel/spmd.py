@@ -54,6 +54,17 @@ def host_lr_of(optimizer) -> Optional[float]:
     return None
 
 
+def inject_host_lr(batch: Dict[str, Any], optimizer) -> Dict[str, Any]:
+    """Single place all jit-based step classes feed a host-driven
+    scheduler's live LR into the compiled step (as a runtime scalar
+    input; shard_map-based steps pass it as a separate argument
+    instead — a rank-0 leaf can't ride a P('dp') batch spec)."""
+    lr = host_lr_of(optimizer)
+    if lr is not None:
+        batch["lr"] = jnp.float32(lr)
+    return batch
+
+
 def _global_put(value, sharding: NamedSharding):
     """device_put that also works on a multi-process mesh.
 
@@ -244,14 +255,10 @@ class ShardedTrainStep:
                      for a in arrays)
 
     def __call__(self, *args, labels=()):
-        batch = {"args": args, "labels": as_label_tuple(labels)}
+        batch = inject_host_lr(
+            {"args": args, "labels": as_label_tuple(labels)},
+            self.optimizer)
         batch = self._place_batch(batch)
-        lr = host_lr_of(self.optimizer)
-        if lr is not None:
-            # placed here (replicated) so the multi-process host-array
-            # guard in _place_batch never sees this internal leaf
-            batch["lr"] = _global_put(jnp.float32(lr),
-                                      self._replicated_sharding)
         with self.mesh:
             self.state, metrics = self._jitted(self.state, batch)
         return metrics

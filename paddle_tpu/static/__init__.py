@@ -286,21 +286,11 @@ class TrainStep:
         return ({"params": new_params, "buffers": new_buffers,
                  "opt": new_opt, "rng": rng}, metrics)
 
-    def _host_lr(self):
-        """Host-driven schedulers (ReduceOnPlateau) can't be traced:
-        their current LR rides into the compiled step as a runtime
-        scalar input (same shape/dtype each call — no recompiles)."""
-        sched = getattr(self.optimizer, "learning_rate", None)
-        if getattr(sched, "host_driven", False):
-            return np.float32(sched.get_lr())
-        return None
-
     def __call__(self, *args, labels=(), **kwargs):
-        batch = {"args": args, "labels": as_label_tuple(labels),
-                 "kwargs": kwargs}
-        lr = self._host_lr()
-        if lr is not None:
-            batch["lr"] = lr
+        from ..parallel.spmd import inject_host_lr
+        batch = inject_host_lr(
+            {"args": args, "labels": as_label_tuple(labels),
+             "kwargs": kwargs}, self.optimizer)
         self.state, metrics = self._jitted(self.state, batch)
         return metrics
 
