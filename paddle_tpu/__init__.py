@@ -34,7 +34,7 @@ from .ops import sparse
 from .tensor import Tensor, to_tensor
 
 from . import amp, data, datasets, hapi, io, jit, metric, nn, optimizer
-from . import vision  # noqa: F401
+from . import utils, vision  # noqa: F401
 from . import parallel
 from . import static
 from .distributed import fleet  # noqa: F401

@@ -15,3 +15,4 @@ rewriting.
 from .base import (DistributedStrategy, Fleet, PaddleCloudRoleMaker,
                    UserDefinedRoleMaker, fleet, init, distributed_optimizer)
 from .strategy_compiler import apply_strategy
+from . import metrics  # noqa: F401 — fleet.metrics.* (ref: fleet/metrics/)

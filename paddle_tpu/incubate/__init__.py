@@ -1,1 +1,2 @@
 from . import auto_checkpoint  # noqa: F401
+from .auto_checkpoint import TrainEpochRange, train_epoch_range  # noqa: F401

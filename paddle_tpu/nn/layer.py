@@ -18,6 +18,7 @@ eager execution — but built so the SAME object compiles under jit:
 from __future__ import annotations
 
 import contextlib
+import contextvars
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
@@ -71,6 +72,28 @@ class Parameter:
                 f"dtype={self.value.dtype}, trainable={self.trainable})")
 
 
+# Training-mode override: None = per-layer flags apply; a bool forces
+# every Layer's .training during the with-block. Lets code that only
+# holds a traced function (Program.clone(for_test=True)) flip the whole
+# model to eval for one trace — the reference's is_test pass
+# (ir is_test_pass, framework.py clone(for_test)). A ContextVar so a
+# concurrent trace on another thread (hapi's async loops) can't have
+# eval semantics leak into its cached executable.
+_TRAINING_OVERRIDE: "contextvars.ContextVar[Optional[bool]]" = \
+    contextvars.ContextVar("pt_training_override", default=None)
+
+
+@contextlib.contextmanager
+def eval_mode():
+    """Force eval-mode (dropout off, BN running stats) for every Layer
+    called inside the block, regardless of per-layer flags."""
+    token = _TRAINING_OVERRIDE.set(False)
+    try:
+        yield
+    finally:
+        _TRAINING_OVERRIDE.reset(token)
+
+
 class Layer:
     """Base class for all layers."""
 
@@ -81,6 +104,17 @@ class Layer:
         object.__setattr__(self, "training", True)
         object.__setattr__(self, "_forward_pre_hooks", OrderedDict())
         object.__setattr__(self, "_forward_post_hooks", OrderedDict())
+
+    @property
+    def training(self) -> bool:
+        ov = _TRAINING_OVERRIDE.get()
+        if ov is not None:
+            return ov
+        return self.__dict__.get("_training", True)
+
+    @training.setter
+    def training(self, value: bool) -> None:
+        self.__dict__["_training"] = bool(value)
 
     # ------------------------------------------------------------------
     # attribute plumbing

@@ -108,15 +108,26 @@ def sequence_reverse(x, length):
         if x.ndim > 2 else rev.astype(jnp.int32), axis=1)
 
 
-def sequence_expand(x, ref_length, x_length=None):
-    """(ref: sequence_expand_op.cc simplified): repeat rows by ref_length.
+def sequence_expand(x, ref_length, max_len: Optional[int] = None):
+    """(ref: sequence_expand_op.cc): repeat each sequence's entry to the
+    reference sequence's length.
 
-    x: [B, ...] one entry per sequence; returns [B, max_ref, ...] padded.
+    Dense redesign of the LoD op (SURVEY §7 ragged decision): x is
+    [B, ...] with one entry per sequence; ref_length [B] gives each
+    target length. Returns [B, max_len, ...] where row b holds x[b]
+    repeated ref_length[b] times then zero-padding. ``max_len`` must be
+    static under jit (defaults to int(ref_length.max()) eagerly —
+    data-dependent, so pass it explicitly inside jit, the same
+    static-shape contract as the other dense sequence ops here).
     """
-    max_ref = ref_length.shape[0] if ref_length.ndim == 0 else None
-    # dense interpretation: broadcast each row up to max len with mask
-    raise NotImplementedError(
-        "use sequence_expand_dense(x, ref_length, max_len)")
+    if max_len is None:
+        import jax.core as _core
+        if isinstance(ref_length, _core.Tracer):
+            raise ValueError(
+                "sequence_expand under jit needs a static max_len= "
+                "(output shapes cannot depend on data in XLA)")
+        max_len = int(jnp.max(ref_length))
+    return sequence_expand_dense(x, ref_length, max_len)
 
 
 def sequence_expand_dense(x, ref_length, max_len: int):

@@ -116,7 +116,22 @@ class Program:
         return self._get_compiled()(state, feeds)
 
     def clone(self, for_test: bool = False) -> "Program":
-        return Program(self.fn, self.state_names, self.name + "_clone")
+        """(ref: framework.py Program.clone: for_test=True prunes
+        training-only ops — dropout becomes identity, BN uses running
+        stats). Here the model call is re-run with the eval-mode flag:
+        the fn is wrapped so any Layer honoring training-mode sees
+        eval during trace."""
+        if not for_test:
+            return Program(self.fn, self.state_names, self.name + "_clone")
+
+        fn = self.fn
+
+        def eval_fn(state, feeds):
+            from ..nn.layer import eval_mode
+            with eval_mode():
+                return fn(state, feeds)
+
+        return Program(eval_fn, self.state_names, self.name + "_test")
 
 
 class Executor:

@@ -1,0 +1,3 @@
+from . import dlpack  # noqa: F401
+from . import crypto  # noqa: F401
+from . import op_bench  # noqa: F401

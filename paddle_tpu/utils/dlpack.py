@@ -1,0 +1,26 @@
+"""DLPack interop (ref: /root/reference/paddle/fluid/framework/
+dlpack_tensor.cc + python paddle.utils.dlpack). Zero-copy tensor
+exchange with torch/numpy/cupy via the DLPack protocol; jax implements
+the capsule plumbing, this module provides the reference's API names.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Export a tensor as a DLPack capsule (ref: pybind dlpack_tensor
+    binding). The array itself supports __dlpack__, so modern consumers
+    can also take it directly."""
+    arr = jnp.asarray(x)
+    return arr.__dlpack__()
+
+
+def from_dlpack(capsule):
+    """Import a DLPack capsule or any __dlpack__-capable object
+    (torch/cupy/numpy arrays included) as a framework tensor."""
+    return jax.dlpack.from_dlpack(capsule)

@@ -1,0 +1,95 @@
+"""Per-op micro-benchmark harness.
+
+Capability parity with the reference's op_tester
+(/root/reference/paddle/fluid/operators/benchmark/op_tester.cc — runs a
+single op from a config of shapes/dtypes, reports ms/op). Here a
+benchmark case is (callable, example inputs); the op runs jitted on the
+ambient backend, synced by fetching a scalar (reliable over
+remote-dispatch backends, unlike block_until_ready).
+
+CLI: ``python -m paddle_tpu.utils.op_bench matmul 512x512`` runs a
+registered op at the given shape.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Callable, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["bench_op", "registered_ops"]
+
+
+def bench_op(fn: Callable, *args, iters: int = 50,
+             warmup: int = 5) -> Dict[str, float]:
+    """Time `fn(*args)` jitted; returns {ms, ops_per_sec}."""
+    def scalar(*a):
+        out = fn(*a)
+        leaf = jax.tree.leaves(out)[0]
+        return jnp.sum(leaf.astype(jnp.float32))
+
+    jf = jax.jit(scalar)
+    for _ in range(warmup):
+        float(jf(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = jf(*args)
+    float(r)
+    dt = (time.perf_counter() - t0) / iters
+    return {"ms": dt * 1e3, "ops_per_sec": 1.0 / dt}
+
+
+def _parse_shape(s: str):
+    return tuple(int(t) for t in s.split("x"))
+
+
+def registered_ops() -> Dict[str, Callable]:
+    from ..ops import nn_functional as F
+    rng = np.random.default_rng(0)
+
+    def matmul(shape):
+        a = jnp.asarray(rng.normal(0, 1, shape), jnp.float32)
+        b = jnp.asarray(rng.normal(0, 1, (shape[-1], shape[0])),
+                        jnp.float32)
+        return lambda: bench_op(jnp.matmul, a, b)
+
+    def softmax(shape):
+        x = jnp.asarray(rng.normal(0, 1, shape), jnp.float32)
+        return lambda: bench_op(jax.nn.softmax, x)
+
+    def layer_norm(shape):
+        x = jnp.asarray(rng.normal(0, 1, shape), jnp.float32)
+        w = jnp.ones((shape[-1],), jnp.float32)
+        b = jnp.zeros((shape[-1],), jnp.float32)
+        return lambda: bench_op(
+            lambda x, w, b: F.layer_norm(x, w, b, 1e-5, x.ndim - 1),
+            x, w, b)
+
+    def conv2d(shape):
+        x = jnp.asarray(rng.normal(0, 1, (1, 8) + shape), jnp.float32)
+        w = jnp.asarray(rng.normal(0, 0.1, (16, 8, 3, 3)), jnp.float32)
+        return lambda: bench_op(lambda x, w: F.conv2d(x, w, None), x, w)
+
+    return {"matmul": matmul, "softmax": softmax,
+            "layer_norm": layer_norm, "conv2d": conv2d}
+
+
+def main(argv: Sequence[str] = None) -> int:
+    argv = list(argv if argv is not None else sys.argv[1:])
+    ops = registered_ops()
+    if not argv or argv[0] not in ops:
+        print(f"usage: op_bench <{'|'.join(ops)}> [HxW[xD..]]")
+        return 2
+    shape = _parse_shape(argv[1]) if len(argv) > 1 else (512, 512)
+    res = ops[argv[0]](shape)()
+    print(f"{argv[0]} {shape}: {res['ms']:.3f} ms/op "
+          f"({res['ops_per_sec']:.1f} ops/s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,72 @@
+"""Fleet distributed metrics (ref: distributed/fleet/metrics/metric.py).
+
+Single-process identity + a real 2-process aggregation through the
+native control plane (the reference's test pattern: real localhost
+workers, test_dist_fleet_base.py).
+"""
+
+import json
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.launch import launch_procs
+
+
+def test_single_process_identity():
+    assert float(fleet.metrics.sum(3.0)) == 3.0
+    assert fleet.metrics.acc(correct=8, total=10) == pytest.approx(0.8)
+    assert fleet.metrics.rmse(sqrerr=4.0, total_ins_num=1) == \
+        pytest.approx(2.0)
+
+
+def test_auc_from_histograms_matches_sklearnless_reference():
+    # two threshold buckets: all positives score high, negatives low
+    pos = np.array([0.0, 10.0])
+    neg = np.array([10.0, 0.0])
+    assert fleet.metrics.auc(pos, neg) == pytest.approx(1.0)
+    # fully mixed → 0.5
+    pos = np.array([5.0, 5.0])
+    neg = np.array([5.0, 5.0])
+    assert fleet.metrics.auc(pos, neg) == pytest.approx(0.5)
+
+
+_WORKER = textwrap.dedent("""
+    import json, os, sys
+    import numpy as np
+    from paddle_tpu.distributed import fleet
+
+    rank = int(os.environ["PT_TRAINER_ID"])
+    # rank 0: 3 of 4 correct; rank 1: 1 of 6 correct → global 4/10
+    correct = 3 if rank == 0 else 1
+    total = 4 if rank == 0 else 6
+    acc = fleet.metrics.acc(correct=correct, total=total)
+    s = float(fleet.metrics.sum(np.array([rank + 1.0])))
+    mx = float(fleet.metrics.max(rank * 10.0))
+    if rank == 0:
+        json.dump({"acc": acc, "sum": s, "max": mx},
+                  open(sys.argv[1], "w"))
+""")
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+def test_two_process_aggregation(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    out = tmp_path / "out.json"
+    env = dict(os.environ)
+    env.pop("PT_CP_ENDPOINT", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    code = launch_procs([sys.executable, str(script), str(out)], nproc=2,
+                        env_extra=env)
+    assert code == 0
+    res = json.load(open(out))
+    assert res["acc"] == pytest.approx(0.4)
+    assert res["sum"] == pytest.approx(3.0)
+    assert res["max"] == pytest.approx(10.0)

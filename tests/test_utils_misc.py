@@ -1,0 +1,106 @@
+"""Minor-parity surfaces: dlpack, crypto, op bench, sequence_expand,
+Program.clone(for_test)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.utils import crypto, dlpack, op_bench
+
+
+def test_dlpack_roundtrip_numpy():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    arr = dlpack.from_dlpack(x)  # numpy supports __dlpack__
+    np.testing.assert_array_equal(np.asarray(arr), x)
+    cap = dlpack.to_dlpack(arr)
+    assert cap is not None
+
+
+def test_dlpack_roundtrip_torch():
+    torch = pytest.importorskip("torch")
+    t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+    arr = dlpack.from_dlpack(t)
+    np.testing.assert_array_equal(np.asarray(arr), t.numpy())
+
+
+def test_crypto_roundtrip_and_integrity():
+    key = crypto.CipherUtils.gen_key(256)
+    c = crypto.CipherFactory.create_cipher()
+    msg = b"model bytes \x00\x01\x02" * 100
+    blob = c.encrypt(msg, key)
+    assert blob != msg and len(blob) > len(msg)
+    assert c.decrypt(blob, key) == msg
+    # wrong key → integrity error, not garbage
+    with pytest.raises(ValueError, match="integrity"):
+        c.decrypt(blob, crypto.CipherUtils.gen_key(256))
+    # tamper → integrity error
+    bad = bytearray(blob)
+    bad[len(bad) // 2] ^= 0xFF
+    with pytest.raises(ValueError, match="integrity"):
+        c.decrypt(bytes(bad), key)
+
+
+def test_crypto_file_roundtrip(tmp_path):
+    key = crypto.CipherUtils.gen_key_to_file(128, str(tmp_path / "k"))
+    assert crypto.CipherUtils.read_key_from_file(
+        str(tmp_path / "k")) == key
+    c = crypto.Cipher()
+    c.encrypt_to_file(b"weights", key, str(tmp_path / "m.enc"))
+    assert c.decrypt_from_file(key, str(tmp_path / "m.enc")) == b"weights"
+
+
+def test_op_bench_runs():
+    res = op_bench.bench_op(jnp.matmul,
+                            jnp.ones((64, 64)), jnp.ones((64, 64)),
+                            iters=3, warmup=1)
+    assert res["ms"] > 0
+
+
+def test_sequence_expand():
+    from paddle_tpu.ops.sequence import sequence_expand
+    x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    ref_len = jnp.asarray([3, 1])
+    out = sequence_expand(x, ref_len)
+    assert out.shape == (2, 3, 2)
+    np.testing.assert_allclose(out[0], [[1, 2], [1, 2], [1, 2]])
+    np.testing.assert_allclose(out[1], [[3, 4], [0, 0], [0, 0]])
+    # static max_len works under jit
+    import jax
+    out2 = jax.jit(lambda x, l: sequence_expand(x, l, max_len=3))(
+        x, ref_len)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(out))
+
+
+def test_program_clone_for_test_disables_dropout():
+    from paddle_tpu.static import Program
+
+    pt.seed(0)
+    net = pt.nn.Sequential(pt.nn.Linear(8, 8), pt.nn.Dropout(0.9))
+    net.train()
+    params = net.param_dict()
+
+    def fn(state, feeds):
+        from paddle_tpu.nn.layer import functional_call
+        out = functional_call(net, state, {}, feeds["x"])
+        return state, {"out": out}
+
+    import jax
+
+    def fresh():
+        # programs donate their state: each run needs live buffers
+        return jax.tree.map(jnp.array, dict(params))
+
+    prog = Program(fn, name="p")
+    test_prog = prog.clone(for_test=True)
+    x = {"x": jnp.ones((4, 8))}
+    _, f1 = test_prog.run(fresh(), x)
+    _, f2 = test_prog.run(fresh(), x)
+    # eval mode: dropout off -> deterministic and not zeroed
+    np.testing.assert_allclose(np.asarray(f1["out"]),
+                               np.asarray(f2["out"]))
+    assert float(jnp.abs(f1["out"]).sum()) > 0
+    # train clone keeps dropout active (stochastic zeros at p=0.9)
+    train_prog = prog.clone(for_test=False)
+    _, g1 = train_prog.run(fresh(), x)
+    assert float((np.asarray(g1["out"]) == 0).mean()) > 0.5
