@@ -1,0 +1,50 @@
+import glob, gzip, json, collections, re, shutil
+import jax
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu.models import BertConfig, BertForPretraining, pretraining_loss
+from paddle_tpu.static import TrainStep
+
+config = BertConfig()
+batch, seq = 8, 512
+pt.seed(0)
+model = BertForPretraining(config)
+model.to(dtype="bfloat16")
+opt = pt.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01)
+step = TrainStep(model, opt, lambda out, m, n: pretraining_loss(out, m, n))
+rng = np.random.default_rng(0)
+ids = rng.integers(0, config.vocab_size, (batch, seq)).astype(np.int32)
+mlm = rng.integers(0, config.vocab_size, (batch, seq)).astype(np.int64)
+nsp = rng.integers(0, 2, (batch,)).astype(np.int64)
+for _ in range(6):
+    m = step(ids, labels=(mlm, nsp))
+    float(m["loss"])
+shutil.rmtree("/tmp/jxtrace", ignore_errors=True)
+jax.profiler.start_trace("/tmp/jxtrace", create_perfetto_trace=True)
+for _ in range(3):
+    m = step(ids, labels=(mlm, nsp))
+float(m["loss"])
+jax.profiler.stop_trace()
+
+f = glob.glob("/tmp/jxtrace/**/perfetto_trace.json.gz", recursive=True)[0]
+with gzip.open(f) as fh:
+    tr = json.load(fh)
+ev = tr["traceEvents"] if isinstance(tr, dict) else tr
+skip = re.compile(r"\$|np\.asarray|jit__step|PjitFunction|DevicePut|ParseArguments|^\d+$|stop_trace|CollectGarbage|linkage")
+per = collections.Counter()
+tot = 0.0
+for e in ev:
+    if e.get("ph") == "X" and "dur" in e and not skip.search(e["name"]):
+        per[e["name"]] += e["dur"]
+        tot += e["dur"]
+print("per step:", round(tot/3e3, 2), "ms")
+for k, v in per.most_common(25):
+    print(round(v/3e3, 3), "ms", k)
+# dump the HLO for cross-referencing
+b = {"args": (jax.numpy.asarray(ids),),
+     "labels": (jax.numpy.asarray(mlm), jax.numpy.asarray(nsp)),
+     "kwargs": {}}
+txt = step._jitted.lower(step.state, b).compile().as_text()
+open("/tmp/step_hlo3.txt", "w").write(txt)

@@ -77,14 +77,30 @@ def _allreduce(local: np.ndarray, op: str) -> np.ndarray:
     payload = struct.pack(">Q", want) \
         + np.ascontiguousarray(local).tobytes()
     cp.set(f"__fmetric_{env.rank}_{want % 2}", payload)
+    # Peers can legitimately lag minutes behind (XLA compiles, data
+    # skew): keep waiting up to a 10-minute deadline rather than dying
+    # on the client's 30s default get timeout.
+    deadline = _time.monotonic() + 600.0
     parts = []
     for r in range(world):
         key = f"__fmetric_{r}_{want % 2}"
         while True:
-            raw = cp.get(key, block=True)
+            try:
+                raw = cp.get(key, block=True, timeout_ms=30000)
+            except (TimeoutError, KeyError):
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"fleet.metrics: rank {r} never published round "
+                        f"{want} within 600s — peer dead or collective "
+                        f"call order diverged")
+                continue
             (got,) = struct.unpack(">Q", raw[:8])
             if got >= want:
                 break
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"fleet.metrics: rank {r} stuck at round {got} < "
+                    f"{want} after 600s")
             _time.sleep(0.002)
         parts.append(np.frombuffer(raw[8:], local.dtype)
                      .reshape(local.shape))

@@ -134,18 +134,33 @@ class AsyncCheckpointer:
             self._thread.join()
             self._thread = None
 
+    def _complete_steps(self) -> Dict[int, str]:
+        """Only ckpt-<digits> entries count: a hard crash mid-save can
+        strand ckpt-N.tmp staging dirs, which must never be parsed as
+        checkpoints (they'd crash every elastic restart) or restored
+        from (they're incomplete)."""
+        out: Dict[int, str] = {}
+        for d in os.listdir(self.directory):
+            if not d.startswith("ckpt-"):
+                continue
+            suffix = d.split("-", 1)[1]
+            if suffix.isdigit():
+                out[int(suffix)] = d
+            else:
+                # stale staging leftover from a crashed save
+                shutil.rmtree(os.path.join(self.directory, d),
+                              ignore_errors=True)
+        return out
+
     def _gc(self) -> None:
-        ckpts = sorted(
-            (d for d in os.listdir(self.directory) if d.startswith("ckpt-")),
-            key=lambda d: int(d.split("-")[1]))
-        for d in ckpts[:-self.max_to_keep]:
-            shutil.rmtree(os.path.join(self.directory, d),
+        steps = self._complete_steps()
+        for s in sorted(steps)[:-self.max_to_keep]:
+            shutil.rmtree(os.path.join(self.directory, steps[s]),
                           ignore_errors=True)
 
     def latest_step(self) -> Optional[int]:
-        ckpts = [int(d.split("-")[1]) for d in os.listdir(self.directory)
-                 if d.startswith("ckpt-")]
-        return max(ckpts) if ckpts else None
+        steps = self._complete_steps()
+        return max(steps) if steps else None
 
     def restore(self, target: Any = None, step: Optional[int] = None):
         step = step if step is not None else self.latest_step()

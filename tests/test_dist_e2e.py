@@ -36,6 +36,9 @@ def test_two_process_training_matches_single_process(tmp_path):
         if not t.startswith("--xla_force_host_platform_device_count"))
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PT_CP_ENDPOINT", None)
+    for var in ("PT_TRAINER_ID", "PT_TRAINERS_NUM", "PADDLE_TRAINER_ID",
+                "PADDLE_TRAINERS_NUM", "PT_ELASTIC_ATTEMPT"):
+        env.pop(var, None)  # env_extra overrides the per-rank env
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
 

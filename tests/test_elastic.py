@@ -67,6 +67,9 @@ def test_kill_resume_end_to_end(tmp_path):
     out = tmp_path / "result.json"
     env = dict(os.environ)
     env.pop("PT_CP_ENDPOINT", None)
+    for var in ("PT_TRAINER_ID", "PT_TRAINERS_NUM", "PADDLE_TRAINER_ID",
+                "PADDLE_TRAINERS_NUM", "PT_ELASTIC_ATTEMPT"):
+        env.pop(var, None)  # env_extra overrides the per-rank env
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
@@ -92,3 +95,23 @@ def test_kill_resume_end_to_end(tmp_path):
 
 
 import numpy as np  # noqa: E402  (used in assertions above)
+
+
+def test_stale_tmp_checkpoint_dir_does_not_break_restart(tmp_path):
+    """A hard crash mid-save strands ckpt-N.tmp; latest_step()/restore
+    must skip (and clean) it instead of raising on every elastic
+    restart."""
+    from paddle_tpu import io as io_mod
+
+    ck = io_mod.AsyncCheckpointer(str(tmp_path / "ck"))
+    ck.save({"w": np.ones(3)}, step=1)
+    ck.wait()
+    # simulate a crash mid-save of step 2
+    stale = tmp_path / "ck" / "ckpt-2.tmp"
+    stale.mkdir(parents=True)
+    (stale / "partial.npy").write_bytes(b"junk")
+
+    assert ck.latest_step() == 1
+    state = ck.restore()
+    np.testing.assert_array_equal(state["w"], np.ones(3))
+    assert not stale.exists()  # stale staging dir cleaned

@@ -61,6 +61,9 @@ def test_two_process_aggregation(tmp_path):
     out = tmp_path / "out.json"
     env = dict(os.environ)
     env.pop("PT_CP_ENDPOINT", None)
+    for var in ("PT_TRAINER_ID", "PT_TRAINERS_NUM", "PADDLE_TRAINER_ID",
+                "PADDLE_TRAINERS_NUM", "PT_ELASTIC_ATTEMPT"):
+        env.pop(var, None)  # env_extra overrides the per-rank env
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
     code = launch_procs([sys.executable, str(script), str(out)], nproc=2,

@@ -1,0 +1,237 @@
+"""Broad numeric-vs-analytic gradient sweep over the op library.
+
+VERDICT r1 weak #10: grad checks covered a minority of the op surface.
+This sweep runs the OpTest check (jax.grad vs central differences,
+mirroring /root/reference/python/paddle/fluid/tests/unittests/
+op_test.py:1236 check_grad) over every differentiable activation, the
+loss family, reductions, and the hot nn_functional/manipulation ops —
+small shapes, smooth input ranges (offsets avoid kinks like relu's 0,
+where finite differences are undefined — the reference's
+op_threshold_white_list plays the same role).
+"""
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import activation as A
+from paddle_tpu.ops import loss as L
+from paddle_tpu.ops import manipulation as MP
+from paddle_tpu.ops import math as M
+from paddle_tpu.ops import nn_functional as F
+from paddle_tpu.ops import reduction as R
+
+from op_test import check_grad
+
+_rng = np.random.default_rng(7)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_rng():
+    # deterministic draws per test regardless of execution order
+    global _rng
+    _rng = np.random.default_rng(7)
+
+
+def _x(*shape, lo=-2.0, hi=2.0, avoid_kinks=0.15):
+    """Smooth-region sample: values at least `avoid_kinks` from 0/±1
+    (common kink locations) so central differences are valid."""
+    x = _rng.uniform(lo, hi, shape)
+    for kink in (0.0, 1.0, -1.0):
+        near = np.abs(x - kink) < avoid_kinks
+        x = np.where(near, x + np.sign(x - kink + 1e-9) * avoid_kinks, x)
+    return x.astype(np.float32)
+
+
+ACTIVATIONS = [
+    "relu", "relu6", "leaky_relu", "elu", "selu", "celu", "gelu",
+    "sigmoid", "logsigmoid", "hard_sigmoid", "hard_swish",
+    "hard_tanh", "tanh", "tanh_shrink",
+    "softplus", "soft_relu", "softsign", "swish", "silu", "mish",
+    "thresholded_relu", "log_softmax", "softmax",
+]
+
+
+@pytest.mark.parametrize("name", ACTIVATIONS)
+def test_activation_grads(name):
+    fn = getattr(A, name)
+    check_grad(fn, [_x(4, 6)])
+
+
+@pytest.mark.parametrize("name", ["soft_shrink", "hard_shrink"])
+def test_shrink_grads(name):
+    # kinks at +-lambda (0.5), not 0/+-1: sample away from them
+    x = _x(4, 6)
+    x = np.where(np.abs(np.abs(x) - 0.5) < 0.15,
+                 x + np.sign(x) * 0.2, x).astype(np.float32)
+    check_grad(getattr(A, name), [x])
+
+
+def test_prelu_grad_both_args():
+    x = _x(4, 6)
+    alpha = np.full((6,), 0.25, np.float32)
+    check_grad(A.prelu, [x, alpha], wrt=0)
+    check_grad(A.prelu, [x, alpha], wrt=1)
+
+
+def test_glu_grad():
+    check_grad(A.glu, [_x(4, 8)])
+
+
+def test_maxout_grad():
+    check_grad(functools.partial(A.maxout, groups=2), [_x(2, 4, 3, 3)])
+
+
+LOSSES = [
+    # (fn, arg builders, wrt)
+    ("mse_loss", lambda: [_x(8), _x(8)], 0),
+    ("l1_loss", lambda: [_x(8), _x(8)], 0),
+    ("smooth_l1_loss", lambda: [_x(8), _x(8)], 0),
+    ("huber_loss", lambda: [_x(8), _x(8)], 0),
+    ("hinge_loss", lambda: [_x(8, 1), (_rng.integers(0, 2, (8, 1))
+                                       ).astype(np.float32)], 0),
+    ("log_loss", lambda: [(_rng.uniform(0.2, 0.8, (8, 1))
+                           ).astype(np.float32),
+                          (_rng.integers(0, 2, (8, 1))
+                           ).astype(np.float32)], 0),
+    ("kl_div", lambda: [np.log(_rng.uniform(0.2, 0.8, (6, 4))
+                               ).astype(np.float32),
+                        _softmax_rows(6, 4)], 0),
+    ("bce_loss", lambda: [(_rng.uniform(0.2, 0.8, (8,))
+                           ).astype(np.float32),
+                          (_rng.integers(0, 2, (8,))
+                           ).astype(np.float32)], 0),
+    ("binary_cross_entropy_with_logits",
+     lambda: [_x(8), (_rng.integers(0, 2, (8,))).astype(np.float32)], 0),
+    ("sigmoid_focal_loss",
+     lambda: [_x(6, 3), (_rng.integers(0, 2, (6, 3))
+                         ).astype(np.float32)], 0),
+    ("squared_l2_distance", lambda: [_x(4, 5), _x(4, 5)], 0),
+    ("bpr_loss", lambda: [_x(4, 5),
+                          _rng.integers(0, 5, (4, 1)).astype(np.int64)],
+     0),
+    ("rank_loss", lambda: [_x(6, 1), _x(6, 1),
+                           (_rng.integers(0, 2, (6, 1))
+                            ).astype(np.float32)], 0),
+    ("margin_rank_loss", lambda: [_x(6, 1) + 3.0, _x(6, 1) - 3.0,
+                                  np.ones((6, 1), np.float32)], 0),
+    ("teacher_student_sigmoid_loss",
+     lambda: [_x(8, 1), (_rng.uniform(0.2, 0.8, (8, 1))
+                         ).astype(np.float32)], 0),
+]
+
+
+def _softmax_rows(n, k):
+    z = _rng.uniform(0, 1, (n, k))
+    return (z / z.sum(1, keepdims=True)).astype(np.float32)
+
+
+@pytest.mark.parametrize("name,builder,wrt",
+                         LOSSES, ids=[t[0] for t in LOSSES])
+def test_loss_grads(name, builder, wrt):
+    check_grad(getattr(L, name), builder(), wrt=wrt)
+
+
+def test_cross_entropy_grad():
+    logits = _x(6, 5)
+    labels = _rng.integers(0, 5, (6,)).astype(np.int64)
+    check_grad(lambda lg: L.cross_entropy(lg, jnp.asarray(labels)),
+               [logits])
+
+
+def test_softmax_with_cross_entropy_grad():
+    logits = _x(6, 5)
+    labels = _rng.integers(0, 5, (6,)).astype(np.int64)
+    check_grad(lambda lg: L.softmax_with_cross_entropy(
+        lg, jnp.asarray(labels)), [logits])
+
+
+REDUCTIONS = ["sum", "mean", "max", "min", "prod", "logsumexp",
+              "frobenius_norm", "squared_l2_norm", "l1_norm", "var",
+              "std", "nanmean", "nansum", "amax", "amin"]
+
+
+@pytest.mark.parametrize("name", REDUCTIONS)
+def test_reduction_grads(name):
+    fn = getattr(R, name)
+    x = _x(4, 6, lo=0.5, hi=2.5)  # distinct positives: unique max/min
+    x += np.arange(24, dtype=np.float32).reshape(4, 6) * 1e-2
+    check_grad(fn, [x])
+
+
+def test_p_norm_grad():
+    check_grad(functools.partial(R.p_norm, p=3.0),
+               [_x(4, 6, lo=0.5, hi=2.0)])
+
+
+NN_CASES = [
+    ("conv2d", lambda: (lambda x, w: F.conv2d(x, w, None),
+                        [_x(1, 2, 6, 6), _x(3, 2, 3, 3) * 0.3])),
+    ("conv2d_transpose",
+     lambda: (lambda x, w: F.conv2d_transpose(x, w, None),
+              [_x(1, 2, 4, 4), _x(2, 3, 3, 3) * 0.3])),
+    ("avg_pool2d", lambda: (functools.partial(F.avg_pool2d, kernel_size=2),
+                            [_x(1, 2, 4, 4)])),
+    ("max_pool2d", lambda: (functools.partial(F.max_pool2d, kernel_size=2),
+                            [_x(1, 2, 4, 4) +
+                             np.arange(32, dtype=np.float32).reshape(
+                                 1, 2, 4, 4) * 0.05])),
+    ("layer_norm", lambda: (lambda x, w, b: F.layer_norm(x, w, b, 1e-5,
+                                                         x.ndim - 1),
+                            [_x(4, 6), _x(6, lo=0.5, hi=1.5), _x(6)])),
+    ("linear", lambda: (lambda x, w, b: x @ w + b,
+                        [_x(4, 6), _x(6, 3) * 0.4, _x(3)])),
+    ("embedding_weight",
+     lambda: ((lambda ids: lambda w: F.embedding(ids, w))(
+         jnp.asarray(_rng.integers(0, 8, (5,)))), [_x(8, 4)])),
+    ("interpolate_bilinear",
+     lambda: (lambda x: F.interpolate(x, size=(6, 6), mode="bilinear"),
+              [_x(1, 2, 3, 3)])),
+    ("grid_sample", lambda: (F.grid_sample,
+                             [_x(1, 2, 4, 4),
+                              (_rng.uniform(-0.8, 0.8, (1, 3, 3, 2))
+                               ).astype(np.float32)])),
+    ("pad", lambda: (lambda x: MP.pad(x, [1, 1, 1, 1]),
+                     [_x(2, 3, 3, 3)])),
+]
+
+
+@pytest.mark.parametrize("name,builder", NN_CASES,
+                         ids=[t[0] for t in NN_CASES])
+def test_nn_grads(name, builder):
+    fn, args = builder()
+    check_grad(fn, args)
+    if len(args) > 1:
+        check_grad(fn, args, wrt=1)
+
+
+MATH_BINARY = ["add", "subtract", "multiply", "divide", "maximum",
+               "minimum", "pow"]
+
+
+@pytest.mark.parametrize("name", MATH_BINARY)
+def test_elementwise_binary_grads(name):
+    fn = getattr(M, name)
+    a = _x(4, 5, lo=0.6, hi=2.0)
+    b = _x(4, 5, lo=0.6, hi=2.0) + 0.3
+    check_grad(fn, [a, b], wrt=0)
+    check_grad(fn, [a, b], wrt=1)
+
+
+def test_matmul_bmm_grads():
+    check_grad(M.matmul, [_x(3, 4) * 0.4, _x(4, 5) * 0.4], wrt=0)
+    check_grad(M.matmul, [_x(3, 4) * 0.4, _x(4, 5) * 0.4], wrt=1)
+    check_grad(M.bmm, [_x(2, 3, 4) * 0.4, _x(2, 4, 3) * 0.4])
+
+
+def test_manipulation_grads():
+    check_grad(lambda x: MP.concat([x, x * 2.0], axis=1), [_x(3, 4)])
+    check_grad(lambda x: MP.transpose(x, (1, 0)), [_x(3, 4)])
+    check_grad(lambda x: MP.reshape(x, (12,)), [_x(3, 4)])
+    idx = jnp.asarray(_rng.integers(0, 6, (4,)))
+    check_grad(lambda x: MP.gather(x, idx), [_x(6, 3)])
+    check_grad(lambda x: MP.tile(x, (2, 1)), [_x(3, 4)])
+    check_grad(lambda x: MP.flip(x, axis=0), [_x(3, 4)])
+    check_grad(lambda x: MP.roll(x, shifts=1, axis=0), [_x(3, 4)])
