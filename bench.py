@@ -217,6 +217,58 @@ def bench_resnet(on_accel: bool) -> None:
     }))
 
 
+def bench_flash_attention(on_accel: bool) -> None:
+    """Flash kernel vs XLA attention across sequence lengths — the
+    routing evidence behind flags.flash_attention_min_seq (the Pallas
+    kernel is also O(T) memory vs XLA's O(T²) scores)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.kernels.flash_attention import flash_attention
+    from paddle_tpu.ops.attention import scaled_dot_product_attention
+
+    import functools
+
+    rng = np.random.default_rng(0)
+    b, h, d = (1, 8, 128) if on_accel else (1, 2, 128)
+    seqs = (1024, 2048, 4096, 8192) if on_accel else (256,)
+    if not on_accel:
+        # Mosaic lowers only on TPU; CPU runs the interpreter
+        flash = functools.partial(flash_attention, interpret=True)
+    else:
+        flash = flash_attention
+    results = {}
+    for t in seqs:
+        q = jnp.asarray(rng.normal(0, 1, (b, h, t, d)), jnp.bfloat16)
+
+        def run(fn):
+            f = jax.jit(lambda q: jnp.sum(
+                fn(q, q, q).astype(jnp.float32)))
+            for _ in range(3):
+                float(f(q))
+            n = 10
+            t0 = time.perf_counter()
+            for _ in range(n):
+                r = f(q)
+            float(r)
+            return (time.perf_counter() - t0) / n * 1e3
+
+        xla_ms = run(scaled_dot_product_attention)
+        flash_ms = run(flash)
+        results[t] = (xla_ms, flash_ms)
+        log(f"seq {t}: xla {xla_ms:.2f}ms  flash {flash_ms:.2f}ms  "
+            f"speedup {xla_ms / flash_ms:.2f}x")
+    t_big = seqs[-1]
+    xla_ms, flash_ms = results[t_big]
+    print(json.dumps({
+        "metric": f"flash-attention fwd speedup vs XLA @seq{t_big}",
+        "value": round(xla_ms / flash_ms, 3),
+        "unit": "x",
+        "vs_baseline": round(xla_ms / flash_ms, 3),
+    }))
+
+
 def main() -> None:
     import jax
 
@@ -234,6 +286,8 @@ def main() -> None:
     which = sys.argv[1] if len(sys.argv) > 1 else "bert"
     if which == "resnet50":
         bench_resnet(on_accel)
+    elif which == "flash":
+        bench_flash_attention(on_accel)
     else:
         bench_bert(on_accel)
 

@@ -1,0 +1,135 @@
+"""Host staging arena: recycled page-aligned buffers for device feeds.
+
+TPU half of the reference's allocator subsystem
+(/root/reference/paddle/fluid/memory/allocation/
+auto_growth_best_fit_allocator.cc growth-by-chunk reuse, pinned staging
+allocation/pinned_allocator.cc, and the allocator_strategy flag
+flags.cc). On TPU, XLA owns device HBM outright (SURVEY §2.3 plan), so
+the allocator capability that remains meaningful is the HOST side of
+every feed: per-batch collate/transfer buffers. The arena hands out
+numpy views over a small ring of large reused blocks — steady-state
+feeding does zero host mallocs, keeps pages warm for DMA, and exposes
+the reference-style stats counters (monitor.h STAT registry role).
+
+Generational safety: ``stage()`` copies a batch into views of the
+current generation's blocks; the caller ``advance()``s once per step
+and views from ``depth`` generations ago are recycled — matching the
+in-flight window of DeviceLoader's prefetch ring.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+__all__ = ["HostStagingArena"]
+
+_ALIGN = 4096  # page alignment for DMA-friendly staging
+
+
+class _Block:
+    __slots__ = ("buf", "offset")
+
+    def __init__(self, nbytes: int) -> None:
+        # over-allocate to guarantee a page-aligned window
+        raw = np.empty(nbytes + _ALIGN, np.uint8)
+        shift = (-raw.ctypes.data) % _ALIGN
+        self.buf = raw[shift:shift + nbytes]
+        self.offset = 0
+
+
+class HostStagingArena:
+    def __init__(self, block_bytes: int = 64 << 20,
+                 depth: int = 3) -> None:
+        self.block_bytes = int(block_bytes)
+        self.depth = max(2, int(depth))
+        # one generation = list of blocks being bump-allocated, plus the
+        # device arrays produced from them (synced before recycling)
+        self._generations: List[List[_Block]] = [[] for _ in
+                                                 range(self.depth)]
+        self._inflight: List[Any] = [None] * self.depth
+        self._free: List[_Block] = []
+        self._gen = 0
+        self.stats: Dict[str, int] = {
+            "blocks_allocated": 0, "blocks_reused": 0,
+            "bytes_staged": 0, "oversize_passthrough": 0,
+            "blocks_released": 0,
+        }
+
+    def _alloc_view(self, nbytes: int) -> np.ndarray:
+        if nbytes > self.block_bytes:
+            # huge single tensors bypass the arena (same policy as the
+            # reference's huge-chunk path in auto_growth)
+            self.stats["oversize_passthrough"] += 1
+            return np.empty(nbytes, np.uint8)
+        gen = self._generations[self._gen % self.depth]
+        aligned = -(-nbytes // _ALIGN) * _ALIGN
+        for blk in gen:
+            if blk.offset + aligned <= len(blk.buf):
+                view = blk.buf[blk.offset:blk.offset + nbytes]
+                blk.offset += aligned
+                return view
+        if self._free:
+            blk = self._free.pop()
+            blk.offset = 0
+            self.stats["blocks_reused"] += 1
+        else:
+            blk = _Block(self.block_bytes)
+            self.stats["blocks_allocated"] += 1
+        gen.append(blk)
+        view = blk.buf[:nbytes]
+        blk.offset = aligned
+        return view
+
+    def stage(self, tree: Any) -> Any:
+        """Copy every ndarray leaf into arena-backed views (same
+        shapes/dtypes/values; contiguous)."""
+        import jax
+
+        def put(x):
+            if not isinstance(x, np.ndarray):
+                return x
+            flat = self._alloc_view(x.nbytes)
+            out = flat.view(x.dtype).reshape(x.shape)
+            np.copyto(out, x)
+            self.stats["bytes_staged"] += x.nbytes
+            return out
+
+        return jax.tree.map(put, tree)
+
+    def advance(self, live_refs: Any = None) -> None:
+        """End of step. ``live_refs``: the device arrays produced from
+        this generation's staged views — before the generation's blocks
+        are recycled ``depth`` steps later, those transfers are synced
+        (device_put returns before the host→device DMA completes;
+        reusing the buffer mid-flight would silently corrupt the device
+        batch)."""
+        import jax
+
+        self._inflight[self._gen % self.depth] = live_refs
+        self._gen += 1
+        slot = self._gen % self.depth
+        old_refs = self._inflight[slot]
+        if old_refs is not None:
+            jax.block_until_ready(old_refs)
+            self._inflight[slot] = None
+        self._free.extend(self._generations[slot])
+        self._generations[slot] = []
+        self._trim_free()
+
+    def _trim_free(self) -> None:
+        """Bound the retained free list by FLAGS_eager_delete_tensor_gb
+        (the reference's retained-buffer GC threshold, flags.cc): keep a
+        working set of `depth` blocks regardless, release the rest once
+        the free list exceeds the flag's byte budget."""
+        try:
+            from ..flags import GLOBAL_FLAGS
+            budget = float(GLOBAL_FLAGS.get("eager_delete_tensor_gb"))
+        except Exception:
+            budget = 0.0
+        keep = max(self.depth,
+                   int(budget * (1 << 30)) // max(self.block_bytes, 1))
+        while len(self._free) > keep:
+            self._free.pop(0)
+            self.stats["blocks_released"] += 1

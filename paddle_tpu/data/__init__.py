@@ -242,19 +242,49 @@ class DataLoader:
 
 
 class DeviceLoader:
-    """Async host→device prefetch (ref: buffered_reader.h:46 ReadAsync)."""
+    """Async host→device prefetch (ref: buffered_reader.h:46 ReadAsync).
+
+    With FLAGS_allocator_strategy="arena" (or use_arena=True), host
+    batches are staged through a :class:`core.arena.HostStagingArena`
+    before device_put — steady state does zero host mallocs per batch
+    (the reference's pinned staging + auto-growth reuse, SURVEY §2.3).
+    """
 
     def __init__(self, loader: Iterable, buffer_size: int = 2,
-                 sharding=None) -> None:
+                 sharding=None, use_arena: Optional[bool] = None) -> None:
         self.loader = loader
         self.buffer_size = buffer_size
         self.sharding = sharding
+        if use_arena is None:
+            from ..flags import GLOBAL_FLAGS
+            use_arena = GLOBAL_FLAGS.get(
+                "allocator_strategy") == "arena"
+        self._arena = None
+        if use_arena:
+            # CPU backend zero-copy-aliases page-aligned host arrays
+            # (verified), so recycling a block would corrupt live
+            # arrays; staging only pays off across a real host→device
+            # boundary anyway.
+            if jax.default_backend() == "cpu":
+                use_arena = False
+        if use_arena:
+            from ..core.arena import HostStagingArena
+            # in-flight window: prefetch ring + the batch being consumed
+            self._arena = HostStagingArena(depth=buffer_size + 2)
 
     def _put(self, batch):
+        if self._arena is not None:
+            batch = self._arena.stage(batch)
         if self.sharding is not None:
-            return jax.tree.map(
+            out = jax.tree.map(
                 lambda x: jax.device_put(x, self.sharding), batch)
-        return jax.tree.map(jax.device_put, batch)
+        else:
+            out = jax.tree.map(jax.device_put, batch)
+        if self._arena is not None:
+            # hand the device refs to the arena so the generation's
+            # buffers are only recycled after their DMAs complete
+            self._arena.advance(live_refs=out)
+        return out
 
     def __iter__(self):
         it = iter(self.loader)

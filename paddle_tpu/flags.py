@@ -125,7 +125,12 @@ define_flag("deterministic", False,
             "Force deterministic XLA lowering choices "
             "(ref: FLAGS_cudnn_deterministic, platform/flags.cc:98).")
 define_flag("allocator_strategy", "xla",
-            "Host staging allocator strategy (xla | arena).")
+            "Host staging allocator strategy (xla | arena). 'arena' "
+            "routes DeviceLoader feeds through core.arena."
+            "HostStagingArena: recycled page-aligned host blocks, zero "
+            "steady-state mallocs (ref: allocator_strategy flags.cc, "
+            "auto_growth_best_fit_allocator.cc). Accelerator backends "
+            "only — the CPU client zero-copy-aliases aligned arrays.")
 define_flag("eager_delete_tensor_gb", 0.0,
             "Retained-buffer GC threshold for host staging arena.")
 define_flag("matmul_precision", "default",

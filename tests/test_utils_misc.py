@@ -104,3 +104,60 @@ def test_program_clone_for_test_disables_dropout():
     train_prog = prog.clone(for_test=False)
     _, g1 = train_prog.run(fresh(), x)
     assert float((np.asarray(g1["out"]) == 0).mean()) > 0.5
+
+
+class TestHostStagingArena:
+    """Host staging arena (ref capability: memory/allocation auto-growth
+    reuse + pinned staging; SURVEY §2.3 TPU plan)."""
+
+    def _arena(self, **kw):
+        from paddle_tpu.core.arena import HostStagingArena
+        return HostStagingArena(**kw)
+
+    def test_stage_preserves_values_shapes_dtypes(self):
+        a = self._arena(block_bytes=1 << 16)
+        batch = {"x": np.arange(12, dtype=np.float32).reshape(3, 4),
+                 "y": np.ones((5,), np.int64), "z": 7}
+        out = a.stage(batch)
+        np.testing.assert_array_equal(out["x"], batch["x"])
+        np.testing.assert_array_equal(out["y"], batch["y"])
+        assert out["z"] == 7
+        assert out["x"].dtype == np.float32
+
+    def test_blocks_recycled_after_depth_generations(self):
+        a = self._arena(block_bytes=1 << 16, depth=2)
+        for _ in range(8):
+            a.stage({"x": np.zeros((1024,), np.float32)})
+            a.advance()
+        # steady state: one or two blocks total, reused thereafter
+        assert a.stats["blocks_allocated"] <= 2
+        assert a.stats["blocks_reused"] >= 4
+
+    def test_views_are_page_aligned(self):
+        a = self._arena(block_bytes=1 << 16)
+        out = a.stage({"x": np.zeros((100,), np.float32)})
+        assert out["x"].ctypes.data % 4096 == 0
+
+    def test_oversize_tensor_passthrough(self):
+        a = self._arena(block_bytes=1 << 12)
+        big = np.zeros((1 << 13,), np.uint8)
+        out = a.stage({"big": big})
+        np.testing.assert_array_equal(out["big"], big)
+        assert a.stats["oversize_passthrough"] == 1
+
+    def test_live_generations_not_overwritten(self):
+        a = self._arena(block_bytes=1 << 16, depth=3)
+        kept = []
+        for i in range(3):  # within the depth window
+            kept.append(a.stage({"x": np.full((256,), float(i),
+                                              np.float32)})["x"])
+            a.advance()
+        for i, v in enumerate(kept):
+            np.testing.assert_array_equal(v, np.full((256,), float(i),
+                                                     np.float32))
+
+    def test_device_loader_arena_disabled_on_cpu(self):
+        from paddle_tpu.data import DeviceLoader
+        dl = DeviceLoader([({"x": np.ones(4, np.float32)})],
+                          use_arena=True)
+        assert dl._arena is None  # cpu backend aliases: must not engage
