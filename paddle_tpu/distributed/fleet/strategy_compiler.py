@@ -61,6 +61,11 @@ def apply_strategy(strategy, model: Layer, optimizer: Optimizer,
     # dgc / localsgd replace the whole step structure (they change how
     # gradients cross replicas), so they take precedence and compose only
     # with optimizer substitution
+    if strategy.amp and (strategy.dgc or strategy.localsgd):
+        raise ValueError(
+            "strategy.amp does not compose with dgc/localsgd yet — "
+            "those steps bypass the AMP pipeline, so enabling both "
+            "would silently train in full precision. Disable one.")
     if strategy.dgc:
         from ...parallel.dgc import DGCTrainStep
         return DGCTrainStep(
@@ -96,6 +101,20 @@ def apply_strategy(strategy, model: Layer, optimizer: Optimizer,
         if strategy.gradient_merge else 1
     local_k = strategy.localsgd_configs.k_steps if strategy.localsgd else 1
 
+    amp_dtype = None
+    scaler = None
+    if strategy.amp:
+        # (ref: amp meta-optimizer, contrib/mixed_precision/decorator.py
+        # :218 OptimizerWithMixedPrecision). bf16 needs no loss scaling;
+        # fp16 gets the in-graph dynamic scaler (the reference's
+        # update_loss_scaling + amp_check_finite_and_scale ops).
+        from ...amp import GradScaler
+        amp_dtype = strategy.amp_configs.dtype
+        if str(amp_dtype) in ("float16", "fp16") \
+                and strategy.amp_configs.use_dynamic_loss_scaling:
+            scaler = GradScaler(
+                init_loss_scaling=strategy.amp_configs.init_loss_scaling)
+
     zero_stage = strategy.sharding_configs.stage if strategy.sharding else 0
     step = _ComposedTrainStep(
         model, optimizer, loss_fn, mesh, batch_spec=batch_spec,
@@ -103,7 +122,8 @@ def apply_strategy(strategy, model: Layer, optimizer: Optimizer,
         remat_policy=model_call,
         grad_accum_steps=k_steps,
         grad_accum_avg=strategy.gradient_merge_configs.avg,
-        localsgd_k=local_k, zero_stage=zero_stage)
+        localsgd_k=local_k, zero_stage=zero_stage,
+        amp_dtype=amp_dtype, scaler=scaler)
     return step
 
 
@@ -114,21 +134,35 @@ class _ComposedTrainStep(ShardedTrainStep):
                  param_rule=None, seed: int = 0, remat_policy=None,
                  grad_accum_steps: int = 1, grad_accum_avg: bool = True,
                  localsgd_k: int = 1, zero_stage: int = 0,
-                 extra_metrics=None) -> None:
+                 extra_metrics=None, amp_dtype=None, scaler=None) -> None:
         self.remat_policy = remat_policy
         self.grad_accum_steps = grad_accum_steps
         self.grad_accum_avg = grad_accum_avg
         self.localsgd_k = localsgd_k
+        self.amp_dtype = amp_dtype
+        self.scaler = scaler
         super().__init__(model, optimizer, loss_fn, mesh,
                          batch_spec=batch_spec, param_rule=param_rule,
                          seed=seed, extra_metrics=extra_metrics,
                          zero_stage=zero_stage)
 
+    def extra_state(self):
+        if self.scaler is None:
+            return {}
+        st = self.scaler.init()
+        return {"amp": (st, jax.tree.map(lambda _: P(), st))}
+
     def _loss_and_buffers(self, params, buffers, args, labels, key):
+        import contextlib
+
         from ...core import random as _random
 
         def run(p, *xs):
-            with _random.rng_scope(default=key, dropout=key):
+            ctx = contextlib.nullcontext()
+            if self.amp_dtype is not None:
+                from ...amp import auto_cast
+                ctx = auto_cast(enable=True, dtype=self.amp_dtype)
+            with ctx, _random.rng_scope(default=key, dropout=key):
                 out, new_buffers = functional_call(
                     self.model, p, buffers, *xs, capture_buffers=True)
             return self.loss_fn(out, *labels), (new_buffers, out)
@@ -153,9 +187,12 @@ class _ComposedTrainStep(ShardedTrainStep):
                 m_labels = tuple(_micro_slice(l, i, k) for l in labels)
 
                 def lf(p):
-                    return self._loss_and_buffers(p, bufs, m_args, m_labels,
-                                                  jax.random.fold_in(
-                                                      step_key, i))
+                    loss, aux = self._loss_and_buffers(
+                        p, bufs, m_args, m_labels,
+                        jax.random.fold_in(step_key, i))
+                    if self.scaler is not None:
+                        loss = self.scaler.scale(loss, state["amp"])
+                    return loss, aux
 
                 (loss, (new_bufs, _)), grads = jax.value_and_grad(
                     lf, has_aux=True)(params)
@@ -170,17 +207,42 @@ class _ComposedTrainStep(ShardedTrainStep):
             loss = loss_sum / k
         else:
             def lf(p):
-                return self._loss_and_buffers(p, buffers, args, labels,
-                                              step_key)
+                loss, aux = self._loss_and_buffers(p, buffers, args,
+                                                   labels, step_key)
+                if self.scaler is not None:
+                    loss = self.scaler.scale(loss, state["amp"])
+                return loss, aux
 
             (loss, (new_buffers, _)), grads = jax.value_and_grad(
                 lf, has_aux=True)(params)
 
-        new_params, new_opt = self.optimizer.apply_gradients(
-            params, grads, state["opt"], lr_override=batch.get("lr"))
+        extra = {}
+        if self.scaler is not None:
+            # unscale + finite check; on inf/nan skip the update and let
+            # the scaler back off (ref: amp_check_finite_and_scale op +
+            # update_loss_scaling, contrib/mixed_precision)
+            grads, found_inf = self.scaler.unscale(grads, state["amp"])
+            upd_params, upd_opt = self.optimizer.apply_gradients(
+                params, grads, state["opt"], lr_override=batch.get("lr"))
+            new_params = jax.tree.map(
+                lambda u, p: jnp.where(found_inf, p, u), upd_params,
+                params)
+            new_opt = jax.tree.map(
+                lambda u, o: jnp.where(found_inf, o, u), upd_opt,
+                state["opt"])
+            # a skipped step must not commit anything from the overflowed
+            # forward — including BN running stats
+            new_buffers = jax.tree.map(
+                lambda u, o: jnp.where(found_inf, o, u), new_buffers,
+                buffers)
+            extra["amp"] = self.scaler.update(state["amp"], found_inf)
+            loss = loss / state["amp"]["scale"].astype(loss.dtype)
+        else:
+            new_params, new_opt = self.optimizer.apply_gradients(
+                params, grads, state["opt"], lr_override=batch.get("lr"))
 
-        return ({"params": new_params, "buffers": new_buffers,
-                 "opt": new_opt, "rng": rng}, {"loss": loss})
+        return ({**state, "params": new_params, "buffers": new_buffers,
+                 "opt": new_opt, "rng": rng, **extra}, {"loss": loss})
 
 
 def _micro_slice(x, i, k):

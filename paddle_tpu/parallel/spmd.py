@@ -168,6 +168,11 @@ class ShardedTrainStep:
         }
         state = {"params": params, "buffers": buffers, "opt": opt_state,
                  "rng": _random.make_key(seed)}
+        # subclass extension point: extra carried state (AMP loss-scale,
+        # custom counters) with its sharding specs
+        for name, (val, spec) in self.extra_state().items():
+            state[name] = val
+            self.state_specs[name] = spec
         state_shardings = jax.tree.map(
             lambda s: NamedSharding(mesh, s), self.state_specs,
             is_leaf=lambda x: isinstance(x, P))
@@ -226,6 +231,11 @@ class ShardedTrainStep:
             return _global_put(jnp.asarray(x), dst)
         return jax.tree.map(put, batch)
 
+    def extra_state(self):
+        """Subclass hook: {name: (initial_value, PartitionSpec tree)}
+        merged into the carried state before compilation."""
+        return {}
+
     def _step(self, state, batch):
         params = state["params"]
         buffers = state["buffers"]
@@ -246,7 +256,9 @@ class ShardedTrainStep:
         metrics = {"loss": loss}
         for name, fn in self.extra_metrics.items():
             metrics[name] = fn(out, *batch["labels"])
-        return ({"params": new_params, "buffers": new_buffers,
+        # **state first: subclass-registered extra state (extra_state())
+        # passes through untouched
+        return ({**state, "params": new_params, "buffers": new_buffers,
                  "opt": new_opt, "rng": rng}, metrics)
 
     def shard_batch(self, *arrays):

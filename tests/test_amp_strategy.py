@@ -1,0 +1,127 @@
+"""AMP via DistributedStrategy: bf16 autocast + fp16 dynamic loss
+scaling compiled into the sharded step (ref: amp meta-optimizer,
+contrib/mixed_precision/decorator.py:218, update_loss_scaling op,
+amp_check_finite_and_scale_op.cc)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.fleet.strategy_compiler import apply_strategy
+
+
+def _model():
+    pt.seed(3)
+    return pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.ReLU(),
+                            pt.nn.Linear(16, 2))
+
+
+def _data(poison=False):
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (16, 8)).astype(np.float32)
+    if poison:
+        x[0, 0] = np.inf
+    y = rng.integers(0, 2, (16,)).astype(np.int64)
+    return x, y
+
+
+def test_amp_bf16_trains():
+    s = DistributedStrategy()
+    s.amp = True  # default dtype bfloat16: no scaler needed
+    step = apply_strategy(
+        s, _model(), pt.optimizer.SGD(learning_rate=0.1),
+        lambda o, t: pt.nn.functional.cross_entropy(o, t))
+    assert step.scaler is None
+    x, y = _data()
+    losses = [float(step(x, labels=y)["loss"]) for _ in range(5)]
+    assert losses[-1] < losses[0]
+    assert all(np.isfinite(l) for l in losses)
+
+
+def test_amp_fp16_dynamic_scaling_skips_inf_steps():
+    s = DistributedStrategy()
+    s.amp = True
+    s.amp_configs.dtype = "float16"
+    s.amp_configs.init_loss_scaling = 2.0 ** 10
+    step = apply_strategy(
+        s, _model(), pt.optimizer.SGD(learning_rate=0.1),
+        lambda o, t: pt.nn.functional.cross_entropy(o, t))
+    assert step.scaler is not None
+    assert "amp" in step.state
+
+    x, y = _data()
+    # clean step: params move, good_steps increments
+    w0 = np.asarray(step.state["params"]["0.weight"]).copy()
+    m = step(x, labels=y)
+    assert np.isfinite(float(m["loss"]))
+    w1 = np.asarray(step.state["params"]["0.weight"]).copy()
+    assert np.abs(w1 - w0).sum() > 0
+    assert int(step.state["amp"]["good_steps"]) == 1
+
+    # poisoned steps: non-finite grads -> update skipped, scale backs
+    # off after decr_every_n_nan_or_inf (2) bad steps
+    xp, yp = _data(poison=True)
+    scale0 = float(step.state["amp"]["scale"])
+    step(xp, labels=yp)
+    w2 = np.asarray(step.state["params"]["0.weight"]).copy()
+    np.testing.assert_array_equal(w1, w2)  # update skipped
+    step(xp, labels=yp)
+    w3 = np.asarray(step.state["params"]["0.weight"]).copy()
+    np.testing.assert_array_equal(w1, w3)
+    assert float(step.state["amp"]["scale"]) < scale0
+
+    # recovery: clean steps train again
+    m = step(x, labels=y)
+    assert np.isfinite(float(m["loss"]))
+    w4 = np.asarray(step.state["params"]["0.weight"])
+    assert np.abs(w4 - w1).sum() > 0
+
+
+def test_amp_composes_with_recompute_and_grad_merge():
+    s = DistributedStrategy()
+    s.amp = True
+    s.recompute = True
+    s.gradient_merge = True
+    s.gradient_merge_configs.k_steps = 2
+    step = apply_strategy(
+        s, _model(), pt.optimizer.SGD(learning_rate=0.1),
+        lambda o, t: pt.nn.functional.cross_entropy(o, t))
+    x, y = _data()
+    losses = [float(step(x, labels=y)["loss"]) for _ in range(4)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
+def test_amp_with_dgc_or_localsgd_raises():
+    import pytest
+    for flag in ("dgc", "localsgd"):
+        s = DistributedStrategy()
+        s.amp = True
+        setattr(s, flag, True)
+        with pytest.raises(ValueError, match="amp does not compose"):
+            apply_strategy(
+                s, _model(), pt.optimizer.SGD(learning_rate=0.1),
+                lambda o, t: pt.nn.functional.cross_entropy(o, t))
+
+
+def test_amp_fp16_skipped_step_preserves_bn_buffers():
+    s = DistributedStrategy()
+    s.amp = True
+    s.amp_configs.dtype = "float16"
+    pt.seed(3)
+    net = pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.BatchNorm1D(16),
+                           pt.nn.ReLU(), pt.nn.Linear(16, 2))
+    step = apply_strategy(
+        s, net, pt.optimizer.SGD(learning_rate=0.1),
+        lambda o, t: pt.nn.functional.cross_entropy(o, t))
+    x, y = _data()
+    step(x, labels=y)  # clean step: buffers move
+    bufs_before = {k: np.asarray(v).copy()
+                   for k, v in step.state["buffers"].items()}
+    xp, yp = _data(poison=True)
+    step(xp, labels=yp)  # skipped step: buffers must NOT change
+    for k, v in step.state["buffers"].items():
+        np.testing.assert_array_equal(np.asarray(v), bufs_before[k],
+                                      err_msg=k)
+        assert np.isfinite(np.asarray(v)).all()
