@@ -109,11 +109,20 @@ def apply_strategy(strategy, model: Layer, optimizer: Optimizer,
         # fp16 gets the in-graph dynamic scaler (the reference's
         # update_loss_scaling + amp_check_finite_and_scale ops).
         from ...amp import GradScaler
+        from ...core.dtype import convert_dtype
         amp_dtype = strategy.amp_configs.dtype
-        if str(amp_dtype) in ("float16", "fp16") \
-                and strategy.amp_configs.use_dynamic_loss_scaling:
-            scaler = GradScaler(
-                init_loss_scaling=strategy.amp_configs.init_loss_scaling)
+        if str(convert_dtype(amp_dtype)) == "float16":
+            if strategy.amp_configs.use_dynamic_loss_scaling:
+                scaler = GradScaler(
+                    init_loss_scaling=strategy.amp_configs
+                    .init_loss_scaling)
+            else:
+                # static scaling (ref: decorator.py use_dynamic_loss_
+                # scaling=False): constant scale, still skip-on-inf
+                scaler = GradScaler(
+                    init_loss_scaling=strategy.amp_configs
+                    .init_loss_scaling,
+                    incr_ratio=1.0, decr_ratio=1.0)
 
     zero_stage = strategy.sharding_configs.stage if strategy.sharding else 0
     step = _ComposedTrainStep(

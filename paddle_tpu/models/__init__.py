@@ -5,3 +5,7 @@ from .bert import (BertConfig, BertForPretraining, BertModel,
 from .lenet import LeNet
 from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
                      resnet152, resnext50_32x4d)
+from .mobilenet import (MobileNetV1, MobileNetV2, mobilenet_v1,  # noqa
+                        mobilenet_v2)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from .transformer_seq2seq import Seq2SeqConfig, TransformerSeq2Seq  # noqa

@@ -1,0 +1,132 @@
+"""MobileNet V1/V2.
+
+Capability parity with the reference's hapi vision models
+(/root/reference/python/paddle/incubate/hapi/vision/models/
+mobilenetv1.py, mobilenetv2.py). Depthwise convolutions use the same
+grouped-conv lowering the reference's depthwise_conv2d op provides
+(operators/math/depthwise_conv.cu) — on TPU, XLA lowers
+feature_group_count convolutions directly.
+"""
+
+from __future__ import annotations
+
+from .. import nn
+
+__all__ = ["MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2"]
+
+
+def _conv_bn(in_c: int, out_c: int, kernel: int, stride: int = 1,
+             padding: int = 0, groups: int = 1) -> nn.Layer:
+    return nn.Sequential(
+        nn.Conv2D(in_c, out_c, kernel, stride=stride, padding=padding,
+                  groups=groups, bias_attr=False),
+        nn.BatchNorm2D(out_c),
+        nn.ReLU6(),
+    )
+
+
+class _DepthwiseSeparable(nn.Layer):
+    """(ref: mobilenetv1.py DepthwiseSeparable)."""
+
+    def __init__(self, in_c: int, out_c: int, stride: int) -> None:
+        super().__init__()
+        self.depthwise = _conv_bn(in_c, in_c, 3, stride=stride, padding=1,
+                                  groups=in_c)
+        self.pointwise = _conv_bn(in_c, out_c, 1)
+
+    def forward(self, x):
+        return self.pointwise(self.depthwise(x))
+
+
+class MobileNetV1(nn.Layer):
+    """(ref: hapi/vision/models/mobilenetv1.py MobileNetV1)."""
+
+    def __init__(self, num_classes: int = 1000,
+                 scale: float = 1.0) -> None:
+        super().__init__()
+
+        def c(ch: int) -> int:
+            return max(int(ch * scale), 8)
+
+        cfg = [  # (in, out, stride)
+            (c(32), c(64), 1), (c(64), c(128), 2), (c(128), c(128), 1),
+            (c(128), c(256), 2), (c(256), c(256), 1),
+            (c(256), c(512), 2),
+            *[(c(512), c(512), 1)] * 5,
+            (c(512), c(1024), 2), (c(1024), c(1024), 1),
+        ]
+        self.stem = _conv_bn(3, c(32), 3, stride=2, padding=1)
+        self.blocks = nn.Sequential(
+            *[_DepthwiseSeparable(i, o, s) for i, o, s in cfg])
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        h = self.blocks(self.stem(x))
+        h = self.pool(h).reshape((x.shape[0], -1))
+        return self.fc(h)
+
+
+class _InvertedResidual(nn.Layer):
+    """(ref: mobilenetv2.py InvertedResidual): expand → depthwise →
+    project, with a linear bottleneck and residual when shapes allow."""
+
+    def __init__(self, in_c: int, out_c: int, stride: int,
+                 expand: int) -> None:
+        super().__init__()
+        hidden = in_c * expand
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if expand != 1:
+            layers.append(_conv_bn(in_c, hidden, 1))
+        layers.append(_conv_bn(hidden, hidden, 3, stride=stride,
+                               padding=1, groups=hidden))
+        layers.append(nn.Conv2D(hidden, out_c, 1, bias_attr=False))
+        layers.append(nn.BatchNorm2D(out_c))  # linear bottleneck: no act
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    """(ref: hapi/vision/models/mobilenetv2.py MobileNetV2)."""
+
+    # (expand, out_c, repeats, stride) — the paper's table 2
+    _CFG = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+
+    def __init__(self, num_classes: int = 1000,
+                 scale: float = 1.0) -> None:
+        super().__init__()
+
+        def c(ch: int) -> int:
+            return max(int(ch * scale), 8)
+
+        in_c = c(32)
+        self.stem = _conv_bn(3, in_c, 3, stride=2, padding=1)
+        blocks = []
+        for expand, out, reps, stride in self._CFG:
+            for r in range(reps):
+                blocks.append(_InvertedResidual(
+                    in_c, c(out), stride if r == 0 else 1, expand))
+                in_c = c(out)
+        self.blocks = nn.Sequential(*blocks)
+        last = max(c(1280), 1280) if scale > 1.0 else 1280
+        self.head = _conv_bn(in_c, last, 1)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(last, num_classes)
+
+    def forward(self, x):
+        h = self.head(self.blocks(self.stem(x)))
+        h = self.pool(h).reshape((x.shape[0], -1))
+        return self.fc(h)
+
+
+def mobilenet_v1(num_classes: int = 1000, scale: float = 1.0):
+    return MobileNetV1(num_classes=num_classes, scale=scale)
+
+
+def mobilenet_v2(num_classes: int = 1000, scale: float = 1.0):
+    return MobileNetV2(num_classes=num_classes, scale=scale)

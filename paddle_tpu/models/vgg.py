@@ -1,0 +1,80 @@
+"""VGG 11/13/16/19.
+
+Capability parity with the reference's hapi vision model
+(/root/reference/python/paddle/incubate/hapi/vision/models/vgg.py —
+same make_layers config strings, optional batch norm).
+"""
+
+from __future__ import annotations
+
+from typing import List, Union
+
+from .. import nn
+
+__all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19"]
+
+_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+          512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+          "M", 512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512,
+          512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+def _make_layers(cfg: List[Union[int, str]],
+                 batch_norm: bool) -> nn.Layer:
+    layers: list = []
+    in_c = 3
+    for v in cfg:
+        if v == "M":
+            layers.append(nn.MaxPool2D(kernel_size=2, stride=2))
+            continue
+        layers.append(nn.Conv2D(in_c, v, 3, padding=1))
+        if batch_norm:
+            layers.append(nn.BatchNorm2D(v))
+        layers.append(nn.ReLU())
+        in_c = v
+    return nn.Sequential(*layers)
+
+
+class VGG(nn.Layer):
+    """(ref: hapi/vision/models/vgg.py VGG)."""
+
+    def __init__(self, features: nn.Layer, num_classes: int = 1000,
+                 dropout: float = 0.5) -> None:
+        super().__init__()
+        self.features = features
+        self.pool = nn.AdaptiveAvgPool2D(7)
+        self.classifier = nn.Sequential(
+            nn.Linear(512 * 7 * 7, 4096), nn.ReLU(), nn.Dropout(dropout),
+            nn.Linear(4096, 4096), nn.ReLU(), nn.Dropout(dropout),
+            nn.Linear(4096, num_classes),
+        )
+
+    def forward(self, x):
+        h = self.pool(self.features(x))
+        return self.classifier(h.reshape((x.shape[0], -1)))
+
+
+def _vgg(cfg: str, batch_norm: bool, num_classes: int) -> VGG:
+    return VGG(_make_layers(_CFGS[cfg], batch_norm),
+               num_classes=num_classes)
+
+
+def vgg11(num_classes: int = 1000, batch_norm: bool = False) -> VGG:
+    return _vgg("A", batch_norm, num_classes)
+
+
+def vgg13(num_classes: int = 1000, batch_norm: bool = False) -> VGG:
+    return _vgg("B", batch_norm, num_classes)
+
+
+def vgg16(num_classes: int = 1000, batch_norm: bool = False) -> VGG:
+    return _vgg("D", batch_norm, num_classes)
+
+
+def vgg19(num_classes: int = 1000, batch_norm: bool = False) -> VGG:
+    return _vgg("E", batch_norm, num_classes)

@@ -125,3 +125,21 @@ def test_amp_fp16_skipped_step_preserves_bn_buffers():
         np.testing.assert_array_equal(np.asarray(v), bufs_before[k],
                                       err_msg=k)
         assert np.isfinite(np.asarray(v)).all()
+
+
+def test_amp_fp16_static_scaling():
+    s = DistributedStrategy()
+    s.amp = True
+    s.amp_configs.dtype = "float16"
+    s.amp_configs.use_dynamic_loss_scaling = False
+    s.amp_configs.init_loss_scaling = 512.0
+    step = apply_strategy(
+        s, _model(), pt.optimizer.SGD(learning_rate=0.1),
+        lambda o, t: pt.nn.functional.cross_entropy(o, t))
+    assert step.scaler is not None  # static scale, not "no scale"
+    x, y = _data()
+    for _ in range(3):
+        m = step(x, labels=y)
+    assert np.isfinite(float(m["loss"]))
+    # scale stays constant in static mode
+    assert float(step.state["amp"]["scale"]) == 512.0

@@ -150,3 +150,39 @@ def test_prepare_rejects_unknown_kwargs(loader):
     m = Model(_MLP())
     with pytest.raises(TypeError):
         m.prepare(optimzer=pt.optimizer.Adam())  # typo must not be eaten
+
+
+class TestVisionModelZoo:
+    """MobileNetV1/V2 + VGG parity (ref: hapi/vision/models/)."""
+
+    def _train_smoke(self, model, img=32, classes=4):
+        import paddle_tpu as pt
+        from paddle_tpu.static import TrainStep
+        pt.seed(0)
+        step = TrainStep(model, pt.optimizer.Momentum(0.05, 0.9),
+                         lambda o, y: pt.nn.functional.cross_entropy(o, y))
+        rng = np.random.default_rng(0)
+        x = rng.normal(0, 1, (4, 3, img, img)).astype(np.float32)
+        y = rng.integers(0, classes, (4,)).astype(np.int64)
+        l0 = float(step(x, labels=y)["loss"])
+        for _ in range(4):
+            m = step(x, labels=y)
+        assert float(m["loss"]) < l0
+
+    def test_mobilenet_v1_shapes_and_training(self):
+        from paddle_tpu.models import mobilenet_v1
+        self._train_smoke(mobilenet_v1(num_classes=4, scale=0.25))
+
+    def test_mobilenet_v2_shapes_and_training(self):
+        from paddle_tpu.models import mobilenet_v2
+        self._train_smoke(mobilenet_v2(num_classes=4, scale=0.25))
+
+    def test_vgg11_forward_shape(self):
+        import jax.numpy as jnp
+        import paddle_tpu as pt
+        from paddle_tpu.models import vgg11
+        pt.seed(0)
+        net = vgg11(num_classes=7, batch_norm=True)
+        net.eval()
+        out = net(jnp.ones((2, 3, 32, 32)))
+        assert out.shape == (2, 7)
