@@ -9,3 +9,4 @@ from .mobilenet import (MobileNetV1, MobileNetV2, mobilenet_v1,  # noqa
                         mobilenet_v2)
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .transformer_seq2seq import Seq2SeqConfig, TransformerSeq2Seq  # noqa
+from .lstm_lm import LMConfig, LSTMLanguageModel  # noqa: F401

@@ -1,0 +1,49 @@
+"""LSTM language model (PTB-style).
+
+Capability parity with the reference's RNN LM family (the book tests'
+LSTM models and fluid's cudnn_lstm path,
+/root/reference/paddle/fluid/operators/cudnn_lstm_op.cu — here the
+stacked nn.LSTM lowers through lax.scan; XLA fuses the cell math).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import nn
+
+__all__ = ["LMConfig", "LSTMLanguageModel"]
+
+
+@dataclass
+class LMConfig:
+    vocab_size: int = 10000
+    hidden_size: int = 200
+    num_layers: int = 2
+    dropout: float = 0.0
+    tie_weights: bool = True
+
+
+class LSTMLanguageModel(nn.Layer):
+    def __init__(self, config: Optional[LMConfig] = None) -> None:
+        super().__init__()
+        self.config = cfg = config or LMConfig()
+        self.embed = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.lstm = nn.LSTM(cfg.hidden_size, cfg.hidden_size,
+                            num_layers=cfg.num_layers,
+                            dropout=cfg.dropout)
+        self.dropout = nn.Dropout(cfg.dropout)
+        if not cfg.tie_weights:
+            self.proj = nn.Linear(cfg.hidden_size, cfg.vocab_size)
+
+    def forward(self, ids, state=None):
+        """ids [B, T] → logits [B, T, V] (next-token)."""
+        h = self.dropout(self.embed(ids))
+        out, _ = self.lstm(h, state)
+        out = self.dropout(out)
+        if self.config.tie_weights:
+            return out @ self.embed.weight.T
+        return self.proj(out)
