@@ -39,7 +39,7 @@ from .sparse import scatter_apply, to_dense  # noqa: F401
 from .crf import chunk_eval, crf_decoding, linear_chain_crf  # noqa: F401
 from .beam import (beam_search, beam_search_decode,  # noqa: F401
                    beam_search_step, gather_tree)
-from .sampling import (hsigmoid_loss, nce_loss,  # noqa: F401
+from .sampling import (hash_bucket, hsigmoid_loss, nce_loss,  # noqa: F401
                        sampled_softmax_with_cross_entropy)
 from .conv_extra import *  # noqa: F401,F403
 from .tensor_array import (TensorArray, array_length,  # noqa: F401

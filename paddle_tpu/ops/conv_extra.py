@@ -239,6 +239,10 @@ def fsp_matrix(x, y):
     """Flow-of-solution-procedure matrix for distillation
     (ref: fsp_op.cc): [B,C1,H,W]×[B,C2,H,W] → [B,C1,C2] / (H·W)."""
     h, w = x.shape[2], x.shape[3]
+    if (h, w) != tuple(y.shape[2:4]):
+        raise ValueError(
+            f"fsp_matrix spatial mismatch {(h, w)} vs "
+            f"{tuple(y.shape[2:4])}")
     return jnp.einsum("bihw,bjhw->bij", x, y) / (h * w)
 
 

@@ -364,3 +364,4 @@ def center_loss(features, label, centers, alpha: float = 0.5,
     grad = jnp.zeros_like(centers).at[lbl].add(-diff)
     new_centers = centers - alpha * grad / (counts[:, None] + 1.0)
     return loss, new_centers
+

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 
 from . import random_ops as _random
 
-__all__ = ["hsigmoid_loss", "nce_loss", "sampled_softmax_with_cross_entropy"]
+__all__ = ["hash_bucket", "hsigmoid_loss", "nce_loss", "sampled_softmax_with_cross_entropy"]
 
 
 def _default_code(label, num_classes: int, depth: int):
@@ -142,3 +142,31 @@ def sampled_softmax_with_cross_entropy(x, weight, label,
     dup = (ids[:, 1:] == ids[:, :1])
     logits = logits.at[:, 1:].set(jnp.where(dup, -1e9, logits[:, 1:]))
     return -jax.nn.log_softmax(logits, axis=1)[:, 0]
+
+
+def hash_bucket(ids, num_buckets: int, num_hash: int = 1,
+                mod_by: int = 100000007):
+    """(ref: hash_op.cc — xxhash of int ids into buckets, one column per
+    hash seed; used to build multi-probe sparse feature ids.)
+
+    ids: integer array [..., 1] or [...]. Returns int64-ish [..., num_hash]
+    of bucket ids. The hash is a splitmix64-style integer mix — a
+    deterministic, well-distributed stand-in for xxhash that stays
+    vectorized on TPU.
+    """
+    x = jnp.asarray(ids)
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = x[..., 0]
+    x = x.astype(jnp.uint32)
+
+    def mix(v, seed):
+        v = v ^ jnp.uint32(seed)
+        v = (v ^ (v >> 16)) * jnp.uint32(0x45D9F3B)
+        v = (v ^ (v >> 16)) * jnp.uint32(0x45D9F3B)
+        v = v ^ (v >> 16)
+        return v
+
+    cols = [mix(x, (0x9E3779B9 + 0x85EBCA6B * k) & 0xFFFFFFFF)
+            % jnp.uint32(mod_by)
+            % jnp.uint32(num_buckets) for k in range(num_hash)]
+    return jnp.stack(cols, axis=-1).astype(jnp.int32)

@@ -348,3 +348,28 @@ def lod_reset(x, length, new_length):
             out = out.at[i, :ln].set(packed[off:off + ln])
         off += ln
     return out, jnp.asarray(new_lens, jnp.int32)
+
+
+def filter_by_instag(x, ins_tags, filter_tags, is_lod: bool = False):
+    """(ref: filter_by_instag_op.cc) keep rows whose tag set intersects
+    ``filter_tags``.
+
+    Dense redesign of the LoD op: x [B, ...]; ins_tags [B, T] padded
+    with 0; filter_tags [K]. Returns (filtered_x, mask, loss_weight) —
+    filtered rows keep their values, non-matching rows are zeroed
+    (static shape; the reference compacts rows, which is dynamic), mask
+    is the [B] keep-mask and loss_weight its float view (the op's
+    LossWeight output, used to zero those rows' loss).
+    """
+    if is_lod:
+        raise NotImplementedError(
+            "LoD (row-compacting) mode has no static-shape equivalent; "
+            "use the dense mask semantics (is_lod=False)")
+    tags = jnp.asarray(ins_tags)
+    filt = jnp.asarray(filter_tags).reshape(-1)
+    hit = (tags[..., None] == filt[None, None, :]) \
+        & (tags[..., None] != 0)
+    mask = jnp.any(hit, axis=(1, 2))
+    w = mask.astype(jnp.float32)
+    xf = x * w.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
+    return xf, mask, w

@@ -553,3 +553,43 @@ def test_proximal_gd_l1_sparsifies():
     g = {"w": jnp.asarray([0.0, 0.0])}
     p, state = opt.apply_gradients(p, g, state)
     assert float(p["w"][0]) == 0.0  # small weight clipped to zero by L1
+
+
+def test_hash_bucket_deterministic_and_spread():
+    from paddle_tpu.ops import hash_bucket
+    ids = jnp.arange(1000)
+    h = hash_bucket(ids, num_buckets=64, num_hash=3)
+    assert h.shape == (1000, 3)
+    assert int(h.min()) >= 0 and int(h.max()) < 64
+    # deterministic
+    np.testing.assert_array_equal(np.asarray(h),
+                                  np.asarray(hash_bucket(ids, 64, 3)))
+    # reasonably uniform: no bucket holds >5% of ids for any hash column
+    for c in range(3):
+        counts = np.bincount(np.asarray(h[:, c]), minlength=64)
+        assert counts.max() < 50
+    # different hash columns disagree
+    assert (np.asarray(h[:, 0]) != np.asarray(h[:, 1])).mean() > 0.9
+
+
+def test_fsp_matrix():
+    from paddle_tpu.ops import fsp_matrix
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (2, 3, 4, 5)).astype(np.float32)
+    y = rng.normal(0, 1, (2, 6, 4, 5)).astype(np.float32)
+    out = fsp_matrix(jnp.asarray(x), jnp.asarray(y))
+    assert out.shape == (2, 3, 6)
+    want = np.einsum("bchw,bdhw->bcd", x, y) / 20.0
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5)
+
+
+def test_filter_by_instag():
+    from paddle_tpu.ops import filter_by_instag
+    x = jnp.asarray(np.arange(12, dtype=np.float32).reshape(4, 3))
+    tags = jnp.asarray([[1, 0], [2, 3], [4, 0], [3, 1]])
+    xf, mask, w = filter_by_instag(x, tags, [1, 4])
+    np.testing.assert_array_equal(np.asarray(mask),
+                                  [True, False, True, True])
+    assert np.asarray(xf)[1].sum() == 0.0  # filtered row zeroed
+    np.testing.assert_array_equal(np.asarray(xf)[0], np.asarray(x)[0])
+    np.testing.assert_array_equal(np.asarray(w), [1.0, 0.0, 1.0, 1.0])
