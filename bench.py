@@ -269,7 +269,37 @@ def bench_flash_attention(on_accel: bool) -> None:
     }))
 
 
+def _probe_backend(attempts: int = 3, timeout_s: int = 300) -> bool:
+    """Fail FAST (with retries) if the accelerator tunnel is hung or
+    down, instead of hanging until the driver's timeout (round 1's
+    rc=124 failure mode). Probes in a subprocess so a wedged PJRT init
+    can't freeze this process."""
+    import subprocess
+
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.default_backend())"],
+                capture_output=True, timeout=timeout_s, text=True)
+            if r.returncode == 0:
+                backend = r.stdout.strip().splitlines()[-1]
+                log(f"backend probe {i}: {backend}")
+                return True
+            log(f"backend probe {i}: rc={r.returncode} "
+                f"{r.stderr.strip().splitlines()[-1][:200] if r.stderr else ''}")
+        except subprocess.TimeoutExpired:
+            log(f"backend probe {i}: hung >{timeout_s}s (tunnel down?)")
+        time.sleep(30)
+    return False
+
+
 def main() -> None:
+    if not _probe_backend():
+        log("accelerator backend unreachable after retries; aborting "
+            "fast so the driver can rerun (no fabricated numbers)")
+        sys.exit(3)
+
     import jax
 
     jax.config.update("jax_compilation_cache_dir",
