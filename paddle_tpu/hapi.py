@@ -342,12 +342,23 @@ class Model:
                                               if isinstance(b, tuple)
                                               else b)) for b in loader]
 
-    def save(self, path: str) -> None:
+    def save(self, path: str, training: bool = True,
+             input_spec=None) -> None:
+        """training=True: checkpoint (params+buffers). training=False:
+        inference export — serialized StableHLO + params via jit.save
+        (ref: hapi/model.py Model.save(training=False) →
+        save_inference_model)."""
         # Mid-fit (ModelCheckpoint callback) the live training state must be
         # pulled back first; outside fit the eager network is authoritative
         # and syncing would clobber user weight mutations.
         if self._fitting and self._train_step is not None:
             self._train_step.sync_to_model()
+        if not training:
+            # jit.save itself forces eval mode for the export trace and
+            # restores the layer's mode afterwards
+            from . import jit as jit_mod
+            jit_mod.save(self.network, path, input_spec=input_spec)
+            return
         io_mod.save(self.network.state_dict(), path + ".pdparams")
 
     def load(self, path: str) -> None:

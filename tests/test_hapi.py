@@ -186,3 +186,23 @@ class TestVisionModelZoo:
         net.eval()
         out = net(jnp.ones((2, 3, 32, 32)))
         assert out.shape == (2, 7)
+
+
+def test_model_save_inference_export(tmp_path):
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu import jit as jit_mod
+    pt.seed(0)
+    net = pt.nn.Sequential(pt.nn.Linear(8, 4), pt.nn.ReLU(),
+                           pt.nn.Dropout(0.5), pt.nn.Linear(4, 2))
+    model = pt.hapi.Model(net)
+    path = str(tmp_path / "served")
+    model.save(path, training=False,
+               input_spec=[jit_mod.InputSpec([None, 8], "float32")])
+    assert net.training  # mode restored after export
+    loaded = jit_mod.load(path)
+    x = jnp.ones((3, 8))
+    out = loaded(x)
+    assert out.shape == (3, 2)
+    # dropout was exported in eval mode: deterministic
+    np.testing.assert_allclose(np.asarray(out), np.asarray(loaded(x)))
