@@ -126,8 +126,14 @@ def test_mp_speedup_on_parse_heavy_dataset():
 
     t_mp = run(4)  # warm start: fork is cheap, but measure mp first is
     t_serial = run(0)  # unfair to serial; order avoids cold-cache bias
-    # 4 workers on parse-heavy data must beat serial by a clear margin
-    assert t_mp < t_serial * 0.8, (t_serial, t_mp)
+    if (os.cpu_count() or 1) >= 2:
+        # 4 workers on parse-heavy data must beat serial clearly
+        assert t_mp < t_serial * 0.8, (t_serial, t_mp)
+    else:
+        # single-core box (CI): parallel speedup is physically
+        # impossible — only require that process workers aren't
+        # pathologically slower than serial (transport overhead bound)
+        assert t_mp < t_serial * 2.0, (t_serial, t_mp)
 
 
 def test_mp_worker_death_raises():
