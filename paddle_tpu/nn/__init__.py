@@ -27,6 +27,7 @@ from .layers.loss import (BCELoss, BCEWithLogitsLoss, CTCLoss,
                           CosineEmbeddingLoss, CrossEntropyLoss, KLDivLoss,
                           L1Loss, MSELoss, MarginRankingLoss, NLLLoss,
                           SmoothL1Loss, TripletMarginLoss)
+from .layers.moe import MoELayer, moe_param_rule  # noqa: F401
 from .layers.rnn import (GRU, GRUCell, LSTM, LSTMCell, RNN, SimpleRNN,
                          SimpleRNNCell)
 from .layers.transformer import (MultiHeadAttention, Transformer,

@@ -1,0 +1,130 @@
+"""Mixture-of-Experts layer with expert parallelism.
+
+The reference predates MoE (SURVEY §2.8 marks EP "absent in this
+reference; cheap extension under pjit"), but the capability class it
+covers — sharding a huge parameter space across devices, the role its
+PS sharded embeddings play — is idiomatic on TPU as an expert-parallel
+einsum: experts live stacked on a leading [E, ...] axis sharded over
+the mesh's "ep" axis, tokens are dispatched densely with a capacity
+limit (one-hot einsum — static shapes, MXU-friendly), and XLA inserts
+the all-to-alls from the sharding annotations (the same mechanism the
+reference's NCCL graph passes hand-build).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dtype import get_default_dtype
+from .. import initializer as I
+from ..layer import Layer, Parameter
+
+__all__ = ["MoELayer", "moe_param_rule"]
+
+
+class MoELayer(Layer):
+    """Top-k gated MoE FFN (Switch/GShard style).
+
+    x [B, T, D] → gate picks top_k of num_experts per token; each
+    expert is a 2-layer FFN with stacked weights [E, D, H]/[E, H, D].
+    Dense dispatch with ``capacity_factor``: each expert processes at
+    most ceil(tokens/E * cf) tokens, overflow tokens are dropped
+    (standard GShard semantics; keeps every shape static for XLA).
+    """
+
+    def __init__(self, d_model: int, d_hidden: int, num_experts: int,
+                 top_k: int = 2, capacity_factor: float = 1.25,
+                 activation: str = "gelu") -> None:
+        super().__init__()
+        self.d_model = d_model
+        self.d_hidden = d_hidden
+        self.num_experts = num_experts
+        self.top_k = min(top_k, num_experts)
+        self.capacity_factor = capacity_factor
+        dtype = get_default_dtype()
+        init = I.XavierUniform()
+        self.gate_weight = Parameter(
+            init((d_model, num_experts), dtype))
+        self.w_in = Parameter(init((num_experts, d_model, d_hidden),
+                                   dtype))
+        self.b_in = Parameter(jnp.zeros((num_experts, d_hidden), dtype))
+        self.w_out = Parameter(init((num_experts, d_hidden, d_model),
+                                    dtype))
+        self.b_out = Parameter(jnp.zeros((num_experts, d_model), dtype))
+        # threaded out through functional_call's buffer capture (a plain
+        # attribute would leak a tracer under jit); to TRAIN with it,
+        # return it from your model and add weight*aux in loss_fn
+        self.register_buffer("aux_loss", jnp.zeros((), jnp.float32))
+        from ...ops import activation as A
+        self._act = getattr(A, activation)
+
+    def forward(self, x):
+        b, t, d = x.shape
+        n_tok = b * t
+        e = self.num_experts
+        cap = max(1, math.ceil(
+            self.capacity_factor * n_tok * self.top_k / e))
+        tokens = x.reshape(n_tok, d)
+
+        logits = tokens @ self.gate_weight  # [N, E]
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        top_p, top_e = jax.lax.top_k(probs, self.top_k)  # [N, k]
+
+        # position of each (token, choice) within its expert's queue:
+        # count prior assignments to the same expert (GShard cumsum)
+        choice_onehot = jax.nn.one_hot(top_e, e, dtype=jnp.float32)
+        # flatten choices in priority order: all k=0 choices first
+        flat = choice_onehot.transpose(1, 0, 2).reshape(
+            self.top_k * n_tok, e)
+        pos_flat = jnp.cumsum(flat, axis=0) - flat  # prior count
+        position = (pos_flat * flat).sum(-1).reshape(
+            self.top_k, n_tok).transpose(1, 0)  # [N, k]
+        keep = position < cap
+
+        pos_onehot = jax.nn.one_hot(position, cap,
+                                    dtype=jnp.float32)  # [N, k, C]
+        # dispatch[n, e, c] = Σ_k choice[n,k,e]·keep[n,k]·pos[n,k,c]
+        dispatch = jnp.einsum("nke,nk,nkc->nec", choice_onehot,
+                              keep.astype(jnp.float32), pos_onehot)
+
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch,
+                               tokens.astype(jnp.float32))
+        expert_in = expert_in.astype(x.dtype)  # [E, C, D]
+        h = self._act(jnp.einsum("ecd,edh->ech", expert_in, self.w_in)
+                      + self.b_in[:, None])
+        out = jnp.einsum("ech,ehd->ecd", h, self.w_out) \
+            + self.b_out[:, None]  # [E, C, D]
+
+        gates = (top_p * keep).astype(jnp.float32)  # [N, k]
+        combine = jnp.einsum("nke,nk,nkc->nec", choice_onehot, gates,
+                             pos_onehot)
+        y = jnp.einsum("nec,ecd->nd", combine,
+                       out.astype(jnp.float32)).astype(x.dtype)
+
+        # load-balance auxiliary loss (GShard): mean gate prob x mean
+        # assignment fraction per expert, scaled by E
+        frac_tokens = choice_onehot[:, 0].mean(axis=0)  # top-1 fraction
+        mean_prob = probs.mean(axis=0)
+        self.aux_loss = e * jnp.sum(frac_tokens * mean_prob)
+        return y.reshape(b, t, d)
+
+
+def moe_param_rule(ep_axis: str = "ep"):
+    """param_rule for ShardedTrainStep: shard the stacked expert
+    dimension over the ep mesh axis (XLA turns the dispatch/combine
+    einsums into all-to-alls across it)."""
+    from jax.sharding import PartitionSpec as P
+
+    def rule(name: str, v) -> P:
+        shape = getattr(v, "shape", ())
+        leaf = name.split(".")[-1]
+        if leaf in ("w_in", "w_out", "b_in", "b_out") \
+                and len(shape) >= 2:
+            return P(ep_axis, *([None] * (len(shape) - 1)))
+        return P()
+
+    return rule

@@ -1,0 +1,114 @@
+"""Mixture-of-Experts with expert parallelism (SURVEY §2.8 EP
+extension; GShard-style dense dispatch)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu.nn import MoELayer, moe_param_rule
+from paddle_tpu.parallel import ShardedTrainStep, create_mesh
+from paddle_tpu.static import TrainStep
+
+
+class MoENet(pt.nn.Layer):
+    def __init__(self, d=16, h=32, e=4, classes=4):
+        super().__init__()
+        self.embed = pt.nn.Linear(8, d)
+        self.moe = MoELayer(d, h, num_experts=e, top_k=2,
+                            capacity_factor=2.0)
+        self.head = pt.nn.Linear(d, classes)
+
+    def forward(self, x):
+        h = self.embed(x)
+        h = h + self.moe(h)
+        return self.head(h.mean(axis=1))
+
+
+def _data(rng, n=32):
+    x = rng.normal(0, 1, (n, 6, 8)).astype(np.float32)
+    y = rng.integers(0, 4, (n,)).astype(np.int64)
+    return x, y
+
+
+def test_moe_forward_and_combine_weights():
+    pt.seed(0)
+    layer = MoELayer(8, 16, num_experts=2, top_k=1, capacity_factor=4.0)
+    x = jnp.asarray(np.random.default_rng(0).normal(0, 1, (2, 3, 8)),
+                    jnp.float32)
+    y = layer(x)
+    assert y.shape == (2, 3, 8)
+    assert np.isfinite(np.asarray(y)).all()
+    assert float(layer.aux_loss) > 0.0
+
+
+def test_moe_trains_single_device():
+    pt.seed(0)
+    net = MoENet()
+    step = TrainStep(net, pt.optimizer.Adam(3e-3),
+                     lambda o, t: pt.nn.functional.cross_entropy(o, t))
+    rng = np.random.default_rng(0)
+    x, y = _data(rng)
+    losses = [float(step(x, labels=y)["loss"]) for _ in range(25)]
+    assert losses[-1] < losses[0], losses[::8]
+
+
+def test_moe_expert_parallel_mesh():
+    mesh = create_mesh({"dp": 2, "ep": 4})
+    pt.seed(0)
+    net = MoENet(e=4)
+    step = ShardedTrainStep(
+        net, pt.optimizer.Adam(3e-3),
+        lambda o, t: pt.nn.functional.cross_entropy(o, t),
+        mesh, batch_spec=P("dp"), param_rule=moe_param_rule("ep"))
+    # expert weights actually sharded over ep
+    spec = step.state_specs["params"]["moe.w_in"]
+    assert spec == P("ep", None, None)
+    rng = np.random.default_rng(0)
+    x, y = _data(rng)
+    losses = [float(step(x, labels=y)["loss"]) for _ in range(10)]
+    assert losses[-1] < losses[0]
+
+
+def test_moe_ep_matches_single_device():
+    rng = np.random.default_rng(0)
+    x, y = _data(rng, n=16)
+    loss_fn = lambda o, t: pt.nn.functional.cross_entropy(o, t)
+
+    pt.seed(0)
+    net1 = MoENet(e=4)
+    s1 = TrainStep(net1, pt.optimizer.SGD(0.05), loss_fn)
+    l1 = [float(s1(x, labels=y)["loss"]) for _ in range(5)]
+
+    mesh = create_mesh({"dp": 1, "ep": 4}, devices=jax.devices()[:4])
+    pt.seed(0)
+    net2 = MoENet(e=4)
+    s2 = ShardedTrainStep(net2, pt.optimizer.SGD(0.05), loss_fn, mesh,
+                          batch_spec=P("dp"),
+                          param_rule=moe_param_rule("ep"))
+    l2 = [float(s2(x, labels=y)["loss"]) for _ in range(5)]
+    np.testing.assert_allclose(l1, l2, rtol=2e-4)
+
+
+def test_moe_aux_loss_is_buffer_not_leaked_tracer():
+    pt.seed(0)
+    net = MoENet()
+    step = TrainStep(net, pt.optimizer.Adam(1e-3),
+                     lambda o, t: pt.nn.functional.cross_entropy(o, t))
+    rng = np.random.default_rng(0)
+    x, y = _data(rng, n=8)
+    step(x, labels=y)
+    # aux loss rode out through the buffer capture: concrete & finite
+    aux = step.state["buffers"]["moe.aux_loss"]
+    v = float(aux)
+    assert np.isfinite(v) and v > 0.0
+
+
+def test_moe_param_rule_no_substring_false_positive():
+    from jax.sharding import PartitionSpec as P
+    rule = moe_param_rule("ep")
+    class V:  # 2-D non-expert weight whose name contains 'b_in'
+        shape = (16, 8)
+    assert rule("emb_in.weight", V()) == P()
+    assert rule("moe.w_in", V()) == P("ep", None)
