@@ -133,6 +133,22 @@ int64_t pt_ps_sparse_size(int64_t h, const char* name);
 int pt_ps_save(int64_t h, const char* path);
 int pt_ps_load(int64_t h, const char* path);
 
+// ---------------- inference serving transport ----------------
+// Native TCP front for the serving engine (serving.cc): framed
+// request/reply with pipelining, bounded queue with backpressure. The
+// payload is an opaque tensor codec owned by paddle_tpu/inference.
+int64_t pt_srv_start(int port, int queue_cap);
+int pt_srv_port(int64_t h);
+void pt_srv_stop(int64_t h);
+// Dequeue one request into buf: returns payload length, -1 timeout, -2
+// cap too small (request stays queued), 0 if stopping and drained.
+int64_t pt_srv_next(int64_t h, int timeout_ms, uint64_t* req_id,
+                    uint8_t* buf, int64_t cap);
+// Reply to a dequeued request. 0 ok, -1 unknown id, -3 client gone.
+int pt_srv_reply(int64_t h, uint64_t req_id, int64_t status,
+                 const uint8_t* data, int64_t len);
+int64_t pt_srv_pending(int64_t h);
+
 // ---------------- monitor ----------------
 void pt_mon_add(const char* name, int64_t v);
 int64_t pt_mon_get(const char* name);

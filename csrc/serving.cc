@@ -1,0 +1,316 @@
+// Native inference-serving transport: TCP accept loop, length-framed
+// request/reply protocol, bounded request queue with backpressure, and
+// per-connection ordered reply channels.
+//
+// This is the TPU framework's analogue of the reference's native serving
+// front (the C++ AnalysisPredictor service surface,
+// /root/reference/paddle/fluid/inference/api/analysis_predictor.cc:1, and
+// its demo servers under inference/api/demo_ci). The split is TPU-first:
+// the native side owns everything the reference's C++ owns that still
+// makes sense off-device — sockets, framing, admission control, batching
+// queues — while tensor execution stays in the XLA-compiled serving
+// module (paddle_tpu/inference). Requests are opaque byte payloads here;
+// the tensor codec lives next to the runtime that consumes it.
+//
+// Wire protocol, little-endian:
+//   client -> server:  u32 magic 'PTSV' | u64 tag | u32 len | payload
+//   server -> client:  u64 tag | i64 status | u32 len | payload
+// A connection may pipeline many tagged requests; replies carry the tag
+// and may arrive out of order (the Python batcher decides scheduling).
+
+#include "ptnative.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x56535450;  // "PTSV"
+// Hard cap on a single request payload: a corrupt/malicious length must
+// fail the request, not drive an unchecked allocation (same rule as the
+// PS dispatch validation).
+constexpr uint32_t kMaxPayload = 256u * 1024u * 1024u;
+
+bool ReadFull(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool WriteFull(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct Conn {
+  int fd;
+  std::mutex write_mu;  // replies from multiple batches interleave
+  std::atomic<bool> alive{true};
+
+  explicit Conn(int f) : fd(f) {}
+  ~Conn() {
+    if (fd >= 0) ::close(fd);
+  }
+};
+
+struct Request {
+  uint64_t id;  // server-assigned, returned to Python
+  uint64_t tag;  // client-assigned, echoed in the reply
+  std::shared_ptr<Conn> conn;
+  std::string payload;
+};
+
+class Server {
+ public:
+  explicit Server(int queue_cap) : queue_cap_(queue_cap) {}
+
+  bool Start(int port) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return false;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0 ||
+        ::listen(listen_fd_, 128) != 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return false;
+    }
+    socklen_t len = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    port_ = ntohs(addr.sin_port);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return true;
+  }
+
+  void Stop() {
+    stopping_.store(true);
+    if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      for (auto& c : conns_) {
+        c->alive.store(false);
+        ::shutdown(c->fd, SHUT_RDWR);
+      }
+      cv_.notify_all();
+      space_cv_.notify_all();
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    for (auto& t : conn_threads_) {
+      if (t.joinable()) t.join();
+    }
+    if (listen_fd_ >= 0) ::close(listen_fd_);
+    listen_fd_ = -1;
+  }
+
+  int port() const { return port_; }
+
+  // Dequeue one request into buf. Returns payload length, or -1 on
+  // timeout, -2 if cap is too small (request is left queued), 0 if the
+  // server is stopping and the queue is drained.
+  int64_t Next(int timeout_ms, uint64_t* req_id, uint8_t* buf, int64_t cap) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (!cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms), [this] {
+          return !queue_.empty() || stopping_.load();
+        })) {
+      return -1;
+    }
+    if (queue_.empty()) return stopping_.load() ? 0 : -1;
+    Request& r = queue_.front();
+    if (static_cast<int64_t>(r.payload.size()) > cap) return -2;
+    *req_id = r.id;
+    std::memcpy(buf, r.payload.data(), r.payload.size());
+    int64_t n = static_cast<int64_t>(r.payload.size());
+    inflight_.emplace(r.id, InFlight{r.tag, r.conn});
+    queue_.pop_front();
+    space_cv_.notify_one();
+    return n;
+  }
+
+  // Send a framed reply for a dequeued request. 0 ok, -1 unknown id,
+  // -3 the client connection is gone (reply dropped).
+  int Reply(uint64_t req_id, int64_t status, const uint8_t* data,
+            int64_t len) {
+    InFlight inf;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = inflight_.find(req_id);
+      if (it == inflight_.end()) return -1;
+      inf = it->second;
+      inflight_.erase(it);
+    }
+    if (!inf.conn->alive.load()) return -3;
+    uint8_t hdr[8 + 8 + 4];
+    std::memcpy(hdr, &inf.tag, 8);
+    std::memcpy(hdr + 8, &status, 8);
+    uint32_t l = static_cast<uint32_t>(len);
+    std::memcpy(hdr + 16, &l, 4);
+    std::lock_guard<std::mutex> wl(inf.conn->write_mu);
+    if (!WriteFull(inf.conn->fd, hdr, sizeof(hdr)) ||
+        (len > 0 && !WriteFull(inf.conn->fd, data, len))) {
+      inf.conn->alive.store(false);
+      return -3;
+    }
+    return 0;
+  }
+
+  int64_t Pending() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return static_cast<int64_t>(queue_.size());
+  }
+
+ private:
+  struct InFlight {
+    uint64_t tag;
+    std::shared_ptr<Conn> conn;
+  };
+
+  void AcceptLoop() {
+    while (!stopping_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (stopping_.load()) break;
+        continue;
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto conn = std::make_shared<Conn>(fd);
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        conns_.push_back(conn);
+        conn_threads_.emplace_back([this, conn] { ConnLoop(conn); });
+      }
+    }
+  }
+
+  void ConnLoop(std::shared_ptr<Conn> conn) {
+    while (!stopping_.load() && conn->alive.load()) {
+      uint8_t hdr[4 + 8 + 4];
+      if (!ReadFull(conn->fd, hdr, sizeof(hdr))) break;
+      uint32_t magic, len;
+      uint64_t tag;
+      std::memcpy(&magic, hdr, 4);
+      std::memcpy(&tag, hdr + 4, 8);
+      std::memcpy(&len, hdr + 12, 4);
+      if (magic != kMagic || len > kMaxPayload) break;  // corrupt stream
+      std::string payload(len, '\0');
+      if (len > 0 && !ReadFull(conn->fd, payload.data(), len)) break;
+      std::unique_lock<std::mutex> lk(mu_);
+      // Backpressure: block the reading side when the queue is full, so
+      // a flood degrades to TCP flow control instead of unbounded memory.
+      space_cv_.wait(lk, [this] {
+        return static_cast<int>(queue_.size()) < queue_cap_ ||
+               stopping_.load();
+      });
+      if (stopping_.load()) break;
+      queue_.push_back(Request{next_id_++, tag, conn, std::move(payload)});
+      cv_.notify_one();
+    }
+    conn->alive.store(false);
+  }
+
+  int listen_fd_ = -1;
+  int port_ = 0;
+  int queue_cap_;
+  std::atomic<bool> stopping_{false};
+  std::thread accept_thread_;
+  std::vector<std::thread> conn_threads_;
+  std::vector<std::shared_ptr<Conn>> conns_;
+
+  std::mutex mu_;
+  std::condition_variable cv_;        // queue has work
+  std::condition_variable space_cv_;  // queue has space
+  std::deque<Request> queue_;
+  std::map<uint64_t, InFlight> inflight_;
+  uint64_t next_id_ = 1;
+};
+
+std::mutex g_mu;
+std::map<int64_t, std::unique_ptr<Server>> g_servers;
+int64_t g_next = 1;
+
+Server* Get(int64_t h) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_servers.find(h);
+  return it == g_servers.end() ? nullptr : it->second.get();
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t pt_srv_start(int port, int queue_cap) {
+  auto srv = std::make_unique<Server>(queue_cap > 0 ? queue_cap : 256);
+  if (!srv->Start(port)) return -1;
+  std::lock_guard<std::mutex> lk(g_mu);
+  int64_t h = g_next++;
+  g_servers[h] = std::move(srv);
+  return h;
+}
+
+int pt_srv_port(int64_t h) {
+  Server* s = Get(h);
+  return s ? s->port() : -1;
+}
+
+void pt_srv_stop(int64_t h) {
+  std::unique_ptr<Server> srv;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    auto it = g_servers.find(h);
+    if (it == g_servers.end()) return;
+    srv = std::move(it->second);
+    g_servers.erase(it);
+  }
+  srv->Stop();
+}
+
+int64_t pt_srv_next(int64_t h, int timeout_ms, uint64_t* req_id,
+                    uint8_t* buf, int64_t cap) {
+  Server* s = Get(h);
+  if (!s) return -1;
+  return s->Next(timeout_ms, req_id, buf, cap);
+}
+
+int pt_srv_reply(int64_t h, uint64_t req_id, int64_t status,
+                 const uint8_t* data, int64_t len) {
+  Server* s = Get(h);
+  if (!s) return -1;
+  return s->Reply(req_id, status, data, len);
+}
+
+int64_t pt_srv_pending(int64_t h) {
+  Server* s = Get(h);
+  return s ? s->Pending() : -1;
+}
+
+}  // extern "C"

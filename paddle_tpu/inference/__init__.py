@@ -1,0 +1,507 @@
+"""Inference engine: Config/Predictor serving of exported modules.
+
+TPU-native rebuild of the reference's inference stack
+(/root/reference/paddle/fluid/inference/api/analysis_predictor.cc:1,
+paddle_api.h ZeroCopyTensor, analysis_config.h AnalysisConfig, and the
+Python surface in python/paddle/fluid/inference/__init__.py). The
+architecture is inverted the TPU way:
+
+- The reference loads a ProgramDesc, runs analysis/IR passes (fusion,
+  memory optim, TRT subgraphs), then interprets the optimized graph with
+  a NaiveExecutor. Here the artifact IS the optimized program — a
+  serialized StableHLO module from ``jit.save`` — and XLA performs every
+  analysis pass at compile time. ``Config.switch_ir_optim`` therefore
+  gates jit re-compilation caching, not a pass pipeline.
+- ZeroCopyTensor's job (feed/fetch without extra copies) maps to keeping
+  weights and outputs device-resident: input handles stage host arrays,
+  outputs stay on device until ``copy_to_cpu``.
+- Dynamic shapes are served the TPU way: the leading (batch) dim is
+  exported polymorphically, and the predictor pads each run up to a
+  shape *bucket* so XLA compiles once per bucket instead of once per
+  batch size (the analogue of the reference's TRT dynamic-shape
+  profiles, analysis_config.h EnableTensorRtEngine min/max/opt shapes).
+
+The native serving front (socket transport, framing, bounded queues)
+lives in csrc/serving.cc; :class:`Server` here is the compute half.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["Config", "PrecisionType", "Predictor", "create_predictor",
+           "Tensor", "Server", "Client"]
+
+
+class PrecisionType:
+    """(ref: paddle_api.h PaddlePrecision) kInt8/kHalf map to the TPU's
+    native low-precision types."""
+    Float32 = "float32"
+    Half = "bfloat16"       # TPU half-precision is bf16
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
+class Config:
+    """Predictor configuration (ref: analysis_config.h AnalysisConfig).
+
+    ``model_dir`` must hold a ``jit.save`` artifact (params/ +
+    module.bin + meta.json).
+    """
+
+    def __init__(self, model_dir: str):
+        self.model_dir = model_dir
+        self._ir_optim = True
+        self._memory_optim = True
+        self._profile = False
+        self._precision = PrecisionType.Float32
+        self._max_batch_size = 64
+        self._batch_buckets: Optional[List[int]] = None
+        self._device = None  # default jax backend
+
+    # -- parity surface (reference names) --------------------------------
+    def switch_ir_optim(self, on: bool = True) -> None:
+        """On TPU "IR optimization" is XLA compilation caching per shape
+        bucket; off forces eager per-exact-shape execution."""
+        self._ir_optim = bool(on)
+
+    def enable_memory_optim(self, on: bool = True) -> None:
+        self._memory_optim = bool(on)
+
+    def enable_profile(self) -> None:
+        self._profile = True
+
+    def set_precision(self, p: str) -> None:
+        self._precision = p
+
+    def set_max_batch_size(self, n: int) -> None:
+        self._max_batch_size = int(n)
+
+    def set_batch_buckets(self, sizes: Sequence[int]) -> None:
+        """Explicit bucket ladder; default is powers of two up to
+        max_batch_size."""
+        self._batch_buckets = sorted(int(s) for s in sizes)
+
+    def disable_glog_info(self) -> None:  # parity no-op
+        pass
+
+    def batch_buckets(self) -> List[int]:
+        if self._batch_buckets:
+            return self._batch_buckets
+        out, b = [], 1
+        while b < self._max_batch_size:
+            out.append(b)
+            b *= 2
+        out.append(self._max_batch_size)
+        return out
+
+
+class Tensor:
+    """Input/output handle (ref: paddle_api.h ZeroCopyTensor).
+
+    Inputs: ``copy_from_cpu`` stages a host array. Outputs: the value is
+    device-resident until ``copy_to_cpu``.
+    """
+
+    def __init__(self, name: str, spec_shape: Tuple, dtype: str):
+        self.name = name
+        self._spec_shape = tuple(spec_shape)
+        self._dtype = dtype
+        self._value = None
+
+    def copy_from_cpu(self, arr) -> None:
+        arr = np.asarray(arr)
+        for have, want in zip(arr.shape[1:], self._spec_shape[1:]):
+            if want is not None and have != want:
+                raise ValueError(
+                    f"input {self.name}: shape {arr.shape} does not match "
+                    f"spec {self._spec_shape}")
+        self._value = arr
+
+    def reshape(self, shape) -> None:
+        if self._value is not None:
+            self._value = np.reshape(self._value, shape)
+
+    def copy_to_cpu(self):
+        if self._value is None:
+            raise ValueError(f"tensor {self.name} has no value")
+        return np.asarray(self._value)
+
+    @property
+    def shape(self):
+        return None if self._value is None else tuple(self._value.shape)
+
+
+class Predictor:
+    """Serving executor over a ``jit.save`` artifact
+    (ref: analysis_predictor.cc AnalysisPredictor::Run/ZeroCopyRun).
+
+    Compiles the exported StableHLO once per shape bucket and keeps
+    weights device-resident. ``clone()`` shares weights and the compile
+    cache (the reference's predictor Clone shares the scope for exactly
+    this reason: analysis_predictor.cc:~900).
+    """
+
+    def __init__(self, config: Config, _shared=None):
+        import jax
+
+        self.config = config
+        if _shared is not None:
+            (self._exported, self._params, self._buffers, self._meta,
+             self._jit_call, self._run_lock) = _shared
+        else:
+            from .. import jit as jit_mod
+            tl = jit_mod.load(config.model_dir)
+            self._exported = tl._exported
+            self._meta = tl.meta
+            # device-resident, shared across clones
+            self._params = jax.tree.map(jax.numpy.asarray, tl._params)
+            self._buffers = jax.tree.map(jax.numpy.asarray, tl._buffers)
+            exported = self._exported
+
+            def call(params, buffers, *args):
+                return exported.call(params, buffers, *args)
+
+            # jax.jit caches one executable per concrete input shape —
+            # with bucketing this is one compile per bucket.
+            self._jit_call = jax.jit(call)
+            self._run_lock = threading.Lock()
+        specs = self._meta["input_spec"]
+        self._inputs = [
+            Tensor(s.get("name", f"x{i}"),
+                   tuple(s["shape"]), s["dtype"])
+            for i, s in enumerate(specs)]
+        self._poly_batch = [s["shape"] and s["shape"][0] is None
+                            for s in specs]
+        self._outputs: List[Tensor] = []
+        self._n_runs = 0
+
+    # -- reference API ---------------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return [t.name for t in self._inputs]
+
+    def get_input_handle(self, name: str) -> Tensor:
+        for t in self._inputs:
+            if t.name == name:
+                return t
+        raise KeyError(name)
+
+    get_input_tensor = get_input_handle
+
+    def get_output_names(self) -> List[str]:
+        return [t.name for t in self._outputs]
+
+    def get_output_handle(self, name: str) -> Tensor:
+        for t in self._outputs:
+            if t.name == name:
+                return t
+        raise KeyError(name)
+
+    get_output_tensor = get_output_handle
+
+    def run(self, inputs: Optional[Sequence] = None):
+        """Execute. Either pass arrays positionally or stage them on the
+        input handles first (zero-copy style). Returns host arrays (and
+        also populates the output handles)."""
+        if inputs is not None:
+            for t, a in zip(self._inputs, inputs):
+                t.copy_from_cpu(a)
+        args = [t._value for t in self._inputs]
+        if any(a is None for a in args):
+            missing = [t.name for t in self._inputs if t._value is None]
+            raise ValueError(f"inputs not set: {missing}")
+        t0 = time.perf_counter()
+        outs = self._run_batched(args)
+        self._n_runs += 1
+        if self.config._profile:
+            from ..native import stat_add
+            stat_add("inference.runs", 1)
+            stat_add("inference.us", int((time.perf_counter() - t0) * 1e6))
+        outs_list = list(outs) if isinstance(outs, (tuple, list)) else [outs]
+        self._outputs = []
+        for i, o in enumerate(outs_list):
+            t = Tensor(f"out{i}", tuple(o.shape), str(o.dtype))
+            t._value = o
+            self._outputs.append(t)
+        return [np.asarray(o) for o in outs_list]
+
+    zero_copy_run = run
+
+    def _run_batched(self, args):
+        import jax.numpy as jnp
+
+        batch = args[0].shape[0] if (args and self._poly_batch
+                                     and self._poly_batch[0]) else None
+        pad_to = None
+        if (batch is not None and self.config._ir_optim
+                and all(self._poly_batch)):
+            for b in self.config.batch_buckets():
+                if b >= batch:
+                    pad_to = b
+                    break
+        if pad_to is not None and pad_to != batch:
+            # repeat the final row: inert padding for any pointwise or
+            # row-wise head (zeros can still NaN under 1/x-style heads)
+            padded = []
+            for a in args:
+                reps = np.repeat(a[-1:], pad_to - a.shape[0], axis=0)
+                padded.append(np.concatenate([a, reps], axis=0))
+            args = padded
+        jargs = [jnp.asarray(a) for a in args]
+        with self._run_lock:
+            outs = self._jit_call(self._params, self._buffers, *jargs)
+        if pad_to is not None and pad_to != batch:
+            outs = _slice_leading(outs, batch)
+        return outs
+
+    def clone(self) -> "Predictor":
+        return Predictor(self.config,
+                         _shared=(self._exported, self._params,
+                                  self._buffers, self._meta, self._jit_call,
+                                  self._run_lock))
+
+
+def _slice_leading(outs, n):
+    import jax
+
+    def cut(o):
+        return o[:n] if hasattr(o, "shape") and o.ndim >= 1 else o
+
+    return jax.tree.map(cut, outs)
+
+
+def create_predictor(config: Config) -> Predictor:
+    """(ref: paddle_infer::CreatePredictor / create_paddle_predictor)."""
+    return Predictor(config)
+
+
+# ------------------------------------------------------------------ codec
+# Tensor payload codec for the native serving transport. Little-endian:
+#   u32 n_tensors | per tensor:
+#     u8 dtype_code | u8 ndim | u32 dims[ndim] | u64 nbytes | raw bytes
+
+_DTYPES = ["float32", "float64", "int32", "int64", "uint8", "bool",
+           "bfloat16", "float16", "int8", "uint32", "uint64", "int16"]
+
+
+def _np_dtype(code: int):
+    name = _DTYPES[code]
+    if name == "bfloat16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def _dtype_code(dt) -> int:
+    return _DTYPES.index(str(np.dtype(dt)))
+
+
+def encode_tensors(arrays: Sequence[np.ndarray]) -> bytes:
+    parts = [struct.pack("<I", len(arrays))]
+    for a in arrays:
+        # NOT ascontiguousarray: it promotes 0-d arrays to 1-d
+        a = np.asarray(a, order="C")
+        raw = a.tobytes()
+        parts.append(struct.pack("<BB", _dtype_code(a.dtype), a.ndim))
+        parts.append(struct.pack(f"<{a.ndim}I", *a.shape))
+        parts.append(struct.pack("<Q", len(raw)))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def decode_tensors(buf: bytes) -> List[np.ndarray]:
+    (n,) = struct.unpack_from("<I", buf, 0)
+    off = 4
+    out = []
+    for _ in range(n):
+        code, ndim = struct.unpack_from("<BB", buf, off)
+        off += 2
+        dims = struct.unpack_from(f"<{ndim}I", buf, off)
+        off += 4 * ndim
+        (nbytes,) = struct.unpack_from("<Q", buf, off)
+        off += 8
+        dt = _np_dtype(code)
+        a = np.frombuffer(buf, dtype=dt, count=nbytes // dt.itemsize,
+                          offset=off).reshape(dims)
+        out.append(a.copy())
+        off += nbytes
+    return out
+
+
+# ----------------------------------------------------------------- server
+
+class Server:
+    """Dynamic-batching inference server: native transport in C++
+    (csrc/serving.cc), XLA execution here.
+
+    Groups concurrently-arriving requests with the same per-row
+    signature, concatenates them along the batch dim, runs ONE bucketed
+    predictor call, and scatters the replies (the role the reference
+    delegates to external serving on top of AnalysisPredictor; here it
+    is in-framework because static shapes make batching the unit of
+    efficiency on TPU).
+    """
+
+    def __init__(self, predictor: Predictor, port: int = 0,
+                 max_batch: int = 32, wait_ms: int = 2,
+                 queue_cap: int = 512):
+        from ..native import ServingTransport
+
+        self.predictor = predictor
+        self.max_batch = max_batch
+        self.wait_ms = wait_ms
+        self.transport = ServingTransport(port=port, queue_cap=queue_cap)
+        self.port = self.transport.port
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self.n_batches = 0
+        self.n_requests = 0
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            first = self.transport.next_request(timeout_ms=100)
+            if first is None:
+                continue
+            group = [first]
+            deadline = time.perf_counter() + self.wait_ms / 1e3
+            while len(group) < self.max_batch:
+                left = deadline - time.perf_counter()
+                if left <= 0 and self.transport.pending() == 0:
+                    break
+                nxt = self.transport.next_request(
+                    timeout_ms=max(1, int(left * 1e3)))
+                if nxt is None:
+                    break
+                group.append(nxt)
+            self._serve_group(group)
+
+    def _serve_group(self, group) -> None:
+        decoded = []
+        for rid, payload in group:
+            try:
+                decoded.append((rid, decode_tensors(payload)))
+            except Exception as e:  # noqa: BLE001
+                self.transport.reply(rid, str(e).encode(), status=-1)
+        # group by per-row signature (shape minus batch dim + dtypes)
+        sigs: Dict[Tuple, List[Tuple[int, List[np.ndarray]]]] = {}
+        for rid, arrs in decoded:
+            sig = tuple((a.shape[1:], str(a.dtype)) for a in arrs)
+            sigs.setdefault(sig, []).append((rid, arrs))
+        for batch_members in sigs.values():
+            rows = [m[1][0].shape[0] for m in batch_members]
+            try:
+                joined = [np.concatenate([m[1][i] for m in batch_members],
+                                         axis=0)
+                          for i in range(len(batch_members[0][1]))]
+                outs = self.predictor.run(joined)
+                self.n_batches += 1
+                off = 0
+                for (rid, _), r in zip(batch_members, rows):
+                    part = [o[off:off + r] for o in outs]
+                    self.transport.reply(rid, encode_tensors(part))
+                    off += r
+                    self.n_requests += 1
+            except Exception as e:  # noqa: BLE001
+                for rid, _ in batch_members:
+                    self.transport.reply(rid, str(e).encode(), status=-1)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.transport.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Client:
+    """Socket client of the native serving protocol (tests and the
+    reference's demo_ci role). Thread-safe; supports pipelining."""
+
+    _MAGIC = 0x56535450
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 timeout_s: float = 30.0):
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._wlock = threading.Lock()
+        self._rlock = threading.Lock()
+        self._tag = 0
+        self._replies: Dict[int, Tuple[int, bytes]] = {}
+        self._rcond = threading.Condition()
+
+    def infer(self, arrays: Sequence[np.ndarray]) -> List[np.ndarray]:
+        tag = self._send(arrays)
+        status, payload = self._recv(tag)
+        if status != 0:
+            raise RuntimeError(f"server error: {payload.decode()!r}")
+        return decode_tensors(payload)
+
+    def _send(self, arrays) -> int:
+        payload = encode_tensors(arrays)
+        with self._wlock:
+            self._tag += 1
+            tag = self._tag
+            hdr = struct.pack("<IQI", self._MAGIC, tag, len(payload))
+            self._sock.sendall(hdr + payload)
+        return tag
+
+    def _recv(self, want_tag: int) -> Tuple[int, bytes]:
+        # One thread at a time owns the socket read side (_rlock) and
+        # parks frames for the others; non-owners wait on the condition.
+        while True:
+            with self._rcond:
+                if want_tag in self._replies:
+                    return self._replies.pop(want_tag)
+            if not self._rlock.acquire(blocking=False):
+                with self._rcond:
+                    if want_tag in self._replies:
+                        return self._replies.pop(want_tag)
+                    self._rcond.wait(timeout=0.05)
+                continue
+            try:
+                with self._rcond:
+                    if want_tag in self._replies:
+                        return self._replies.pop(want_tag)
+                hdr = self._read_exact(8 + 8 + 4)
+                tag, status, n = struct.unpack("<QqI", hdr)
+                payload = self._read_exact(n) if n else b""
+                with self._rcond:
+                    self._replies[tag] = (status, payload)
+                    self._rcond.notify_all()
+            finally:
+                self._rlock.release()
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("server closed connection")
+            buf += chunk
+        return buf
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -261,7 +261,9 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None) -> None:
         "platforms": list(exported.platforms),
         "input_spec": [{"shape": [None if s is None else int(s)
                                   for s in sp.shape],
-                        "dtype": str(sp.dtype)} for sp in specs],
+                        "dtype": str(sp.dtype),
+                        "name": sp.name or f"x{i}"}
+                       for i, sp in enumerate(specs)],
     }
     with open(os.path.join(path, "meta.json"), "w") as f:
         json.dump(meta, f, indent=1)

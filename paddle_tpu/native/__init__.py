@@ -137,6 +137,14 @@ def _load():
             "pt_ps_sparse_size": ([c.c_int64, c.c_char_p], c.c_int64),
             "pt_ps_save": ([c.c_int64, c.c_char_p], c.c_int),
             "pt_ps_load": ([c.c_int64, c.c_char_p], c.c_int),
+            "pt_srv_start": ([c.c_int, c.c_int], c.c_int64),
+            "pt_srv_port": ([c.c_int64], c.c_int),
+            "pt_srv_stop": ([c.c_int64], None),
+            "pt_srv_next": ([c.c_int64, c.c_int, c.POINTER(c.c_uint64),
+                             c.POINTER(c.c_uint8), c.c_int64], c.c_int64),
+            "pt_srv_reply": ([c.c_int64, c.c_uint64, c.c_int64,
+                              c.POINTER(c.c_uint8), c.c_int64], c.c_int),
+            "pt_srv_pending": ([c.c_int64], c.c_int64),
             "pt_mon_add": ([c.c_char_p, c.c_int64], None),
             "pt_mon_get": ([c.c_char_p], c.c_int64),
             "pt_mon_reset": ([c.c_char_p], None),
@@ -525,6 +533,65 @@ class PsClient:
 
     def __exit__(self, *exc):
         self.close()
+
+
+# ----------------------------------------------------------- serving transport
+
+class ServingTransport:
+    """Native TCP front of the inference server (csrc/serving.cc).
+
+    Owns the sockets, framing, and the bounded request queue; the Python
+    side (paddle_tpu.inference.Server) dequeues payloads, runs the
+    XLA-compiled serving module, and posts replies by request id.
+    """
+
+    def __init__(self, port: int = 0, queue_cap: int = 256,
+                 max_payload: int = 64 << 20):
+        lib = _load()
+        self._h = lib.pt_srv_start(port, queue_cap)
+        if self._h < 0:
+            raise RuntimeError(f"serving transport failed on port {port}")
+        self.port = lib.pt_srv_port(self._h)
+        self._buf = (ctypes.c_uint8 * max_payload)()
+        self._max_payload = max_payload
+
+    def next_request(self, timeout_ms: int = 100
+                     ) -> Optional[Tuple[int, bytes]]:
+        """One (req_id, payload), or None on timeout/shutdown."""
+        rid = ctypes.c_uint64(0)
+        n = _load().pt_srv_next(self._h, timeout_ms, ctypes.byref(rid),
+                                self._buf, self._max_payload)
+        if n == -2:
+            raise RuntimeError(
+                f"request exceeds max_payload={self._max_payload}")
+        if n <= 0:
+            return None
+        return rid.value, bytes(bytearray(self._buf[:n]))
+
+    def reply(self, req_id: int, payload: bytes, status: int = 0) -> None:
+        buf = (ctypes.c_uint8 * max(1, len(payload))).from_buffer_copy(
+            payload or b"\0")
+        _load().pt_srv_reply(self._h, req_id, status, buf, len(payload))
+
+    def pending(self) -> int:
+        return _load().pt_srv_pending(self._h)
+
+    def stop(self) -> None:
+        if self._h > 0:
+            _load().pt_srv_stop(self._h)
+            self._h = -1
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
 
 
 # --------------------------------------------------------------------- monitor

@@ -1,0 +1,172 @@
+"""Inference engine: Config/Predictor/clone, batch bucketing, tensor
+codec, and the native dynamic-batching server end to end.
+
+Models the reference's inference tests
+(/root/reference/paddle/fluid/inference/api/analysis_predictor_tester.cc,
+api_impl_tester.cc: create predictor, feed ZeroCopyTensors, Run, clone
+and run concurrently)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import jit
+from paddle_tpu import nn
+from paddle_tpu.inference import (Client, Config, Predictor, Server,
+                                  create_predictor, decode_tensors,
+                                  encode_tensors)
+
+
+class _Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 3)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("inf") / "model")
+    pt.seed(7)
+    net = _Net()
+    jit.save(net, d, input_spec=[jit.InputSpec([None, 8], name="feats")])
+    x = np.random.default_rng(0).normal(size=(5, 8)).astype(np.float32)
+    want = np.asarray(net(x))
+    return d, x, want
+
+
+def test_predictor_matches_eager(artifact):
+    d, x, want = artifact
+    pred = create_predictor(Config(d))
+    assert pred.get_input_names() == ["feats"]
+    h = pred.get_input_handle("feats")
+    h.copy_from_cpu(x)
+    outs = pred.run()
+    np.testing.assert_allclose(outs[0], want, rtol=1e-5, atol=1e-5)
+    # output handles populated (ZeroCopyTensor-style fetch)
+    got = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_batch_bucketing_pads_and_slices(artifact):
+    d, x, want = artifact
+    cfg = Config(d)
+    cfg.set_batch_buckets([4, 8, 64])
+    pred = create_predictor(cfg)
+    # batch 5 -> padded to bucket 8, sliced back to 5
+    outs = pred.run([x])
+    assert outs[0].shape == (5, 3)
+    np.testing.assert_allclose(outs[0], want, rtol=1e-5, atol=1e-5)
+    # a second, different batch size within the same bucket: no recompile
+    outs3 = pred.run([x[:3]])
+    assert outs3[0].shape == (3, 3)
+    np.testing.assert_allclose(outs3[0], want[:3], rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_rejects_bad_row_shape(artifact):
+    d, x, _ = artifact
+    pred = create_predictor(Config(d))
+    with pytest.raises(ValueError):
+        pred.get_input_handle("feats").copy_from_cpu(
+            np.zeros((2, 9), np.float32))
+
+
+def test_clone_shares_weights(artifact):
+    d, x, want = artifact
+    pred = create_predictor(Config(d))
+    clone = pred.clone()
+    assert clone._params is pred._params  # shared device weights
+    outs = clone.run([x])
+    np.testing.assert_allclose(outs[0], want, rtol=1e-5, atol=1e-5)
+
+
+def test_codec_roundtrip():
+    import ml_dtypes
+    arrays = [
+        np.arange(12, dtype=np.float32).reshape(3, 4),
+        np.array([[1, 2], [3, 4]], dtype=np.int64),
+        np.array([True, False, True]),
+        np.arange(6, dtype=np.float32).astype(ml_dtypes.bfloat16),
+        np.float32(3.5).reshape(()),  # 0-d
+    ]
+    out = decode_tensors(encode_tensors(arrays))
+    assert len(out) == len(arrays)
+    for a, b in zip(arrays, out):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b, np.float32))
+
+
+def test_server_end_to_end(artifact):
+    d, x, want = artifact
+    pred = create_predictor(Config(d))
+    with Server(pred, max_batch=8, wait_ms=20) as srv:
+        with Client(port=srv.port) as cli:
+            outs = cli.infer([x])
+            np.testing.assert_allclose(outs[0], want, rtol=1e-5, atol=1e-5)
+
+
+def test_server_batches_concurrent_requests(artifact):
+    d, x, want = artifact
+    pred = create_predictor(Config(d))
+    with Server(pred, max_batch=16, wait_ms=100) as srv:
+        n_clients = 6
+        results = [None] * n_clients
+        errs = []
+
+        def worker(i):
+            try:
+                with Client(port=srv.port) as cli:
+                    rows = 1 + (i % 3)
+                    out = cli.infer([x[:rows]])[0]
+                    results[i] = (rows, out)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errs
+        for i, (rows, out) in enumerate(results):
+            assert out.shape == (rows, 3)
+            np.testing.assert_allclose(out, want[:rows], rtol=1e-5,
+                                       atol=1e-5)
+        # batching actually merged concurrent work
+        assert srv.n_requests == n_clients
+        assert srv.n_batches < n_clients
+
+
+def test_server_reports_bad_request(artifact):
+    d, x, _ = artifact
+    pred = create_predictor(Config(d))
+    with Server(pred, wait_ms=1) as srv:
+        with Client(port=srv.port) as cli:
+            with pytest.raises(RuntimeError, match="server error"):
+                cli.infer([np.zeros((2, 9), np.float32)])
+
+
+def test_client_pipelining(artifact):
+    d, x, want = artifact
+    pred = create_predictor(Config(d))
+    with Server(pred, max_batch=8, wait_ms=10) as srv:
+        with Client(port=srv.port) as cli:
+            # several threads share one connection
+            outs = [None] * 4
+            def go(i):
+                outs[i] = cli.infer([x[: i + 1]])[0]
+            ts = [threading.Thread(target=go, args=(i,)) for i in range(4)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=60)
+            for i in range(4):
+                np.testing.assert_allclose(outs[i], want[: i + 1],
+                                           rtol=1e-5, atol=1e-5)
