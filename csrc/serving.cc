@@ -125,9 +125,12 @@ class Server {
       space_cv_.notify_all();
     }
     if (accept_thread_.joinable()) accept_thread_.join();
+    // The accept thread is gone, so conn_threads_ can no longer grow;
+    // join without mu_ (the conn threads themselves take mu_ to exit).
     for (auto& t : conn_threads_) {
-      if (t.joinable()) t.join();
+      if (t.first.joinable()) t.first.join();
     }
+    conn_threads_.clear();
     if (listen_fd_ >= 0) ::close(listen_fd_);
     listen_fd_ = -1;
   }
@@ -135,25 +138,45 @@ class Server {
   int port() const { return port_; }
 
   // Dequeue one request into buf. Returns payload length, or -1 on
-  // timeout, -2 if cap is too small (request is left queued), 0 if the
-  // server is stopping and the queue is drained.
+  // timeout, 0 if the server is stopping and the queue is drained. A
+  // request larger than cap is popped and answered with an error frame
+  // (status -2) so it can never wedge the queue head; the scan then
+  // continues to the next request.
   int64_t Next(int timeout_ms, uint64_t* req_id, uint8_t* buf, int64_t cap) {
-    std::unique_lock<std::mutex> lk(mu_);
-    if (!cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms), [this] {
-          return !queue_.empty() || stopping_.load();
-        })) {
-      return -1;
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    for (;;) {
+      InFlight oversized;
+      uint64_t oversized_id = 0;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        if (!cv_.wait_until(lk, deadline, [this] {
+              return !queue_.empty() || stopping_.load();
+            })) {
+          return -1;
+        }
+        if (queue_.empty()) return stopping_.load() ? 0 : -1;
+        Request& r = queue_.front();
+        int64_t n = static_cast<int64_t>(r.payload.size());
+        if (n <= cap) {
+          *req_id = r.id;
+          std::memcpy(buf, r.payload.data(), r.payload.size());
+          inflight_.emplace(r.id, InFlight{r.tag, r.conn});
+          queue_.pop_front();
+          space_cv_.notify_one();
+          return n;
+        }
+        oversized = InFlight{r.tag, r.conn};
+        oversized_id = r.id;
+        inflight_.emplace(oversized_id, oversized);
+        queue_.pop_front();
+        space_cv_.notify_one();
+      }
+      // Error-reply outside mu_ (Reply re-takes it).
+      static const char kMsg[] = "request exceeds server max_payload";
+      Reply(oversized_id, -2, reinterpret_cast<const uint8_t*>(kMsg),
+            sizeof(kMsg) - 1);
     }
-    if (queue_.empty()) return stopping_.load() ? 0 : -1;
-    Request& r = queue_.front();
-    if (static_cast<int64_t>(r.payload.size()) > cap) return -2;
-    *req_id = r.id;
-    std::memcpy(buf, r.payload.data(), r.payload.size());
-    int64_t n = static_cast<int64_t>(r.payload.size());
-    inflight_.emplace(r.id, InFlight{r.tag, r.conn});
-    queue_.pop_front();
-    space_cv_.notify_one();
-    return n;
   }
 
   // Send a framed reply for a dequeued request. 0 ok, -1 unknown id,
@@ -204,10 +227,40 @@ class Server {
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       auto conn = std::make_shared<Conn>(fd);
+      auto done = std::make_shared<std::atomic<bool>>(false);
       {
         std::lock_guard<std::mutex> lk(mu_);
+        ReapLocked();
         conns_.push_back(conn);
-        conn_threads_.emplace_back([this, conn] { ConnLoop(conn); });
+        conn_threads_.emplace_back(
+            std::thread([this, conn, done] {
+              ConnLoop(conn);
+              done->store(true);
+            }),
+            done);
+      }
+    }
+  }
+
+  // Join finished connection threads and drop dead Conns. Long-lived
+  // servers churn through many short client connections; without this
+  // both vectors grow for the server's lifetime. Caller holds mu_.
+  void ReapLocked() {
+    for (auto it = conn_threads_.begin(); it != conn_threads_.end();) {
+      if (it->second->load()) {
+        if (it->first.joinable()) it->first.join();
+        it = conn_threads_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    for (auto it = conns_.begin(); it != conns_.end();) {
+      // use_count 1 = only our bookkeeping holds it (no thread, no
+      // queued request, no inflight reply)
+      if (!(*it)->alive.load() && it->use_count() == 1) {
+        it = conns_.erase(it);
+      } else {
+        ++it;
       }
     }
   }
@@ -243,7 +296,8 @@ class Server {
   int queue_cap_;
   std::atomic<bool> stopping_{false};
   std::thread accept_thread_;
-  std::vector<std::thread> conn_threads_;
+  std::vector<std::pair<std::thread, std::shared_ptr<std::atomic<bool>>>>
+      conn_threads_;
   std::vector<std::shared_ptr<Conn>> conns_;
 
   std::mutex mu_;
@@ -255,13 +309,16 @@ class Server {
 };
 
 std::mutex g_mu;
-std::map<int64_t, std::unique_ptr<Server>> g_servers;
+// shared_ptr, not unique_ptr: pt_srv_stop may race a thread still blocked
+// inside Next/Reply; each C entry point holds a reference for the call so
+// the Server outlives any in-flight use (Stop wakes the waiters first).
+std::map<int64_t, std::shared_ptr<Server>> g_servers;
 int64_t g_next = 1;
 
-Server* Get(int64_t h) {
+std::shared_ptr<Server> Get(int64_t h) {
   std::lock_guard<std::mutex> lk(g_mu);
   auto it = g_servers.find(h);
-  return it == g_servers.end() ? nullptr : it->second.get();
+  return it == g_servers.end() ? nullptr : it->second;
 }
 
 }  // namespace
@@ -269,7 +326,7 @@ Server* Get(int64_t h) {
 extern "C" {
 
 int64_t pt_srv_start(int port, int queue_cap) {
-  auto srv = std::make_unique<Server>(queue_cap > 0 ? queue_cap : 256);
+  auto srv = std::make_shared<Server>(queue_cap > 0 ? queue_cap : 256);
   if (!srv->Start(port)) return -1;
   std::lock_guard<std::mutex> lk(g_mu);
   int64_t h = g_next++;
@@ -278,12 +335,12 @@ int64_t pt_srv_start(int port, int queue_cap) {
 }
 
 int pt_srv_port(int64_t h) {
-  Server* s = Get(h);
+  auto s = Get(h);
   return s ? s->port() : -1;
 }
 
 void pt_srv_stop(int64_t h) {
-  std::unique_ptr<Server> srv;
+  std::shared_ptr<Server> srv;
   {
     std::lock_guard<std::mutex> lk(g_mu);
     auto it = g_servers.find(h);
@@ -296,20 +353,20 @@ void pt_srv_stop(int64_t h) {
 
 int64_t pt_srv_next(int64_t h, int timeout_ms, uint64_t* req_id,
                     uint8_t* buf, int64_t cap) {
-  Server* s = Get(h);
+  auto s = Get(h);
   if (!s) return -1;
   return s->Next(timeout_ms, req_id, buf, cap);
 }
 
 int pt_srv_reply(int64_t h, uint64_t req_id, int64_t status,
                  const uint8_t* data, int64_t len) {
-  Server* s = Get(h);
+  auto s = Get(h);
   if (!s) return -1;
   return s->Reply(req_id, status, data, len);
 }
 
 int64_t pt_srv_pending(int64_t h) {
-  Server* s = Get(h);
+  auto s = Get(h);
   return s ? s->Pending() : -1;
 }
 

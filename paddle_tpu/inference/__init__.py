@@ -119,6 +119,10 @@ class Tensor:
 
     def copy_from_cpu(self, arr) -> None:
         arr = np.asarray(arr)
+        if arr.ndim != len(self._spec_shape):
+            raise ValueError(
+                f"input {self.name}: rank {arr.ndim} does not match spec "
+                f"{self._spec_shape}")
         for have, want in zip(arr.shape[1:], self._spec_shape[1:]):
             if want is not None and have != want:
                 raise ValueError(
@@ -352,13 +356,14 @@ class Server:
 
     def __init__(self, predictor: Predictor, port: int = 0,
                  max_batch: int = 32, wait_ms: int = 2,
-                 queue_cap: int = 512):
+                 queue_cap: int = 512, max_payload: int = 64 << 20):
         from ..native import ServingTransport
 
         self.predictor = predictor
         self.max_batch = max_batch
         self.wait_ms = wait_ms
-        self.transport = ServingTransport(port=port, queue_cap=queue_cap)
+        self.transport = ServingTransport(port=port, queue_cap=queue_cap,
+                                          max_payload=max_payload)
         self.port = self.transport.port
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -382,13 +387,25 @@ class Server:
                 if nxt is None:
                     break
                 group.append(nxt)
-            self._serve_group(group)
+            try:
+                self._serve_group(group)
+            except Exception:  # noqa: BLE001
+                # One bad batch must not kill the serving loop; members
+                # that were not yet answered time out client-side.
+                import traceback
+                traceback.print_exc()
 
     def _serve_group(self, group) -> None:
         decoded = []
         for rid, payload in group:
             try:
-                decoded.append((rid, decode_tensors(payload)))
+                arrs = decode_tensors(payload)
+                # batching concatenates along dim 0: every tensor needs one
+                if not arrs or any(a.ndim == 0 for a in arrs):
+                    raise ValueError(
+                        "request must carry >=1 tensors, each with a "
+                        "leading batch dim")
+                decoded.append((rid, arrs))
             except Exception as e:  # noqa: BLE001
                 self.transport.reply(rid, str(e).encode(), status=-1)
         # group by per-row signature (shape minus batch dim + dtypes)
@@ -397,8 +414,8 @@ class Server:
             sig = tuple((a.shape[1:], str(a.dtype)) for a in arrs)
             sigs.setdefault(sig, []).append((rid, arrs))
         for batch_members in sigs.values():
-            rows = [m[1][0].shape[0] for m in batch_members]
             try:
+                rows = [m[1][0].shape[0] for m in batch_members]
                 joined = [np.concatenate([m[1][i] for m in batch_members],
                                          axis=0)
                           for i in range(len(batch_members[0][1]))]

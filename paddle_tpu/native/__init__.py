@@ -557,16 +557,15 @@ class ServingTransport:
 
     def next_request(self, timeout_ms: int = 100
                      ) -> Optional[Tuple[int, bytes]]:
-        """One (req_id, payload), or None on timeout/shutdown."""
+        """One (req_id, payload), or None on timeout/shutdown.
+        Requests above max_payload are error-replied by the native side
+        and never surface here."""
         rid = ctypes.c_uint64(0)
         n = _load().pt_srv_next(self._h, timeout_ms, ctypes.byref(rid),
                                 self._buf, self._max_payload)
-        if n == -2:
-            raise RuntimeError(
-                f"request exceeds max_payload={self._max_payload}")
         if n <= 0:
             return None
-        return rid.value, bytes(bytearray(self._buf[:n]))
+        return rid.value, ctypes.string_at(self._buf, n)
 
     def reply(self, req_id: int, payload: bytes, status: int = 0) -> None:
         buf = (ctypes.c_uint8 * max(1, len(payload))).from_buffer_copy(
