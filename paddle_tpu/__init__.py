@@ -33,8 +33,8 @@ from .ops import *  # noqa: F401,F403
 from .ops import sparse
 from .tensor import Tensor, to_tensor
 
-from . import amp, data, datasets, hapi, inference, io, jit, metric, nn, \
-    optimizer
+from . import amp, data, datasets, distribution, hapi, inference, io, \
+    jit, layers, metric, nn, optimizer
 from . import utils, vision  # noqa: F401
 from . import parallel
 from . import static

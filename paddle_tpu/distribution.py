@@ -1,0 +1,210 @@
+"""Probability distributions (ref: /root/reference/python/paddle/
+fluid/layers/distributions.py:1 — Uniform/Normal/Categorical/
+MultivariateNormalDiag — re-exported as paddle.distribution).
+
+TPU-native redesign: the reference emits graph ops per method call
+(sample builds uniform_random ops etc.); here every method is a pure
+jnp computation, so distributions compose under jit/grad/vmap — log_prob
+of a sampled trajectory differentiates through reparameterized samples
+for free (the reference has no reparameterization story).
+
+Broadcasting follows the loc/scale convention: all parameters broadcast
+against each other, and ``sample(shape)`` prepends ``shape``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core import random as _random
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical",
+           "MultivariateNormalDiag", "kl_divergence"]
+
+
+def _asarray(x, dtype=jnp.float32):
+    return jnp.asarray(x, dtype)
+
+
+def _key(key):
+    return key if key is not None else _random.next_key("random")
+
+
+class Distribution:
+    """Abstract base (ref: distributions.py Distribution)."""
+
+    def sample(self, shape: Sequence[int] = (), key=None):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other: "Distribution"):
+        raise NotImplementedError
+
+    def probs(self, value):
+        return jnp.exp(self.log_prob(value))
+
+
+class Uniform(Distribution):
+    """U(low, high) (ref: distributions.py Uniform).
+
+    sample uses reparameterization (low + (high-low)*u) so gradients flow
+    to the bounds.
+    """
+
+    def __init__(self, low, high):
+        self.low = _asarray(low)
+        self.high = _asarray(high)
+
+    def sample(self, shape: Sequence[int] = (), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.low.shape,
+                                                    self.high.shape)
+        u = jax.random.uniform(_key(key), shape)
+        return self.low + (self.high - self.low) * u
+
+    def log_prob(self, value):
+        value = _asarray(value)
+        inside = (value >= self.low) & (value < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return jnp.where(inside, lp, -jnp.inf)
+
+    def entropy(self):
+        return jnp.log(self.high - self.low)
+
+    def kl_divergence(self, other: "Distribution"):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    """N(loc, scale) (ref: distributions.py Normal)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _asarray(loc)
+        self.scale = _asarray(scale)
+
+    def sample(self, shape: Sequence[int] = (), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        eps = jax.random.normal(_key(key), shape)
+        return self.loc + self.scale * eps
+
+    def log_prob(self, value):
+        value = _asarray(value)
+        var = self.scale ** 2
+        return (-((value - self.loc) ** 2) / (2 * var)
+                - jnp.log(self.scale) - 0.5 * np.log(2 * np.pi))
+
+    def entropy(self):
+        return 0.5 + 0.5 * np.log(2 * np.pi) + jnp.log(
+            jnp.broadcast_to(self.scale,
+                             jnp.broadcast_shapes(self.loc.shape,
+                                                  self.scale.shape)))
+
+    def kl_divergence(self, other: "Distribution"):
+        return kl_divergence(self, other)
+
+
+class Categorical(Distribution):
+    """Categorical over the last axis of ``logits``
+    (ref: distributions.py Categorical)."""
+
+    def __init__(self, logits):
+        self.logits = _asarray(logits)
+        self._log_p = jax.nn.log_softmax(self.logits, axis=-1)
+
+    @property
+    def probs_param(self):
+        return jnp.exp(self._log_p)
+
+    def sample(self, shape: Sequence[int] = (), key=None):
+        return jax.random.categorical(_key(key), self.logits,
+                                      shape=tuple(shape)
+                                      + self.logits.shape[:-1])
+
+    def log_prob(self, value):
+        value = jnp.asarray(value, jnp.int32)
+        return jnp.take_along_axis(self._log_p, value[..., None],
+                                   axis=-1)[..., 0]
+
+    def entropy(self):
+        p = jnp.exp(self._log_p)
+        return -jnp.sum(p * self._log_p, axis=-1)
+
+    def kl_divergence(self, other: "Distribution"):
+        return kl_divergence(self, other)
+
+
+class MultivariateNormalDiag(Distribution):
+    """N(loc, diag(scale)) with event dim = last axis
+    (ref: distributions.py MultivariateNormalDiag)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _asarray(loc)
+        self.scale = _asarray(scale)  # diagonal std, same shape as loc
+
+    @property
+    def _dim(self):
+        return self.loc.shape[-1]
+
+    def sample(self, shape: Sequence[int] = (), key=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        eps = jax.random.normal(_key(key), shape)
+        return self.loc + self.scale * eps
+
+    def log_prob(self, value):
+        value = _asarray(value)
+        z = (value - self.loc) / self.scale
+        return (-0.5 * jnp.sum(z ** 2, axis=-1)
+                - jnp.sum(jnp.log(self.scale), axis=-1)
+                - 0.5 * self._dim * np.log(2 * np.pi))
+
+    def entropy(self):
+        return (0.5 * self._dim * (1 + np.log(2 * np.pi))
+                + jnp.sum(jnp.log(
+                    jnp.broadcast_to(self.scale, jnp.broadcast_shapes(
+                        self.loc.shape, self.scale.shape))), axis=-1))
+
+    def kl_divergence(self, other: "Distribution"):
+        return kl_divergence(self, other)
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    """KL(p||q) for matched families (ref: distributions.py kl pairs)."""
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_ratio = (p.scale / q.scale) ** 2
+        t1 = ((p.loc - q.loc) / q.scale) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+    if (isinstance(p, MultivariateNormalDiag)
+            and isinstance(q, MultivariateNormalDiag)):
+        var_ratio = (p.scale / q.scale) ** 2
+        t1 = ((p.loc - q.loc) / q.scale) ** 2
+        return 0.5 * jnp.sum(var_ratio + t1 - 1 - jnp.log(var_ratio),
+                             axis=-1)
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        pp = jnp.exp(p._log_p)
+        return jnp.sum(pp * (p._log_p - q._log_p), axis=-1)
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        # supp(p) must lie inside supp(q) for finite KL
+        inside = (q.low <= p.low) & (p.high <= q.high)
+        kl = jnp.log((q.high - q.low) / (p.high - p.low))
+        return jnp.where(inside, kl, jnp.inf)
+    if isinstance(p, Uniform) and isinstance(q, Normal):
+        # E_p[log p] - E_p[log q], closed form over [a,b]
+        a, b = p.low, p.high
+        m2 = (b ** 3 - a ** 3) / (3 * (b - a))  # E[x^2]
+        mean = (a + b) / 2
+        elogq = (-0.5 * np.log(2 * np.pi) - jnp.log(q.scale)
+                 - (m2 - 2 * q.loc * mean + q.loc ** 2)
+                 / (2 * q.scale ** 2))
+        return -p.entropy() - elogq
+    raise NotImplementedError(
+        f"kl_divergence({type(p).__name__}, {type(q).__name__})")

@@ -32,7 +32,7 @@ from .control_flow import (case, cond, fori_loop, scan,  # noqa: F401
                            static_rnn, switch_case, while_loop)
 from .sequence import *  # noqa: F401,F403
 from .metrics_ops import (accuracy, auc_from_stats,  # noqa: F401
-                          auc_stats, positive_negative_pair,
+                          auc_stats, mean_iou, positive_negative_pair,
                           precision_recall_stats)
 from .sparse import RowSlices, embedding_grad, merge_rows  # noqa: F401
 from .sparse import scatter_apply, to_dense  # noqa: F401

@@ -621,3 +621,330 @@ def roi_perspective_transform(feat, rois, transformed_height: int,
         return _bilinear_sample(feat[bidx], ys, xs)  # [C, th, tw]
 
     return jax.vmap(one_roi)(rois.astype(jnp.float32), roi_batch_indices)
+
+
+def matrix_nms(bboxes, scores, score_threshold: float = 0.05,
+               post_threshold: float = 0.0, nms_top_k: int = 100,
+               keep_top_k: int = 100, use_gaussian: bool = False,
+               gaussian_sigma: float = 2.0, normalized: bool = True):
+    """Matrix NMS (ref: matrix_nms_op.cc — parallel soft suppression via
+    the pairwise IoU matrix; unlike NMSFast there is no sequential loop,
+    which is exactly the TPU-friendly formulation).
+
+    bboxes: [N, 4]; scores: [C, N]. Returns (out [keep_top_k, 6]
+    (cls, score, x1, y1, x2, y2), valid [keep_top_k] bool).
+    """
+    c, n = scores.shape
+    k = min(nms_top_k, n)
+
+    def one_class(cls_idx, cls_scores):
+        s, order = lax.top_k(cls_scores, k)
+        b = bboxes[order]
+        iou = iou_similarity(b, b, box_normalized=normalized)
+        # strict upper triangle in score order: upper[i, j] = IoU of box j
+        # with the better box i (i < j), 0 elsewhere
+        rows = jnp.arange(k)
+        upper = jnp.where(rows[:, None] < rows[None, :], iou, 0.0)
+        # compensate[i]: how much box i itself overlaps its betters —
+        # its own decay denominator (SOLOv2 matrix-NMS formula)
+        compensate = jnp.max(upper, axis=0)
+        num = _decay(upper, use_gaussian, gaussian_sigma)      # [k, k]
+        den = _decay(compensate, use_gaussian, gaussian_sigma)  # [k]
+        ratio = num / jnp.maximum(den[:, None], 1e-12)
+        # only i<j rows participate in the min over i
+        ratio = jnp.where(rows[:, None] < rows[None, :], ratio, jnp.inf)
+        decay = jnp.minimum(jnp.min(ratio, axis=0), 1.0)  # j=0 -> 1
+        new_s = jnp.where(s > score_threshold, s * decay, 0.0)
+        new_s = jnp.where(new_s > post_threshold, new_s, 0.0)
+        cls_col = jnp.full((k, 1), cls_idx, jnp.float32)
+        return jnp.concatenate([cls_col, new_s[:, None], b], axis=1)
+
+    per_class = jnp.concatenate(
+        [one_class(ci, scores[ci]) for ci in range(c)], axis=0)
+    topk = min(keep_top_k, per_class.shape[0])
+    best_s, best_i = lax.top_k(per_class[:, 1], topk)
+    out = per_class[best_i]
+    if topk < keep_top_k:
+        out = jnp.pad(out, ((0, keep_top_k - topk), (0, 0)))
+        best_s = jnp.pad(best_s, (0, keep_top_k - topk))
+    return out, best_s > 0
+
+
+def _decay(iou, use_gaussian: bool, sigma: float):
+    if use_gaussian:
+        return jnp.exp(-(iou ** 2) / sigma)
+    return 1.0 - iou
+
+
+def locality_aware_nms(boxes, scores, iou_threshold: float = 0.3,
+                       score_threshold: float = 0.0, max_out: int = 100):
+    """(ref: locality_aware_nms_op.cc — EAST text detection: first merge
+    consecutive overlapping boxes by score-weighted averaging, then
+    standard NMS)."""
+    n = boxes.shape[0]
+
+    def merge_step(carry, inp):
+        cur_box, cur_score, have = carry
+        box, score = inp
+        iou = iou_similarity(cur_box[None], box[None])[0, 0]
+        do_merge = have & (iou >= iou_threshold)
+        w1, w2 = cur_score, score
+        merged = (cur_box * w1 + box * w2) / jnp.maximum(w1 + w2, 1e-12)
+        out_box = jnp.where(have & ~do_merge, cur_box, 0.0)
+        out_score = jnp.where(have & ~do_merge, cur_score, -jnp.inf)
+        new_box = jnp.where(do_merge, merged, box)
+        new_score = jnp.where(do_merge, w1 + w2, score)
+        return (new_box, new_score, jnp.asarray(True)), (out_box, out_score)
+
+    (last_box, last_score, have), (mboxes, mscores) = lax.scan(
+        merge_step, (jnp.zeros((4,), boxes.dtype), jnp.float32(-jnp.inf),
+                     jnp.asarray(False)), (boxes, scores))
+    mboxes = jnp.concatenate([mboxes, last_box[None]], axis=0)
+    mscores = jnp.concatenate([mscores, last_score[None]], axis=0)
+    return nms(mboxes, mscores, iou_threshold, score_threshold, max_out) \
+        + (mboxes, mscores)
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, post_nms_top_n: int):
+    """(ref: collect_fpn_proposals_op.cc) concat per-level proposals and
+    keep the global top-N by score. Returns (rois [N,4], scores [N])."""
+    rois = jnp.concatenate(multi_rois, axis=0)
+    scores = jnp.concatenate(multi_scores, axis=0)
+    k = min(post_nms_top_n, scores.shape[0])
+    top_s, top_i = lax.top_k(scores, k)
+    return rois[top_i], top_s
+
+
+def target_assign(x, match_indices, neg_indices=None, mismatch_value=0.0):
+    """(ref: target_assign_op.cc) gather per-prior targets by match index;
+    unmatched (index<0) entries get mismatch_value, weight 0.
+
+    x: [M, K] entity targets; match_indices: [B, P] (ours is per-batch
+    pre-flattened: [P]) -> (out [P, K], out_weight [P, 1]).
+    """
+    mi = jnp.asarray(match_indices, jnp.int32)
+    matched = mi >= 0
+    safe = jnp.maximum(mi, 0)
+    out = jnp.where(matched[..., None], x[safe], mismatch_value)
+    w = matched.astype(jnp.float32)[..., None]
+    if neg_indices is not None:
+        neg_mask = jnp.zeros(mi.shape, bool).at[neg_indices].set(True)
+        w = jnp.maximum(w, neg_mask.astype(jnp.float32)[..., None])
+    return out, w
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_boxes,
+             prior_box_var=None, background_label: int = 0,
+             overlap_threshold: float = 0.5, neg_pos_ratio: float = 3.0,
+             loc_loss_weight: float = 1.0, conf_loss_weight: float = 1.0):
+    """SSD multibox loss (ref: python/paddle/fluid/layers/detection.py
+    ssd_loss — orchestration of iou/bipartite_match/target_assign +
+    smooth-L1 & softmax losses, with hard negative mining).
+
+    location [B, P, 4], confidence [B, P, C], gt_box [B, G, 4] (0-padded),
+    gt_label [B, G] (−1 padding), prior_boxes [P, 4]. Dense-padded
+    redesign of the reference's LoD inputs; mining keeps a static
+    negative count per image (neg_pos_ratio × positives, rank-selected).
+    """
+    from .loss import smooth_l1_loss
+    b, p, ccls = confidence.shape
+
+    def one_image(loc, conf, gts, lbls):
+        valid_gt = lbls >= 0
+        iou = iou_similarity(gts, prior_boxes)          # [G, P]
+        iou = jnp.where(valid_gt[:, None], iou, -1.0)
+        # per-prior best gt + bipartite guarantee for each gt's argmax
+        best_gt = jnp.argmax(iou, axis=0)               # [P]
+        best_iou = jnp.max(iou, axis=0)
+        matched = best_iou >= overlap_threshold
+        gt_best_prior = jnp.argmax(iou, axis=1)         # [G]
+        # invalid gts are routed out of range and dropped — a plain
+        # .set() with duplicate indices would let an invalid gt's write
+        # (all share argmax 0) clobber the valid one
+        write_at = jnp.where(valid_gt, gt_best_prior, p)
+        matched = matched.at[write_at].set(True, mode="drop")
+        best_gt = best_gt.at[write_at].set(
+            jnp.arange(gts.shape[0]), mode="drop")
+        # localization targets: encode each prior's matched gt against it
+        # (pairwise box_coder would be [G,P,4]; only the diagonal of the
+        # match is needed, so encode directly)
+        mg = gts[best_gt]                                # [P, 4]
+        pw = prior_boxes[:, 2] - prior_boxes[:, 0]
+        ph = prior_boxes[:, 3] - prior_boxes[:, 1]
+        pcx = prior_boxes[:, 0] + 0.5 * pw
+        pcy = prior_boxes[:, 1] + 0.5 * ph
+        gw = mg[:, 2] - mg[:, 0]
+        gh = mg[:, 3] - mg[:, 1]
+        var = (prior_box_var if prior_box_var is not None
+               else jnp.ones((4,), loc.dtype))
+        enc = jnp.stack(
+            [(mg[:, 0] + 0.5 * gw - pcx) / jnp.maximum(pw, 1e-9) / var[0],
+             (mg[:, 1] + 0.5 * gh - pcy) / jnp.maximum(ph, 1e-9) / var[1],
+             jnp.log(jnp.maximum(gw / jnp.maximum(pw, 1e-9), 1e-9))
+             / var[2],
+             jnp.log(jnp.maximum(gh / jnp.maximum(ph, 1e-9), 1e-9))
+             / var[3]], axis=-1)
+        loc_l = jnp.sum(smooth_l1_loss(loc, enc, reduction="none"), -1)
+        loc_loss = jnp.sum(jnp.where(matched, loc_l, 0.0))
+        # classification: positives -> gt label, negatives -> background
+        tgt = jnp.where(matched, lbls[best_gt], background_label)
+        logp = jax.nn.log_softmax(conf, axis=-1)
+        conf_l = -jnp.take_along_axis(logp, tgt[:, None], axis=1)[:, 0]
+        n_pos = jnp.sum(matched)
+        n_neg = jnp.minimum((neg_pos_ratio * n_pos).astype(jnp.int32),
+                            p - n_pos)
+        neg_cand = jnp.where(matched, -jnp.inf, conf_l)
+        order = jnp.argsort(-neg_cand)
+        neg_rank = jnp.zeros((p,), jnp.int32).at[order].set(jnp.arange(p))
+        neg_sel = (~matched) & (neg_rank < n_neg)
+        conf_loss = jnp.sum(jnp.where(matched | neg_sel, conf_l, 0.0))
+        denom = jnp.maximum(n_pos, 1).astype(loc.dtype)
+        return (loc_loss_weight * loc_loss
+                + conf_loss_weight * conf_loss) / denom
+
+    return jax.vmap(one_image)(location, confidence, gt_box, gt_label)
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors: Sequence[int],
+                anchor_mask: Sequence[int], class_num: int,
+                ignore_thresh: float = 0.7, downsample_ratio: int = 32,
+                gt_score=None, use_label_smooth: bool = False):
+    """YOLOv3 training loss for one detection head
+    (ref: yolov3_loss_op.cc / yolov3_loss_op.h).
+
+    x: [B, M*(5+C), H, W]; gt_box: [B, G, 4] (cx,cy,w,h in [0,1] image
+    units, 0-padded); gt_label: [B, G]. Per-cell responsibility follows
+    the reference: each gt is assigned to the best-IoU anchor over ALL
+    anchors; the loss trains only anchors in this head's mask;
+    objectness negatives above ignore_thresh vs any gt are ignored.
+    """
+    b, _, h, w = x.shape
+    m = len(anchor_mask)
+    x = x.reshape(b, m, 5 + class_num, h, w)
+    an_all = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)  # [A, 2] px
+    input_size = downsample_ratio * h
+
+    tx, ty = x[:, :, 0], x[:, :, 1]
+    tw, th = x[:, :, 2], x[:, :, 3]
+    tobj = x[:, :, 4]
+    tcls = x[:, :, 5:]
+
+    gy, gx = jnp.meshgrid(jnp.arange(h, dtype=jnp.float32),
+                          jnp.arange(w, dtype=jnp.float32), indexing="ij")
+    mask_an = an_all[jnp.asarray(anchor_mask)]          # [M, 2]
+    # predicted boxes in image units (for the ignore mask)
+    px = (jax.nn.sigmoid(tx) + gx) / w
+    py = (jax.nn.sigmoid(ty) + gy) / h
+    pw = jnp.exp(tw) * mask_an[None, :, 0, None, None] / input_size
+    ph = jnp.exp(th) * mask_an[None, :, 1, None, None] / input_size
+
+    def one_image(px_, py_, pw_, ph_, tx_, ty_, tw_, th_, tobj_, tcls_,
+                  gts, lbls, gscore):
+        valid = (gts[:, 2] > 0) & (gts[:, 3] > 0)
+        # ignore mask: pred-vs-gt IoU in cxcywh
+        p_boxes = jnp.stack([px_, py_, pw_, ph_], -1).reshape(-1, 4)
+        iou_pg = _iou_cxcywh(p_boxes[:, None, :], gts[None, :, :])
+        iou_pg = jnp.where(valid[None, :], iou_pg, 0.0)
+        ignore = (jnp.max(iou_pg, 1) > ignore_thresh).reshape(m, h, w)
+        # gt -> best anchor over ALL anchors (shape-only IoU)
+        g_wh = gts[:, 2:4] * input_size
+        inter = (jnp.minimum(g_wh[:, None, 0], an_all[None, :, 0])
+                 * jnp.minimum(g_wh[:, None, 1], an_all[None, :, 1]))
+        union = (g_wh[:, 0:1] * g_wh[:, 1:2]
+                 + an_all[None, :, 0] * an_all[None, :, 1] - inter)
+        best_anchor = jnp.argmax(inter / jnp.maximum(union, 1e-9), 1)
+        gi = jnp.clip((gts[:, 0] * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gts[:, 1] * h).astype(jnp.int32), 0, h - 1)
+        scale = 2.0 - gts[:, 2] * gts[:, 3]  # small-box up-weighting
+
+        loss = jnp.float32(0.0)
+        obj_target = jnp.zeros((m, h, w))
+        obj_pos = jnp.zeros((m, h, w), bool)
+        for k_local, a_global in enumerate(anchor_mask):
+            sel = valid & (best_anchor == a_global)
+            sw = jnp.where(sel, scale, 0.0) * gscore
+            t_x = gts[:, 0] * w - gi
+            t_y = gts[:, 1] * h - gj
+            t_w = jnp.log(jnp.maximum(
+                g_wh[:, 0] / an_all[a_global, 0], 1e-9))
+            t_h = jnp.log(jnp.maximum(
+                g_wh[:, 1] / an_all[a_global, 1], 1e-9))
+            p_tx = jax.nn.sigmoid(tx_[k_local, gj, gi])
+            p_ty = jax.nn.sigmoid(ty_[k_local, gj, gi])
+            loss = loss + jnp.sum(sw * ((p_tx - t_x) ** 2
+                                        + (p_ty - t_y) ** 2))
+            loss = loss + jnp.sum(sw * (
+                (tw_[k_local, gj, gi] - t_w) ** 2
+                + (th_[k_local, gj, gi] - t_h) ** 2))
+            logp = jax.nn.log_softmax(tcls_[k_local][:, gj, gi].T, -1)
+            onehot = jax.nn.one_hot(lbls, class_num)
+            if use_label_smooth:
+                delta = 1.0 / class_num
+                onehot = onehot * (1 - delta) + delta / class_num
+            loss = loss - jnp.sum(sw[:, None] * onehot * logp)
+            obj_target = obj_target.at[k_local, gj, gi].max(
+                jnp.where(sel, 1.0, 0.0))
+            obj_pos = obj_pos.at[k_local, gj, gi].max(sel)
+        obj_logp = jax.nn.log_sigmoid(tobj_)
+        obj_logn = jax.nn.log_sigmoid(-tobj_)
+        obj_loss = -(obj_target * obj_logp
+                     + jnp.where(obj_pos | ignore, 0.0, obj_logn))
+        return loss + jnp.sum(obj_loss)
+
+    gscore = (jnp.asarray(gt_score, jnp.float32) if gt_score is not None
+              else jnp.ones(jnp.asarray(gt_label).shape, jnp.float32))
+    return jax.vmap(one_image)(
+        px, py, pw, ph, tx, ty, tw, th, tobj, tcls, gt_box,
+        jnp.asarray(gt_label, jnp.int32), gscore)
+
+
+def _iou_cxcywh(a, b):
+    ax1, ay1 = a[..., 0] - a[..., 2] / 2, a[..., 1] - a[..., 3] / 2
+    ax2, ay2 = a[..., 0] + a[..., 2] / 2, a[..., 1] + a[..., 3] / 2
+    bx1, by1 = b[..., 0] - b[..., 2] / 2, b[..., 1] - b[..., 3] / 2
+    bx2, by2 = b[..., 0] + b[..., 2] / 2, b[..., 1] + b[..., 3] / 2
+    iw = jnp.maximum(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1), 0.0)
+    ih = jnp.maximum(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1), 0.0)
+    inter = iw * ih
+    ua = ((ax2 - ax1) * (ay2 - ay1) + (bx2 - bx1) * (by2 - by1) - inter)
+    return inter / jnp.maximum(ua, 1e-9)
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box,
+                           box_score, box_clip_val: float = 4.135):
+    """(ref: box_decoder_and_assign_op.cc) decode per-class deltas then
+    pick each box's best-scoring class decode.
+
+    target_box: [N, C*4]; box_score: [N, C]. Returns
+    (decoded [N, C*4], assigned [N, 4])."""
+    n, c = box_score.shape
+    deltas = target_box.reshape(n, c, 4)
+    pw = prior_box[:, 2] - prior_box[:, 0] + 1.0
+    ph = prior_box[:, 3] - prior_box[:, 1] + 1.0
+    pcx = prior_box[:, 0] + 0.5 * pw
+    pcy = prior_box[:, 1] + 0.5 * ph
+    var = prior_box_var if prior_box_var is not None else jnp.ones((4,))
+    dx = deltas[..., 0] * var[0]
+    dy = deltas[..., 1] * var[1]
+    dw = jnp.clip(deltas[..., 2] * var[2], -box_clip_val, box_clip_val)
+    dh = jnp.clip(deltas[..., 3] * var[3], -box_clip_val, box_clip_val)
+    cx = pcx[:, None] + dx * pw[:, None]
+    cy = pcy[:, None] + dy * ph[:, None]
+    w = jnp.exp(dw) * pw[:, None]
+    h = jnp.exp(dh) * ph[:, None]
+    decoded = jnp.stack([cx - w / 2, cy - h / 2,
+                         cx + w / 2 - 1, cy + h / 2 - 1], -1)  # [N,C,4]
+    best = jnp.argmax(box_score, axis=1)
+    assigned = decoded[jnp.arange(n), best]
+    return decoded.reshape(n, c * 4), assigned
+
+
+def polygon_box_transform(x):
+    """(ref: polygon_box_transform_op.cc) EAST geometry: channel 2k is a
+    per-pixel x-offset, 2k+1 a y-offset; output = cell coordinate minus
+    offset (input quantified at 4x subsampling)."""
+    b, c, h, w = x.shape
+    gy, gx = jnp.meshgrid(jnp.arange(h, dtype=x.dtype) * 4,
+                          jnp.arange(w, dtype=x.dtype) * 4, indexing="ij")
+    base = jnp.stack([gx, gy] * (c // 2), axis=0)  # [C, H, W]
+    return base[None] - x

@@ -365,3 +365,29 @@ def center_loss(features, label, centers, alpha: float = 0.5,
     new_centers = centers - alpha * grad / (counts[:, None] + 1.0)
     return loss, new_centers
 
+
+
+def dice_loss(input, label, epsilon: float = 1e-5):
+    """(ref: python/paddle/fluid/layers/nn.py dice_loss) 1 - Dice
+    coefficient between softmax-style predictions and one-hot labels.
+    input: [..., D] probabilities; label: [..., 1] int class ids.
+    """
+    lbl = jnp.squeeze(jnp.asarray(label), -1)
+    one_hot = jax.nn.one_hot(lbl, input.shape[-1], dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * one_hot, axis=reduce_dims)
+    union = jnp.sum(input, axis=reduce_dims) + jnp.sum(one_hot,
+                                                       axis=reduce_dims)
+    dice = (2.0 * inter + epsilon) / (union + epsilon)
+    return jnp.mean(1.0 - dice)
+
+
+# reference name for ctc_loss (warpctc_op.cc is the CUDA provider of the
+# same math; on TPU the lax.scan DP in ctc_loss IS the kernel)
+def warpctc(log_probs, labels, input_lengths, label_lengths,
+            blank: int = 0, norm_by_times: bool = False):
+    loss = ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                    blank=blank, reduction="none")
+    if norm_by_times:
+        loss = loss / jnp.maximum(input_lengths.astype(log_probs.dtype), 1)
+    return loss

@@ -449,3 +449,36 @@ def im2sequence(x, kernel: Sequence[int], stride: Sequence[int] = (1, 1),
                   paddings=padding)  # [N, C*kh*kw, L]
     n, ckk, l = cols.shape
     return jnp.swapaxes(cols, 1, 2).reshape(n * l, ckk)
+
+
+def reverse(x, axis):
+    """(ref: reverse_op.cc) fluid spelling of flip()."""
+    return flip(x, axis)
+
+
+def unique_with_counts(x, size: Optional[int] = None, fill_value=None):
+    """(ref: unique_with_counts_op.cc). Returns (out, index, count); pass
+    ``size`` for a static-shape result under jit (XLA requirement)."""
+    out, index, count = jnp.unique(x.reshape(-1), return_inverse=True,
+                                   return_counts=True, size=size,
+                                   fill_value=fill_value)
+    return out, index, count
+
+
+def crop_tensor(x, shape: Sequence[int], offsets: Optional[Sequence[int]]
+                = None):
+    """(ref: crop_tensor_op.cc) static crop: slice `shape` out of x at
+    `offsets` (default 0s)."""
+    if offsets is None:
+        offsets = [0] * x.ndim
+    shape = [x.shape[i] if s in (-1, None) else int(s)
+             for i, s in enumerate(shape)]
+    return jax.lax.dynamic_slice(x, tuple(jnp.asarray(o) for o in offsets),
+                                 tuple(shape))
+
+
+def is_empty(x) -> bool:
+    """(ref: is_empty_op.cc). Shapes are static under XLA, so this is a
+    Python-level predicate usable for trace-time branching."""
+    import numpy as _np
+    return int(_np.prod(x.shape)) == 0

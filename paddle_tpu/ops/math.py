@@ -436,3 +436,64 @@ def multiplex(inputs: Sequence[jax.Array], index):
     idx = index.reshape(-1).astype(jnp.int32)
     rows = jnp.arange(stacked.shape[1])
     return stacked[idx, rows]
+
+
+def has_inf(x):
+    """(ref: isfinite_op.cc has_inf) scalar bool: any inf in x."""
+    return jnp.any(jnp.isinf(x))
+
+
+def has_nan(x):
+    """(ref: isfinite_op.cc has_nan)."""
+    return jnp.any(jnp.isnan(x))
+
+
+def isfinite_all(x):
+    """(ref: isfinite_op.cc isfinite — scalar all-finite reduction)."""
+    return jnp.all(jnp.isfinite(x))
+
+
+def sums(inputs, out=None):
+    """(ref: sum_op.cc over a list) elementwise sum of a tensor list.
+    ``out`` is the reference's output-variable slot — functionally
+    meaningless here, accepted and ignored for signature parity."""
+    acc = inputs[0]
+    for t in inputs[1:]:
+        acc = acc + t
+    return acc
+
+
+def fill_constant_batch_size_like(input, shape: Sequence[int], dtype,
+                                  value, input_dim_idx: int = 0,
+                                  output_dim_idx: int = 0):
+    """(ref: fill_constant_batch_size_like_op.cc) fill with the batch dim
+    copied from a reference tensor — under jit shapes are static, so this
+    is a plain full() with one dim substituted."""
+    from ..core.dtype import convert_dtype
+    shape = list(shape)
+    shape[output_dim_idx] = input.shape[input_dim_idx]
+    return jnp.full(shape, value, convert_dtype(dtype))
+
+
+def uniform_random_batch_size_like(input, shape: Sequence[int],
+                                   min: float = -1.0, max: float = 1.0,
+                                   input_dim_idx: int = 0,
+                                   output_dim_idx: int = 0,
+                                   dtype="float32", key=None):
+    """(ref: uniform_random_batch_size_like_op.cc)."""
+    from .random_ops import uniform
+    shape = list(shape)
+    shape[output_dim_idx] = input.shape[input_dim_idx]
+    return uniform(shape, dtype=dtype, min=min, max=max, key=key)
+
+
+def gaussian_random_batch_size_like(input, shape: Sequence[int],
+                                    mean: float = 0.0, std: float = 1.0,
+                                    input_dim_idx: int = 0,
+                                    output_dim_idx: int = 0,
+                                    dtype="float32", key=None):
+    """(ref: gaussian_random_batch_size_like_op.cc)."""
+    from .random_ops import gaussian
+    shape = list(shape)
+    shape[output_dim_idx] = input.shape[input_dim_idx]
+    return gaussian(shape, mean=mean, std=std, dtype=dtype, key=key)

@@ -76,3 +76,26 @@ def positive_negative_pair(score, label, query_id):
     neu = jnp.sum(valid & (si == sj))
     return pos.astype(jnp.float32), neg.astype(jnp.float32), \
         neu.astype(jnp.float32)
+
+
+def mean_iou(input, label, num_classes: int):
+    """(ref: mean_iou_op.cc) Mean intersection-over-union over classes
+    present in either prediction or label. Returns
+    (mean_iou, out_wrong [C], out_correct [C]) like the reference.
+    """
+    pred = jnp.asarray(input).reshape(-1).astype(jnp.int32)
+    lbl = jnp.asarray(label).reshape(-1).astype(jnp.int32)
+    correct_mask = pred == lbl
+    out_correct = jnp.zeros((num_classes,), jnp.int32).at[
+        jnp.where(correct_mask, lbl, num_classes)].add(
+            1, mode="drop")
+    pred_count = jnp.zeros((num_classes,), jnp.int32).at[pred].add(
+        1, mode="drop")
+    lbl_count = jnp.zeros((num_classes,), jnp.int32).at[lbl].add(
+        1, mode="drop")
+    union = pred_count + lbl_count - out_correct
+    present = union > 0
+    iou = jnp.where(present, out_correct / jnp.maximum(union, 1), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(present), 1)
+    out_wrong = jnp.where(present, union - out_correct, 0)
+    return miou, out_wrong, out_correct

@@ -792,3 +792,124 @@ def npair_loss(anchor, positive, labels, l2_reg: float = 0.002):
     prob = same / jnp.sum(same, axis=1, keepdims=True)
     xent = -jnp.sum(prob * jax.nn.log_softmax(logits, axis=1), axis=1)
     return jnp.mean(xent) + reg
+
+
+def pool3d(x, pool_size=-1, pool_type: str = "max", pool_stride=1,
+           pool_padding=0, global_pooling: bool = False,
+           ceil_mode: bool = False, exclusive: bool = True):
+    """NCDHW pooling (ref: pool_op.cc 3-D path)."""
+    return _pool(x, pool_type, pool_size, pool_stride, pool_padding,
+                 ceil_mode, exclusive, 3, global_pooling)
+
+
+def adaptive_pool3d(x, output_size, pool_type: str = "avg"):
+    """(ref: pool_op.cc adaptive 3-D). Exact when each spatial dim
+    divides; general case composes interpolation-style bins."""
+    od, oh, ow = _pair(output_size, 3)
+    n, c, d, h, w = x.shape
+    if d % od == 0 and h % oh == 0 and w % ow == 0:
+        r = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+        if pool_type == "avg":
+            return jnp.mean(r, axis=(3, 5, 7))
+        return jnp.max(r, axis=(3, 5, 7))
+    # slice per output cell (static python loops: od/oh/ow are constants)
+    cells = []
+    for i in range(od):
+        d0, d1 = (d * i) // od, (d * (i + 1) + od - 1) // od
+        for j in range(oh):
+            h0, h1 = (h * j) // oh, (h * (j + 1) + oh - 1) // oh
+            for k in range(ow):
+                w0, w1 = (w * k) // ow, (w * (k + 1) + ow - 1) // ow
+                win = x[:, :, d0:d1, h0:h1, w0:w1]
+                cells.append(jnp.mean(win, axis=(2, 3, 4))
+                             if pool_type == "avg"
+                             else jnp.max(win, axis=(2, 3, 4)))
+    return jnp.stack(cells, axis=-1).reshape(n, c, od, oh, ow)
+
+
+def add_position_encoding(x, alpha: float = 1.0, beta: float = 1.0):
+    """(ref: add_position_encoding_op.cc) out = alpha*x + beta*PE with the
+    transformer sinusoid table. x: [B, T, C]."""
+    b, t, c = x.shape
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    half = c // 2
+    div = jnp.exp(jnp.arange(half, dtype=jnp.float32)
+                  * (-jnp.log(10000.0) / jnp.maximum(half - 1, 1)))
+    pe = jnp.concatenate([jnp.sin(pos * div), jnp.cos(pos * div)], axis=1)
+    if pe.shape[1] < c:  # odd channel count
+        pe = jnp.pad(pe, ((0, 0), (0, c - pe.shape[1])))
+    return alpha * x + beta * pe[None].astype(x.dtype)
+
+
+def similarity_focus(x, axis: int, indexes):
+    """(ref: similarity_focus_op.cc) build a focus mask: for each selected
+    index along `axis` of a [B, C, H, W]-like tensor, mark the argmax
+    cell of every row and column of the remaining 2-D slice."""
+    if axis != 1:
+        x = jnp.moveaxis(x, axis, 1)
+    b, c, h, w = x.shape
+    mask = jnp.zeros_like(x)
+    for idx in indexes:
+        sl = x[:, idx]  # [B, H, W]
+        row_best = jnp.argmax(sl, axis=2)  # [B, H]
+        col_best = jnp.argmax(sl, axis=1)  # [B, W]
+        m = jnp.zeros((b, h, w), x.dtype)
+        m = m.at[jnp.arange(b)[:, None], jnp.arange(h)[None, :],
+                 row_best].set(1.0)
+        m = m.at[jnp.arange(b)[:, None], col_best,
+                 jnp.arange(w)[None, :]].set(1.0)
+        mask = mask.at[:, idx].set(m)
+    if axis != 1:
+        mask = jnp.moveaxis(mask, 1, axis)
+    return mask
+
+
+def random_crop(x, shape: Sequence[int], key=None):
+    """(ref: random_crop_op.cc) random crop of the trailing dims to
+    `shape`, with an INDEPENDENT offset per leading-dim sample (the
+    reference draws per-instance; a shared window would collapse the
+    augmentation)."""
+    from ..core import random as _random
+    if key is None:
+        key = _random.next_key("random")
+    lead_shape = x.shape[: x.ndim - len(shape)]
+    tail_shape = x.shape[x.ndim - len(shape):]
+
+    def crop_one(xi, k):
+        ks = jax.random.split(k, len(shape))
+        starts = [jax.random.randint(ks[i], (), 0, dim - out + 1)
+                  for i, (dim, out) in enumerate(zip(tail_shape, shape))]
+        return jax.lax.dynamic_slice(xi, starts, shape)
+
+    if not lead_shape:
+        return crop_one(x, key)
+    n = 1
+    for d in lead_shape:
+        n *= d
+    flat = x.reshape((n,) + tuple(tail_shape))
+    keys = jax.random.split(key, n)
+    out = jax.vmap(crop_one)(flat, keys)
+    return out.reshape(tuple(lead_shape) + tuple(shape))
+
+
+def inplace_abn(x, running_mean, running_var, weight=None, bias=None,
+                training: bool = False, momentum: float = 0.9,
+                epsilon: float = 1e-5, act: Optional[str] = None,
+                act_alpha: float = 1.0):
+    """(ref: inplace_abn_op.cc) batch norm + activation. "In-place" is a
+    CUDA memory trick with no XLA meaning (buffer reuse is the
+    compiler's job); semantics = batch_norm then act."""
+    out = batch_norm(x, running_mean, running_var, weight, bias,
+                     training=training, momentum=momentum, epsilon=epsilon)
+    y = out[0] if isinstance(out, tuple) else out
+    if act == "relu":
+        y = jax.nn.relu(y)
+    elif act == "leaky_relu":
+        y = jax.nn.leaky_relu(y, act_alpha)
+    elif act == "elu":
+        y = jax.nn.elu(y, act_alpha)
+    elif act is not None:
+        raise ValueError(f"inplace_abn: unsupported act {act}")
+    if isinstance(out, tuple):
+        return (y,) + out[1:]
+    return y

@@ -1,0 +1,261 @@
+"""Functional recurrent ops (reference fluid.layers surface).
+
+TPU-native lowerings of /root/reference/paddle/fluid/operators/:
+lstm_op.cc (dynamic_lstm), lstmp_op.cc (dynamic_lstmp), gru_op.cc
+(dynamic_gru), lstm_unit_op.cc, gru_unit_op.cc, cudnn_lstm_op.cu (lstm).
+
+The reference's dynamic_* ops consume LoD-packed sequences and run
+per-timestep CPU/CUDA kernels over a sorted batch; here sequences are
+dense padded [B, T, ...] (+ optional lengths) and the recurrence is ONE
+``lax.scan`` whose body is a fused matmul — the whole unrolled loop
+compiles into a single XLA while-op with MXU-sized steps.
+
+Gate layouts follow the reference: dynamic_lstm takes pre-projected
+input [B, T, 4H] (the x@W_ih matmul is hoisted out of the recurrence,
+exactly why the reference splits input projection from the op), weights
+are hidden-to-hidden only.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["lstm_unit", "gru_unit", "dynamic_lstm", "dynamic_lstmp",
+           "dynamic_gru", "lstm"]
+
+
+def _act(name: str):
+    return {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "relu": jax.nn.relu, "identity": lambda x: x}[name]
+
+
+def lstm_unit(x_t, h_prev, c_prev, weight_hh, bias=None,
+              forget_bias: float = 0.0,
+              gate_activation: str = "sigmoid",
+              cell_activation: str = "tanh"):
+    """One LSTM step (ref: lstm_unit_op.cc). x_t: [B, 4H] pre-projected;
+    weight_hh: [H, 4H]; gate order i, f, c, o. Returns (h, c)."""
+    gates = x_t + h_prev @ weight_hh
+    if bias is not None:
+        gates = gates + bias
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    ga, ca = _act(gate_activation), _act(cell_activation)
+    c = ga(f + forget_bias) * c_prev + ga(i) * ca(g)
+    h = ga(o) * ca(c)
+    return h, c
+
+
+def gru_unit(x_t, h_prev, weight_hh, bias=None,
+             gate_activation: str = "sigmoid",
+             activation: str = "tanh"):
+    """One GRU step (ref: gru_unit_op.cc). x_t: [B, 3H] pre-projected
+    (order u, r, c); weight_hh: [H, 3H] with the candidate block last.
+    Returns (h, reset_h, gates)."""
+    h_dim = h_prev.shape[-1]
+    ga, ca = _act(gate_activation), _act(activation)
+    xu, xr, xc = jnp.split(x_t, 3, axis=-1)
+    w_ur, w_c = weight_hh[:, :2 * h_dim], weight_hh[:, 2 * h_dim:]
+    hu, hr = jnp.split(h_prev @ w_ur, 2, axis=-1)
+    bu = br = bc = 0.0
+    if bias is not None:
+        bu, br, bc = jnp.split(bias, 3, axis=-1)
+    u = ga(xu + hu + bu)
+    r = ga(xr + hr + br)
+    reset_h = r * h_prev
+    c = ca(xc + reset_h @ w_c + bc)
+    h = u * h_prev + (1.0 - u) * c
+    return h, reset_h, jnp.concatenate([u, r, c], axis=-1)
+
+
+def _masked(new, old, t, lengths):
+    if lengths is None:
+        return new
+    keep = (t < lengths)[:, None]
+    return jnp.where(keep, new, old)
+
+
+def dynamic_lstm(input, weight, bias=None, lengths=None, h0=None, c0=None,
+                 is_reverse: bool = False, use_peepholes: bool = False,
+                 gate_activation: str = "sigmoid",
+                 cell_activation: str = "tanh",
+                 candidate_activation: str = "tanh",
+                 forget_bias: float = 0.0):
+    """(ref: lstm_op.cc) input: [B, T, 4H] pre-projected; weight: [H, 4H];
+    bias: [4H] or [7H] with peephole weights Wic|Wif|Woc appended.
+    Returns (hidden [B, T, H], cell [B, T, H])."""
+    b, t_max, four_h = input.shape
+    h_dim = four_h // 4
+    ga, ca, na = (_act(gate_activation), _act(cell_activation),
+                  _act(candidate_activation))
+    w_ic = w_if = w_oc = None
+    b_gate = None
+    if bias is not None:
+        b_gate = bias[: 4 * h_dim]
+        if use_peepholes:
+            w_ic = bias[4 * h_dim: 5 * h_dim]
+            w_if = bias[5 * h_dim: 6 * h_dim]
+            w_oc = bias[6 * h_dim: 7 * h_dim]
+    h = h0 if h0 is not None else jnp.zeros((b, h_dim), input.dtype)
+    c = c0 if c0 is not None else jnp.zeros((b, h_dim), input.dtype)
+    xs = jnp.swapaxes(input, 0, 1)  # [T, B, 4H]
+    ts = jnp.arange(t_max)
+    if is_reverse:
+        xs = xs[::-1]
+        ts = ts[::-1]
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        x_t, t = inp
+        gates = x_t + h_prev @ weight
+        if b_gate is not None:
+            gates = gates + b_gate
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        if use_peepholes:
+            i = i + c_prev * w_ic
+            f = f + c_prev * w_if
+        i, f = ga(i), ga(f + forget_bias)
+        c_new = f * c_prev + i * na(g)
+        if use_peepholes:
+            o = o + c_new * w_oc
+        h_new = ga(o) * ca(c_new)
+        h_new = _masked(h_new, h_prev, t, lengths)
+        c_new = _masked(c_new, c_prev, t, lengths)
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h, c), (xs, ts))
+    if is_reverse:
+        hs, cs = hs[::-1], cs[::-1]
+    return jnp.swapaxes(hs, 0, 1), jnp.swapaxes(cs, 0, 1)
+
+
+def dynamic_lstmp(input, weight, proj_weight, bias=None, lengths=None,
+                  h0=None, c0=None, is_reverse: bool = False,
+                  use_peepholes: bool = False,
+                  gate_activation: str = "sigmoid",
+                  cell_activation: str = "tanh",
+                  candidate_activation: str = "tanh",
+                  proj_activation: str = "tanh",
+                  forget_bias: float = 0.0):
+    """(ref: lstmp_op.cc) LSTM with a recurrent projection: the state fed
+    back is r = act(h @ P) with P: [H, P_dim]; weight: [P_dim, 4H].
+    Returns (projection [B, T, P], cell [B, T, H])."""
+    b, t_max, four_h = input.shape
+    h_dim = four_h // 4
+    p_dim = proj_weight.shape[1]
+    ga, ca, na, pa = (_act(gate_activation), _act(cell_activation),
+                      _act(candidate_activation), _act(proj_activation))
+    b_gate = None
+    w_ic = w_if = w_oc = None
+    if bias is not None:
+        b_gate = bias[: 4 * h_dim]
+        if use_peepholes:
+            w_ic = bias[4 * h_dim: 5 * h_dim]
+            w_if = bias[5 * h_dim: 6 * h_dim]
+            w_oc = bias[6 * h_dim: 7 * h_dim]
+    r = h0 if h0 is not None else jnp.zeros((b, p_dim), input.dtype)
+    c = c0 if c0 is not None else jnp.zeros((b, h_dim), input.dtype)
+    xs = jnp.swapaxes(input, 0, 1)
+    ts = jnp.arange(t_max)
+    if is_reverse:
+        xs = xs[::-1]
+        ts = ts[::-1]
+
+    def step(carry, inp):
+        r_prev, c_prev = carry
+        x_t, t = inp
+        gates = x_t + r_prev @ weight
+        if b_gate is not None:
+            gates = gates + b_gate
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        if use_peepholes:
+            i = i + c_prev * w_ic
+            f = f + c_prev * w_if
+        i, f = ga(i), ga(f + forget_bias)
+        c_new = f * c_prev + i * na(g)
+        if use_peepholes:
+            o = o + c_new * w_oc
+        h_new = ga(o) * ca(c_new)
+        r_new = pa(h_new @ proj_weight)
+        r_new = _masked(r_new, r_prev, t, lengths)
+        c_new = _masked(c_new, c_prev, t, lengths)
+        return (r_new, c_new), (r_new, c_new)
+
+    (_, _), (rs, cs) = jax.lax.scan(step, (r, c), (xs, ts))
+    if is_reverse:
+        rs, cs = rs[::-1], cs[::-1]
+    return jnp.swapaxes(rs, 0, 1), jnp.swapaxes(cs, 0, 1)
+
+
+def dynamic_gru(input, weight, bias=None, lengths=None, h0=None,
+                is_reverse: bool = False,
+                gate_activation: str = "sigmoid",
+                candidate_activation: str = "tanh"):
+    """(ref: gru_op.cc) input: [B, T, 3H] pre-projected (order u, r, c);
+    weight: [H, 3H]. Returns hidden [B, T, H]."""
+    b, t_max, three_h = input.shape
+    h_dim = three_h // 3
+    h = h0 if h0 is not None else jnp.zeros((b, h_dim), input.dtype)
+    xs = jnp.swapaxes(input, 0, 1)
+    ts = jnp.arange(t_max)
+    if is_reverse:
+        xs = xs[::-1]
+        ts = ts[::-1]
+
+    def step(h_prev, inp):
+        x_t, t = inp
+        h_new, _, _ = gru_unit(x_t, h_prev, weight, bias,
+                               gate_activation, candidate_activation)
+        h_new = _masked(h_new, h_prev, t, lengths)
+        return h_new, h_new
+
+    _, hs = jax.lax.scan(step, h, (xs, ts))
+    if is_reverse:
+        hs = hs[::-1]
+    return jnp.swapaxes(hs, 0, 1)
+
+
+def lstm(input, init_h, init_c, weights: Sequence, lengths=None,
+         num_layers: int = 1, is_bidirec: bool = False,
+         dropout_prob: float = 0.0, training: bool = False, key=None):
+    """Multi-layer (optionally bidirectional) LSTM
+    (ref: cudnn_lstm_op.cu — the fused CUDNN path; on TPU each layer is a
+    scan and XLA fuses the stack).
+
+    input: [B, T, C]. init_h/init_c: [L*D, B, H]. weights: one dict per
+    (layer, direction) with keys w_ih [C_in, 4H], w_hh [H, 4H], b [4H].
+    Returns (out [B, T, H*D], last_h, last_c).
+    """
+    d = 2 if is_bidirec else 1
+    x = input
+    last_h, last_c = [], []
+    for layer in range(num_layers):
+        outs = []
+        for direction in range(d):
+            wd = weights[layer * d + direction]
+            h0 = init_h[layer * d + direction]
+            c0 = init_c[layer * d + direction]
+            proj = x @ wd["w_ih"]
+            hs, cs = dynamic_lstm(proj, wd["w_hh"], wd.get("b"),
+                                  lengths=lengths, h0=h0, c0=c0,
+                                  is_reverse=(direction == 1))
+            outs.append(hs)
+            if lengths is None:
+                last_h.append(hs[:, -1] if direction == 0 else hs[:, 0])
+                last_c.append(cs[:, -1] if direction == 0 else cs[:, 0])
+            else:
+                idx = jnp.maximum(lengths - 1, 0)
+                bi = jnp.arange(x.shape[0])
+                if direction == 0:
+                    last_h.append(hs[bi, idx])
+                    last_c.append(cs[bi, idx])
+                else:
+                    last_h.append(hs[:, 0])
+                    last_c.append(cs[:, 0])
+        x = outs[0] if d == 1 else jnp.concatenate(outs, axis=-1)
+        if dropout_prob > 0.0 and training and layer < num_layers - 1:
+            from .nn_functional import dropout
+            x = dropout(x, p=dropout_prob, training=True, key=key)
+    return x, jnp.stack(last_h), jnp.stack(last_c)

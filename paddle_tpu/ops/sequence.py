@@ -373,3 +373,78 @@ def filter_by_instag(x, ins_tags, filter_tags, is_lod: bool = False):
     w = mask.astype(jnp.float32)
     xf = x * w.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
     return xf, mask, w
+
+
+def edit_distance(input, input_length, label, label_length,
+                  normalized: bool = True):
+    """Levenshtein distance per batch row (ref: edit_distance_op.cc; the
+    reference consumes LoD token sequences, here dense padded + lengths).
+
+    input: [B, T1] int token ids; label: [B, T2]. Returns
+    (distance [B], sequence_num) matching the reference's outputs.
+
+    The DP recurrence row[j] = min(prev[j]+1, row[j-1]+1, prev[j-1]+cost)
+    has a sequential dependency in j; it is re-associated into a prefix
+    minimum — row[j] = min(c[j], min_{k<=j}(c[k]-k)+j) with
+    c = min(prev+1, prev[j-1]+cost) — so each outer scan step is fully
+    vectorized (no O(T2) inner loop on the MXU's critical path).
+    """
+    input = jnp.asarray(input, jnp.int32)
+    label = jnp.asarray(label, jnp.int32)
+    b, t1 = input.shape
+    t2 = label.shape[1]
+    input_length = jnp.asarray(input_length, jnp.int32).reshape(b)
+    label_length = jnp.asarray(label_length, jnp.int32).reshape(b)
+
+    jcol = jnp.arange(t2 + 1, dtype=jnp.float32)
+    row0 = jnp.broadcast_to(jcol, (b, t2 + 1))
+
+    def step(prev, x_i):
+        # x_i: [B] the i-th input token (1-based row index via carry aux)
+        prev_row, i = prev
+        cost = (x_i[:, None] != label).astype(jnp.float32)  # [B, T2]
+        cand = jnp.concatenate(
+            [jnp.full((b, 1), 1e9, jnp.float32),
+             jnp.minimum(prev_row[:, 1:] + 1.0,
+                         prev_row[:, :-1] + cost)], axis=1)
+        cand = cand.at[:, 0].set(i + 1.0)  # row[0] = deletions only
+        # row[j] = min(cand[j], min_{k<j}(row[k]) + (j-k)) via cummin
+        shifted = jax.lax.cummin(cand - jcol, axis=1) + jcol
+        row = jnp.minimum(cand, shifted)
+        return (row, i + 1.0), row
+
+    (_, _), rows = jax.lax.scan(step, (row0, jnp.float32(0)),
+                                jnp.swapaxes(input, 0, 1))
+    # rows: [T1, B, T2+1]; prepend row0 then gather [input_len, label_len]
+    all_rows = jnp.concatenate([row0[None], rows], axis=0)  # [T1+1,B,T2+1]
+    bi = jnp.arange(b)
+    dist = all_rows[input_length, bi, label_length]
+    if normalized:
+        dist = dist / jnp.maximum(label_length.astype(jnp.float32), 1.0)
+    return dist, jnp.asarray(b, jnp.int32)
+
+
+def ctc_greedy_decoder(log_probs, length, blank: Optional[int] = None):
+    """Best-path CTC decoding (ref: ctc_align_op.cu ctc_greedy_decoder:
+    argmax per frame, merge repeats, drop blanks).
+
+    log_probs: [B, T, C]; length: [B] valid frames. blank defaults to C-1
+    (the reference's convention). Returns (decoded [B, T] padded with -1,
+    decoded_length [B]) — dense analogue of the reference's LoD output.
+    """
+    b, t, c = log_probs.shape
+    if blank is None:
+        blank = c - 1
+    ids = jnp.argmax(log_probs, axis=-1).astype(jnp.int32)  # [B, T]
+    valid = jnp.arange(t)[None, :] < jnp.asarray(length).reshape(b, 1)
+    prev = jnp.concatenate([jnp.full((b, 1), -1, jnp.int32),
+                            ids[:, :-1]], axis=1)
+    keep = valid & (ids != blank) & (ids != prev)
+    # stable compaction: order keep-positions first
+    order = jnp.argsort(jnp.where(keep, jnp.arange(t)[None, :], t + 1),
+                        axis=1)
+    packed = jnp.take_along_axis(ids, order, axis=1)
+    n_kept = jnp.sum(keep, axis=1)
+    decoded = jnp.where(jnp.arange(t)[None, :] < n_kept[:, None],
+                        packed, -1)
+    return decoded, n_kept

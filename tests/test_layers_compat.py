@@ -1,0 +1,390 @@
+"""fluid.layers-surface parity: the layers namespace, distributions,
+functional RNN ops, detection training losses, and the op-gap fills
+(edit_distance, ctc_greedy_decoder, mean_iou, dice, pool3d, ...).
+
+Modeled on the reference's per-op unittests
+(/root/reference/python/paddle/fluid/tests/unittests/test_edit_distance_op.py,
+test_yolov3_loss_op.py, test_ssd_loss.py, test_distributions.py,
+test_lstm_op.py, test_matrix_nms_op.py patterns: compare against a
+numpy re-derivation)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers as L
+from paddle_tpu import distribution as dist
+import paddle_tpu.ops.rnn_functional as R
+import paddle_tpu.ops.detection as D
+import paddle_tpu.ops.sequence as S
+
+
+# ------------------------------------------------------------- namespace
+
+def test_elementwise_axis_semantics():
+    x = np.zeros((2, 3, 4), np.float32)
+    y = np.arange(3, dtype=np.float32)
+    out = L.elementwise_add(x, y, axis=1)
+    assert out.shape == (2, 3, 4)
+    np.testing.assert_allclose(np.asarray(out)[0, :, 0], y)
+
+
+def test_reduce_dim_keepdim():
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    assert L.reduce_sum(x, dim=1, keep_dim=True).shape == (2, 1)
+    assert float(L.reduce_max(x)) == 5.0
+
+
+def test_lr_decay_functions_feed_optimizer():
+    sched = L.piecewise_decay([100, 200], [0.1, 0.05, 0.01])
+    assert float(sched.lr_at(0)) == pytest.approx(0.1)
+    assert float(sched.lr_at(150)) == pytest.approx(0.05)
+    opt = pt.optimizer.SGD(learning_rate=L.cosine_decay(0.1, 10, 2))
+    params = {"w": np.ones((3,), np.float32)}
+    state = opt.init(params)
+    p2, _ = opt.apply_gradients(params, {"w": np.ones((3,), np.float32)},
+                                state)
+    assert not np.allclose(np.asarray(p2["w"]), 1.0)
+
+
+def test_unavailable_name_raises_loudly():
+    with pytest.raises(NotImplementedError, match="static_rnn"):
+        L.StaticRNN
+    with pytest.raises(AttributeError):
+        L.definitely_not_an_op
+
+
+def test_assert_and_print_eager():
+    L.Assert(True)
+    with pytest.raises(AssertionError):
+        L.Assert(False, data="msg")
+    out = L.Print(np.arange(3), message="dbg")
+    assert out.shape == (3,)
+
+
+# --------------------------------------------------------- distributions
+
+def test_normal_log_prob_and_kl():
+    n = dist.Normal(1.0, 2.0)
+    lp = float(n.log_prob(1.0))
+    assert lp == pytest.approx(-np.log(2.0) - 0.5 * np.log(2 * np.pi))
+    kl = float(dist.kl_divergence(n, dist.Normal(1.0, 2.0)))
+    assert kl == pytest.approx(0.0, abs=1e-6)
+    # sampling statistics
+    s = np.asarray(n.sample((20000,)))
+    assert abs(s.mean() - 1.0) < 0.1 and abs(s.std() - 2.0) < 0.1
+
+
+def test_normal_reparameterized_gradient():
+    import jax
+    import jax.numpy as jnp
+
+    def f(mu):
+        d = dist.Normal(mu, 1.0)
+        s = d.sample((500,), key=jax.random.key(0))
+        return jnp.mean(s)
+
+    g = float(jax.grad(f)(jnp.float32(0.0)))
+    assert g == pytest.approx(1.0, abs=1e-4)
+
+
+def test_categorical_entropy_uniform():
+    c = dist.Categorical(np.zeros((5,), np.float32))
+    assert float(c.entropy()) == pytest.approx(np.log(5), rel=1e-5)
+    s = np.asarray(c.sample((4000,)))
+    counts = np.bincount(s, minlength=5) / 4000
+    assert np.all(np.abs(counts - 0.2) < 0.05)
+
+
+def test_uniform_support_and_kl():
+    u = dist.Uniform(0.0, 2.0)
+    assert float(u.log_prob(1.0)) == pytest.approx(-np.log(2))
+    assert np.isneginf(float(u.log_prob(2.5)))
+    kl = float(dist.kl_divergence(u, dist.Uniform(-1.0, 3.0)))
+    assert kl == pytest.approx(np.log(4 / 2))
+
+
+def test_mvn_diag_matches_factored_normals():
+    mu = np.array([0.5, -1.0], np.float32)
+    sd = np.array([1.5, 0.7], np.float32)
+    m = dist.MultivariateNormalDiag(mu, sd)
+    x = np.array([0.1, 0.2], np.float32)
+    want = sum(float(dist.Normal(mu[i], sd[i]).log_prob(x[i]))
+               for i in range(2))
+    assert float(m.log_prob(x)) == pytest.approx(want, rel=1e-5)
+
+
+# ------------------------------------------------------------ op fills
+
+def test_edit_distance_matches_bruteforce(rng):
+    def ed(a, b):
+        m, n = len(a), len(b)
+        d = np.zeros((m + 1, n + 1))
+        d[:, 0] = np.arange(m + 1)
+        d[0, :] = np.arange(n + 1)
+        for i in range(1, m + 1):
+            for j in range(1, n + 1):
+                d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1,
+                              d[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+        return d[m, n]
+
+    for _ in range(10):
+        la, lb = rng.integers(1, 8), rng.integers(1, 6)
+        a = rng.integers(0, 4, (la,))
+        b = rng.integers(0, 4, (lb,))
+        A = np.zeros((1, 8), np.int32)
+        A[0, :la] = a
+        B = np.zeros((1, 6), np.int32)
+        B[0, :lb] = b
+        d, num = S.edit_distance(A, np.array([la]), B, np.array([lb]),
+                                 normalized=False)
+        assert float(d[0]) == ed(a, b)
+    dn, _ = S.edit_distance(A, np.array([la]), B, np.array([lb]),
+                            normalized=True)
+    assert float(dn[0]) == pytest.approx(ed(a, b) / lb)
+
+
+def test_ctc_greedy_decoder():
+    # ids over time: 1 1 0 blank 1 -> merged [1, 0, 1]
+    probs = np.full((1, 5, 3), 0.1, np.float32)
+    for t, c in enumerate([1, 1, 0, 2, 1]):
+        probs[0, t, c] = 0.8
+    dec, n = S.ctc_greedy_decoder(np.log(probs), np.array([5]), blank=2)
+    assert list(np.asarray(dec[0, :3])) == [1, 0, 1]
+    assert int(n[0]) == 3
+    # length masking: trailing frames ignored
+    dec2, n2 = S.ctc_greedy_decoder(np.log(probs), np.array([2]), blank=2)
+    assert int(n2[0]) == 1 and int(dec2[0, 0]) == 1
+
+
+def test_mean_iou_perfect_and_partial():
+    miou, wrong, correct = L.mean_iou(np.array([0, 1, 1]),
+                                      np.array([0, 1, 1]), 2)
+    assert float(miou) == pytest.approx(1.0)
+    miou2, _, _ = L.mean_iou(np.array([0, 1, 1, 2]),
+                             np.array([0, 1, 2, 2]), 3)
+    # class0: 1/1, class1: 1/2, class2: 1/2 -> mean 2/3
+    assert float(miou2) == pytest.approx(2 / 3, rel=1e-5)
+
+
+def test_dice_loss_perfect_prediction():
+    pred = np.array([[0.0, 1.0], [1.0, 0.0]], np.float32)
+    lbl = np.array([[1], [0]])
+    assert float(L.dice_loss(pred, lbl)) == pytest.approx(0.0, abs=1e-4)
+
+
+def test_pool3d_and_adaptive():
+    x = np.random.default_rng(0).normal(size=(1, 2, 4, 4, 4)) \
+        .astype(np.float32)
+    out = L.pool3d(x, 2, "avg", 2)
+    assert out.shape == (1, 2, 2, 2, 2)
+    np.testing.assert_allclose(np.asarray(out)[0, 0, 0, 0, 0],
+                               x[0, 0, :2, :2, :2].mean(), rtol=1e-5)
+    assert L.adaptive_pool3d(x, 3, "max").shape == (1, 2, 3, 3, 3)
+
+
+def test_add_position_encoding_identity_scale():
+    x = np.zeros((1, 4, 8), np.float32)
+    pe = np.asarray(L.add_position_encoding(x))
+    assert pe[0, 0, 0] == pytest.approx(0.0)      # sin(0)
+    assert pe[0, 0, 4] == pytest.approx(1.0)      # cos(0)
+    assert not np.allclose(pe[0, 1], pe[0, 2])
+
+
+def test_has_inf_nan_and_batch_size_like():
+    assert bool(L.has_inf(np.array([1.0, np.inf])))
+    assert not bool(L.has_nan(np.array([1.0])))
+    ref = np.zeros((5, 2), np.float32)
+    out = L.fill_constant_batch_size_like(ref, [1, 7], "float32", 3.0)
+    assert out.shape == (5, 7) and float(out[0, 0]) == 3.0
+
+
+# ------------------------------------------------------- functional RNN
+
+def test_dynamic_lstm_matches_cell(rng):
+    B, T, H, C = 2, 4, 3, 5
+    x = rng.normal(0, 0.5, (B, T, C)).astype(np.float32)
+    w_ih = rng.normal(0, 0.5, (C, 4 * H)).astype(np.float32)
+    w_hh = rng.normal(0, 0.5, (H, 4 * H)).astype(np.float32)
+    b = rng.normal(0, 0.1, (4 * H,)).astype(np.float32)
+    hs, cs = R.dynamic_lstm(x @ w_ih, w_hh, b)
+    # numpy single-step re-derivation
+    sig = lambda v: 1 / (1 + np.exp(-v))  # noqa: E731
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    for t in range(T):
+        g = x[:, t] @ w_ih + b + h @ w_hh
+        i, f, gg, o = np.split(g, 4, axis=1)
+        c = sig(f) * c + sig(i) * np.tanh(gg)
+        h = sig(o) * np.tanh(c)
+        np.testing.assert_allclose(np.asarray(hs[:, t]), h, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(cs[:, t]), c, atol=1e-5)
+
+
+def test_dynamic_lstm_length_mask_freezes_state(rng):
+    B, T, H, C = 2, 5, 3, 4
+    x = rng.normal(0, 0.5, (B, T, C)).astype(np.float32)
+    w_ih = rng.normal(0, 0.5, (C, 4 * H)).astype(np.float32)
+    w_hh = rng.normal(0, 0.5, (H, 4 * H)).astype(np.float32)
+    hs, cs = R.dynamic_lstm(x @ w_ih, w_hh,
+                            lengths=np.array([5, 2]))
+    np.testing.assert_allclose(np.asarray(hs[1, 1]), np.asarray(hs[1, 4]))
+
+
+def test_dynamic_gru_reverse(rng):
+    B, T, H = 2, 4, 3
+    xp = rng.normal(0, 0.5, (B, T, 3 * H)).astype(np.float32)
+    w = rng.normal(0, 0.5, (H, 3 * H)).astype(np.float32)
+    fwd = R.dynamic_gru(xp, w)
+    rev = R.dynamic_gru(xp[:, ::-1], w, is_reverse=False)
+    rev2 = R.dynamic_gru(xp, w, is_reverse=True)
+    np.testing.assert_allclose(np.asarray(rev[:, ::-1]),
+                               np.asarray(rev2), atol=1e-5)
+    assert not np.allclose(np.asarray(fwd), np.asarray(rev2))
+
+
+def test_multilayer_bidirectional_lstm(rng):
+    B, T, C, H = 2, 5, 4, 3
+    x = rng.normal(0, 0.5, (B, T, C)).astype(np.float32)
+    mk = lambda cin: {  # noqa: E731
+        "w_ih": rng.normal(0, 0.5, (cin, 4 * H)).astype(np.float32),
+        "w_hh": rng.normal(0, 0.5, (H, 4 * H)).astype(np.float32),
+        "b": rng.normal(0, 0.1, (4 * H,)).astype(np.float32)}
+    weights = [mk(C), mk(C), mk(2 * H), mk(2 * H)]
+    h0 = np.zeros((4, B, H), np.float32)
+    out, lh, lc = R.lstm(x, h0, h0, weights, num_layers=2,
+                         is_bidirec=True)
+    assert out.shape == (B, T, 2 * H)
+    assert lh.shape == (4, B, H) and lc.shape == (4, B, H)
+
+
+# ---------------------------------------------------- detection training
+
+def _boxes(rng, n, lo=0.05, hi=0.95):
+    c = rng.uniform(lo + 0.1, hi - 0.1, (n, 2))
+    wh = rng.uniform(0.05, 0.2, (n, 2))
+    return np.concatenate([c - wh, c + wh], 1).astype(np.float32)
+
+
+def test_ssd_loss_positive_and_differentiable(rng):
+    import jax
+    import jax.numpy as jnp
+    B, P, C, G = 2, 20, 4, 3
+    priors = _boxes(rng, P)
+    loc = rng.normal(0, 0.1, (B, P, 4)).astype(np.float32)
+    conf = rng.normal(0, 1, (B, P, C)).astype(np.float32)
+    gtb = np.stack([_boxes(rng, G) for _ in range(B)])
+    gtl = np.array([[1, 2, -1], [3, -1, -1]])
+    loss = np.asarray(D.ssd_loss(loc, conf, gtb, gtl, priors))
+    assert loss.shape == (B,) and (loss > 0).all()
+    g = jax.grad(lambda lc: jnp.sum(
+        D.ssd_loss(lc, conf, gtb, gtl, priors)))(jnp.asarray(loc))
+    assert bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_ssd_loss_ignores_padded_gt(rng):
+    B, P, C = 1, 12, 3
+    priors = _boxes(rng, P)
+    loc = rng.normal(0, 0.1, (B, P, 4)).astype(np.float32)
+    conf = rng.normal(0, 1, (B, P, C)).astype(np.float32)
+    gt1 = np.stack([_boxes(rng, 2)])
+    lbl_all = np.array([[1, 2]])
+    # same gts plus padding must give identical loss
+    gt2 = np.concatenate([gt1, np.zeros((1, 3, 4), np.float32)], 1)
+    lbl_pad = np.array([[1, 2, -1, -1, -1]])
+    l1 = float(D.ssd_loss(loc, conf, gt1, lbl_all, priors)[0])
+    l2 = float(D.ssd_loss(loc, conf, gt2, lbl_pad, priors)[0])
+    assert l1 == pytest.approx(l2, rel=1e-5)
+
+
+def test_yolov3_loss_drops_when_predicting_gt(rng):
+    import jax
+    import jax.numpy as jnp
+    B, H, W, CN = 1, 4, 4, 3
+    anchors = [10, 14, 23, 27, 37, 58]
+    mask = [0, 1]
+    M = len(mask)
+    x = rng.normal(0, 0.1, (B, M * (5 + CN), H, W)).astype(np.float32)
+    gtb = np.array([[[0.4, 0.4, 0.2, 0.3]]], np.float32)  # cx cy w h
+    gtl = np.array([[1]])
+    base = float(D.yolov3_loss(x, gtb, gtl, anchors, mask, CN,
+                               downsample_ratio=8)[0])
+    # training on this single target must reduce the loss
+    f = lambda xx: jnp.sum(D.yolov3_loss(  # noqa: E731
+        xx, gtb, gtl, anchors, mask, CN, downsample_ratio=8))
+    g = jax.grad(f)(jnp.asarray(x))
+    x2 = jnp.asarray(x) - 0.5 * g
+    assert float(f(x2)) < base
+    assert bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_matrix_nms_suppresses_duplicates():
+    # a near-duplicate of the top box MUST be decayed (a no-op
+    # suppressor passes raw scores through — regression guard), while a
+    # disjoint box keeps its raw score
+    boxes = np.array([[0.1, 0.1, 0.4, 0.4],
+                      [0.11, 0.11, 0.41, 0.41],   # dup of box 0
+                      [0.6, 0.6, 0.9, 0.9]], np.float32)
+    scores = np.array([[0.9, 0.8, 0.7]], np.float32)
+    out, valid = D.matrix_nms(boxes, scores, keep_top_k=3,
+                              post_threshold=0.0)
+    out = np.asarray(out)
+    by_box = {tuple(np.round(r[2:].astype(np.float64), 2)): r[1]
+              for r in out}
+    assert by_box[(0.1, 0.1, 0.4, 0.4)] == pytest.approx(0.9)
+    assert by_box[(0.6, 0.6, 0.9, 0.9)] == pytest.approx(0.7)
+    # the duplicate decays hard (IoU ~0.86 -> linear decay < 0.2)
+    assert by_box[(0.11, 0.11, 0.41, 0.41)] < 0.8 * 0.25
+    # gaussian mode decays too, differently
+    outg, _ = D.matrix_nms(boxes, scores, keep_top_k=3, use_gaussian=True,
+                           post_threshold=0.0)
+    g = {tuple(np.round(r[2:].astype(np.float64), 2)): r[1]
+         for r in np.asarray(outg)}
+    assert g[(0.11, 0.11, 0.41, 0.41)] < 0.8 * 0.8
+
+
+def test_random_crop_per_sample_offsets():
+    import paddle_tpu.ops.nn_functional as F
+    pt.seed(0)
+    # each sample is a coordinate ramp; identical crops across the batch
+    # would make all cropped rows equal
+    x = np.broadcast_to(np.arange(32, dtype=np.float32), (8, 32)).copy()
+    out = np.asarray(F.random_crop(x, [4]))
+    assert out.shape == (8, 4)
+    assert len({float(r[0]) for r in out}) > 1, \
+        "every sample got the same crop offset"
+
+
+def test_target_assign_and_collect_fpn(rng):
+    x = rng.normal(0, 1, (4, 3)).astype(np.float32)
+    out, w = D.target_assign(x, np.array([2, -1, 0]))
+    np.testing.assert_allclose(np.asarray(out[0]), x[2])
+    assert list(np.asarray(w).ravel()) == [1.0, 0.0, 1.0]
+    rois = [_boxes(rng, 5) for _ in range(2)]
+    scores = [rng.uniform(0, 1, (5,)).astype(np.float32)
+              for _ in range(2)]
+    r, s = D.collect_fpn_proposals(rois, scores, 4)
+    assert r.shape == (4, 4)
+    assert np.all(np.diff(np.asarray(s)) <= 1e-6)
+
+
+def test_detection_output_end_to_end(rng):
+    B, P, C = 1, 10, 3
+    priors = _boxes(rng, P)
+    loc = np.zeros((B, P, 4), np.float32)  # decode = priors themselves
+    scores = rng.uniform(0, 1, (B, P, C)).astype(np.float32)
+    outs = L.detection_output(loc, scores, priors, None,
+                              keep_top_k=5, score_threshold=0.1)
+    assert len(outs) == B
+    out, valid = outs[0]
+    assert out.shape[0] == 5
+
+
+def test_locality_aware_nms_merges(rng):
+    boxes = np.array([[0.1, 0.1, 0.5, 0.5], [0.12, 0.12, 0.52, 0.52],
+                      [0.7, 0.7, 0.9, 0.9]], np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    idx, valid, mboxes, mscores = D.locality_aware_nms(
+        boxes, scores, iou_threshold=0.5, max_out=3)
+    # first two merge: merged score = 1.7
+    assert float(np.max(np.asarray(mscores))) == pytest.approx(1.7)
