@@ -45,10 +45,13 @@ from ..optimizer import lr as _lr
 
 def _elementwise(fn):
     def op(x, y, axis: int = -1, act: Optional[str] = None, name=None):
-        if axis not in (-1, 0) and jnp.ndim(y) < jnp.ndim(x):
-            # fluid's axis semantics: align y's dims starting at `axis`
+        if axis != -1 and jnp.ndim(y) < jnp.ndim(x):
+            # fluid's axis semantics: y's dims align with x starting at
+            # `axis` (so axis=0 pads trailing ones — numpy's default
+            # right-alignment only matches fluid's axis=-1)
             y = jnp.reshape(
-                y, y.shape + (1,) * (jnp.ndim(x) - axis - jnp.ndim(y)))
+                y, tuple(jnp.shape(y))
+                + (1,) * (jnp.ndim(x) - axis - jnp.ndim(y)))
         out = fn(x, y)
         if act is not None:
             out = getattr(_act, act)(out)
@@ -308,13 +311,17 @@ class _PyReader:
         self._it = iter(self._gen())
 
     def reset(self):
+        # fluid's per-epoch pattern: reset() then start() re-arms it
         self._it = None
 
     def __iter__(self):
+        if getattr(self, "_it", None) is None:
+            raise ValueError("py_reader: call start() before iterating "
+                             "(and after each reset())")
         return self._it
 
     def __next__(self):
-        return next(self._it)
+        return next(iter(self))
 
 
 def py_reader(capacity: int, shapes, dtypes, lod_levels=None,
@@ -390,7 +397,6 @@ fill_constant_batch_size_like = _math.fill_constant_batch_size_like
 uniform_random_batch_size_like = _math.uniform_random_batch_size_like
 gaussian_random_batch_size_like = _math.gaussian_random_batch_size_like
 uniform_random = _rand.uniform_random
-sampling_id = None  # assigned below
 reverse = _manip.reverse
 unique_with_counts = _manip.unique_with_counts
 crop_tensor = _manip.crop_tensor
@@ -431,7 +437,6 @@ distribute_fpn_proposals = _det.distribute_fpn_proposals
 collect_fpn_proposals = _det.collect_fpn_proposals
 box_decoder_and_assign = _det.box_decoder_and_assign
 polygon_box_transform = _det.polygon_box_transform
-detection_output = None  # assigned below
 
 # sampling / search
 nce = _samp.nce_loss
@@ -496,19 +501,6 @@ import builtins as _builtins  # noqa: E402
 builtins_range = _builtins.range
 
 
-def _missing(name):
-    raise NotImplementedError(
-        f"fluid.layers.{name} has no TPU lowering yet")
-
-
-# Module __getattr__ only fires for genuinely absent names; make every
-# still-None placeholder absent so lookups fail loudly instead of
-# returning None.
-_UNAVAILABLE = {k for k, v in list(globals().items())
-                if v is None and not k.startswith("_")}
-for _k in _UNAVAILABLE:
-    del globals()[_k]
-
 # Graph-recording block APIs with no tracing analogue: the `with
 # rnn.step():` protocol records ops into a sub-block, which has no
 # meaning when tracing IS compilation. The working equivalents:
@@ -516,14 +508,11 @@ _REDIRECTED = {
     "DynamicRNN": "nn.RNN / ops.control_flow.static_rnn over dense "
                   "padded sequences (+ lengths)",
     "StaticRNN": "ops.control_flow.static_rnn (lax.scan)",
-    "While": None,  # exported above as while_loop-backed callable
 }
 
 
 def __getattr__(name):
-    if name in _UNAVAILABLE:
-        _missing(name)
-    if name in _REDIRECTED and _REDIRECTED[name]:
+    if name in _REDIRECTED:
         raise NotImplementedError(
             f"fluid.layers.{name} is a graph-recording block API; use "
             f"{_REDIRECTED[name]} instead")

@@ -1,0 +1,59 @@
+"""CRNN-CTC OCR model — the reference's OCR recognition family (ref:
+the warpctc pipeline: operators/warpctc_op.cc + ctc_align_op.cu, used by
+models like ocr_recognition with img conv -> GRU -> CTC).
+
+conv stack (collapse height) -> bidirectional GRU -> per-frame vocab
+logits -> CTC loss / greedy decode, all dense-padded static shapes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..ops.loss import ctc_loss
+from ..ops.sequence import ctc_greedy_decoder
+
+
+class CRNNCTC(nn.Layer):
+    """images [B, 1, H, W] -> logits [B, W//4, num_classes+1]; class
+    num_classes is the CTC blank (reference convention: blank last)."""
+
+    def __init__(self, num_classes: int, height: int = 32, base: int = 32,
+                 rnn_hidden: int = 64):
+        super().__init__()
+        self.conv1 = nn.Conv2D(1, base, 3, stride=1, padding=1)
+        self.bn1 = nn.BatchNorm2D(base)
+        self.conv2 = nn.Conv2D(base, base * 2, 3, stride=1, padding=1)
+        self.bn2 = nn.BatchNorm2D(base * 2)
+        feat = base * 2 * (height // 4)
+        self.rnn = nn.GRU(feat, rnn_hidden, direction="bidirect")
+        self.head = nn.Linear(2 * rnn_hidden, num_classes + 1)
+        self.blank = num_classes
+
+    def forward(self, images):
+        h = F.relu(self.bn1(self.conv1(images)))
+        h = F.max_pool2d(h, 2, 2)
+        h = F.relu(self.bn2(self.conv2(h)))
+        h = F.max_pool2d(h, 2, 2)               # [B, C, H/4, W/4]
+        b, c, hh, ww = h.shape
+        seq = jnp.transpose(h, (0, 3, 1, 2)).reshape(b, ww, c * hh)
+        out, _ = self.rnn(seq)
+        return self.head(out)                   # [B, T, num_classes+1]
+
+    def loss(self, images, labels, label_lengths):
+        logits = self.forward(images)
+        log_probs = jnp.transpose(
+            F.log_softmax(logits, axis=-1), (1, 0, 2))  # [T, B, C]
+        t = logits.shape[1]
+        input_lengths = jnp.full((images.shape[0],), t, jnp.int32)
+        return ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                        blank=self.blank)
+
+    def decode(self, images):
+        logits = self.forward(images)
+        t = logits.shape[1]
+        lengths = jnp.full((images.shape[0],), t, jnp.int32)
+        return ctc_greedy_decoder(F.log_softmax(logits, axis=-1), lengths,
+                                  blank=self.blank)

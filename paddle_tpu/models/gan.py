@@ -1,0 +1,146 @@
+"""DCGAN — the reference's generative family (ref:
+/root/reference/python/paddle/fluid/contrib/tests/test_image_gan... and
+the c_gan book example pattern: separate G/D programs sharing no
+parameters, alternating optimization).
+
+TPU-native: G and D are plain Layers; GANTrainStep compiles BOTH
+adversarial updates into one jitted program per call (the reference
+builds two Programs and alternates executor runs — here XLA sees the
+whole alternation and can overlap G/D compute).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+
+
+class Generator(nn.Layer):
+    """z [B, zdim] -> image [B, 1, 28, 28] (DCGAN-style deconv stack)."""
+
+    def __init__(self, z_dim: int = 64, base: int = 32):
+        super().__init__()
+        self.fc = nn.Linear(z_dim, base * 2 * 7 * 7)
+        self.bn0 = nn.BatchNorm1D(base * 2 * 7 * 7)
+        self.deconv1 = nn.Conv2DTranspose(base * 2, base, 4, stride=2,
+                                          padding=1)
+        self.bn1 = nn.BatchNorm2D(base)
+        self.deconv2 = nn.Conv2DTranspose(base, 1, 4, stride=2, padding=1)
+        self.base = base
+
+    def forward(self, z):
+        h = F.relu(self.bn0(self.fc(z)))
+        h = h.reshape(z.shape[0], self.base * 2, 7, 7)
+        h = F.relu(self.bn1(self.deconv1(h)))
+        return jnp.tanh(self.deconv2(h))
+
+
+class Discriminator(nn.Layer):
+    """image -> real/fake logit."""
+
+    def __init__(self, base: int = 32):
+        super().__init__()
+        self.conv1 = nn.Conv2D(1, base, 4, stride=2, padding=1)
+        self.conv2 = nn.Conv2D(base, base * 2, 4, stride=2, padding=1)
+        self.bn2 = nn.BatchNorm2D(base * 2)
+        self.fc = nn.Linear(base * 2 * 7 * 7, 1)
+
+    def forward(self, x):
+        h = F.leaky_relu(self.conv1(x), 0.2)
+        h = F.leaky_relu(self.bn2(self.conv2(h)), 0.2)
+        return self.fc(h.reshape(x.shape[0], -1))
+
+
+def _bce_logits(logit, target: float):
+    from ..ops.loss import binary_cross_entropy_with_logits
+    return binary_cross_entropy_with_logits(
+        logit, jnp.full_like(logit, target), reduction="mean")
+
+
+class GANTrainStep:
+    """Alternating adversarial update compiled as one program.
+
+    d_loss = BCE(D(real),1) + BCE(D(G(z)),0);  g_loss = BCE(D(G(z)),1).
+    Both parameter sets update each call (one D step + one G step), the
+    standard DCGAN schedule.
+    """
+
+    def __init__(self, generator: Generator, disc: Discriminator,
+                 g_opt, d_opt, seed: int = 0):
+        from ..core import random as _random
+        from ..nn.layer import functional_call
+
+        self.g = generator
+        self.d = disc
+        self.g_opt = g_opt
+        self.d_opt = d_opt
+        g_params = generator.param_dict()
+        d_params = disc.param_dict()
+        self.state = {
+            "g": g_params, "gb": generator.buffer_dict(),
+            "d": d_params, "db": disc.buffer_dict(),
+            "g_opt": g_opt.init(g_params), "d_opt": d_opt.init(d_params),
+            "rng": _random.make_key(seed),
+        }
+
+        def step(state, real):
+            rng, zkey, dropkey = jax.random.split(state["rng"], 3)
+            z = jax.random.normal(zkey, (real.shape[0],
+                                         generator.fc.weight.shape[0]))
+
+            def d_loss_fn(d_params):
+                with _random.rng_scope(default=dropkey, dropout=dropkey):
+                    fake, _ = functional_call(self.g, state["g"],
+                                              state["gb"], z,
+                                              capture_buffers=True)
+                    real_logit, db = functional_call(
+                        self.d, d_params, state["db"], real,
+                        capture_buffers=True)
+                    fake_logit, db = functional_call(
+                        self.d, d_params, db, fake, capture_buffers=True)
+                return (_bce_logits(real_logit, 1.0)
+                        + _bce_logits(fake_logit, 0.0)), db
+
+            (d_loss, db), d_grads = jax.value_and_grad(
+                d_loss_fn, has_aux=True)(state["d"])
+            new_d, new_d_opt = self.d_opt.apply_gradients(
+                state["d"], d_grads, state["d_opt"])
+
+            def g_loss_fn(g_params):
+                with _random.rng_scope(default=dropkey, dropout=dropkey):
+                    fake, gb = functional_call(self.g, g_params,
+                                               state["gb"], z,
+                                               capture_buffers=True)
+                    fake_logit, _ = functional_call(
+                        self.d, new_d, db, fake, capture_buffers=True)
+                return _bce_logits(fake_logit, 1.0), gb
+
+            (g_loss, gb), g_grads = jax.value_and_grad(
+                g_loss_fn, has_aux=True)(state["g"])
+            new_g, new_g_opt = self.g_opt.apply_gradients(
+                state["g"], g_grads, state["g_opt"])
+            new_state = {"g": new_g, "gb": gb, "d": new_d, "db": db,
+                         "g_opt": new_g_opt, "d_opt": new_d_opt,
+                         "rng": rng}
+            return new_state, {"d_loss": d_loss, "g_loss": g_loss}
+
+        self._jitted = jax.jit(step, donate_argnums=(0,))
+
+    def __call__(self, real):
+        self.state, metrics = self._jitted(self.state, real)
+        return metrics
+
+    def sample(self, n: int, key=None):
+        from ..core import random as _random
+        from ..nn.layer import functional_call
+        if key is None:
+            key = _random.next_key("random")
+        z = jax.random.normal(key, (n, self.g.fc.weight.shape[0]))
+        out, _ = functional_call(self.g, self.state["g"], self.state["gb"],
+                                 z, capture_buffers=True)
+        return out

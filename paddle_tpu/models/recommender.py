@@ -1,0 +1,102 @@
+"""Recommender models — the reference's book chapter 5 dual-tower
+network (/root/reference/python/paddle/fluid/tests/book/
+test_recommender_system.py: user/movie feature towers + cosine match)
+and a DeepFM CTR model for the PS-style sparse workload the reference's
+distributed stack exists for (large_scale_kv.h sparse tables,
+distribute_lookup_table.py).
+
+TPU-native notes: the categorical features are dense int arrays (the PS
+path exchanges RowSlices for the embedding gradients); the FM pairwise
+term uses the (sum^2 - sum-of-squares)/2 identity so it is two matmul-
+shaped reductions instead of an O(F^2) loop.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+
+
+class _Tower(nn.Layer):
+    def __init__(self, cat_cardinalities: Sequence[int], embed_dim: int,
+                 hidden: int):
+        super().__init__()
+        self.embeds = nn.LayerList(
+            [nn.Embedding(c, embed_dim) for c in cat_cardinalities])
+        self.fc = nn.Linear(len(cat_cardinalities) * embed_dim, hidden)
+
+    def forward(self, cats):
+        """cats: [B, n_features] int ids."""
+        es = [emb(cats[:, i]) for i, emb in enumerate(self.embeds)]
+        return jnp.tanh(self.fc(jnp.concatenate(es, axis=-1)))
+
+
+class RecommenderSystem(nn.Layer):
+    """Dual-tower rating model (book ch.5): user tower (id, gender, age,
+    job) x movie tower (id, category) -> scaled cosine -> rating."""
+
+    def __init__(self, n_users: int = 6041, n_genders: int = 2,
+                 n_ages: int = 7, n_jobs: int = 21,
+                 n_movies: int = 3953, n_categories: int = 19,
+                 embed_dim: int = 32, hidden: int = 200):
+        super().__init__()
+        self.user_tower = _Tower([n_users, n_genders, n_ages, n_jobs],
+                                 embed_dim, hidden)
+        self.movie_tower = _Tower([n_movies, n_categories], embed_dim,
+                                  hidden)
+
+    def forward(self, user_feats, movie_feats):
+        u = self.user_tower(user_feats)
+        m = self.movie_tower(movie_feats)
+        un = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+        mn = m / jnp.linalg.norm(m, axis=-1, keepdims=True)
+        return 5.0 * jnp.sum(un * mn, axis=-1, keepdims=True)
+
+    def loss(self, user_feats, movie_feats, rating):
+        pred = self.forward(user_feats, movie_feats)
+        return jnp.mean((pred - rating) ** 2)
+
+
+class DeepFM(nn.Layer):
+    """DeepFM CTR model: first-order + FM second-order + deep tower over
+    shared feature embeddings (the workload class the reference's
+    parameter-server mode serves; ref distributed CTR reader
+    ctr_dataset_reader pattern in incubate/fleet tests).
+    """
+
+    def __init__(self, field_cardinalities: Sequence[int],
+                 embed_dim: int = 16, hidden: Sequence[int] = (64, 32)):
+        super().__init__()
+        self.first_order = nn.LayerList(
+            [nn.Embedding(c, 1) for c in field_cardinalities])
+        self.embeds = nn.LayerList(
+            [nn.Embedding(c, embed_dim) for c in field_cardinalities])
+        dims = [len(field_cardinalities) * embed_dim, *hidden]
+        self.deep = nn.LayerList(
+            [nn.Linear(dims[i], dims[i + 1]) for i in range(len(hidden))])
+        self.out = nn.Linear(1 + 1 + dims[-1], 1)
+
+    def forward(self, fields):
+        """fields: [B, n_fields] int ids -> logit [B, 1]."""
+        fo = sum(emb(fields[:, i])
+                 for i, emb in enumerate(self.first_order))   # [B, 1]
+        es = jnp.stack([emb(fields[:, i])
+                        for i, emb in enumerate(self.embeds)], axis=1)
+        # FM pairwise: 0.5 * ((sum_f e)^2 - sum_f e^2), summed over dim
+        s = jnp.sum(es, axis=1)
+        fm = 0.5 * jnp.sum(s * s - jnp.sum(es * es, axis=1), axis=-1,
+                           keepdims=True)                      # [B, 1]
+        deep = es.reshape(es.shape[0], -1)
+        for fc in self.deep:
+            deep = F.relu(fc(deep))
+        return self.out(jnp.concatenate([fo, fm, deep], axis=-1))
+
+    def loss(self, fields, click):
+        from ..ops.loss import binary_cross_entropy_with_logits
+        logit = self.forward(fields)[:, 0]
+        return binary_cross_entropy_with_logits(
+            logit, click.astype(logit.dtype), reduction="mean")

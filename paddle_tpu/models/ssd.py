@@ -1,0 +1,116 @@
+"""SSD single-shot detector — the reference's detection model family
+(ref: fluid/layers/detection.py multi_box_head + ssd_loss +
+detection_output; PaddleCV ssd/mobilenet_ssd network shape).
+
+A compact MobileNet-ish backbone with two extra strided stages; each
+selected feature map contributes a (loc [B,P_i,4], conf [B,P_i,C])
+head and a static prior-box grid. Everything is static-shape: the
+priors are computed once at build time (they depend only on feature-map
+geometry), so the whole detector jits into one XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..ops import detection as det
+
+
+class _ConvBNRelu(nn.Layer):
+    def __init__(self, cin, cout, stride=1):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, 3, stride=stride, padding=1)
+        self.bn = nn.BatchNorm2D(cout)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+class SSDLite(nn.Layer):
+    """image [B, 3, S, S] -> (loc [B, P, 4], conf [B, P, C+1],
+    priors [P, 4], prior_vars [P, 4]). Class 0 is background
+    (reference convention)."""
+
+    def __init__(self, num_classes: int = 20, image_size: int = 128,
+                 base: int = 32):
+        super().__init__()
+        self.num_classes = num_classes
+        self.image_size = image_size
+        c = num_classes + 1
+        self.stem = _ConvBNRelu(3, base, stride=2)        # S/2
+        self.s1 = _ConvBNRelu(base, base * 2, stride=2)   # S/4
+        self.s2 = _ConvBNRelu(base * 2, base * 4, stride=2)  # S/8
+        self.s3 = _ConvBNRelu(base * 4, base * 4, stride=2)  # S/16
+        feat_strides = (8, 16)
+        self.head_feats = ("s2", "s3")
+        min_ratio, max_ratio = 0.2, 0.9
+        n_priors = []
+        priors = []
+        pvars = []
+        self.loc_heads = nn.LayerList()
+        self.conf_heads = nn.LayerList()
+        chans = {"s2": base * 4, "s3": base * 4}
+        for i, (name, stride) in enumerate(zip(self.head_feats,
+                                               feat_strides)):
+            fm = image_size // stride
+            s_k = min_ratio + (max_ratio - min_ratio) * i / max(
+                len(feat_strides) - 1, 1)
+            s_k1 = min_ratio + (max_ratio - min_ratio) * (i + 1) / max(
+                len(feat_strides) - 1, 1)
+            boxes, variances = det.prior_box(
+                (fm, fm), (image_size, image_size),
+                min_sizes=[s_k * image_size],
+                max_sizes=[s_k1 * image_size],
+                aspect_ratios=(2.0,), flip=True, clip=True)
+            a = boxes.shape[2]
+            n_priors.append(a)
+            priors.append(np.asarray(boxes).reshape(-1, 4))
+            pvars.append(np.asarray(variances).reshape(-1, 4))
+            self.loc_heads.append(nn.Conv2D(chans[name], a * 4, 3,
+                                            padding=1))
+            self.conf_heads.append(nn.Conv2D(chans[name], a * c, 3,
+                                             padding=1))
+        self.register_buffer("priors",
+                             jnp.asarray(np.concatenate(priors, 0)))
+        self.register_buffer("prior_vars",
+                             jnp.asarray(np.concatenate(pvars, 0)))
+
+    def forward(self, images):
+        b = images.shape[0]
+        c = self.num_classes + 1
+        h = self.stem(images)
+        h = self.s1(h)
+        f2 = self.s2(h)
+        f3 = self.s3(f2)
+        locs, confs = [], []
+        for feat, lh, ch in zip((f2, f3), self.loc_heads,
+                                self.conf_heads):
+            lo = lh(feat)   # [B, A*4, H, W]
+            co = ch(feat)
+            locs.append(jnp.transpose(lo, (0, 2, 3, 1)).reshape(b, -1, 4))
+            confs.append(jnp.transpose(co, (0, 2, 3, 1)).reshape(b, -1, c))
+        return jnp.concatenate(locs, 1), jnp.concatenate(confs, 1)
+
+    def loss(self, images, gt_box, gt_label):
+        """gt_box [B, G, 4] normalized corners (0-padded); gt_label
+        [B, G] with -1 padding; labels are 1..num_classes (0=background).
+        """
+        loc, conf = self.forward(images)
+        per_image = det.ssd_loss(loc, conf, gt_box, gt_label, self.priors,
+                                 prior_box_var=None)
+        return jnp.mean(per_image)
+
+    def predict(self, images, keep_top_k: int = 20,
+                score_threshold: float = 0.3):
+        from ..layers import detection_output
+        loc, conf = self.forward(images)
+        scores = F.softmax(conf, axis=-1)
+        return detection_output(loc, scores, self.priors,
+                                jnp.mean(self.prior_vars, axis=0),
+                                keep_top_k=keep_top_k,
+                                score_threshold=score_threshold)

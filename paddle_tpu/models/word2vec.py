@@ -1,0 +1,74 @@
+"""Word2vec models — the reference's book chapter 4
+(/root/reference/python/paddle/fluid/tests/book/test_word2vec.py: N-gram
+neural LM with concatenated embeddings) and the NCE skip-gram variant its
+nce layer exists for (layers/nn.py nce, operators/nce_op.cc).
+
+TPU-native: embeddings are gathers that fuse into the surrounding
+matmuls; NCE negatives come from the framework RNG so sampling runs
+on-device inside the jitted train step.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..ops.sampling import nce_loss
+
+
+class NGramLM(nn.Layer):
+    """The book's N-gram model: concat N-1 word embeddings -> hidden ->
+    softmax over the vocabulary (test_word2vec.py network)."""
+
+    def __init__(self, vocab_size: int, embed_dim: int = 32,
+                 context: int = 4, hidden: int = 256):
+        super().__init__()
+        self.embed = nn.Embedding(vocab_size, embed_dim)
+        self.fc1 = nn.Linear(context * embed_dim, hidden)
+        self.fc2 = nn.Linear(hidden, vocab_size)
+        self.context = context
+
+    def forward(self, words):
+        """words: [B, context] int ids -> logits [B, vocab]."""
+        e = self.embed(words)                   # [B, ctx, D]
+        h = e.reshape(e.shape[0], -1)
+        h = F.relu(self.fc1(h))
+        return self.fc2(h)
+
+    def loss(self, words, next_word):
+        return F.cross_entropy(self.forward(words), next_word)
+
+
+class SkipGramNCE(nn.Layer):
+    """Skip-gram trained with noise-contrastive estimation
+    (ref: nce_op.cc; word2vec's standard large-vocab trick — no full
+    softmax over the vocabulary ever materializes)."""
+
+    def __init__(self, vocab_size: int, embed_dim: int = 64,
+                 num_neg: int = 8):
+        super().__init__()
+        self.in_embed = nn.Embedding(vocab_size, embed_dim)
+        self.out_weight = nn.Parameter(
+            jnp.zeros((vocab_size, embed_dim), jnp.float32))
+        self.vocab_size = vocab_size
+        self.num_neg = num_neg
+
+    def forward(self, center):
+        return self.in_embed(center)
+
+    def loss(self, center, context):
+        """center, context: [B] int ids."""
+        x = self.in_embed(center)
+        per_ex = nce_loss(x, self.out_weight, context,
+                          num_total_classes=self.vocab_size,
+                          num_neg_samples=self.num_neg,
+                          sampler="log_uniform")
+        return jnp.mean(per_ex)
+
+    def similarity(self, a, b):
+        ea = self.in_embed(a)
+        eb = self.in_embed(b)
+        na = ea / jnp.linalg.norm(ea, axis=-1, keepdims=True)
+        nb = eb / jnp.linalg.norm(eb, axis=-1, keepdims=True)
+        return jnp.sum(na * nb, axis=-1)

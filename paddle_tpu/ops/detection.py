@@ -626,7 +626,8 @@ def roi_perspective_transform(feat, rois, transformed_height: int,
 def matrix_nms(bboxes, scores, score_threshold: float = 0.05,
                post_threshold: float = 0.0, nms_top_k: int = 100,
                keep_top_k: int = 100, use_gaussian: bool = False,
-               gaussian_sigma: float = 2.0, normalized: bool = True):
+               gaussian_sigma: float = 2.0, normalized: bool = True,
+               background_label: int = 0):
     """Matrix NMS (ref: matrix_nms_op.cc — parallel soft suppression via
     the pairwise IoU matrix; unlike NMSFast there is no sequential loop,
     which is exactly the TPU-friendly formulation).
@@ -660,7 +661,11 @@ def matrix_nms(bboxes, scores, score_threshold: float = 0.05,
         return jnp.concatenate([cls_col, new_s[:, None], b], axis=1)
 
     per_class = jnp.concatenate(
-        [one_class(ci, scores[ci]) for ci in range(c)], axis=0)
+        [one_class(ci, scores[ci]) for ci in range(c)
+         if ci != background_label], axis=0)
+    if per_class.shape[0] == 0:
+        raise ValueError("matrix_nms: no foreground classes "
+                         "(set background_label=-1 to score all)")
     topk = min(keep_top_k, per_class.shape[0])
     best_s, best_i = lax.top_k(per_class[:, 1], topk)
     out = per_class[best_i]

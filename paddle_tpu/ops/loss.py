@@ -311,11 +311,16 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths,
 
     def logaddexp3(a, b_, c_):
         m = jnp.maximum(jnp.maximum(a, b_), c_)
-        m_safe = jnp.where(m == neg_inf, 0.0, m)
+        dead = m == neg_inf
+        m_safe = jnp.where(dead, 0.0, m)
+        # zero the diffs on dead cells BEFORE exp/log: grad of the
+        # unselected log(0) branch is inf, and inf * where-mask = NaN
+        da = jnp.where(dead, 0.0, a - m_safe)
+        db = jnp.where(dead, 0.0, b_ - m_safe)
+        dc = jnp.where(dead, 0.0, c_ - m_safe)
         return jnp.where(
-            m == neg_inf, neg_inf,
-            m_safe + jnp.log(jnp.exp(a - m_safe) + jnp.exp(b_ - m_safe)
-                             + jnp.exp(c_ - m_safe)))
+            dead, neg_inf,
+            m_safe + jnp.log(jnp.exp(da) + jnp.exp(db) + jnp.exp(dc)))
 
     def step(alpha, lp_t):
         prev1 = jnp.concatenate(
@@ -343,8 +348,12 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths,
     a_last = jnp.take_along_axis(alpha_final, idx_last, axis=1)[:, 0]
     a_prev = jnp.take_along_axis(alpha_final, idx_prev, axis=1)[:, 0]
     m = jnp.maximum(a_last, a_prev)
-    m_safe = jnp.where(m == neg_inf, 0.0, m)
-    ll = m_safe + jnp.log(jnp.exp(a_last - m_safe) + jnp.exp(a_prev - m_safe))
+    dead = m == neg_inf
+    m_safe = jnp.where(dead, 0.0, m)
+    dl = jnp.where(dead, 0.0, a_last - m_safe)
+    dp = jnp.where(dead, 0.0, a_prev - m_safe)
+    ll = jnp.where(dead, neg_inf,
+                   m_safe + jnp.log(jnp.exp(dl) + jnp.exp(dp)))
     loss = -ll
     if reduction == "mean":
         return jnp.mean(loss / jnp.maximum(label_lengths, 1))

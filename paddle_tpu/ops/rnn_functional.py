@@ -257,5 +257,9 @@ def lstm(input, init_h, init_c, weights: Sequence, lengths=None,
         x = outs[0] if d == 1 else jnp.concatenate(outs, axis=-1)
         if dropout_prob > 0.0 and training and layer < num_layers - 1:
             from .nn_functional import dropout
-            x = dropout(x, p=dropout_prob, training=True, key=key)
+            if key is not None:
+                key, sub = jax.random.split(key)  # distinct mask per layer
+            else:
+                sub = None  # dropout draws from the framework RNG stream
+            x = dropout(x, p=dropout_prob, training=True, key=sub)
     return x, jnp.stack(last_h), jnp.stack(last_c)

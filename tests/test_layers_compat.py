@@ -327,7 +327,7 @@ def test_matrix_nms_suppresses_duplicates():
                       [0.6, 0.6, 0.9, 0.9]], np.float32)
     scores = np.array([[0.9, 0.8, 0.7]], np.float32)
     out, valid = D.matrix_nms(boxes, scores, keep_top_k=3,
-                              post_threshold=0.0)
+                              post_threshold=0.0, background_label=-1)
     out = np.asarray(out)
     by_box = {tuple(np.round(r[2:].astype(np.float64), 2)): r[1]
               for r in out}
@@ -337,7 +337,7 @@ def test_matrix_nms_suppresses_duplicates():
     assert by_box[(0.11, 0.11, 0.41, 0.41)] < 0.8 * 0.25
     # gaussian mode decays too, differently
     outg, _ = D.matrix_nms(boxes, scores, keep_top_k=3, use_gaussian=True,
-                           post_threshold=0.0)
+                           post_threshold=0.0, background_label=-1)
     g = {tuple(np.round(r[2:].astype(np.float64), 2)): r[1]
          for r in np.asarray(outg)}
     assert g[(0.11, 0.11, 0.41, 0.41)] < 0.8 * 0.8

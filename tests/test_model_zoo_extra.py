@@ -1,0 +1,198 @@
+"""New model families: word2vec, recommender/CTR, DCGAN, CRNN-CTC, SSD.
+
+Convergence tests mirror the reference's book chapter tests
+(/root/reference/python/paddle/fluid/tests/book/test_word2vec.py,
+test_recommender_system.py: train few iterations, assert loss drops
+below a threshold)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models import (CRNNCTC, DeepFM, Discriminator,
+                               GANTrainStep, Generator, NGramLM,
+                               RecommenderSystem, SkipGramNCE, SSDLite)
+from paddle_tpu.static import TrainStep
+
+
+def test_ngram_lm_memorizes(rng):
+    pt.seed(0)
+    vocab = 30
+    model = NGramLM(vocab, embed_dim=16, context=3, hidden=32)
+    opt = pt.optimizer.Adam(learning_rate=5e-3)
+    step = TrainStep(model, opt, lambda out, y: pt.nn.functional
+                     .cross_entropy(out, y))
+    # deterministic successor pattern: next = (sum of ctx) % vocab
+    ctx = rng.integers(0, vocab, (64, 3)).astype(np.int32)
+    nxt = (ctx.sum(1) % vocab).astype(np.int64)
+    first = float(step(ctx, labels=nxt)["loss"])
+    for _ in range(60):
+        last = float(step(ctx, labels=nxt)["loss"])
+    assert last < first * 0.5, (first, last)
+
+
+def test_skipgram_nce_pulls_cooccurring_words(rng):
+    pt.seed(0)
+    vocab = 40
+    m = SkipGramNCE(vocab, embed_dim=16, num_neg=5)
+    opt = pt.optimizer.Adam(learning_rate=1e-2)
+
+    class _M(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.inner = m
+
+        def forward(self, c, ctx):
+            return self.inner.loss(c, ctx)
+
+    net = _M()
+    step = TrainStep(net, opt, lambda out: out)
+    # words 2k and 2k+1 always co-occur
+    centers = rng.integers(0, vocab // 2, (256,)) * 2
+    contexts = centers + 1
+    first = float(step(centers.astype(np.int32),
+                       contexts.astype(np.int64), labels=())["loss"])
+    for _ in range(40):
+        last = float(step(centers.astype(np.int32),
+                          contexts.astype(np.int64), labels=())["loss"])
+    assert last < first, (first, last)
+
+
+def test_recommender_fits_ratings(rng):
+    pt.seed(0)
+    model = RecommenderSystem(n_users=50, n_movies=60, embed_dim=8,
+                              hidden=32)
+    opt = pt.optimizer.Adam(learning_rate=2e-3)
+
+    class _M(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.inner = model
+
+        def forward(self, u, mv, r):
+            return self.inner.loss(u, mv, r)
+
+    step = TrainStep(_M(), opt, lambda out: out)
+    B = 64
+    users = np.stack([rng.integers(0, 50, B), rng.integers(0, 2, B),
+                      rng.integers(0, 7, B), rng.integers(0, 21, B)],
+                     1).astype(np.int32)
+    movies = np.stack([rng.integers(0, 60, B),
+                       rng.integers(0, 19, B)], 1).astype(np.int32)
+    ratings = rng.uniform(1, 5, (B, 1)).astype(np.float32)
+    first = float(step(users, movies, ratings, labels=())["loss"])
+    for _ in range(50):
+        last = float(step(users, movies, ratings, labels=())["loss"])
+    assert last < first * 0.7, (first, last)
+
+
+def test_deepfm_learns_feature_interaction(rng):
+    pt.seed(0)
+    fields = [20, 20, 10]
+    model = DeepFM(fields, embed_dim=8, hidden=(32, 16))
+    opt = pt.optimizer.Adam(learning_rate=5e-3)
+
+    class _M(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.inner = model
+
+        def forward(self, f, y):
+            return self.inner.loss(f, y)
+
+    step = TrainStep(_M(), opt, lambda out: out)
+    B = 256
+    x = np.stack([rng.integers(0, c, B) for c in fields], 1) \
+        .astype(np.int32)
+    # click iff field0 and field1 ids have the same parity (a pure
+    # second-order interaction — exactly what the FM term models)
+    y = ((x[:, 0] % 2) == (x[:, 1] % 2)).astype(np.int64)
+    first = float(step(x, y, labels=())["loss"])
+    for _ in range(80):
+        last = float(step(x, y, labels=())["loss"])
+    assert last < 0.5 and last < first, (first, last)
+
+
+@pytest.mark.slow
+def test_dcgan_adversarial_losses_move(rng):
+    pt.seed(0)
+    g = Generator(z_dim=16, base=8)
+    d = Discriminator(base=8)
+    step = GANTrainStep(g, d,
+                        pt.optimizer.Adam(learning_rate=2e-4, beta1=0.5),
+                        pt.optimizer.Adam(learning_rate=2e-4, beta1=0.5))
+    real = rng.normal(0, 1, (8, 1, 28, 28)).astype(np.float32)
+    m0 = step(real)
+    d0 = float(m0["d_loss"])
+    for _ in range(10):
+        m = step(real)
+    # D learns to separate real from fake: its loss drops
+    assert float(m["d_loss"]) < d0
+    # G still produces images of the right shape, values in tanh range
+    imgs = np.asarray(step.sample(4))
+    assert imgs.shape == (4, 1, 28, 28)
+    assert np.all(imgs <= 1.0) and np.all(imgs >= -1.0)
+
+
+def test_crnn_ctc_overfits_tiny_vocab(rng):
+    pt.seed(0)
+    model = CRNNCTC(num_classes=5, height=16, base=8, rnn_hidden=16)
+    opt = pt.optimizer.Adam(learning_rate=2e-3)
+
+    class _M(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.inner = model
+
+        def forward(self, img, lab, lens):
+            return self.inner.loss(img, lab, lens)
+
+    step = TrainStep(_M(), opt, lambda out: out)
+    B, W = 4, 32
+    imgs = rng.normal(0, 1, (B, 1, 16, W)).astype(np.float32)
+    labels = rng.integers(0, 5, (B, 3)).astype(np.int64)
+    lens = np.full((B,), 3, np.int64)
+    first = float(step(imgs, labels, lens, labels=())["loss"])
+    for _ in range(60):
+        last = float(step(imgs, labels, lens, labels=())["loss"])
+    assert last < first * 0.5, (first, last)
+    step.sync_to_model()  # params were donated into the jitted step
+    decoded, dec_len = model.decode(imgs)
+    assert decoded.shape[0] == B
+
+
+def test_ssd_lite_shapes_and_loss_trains(rng):
+    pt.seed(0)
+    model = SSDLite(num_classes=3, image_size=64, base=8)
+    loc, conf = model(np.zeros((2, 3, 64, 64), np.float32))
+    p = model.priors.shape[0]
+    assert loc.shape == (2, p, 4) and conf.shape == (2, p, 4)
+    assert p > 0
+    # priors normalized
+    pr = np.asarray(model.priors)
+    assert pr.min() >= 0.0 and pr.max() <= 1.0
+
+    opt = pt.optimizer.Adam(learning_rate=1e-3)
+
+    class _M(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.inner = model
+
+        def forward(self, img, gb, gl):
+            return self.inner.loss(img, gb, gl)
+
+    step = TrainStep(_M(), opt, lambda out: out)
+    imgs = rng.normal(0, 1, (2, 3, 64, 64)).astype(np.float32)
+    gtb = np.array([[[0.1, 0.1, 0.4, 0.5], [0.5, 0.5, 0.9, 0.9]],
+                    [[0.3, 0.2, 0.6, 0.7], [0, 0, 0, 0]]], np.float32)
+    gtl = np.array([[1, 2], [3, -1]])
+    first = float(step(imgs, gtb, gtl, labels=())["loss"])
+    for _ in range(25):
+        last = float(step(imgs, gtb, gtl, labels=())["loss"])
+    assert last < first, (first, last)
+    step.sync_to_model()  # params were donated into the jitted step
+    # inference path produces [keep_top_k, 6] detections per image
+    outs = model.predict(imgs[:1], keep_top_k=5)
+    det, valid = outs[0]
+    assert det.shape == (5, 6)
