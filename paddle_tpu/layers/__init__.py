@@ -433,6 +433,12 @@ prroi_pool = _det.prroi_pool
 roi_perspective_transform = _det.roi_perspective_transform
 deformable_conv = _convx.deformable_conv
 generate_proposals = _det.generate_proposals
+rpn_target_assign = _det.rpn_target_assign
+retinanet_target_assign = _det.retinanet_target_assign
+retinanet_detection_output = _det.retinanet_detection_output
+sigmoid_focal_loss = _det.sigmoid_focal_loss
+generate_proposal_labels = _det.generate_proposal_labels
+generate_mask_labels = _det.generate_mask_labels
 distribute_fpn_proposals = _det.distribute_fpn_proposals
 collect_fpn_proposals = _det.collect_fpn_proposals
 box_decoder_and_assign = _det.box_decoder_and_assign

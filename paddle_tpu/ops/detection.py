@@ -953,3 +953,265 @@ def polygon_box_transform(x):
                           jnp.arange(w, dtype=x.dtype) * 4, indexing="ij")
     base = jnp.stack([gx, gy] * (c // 2), axis=0)  # [C, H, W]
     return base[None] - x
+
+
+def _matched_box_encode(boxes, matched_gt, off: float = 0.0,
+                        weights=None):
+    """Elementwise center-size encode of each box's MATCHED gt — the
+    matched-pair complement of box_coder's pairwise encode (box_coder
+    produces [G, N, 4]; here row i encodes pair (boxes[i], gt[i]))."""
+    bw = jnp.maximum(boxes[:, 2] - boxes[:, 0] + off, 1e-9)
+    bh = jnp.maximum(boxes[:, 3] - boxes[:, 1] + off, 1e-9)
+    bcx = boxes[:, 0] + 0.5 * bw
+    bcy = boxes[:, 1] + 0.5 * bh
+    gw = matched_gt[:, 2] - matched_gt[:, 0] + off
+    gh = matched_gt[:, 3] - matched_gt[:, 1] + off
+    gcx = matched_gt[:, 0] + 0.5 * gw
+    gcy = matched_gt[:, 1] + 0.5 * gh
+    enc = jnp.stack([(gcx - bcx) / bw, (gcy - bcy) / bh,
+                     jnp.log(jnp.maximum(gw / bw, 1e-9)),
+                     jnp.log(jnp.maximum(gh / bh, 1e-9))], axis=1)
+    if weights is not None:
+        enc = enc / jnp.asarray(weights)
+    return enc
+
+
+def _matched_box_decode(boxes, deltas, off: float = 0.0):
+    """Inverse of :func:`_matched_box_encode` (one delta per box)."""
+    bw = boxes[:, 2] - boxes[:, 0] + off
+    bh = boxes[:, 3] - boxes[:, 1] + off
+    bcx = boxes[:, 0] + 0.5 * bw
+    bcy = boxes[:, 1] + 0.5 * bh
+    cx = deltas[:, 0] * bw + bcx
+    cy = deltas[:, 1] * bh + bcy
+    w = jnp.exp(jnp.clip(deltas[:, 2], -10.0, 10.0)) * bw
+    h = jnp.exp(jnp.clip(deltas[:, 3], -10.0, 10.0)) * bh
+    return jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                      cx + 0.5 * w - off, cy + 0.5 * h - off], axis=1)
+
+
+def _match_to_gt(gt_boxes, boxes, pos_thresh, box_normalized: bool):
+    """Shared anchor<->gt matching: per-box best gt with the 'every valid
+    gt claims its argmax box' guarantee. Returns
+    (best_iou [N], best_gt [N], fg [N], valid_gt [G])."""
+    n = boxes.shape[0]
+    valid_gt = (gt_boxes[:, 2] > gt_boxes[:, 0]) & \
+               (gt_boxes[:, 3] > gt_boxes[:, 1])
+    iou = iou_similarity(gt_boxes, boxes, box_normalized=box_normalized)
+    iou = jnp.where(valid_gt[:, None], iou, -1.0)
+    best_iou = jnp.max(iou, axis=0)
+    best_gt = jnp.argmax(iou, axis=0)
+    fg = best_iou >= pos_thresh
+    # invalid gts all share argmax 0: route their writes out of range
+    gt_best_box = jnp.argmax(iou, axis=1)
+    write_at = jnp.where(valid_gt, gt_best_box, n)
+    fg = fg.at[write_at].set(True, mode="drop")
+    best_gt = best_gt.at[write_at].set(
+        jnp.arange(gt_boxes.shape[0]), mode="drop")
+    return best_iou, best_gt, fg, valid_gt
+
+
+def _rank_sample(mask, limit, use_random: bool, key):
+    """Keep at most `limit` True entries of mask, randomly rank-sampled
+    (deterministic order when use_random=False)."""
+    n = mask.shape[0]
+    rand = jax.random.uniform(key, (n,)) if use_random else \
+        jnp.linspace(0.0, 1.0, n)
+    rank = jnp.argsort(jnp.argsort(jnp.where(mask, rand, 2.0)))
+    return mask & (rank < limit)
+
+
+def rpn_target_assign(anchors, gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im: int = 256,
+                      rpn_straddle_thresh: float = 0.0,
+                      rpn_fg_fraction: float = 0.5,
+                      rpn_positive_overlap: float = 0.7,
+                      rpn_negative_overlap: float = 0.3,
+                      use_random: bool = True,
+                      box_normalized: bool = True, key=None):
+    """RPN training target assignment for ONE image
+    (ref: rpn_target_assign_op.cc).
+
+    anchors [A, 4]; gt_boxes [G, 4] (0-padded rows allowed). Returns
+    (loc_target [A, 4], label [A]) with label 1=fg, 0=bg, -1=ignore —
+    a static-shape redesign of the reference's gathered index outputs:
+    downstream losses mask by label instead of gathering (XLA-friendly).
+    When ``im_info=(h, w, ...)`` is given, anchors straddling the image
+    boundary by more than ``rpn_straddle_thresh`` are ignored before
+    sampling (reference default behavior). ``box_normalized`` selects
+    the [0,1] (off=0) vs pixel (+1) box convention for BOTH the IoU
+    matching and the regression encode.
+    """
+    from ..core import random as _random
+    off = 0.0 if box_normalized else 1.0
+    best_iou, best_gt, fg, valid_gt = _match_to_gt(
+        gt_boxes, anchors, rpn_positive_overlap, box_normalized)
+    bg = (best_iou < rpn_negative_overlap) & ~fg
+    if im_info is not None:
+        h, w = im_info[0], im_info[1]
+        t = rpn_straddle_thresh
+        inside = ((anchors[:, 0] >= -t) & (anchors[:, 1] >= -t)
+                  & (anchors[:, 2] < w + t) & (anchors[:, 3] < h + t))
+        fg = fg & inside
+        bg = bg & inside
+    if is_crowd is not None:
+        fg = fg & ~is_crowd[best_gt]
+    # subsample to rpn_batch_size_per_im with fg_fraction cap
+    if key is None:
+        key = _random.next_key("random")
+    kf, kb = jax.random.split(key)
+    fg_keep = _rank_sample(fg, int(rpn_batch_size_per_im
+                                   * rpn_fg_fraction), use_random, kf)
+    bg_keep = _rank_sample(bg, rpn_batch_size_per_im - jnp.sum(fg_keep),
+                           use_random, kb)
+    label = jnp.where(fg_keep, 1, jnp.where(bg_keep, 0, -1))
+    loc = _matched_box_encode(anchors, gt_boxes[best_gt], off)
+    return loc, label
+
+
+def retinanet_target_assign(anchors, gt_boxes, gt_labels, im_info=None,
+                            positive_overlap: float = 0.5,
+                            negative_overlap: float = 0.4,
+                            box_normalized: bool = True):
+    """RetinaNet per-anchor targets for ONE image
+    (ref: retinanet_target_assign in rpn_target_assign_op.cc).
+
+    Like RPN assignment but multi-class and without subsampling (focal
+    loss consumes ALL anchors). Returns (loc_target [A,4],
+    cls_target [A] in {-1 ignore, 0 bg, 1..C fg}, fg_num)."""
+    off = 0.0 if box_normalized else 1.0
+    best_iou, best_gt, fg, _ = _match_to_gt(
+        gt_boxes, anchors, positive_overlap, box_normalized)
+    bg = (best_iou < negative_overlap) & ~fg
+    cls = jnp.where(fg, jnp.asarray(gt_labels, jnp.int32)[best_gt],
+                    jnp.where(bg, 0, -1))
+    loc = _matched_box_encode(anchors, gt_boxes[best_gt], off)
+    return loc, cls, jnp.sum(fg)
+
+
+def sigmoid_focal_loss(logits, labels, fg_num, gamma: float = 2.0,
+                       alpha: float = 0.25):
+    """(ref: sigmoid_focal_loss_op.cc) logits [A, C]; labels [A] in
+    {-1 ignore, 0 bg, 1..C fg}; normalized by fg_num."""
+    a, c = logits.shape
+    lbl = jnp.asarray(labels, jnp.int32)
+    t = jax.nn.one_hot(lbl - 1, c, dtype=logits.dtype)  # bg/ignore -> 0
+    p = jax.nn.sigmoid(logits)
+    ce = (t * jax.nn.softplus(-logits)
+          + (1 - t) * jax.nn.softplus(logits))
+    pt = jnp.where(t > 0, p, 1 - p)
+    w = jnp.where(t > 0, alpha, 1 - alpha) * (1 - pt) ** gamma
+    loss = jnp.where((lbl >= 0)[:, None], w * ce, 0.0)
+    return jnp.sum(loss) / jnp.maximum(fg_num, 1)
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info=None,
+                               score_threshold: float = 0.05,
+                               nms_top_k: int = 1000,
+                               keep_top_k: int = 100,
+                               nms_threshold: float = 0.3,
+                               box_normalized: bool = True):
+    """(ref: retinanet_detection_output_op.cc) decode per-anchor deltas
+    against anchors, clip to the image when im_info=(h, w, ...) is
+    given, then class-wise NMS. bboxes [A, 4] deltas; scores [A, C]
+    sigmoid scores. Returns (out [keep_top_k, 6], valid)."""
+    off = 0.0 if box_normalized else 1.0
+    decoded = _matched_box_decode(anchors, bboxes, off)
+    if im_info is not None:
+        decoded = box_clip(decoded, (im_info[0], im_info[1]))
+    return multiclass_nms(decoded, scores.T,
+                          score_threshold=score_threshold,
+                          nms_threshold=nms_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          background_label=-1)
+
+
+def generate_proposal_labels(rois, gt_boxes, gt_labels,
+                             batch_size_per_im: int = 128,
+                             fg_fraction: float = 0.25,
+                             fg_thresh: float = 0.5,
+                             bg_thresh_hi: float = 0.5,
+                             bg_thresh_lo: float = 0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             num_classes: int = 81,
+                             use_random: bool = True,
+                             box_normalized: bool = True, key=None):
+    """Fast R-CNN second-stage sampling for ONE image
+    (ref: generate_proposal_labels_op.cc).
+
+    Static-shape redesign: instead of gathering a variable-size sampled
+    set, returns a 4-tuple over rois+gt concatenated (gt boxes always
+    join the candidate pool, as the reference appends them):
+      - cand [R, 4]           the candidate boxes (rois ++ gt)
+      - label [R]             {-1 dropped, 0 bg, 1.. fg}
+      - bbox_targets [R, 4*num_classes]  per-class expanded targets,
+        non-zero only in the matched class' slot (reference layout)
+      - bbox_inside_weights [R, 4*num_classes]
+    """
+    from ..core import random as _random
+    off = 0.0 if box_normalized else 1.0
+    cand = jnp.concatenate([rois, gt_boxes], axis=0)
+    r = cand.shape[0]
+    best_iou, best_gt, fg_raw, _ = _match_to_gt(
+        gt_boxes, cand, fg_thresh, box_normalized)
+    # NOTE: padded/absent gts leave best_iou at -1; clamp to 0 so such
+    # candidates still sample as BACKGROUND (bg_thresh_lo is 0.0) — an
+    # image with no gt must still contribute negatives, like the ref.
+    fg = best_iou >= fg_thresh   # no forced gt-argmax here (ref behavior)
+    bi0 = jnp.maximum(best_iou, 0.0)
+    bg = (bi0 < bg_thresh_hi) & (bi0 >= bg_thresh_lo) & ~fg
+    if key is None:
+        key = _random.next_key("random")
+    kf, kb = jax.random.split(key)
+    fg_keep = _rank_sample(fg, int(batch_size_per_im * fg_fraction),
+                           use_random, kf)
+    bg_keep = _rank_sample(bg, batch_size_per_im - jnp.sum(fg_keep),
+                           use_random, kb)
+    label = jnp.where(
+        fg_keep, jnp.asarray(gt_labels, jnp.int32)[best_gt],
+        jnp.where(bg_keep, 0, -1))
+    tgt = _matched_box_encode(cand, gt_boxes[best_gt], off,
+                              weights=bbox_reg_weights)
+    # per-class expansion: targets live in the matched class' 4-slot
+    cls_slot = jax.nn.one_hot(label, num_classes,
+                              dtype=cand.dtype)          # [R, C] (bg->0)
+    cls_slot = jnp.where((label > 0)[:, None], cls_slot, 0.0)
+    expanded = (cls_slot[:, :, None] * tgt[:, None, :]).reshape(
+        r, 4 * num_classes)
+    inside_w = jnp.repeat(cls_slot, 4, axis=1)
+    return cand, label, expanded, inside_w
+
+
+def generate_mask_labels(rois, roi_labels, gt_segms_mask, gt_boxes,
+                         resolution: int = 14):
+    """Mask R-CNN mask targets (ref: generate_mask_labels_op.cc).
+
+    Dense redesign: gt_segms_mask is a per-gt binary mask stack
+    [G, H, W] (the reference consumes polygons; rasterization happens
+    in the data pipeline). For each fg roi, crops its matched gt's mask
+    to the roi window and resizes to resolution^2. Returns
+    (mask_target [R, resolution, resolution], mask_weight [R])."""
+    gt_segms_mask = jnp.asarray(gt_segms_mask)
+    rois = jnp.asarray(rois)
+    gt_boxes = jnp.asarray(gt_boxes)
+    valid_gt = (gt_boxes[:, 2] > gt_boxes[:, 0]) & \
+               (gt_boxes[:, 3] > gt_boxes[:, 1])
+    iou = iou_similarity(gt_boxes, rois)
+    iou = jnp.where(valid_gt[:, None], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=0)
+    h, w = gt_segms_mask.shape[1:]
+
+    def one_roi(roi, gt_idx):
+        mask = gt_segms_mask[gt_idx].astype(jnp.float32)  # [H, W]
+        # roi window in mask pixel coords
+        x1, y1, x2, y2 = roi
+        # normalized sampling grid over the roi
+        ys = y1 + (y2 - y1) * (jnp.arange(resolution) + 0.5) / resolution
+        xs = x1 + (x2 - x1) * (jnp.arange(resolution) + 0.5) / resolution
+        yi = jnp.clip(ys.astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(xs.astype(jnp.int32), 0, w - 1)
+        return mask[yi[:, None], xi[None, :]]
+
+    targets = jax.vmap(one_roi)(rois, best_gt)
+    weight = (jnp.asarray(roi_labels) > 0).astype(jnp.float32)
+    return (targets > 0.5).astype(jnp.float32), weight

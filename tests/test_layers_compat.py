@@ -388,3 +388,115 @@ def test_locality_aware_nms_merges(rng):
         boxes, scores, iou_threshold=0.5, max_out=3)
     # first two merge: merged score = 1.7
     assert float(np.max(np.asarray(mscores))) == pytest.approx(1.7)
+
+
+# ----------------------------------------------- two-stage / retinanet
+
+def test_rpn_target_assign_basics(rng):
+    anchors = _boxes(rng, 30)
+    gts = np.array([[0.2, 0.2, 0.5, 0.5], [0, 0, 0, 0]], np.float32)
+    # plant an anchor exactly on the gt: must be labeled fg
+    anchors[0] = gts[0]
+    loc, label = D.rpn_target_assign(anchors, gts,
+                                     rpn_batch_size_per_im=16,
+                                     use_random=False)
+    label = np.asarray(label)
+    assert label[0] == 1
+    assert set(np.unique(label)).issubset({-1, 0, 1})
+    assert (label == 1).sum() <= 8  # fg_fraction cap
+    assert (label >= 0).sum() <= 16
+    # the planted anchor's regression target is ~zero offset
+    np.testing.assert_allclose(np.asarray(loc[0]), 0.0, atol=1e-5)
+
+
+def test_retinanet_assign_and_focal_loss(rng):
+    import jax.numpy as jnp
+    anchors = _boxes(rng, 20)
+    gts = np.array([[0.3, 0.3, 0.6, 0.6]], np.float32)
+    anchors[3] = gts[0]
+    loc, cls, fg_num = D.retinanet_target_assign(anchors, gts,
+                                                 np.array([2]))
+    cls = np.asarray(cls)
+    assert cls[3] == 2 and int(fg_num) >= 1
+    logits = np.zeros((20, 3), np.float32)
+    loss = float(D.sigmoid_focal_loss(logits, cls, fg_num))
+    assert loss > 0 and np.isfinite(loss)
+    # perfect logits give near-zero loss
+    perfect = np.full((20, 3), -20.0, np.float32)
+    for i in range(20):
+        if cls[i] > 0:
+            perfect[i, cls[i] - 1] = 20.0
+    assert float(D.sigmoid_focal_loss(perfect, cls, fg_num)) < 1e-4
+
+
+def test_retinanet_detection_output(rng):
+    anchors = _boxes(rng, 15)
+    deltas = np.zeros((15, 4), np.float32)
+    scores = rng.uniform(0, 1, (15, 2)).astype(np.float32)
+    out, valid = D.retinanet_detection_output(deltas, scores, anchors,
+                                              keep_top_k=6)
+    assert out.shape == (6, 6)
+
+
+def test_generate_proposal_labels(rng):
+    rois = _boxes(rng, 25)
+    gts = np.array([[0.2, 0.2, 0.5, 0.5], [0.6, 0.6, 0.9, 0.9]],
+                   np.float32)
+    cand, label, tgt, inw = D.generate_proposal_labels(
+        rois, gts, np.array([1, 3]), batch_size_per_im=12,
+        fg_fraction=0.25, use_random=False, num_classes=4)
+    label = np.asarray(label)
+    assert cand.shape[0] == 27  # rois + gt appended
+    # the gt rows themselves are perfect candidates -> fg with gt label
+    assert label[25] in (1, -1) and label[26] in (3, -1)
+    assert (label > 0).sum() <= 3
+    assert (label >= 0).sum() <= 12
+    # per-class expansion: weights 1 exactly in the matched class' slot
+    inw = np.asarray(inw)
+    assert inw.shape == (27, 16) and np.asarray(tgt).shape == (27, 16)
+    for i in np.where(label > 0)[0]:
+        c = label[i]
+        assert np.all(inw[i, 4 * c: 4 * c + 4] == 1.0)
+        assert inw[i].sum() == 4.0
+    assert np.all(inw[label <= 0] == 0.0)
+
+
+def test_generate_proposal_labels_no_gt_still_samples_bg(rng):
+    """An image whose gt rows are all padding must still contribute
+    background rois (regression: masked IoU of -1 failed the
+    bg_thresh_lo >= 0 test and dropped every candidate)."""
+    rois = _boxes(rng, 10)
+    gts = np.zeros((2, 4), np.float32)
+    _, label, _, _ = D.generate_proposal_labels(
+        rois, gts, np.array([0, 0]), batch_size_per_im=8,
+        use_random=False)
+    label = np.asarray(label)
+    assert (label == 0).sum() == 8
+    assert (label > 0).sum() == 0
+
+
+def test_rpn_straddle_thresh_excludes_boundary_anchors(rng):
+    anchors = np.array([[0.1, 0.1, 0.4, 0.4],     # inside
+                        [-0.2, 0.1, 0.2, 0.4],    # straddles left edge
+                        [0.6, 0.6, 1.2, 1.2]],    # straddles right edge
+                       np.float32)
+    gts = np.array([[0.1, 0.1, 0.4, 0.4]], np.float32)
+    _, label = D.rpn_target_assign(anchors, gts, im_info=(1.0, 1.0),
+                                   rpn_straddle_thresh=0.0,
+                                   use_random=False)
+    label = np.asarray(label)
+    assert label[0] == 1          # exact match, inside
+    assert label[1] == -1 and label[2] == -1  # straddlers ignored
+
+
+def test_generate_mask_labels(rng):
+    gts = np.array([[2, 2, 10, 10]], np.float32)
+    masks = np.zeros((1, 16, 16), np.float32)
+    masks[0, 2:10, 2:10] = 1.0
+    rois = np.array([[2, 2, 10, 10], [12, 12, 15, 15]], np.float32)
+    tgt, w = D.generate_mask_labels(rois, np.array([1, 0]), masks, gts,
+                                    resolution=7)
+    assert tgt.shape == (2, 7, 7)
+    # roi 0 sits exactly on the gt box: target all ones
+    np.testing.assert_allclose(np.asarray(tgt[0]), 1.0)
+    assert list(np.asarray(w)) == [1.0, 0.0]
