@@ -29,7 +29,7 @@ import numpy as np
 from ..data import Dataset
 
 __all__ = ["DATA_HOME", "MNIST", "FashionMNIST", "Cifar10", "Cifar100",
-           "UCIHousing", "Imdb"]
+           "UCIHousing", "Imdb", "Imikolov", "Movielens"]
 
 
 def DATA_HOME() -> str:
@@ -302,3 +302,174 @@ class Imdb(Dataset):
 
     def __len__(self) -> int:
         return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB language-model dataset (ref: dataset/imikolov.py — parses
+    simple-examples.tgz, frequency-sorted dict with <s>/<e>/<unk>,
+    yields n-grams or full sequences).
+
+    ``data_type="ngram"`` yields (context [n-1], next_word);
+    ``data_type="seq"`` yields (padded sequence [seq_len], length) —
+    padding uses a DEDICATED ``pad_id`` (one past <unk>), never a real
+    word id, and the true length rides along so losses can mask.
+    """
+
+    _URL = "http://www.fit.vutbr.cz/~imikolov/rnnlm/simple-examples.tgz"
+
+    def __init__(self, mode: str = "train", data_type: str = "ngram",
+                 window_size: int = 5, seq_len: int = 64,
+                 min_word_freq: int = 50,
+                 data_home: Optional[str] = None) -> None:
+        self.data_type = data_type
+        self.window_size = window_size
+        if mode == "synthetic":
+            rng = np.random.default_rng(13)
+            vocab = 200
+            self.word_idx = {f"w{i}": i for i in range(vocab)}
+            if data_type == "ngram":
+                n = 512
+                self.ctx = rng.integers(0, vocab, (n, window_size - 1)) \
+                    .astype(np.int64)
+                self.nxt = (self.ctx.sum(1) % vocab).astype(np.int64)
+            else:
+                n = 64
+                self.pad_id = vocab
+                self.seqs = rng.integers(0, vocab, (n, seq_len)) \
+                    .astype(np.int64)
+                self.seq_lens = np.full((n,), seq_len, np.int64)
+            return
+        home = data_home or os.path.join(DATA_HOME(), "imikolov")
+        path = _require(os.path.join(home, "simple-examples.tgz"),
+                        self._URL)
+        fname = ("./simple-examples/data/ptb.train.txt" if mode == "train"
+                 else "./simple-examples/data/ptb.valid.txt")
+        freq: dict = {}
+        lines_cache = []
+        with tarfile.open(path, "r:*") as tar:
+            # dict over the TRAIN split only (ref: build_dict(train()))
+            f = tar.extractfile("./simple-examples/data/ptb.train.txt")
+            train_lines = f.read().decode("utf-8").splitlines()
+            for line in train_lines:
+                for w in line.strip().split():
+                    freq[w] = freq.get(w, 0) + 1
+            if mode == "train":
+                lines_cache = train_lines
+            else:
+                f = tar.extractfile(fname)
+                lines_cache = f.read().decode("utf-8").splitlines()
+        freq = {w: c for w, c in freq.items() if c > min_word_freq
+                and w != "<unk>"}
+        words = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+        # ids: 0.. for words, then <s>, <e>, <unk> (ref ordering)
+        self.word_idx = {w: i for i, (w, _) in enumerate(words)}
+        self.word_idx["<s>"] = len(self.word_idx)
+        self.word_idx["<e>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"] = len(self.word_idx)
+        bos, eos = self.word_idx["<s>"], self.word_idx["<e>"]
+        if data_type == "ngram":
+            ctxs, nxts = [], []
+            n = window_size
+            for line in lines_cache:
+                ids = [bos] + [self.word_idx.get(w, unk)
+                               for w in line.strip().split()] + [eos]
+                for i in range(n - 1, len(ids)):
+                    ctxs.append(ids[i - n + 1: i])
+                    nxts.append(ids[i])
+            self.ctx = np.asarray(ctxs, np.int64)
+            self.nxt = np.asarray(nxts, np.int64)
+        else:
+            self.pad_id = len(self.word_idx)  # one past <unk>
+            seqs, lens = [], []
+            for line in lines_cache:
+                ids = [bos] + [self.word_idx.get(w, unk)
+                               for w in line.strip().split()] + [eos]
+                row = np.full((seq_len,), self.pad_id, np.int64)
+                n_ids = min(len(ids), seq_len)
+                row[:n_ids] = ids[:seq_len]
+                seqs.append(row)
+                lens.append(n_ids)
+            self.seqs = np.stack(seqs)
+            self.seq_lens = np.asarray(lens, np.int64)
+
+    def __len__(self):
+        return len(self.ctx) if self.data_type == "ngram" \
+            else len(self.seqs)
+
+    def __getitem__(self, i):
+        if self.data_type == "ngram":
+            return self.ctx[i], self.nxt[i]
+        return self.seqs[i], self.seq_lens[i]
+
+
+class Movielens(Dataset):
+    """MovieLens 1-M ratings (ref: dataset/movielens.py — parses
+    ml-1m.zip's ::-separated users.dat/movies.dat/ratings.dat; yields
+    (user_id, gender, age_bucket, job, movie_id, first_category,
+    rating)).
+
+    Dense int features sized for the framework's RecommenderSystem
+    model; ``holdout`` fraction becomes the test split (the reference
+    random-splits 9:1 per user).
+    """
+
+    _URL = "http://files.grouplens.org/datasets/movielens/ml-1m.zip"
+    AGE_TABLE = (1, 18, 25, 35, 45, 50, 56)
+
+    def __init__(self, mode: str = "train", holdout: float = 0.1,
+                 data_home: Optional[str] = None) -> None:
+        if mode == "synthetic":
+            rng = np.random.default_rng(17)
+            n = 256
+            self.rows = np.stack([
+                rng.integers(1, 100, n), rng.integers(0, 2, n),
+                rng.integers(0, 7, n), rng.integers(0, 21, n),
+                rng.integers(1, 120, n), rng.integers(0, 19, n),
+            ], 1).astype(np.int64)
+            self.ratings = rng.integers(1, 6, (n, 1)).astype(np.float32)
+            self.categories = [f"c{i}" for i in range(19)]
+            return
+        import io
+        import zipfile
+        home = data_home or os.path.join(DATA_HOME(), "movielens")
+        path = _require(os.path.join(home, "ml-1m.zip"), self._URL)
+        users, movies = {}, {}
+        cats: dict = {}
+        with zipfile.ZipFile(path) as z:
+            with z.open("ml-1m/users.dat") as f:
+                for line in io.TextIOWrapper(f, "latin-1"):
+                    uid, gender, age, job, _zip = line.strip().split("::")
+                    users[int(uid)] = (
+                        0 if gender == "M" else 1,
+                        self.AGE_TABLE.index(int(age)), int(job))
+            with z.open("ml-1m/movies.dat") as f:
+                for line in io.TextIOWrapper(f, "latin-1"):
+                    mid, _title, genres = line.strip().split("::")
+                    g0 = genres.split("|")[0]
+                    cats.setdefault(g0, len(cats))
+                    movies[int(mid)] = cats[g0]
+            rows, ratings = [], []
+            with z.open("ml-1m/ratings.dat") as f:
+                for line in io.TextIOWrapper(f, "latin-1"):
+                    uid, mid, rate, _ts = line.strip().split("::")
+                    uid, mid = int(uid), int(mid)
+                    if uid not in users or mid not in movies:
+                        continue
+                    g, a, j = users[uid]
+                    rows.append((uid, g, a, j, mid, movies[mid]))
+                    ratings.append(float(rate))
+        rows_np = np.asarray(rows, np.int64)
+        ratings_np = np.asarray(ratings, np.float32)[:, None]
+        # deterministic split (ref uses a seeded random 9:1)
+        rng = np.random.default_rng(0)
+        take_test = rng.random(len(rows_np)) < holdout
+        pick = take_test if mode == "test" else ~take_test
+        self.rows = rows_np[pick]
+        self.ratings = ratings_np[pick]
+        self.categories = sorted(cats, key=cats.get)
+
+    def __len__(self):
+        return len(self.rows)
+
+    def __getitem__(self, i):
+        return self.rows[i], self.ratings[i]

@@ -448,6 +448,10 @@ polygon_box_transform = _det.polygon_box_transform
 nce = _samp.nce_loss
 hsigmoid = _samp.hsigmoid_loss
 beam_search = _beam.beam_search
+from ..nn.decode import (BasicDecoder, BeamSearchDecoder,  # noqa: E402
+                         DecodeHelper, Decoder, dynamic_decode,
+                         GreedyEmbeddingHelper, SampleEmbeddingHelper,
+                         TrainingHelper)
 beam_search_decode = _beam.beam_search_decode
 gather_tree = _beam.gather_tree
 

@@ -28,6 +28,10 @@ from .layers.loss import (BCELoss, BCEWithLogitsLoss, CTCLoss,
                           L1Loss, MSELoss, MarginRankingLoss, NLLLoss,
                           SmoothL1Loss, TripletMarginLoss)
 from .layers.moe import MoELayer, moe_param_rule  # noqa: F401
+from .decode import (BasicDecoder, BeamSearchDecoder,  # noqa: F401
+                     DecodeHelper, Decoder, dynamic_decode,
+                     GreedyEmbeddingHelper, SampleEmbeddingHelper,
+                     TrainingHelper)
 from .layers.rnn import (GRU, GRUCell, LSTM, LSTMCell, RNN, SimpleRNN,
                          SimpleRNNCell)
 from .layers.transformer import (MultiHeadAttention, Transformer,

@@ -1,0 +1,243 @@
+"""Seq2seq decoding API — the reference's RNNCell/Decoder family
+(/root/reference/python/paddle/fluid/layers/rnn.py: RNNCell, Decoder,
+BasicDecoder, DecodeHelper, TrainingHelper, GreedyEmbeddingHelper,
+SampleEmbeddingHelper, BeamSearchDecoder, dynamic_decode).
+
+TPU-native redesign: the reference drives decoding with a while_op over
+LoD tensors; here ``dynamic_decode`` is ONE ``lax.scan`` over a static
+``max_step_num`` with a ``finished`` mask (XLA unrolls nothing, pads
+nothing, and the whole decode jits). The cell protocol is the framework's
+existing one — ``cell(inputs, states) -> (outputs, new_states)`` — so
+``nn.LSTMCell``/``nn.GRUCell`` plug in directly as the reference's
+RNNCell subclasses do. Beam search routes to the static-shape beam
+machinery in ops/beam.py (beam_search_op.cc analogue).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _random
+
+__all__ = ["Decoder", "BasicDecoder", "DecodeHelper", "TrainingHelper",
+           "GreedyEmbeddingHelper", "SampleEmbeddingHelper",
+           "BeamSearchDecoder", "dynamic_decode"]
+
+
+class DecodeHelper:
+    """Sampling/feeding policy for BasicDecoder (ref: rnn.py
+    DecodeHelper): provides initial inputs, and how to sample + produce
+    the next step's inputs."""
+
+    def initialize(self, batch_size: int):
+        raise NotImplementedError
+
+    def sample(self, time, outputs):
+        raise NotImplementedError
+
+    def next_inputs(self, time, outputs, sample_ids):
+        """returns (finished [B] bool, next_inputs)."""
+        raise NotImplementedError
+
+
+class TrainingHelper(DecodeHelper):
+    """Teacher forcing: feed the ground-truth sequence
+    (ref: rnn.py TrainingHelper). inputs: [B, T, ...]."""
+
+    def __init__(self, inputs, sequence_length=None, time_major=False):
+        self.inputs = jnp.swapaxes(inputs, 0, 1) if not time_major \
+            else inputs                           # [T, B, ...]
+        self.sequence_length = sequence_length
+        self.t_max = self.inputs.shape[0]
+
+    def initialize(self, batch_size: int):
+        fin = jnp.zeros((batch_size,), bool) if self.sequence_length is \
+            None else (jnp.asarray(self.sequence_length) <= 0)
+        return fin, self.inputs[0]
+
+    def sample(self, time, outputs):
+        return jnp.argmax(outputs, axis=-1).astype(jnp.int32)
+
+    def next_inputs(self, time, outputs, sample_ids):
+        nxt = jnp.clip(time + 1, 0, self.t_max - 1)
+        if self.sequence_length is not None:
+            finished = (time + 1) >= jnp.asarray(self.sequence_length)
+        else:
+            finished = jnp.broadcast_to(time + 1 >= self.t_max,
+                                        (outputs.shape[0],))
+        return finished, self.inputs[nxt]
+
+
+class GreedyEmbeddingHelper(DecodeHelper):
+    """Inference: feed back argmax through an embedding
+    (ref: rnn.py GreedyEmbeddingHelper)."""
+
+    def __init__(self, embedding_fn: Callable, start_tokens, end_token):
+        self.embedding_fn = embedding_fn
+        self.start_tokens = jnp.asarray(start_tokens, jnp.int32)
+        self.end_token = int(end_token)
+
+    def initialize(self, batch_size: int):
+        fin = jnp.zeros((batch_size,), bool)
+        return fin, self.embedding_fn(self.start_tokens)
+
+    def sample(self, time, outputs):
+        return jnp.argmax(outputs, axis=-1).astype(jnp.int32)
+
+    def next_inputs(self, time, outputs, sample_ids):
+        return sample_ids == self.end_token, self.embedding_fn(sample_ids)
+
+
+class SampleEmbeddingHelper(GreedyEmbeddingHelper):
+    """Inference with sampling instead of argmax
+    (ref: rnn.py SampleEmbeddingHelper)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token,
+                 softmax_temperature: Optional[float] = None, key=None):
+        super().__init__(embedding_fn, start_tokens, end_token)
+        self.temperature = softmax_temperature
+        self.key = key
+
+    def sample(self, time, outputs):
+        logits = outputs if self.temperature is None \
+            else outputs / self.temperature
+        key = self.key if self.key is not None \
+            else _random.next_key("random")
+        # fold in the step so every timestep draws fresh randomness
+        # while the scan stays side-effect free
+        key = jax.random.fold_in(key, time)
+        return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
+class Decoder:
+    """One-step decode interface (ref: rnn.py Decoder)."""
+
+    def initialize(self, inits, batch_size: int):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states):
+        """returns (outputs, next_states, next_inputs, finished)."""
+        raise NotImplementedError
+
+
+class BasicDecoder(Decoder):
+    """cell + helper + optional output layer (ref: rnn.py BasicDecoder).
+    outputs per step: (cell_outputs, sample_ids)."""
+
+    def __init__(self, cell, helper: DecodeHelper,
+                 output_fn: Optional[Callable] = None):
+        self.cell = cell
+        self.helper = helper
+        self.output_fn = output_fn
+
+    def initialize(self, inits, batch_size: int):
+        finished, first_inputs = self.helper.initialize(batch_size)
+        return first_inputs, inits, finished
+
+    def step(self, time, inputs, states):
+        cell_out, next_states = self.cell(inputs, states)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        sample_ids = self.helper.sample(time, cell_out)
+        finished, next_inputs = self.helper.next_inputs(time, cell_out,
+                                                        sample_ids)
+        return (cell_out, sample_ids), next_states, next_inputs, finished
+
+
+class BeamSearchDecoder:
+    """Beam-search decoding (ref: rnn.py BeamSearchDecoder). Wraps the
+    static-shape beam machinery (ops/beam.py — beam_search_op.cc
+    analogue); consumed by :func:`dynamic_decode`."""
+
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn: Callable,
+                 output_fn: Optional[Callable] = None,
+                 length_penalty: float = 0.0):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+        self.length_penalty = length_penalty
+
+    def decode(self, inits, batch_size: int, max_step_num: int):
+        from ..ops.beam import beam_search
+        k = self.beam_size
+
+        # cell state pytree must be [batch, beam, ...]
+        tiled = jax.tree.map(
+            lambda leaf: jnp.broadcast_to(
+                leaf[:, None], (batch_size, k) + leaf.shape[1:]), inits)
+
+        def step_fn(tokens, cell_state):
+            # flatten beams into the batch for the cell
+            emb = self.embedding_fn(tokens.reshape(-1))
+            flat_state = jax.tree.map(
+                lambda leaf: leaf.reshape((-1,) + leaf.shape[2:]),
+                cell_state)
+            out, new_state = self.cell(emb, flat_state)
+            if self.output_fn is not None:
+                out = self.output_fn(out)
+            log_probs = jax.nn.log_softmax(out, axis=-1)
+            log_probs = log_probs.reshape(batch_size, k, -1)
+            new_state = jax.tree.map(
+                lambda leaf: leaf.reshape((batch_size, k)
+                                          + leaf.shape[1:]), new_state)
+            return log_probs, new_state
+
+        return beam_search(step_fn, tiled, batch_size, k, max_step_num,
+                           self.start_token, self.end_token,
+                           self.length_penalty)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num: int = 100,
+                   batch_size: Optional[int] = None,
+                   output_time_major: bool = False,
+                   impute_finished: bool = True):
+    """Run a decoder to completion (ref: rnn.py dynamic_decode).
+
+    For a :class:`BeamSearchDecoder` returns (sequences [B, beam, T],
+    scores [B, beam]). For step decoders returns
+    (outputs pytree stacked over time, final_states, sequence_lengths)
+    — one lax.scan over ``max_step_num`` with finished masking (the
+    reference's while_op + array-write loop).
+    """
+    if isinstance(decoder, BeamSearchDecoder):
+        if batch_size is None:
+            leaf = jax.tree.leaves(inits)[0]
+            batch_size = leaf.shape[0]
+        return decoder.decode(inits, batch_size, max_step_num)
+
+    if batch_size is None:
+        leaf = jax.tree.leaves(inits)[0]
+        batch_size = leaf.shape[0]
+    first_inputs, states0, finished0 = decoder.initialize(inits,
+                                                          batch_size)
+
+    def one_step(carry, time):
+        inputs, states, finished, seq_len = carry
+        outputs, next_states, next_inputs, step_fin = decoder.step(
+            time, inputs, states)
+        if impute_finished:
+            # frozen state once finished (reference impute_finished)
+            next_states = jax.tree.map(
+                lambda new, old: jnp.where(
+                    finished.reshape((-1,) + (1,) * (new.ndim - 1)),
+                    old, new), next_states, states)
+        seq_len = jnp.where(finished, seq_len, time + 1)
+        new_finished = finished | step_fin
+        return ((next_inputs, next_states, new_finished, seq_len),
+                outputs)
+
+    carry0 = (first_inputs, states0, finished0,
+              jnp.zeros((batch_size,), jnp.int32))
+    (_, final_states, _, seq_len), outputs = jax.lax.scan(
+        one_step, carry0, jnp.arange(max_step_num))
+    if not output_time_major:
+        outputs = jax.tree.map(
+            lambda leaf: jnp.swapaxes(leaf, 0, 1), outputs)
+    return outputs, final_states, seq_len

@@ -990,15 +990,21 @@ def _matched_box_decode(boxes, deltas, off: float = 0.0):
                       cx + 0.5 * w - off, cy + 0.5 * h - off], axis=1)
 
 
-def _match_to_gt(gt_boxes, boxes, pos_thresh, box_normalized: bool):
+def _match_to_gt(gt_boxes, boxes, pos_thresh, box_normalized: bool,
+                 valid_boxes=None):
     """Shared anchor<->gt matching: per-box best gt with the 'every valid
-    gt claims its argmax box' guarantee. Returns
+    gt claims its argmax box' guarantee. ``valid_boxes`` (e.g. the
+    straddle filter) must be applied HERE, before matching, so each gt's
+    forced argmax lands on an eligible box (reference order:
+    rpn_target_assign_op.cc filters straddlers first). Returns
     (best_iou [N], best_gt [N], fg [N], valid_gt [G])."""
     n = boxes.shape[0]
     valid_gt = (gt_boxes[:, 2] > gt_boxes[:, 0]) & \
                (gt_boxes[:, 3] > gt_boxes[:, 1])
     iou = iou_similarity(gt_boxes, boxes, box_normalized=box_normalized)
     iou = jnp.where(valid_gt[:, None], iou, -1.0)
+    if valid_boxes is not None:
+        iou = jnp.where(valid_boxes[None, :], iou, -1.0)
     best_iou = jnp.max(iou, axis=0)
     best_gt = jnp.argmax(iou, axis=0)
     fg = best_iou >= pos_thresh
@@ -1044,14 +1050,17 @@ def rpn_target_assign(anchors, gt_boxes, is_crowd=None, im_info=None,
     """
     from ..core import random as _random
     off = 0.0 if box_normalized else 1.0
-    best_iou, best_gt, fg, valid_gt = _match_to_gt(
-        gt_boxes, anchors, rpn_positive_overlap, box_normalized)
-    bg = (best_iou < rpn_negative_overlap) & ~fg
+    inside = None
     if im_info is not None:
         h, w = im_info[0], im_info[1]
         t = rpn_straddle_thresh
         inside = ((anchors[:, 0] >= -t) & (anchors[:, 1] >= -t)
                   & (anchors[:, 2] < w + t) & (anchors[:, 3] < h + t))
+    best_iou, best_gt, fg, valid_gt = _match_to_gt(
+        gt_boxes, anchors, rpn_positive_overlap, box_normalized,
+        valid_boxes=inside)
+    bg = (best_iou < rpn_negative_overlap) & ~fg
+    if inside is not None:
         fg = fg & inside
         bg = bg & inside
     if is_crowd is not None:
@@ -1080,9 +1089,18 @@ def retinanet_target_assign(anchors, gt_boxes, gt_labels, im_info=None,
     loss consumes ALL anchors). Returns (loc_target [A,4],
     cls_target [A] in {-1 ignore, 0 bg, 1..C fg}, fg_num)."""
     off = 0.0 if box_normalized else 1.0
+    inside = None
+    if im_info is not None:
+        h, w = im_info[0], im_info[1]
+        inside = ((anchors[:, 0] >= 0) & (anchors[:, 1] >= 0)
+                  & (anchors[:, 2] < w) & (anchors[:, 3] < h))
     best_iou, best_gt, fg, _ = _match_to_gt(
-        gt_boxes, anchors, positive_overlap, box_normalized)
+        gt_boxes, anchors, positive_overlap, box_normalized,
+        valid_boxes=inside)
     bg = (best_iou < negative_overlap) & ~fg
+    if inside is not None:
+        fg = fg & inside
+        bg = bg & inside
     cls = jnp.where(fg, jnp.asarray(gt_labels, jnp.int32)[best_gt],
                     jnp.where(bg, 0, -1))
     loc = _matched_box_encode(anchors, gt_boxes[best_gt], off)
@@ -1160,6 +1178,10 @@ def generate_proposal_labels(rois, gt_boxes, gt_labels,
     fg = best_iou >= fg_thresh   # no forced gt-argmax here (ref behavior)
     bi0 = jnp.maximum(best_iou, 0.0)
     bg = (bi0 < bg_thresh_hi) & (bi0 >= bg_thresh_lo) & ~fg
+    # padded gt rows joined cand: zero-area boxes must never be sampled
+    valid_cand = (cand[:, 2] > cand[:, 0]) & (cand[:, 3] > cand[:, 1])
+    fg = fg & valid_cand
+    bg = bg & valid_cand
     if key is None:
         key = _random.next_key("random")
     kf, kb = jax.random.split(key)

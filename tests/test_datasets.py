@@ -153,3 +153,78 @@ def test_fashion_mnist_synthetic():
     ds = FashionMNIST(mode="synthetic")
     img, lab = ds[0]
     assert img.shape == (1, 28, 28)
+
+
+def test_imikolov_parses_ptb_tgz(tmp_path):
+    from paddle_tpu.datasets import Imikolov
+    train_text = ("the cat sat on the mat\n"
+                  "the dog sat on the log\n" * 30)
+    valid_text = "the cat sat\n"
+    path = tmp_path / "simple-examples.tgz"
+    with tarfile.open(path, "w:gz") as tar:
+        for name, text in (
+                ("./simple-examples/data/ptb.train.txt", train_text),
+                ("./simple-examples/data/ptb.valid.txt", valid_text)):
+            data = text.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    ds = Imikolov(mode="train", window_size=3, min_word_freq=5,
+                  data_home=str(tmp_path))
+    assert "<s>" in ds.word_idx and "<unk>" in ds.word_idx
+    ctx, nxt = ds[0]
+    assert ctx.shape == (2,)
+    # first ngram of first line: (<s>, the) -> cat
+    assert ctx[0] == ds.word_idx["<s>"]
+    assert ctx[1] == ds.word_idx["the"]
+    assert nxt == ds.word_idx["cat"]
+    # rare words map to <unk>; dict is frequency-sorted ("the" most
+    # frequent -> id 0)
+    assert ds.word_idx["the"] == 0
+    valid = Imikolov(mode="test", window_size=3, min_word_freq=5,
+                     data_home=str(tmp_path))
+    assert len(valid) == 3  # <s> the cat sat <e> -> 3 trigrams
+    seq = Imikolov(mode="train", data_type="seq", seq_len=10,
+                   min_word_freq=5, data_home=str(tmp_path))
+    row, length = seq[0]
+    assert row.shape == (10,)
+    assert row[0] == seq.word_idx["<s>"]
+    # padding uses the dedicated pad id, not word id 0
+    assert seq.pad_id not in seq.word_idx.values()
+    assert int(length) == 8  # <s> + 6 words + <e>
+    assert np.all(row[length:] == seq.pad_id)
+
+
+def test_movielens_parses_ml1m_zip(tmp_path):
+    import zipfile
+    from paddle_tpu.datasets import Movielens
+    path = tmp_path / "ml-1m.zip"
+    users = "1::M::25::4::10001\n2::F::35::7::10002\n"
+    movies = ("1::Toy Story (1995)::Animation|Children's\n"
+              "2::Heat (1995)::Action|Crime\n")
+    ratings = ("1::1::5::978300760\n1::2::3::978301968\n"
+               "2::1::4::978302268\n2::2::1::978302039\n" * 8)
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("ml-1m/users.dat", users)
+        z.writestr("ml-1m/movies.dat", movies)
+        z.writestr("ml-1m/ratings.dat", ratings)
+    tr = Movielens(mode="train", data_home=str(tmp_path))
+    te = Movielens(mode="test", data_home=str(tmp_path))
+    assert len(tr) + len(te) == 32
+    row, rating = tr[0]
+    assert row.shape == (6,) and rating.shape == (1,)
+    # gender/age/job decode: user1 = M, 25 -> bucket 2, job 4
+    u1 = tr.rows[tr.rows[:, 0] == 1]
+    assert np.all(u1[:, 1] == 0) and np.all(u1[:, 2] == 2) \
+        and np.all(u1[:, 3] == 4)
+    assert set(tr.categories) == {"Animation", "Action"}
+
+
+def test_synthetic_imikolov_movielens_feed_models():
+    from paddle_tpu.datasets import Imikolov, Movielens
+    ds = Imikolov(mode="synthetic", window_size=4)
+    ctx, nxt = ds[0]
+    assert ctx.shape == (3,)
+    ml = Movielens(mode="synthetic")
+    row, rating = ml[0]
+    assert row.shape == (6,) and 1 <= float(rating) <= 5

@@ -1,0 +1,122 @@
+"""Decoder API family: helpers, BasicDecoder, dynamic_decode, and
+BeamSearchDecoder (ref test pattern:
+/root/reference/python/paddle/fluid/tests/unittests/test_rnn_decode_api.py
+— build cell + helper + decoder, decode, check shapes/consistency)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+
+
+VOCAB, EMB, HID = 12, 8, 16
+
+
+def _setup():
+    pt.seed(3)
+    cell = nn.GRUCell(EMB, HID)
+    embed = nn.Embedding(VOCAB, EMB)
+    proj = nn.Linear(HID, VOCAB)
+    return cell, embed, proj
+
+
+def test_training_helper_teacher_forces():
+    cell, embed, proj = _setup()
+    B, T = 3, 6
+    gt = np.random.default_rng(0).integers(0, VOCAB, (B, T))
+    helper = nn.TrainingHelper(embed(gt))
+    dec = nn.BasicDecoder(cell, helper, output_fn=proj)
+    (logits, samples), final, seq_len = nn.dynamic_decode(
+        dec, cell.get_initial_states(B), max_step_num=T, batch_size=B)
+    assert logits.shape == (B, T, VOCAB)
+    assert samples.shape == (B, T)
+    assert list(np.asarray(seq_len)) == [T] * B
+    # teacher forcing: step t's logits must depend on gt[:, t] (the fed
+    # input), so permuting gt changes outputs
+    helper2 = nn.TrainingHelper(embed(gt[:, ::-1].copy()))
+    dec2 = nn.BasicDecoder(cell, helper2, output_fn=proj)
+    (logits2, _), _, _ = nn.dynamic_decode(
+        dec2, cell.get_initial_states(B), max_step_num=T, batch_size=B)
+    assert not np.allclose(np.asarray(logits), np.asarray(logits2))
+
+
+def test_training_helper_sequence_length_masks():
+    cell, embed, proj = _setup()
+    B, T = 2, 5
+    gt = np.random.default_rng(0).integers(0, VOCAB, (B, T))
+    helper = nn.TrainingHelper(embed(gt), sequence_length=np.array([5, 2]))
+    dec = nn.BasicDecoder(cell, helper, output_fn=proj)
+    _, _, seq_len = nn.dynamic_decode(dec, cell.get_initial_states(B),
+                                      max_step_num=T, batch_size=B)
+    assert list(np.asarray(seq_len)) == [5, 2]
+
+
+def test_greedy_embedding_helper_stops_at_end_token():
+    cell, embed, proj = _setup()
+    B = 4
+    helper = nn.GreedyEmbeddingHelper(embed,
+                                      start_tokens=np.zeros(B, np.int32),
+                                      end_token=1)
+    dec = nn.BasicDecoder(cell, helper, output_fn=proj)
+    (logits, samples), final, seq_len = nn.dynamic_decode(
+        dec, cell.get_initial_states(B), max_step_num=8, batch_size=B)
+    assert logits.shape == (B, 8, VOCAB)
+    sl = np.asarray(seq_len)
+    samples = np.asarray(samples)
+    # greedy = argmax of the logits at every step
+    np.testing.assert_array_equal(samples,
+                                  np.argmax(np.asarray(logits), -1))
+    assert np.all(sl >= 1) and np.all(sl <= 8)
+
+
+def test_sample_embedding_helper_randomness():
+    cell, embed, proj = _setup()
+    B = 8
+    h1 = nn.SampleEmbeddingHelper(embed, np.zeros(B, np.int32), 1,
+                                  key=jax.random.key(0))
+    h2 = nn.SampleEmbeddingHelper(embed, np.zeros(B, np.int32), 1,
+                                  key=jax.random.key(7))
+    outs = []
+    for h in (h1, h2):
+        dec = nn.BasicDecoder(cell, h, output_fn=proj)
+        (_, samples), _, _ = nn.dynamic_decode(
+            dec, cell.get_initial_states(B), max_step_num=6, batch_size=B)
+        outs.append(np.asarray(samples))
+    assert not np.array_equal(outs[0], outs[1])
+
+
+def test_dynamic_decode_jits():
+    cell, embed, proj = _setup()
+    B = 2
+    helper = nn.GreedyEmbeddingHelper(embed, np.zeros(B, np.int32), 1)
+    dec = nn.BasicDecoder(cell, helper, output_fn=proj)
+
+    @jax.jit
+    def run(states):
+        (logits, samples), _, sl = nn.dynamic_decode(
+            dec, states, max_step_num=5, batch_size=B)
+        return samples, sl
+
+    samples, sl = run(cell.get_initial_states(B))
+    assert samples.shape == (B, 5)
+
+
+def test_beam_search_decoder_beats_greedy_score():
+    cell, embed, proj = _setup()
+    B, BEAM, T = 3, 4, 7
+
+    bsd = nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                               beam_size=BEAM, embedding_fn=embed,
+                               output_fn=proj)
+    seqs, scores = nn.dynamic_decode(bsd,
+                                     inits=cell.get_initial_states(B),
+                                     max_step_num=T, batch_size=B)
+    assert seqs.shape == (B, BEAM, T)
+    assert scores.shape == (B, BEAM)
+    # beams sorted: best first
+    s = np.asarray(scores)
+    assert np.all(np.diff(s, axis=1) <= 1e-5)
