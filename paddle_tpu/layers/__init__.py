@@ -528,3 +528,128 @@ def __getattr__(name):
             f"{_REDIRECTED[name]} instead")
     raise AttributeError(f"module 'paddle_tpu.layers' has no attribute "
                          f"{name!r}")
+
+
+# ----------------------------------------------------- remaining fills
+continuous_value_model = _F.continuous_value_model
+deformable_roi_pooling = _F.deformable_roi_pooling
+lod_append = _seq.lod_append
+lod_reset = _seq.lod_reset
+reorder_lod_tensor_by_rank = _seq.reorder_lod_tensor_by_rank
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major: bool = False, is_reverse: bool = False):
+    """(ref: fluid/layers/rnn.py rnn) drive any RNNCell over a dense
+    padded sequence. inputs [B, T, ...] (or [T, B, ...] when
+    time_major); masks by ``sequence_length`` (finished rows keep their
+    last state, outputs zeroed). Returns (outputs, final_states)."""
+    x = inputs if time_major else jnp.swapaxes(inputs, 0, 1)
+    t_max, b = x.shape[0], x.shape[1]
+    if initial_states is None:
+        initial_states = cell.get_initial_states(b)
+    if is_reverse:
+        x = x[::-1]
+    ts = jnp.arange(t_max)
+    if is_reverse:
+        ts = ts[::-1]
+
+    def step(states, inp):
+        x_t, t = inp
+        out, new_states = cell(x_t, states)
+        if sequence_length is not None:
+            alive = (t < jnp.asarray(sequence_length))
+            new_states = jax.tree.map(
+                lambda new, old: jnp.where(
+                    alive.reshape((-1,) + (1,) * (new.ndim - 1)),
+                    new, old), new_states, states)
+            out = jnp.where(alive.reshape((-1,) + (1,) * (out.ndim - 1)),
+                            out, jnp.zeros_like(out))
+        return new_states, out
+
+    final, outs = jax.lax.scan(step, initial_states, (x, ts))
+    if is_reverse:
+        outs = outs[::-1]
+    if not time_major:
+        outs = jnp.swapaxes(outs, 0, 1)
+    return outs, final
+
+from ..nn.layers.rnn import RNNCell  # noqa: E402
+from ..ops.sparse import (RowSlices, merge_rows, to_dense)  # noqa: E402
+
+
+def merge_selected_rows(x: "RowSlices"):
+    """(ref: merge_selected_rows_op.cc) sum duplicate rows of a
+    SelectedRows-analogue RowSlices gradient."""
+    return merge_rows(x)
+
+
+def get_tensor_from_selected_rows(x: "RowSlices"):
+    """(ref: get_tensor_from_selected_rows_op.cc) densify RowSlices."""
+    return to_dense(x)
+
+
+def load(out=None, file_path: str = "", load_as_fp16: bool = False):
+    """(ref: layers/io.py load — load one persistable tensor INTO a
+    variable). When ``out`` is a Parameter its value is replaced
+    in-place (the fluid calling pattern, which discards the return);
+    the loaded array is also returned."""
+    from .. import io as _io
+    data = _io.load(file_path)
+    if isinstance(data, dict) and len(data) == 1:
+        data = next(iter(data.values()))
+    if load_as_fp16:
+        import jax.numpy as _jnp
+        cast = lambda v: _jnp.asarray(v, _jnp.float16)  # noqa: E731
+        data = jax.tree.map(cast, data)
+    if out is not None:
+        if not hasattr(out, "set_value"):
+            raise TypeError(
+                "layers.load: out must be a Parameter (has set_value); "
+                f"got {type(out).__name__}")
+        out.set_value(data)
+        return out
+    return data
+
+
+def multi_box_head(inputs, image_hw, num_classes: int,
+                   min_sizes, max_sizes, aspect_ratios,
+                   loc_weights, conf_weights, loc_biases=None,
+                   conf_biases=None, flip: bool = True,
+                   clip: bool = False):
+    """SSD multi-scale head (ref: layers/detection.py multi_box_head):
+    per-feature-map loc/conf convs + prior boxes, concatenated.
+
+    inputs: list of [B, C_i, H_i, W_i] feature maps; *_weights: per-map
+    conv kernels [A_i*4, C_i, 3, 3] / [A_i*(num_classes), C_i, 3, 3]
+    (functional API — nn-layer users should build heads as in
+    models/ssd.py SSDLite). Returns (loc [B, P, 4],
+    conf [B, P, num_classes], priors [P, 4], variances [P, 4]).
+    """
+    import numpy as _np
+    locs, confs, priors, pvars = [], [], [], []
+    for i, feat in enumerate(inputs):
+        b, c, fh, fw = feat.shape
+        boxes, variances = _det.prior_box(
+            (fh, fw), tuple(image_hw), min_sizes=[min_sizes[i]],
+            max_sizes=[max_sizes[i]] if max_sizes else (),
+            aspect_ratios=aspect_ratios[i]
+            if isinstance(aspect_ratios[i], (list, tuple))
+            else (aspect_ratios[i],), flip=flip, clip=clip)
+        a = boxes.shape[2]
+        if loc_weights[i].shape[0] != a * 4 or \
+                conf_weights[i].shape[0] != a * num_classes:
+            raise ValueError(
+                f"multi_box_head: feature map {i} has {a} priors/cell; "
+                f"loc/conf weights must have {a * 4}/{a * num_classes} "
+                f"output channels, got {loc_weights[i].shape[0]}/"
+                f"{conf_weights[i].shape[0]}")
+        lo = _F.conv2d(feat, loc_weights[i],
+                       loc_biases[i] if loc_biases else None, padding=1)
+        co = _F.conv2d(feat, conf_weights[i],
+                       conf_biases[i] if conf_biases else None, padding=1)
+        locs.append(jnp.transpose(lo, (0, 2, 3, 1)).reshape(b, -1, 4))
+        confs.append(jnp.transpose(co, (0, 2, 3, 1)).reshape(
+            b, -1, num_classes))
+        priors.append(jnp.asarray(_np.asarray(boxes)).reshape(-1, 4))
+        pvars.append(jnp.asarray(_np.asarray(variances)).reshape(-1, 4))
+    return (jnp.concatenate(locs, 1), jnp.concatenate(confs, 1),
+            jnp.concatenate(priors, 0), jnp.concatenate(pvars, 0))

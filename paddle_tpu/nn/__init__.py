@@ -32,7 +32,8 @@ from .decode import (BasicDecoder, BeamSearchDecoder,  # noqa: F401
                      DecodeHelper, Decoder, dynamic_decode,
                      GreedyEmbeddingHelper, SampleEmbeddingHelper,
                      TrainingHelper)
-from .layers.rnn import (GRU, GRUCell, LSTM, LSTMCell, RNN, SimpleRNN,
+from .layers.rnn import (GRU, GRUCell, LSTM, LSTMCell, RNN, RNNCell,  # noqa
+                         SimpleRNN,
                          SimpleRNNCell)
 from .layers.transformer import (MultiHeadAttention, Transformer,
                                  TransformerDecoder,

@@ -21,7 +21,17 @@ from .. import initializer as I
 from ..layer import Layer, Parameter
 
 
-class LSTMCell(Layer):
+class RNNCell(Layer):
+    """Single-step recurrent cell protocol (ref: fluid/layers/rnn.py
+    RNNCell): ``forward(inputs, states) -> (outputs, new_states)`` plus
+    ``get_initial_states``. The Decoder API (nn/decode.py) and the RNN
+    driver both consume this protocol."""
+
+    def get_initial_states(self, batch_size: int):
+        raise NotImplementedError
+
+
+class LSTMCell(RNNCell):
     """(ref: lstm_unit_op.cc gate math: i,f,c,o with forget bias)."""
 
     def __init__(self, input_size: int, hidden_size: int,
@@ -65,7 +75,7 @@ class LSTMCell(Layer):
         return (z, z)
 
 
-class GRUCell(Layer):
+class GRUCell(RNNCell):
     """(ref: gru_unit_op.cc)."""
 
     def __init__(self, input_size: int, hidden_size: int) -> None:
@@ -98,7 +108,7 @@ class GRUCell(Layer):
         return jnp.zeros((batch_size, self.hidden_size), get_default_dtype())
 
 
-class SimpleRNNCell(Layer):
+class SimpleRNNCell(RNNCell):
     def __init__(self, input_size: int, hidden_size: int,
                  activation: str = "tanh") -> None:
         super().__init__()

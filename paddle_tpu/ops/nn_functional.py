@@ -913,3 +913,62 @@ def inplace_abn(x, running_mean, running_var, weight=None, bias=None,
     if isinstance(out, tuple):
         return (y,) + out[1:]
     return y
+
+
+def continuous_value_model(input, cvm, use_cvm: bool = True):
+    """(ref: cvm_op.cc; fluid signature (input, cvm, use_cvm)). input
+    [B, D]: an embedding whose first two slots are show/click
+    placeholders; cvm [B, 2]: the raw (show, click) counts. use_cvm
+    replaces the placeholders with (log(show+1), log(click+1)-log(show+1));
+    otherwise the two slots are stripped (output [B, D-2])."""
+    cvm = jnp.asarray(cvm)
+    show = jnp.log(cvm[:, 0:1] + 1.0)
+    click = jnp.log(cvm[:, 1:2] + 1.0) - show
+    rest = input[:, 2:]
+    if use_cvm:
+        return jnp.concatenate([show, click, rest], axis=1)
+    return rest
+
+
+def deformable_roi_pooling(feat, rois, trans, output_size,
+                           roi_batch_idx=None, spatial_scale: float = 1.0,
+                           trans_std: float = 0.1,
+                           samples_per_bin: int = 2):
+    """(ref: deformable_psroi_pooling_op.cu) ROI pooling with learned
+    per-bin offsets. feat [B, C, H, W]; rois [R, 4]; trans
+    [R, 2, PH, PW] bin offsets. Each (offset-shifted) bin averages a
+    ``samples_per_bin`` x ``samples_per_bin`` grid of bilinear samples
+    (the reference's sample_per_part grid)."""
+    from .detection import _bilinear_sample
+    ph, pw = (output_size, output_size) if isinstance(output_size, int) \
+        else output_size
+    feat = jnp.asarray(feat)   # indexed by traced batch ids under vmap
+    rois = jnp.asarray(rois)
+    trans = jnp.asarray(trans)
+    b, c, h, w = feat.shape
+    if roi_batch_idx is None:
+        roi_batch_idx = jnp.zeros((rois.shape[0],), jnp.int32)
+
+    def one_roi(roi, t, bidx):
+        x1, y1, x2, y2 = roi * spatial_scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w, bin_h = rw / pw, rh / ph
+        fmap = feat[bidx]                    # [C, H, W]
+        sp = samples_per_bin
+        # sub-sample grid inside each bin: offsets (k+0.5)/sp of the bin
+        sub = (jnp.arange(sp) + 0.5) / sp          # [sp]
+        ys = y1 + (jnp.arange(ph)[:, None] + sub[None, :]) * bin_h
+        xs = x1 + (jnp.arange(pw)[:, None] + sub[None, :]) * bin_w
+        # [PH, PW, sp, sp] sample coordinates, offset-shifted per bin
+        yy = ys[:, None, :, None] + (t[1] * trans_std * rh)[:, :, None,
+                                                            None]
+        xx = xs[None, :, None, :] + (t[0] * trans_std * rw)[:, :, None,
+                                                            None]
+        yy = jnp.clip(yy, 0.0, h - 1.0)
+        xx = jnp.clip(xx, 0.0, w - 1.0)
+        vals = _bilinear_sample(fmap, yy, xx)      # [C, PH, PW, sp, sp]
+        return jnp.mean(vals, axis=(-2, -1))       # [C, PH, PW]
+
+    return jax.vmap(one_roi)(rois, trans,
+                             jnp.asarray(roi_batch_idx, jnp.int32))

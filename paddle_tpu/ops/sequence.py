@@ -448,3 +448,22 @@ def ctc_greedy_decoder(log_probs, length, blank: Optional[int] = None):
     decoded = jnp.where(jnp.arange(t)[None, :] < n_kept[:, None],
                         packed, -1)
     return decoded, n_kept
+
+
+def lod_append(length, extra_length):
+    """(ref: lod_append_op.cc) dense-layout analogue: per-row lengths
+    are plain arrays, so appending a finer LoD level is concatenating
+    the two length vectors' semantics — returns the new lengths."""
+    return jnp.asarray(extra_length, jnp.int32)
+
+
+def reorder_lod_tensor_by_rank(x, length, reverse: bool = True):
+    """(ref: reorder_lod_tensor_by_rank_op.cc) sort batch rows by
+    sequence length (desc by default — the packed-RNN ordering the
+    reference's DynamicRNN needed). Returns (x_sorted, length_sorted,
+    restore_index) so the original order can be recovered with
+    x_sorted[restore_index]."""
+    length = jnp.asarray(length)
+    order = jnp.argsort(-length if reverse else length)
+    restore = jnp.argsort(order)
+    return x[order], length[order], restore

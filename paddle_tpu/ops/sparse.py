@@ -68,7 +68,12 @@ def merge_rows(s: RowSlices) -> RowSlices:
     # segment ids: position of the first occurrence of each row value
     seg = jnp.cumsum(is_first) - 1
     merged_vals = jnp.zeros_like(vals_sorted).at[seg].add(vals_sorted)
-    merged_rows = jnp.where(is_first, rows_sorted, s.dense_rows)
+    # rows must be COMPACTED to the same seg positions as the values
+    # (keeping them in place misaligns row ids against summed values);
+    # duplicate writes to one seg slot carry the same row id, tail slots
+    # stay at the dropped dummy index
+    merged_rows = jnp.full_like(rows_sorted, s.dense_rows) \
+        .at[seg].set(rows_sorted)
     return RowSlices(merged_rows, merged_vals, s.dense_rows)
 
 

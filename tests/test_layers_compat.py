@@ -500,3 +500,95 @@ def test_generate_mask_labels(rng):
     # roi 0 sits exactly on the gt box: target all ones
     np.testing.assert_allclose(np.asarray(tgt[0]), 1.0)
     assert list(np.asarray(w)) == [1.0, 0.0]
+
+
+# ------------------------------------------------------ remaining fills
+
+def test_cvm_transform_and_strip():
+    emb = np.array([[9.0, 9.0, 0.5]], np.float32)  # slots 0/1 are dummies
+    cvm = np.array([[3.0, 1.0]], np.float32)
+    out = np.asarray(L.continuous_value_model(emb, cvm))
+    assert out[0, 0] == pytest.approx(np.log(4.0))
+    assert out[0, 1] == pytest.approx(np.log(2.0) - np.log(4.0))
+    assert out[0, 2] == 0.5
+    assert L.continuous_value_model(emb, cvm,
+                                    use_cvm=False).shape == (1, 1)
+
+
+def test_deformable_roi_pooling_zero_offsets_averages(rng):
+    feat = np.ones((1, 1, 8, 8), np.float32)
+    rois = np.array([[0, 0, 7, 7]], np.float32)
+    trans = np.zeros((1, 2, 2, 2), np.float32)
+    out = np.asarray(L.deformable_roi_pooling(feat, rois, trans, 2))
+    np.testing.assert_allclose(out, 1.0, rtol=1e-5)
+    # non-zero offsets move the sample points -> different values
+    feat2 = rng.normal(0, 1, (1, 1, 8, 8)).astype(np.float32)
+    o1 = np.asarray(L.deformable_roi_pooling(feat2, rois, trans, 2))
+    o2 = np.asarray(L.deformable_roi_pooling(
+        feat2, rois, trans + 0.5, 2))
+    assert not np.allclose(o1, o2)
+
+
+def test_reorder_by_rank_roundtrip(rng):
+    x = rng.normal(0, 1, (5, 3)).astype(np.float32)
+    lens = np.array([2, 9, 4, 1, 7])
+    xo, lo, restore = L.reorder_lod_tensor_by_rank(x, lens)
+    assert list(np.asarray(lo)) == [9, 7, 4, 2, 1]
+    np.testing.assert_allclose(np.asarray(xo[restore]), x)
+
+
+def test_selected_rows_helpers():
+    from paddle_tpu.ops.sparse import RowSlices
+    s = RowSlices(np.array([1, 1, 3]),
+                  np.array([[1.0], [2.0], [5.0]], np.float32),
+                  dense_rows=5)
+    merged = L.merge_selected_rows(s)
+    dense = np.asarray(L.get_tensor_from_selected_rows(merged))
+    assert dense.shape[0] == 5
+    assert dense[1, 0] == pytest.approx(3.0)
+    assert dense[3, 0] == pytest.approx(5.0)
+
+
+def test_multi_box_head_concats_scales(rng):
+    f1 = rng.normal(0, 1, (2, 4, 8, 8)).astype(np.float32)
+    f2 = rng.normal(0, 1, (2, 4, 4, 4)).astype(np.float32)
+    mk = lambda a, c: rng.normal(  # noqa: E731
+        0, 0.1, (a, 4, 3, 3)).astype(np.float32)
+    loc, conf, pri, var = L.multi_box_head(
+        [f1, f2], (64, 64), 3, [16.0, 32.0], [32.0, 48.0],
+        [[2.0], [2.0]], [mk(4 * 4, 4), mk(4 * 4, 4)],
+        [mk(4 * 3, 4), mk(4 * 3, 4)])
+    p = pri.shape[0]
+    assert loc.shape == (2, p, 4) and conf.shape == (2, p, 3)
+    assert p == 8 * 8 * 4 + 4 * 4 * 4
+
+
+
+def test_layers_rnn_driver(rng):
+    import paddle_tpu.nn as nn
+    pt.seed(0)
+    cell = nn.GRUCell(4, 5)
+    x = rng.normal(0, 0.5, (2, 6, 4)).astype(np.float32)
+    outs, final = L.rnn(cell, x)
+    assert outs.shape == (2, 6, 5)
+    # sequence_length masks: finished rows freeze state, zero outputs
+    outs2, final2 = L.rnn(cell, x, sequence_length=np.array([6, 3]))
+    assert np.allclose(np.asarray(outs2[1, 3:]), 0.0)
+    np.testing.assert_allclose(np.asarray(outs[0]), np.asarray(outs2[0]),
+                               atol=1e-6)
+    # reverse runs right-to-left
+    outs3, _ = L.rnn(cell, x, is_reverse=True)
+    outs4, _ = L.rnn(cell, x[:, ::-1])
+    np.testing.assert_allclose(np.asarray(outs3),
+                               np.asarray(outs4[:, ::-1]), atol=1e-5)
+
+
+def test_layers_load_into_parameter(tmp_path, rng):
+    import paddle_tpu as pt2
+    w = rng.normal(0, 1, (3, 3)).astype(np.float32)
+    path = str(tmp_path / "w_ckpt")
+    pt2.io.save({"w": w}, path)
+    p = pt2.nn.Parameter(np.zeros((3, 3), np.float32))
+    got = L.load(p, path)
+    assert got is p
+    np.testing.assert_allclose(np.asarray(p.value), w)
