@@ -539,38 +539,14 @@ reorder_lod_tensor_by_rank = _seq.reorder_lod_tensor_by_rank
 def rnn(cell, inputs, initial_states=None, sequence_length=None,
         time_major: bool = False, is_reverse: bool = False):
     """(ref: fluid/layers/rnn.py rnn) drive any RNNCell over a dense
-    padded sequence. inputs [B, T, ...] (or [T, B, ...] when
-    time_major); masks by ``sequence_length`` (finished rows keep their
-    last state, outputs zeroed). Returns (outputs, final_states)."""
-    x = inputs if time_major else jnp.swapaxes(inputs, 0, 1)
-    t_max, b = x.shape[0], x.shape[1]
-    if initial_states is None:
-        initial_states = cell.get_initial_states(b)
-    if is_reverse:
-        x = x[::-1]
-    ts = jnp.arange(t_max)
-    if is_reverse:
-        ts = ts[::-1]
+    padded sequence — delegates to nn.RNN (one lax.scan with length
+    masking: finished rows keep their last state, outputs zeroed).
+    Returns (outputs, final_states)."""
+    from ..nn.layers.rnn import RNN as _RNN
+    driver = _RNN(cell, is_reverse=is_reverse, time_major=time_major)
+    return driver(inputs, initial_states=initial_states,
+                  sequence_length=sequence_length)
 
-    def step(states, inp):
-        x_t, t = inp
-        out, new_states = cell(x_t, states)
-        if sequence_length is not None:
-            alive = (t < jnp.asarray(sequence_length))
-            new_states = jax.tree.map(
-                lambda new, old: jnp.where(
-                    alive.reshape((-1,) + (1,) * (new.ndim - 1)),
-                    new, old), new_states, states)
-            out = jnp.where(alive.reshape((-1,) + (1,) * (out.ndim - 1)),
-                            out, jnp.zeros_like(out))
-        return new_states, out
-
-    final, outs = jax.lax.scan(step, initial_states, (x, ts))
-    if is_reverse:
-        outs = outs[::-1]
-    if not time_major:
-        outs = jnp.swapaxes(outs, 0, 1)
-    return outs, final
 
 from ..nn.layers.rnn import RNNCell  # noqa: E402
 from ..ops.sparse import (RowSlices, merge_rows, to_dense)  # noqa: E402
@@ -624,7 +600,6 @@ def multi_box_head(inputs, image_hw, num_classes: int,
     models/ssd.py SSDLite). Returns (loc [B, P, 4],
     conf [B, P, num_classes], priors [P, 4], variances [P, 4]).
     """
-    import numpy as _np
     locs, confs, priors, pvars = [], [], [], []
     for i, feat in enumerate(inputs):
         b, c, fh, fw = feat.shape
@@ -649,7 +624,7 @@ def multi_box_head(inputs, image_hw, num_classes: int,
         locs.append(jnp.transpose(lo, (0, 2, 3, 1)).reshape(b, -1, 4))
         confs.append(jnp.transpose(co, (0, 2, 3, 1)).reshape(
             b, -1, num_classes))
-        priors.append(jnp.asarray(_np.asarray(boxes)).reshape(-1, 4))
-        pvars.append(jnp.asarray(_np.asarray(variances)).reshape(-1, 4))
+        priors.append(boxes.reshape(-1, 4))
+        pvars.append(variances.reshape(-1, 4))
     return (jnp.concatenate(locs, 1), jnp.concatenate(confs, 1),
             jnp.concatenate(priors, 0), jnp.concatenate(pvars, 0))

@@ -15,3 +15,4 @@ from .recommender import DeepFM, RecommenderSystem  # noqa: F401
 from .gan import Discriminator, GANTrainStep, Generator  # noqa: F401
 from .crnn_ctc import CRNNCTC  # noqa: F401
 from .ssd import SSDLite  # noqa: F401
+from .nlp import SentimentBiLSTM, SRLBiLSTMCRF  # noqa: F401

@@ -1,0 +1,91 @@
+"""NLP model family — the reference book chapters the LSTM/CRF op stack
+exists for (ref: /root/reference/python/paddle/fluid/tests/book/
+notest_understand_sentiment.py stacked-LSTM sentiment net;
+test_label_semantic_roles.py word+predicate BiLSTM -> linear_chain_crf).
+
+Dense padded sequences + lengths throughout (SURVEY §7's LoD decision);
+both models jit end to end through TrainStep.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..ops.crf import crf_decoding, linear_chain_crf
+from ..ops.sequence import sequence_mask
+
+
+class SentimentBiLSTM(nn.Layer):
+    """Stacked bidirectional LSTM sentiment classifier
+    (ref: notest_understand_sentiment.py stacked_lstm_net: embedding ->
+    fc+lstm stack -> max pools -> softmax)."""
+
+    def __init__(self, vocab_size: int, embed_dim: int = 64,
+                 hidden: int = 64, num_layers: int = 2,
+                 num_classes: int = 2, pad_id: int = 0):
+        super().__init__()
+        self.embed = nn.Embedding(vocab_size, embed_dim)
+        self.lstm = nn.LSTM(embed_dim, hidden, num_layers=num_layers,
+                            direction="bidirect")
+        self.fc = nn.Linear(2 * hidden, num_classes)
+        self.pad_id = pad_id
+
+    def forward(self, tokens, length=None):
+        """tokens: [B, T] int ids (pad_id-padded). Returns logits."""
+        if length is None:
+            length = jnp.sum((tokens != self.pad_id).astype(jnp.int32),
+                             axis=1)
+        h = self.embed(tokens)
+        # lengths reach the recurrence: the backward direction must not
+        # accumulate pad embeddings into valid positions
+        out, _ = self.lstm(h, sequence_length=length)    # [B, T, 2H]
+        # max over valid positions (ref: sequence_pool 'max' over LoD);
+        # an all-pad row would pool to -inf — zero it instead of letting
+        # one empty row NaN the whole batch loss
+        mask = sequence_mask(length, tokens.shape[1])[:, :, None]
+        out = jnp.where(mask, out, -jnp.inf)
+        pooled = jnp.max(out, axis=1)
+        pooled = jnp.where(jnp.isfinite(pooled), pooled, 0.0)
+        return self.fc(pooled)
+
+    def loss(self, tokens, labels, length=None):
+        return F.cross_entropy(self.forward(tokens, length), labels)
+
+
+class SRLBiLSTMCRF(nn.Layer):
+    """Semantic role labeling: word + predicate-mark embeddings ->
+    stacked BiLSTM -> linear-chain CRF (ref:
+    test_label_semantic_roles.py db_lstm + linear_chain_crf/
+    crf_decoding)."""
+
+    def __init__(self, vocab_size: int, num_tags: int,
+                 embed_dim: int = 32, hidden: int = 64,
+                 num_layers: int = 2):
+        super().__init__()
+        self.word_embed = nn.Embedding(vocab_size, embed_dim)
+        self.mark_embed = nn.Embedding(2, embed_dim // 2)
+        self.lstm = nn.LSTM(embed_dim + embed_dim // 2, hidden,
+                            num_layers=num_layers, direction="bidirect")
+        self.emission = nn.Linear(2 * hidden, num_tags)
+        # CRF transition: rows 0/1 are start/end scores (reference's
+        # [D+2, D] layout, linear_chain_crf_op.cc)
+        self.transition = nn.Parameter(
+            jnp.zeros((num_tags + 2, num_tags), jnp.float32))
+        self.num_tags = num_tags
+
+    def emissions(self, words, predicate_mark, length=None):
+        h = jnp.concatenate([self.word_embed(words),
+                             self.mark_embed(predicate_mark)], axis=-1)
+        out, _ = self.lstm(h, sequence_length=length)
+        return self.emission(out)                    # [B, T, D]
+
+    def loss(self, words, predicate_mark, tags, length):
+        em = self.emissions(words, predicate_mark, length)
+        nll = linear_chain_crf(em, self.transition, tags, length)
+        return jnp.mean(nll)
+
+    def decode(self, words, predicate_mark, length):
+        em = self.emissions(words, predicate_mark, length)
+        return crf_decoding(em, self.transition, length)

@@ -150,19 +150,37 @@ class RNN(Layer):
     def forward(self, inputs, initial_states=None,
                 sequence_length=None):
         xs = inputs if self.time_major else jnp.swapaxes(inputs, 0, 1)
+        ts = jnp.arange(xs.shape[0])
         if self.is_reverse:
             xs = jnp.flip(xs, axis=0)
+            ts = jnp.flip(ts, axis=0)
         batch = xs.shape[1]
         if initial_states is None:
             initial_states = self.cell.get_initial_states(batch)
 
         cell = self.cell
+        seq_len = None if sequence_length is None \
+            else jnp.asarray(sequence_length)
 
-        def step(states, x_t):
+        def step(states, inp):
+            x_t, t = inp
             out_t, new_states = cell(x_t, states)
+            if seq_len is not None:
+                # padded steps: state frozen, output zeroed. In reverse
+                # the scan starts on the padding, where the state simply
+                # stays initial until the first valid position — the
+                # correct ragged-reverse semantics.
+                alive = t < seq_len
+                new_states = jax.tree.map(
+                    lambda new, old: jnp.where(
+                        alive.reshape((-1,) + (1,) * (new.ndim - 1)),
+                        new, old), new_states, states)
+                out_t = jnp.where(
+                    alive.reshape((-1,) + (1,) * (out_t.ndim - 1)),
+                    out_t, jnp.zeros_like(out_t))
             return new_states, out_t
 
-        final, outs = lax.scan(step, initial_states, xs)
+        final, outs = lax.scan(step, initial_states, (xs, ts))
         if self.is_reverse:
             outs = jnp.flip(outs, axis=0)
         if not self.time_major:
@@ -198,16 +216,17 @@ class _StackedRNNBase(Layer):
     def _make_cell(self, in_size, hidden):
         raise NotImplementedError
 
-    def forward(self, inputs, initial_states=None):
+    def forward(self, inputs, initial_states=None, sequence_length=None):
         x = inputs if not self.time_major else jnp.swapaxes(inputs, 0, 1)
         finals_f = []
         finals_b = []
         from ...ops.nn_functional import dropout as dropout_fn
         for i in range(self.num_layers):
-            out_f, fin_f = self.fw[i](x)
+            out_f, fin_f = self.fw[i](x, sequence_length=sequence_length)
             finals_f.append(fin_f)
             if self.bidirect:
-                out_b, fin_b = self.bw[i](x)
+                out_b, fin_b = self.bw[i](x,
+                                          sequence_length=sequence_length)
                 finals_b.append(fin_b)
                 x = jnp.concatenate([out_f, out_b], axis=-1)
             else:

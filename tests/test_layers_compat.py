@@ -592,3 +592,22 @@ def test_layers_load_into_parameter(tmp_path, rng):
     got = L.load(p, path)
     assert got is p
     np.testing.assert_allclose(np.asarray(p.value), w)
+
+
+def test_nn_rnn_sequence_length_masks_backward_direction(rng):
+    """A sentence's representation must not depend on how much padding
+    its batch neighbors force (regression: the backward LSTM direction
+    used to consume pad embeddings)."""
+    import paddle_tpu.nn as nn
+    pt.seed(0)
+    lstm = nn.LSTM(3, 4, direction="bidirect")
+    x = rng.normal(0, 1, (1, 4, 3)).astype(np.float32)
+    # same row, once alone-padded to T=4 and once padded to T=9
+    x_long = np.concatenate([x, np.full((1, 5, 3), 7.0, np.float32)], 1)
+    lens = np.array([4])
+    out_short, _ = lstm(x, sequence_length=lens)
+    out_long, _ = lstm(x_long, sequence_length=lens)
+    np.testing.assert_allclose(np.asarray(out_short),
+                               np.asarray(out_long[:, :4]), atol=1e-6)
+    # and the padded tail emits zeros
+    assert np.allclose(np.asarray(out_long[:, 4:]), 0.0)

@@ -11,7 +11,8 @@ import pytest
 import paddle_tpu as pt
 from paddle_tpu.models import (CRNNCTC, DeepFM, Discriminator,
                                GANTrainStep, Generator, NGramLM,
-                               RecommenderSystem, SkipGramNCE, SSDLite)
+                               RecommenderSystem, SentimentBiLSTM,
+                               SkipGramNCE, SRLBiLSTMCRF, SSDLite)
 from paddle_tpu.static import TrainStep
 
 
@@ -196,3 +197,61 @@ def test_ssd_lite_shapes_and_loss_trains(rng):
     outs = model.predict(imgs[:1], keep_top_k=5)
     det, valid = outs[0]
     assert det.shape == (5, 6)
+
+
+def test_sentiment_bilstm_learns_keyword(rng):
+    pt.seed(0)
+    vocab = 50
+    model = SentimentBiLSTM(vocab, embed_dim=16, hidden=16, num_layers=1)
+    opt = pt.optimizer.Adam(learning_rate=5e-3)
+
+    class _M(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.inner = model
+
+        def forward(self, toks, y):
+            return self.inner.loss(toks, y)
+
+    step = TrainStep(_M(), opt, lambda out: out)
+    B, T = 64, 12
+    toks = rng.integers(2, vocab, (B, T)).astype(np.int32)
+    y = (np.arange(B) % 2).astype(np.int64)
+    # class-1 docs contain the magic token 1 somewhere
+    pos = rng.integers(0, T, B)
+    toks[y == 1, pos[y == 1]] = 1
+    first = float(step(toks, y, labels=())["loss"])
+    for _ in range(50):
+        last = float(step(toks, y, labels=())["loss"])
+    assert last < 0.3 and last < first, (first, last)
+
+
+def test_srl_bilstm_crf_overfits(rng):
+    pt.seed(0)
+    vocab, tags = 30, 5
+    model = SRLBiLSTMCRF(vocab, tags, embed_dim=16, hidden=16,
+                         num_layers=1)
+    opt = pt.optimizer.Adam(learning_rate=1e-2)
+
+    class _M(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.inner = model
+
+        def forward(self, w, m, t, l):
+            return self.inner.loss(w, m, t, l)
+
+    step = TrainStep(_M(), opt, lambda out: out)
+    B, T = 8, 7
+    words = rng.integers(0, vocab, (B, T)).astype(np.int32)
+    marks = rng.integers(0, 2, (B, T)).astype(np.int32)
+    gold = (words % tags).astype(np.int32)  # learnable tag rule
+    lens = np.full((B,), T, np.int32)
+    first = float(step(words, marks, gold, lens, labels=())["loss"])
+    for _ in range(80):
+        last = float(step(words, marks, gold, lens, labels=())["loss"])
+    assert last < first * 0.3, (first, last)
+    step.sync_to_model()
+    pred = np.asarray(model.decode(words, marks, lens))
+    acc = (pred == gold).mean()
+    assert acc > 0.9, acc
