@@ -222,11 +222,16 @@ class _StackedRNNBase(Layer):
         finals_b = []
         from ...ops.nn_functional import dropout as dropout_fn
         for i in range(self.num_layers):
-            out_f, fin_f = self.fw[i](x, sequence_length=sequence_length)
+            out_f, fin_f = self.fw[i](
+                x, initial_states=self._slice_initial(initial_states, i,
+                                                      backward=False),
+                sequence_length=sequence_length)
             finals_f.append(fin_f)
             if self.bidirect:
-                out_b, fin_b = self.bw[i](x,
-                                          sequence_length=sequence_length)
+                out_b, fin_b = self.bw[i](
+                    x, initial_states=self._slice_initial(
+                        initial_states, i, backward=True),
+                    sequence_length=sequence_length)
                 finals_b.append(fin_b)
                 x = jnp.concatenate([out_f, out_b], axis=-1)
             else:
@@ -237,6 +242,18 @@ class _StackedRNNBase(Layer):
             x = jnp.swapaxes(x, 0, 1)
         finals = finals_f + finals_b
         return x, self._merge_finals(finals)
+
+    def _slice_initial(self, initial_states, layer: int, backward: bool):
+        """Pick layer/direction states out of the stacked initial-state
+        layout — the SAME layout _merge_finals emits ([forward layers...,
+        backward layers...] on axis 0), so `out, st = rnn(x); rnn(y, st)`
+        carries state across segments (truncated BPTT)."""
+        if initial_states is None:
+            return None
+        idx = layer + (self.num_layers if backward else 0)
+        if isinstance(initial_states, tuple):
+            return tuple(s[idx] for s in initial_states)
+        return initial_states[idx]
 
     def _merge_finals(self, finals):
         if isinstance(finals[0], tuple):

@@ -49,6 +49,15 @@ def _as_f32(x):
     return x
 
 
+def _fused_eligible(p) -> bool:
+    """Leaves that can join the flat fused-state pack: dense floating
+    arrays (RowSlices params/ints stay on the per-leaf path)."""
+    if isinstance(p, RowSlices):
+        return False
+    dt = getattr(p, "dtype", None)
+    return dt is not None and jnp.issubdtype(dt, jnp.floating)
+
+
 class Optimizer:
     """Base optimizer.
 
@@ -61,14 +70,31 @@ class Optimizer:
       opt.step(grads)  # or attach via set_grads then step()
     """
 
+    # Optimizers whose update() is purely elementwise can run the fused
+    # flat-state path (flags.optimizer_fused_state): m/v/master packed
+    # into ONE fp32 vector each, collapsing ~3 runtime buffers per
+    # parameter into 3 total. Lamb/Lars need per-parameter norms and
+    # stay per-leaf.
+    _elementwise_update = False
+
     def __init__(self, learning_rate=0.001, parameters=None,
                  weight_decay: Optional[float] = None, grad_clip=None,
-                 name: Optional[str] = None) -> None:
+                 name: Optional[str] = None,
+                 fused_state: Optional[bool] = None) -> None:
         self.learning_rate = learning_rate
         self._parameter_list = list(parameters) if parameters else None
         self.weight_decay = weight_decay
         self.grad_clip = grad_clip
+        self._fused_state = fused_state
         self._eager_state = None
+
+    def _use_fused(self) -> bool:
+        if not self._elementwise_update:
+            return False
+        if self._fused_state is not None:
+            return bool(self._fused_state)
+        from ..flags import GLOBAL_FLAGS
+        return bool(GLOBAL_FLAGS.get("optimizer_fused_state"))
 
     # ------------------------------------------------------------------
     # functional API
@@ -93,6 +119,23 @@ class Optimizer:
                 slots["master"] = jnp.asarray(p, jnp.float32)
             return slots
 
+        if self._use_fused():
+            # Fused flat state: ONE fp32 master + one buffer per slot
+            # kind for ALL eligible leaves (offsets are recomputed from
+            # the params structure at apply time — pure trace-time
+            # Python). Non-eligible leaves keep per-leaf slots.
+            flat_p = jax.tree.flatten(
+                params, is_leaf=lambda x: isinstance(x, RowSlices))[0]
+            elig = [p for p in flat_p if _fused_eligible(p)]
+            master = jnp.concatenate(
+                [jnp.asarray(p, jnp.float32).reshape(-1) for p in elig]) \
+                if elig else jnp.zeros((0,), jnp.float32)
+            fused = dict(self.init_slots(master), master=master)
+            slots = _tree_map(
+                lambda p: {} if _fused_eligible(p) else mk(p), params)
+            return {"step": jnp.zeros((), jnp.int32), "slots": slots,
+                    "fused": fused}
+
         slots = _tree_map(mk, params)
         return {"step": jnp.zeros((), jnp.int32), "slots": slots}
 
@@ -112,7 +155,7 @@ class Optimizer:
             if g is None:
                 return None
             if isinstance(g, RowSlices):
-                return RowSlices(g.rows, _as_f32(g.values))
+                return RowSlices(g.rows, _as_f32(g.values), g.dense_rows)
             return _as_f32(g)
 
         grads = jax.tree.map(
@@ -125,33 +168,114 @@ class Optimizer:
             params, is_leaf=lambda x: isinstance(x, RowSlices))
         flat_g = treedef.flatten_up_to(grads)
         flat_s = treedef.flatten_up_to(state["slots"])
+
+        if "fused" in state:
+            return self._apply_fused(flat_p, flat_g, flat_s, treedef,
+                                     state, lr_t, step)
+
         new_p, new_s = [], []
         for p, g, s in zip(flat_p, flat_g, flat_s):
-            if g is None:
-                new_p.append(p)
-                new_s.append(s)
-                continue
-            out_dtype = getattr(p, "dtype", None)
-            # fp32 master copy (see init): the update reads and writes the
-            # master; the low-precision param is its cast-down view.
-            has_master = isinstance(s, dict) and "master" in s
-            p32 = s["master"] if has_master else _as_f32(p)
-            s_upd = {k: v for k, v in s.items() if k != "master"} \
-                if has_master else s
-            if isinstance(g, RowSlices):
-                np_, ns_ = self.update_sparse(p32, g, s_upd, lr_t, step)
-            else:
-                if self.weight_decay:
-                    g = g + self.weight_decay * p32
-                np_, ns_ = self.update(p32, g, s_upd, lr_t, step)
-            if has_master:
-                ns_ = dict(ns_, master=np_)
-            if out_dtype is not None and np_.dtype != out_dtype:
-                np_ = np_.astype(out_dtype)
+            np_, ns_ = self._update_leaf(p, g, s, lr_t, step)
             new_p.append(np_)
             new_s.append(ns_)
         return (jax.tree.unflatten(treedef, new_p),
                 {"step": step, "slots": jax.tree.unflatten(treedef, new_s)})
+
+    def _update_leaf(self, p, g, s, lr_t, step):
+        """One per-leaf update (shared by the per-leaf and fused paths'
+        non-eligible branch): fp32 master handling, RowSlices dispatch,
+        decay, cast back to the param dtype."""
+        if g is None:
+            return p, s
+        out_dtype = getattr(p, "dtype", None)
+        # fp32 master copy (see init): the update reads and writes the
+        # master; the low-precision param is its cast-down view.
+        has_master = isinstance(s, dict) and "master" in s
+        p32 = s["master"] if has_master else _as_f32(p)
+        s_upd = {k: v for k, v in s.items() if k != "master"} \
+            if has_master else s
+        if isinstance(g, RowSlices):
+            np_, ns_ = self.update_sparse(p32, g, s_upd, lr_t, step)
+        else:
+            if self.weight_decay:
+                g = g + self.weight_decay * p32
+            np_, ns_ = self.update(p32, g, s_upd, lr_t, step)
+        if has_master:
+            ns_ = dict(ns_, master=np_)
+        if out_dtype is not None and np_.dtype != out_dtype:
+            np_ = np_.astype(out_dtype)
+        return np_, ns_
+
+    def _apply_fused(self, flat_p, flat_g, flat_s, treedef, state,
+                     lr_t, step):
+        """Flat fused-state update: eligible leaves update as slices of
+        ONE fp32 master vector (concat grads -> one elementwise update
+        -> split/cast back). Trades two large contiguous copies for the
+        per-leaf buffer traffic of ~3 runtime buffers per parameter —
+        the reference's fused multi-tensor optimizer capability
+        (ref: incubate multi_tensor_apply / merged_adam direction).
+        None-grad (frozen) leaves are masked to exact no-ops; RowSlices
+        grads densify on this path (the per-leaf path keeps them
+        sparse — pick per leaf structure, not per batch)."""
+        elig = [_fused_eligible(p) for p in flat_p]
+        master = state["fused"]["master"]
+
+        g_parts, mask_parts, any_none = [], [], False
+        for p, g, e in zip(flat_p, flat_g, elig):
+            if not e:
+                continue
+            n = int(jnp.size(p))
+            if g is None:
+                any_none = True
+                g_parts.append(jnp.zeros((n,), jnp.float32))
+                mask_parts.append(jnp.zeros((n,), jnp.float32))
+            else:
+                if isinstance(g, RowSlices):
+                    g = to_dense(g)
+                g_parts.append(g.reshape(-1).astype(jnp.float32))
+                mask_parts.append(jnp.ones((n,), jnp.float32))
+        gflat = jnp.concatenate(g_parts) if g_parts else \
+            jnp.zeros((0,), jnp.float32)
+        mask_flat = jnp.concatenate(mask_parts) if any_none else None
+        if self.weight_decay:
+            gflat = gflat + self.weight_decay * master
+        if mask_flat is not None:
+            # after decay: a frozen leaf must be an exact no-op, decay
+            # included
+            gflat = gflat * mask_flat
+
+        s_upd = {k: v for k, v in state["fused"].items() if k != "master"}
+        new_master, ns_fused = self.update(master, gflat, s_upd, lr_t,
+                                           step)
+        if mask_flat is not None:
+            # a zeroed grad is NOT enough for a frozen leaf: decoupled
+            # decay (AdamW) moves the param with g=0, and moment slots
+            # decay by beta — pin BOTH so fused == per-leaf (which skips
+            # frozen leaves entirely)
+            frozen = mask_flat <= 0
+            new_master = jnp.where(frozen, master, new_master)
+            ns_fused = {
+                k: jnp.where(frozen, state["fused"][k], v)
+                if hasattr(v, "shape") and v.shape == master.shape else v
+                for k, v in ns_fused.items()}
+        ns_fused = dict(ns_fused, master=new_master)
+
+        new_p, new_s = [], []
+        off = 0
+        for p, g, s, e in zip(flat_p, flat_g, flat_s, elig):
+            if e:
+                n = int(jnp.size(p))
+                sl = new_master[off:off + n]  # static offsets: plain slice
+                new_p.append(sl.reshape(jnp.shape(p)).astype(p.dtype))
+                new_s.append(s)
+                off += n
+            else:
+                np_, ns_ = self._update_leaf(p, g, s, lr_t, step)
+                new_p.append(np_)
+                new_s.append(ns_)
+        return (jax.tree.unflatten(treedef, new_p),
+                {"step": step, "slots": jax.tree.unflatten(treedef, new_s),
+                 "fused": ns_fused})
 
     def update(self, p, g, slots, lr_t, step):
         raise NotImplementedError
@@ -220,6 +344,7 @@ class Optimizer:
 
 class SGD(Optimizer):
     """(ref: sgd_op.cc)."""
+    _elementwise_update = True
 
     def update(self, p, g, slots, lr_t, step):
         return p - lr_t * g.astype(p.dtype), slots
@@ -231,6 +356,7 @@ class SGD(Optimizer):
 
 class Momentum(Optimizer):
     """(ref: momentum_op.cc; use_nesterov attr)."""
+    _elementwise_update = True
 
     def __init__(self, learning_rate=0.001, momentum: float = 0.9,
                  use_nesterov: bool = False, **kw) -> None:
@@ -280,6 +406,7 @@ class LarsMomentum(Optimizer):
 
 class Adam(Optimizer):
     """(ref: adam_op.h AdamFunctor)."""
+    _elementwise_update = True
 
     def __init__(self, learning_rate=0.001, beta1: float = 0.9,
                  beta2: float = 0.999, epsilon: float = 1e-8,
@@ -362,6 +489,7 @@ class AdamW(Adam):
 
 class Adamax(Optimizer):
     """(ref: adamax_op.cc)."""
+    _elementwise_update = True
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, **kw) -> None:
@@ -382,6 +510,7 @@ class Adamax(Optimizer):
 
 class Adagrad(Optimizer):
     """(ref: adagrad_op.cc)."""
+    _elementwise_update = True
 
     def __init__(self, learning_rate=0.001, epsilon: float = 1e-6,
                  initial_accumulator_value: float = 0.0, **kw) -> None:
@@ -401,6 +530,7 @@ class Adagrad(Optimizer):
 
 class Adadelta(Optimizer):
     """(ref: adadelta_op.cc)."""
+    _elementwise_update = True
 
     def __init__(self, learning_rate=1.0, rho: float = 0.95,
                  epsilon: float = 1e-6, **kw) -> None:
@@ -423,6 +553,7 @@ class Adadelta(Optimizer):
 
 class RMSProp(Optimizer):
     """(ref: rmsprop_op.cc; centered variant supported)."""
+    _elementwise_update = True
 
     def __init__(self, learning_rate=0.001, rho: float = 0.95,
                  epsilon: float = 1e-6, momentum: float = 0.0,

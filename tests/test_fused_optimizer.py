@@ -1,0 +1,168 @@
+"""Fused flat-state optimizer path: numerical parity with the per-leaf
+path across optimizers/dtypes, frozen-leaf no-op guarantee, sparse
+leaves staying per-leaf, and checkpoint round trip.
+
+(ref capability: the reference's fused/merged optimizers —
+operators/optimizers/merged_adam variants; here the fusion is packing
+the state so XLA sees 3 flat buffers instead of 3 per parameter.)
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.ops.sparse import RowSlices
+
+
+def _params(dtype):
+    rng = np.random.default_rng(0)
+    return {
+        "w": jnp.asarray(rng.normal(0, 1, (4, 3)), dtype),
+        "b": jnp.asarray(rng.normal(0, 1, (3,)), dtype),
+        "emb": jnp.asarray(rng.normal(0, 1, (6, 3)), dtype),
+    }
+
+
+def _grads(dtype):
+    rng = np.random.default_rng(1)
+    return {
+        "w": jnp.asarray(rng.normal(0, 0.1, (4, 3)), dtype),
+        "b": jnp.asarray(rng.normal(0, 0.1, (3,)), dtype),
+        "emb": jnp.asarray(rng.normal(0, 0.1, (6, 3)), dtype),
+    }
+
+
+@pytest.mark.parametrize("opt_cls,kw", [
+    (pt.optimizer.SGD, {}),
+    (pt.optimizer.Momentum, {"momentum": 0.9}),
+    (pt.optimizer.Adam, {}),
+    (pt.optimizer.AdamW, {"weight_decay": 0.01}),
+    (pt.optimizer.Adagrad, {}),
+    (pt.optimizer.RMSProp, {}),
+])
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_fused_matches_per_leaf(opt_cls, kw, dtype):
+    dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    ref = opt_cls(learning_rate=0.01, **kw)
+    fused = opt_cls(learning_rate=0.01, fused_state=True, **kw)
+    p_ref, p_fused = _params(dt), _params(dt)
+    s_ref, s_fused = ref.init(p_ref), fused.init(p_fused)
+    assert "fused" in s_fused and "fused" not in s_ref
+    for i in range(5):
+        g = _grads(dt)
+        p_ref, s_ref = ref.apply_gradients(p_ref, g, s_ref)
+        p_fused, s_fused = fused.apply_gradients(p_fused, g, s_fused)
+    for k in p_ref:
+        assert p_fused[k].dtype == dt
+        np.testing.assert_allclose(
+            np.asarray(p_ref[k], np.float32),
+            np.asarray(p_fused[k], np.float32), rtol=2e-5, atol=2e-5)
+
+
+def test_fused_frozen_leaf_is_exact_noop():
+    opt = pt.optimizer.AdamW(learning_rate=0.01, weight_decay=0.1,
+                             fused_state=True)
+    p = _params(jnp.float32)
+    s = opt.init(p)
+    frozen = np.asarray(p["b"]).copy()
+    for _ in range(3):
+        g = _grads(jnp.float32)
+        g = dict(g, b=None)  # frozen leaf
+        p, s = opt.apply_gradients(p, g, s)
+    # weight decay must NOT leak into the frozen leaf
+    np.testing.assert_array_equal(np.asarray(p["b"]), frozen)
+    assert not np.allclose(np.asarray(p["w"]),
+                           np.asarray(_params(jnp.float32)["w"]))
+
+
+def test_fused_handles_rowslices_grad():
+    opt = pt.optimizer.Adam(learning_rate=0.05, fused_state=True)
+    p = _params(jnp.float32)
+    s = opt.init(p)
+    rows = jnp.asarray([0, 2])
+    vals = jnp.ones((2, 3), jnp.float32)
+    g = {"w": jnp.zeros((4, 3), jnp.float32),
+         "b": jnp.zeros((3,), jnp.float32),
+         "emb": RowSlices(rows, vals, dense_rows=6)}
+    p0 = np.asarray(p["emb"]).copy()
+    p, s = opt.apply_gradients(p, g, s)
+    got = np.asarray(p["emb"])
+    assert not np.allclose(got[0], p0[0]) and not np.allclose(got[2],
+                                                              p0[2])
+    np.testing.assert_allclose(got[1], p0[1], atol=1e-6)
+
+
+def test_fused_state_checkpoints(tmp_path):
+    opt = pt.optimizer.Adam(learning_rate=0.01, fused_state=True)
+    p = _params(jnp.bfloat16)
+    s = opt.init(p)
+    g = _grads(jnp.bfloat16)
+    p, s = opt.apply_gradients(p, g, s)
+    path = str(tmp_path / "opt")
+    pt.io.save({"params": p, "opt": s}, path)
+    loaded = pt.io.load(path)
+    # resume: one more step from loaded state matches continuing
+    p2, s2 = opt.apply_gradients(p, g, s)
+    lp = {k.split("/", 1)[1]: v for k, v in loaded.items()
+          if k.startswith("params/")}
+    # nested opt state reconstruction via tree paths is io.load's
+    # flat-key format; check the master vector survived exactly
+    master_keys = [k for k in loaded if k.endswith("fused/master")]
+    assert master_keys
+    np.testing.assert_array_equal(np.asarray(loaded[master_keys[0]]),
+                                  np.asarray(s["fused"]["master"]))
+
+
+def test_fused_via_flag_and_trainstep():
+    pt.set_flags({"optimizer_fused_state": True})
+    try:
+        opt = pt.optimizer.Adam(learning_rate=1e-2)
+        model = pt.nn.Linear(6, 4)
+        from paddle_tpu.static import TrainStep
+        step = TrainStep(model, opt,
+                         lambda out, y: pt.nn.functional.mse_loss(out, y))
+        assert "fused" in step.state["opt"]
+        rng = np.random.default_rng(0)
+        x = rng.normal(0, 1, (8, 6)).astype(np.float32)
+        y = rng.normal(0, 1, (8, 4)).astype(np.float32)
+        first = float(step(x, labels=y)["loss"])
+        for _ in range(30):
+            last = float(step(x, labels=y)["loss"])
+        assert last < first * 0.5, (first, last)
+    finally:
+        pt.set_flags({"optimizer_fused_state": False})
+
+
+def test_fused_sharded_dp_matches_and_zero_rejects():
+    from paddle_tpu.parallel import data_parallel_mesh, ShardedTrainStep
+    pt.seed(0)
+    mesh = data_parallel_mesh()
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (16, 6)).astype(np.float32)
+    y = rng.normal(0, 1, (16, 4)).astype(np.float32)
+
+    pt.seed(42)
+    m1 = pt.nn.Linear(6, 4)
+    step = ShardedTrainStep(
+        m1, pt.optimizer.Adam(learning_rate=1e-2, fused_state=True),
+        lambda out, yy: pt.nn.functional.mse_loss(out, yy), mesh=mesh)
+    losses_fused = [float(step(x, labels=y)["loss"]) for _ in range(5)]
+
+    pt.seed(42)
+    m2 = pt.nn.Linear(6, 4)
+    step2 = ShardedTrainStep(
+        m2, pt.optimizer.Adam(learning_rate=1e-2, fused_state=False),
+        lambda out, yy: pt.nn.functional.mse_loss(out, yy), mesh=mesh)
+    losses_ref = [float(step2(x, labels=y)["loss"]) for _ in range(5)]
+    np.testing.assert_allclose(losses_fused, losses_ref, rtol=1e-5)
+
+    # ZeRO + fused is a hard error, not silent divergence
+    pt.seed(42)
+    with pytest.raises(ValueError, match="fused_state"):
+        ShardedTrainStep(
+            pt.nn.Linear(6, 4),
+            pt.optimizer.Adam(learning_rate=1e-2, fused_state=True),
+            lambda out, yy: pt.nn.functional.mse_loss(out, yy),
+            mesh=mesh, zero_stage=1)

@@ -611,3 +611,43 @@ def test_nn_rnn_sequence_length_masks_backward_direction(rng):
                                np.asarray(out_long[:, :4]), atol=1e-6)
     # and the padded tail emits zeros
     assert np.allclose(np.asarray(out_long[:, 4:]), 0.0)
+
+
+def test_stacked_rnn_carries_initial_states(rng):
+    """out, st = lstm(x); lstm(y, st) must continue from st (truncated
+    BPTT — regression: initial_states used to be silently dropped)."""
+    import paddle_tpu.nn as nn
+    pt.seed(0)
+    lstm = nn.LSTM(3, 4, num_layers=2)
+    x = rng.normal(0, 1, (2, 5, 3)).astype(np.float32)
+    y = rng.normal(0, 1, (2, 5, 3)).astype(np.float32)
+    full = np.concatenate([x, y], axis=1)
+    out_full, fin_full = lstm(full)
+    _, st = lstm(x)
+    out_seg, fin_seg = lstm(y, st)
+    np.testing.assert_allclose(np.asarray(out_full[:, 5:]),
+                               np.asarray(out_seg), atol=1e-5)
+    for a, b in zip(fin_full, fin_seg):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5)
+
+
+def test_fused_frozen_then_unfrozen_matches_per_leaf():
+    """Slots of a frozen leaf must not decay on the fused path: freeze,
+    unfreeze, and compare against the per-leaf optimizer."""
+    import jax.numpy as jnp
+    ref = pt.optimizer.Adam(learning_rate=0.01)
+    fused = pt.optimizer.Adam(learning_rate=0.01, fused_state=True)
+    mk = lambda: {"a": jnp.ones((4,), jnp.float32),  # noqa: E731
+                  "b": jnp.full((3,), 2.0, jnp.float32)}
+    p_r, p_f = mk(), mk()
+    s_r, s_f = ref.init(p_r), fused.init(p_f)
+    g_full = {"a": jnp.full((4,), 0.1, jnp.float32),
+              "b": jnp.full((3,), 0.2, jnp.float32)}
+    g_frozen = dict(g_full, b=None)
+    for g in (g_full, g_frozen, g_frozen, g_full):
+        p_r, s_r = ref.apply_gradients(p_r, g, s_r)
+        p_f, s_f = fused.apply_gradients(p_f, g, s_f)
+    for k in p_r:
+        np.testing.assert_allclose(np.asarray(p_r[k]), np.asarray(p_f[k]),
+                                   rtol=1e-6, atol=1e-6)
