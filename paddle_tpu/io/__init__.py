@@ -47,6 +47,12 @@ def _path_str(p) -> str:
     return str(p)
 
 
+_BUILTIN_DTYPES = {
+    "bool", "int8", "int16", "int32", "int64", "uint8", "uint16",
+    "uint32", "uint64", "float16", "float32", "float64", "complex64",
+    "complex128"}
+
+
 def save(state: Any, path: str, step: Optional[int] = None,
          overwrite: bool = True) -> None:
     """Save a pytree (state dict, TrainStep.state, ...) to ``path``."""
@@ -65,7 +71,13 @@ def save(state: Any, path: str, step: Optional[int] = None,
     }
     for k, v in flat.items():
         fname = k.replace("/", "__") + ".npy"
-        np.save(os.path.join(tmp, "data", fname), v)
+        arr = np.asarray(v)
+        # numpy serializes extension dtypes (bfloat16, float8_*) as raw
+        # void records and np.load hands back 'V2' garbage — store the
+        # raw bits as uintN and restore via the manifest's dtype string
+        if arr.dtype.kind == "V" or str(arr.dtype) not in _BUILTIN_DTYPES:
+            arr = arr.view(np.dtype(f"u{arr.dtype.itemsize}"))
+        np.save(os.path.join(tmp, "data", fname), arr)
     with open(os.path.join(tmp, "manifest.json"), "w") as f:
         json.dump(manifest, f, indent=1)
     if os.path.exists(path):
@@ -84,9 +96,18 @@ def load(path: str, target: Optional[Any] = None) -> Any:
     if manifest.get(_SENTINEL_KEY) != _VERSION:
         raise ValueError(f"{path} is not a paddle_tpu checkpoint")
     flat = {}
-    for k in manifest["leaves"]:
+    for k, meta in manifest["leaves"].items():
         fname = k.replace("/", "__") + ".npy"
-        flat[k] = np.load(os.path.join(path, "data", fname))
+        arr = np.load(os.path.join(path, "data", fname))
+        want = meta.get("dtype") if isinstance(meta, dict) else None
+        if want and str(arr.dtype) != want:
+            if want not in _BUILTIN_DTYPES:
+                import ml_dtypes
+                arr = arr.view(getattr(ml_dtypes, want))
+            elif arr.dtype.kind == "V":  # legacy bf16-as-void files
+                import ml_dtypes
+                arr = arr.view(ml_dtypes.bfloat16).astype(want)
+        flat[k] = arr
     if target is None:
         return flat
     leaves_with_path = jax.tree_util.tree_flatten_with_path(target)[0]

@@ -240,17 +240,26 @@ class _StackedRNNBase(Layer):
                 x = dropout_fn(x, self.dropout, training=self.training)
         if self.time_major:
             x = jnp.swapaxes(x, 0, 1)
-        finals = finals_f + finals_b
+        if self.bidirect:
+            # layer-major interleave [l0fw, l0bw, l1fw, l1bw, ...] —
+            # the reference's (num_layers*2, B, H) layout reshapable to
+            # (num_layers, 2, ...) (contrib/layers/rnn_impl.py:196)
+            finals = [f for pair in zip(finals_f, finals_b)
+                      for f in pair]
+        else:
+            finals = finals_f
         return x, self._merge_finals(finals)
 
     def _slice_initial(self, initial_states, layer: int, backward: bool):
         """Pick layer/direction states out of the stacked initial-state
-        layout — the SAME layout _merge_finals emits ([forward layers...,
-        backward layers...] on axis 0), so `out, st = rnn(x); rnn(y, st)`
-        carries state across segments (truncated BPTT)."""
+        layout — the SAME layer-major layout _merge_finals emits
+        ((num_layers*dirs, B, H), reshapable to (num_layers, dirs, ...)),
+        so `out, st = rnn(x); rnn(y, st)` carries state across segments
+        (truncated BPTT) and reference-layout states route correctly."""
         if initial_states is None:
             return None
-        idx = layer + (self.num_layers if backward else 0)
+        n_dirs = 2 if self.bidirect else 1
+        idx = layer * n_dirs + (1 if backward else 0)
         if isinstance(initial_states, tuple):
             return tuple(s[idx] for s in initial_states)
         return initial_states[idx]

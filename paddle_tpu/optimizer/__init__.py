@@ -221,6 +221,7 @@ class Optimizer:
         master = state["fused"]["master"]
 
         g_parts, mask_parts, any_none = [], [], False
+        decay_parts, any_sparse = [], False
         for p, g, e in zip(flat_p, flat_g, elig):
             if not e:
                 continue
@@ -229,16 +230,27 @@ class Optimizer:
                 any_none = True
                 g_parts.append(jnp.zeros((n,), jnp.float32))
                 mask_parts.append(jnp.zeros((n,), jnp.float32))
+                decay_parts.append(jnp.zeros((n,), jnp.float32))
+            elif isinstance(g, RowSlices):
+                # densified for the flat update, but the per-leaf path's
+                # update_sparse never applies weight decay to sparse
+                # grads — keep that contract here too
+                any_sparse = True
+                g_parts.append(to_dense(g).reshape(-1)
+                               .astype(jnp.float32))
+                mask_parts.append(jnp.ones((n,), jnp.float32))
+                decay_parts.append(jnp.zeros((n,), jnp.float32))
             else:
-                if isinstance(g, RowSlices):
-                    g = to_dense(g)
                 g_parts.append(g.reshape(-1).astype(jnp.float32))
                 mask_parts.append(jnp.ones((n,), jnp.float32))
+                decay_parts.append(jnp.ones((n,), jnp.float32))
         gflat = jnp.concatenate(g_parts) if g_parts else \
             jnp.zeros((0,), jnp.float32)
         mask_flat = jnp.concatenate(mask_parts) if any_none else None
         if self.weight_decay:
-            gflat = gflat + self.weight_decay * master
+            decay = master if not any_sparse else \
+                master * jnp.concatenate(decay_parts)
+            gflat = gflat + self.weight_decay * decay
         if mask_flat is not None:
             # after decay: a frozen leaf must be an exact no-op, decay
             # included

@@ -95,6 +95,9 @@ def test_fused_handles_rowslices_grad():
 
 
 def test_fused_state_checkpoints(tmp_path):
+    """Save fused state, restore into the same structure, take one more
+    step from BOTH the live and the restored state: results must be
+    bit-identical (resume correctness, incl. the flat master)."""
     opt = pt.optimizer.Adam(learning_rate=0.01, fused_state=True)
     p = _params(jnp.bfloat16)
     s = opt.init(p)
@@ -102,17 +105,16 @@ def test_fused_state_checkpoints(tmp_path):
     p, s = opt.apply_gradients(p, g, s)
     path = str(tmp_path / "opt")
     pt.io.save({"params": p, "opt": s}, path)
-    loaded = pt.io.load(path)
-    # resume: one more step from loaded state matches continuing
-    p2, s2 = opt.apply_gradients(p, g, s)
-    lp = {k.split("/", 1)[1]: v for k, v in loaded.items()
-          if k.startswith("params/")}
-    # nested opt state reconstruction via tree paths is io.load's
-    # flat-key format; check the master vector survived exactly
-    master_keys = [k for k in loaded if k.endswith("fused/master")]
-    assert master_keys
-    np.testing.assert_array_equal(np.asarray(loaded[master_keys[0]]),
-                                  np.asarray(s["fused"]["master"]))
+    restored = pt.io.load(path, target={"params": p, "opt": s})
+    p_live, s_live = opt.apply_gradients(p, g, s)
+    p_res, s_res = opt.apply_gradients(restored["params"], g,
+                                       restored["opt"])
+    for k in p_live:
+        np.testing.assert_array_equal(
+            np.asarray(p_live[k], np.float32),
+            np.asarray(p_res[k], np.float32))
+    np.testing.assert_array_equal(np.asarray(s_live["fused"]["master"]),
+                                  np.asarray(s_res["fused"]["master"]))
 
 
 def test_fused_via_flag_and_trainstep():
@@ -166,3 +168,24 @@ def test_fused_sharded_dp_matches_and_zero_rejects():
             pt.optimizer.Adam(learning_rate=1e-2, fused_state=True),
             lambda out, yy: pt.nn.functional.mse_loss(out, yy),
             mesh=mesh, zero_stage=1)
+
+
+def test_fused_frozen_then_unfrozen_matches_per_leaf():
+    """Slots of a frozen leaf must not decay on the fused path: freeze,
+    unfreeze, and compare against the per-leaf optimizer."""
+    import jax.numpy as jnp
+    ref = pt.optimizer.Adam(learning_rate=0.01)
+    fused = pt.optimizer.Adam(learning_rate=0.01, fused_state=True)
+    mk = lambda: {"a": jnp.ones((4,), jnp.float32),  # noqa: E731
+                  "b": jnp.full((3,), 2.0, jnp.float32)}
+    p_r, p_f = mk(), mk()
+    s_r, s_f = ref.init(p_r), fused.init(p_f)
+    g_full = {"a": jnp.full((4,), 0.1, jnp.float32),
+              "b": jnp.full((3,), 0.2, jnp.float32)}
+    g_frozen = dict(g_full, b=None)
+    for g in (g_full, g_frozen, g_frozen, g_full):
+        p_r, s_r = ref.apply_gradients(p_r, g, s_r)
+        p_f, s_f = fused.apply_gradients(p_f, g, s_f)
+    for k in p_r:
+        np.testing.assert_allclose(np.asarray(p_r[k]), np.asarray(p_f[k]),
+                                   rtol=1e-6, atol=1e-6)

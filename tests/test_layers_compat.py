@@ -630,24 +630,3 @@ def test_stacked_rnn_carries_initial_states(rng):
     for a, b in zip(fin_full, fin_seg):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-5)
-
-
-def test_fused_frozen_then_unfrozen_matches_per_leaf():
-    """Slots of a frozen leaf must not decay on the fused path: freeze,
-    unfreeze, and compare against the per-leaf optimizer."""
-    import jax.numpy as jnp
-    ref = pt.optimizer.Adam(learning_rate=0.01)
-    fused = pt.optimizer.Adam(learning_rate=0.01, fused_state=True)
-    mk = lambda: {"a": jnp.ones((4,), jnp.float32),  # noqa: E731
-                  "b": jnp.full((3,), 2.0, jnp.float32)}
-    p_r, p_f = mk(), mk()
-    s_r, s_f = ref.init(p_r), fused.init(p_f)
-    g_full = {"a": jnp.full((4,), 0.1, jnp.float32),
-              "b": jnp.full((3,), 0.2, jnp.float32)}
-    g_frozen = dict(g_full, b=None)
-    for g in (g_full, g_frozen, g_frozen, g_full):
-        p_r, s_r = ref.apply_gradients(p_r, g, s_r)
-        p_f, s_f = fused.apply_gradients(p_f, g, s_f)
-    for k in p_r:
-        np.testing.assert_allclose(np.asarray(p_r[k]), np.asarray(p_f[k]),
-                                   rtol=1e-6, atol=1e-6)

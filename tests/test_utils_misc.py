@@ -167,3 +167,20 @@ class TestHostStagingArena:
             assert dl._arena is None
         else:
             assert dl._arena is not None
+
+
+def test_checkpoint_preserves_bfloat16(tmp_path):
+    """np.save writes extension dtypes as void records; the manifest
+    dtype must restore real bfloat16 (regression: bf16 state loaded
+    back as 'V2' and crashed jnp.asarray)."""
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    w = jnp.asarray(np.linspace(-2, 2, 16), jnp.bfloat16).reshape(4, 4)
+    path = str(tmp_path / "bf16ck")
+    pt.io.save({"w": w, "n": jnp.ones((2,), jnp.float32)}, path)
+    flat = pt.io.load(path)
+    assert str(flat["w"].dtype) == "bfloat16"
+    np.testing.assert_array_equal(np.asarray(flat["w"], np.float32),
+                                  np.asarray(w, np.float32))
+    tgt = pt.io.load(path, target={"w": w, "n": None})
+    assert str(tgt["w"].dtype) == "bfloat16"
