@@ -171,8 +171,9 @@ def bench_bert(on_accel: bool) -> None:
     # path trades that for two large contiguous copies. Time both
     # briefly and keep the winner (set PT_BENCH_FUSED=0/1 to pin).
     pin = os.environ.get("PT_BENCH_FUSED")
-    if pin is not None:
-        candidates = [bool(int(pin))]
+    if pin is not None and pin.strip() != "":
+        truthy = pin.strip().lower() in ("1", "true", "yes", "on")
+        candidates = [truthy]
     elif on_accel:
         candidates = [True, False]
     else:

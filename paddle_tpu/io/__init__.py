@@ -72,10 +72,12 @@ def save(state: Any, path: str, step: Optional[int] = None,
     for k, v in flat.items():
         fname = k.replace("/", "__") + ".npy"
         arr = np.asarray(v)
-        # numpy serializes extension dtypes (bfloat16, float8_*) as raw
-        # void records and np.load hands back 'V2' garbage — store the
-        # raw bits as uintN and restore via the manifest's dtype string
-        if arr.dtype.kind == "V" or str(arr.dtype) not in _BUILTIN_DTYPES:
+        # numpy serializes ml_dtypes extension floats (bfloat16,
+        # float8_*) as raw void records and np.load hands back 'V2'
+        # garbage — store those as uintN bits and restore via the
+        # manifest's dtype string. Strings/objects keep plain np.save.
+        if (arr.dtype.kind in "Vf"
+                and str(arr.dtype) not in _BUILTIN_DTYPES):
             arr = arr.view(np.dtype(f"u{arr.dtype.itemsize}"))
         np.save(os.path.join(tmp, "data", fname), arr)
     with open(os.path.join(tmp, "manifest.json"), "w") as f:

@@ -235,3 +235,106 @@ def test_manipulation_grads():
     check_grad(lambda x: MP.tile(x, (2, 1)), [_x(3, 4)])
     check_grad(lambda x: MP.flip(x, axis=0), [_x(3, 4)])
     check_grad(lambda x: MP.roll(x, shifts=1, axis=0), [_x(3, 4)])
+
+
+# --------------------------- round-2 additions: new op families --------
+
+def test_dice_loss_grad():
+    probs = np.abs(_x(4, 5)) + 0.2
+    probs = probs / probs.sum(-1, keepdims=True)
+    lbl = (_rng.integers(0, 5, (4, 1))).astype(np.int64)
+    check_grad(lambda p: L.dice_loss(p, lbl), [probs.astype(np.float32)])
+
+
+def test_sigmoid_focal_loss_grad():
+    from paddle_tpu.ops import detection as D
+    logits = _x(12, 3)
+    labels = _rng.integers(-1, 4, (12,))
+    check_grad(lambda lg: D.sigmoid_focal_loss(lg, labels, 3),
+               [logits])
+
+
+def test_ssd_loss_grads():
+    from paddle_tpu.ops import detection as D
+    c = _rng.uniform(0.25, 0.75, (6, 2))
+    wh = _rng.uniform(0.1, 0.2, (6, 2))
+    priors = np.concatenate([c - wh, c + wh], 1).astype(np.float32)
+    loc = (_rng.normal(0, 0.1, (1, 6, 4))).astype(np.float32)
+    conf = (_rng.normal(0, 1, (1, 6, 3))).astype(np.float32)
+    gtb = np.array([[[0.2, 0.2, 0.5, 0.5]]], np.float32)
+    gtl = np.array([[1]])
+    f = lambda lc, cf: jnp.sum(  # noqa: E731
+        D.ssd_loss(lc, cf, gtb, gtl, priors))
+    check_grad(f, [loc, conf], rtol=5e-2, atol=5e-3)
+    check_grad(f, [loc, conf], wrt=1, rtol=5e-2, atol=5e-3)
+
+
+def test_ctc_loss_grad():
+    t, b, c = 6, 2, 4
+    logits = _x(t, b, c)
+    import jax
+    labels = _rng.integers(1, c, (b, 2)).astype(np.int64)
+    il = np.full((b,), t, np.int64)
+    ll = np.full((b,), 2, np.int64)
+    check_grad(
+        lambda lg: L.ctc_loss(jax.nn.log_softmax(lg, -1), labels, il, ll),
+        [logits])
+
+
+def test_dynamic_lstm_grad():
+    from paddle_tpu.ops import rnn_functional as RF
+    B, T, H = 2, 3, 3
+    xproj = _x(B, T, 4 * H, lo=-1, hi=1)
+    w = _x(H, 4 * H, lo=-0.5, hi=0.5)
+    f = lambda xp, ww: jnp.sum(RF.dynamic_lstm(xp, ww)[0] ** 2)  # noqa
+    check_grad(f, [xproj, w])
+    check_grad(f, [xproj, w], wrt=1)
+
+
+def test_dynamic_gru_grad():
+    from paddle_tpu.ops import rnn_functional as RF
+    B, T, H = 2, 3, 3
+    xproj = _x(B, T, 3 * H, lo=-1, hi=1)
+    w = _x(H, 3 * H, lo=-0.5, hi=0.5)
+    f = lambda xp, ww: jnp.sum(RF.dynamic_gru(xp, ww) ** 2)  # noqa
+    check_grad(f, [xproj, w])
+    check_grad(f, [xproj, w], wrt=1)
+
+
+def test_distribution_log_prob_grads():
+    from paddle_tpu import distribution as dist
+    x = _x(8)
+    f = lambda mu, sd: jnp.sum(  # noqa: E731
+        dist.Normal(mu, jnp.abs(sd) + 0.5).log_prob(x))
+    args = [_x(1), _x(1)]
+    check_grad(f, args)
+    check_grad(f, args, wrt=1)
+    check_grad(lambda lo: jnp.sum(
+        dist.Categorical(lo).log_prob(np.array([1, 2]))),
+        [_x(2, 4)])
+
+
+def test_deformable_roi_pooling_grads():
+    feat = _x(1, 2, 8, 8)
+    rois = np.array([[1.2, 1.2, 6.3, 6.3]], np.float32)
+    trans = (_rng.normal(0, 0.3, (1, 2, 2, 2))).astype(np.float32)
+    g = lambda f, t: jnp.sum(  # noqa: E731
+        F.deformable_roi_pooling(f, rois, t, 2) ** 2)
+    check_grad(g, [feat, trans], rtol=5e-2, atol=5e-3)
+    check_grad(g, [feat, trans], wrt=1, rtol=5e-2, atol=5e-3)
+
+
+def test_add_position_encoding_and_cvm_grads():
+    x = _x(2, 4, 6)
+    check_grad(lambda v: jnp.sum(F.add_position_encoding(v, 0.7, 1.3)
+                                 ** 2), [x])
+    emb = _x(3, 5, lo=0.2, hi=2.0)
+    cvm = np.abs(_x(3, 2)) + 0.5
+    check_grad(lambda e: jnp.sum(
+        F.continuous_value_model(e, cvm) ** 2), [emb])
+
+
+def test_mvn_and_uniform_entropy_grads():
+    from paddle_tpu import distribution as dist
+    check_grad(lambda sd: jnp.sum(dist.MultivariateNormalDiag(
+        np.zeros(3, np.float32), jnp.abs(sd) + 0.5).entropy()), [_x(3)])
