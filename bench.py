@@ -172,8 +172,14 @@ def bench_bert(on_accel: bool) -> None:
     # briefly and keep the winner (set PT_BENCH_FUSED=0/1 to pin).
     pin = os.environ.get("PT_BENCH_FUSED")
     if pin is not None and pin.strip() != "":
-        truthy = pin.strip().lower() in ("1", "true", "yes", "on")
-        candidates = [truthy]
+        val = pin.strip().lower()
+        if val in ("1", "true", "yes", "on"):
+            candidates = [True]
+        elif val in ("0", "false", "no", "off"):
+            candidates = [False]
+        else:
+            raise SystemExit(
+                f"PT_BENCH_FUSED={pin!r}: expected 0/1/true/false")
     elif on_accel:
         candidates = [True, False]
     else:

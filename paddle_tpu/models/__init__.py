@@ -16,3 +16,5 @@ from .gan import Discriminator, GANTrainStep, Generator  # noqa: F401
 from .crnn_ctc import CRNNCTC  # noqa: F401
 from .ssd import SSDLite  # noqa: F401
 from .nlp import SentimentBiLSTM, SRLBiLSTMCRF  # noqa: F401
+from .transformer_xl import (TransformerXL, TransformerXLConfig,  # noqa
+                             TransformerXLTrainStep)

@@ -1,0 +1,219 @@
+"""Transformer-XL language model — BASELINE config 5's model family
+(segment-level recurrence + relative positional attention; the
+reference serves this class of model through its fleet DP + AMP stack).
+
+TPU-native design notes:
+- the segment memory is part of the carried train-step state (like
+  optimizer slots), so multi-segment training stays one donated-buffer
+  jitted step per segment — no host round trips between segments;
+- relative attention uses the standard two-term (content/position)
+  decomposition with the circular-shift trick for the B/D terms, all
+  static shapes;
+- memories are stop_gradient'ed exactly as the paper/reference
+  implementations detach them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+
+
+@dataclass
+class TransformerXLConfig:
+    vocab_size: int = 1000
+    d_model: int = 128
+    n_heads: int = 4
+    d_ff: int = 512
+    n_layers: int = 4
+    mem_len: int = 64
+    dropout: float = 0.1
+
+
+def _rel_shift(x):
+    """[B, H, Tq, Tk] position-logit shift (Dai et al. appendix B):
+    pad one column, reshape, drop — aligns logit (i, j) to relative
+    distance i - j."""
+    b, h, tq, tk = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (1, 0)))
+    x = x.reshape(b, h, tk + 1, tq)
+    return x[:, :, 1:].reshape(b, h, tq, tk)
+
+
+class RelMultiHeadAttention(nn.Layer):
+    def __init__(self, d_model: int, n_heads: int, dropout: float):
+        super().__init__()
+        self.n_heads = n_heads
+        self.d_head = d_model // n_heads
+        self.q = nn.Linear(d_model, d_model, bias_attr=False)
+        self.kv = nn.Linear(d_model, 2 * d_model, bias_attr=False)
+        self.r = nn.Linear(d_model, d_model, bias_attr=False)
+        self.out = nn.Linear(d_model, d_model, bias_attr=False)
+        # global content/position biases (u, v in the paper)
+        self.u = nn.Parameter(jnp.zeros((n_heads, self.d_head),
+                                        jnp.float32))
+        self.v = nn.Parameter(jnp.zeros((n_heads, self.d_head),
+                                        jnp.float32))
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x, ctx, mem_valid, rel_emb):
+        """x [B, T, D]; ctx [B, M+T, D] = concat(mem, x) (built once per
+        layer by the caller); mem_valid: scalar count of REAL memory
+        slots (rightmost) — zero-initialized padding slots must not
+        receive softmax mass, which the content term alone cannot
+        prevent because the position logits (q+v)·r are nonzero for
+        empty slots. rel_emb [M+T, D] (distance M+T-1 .. 0)."""
+        b, t, d = x.shape
+        m = ctx.shape[1] - t
+        q = self.q(x).reshape(b, t, self.n_heads, self.d_head)
+        kv = self.kv(ctx).reshape(b, m + t, 2, self.n_heads, self.d_head)
+        k, v_ = kv[:, :, 0], kv[:, :, 1]
+        r = self.r(rel_emb).reshape(m + t, self.n_heads, self.d_head)
+
+        # content logits: (q + u) . k
+        ac = jnp.einsum("bthd,bshd->bhts", q + self.u[None, None], k)
+        # position logits: (q + v) . r, then shift to relative alignment
+        bd = jnp.einsum("bthd,shd->bhts", q + self.v[None, None], r)
+        bd = _rel_shift(bd)
+        logits = (ac + bd) / (self.d_head ** 0.5)
+
+        # causal over the concatenated timeline + exclude empty
+        # (zero-padded) memory slots
+        pos_k = jnp.arange(m + t)[None, :]
+        pos_q = (m + jnp.arange(t))[:, None]
+        mask = (pos_k <= pos_q) & (pos_k >= m - mem_valid)
+        logits = jnp.where(mask[None, None], logits,
+                           jnp.finfo(logits.dtype).min)
+        w = self.dropout(jax.nn.softmax(logits, axis=-1))
+        o = jnp.einsum("bhts,bshd->bthd", w, v_).reshape(b, t, d)
+        return self.out(o)
+
+
+class TransformerXLLayer(nn.Layer):
+    def __init__(self, cfg: TransformerXLConfig):
+        super().__init__()
+        self.attn = RelMultiHeadAttention(cfg.d_model, cfg.n_heads,
+                                          cfg.dropout)
+        self.ln1 = nn.LayerNorm(cfg.d_model)
+        self.ff1 = nn.Linear(cfg.d_model, cfg.d_ff)
+        self.ff2 = nn.Linear(cfg.d_ff, cfg.d_model)
+        self.ln2 = nn.LayerNorm(cfg.d_model)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x, ctx, mem_valid, rel_emb):
+        h = self.ln1(x + self.dropout(self.attn(x, ctx, mem_valid,
+                                                rel_emb)))
+        ff = self.ff2(F.gelu(self.ff1(h)))
+        return self.ln2(h + self.dropout(ff))
+
+
+class TransformerXL(nn.Layer):
+    """LM head + stack; ``forward(ids, mems)`` returns (logits,
+    new_mems) with new_mems detached (paper's stop-gradient across
+    segments)."""
+
+    def __init__(self, cfg: TransformerXLConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embed = nn.Embedding(cfg.vocab_size, cfg.d_model)
+        self.layers = nn.LayerList(
+            [TransformerXLLayer(cfg) for _ in range(cfg.n_layers)])
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def init_mems(self, batch_size: int):
+        """Memories start EMPTY: fixed-shape zero buffers plus a valid
+        counter (official TXL grows mems from length 0; static shapes
+        make that a mask instead)."""
+        return {"layers": [jnp.zeros((batch_size, self.cfg.mem_len,
+                                      self.cfg.d_model), jnp.float32)
+                           for _ in self.layers],
+                "valid": jnp.zeros((), jnp.int32)}
+
+    def _rel_emb(self, length: int):
+        # sinusoid over relative distances length-1 .. 0
+        pos = jnp.arange(length - 1, -1, -1, dtype=jnp.float32)
+        half = self.cfg.d_model // 2
+        inv = 1.0 / (10000 ** (jnp.arange(half, dtype=jnp.float32)
+                               / half))
+        ang = pos[:, None] * inv[None, :]
+        return jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=1)
+
+    def forward(self, ids, mems=None):
+        b, t = ids.shape
+        if mems is None:
+            mems = self.init_mems(b)
+        valid = mems["valid"]
+        h = self.drop(self.embed(ids))
+        rel = self._rel_emb(self.cfg.mem_len + t)
+        new_layers = []
+        for layer, mem in zip(self.layers, mems["layers"]):
+            # memory update BEFORE the layer transforms h (layer input
+            # is what the paper caches), detached across segments
+            cat = jnp.concatenate([mem, h], axis=1)
+            new_layers.append(
+                jax.lax.stop_gradient(cat[:, -self.cfg.mem_len:]))
+            h = layer(h, cat, valid, rel)
+        logits = h @ self.embed.weight.T  # tied softmax
+        new_mems = {"layers": new_layers,
+                    "valid": jnp.minimum(self.cfg.mem_len, valid + t)}
+        return logits, new_mems
+
+    def loss(self, ids, target, mems=None):
+        logits, new_mems = self.forward(ids, mems)
+        return F.cross_entropy(
+            logits.reshape(-1, self.cfg.vocab_size),
+            target.reshape(-1)), new_mems
+
+
+class TransformerXLTrainStep:
+    """Segment-recurrent train step: the layer memories ride in the
+    donated jitted state next to params/optimizer slots, so a stream of
+    segments is one compiled call each with zero host traffic for the
+    recurrence."""
+
+    def __init__(self, model: TransformerXL, optimizer, batch_size: int,
+                 seed: int = 0):
+        from ..core import random as _random
+
+        self.model = model
+        self.optimizer = optimizer
+        params = model.param_dict()
+        self.state = {
+            "params": params,
+            "buffers": model.buffer_dict(),
+            "opt": optimizer.init(params),
+            "mems": model.init_mems(batch_size),
+            "rng": _random.make_key(seed),
+        }
+
+        def step(state, ids, target):
+            rng, key = jax.random.split(state["rng"])
+
+            def loss_of(p):
+                with _random.rng_scope(default=key, dropout=key):
+                    with model.bind(p, state["buffers"]) as cap:
+                        loss, new_mems = model.loss(ids, target,
+                                                    state["mems"])
+                return loss, (new_mems, cap.buffers)
+
+            (loss, (new_mems, bufs)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(state["params"])
+            new_p, new_opt = optimizer.apply_gradients(
+                state["params"], grads, state["opt"])
+            return ({"params": new_p, "buffers": bufs, "opt": new_opt,
+                     "mems": new_mems, "rng": rng}, {"loss": loss})
+
+        self._jitted = jax.jit(step, donate_argnums=(0,))
+
+    def __call__(self, ids, target):
+        self.state, metrics = self._jitted(self.state, ids, target)
+        return metrics
+
+    def reset_mems(self, batch_size: int):
+        self.state["mems"] = self.model.init_mems(batch_size)

@@ -338,3 +338,8 @@ def test_mvn_and_uniform_entropy_grads():
     from paddle_tpu import distribution as dist
     check_grad(lambda sd: jnp.sum(dist.MultivariateNormalDiag(
         np.zeros(3, np.float32), jnp.abs(sd) + 0.5).entropy()), [_x(3)])
+    f = lambda lo, hi: jnp.sum(  # noqa: E731
+        dist.Uniform(lo, jnp.abs(hi) + 3.0).entropy())
+    args = [_x(2), _x(2)]
+    check_grad(f, args)
+    check_grad(f, args, wrt=1)
