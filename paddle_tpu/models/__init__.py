@@ -18,3 +18,5 @@ from .ssd import SSDLite  # noqa: F401
 from .nlp import SentimentBiLSTM, SRLBiLSTMCRF  # noqa: F401
 from .transformer_xl import (TransformerXL, TransformerXLConfig,  # noqa
                              TransformerXLTrainStep)
+from .ernie import (ErnieConfig, ErnieForPretraining, ErnieModel,  # noqa
+                    knowledge_mask)

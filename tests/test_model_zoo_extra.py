@@ -331,3 +331,66 @@ def test_transformer_xl_empty_memory_is_inert(rng):
     out_b, _ = model(ids, garbage)
     np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b),
                                atol=1e-6)
+
+
+def test_ernie_knowledge_mask_units(rng):
+    from paddle_tpu.models import knowledge_mask
+    B, T, V, MASK = 2, 16, 100, 99
+    ids = rng.integers(0, 90, (B, T)).astype(np.int64)
+    spans = [[(0, 3), (3, 4), (8, 12)], [(0, 1), (5, 8)]]
+    out, labels = knowledge_mask(ids, spans, MASK, V, mask_prob=1.0,
+                                 rng=np.random.default_rng(0))
+    # every unit masked at prob 1: a span is REPLACED as a unit — it is
+    # either all mask_id, all original (the 10% keep branch), or all
+    # random-resampled; a half-masked span must fail
+    n_mask_units = 0
+    for b, row in enumerate(spans):
+        for (s, e) in row:
+            lab = labels[b, s:e]
+            np.testing.assert_array_equal(lab, ids[b, s:e])
+            unit = out[b, s:e]
+            is_all_mask = bool(np.all(unit == MASK))
+            is_all_orig = bool(np.array_equal(unit, ids[b, s:e]))
+            has_any_mask = bool(np.any(unit == MASK))
+            # atomicity: mask tokens never appear in a partially-
+            # original unit
+            assert is_all_mask or not has_any_mask, (b, s, e, unit)
+            n_mask_units += is_all_mask
+            del is_all_orig
+    assert n_mask_units >= 3  # 80% branch dominates at mask_prob=1
+    # non-span positions untouched and ignored
+    assert labels[0, 4] == -100 and out[0, 4] == ids[0, 4]
+    # stochastic by default: two calls without rng differ (eventually)
+    outs = {knowledge_mask(ids, spans, MASK, V,
+                           mask_prob=1.0)[0].tobytes()
+            for _ in range(8)}
+    assert len(outs) > 1
+
+
+def test_ernie_pretrains_end_to_end(rng):
+    from paddle_tpu.models import (ErnieConfig, ErnieForPretraining,
+                                   knowledge_mask)
+    from paddle_tpu.models import pretraining_loss
+    pt.seed(0)
+    cfg = ErnieConfig(vocab_size=60, hidden_size=32,
+                      num_hidden_layers=2, num_attention_heads=2,
+                      intermediate_size=64, max_position_embeddings=32)
+    model = ErnieForPretraining(cfg)
+    opt = pt.optimizer.AdamW(learning_rate=5e-4)
+    step = TrainStep(model, opt,
+                     lambda out, mlm, nsp: pretraining_loss(out, mlm,
+                                                            nsp))
+    B, T = 4, 16
+    ids = rng.integers(4, 60, (B, T)).astype(np.int32)
+    spans = [[(i, min(i + 2, T)) for i in range(0, T, 4)]
+             for _ in range(B)]
+    masked, mlm = knowledge_mask(ids, spans, mask_id=3, vocab_size=60,
+                                 mask_prob=0.5,
+                                 rng=np.random.default_rng(1))
+    nsp = rng.integers(0, 2, (B,)).astype(np.int64)
+    first = float(step(masked.astype(np.int32),
+                       labels=(mlm, nsp))["loss"])
+    for _ in range(30):
+        last = float(step(masked.astype(np.int32),
+                          labels=(mlm, nsp))["loss"])
+    assert last < first, (first, last)
