@@ -972,3 +972,28 @@ def deformable_roi_pooling(feat, rois, trans, output_size,
 
     return jax.vmap(one_roi)(rois, trans,
                              jnp.asarray(roi_batch_idx, jnp.int32))
+
+
+def max_pool3d_with_index(x, kernel_size, stride=None, padding=0):
+    """(ref: max_pool3d_with_index_op) values + flat argmax indices per
+    window over NCDHW input.
+
+    Index recovery packs (value, position) into one f32 reduce_window
+    (value scaled by the spatial size, position subtracted to break
+    ties toward the smaller index). That packing needs value*size to
+    stay inside the f32 mantissa — guard rejects spatial sizes where
+    recovery would silently corrupt."""
+    vals = _pool(x, "max", kernel_size, stride, padding, False, True, 3,
+                 False)
+    n, c, d, h, w = x.shape
+    size = d * h * w
+    if size > (1 << 20):
+        raise ValueError(
+            f"max_pool3d_with_index: spatial size {size} too large for "
+            "exact f32 index packing (limit 2^20)")
+    flat_idx = jnp.arange(size, dtype=jnp.float32).reshape(d, h, w)
+    big = _pool(x.astype(jnp.float32) * size - flat_idx[None, None],
+                "max", kernel_size, stride, padding, False, True, 3,
+                False)
+    idx = (-(big - vals.astype(jnp.float32) * size)).astype(jnp.int32)
+    return vals, idx

@@ -114,3 +114,13 @@ def array_to_lod_tensor(array: TensorArray):
     """(ref: array_to_lod_tensor_op.cc). Inverse: [T, B, ...] steps back
     to the padded [B, T, ...] batch."""
     return jnp.moveaxis(array.data, 0, 1)
+
+
+def write_to_array(array: "TensorArray", i, value) -> "TensorArray":
+    """(ref: write_to_array op) fluid spelling of TensorArray.write."""
+    return array.write(i, value)
+
+
+def read_from_array(array: "TensorArray", i):
+    """(ref: read_from_array op) fluid spelling of TensorArray.read."""
+    return array.read(i)

@@ -184,3 +184,24 @@ def test_checkpoint_preserves_bfloat16(tmp_path):
                                   np.asarray(w, np.float32))
     tgt = pt.io.load(path, target={"w": w, "n": None})
     assert str(tgt["w"].dtype) == "bfloat16"
+
+
+def test_max_pool3d_with_index_recovers_positions():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.ops.nn_functional import max_pool3d_with_index
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (1, 2, 4, 4, 4)).astype(np.float32)
+    v, i = max_pool3d_with_index(x, 2, 2)
+    for c in range(2):
+        flat = x[0, c].reshape(-1)
+        for di in range(2):
+            for hi in range(2):
+                for wi in range(2):
+                    win = x[0, c, di*2:di*2+2, hi*2:hi*2+2, wi*2:wi*2+2]
+                    assert np.isclose(v[0, c, di, hi, wi], win.max())
+                    assert np.isclose(flat[i[0, c, di, hi, wi]],
+                                      win.max())
+    with pytest.raises(ValueError, match="too large"):
+        max_pool3d_with_index(np.zeros((1, 1, 128, 128, 128),
+                                       np.float32), 2, 2)
