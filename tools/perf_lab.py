@@ -42,11 +42,6 @@ def exp_leafcount():
         per = total // n
         state = {f"p{i}": jnp.zeros((per,), jnp.float32)
                  for i in range(n)}
-
-        @jax.jit
-        def step(s):
-            return {k: v + 1.0 for k, v in s.items()}
-
         step_d = jax.jit(lambda s: {k: v + 1.0 for k, v in s.items()},
                          donate_argnums=(0,))
         for _ in range(3):
@@ -62,14 +57,25 @@ def exp_leafcount():
             f"({dt * 1e6 / n:6.2f} us/leaf)")
 
 
+def _repo_root():
+    import os
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
 def exp_fused():
     """BERT step: per-leaf vs fused optimizer state, measured."""
     import os
 
+    import jax
+
     os.environ["PT_BENCH_FUSED"] = ""
-    sys.path.insert(0, ".")
+    sys.path.insert(0, _repo_root())
     import bench
-    bench.bench_bert(on_accel=True)
+    on_accel = any(d.platform in ("tpu", "axon") for d in jax.devices())
+    if not on_accel:
+        log("no accelerator: running the tiny CPU shape (numbers only "
+            "meaningful on a real chip)")
+    bench.bench_bert(on_accel=on_accel)
 
 
 def exp_batch():
@@ -95,17 +101,9 @@ def exp_batch():
         mlm = rng.integers(0, config.vocab_size, (batch, seq)) \
             .astype(np.int64)
         nsp = rng.integers(0, 2, (batch,)).astype(np.int64)
-        for _ in range(6):
-            t0 = time.perf_counter()
-            float(step(ids, labels=(mlm, nsp))["loss"])
-            if time.perf_counter() - t0 < 1.0:
-                break
-        n = 20
-        t0 = time.perf_counter()
-        for _ in range(n):
-            m = step(ids, labels=(mlm, nsp))
-        float(m["loss"])
-        dt = (time.perf_counter() - t0) / n
+        sys.path.insert(0, _repo_root())
+        from bench import warmup_and_time
+        dt = warmup_and_time(lambda: step(ids, labels=(mlm, nsp)), 20)
         log(f"batch={batch}: {dt * 1e3:.1f} ms/step "
             f"{batch * seq / dt:.0f} tok/s")
         del model, step
@@ -113,8 +111,21 @@ def exp_batch():
 
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    known = {"leafcount", "batch", "fused", "all"}
+    if which not in known:
+        raise SystemExit(f"unknown experiment {which!r}; pick from "
+                         f"{sorted(known)}")
+    # fail fast if the accelerator tunnel is wedged (bench.py's probe,
+    # the round-1 rc=124 failure mode)
+    sys.path.insert(0, _repo_root())
+    import bench
+    if not bench._probe_backend(attempts=1, timeout_s=120):
+        raise SystemExit("accelerator backend unreachable (tunnel "
+                         "wedged?); aborting fast")
     import jax
-    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    import os
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_repo_root(), ".jax_cache"))
     log(f"backend={jax.default_backend()} devices={jax.devices()}")
     if which in ("leafcount", "all"):
         exp_leafcount()
