@@ -29,7 +29,8 @@ from .nn.layer import Layer, Parameter
 
 __all__ = ["fake_quantize_abs_max", "fake_quantize_moving_average_abs_max",
            "fake_channel_wise_quantize_abs_max", "QuantizedLinear",
-           "quantize_model", "PostTrainingQuantization"]
+           "quantize_model", "PostTrainingQuantization", "Int8Linear",
+           "convert_to_int8"]
 
 
 def _quant_levels(bits: int) -> float:
@@ -132,6 +133,12 @@ def quantize_model(model: Layer, weight_bits: int = 8,
     return model
 
 
+def _walk_layers(layer: Layer):
+    yield layer
+    for child in layer._sub_layers.values():
+        yield from _walk_layers(child)
+
+
 class PostTrainingQuantization:
     """Calibrate activation scales on sample batches, then emit a model
     with int8-grid weights (ref: post_training_quantization.py
@@ -145,12 +152,27 @@ class PostTrainingQuantization:
         self.act_scales: Dict[str, float] = {}
 
     def calibrate(self, batches: Sequence) -> "PostTrainingQuantization":
-        for batch in batches:
-            args = batch if isinstance(batch, (tuple, list)) else (batch,)
-            out = self.model(*args)
-            key = "output"
-            cur = float(jnp.max(jnp.abs(out)))
-            self.act_scales[key] = max(self.act_scales.get(key, 0.0), cur)
+        """Run calibration forwards. QuantizedLinear EMA act_scale
+        buffers only update in training mode — flip ONLY those layers
+        to training for the passes (BN/dropout and everything else stay
+        in eval), then restore, so eval-mode PTQ actually calibrates."""
+        qlayers = [m for m in _walk_layers(self.model)
+                   if isinstance(m, QuantizedLinear)]
+        prev = [m.training for m in qlayers]
+        for m in qlayers:
+            m.training = True
+        try:
+            for batch in batches:
+                args = batch if isinstance(batch, (tuple, list)) \
+                    else (batch,)
+                out = self.model(*args)
+                key = "output"
+                cur = float(jnp.max(jnp.abs(out)))
+                self.act_scales[key] = max(self.act_scales.get(key, 0.0),
+                                           cur)
+        finally:
+            for m, p in zip(qlayers, prev):
+                m.training = p
         return self
 
     def quantize(self) -> Layer:
@@ -163,3 +185,83 @@ class PostTrainingQuantization:
                     w, bits=self.weight_bits, axis=w.ndim - 1)
                 p.value = wq
         return self.model
+
+
+class Int8Linear(Layer):
+    """TRUE int8 deployment linear: int8 weights + int8 activations,
+    int32 accumulation on the MXU (v5e runs int8 matmul at 2x bf16
+    peak). The deployment form of :class:`QuantizedLinear` — fake-quant
+    layers simulate this grid with float carriers during training; this
+    layer actually stores int8 and dots in int8.
+
+    (ref capability: slim quantization deployment — the reference emits
+    quantize/dequantize + int8 kernels via its IR passes;
+    quantize_op.cc / mkldnn int8 kernels.)
+    """
+
+    def __init__(self, w_q, w_scale, act_scale, bias=None) -> None:
+        super().__init__()
+        self.register_buffer("w_q", jnp.asarray(w_q, jnp.int8))
+        self.register_buffer("w_scale", jnp.asarray(w_scale, jnp.float32))
+        self.register_buffer("act_scale",
+                             jnp.asarray(act_scale, jnp.float32))
+        if bias is not None:
+            self.register_buffer("bias_f", jnp.asarray(bias, jnp.float32))
+        else:
+            self.bias_f = None
+        self.n_weight = 127.0
+        self.n_act = 127.0
+
+    def forward(self, x):
+        lead = x.shape[:-1]
+        xf = x.reshape(-1, x.shape[-1]).astype(jnp.float32)
+        n_a = self.n_act
+        n_w = self.n_weight
+        inv = n_a / jnp.maximum(self.act_scale, 1e-8)
+        xq = jnp.clip(jnp.round(xf * inv), -n_a, n_a).astype(jnp.int8)
+        acc = jax.lax.dot_general(
+            xq, self.w_q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        out = acc.astype(jnp.float32) * (
+            self.act_scale / n_a) * (self.w_scale[None, :] / n_w)
+        if self.bias_f is not None:
+            out = out + self.bias_f
+        return out.reshape(lead + (out.shape[-1],)).astype(x.dtype)
+
+    @classmethod
+    def from_quantized(cls, q: "QuantizedLinear") -> "Int8Linear":
+        """Convert a calibrated fake-quant layer (QAT or PTQ) into the
+        int8 deployment form, honoring its bit widths (<=8; the int8
+        carrier holds any narrower grid) and using the SAME per-channel
+        scale rule as the fake-quant path, so deployment reproduces the
+        grid QAT calibrated for."""
+        if q.weight_bits > 8 or q.activation_bits > 8:
+            raise ValueError(
+                f"Int8Linear carries at most 8 bits; got weight_bits="
+                f"{q.weight_bits} activation_bits={q.activation_bits}")
+        w = q.inner.weight
+        n_w = _quant_levels(q.weight_bits)
+        # identical scale rule (incl. the 1e-8 floor) as
+        # fake_channel_wise_quantize_abs_max
+        _, w_scale = fake_channel_wise_quantize_abs_max(
+            w, bits=q.weight_bits, axis=w.ndim - 1)
+        w_q = jnp.clip(jnp.round(w * (n_w / w_scale[None, :])),
+                       -n_w, n_w).astype(jnp.int8)
+        bias = getattr(q.inner, "bias", None)
+        layer = cls(w_q, w_scale, q.act_scale, bias)
+        layer.n_weight = n_w
+        layer.n_act = _quant_levels(q.activation_bits)
+        return layer
+
+
+def convert_to_int8(model: Layer) -> Layer:
+    """Swap every calibrated QuantizedLinear for its Int8Linear
+    deployment form, in place (run after QAT training or
+    PostTrainingQuantization calibration with quantize_model-wrapped
+    layers). Returns the model."""
+    for name, child in list(model._sub_layers.items()):
+        if isinstance(child, QuantizedLinear):
+            model._sub_layers[name] = Int8Linear.from_quantized(child)
+        else:
+            convert_to_int8(child)
+    return model

@@ -292,3 +292,106 @@ class TestSlim:
         # outputs close to original (8-bit grid)
         x = batches[0]
         np.testing.assert_allclose(x @ after, x @ before, atol=0.1)
+
+
+# ----------------------------------------------------- int8 deployment
+
+def test_int8_linear_matches_fake_quant(rng):
+    import jax.numpy as jnp
+    from paddle_tpu.slim import (Int8Linear, QuantizedLinear,
+                                 convert_to_int8, quantize_model)
+    pt.seed(0)
+
+    class Net(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = pt.nn.Linear(8, 16)
+            self.fc2 = pt.nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.fc2(pt.nn.functional.relu(self.fc1(x)))
+
+    net = Net()
+    quantize_model(net)
+    x = rng.normal(0, 1, (32, 8)).astype(np.float32)
+    # calibrate act scales (training mode updates the EMA buffers)
+    net.train()
+    for _ in range(5):
+        net(x)
+    net.eval()
+    want = np.asarray(net(x))
+    convert_to_int8(net)
+    assert isinstance(net._sub_layers["fc1"], Int8Linear)
+    assert str(net._sub_layers["fc1"].w_q.dtype) == "int8"
+    got = np.asarray(net(x))
+    # int8 grid vs fake-quant grid: same quantization, tiny numeric gap
+    assert np.mean(np.abs(got - want)) < 0.05 * np.mean(np.abs(want))
+    # deployment model still jits
+    import jax
+    j = jax.jit(lambda v: net(v))
+    np.testing.assert_allclose(np.asarray(j(x)), got, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_int8_conversion_roundtrip_through_serving(rng, tmp_path):
+    """int8-converted model exports and serves through the inference
+    engine (weights ride as int8 buffers in the artifact)."""
+    from paddle_tpu import jit as jit_mod
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.slim import convert_to_int8, quantize_model
+    pt.seed(1)
+    net = pt.nn.Sequential(pt.nn.Linear(6, 12), pt.nn.ReLU(),
+                           pt.nn.Linear(12, 3))
+    quantize_model(net)
+    x = rng.normal(0, 1, (4, 6)).astype(np.float32)
+    net.train()
+    net(x)
+    net.eval()
+    convert_to_int8(net)
+    want = np.asarray(net(x))
+    d = str(tmp_path / "int8_artifact")
+    jit_mod.save(net, d, input_spec=[jit_mod.InputSpec([None, 6])])
+    pred = create_predictor(Config(d))
+    got = pred.run([x])[0]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_ptq_eval_mode_calibrates_act_scales(rng):
+    """The documented PTQ recipe (model in EVAL mode) must still update
+    QuantizedLinear act scales (regression: EMA only ran in training
+    mode, leaving act_scale=1 and clipping activations)."""
+    from paddle_tpu.slim import (PostTrainingQuantization,
+                                 convert_to_int8, quantize_model)
+    pt.seed(2)
+    net = pt.nn.Sequential(pt.nn.Linear(4, 8), pt.nn.ReLU(),
+                           pt.nn.Linear(8, 2))
+    quantize_model(net)
+    net.eval()
+    x = (10.0 * rng.normal(0, 1, (16, 4))).astype(np.float32)
+    PostTrainingQuantization(net).calibrate([x, x])
+    scale0 = float(net._sub_layers["0"].act_scale)
+    assert scale0 > 2.0, f"act_scale uncalibrated: {scale0}"
+    # reference = the calibrated fake-quant model (what QAT simulated)
+    want = np.asarray(net(x))
+    convert_to_int8(net)
+    got = np.asarray(net(x))
+    assert np.mean(np.abs(got - want)) < 0.1 * np.mean(np.abs(want))
+
+
+def test_int8_conversion_honors_bit_width(rng):
+    from paddle_tpu.slim import convert_to_int8, quantize_model
+    pt.seed(3)
+    net = pt.nn.Sequential(pt.nn.Linear(4, 6))
+    quantize_model(net, weight_bits=4, activation_bits=4)
+    net.train()
+    x = rng.normal(0, 1, (8, 4)).astype(np.float32)
+    net(x)
+    net.eval()
+    want = np.asarray(net(x))
+    convert_to_int8(net)
+    q = net._sub_layers["0"]
+    assert q.n_weight == 7.0 and q.n_act == 7.0  # 4-bit grid
+    # stored values stay on the 4-bit grid
+    assert np.abs(np.asarray(q.w_q)).max() <= 7
+    got = np.asarray(net(x))
+    assert np.mean(np.abs(got - want)) < 0.2 * np.mean(np.abs(want))
