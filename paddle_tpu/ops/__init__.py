@@ -12,10 +12,10 @@ random_ops, sequence (ragged/LoD analogue), control_flow, sparse
 (SelectedRows analogue), metrics_ops.
 """
 
-from . import (activation, beam, control_flow, conv_extra, crf, detection,
-               loss, manipulation, math, metrics_ops, nn_functional,
-               random_ops, reduction, sampling, search, sequence, sparse,
-               tensor_array)
+from . import (activation, attention, beam, control_flow, conv_extra,
+               crf, detection, loss, manipulation, math, metrics_ops,
+               nn_functional, random_ops, reduction, rnn_functional,
+               sampling, search, sequence, sparse, tensor_array)
 
 from .activation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
@@ -41,6 +41,17 @@ from .beam import (beam_search, beam_search_decode,  # noqa: F401
                    beam_search_step, gather_tree)
 from .sampling import (hash_bucket, hsigmoid_loss, nce_loss,  # noqa: F401
                        sampled_softmax_with_cross_entropy)
+from .rnn_functional import (dynamic_gru, dynamic_lstm,  # noqa: F401
+                             dynamic_lstmp, gru_unit, lstm, lstm_unit)
+from .detection import (bipartite_match, box_clip, box_coder,  # noqa
+                        collect_fpn_proposals, density_prior_box,
+                        generate_mask_labels,
+                        generate_proposal_labels, generate_proposals,
+                        iou_similarity, locality_aware_nms, matrix_nms,
+                        multiclass_nms, prior_box, retinanet_detection_output,
+                        retinanet_target_assign, roi_align, roi_pool,
+                        rpn_target_assign, sigmoid_focal_loss, ssd_loss,
+                        target_assign, yolo_box, yolov3_loss)
 from .conv_extra import *  # noqa: F401,F403
 from .tensor_array import (TensorArray, array_length,  # noqa: F401
                            array_read, array_to_lod_tensor, array_write,
