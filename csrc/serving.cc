@@ -289,6 +289,9 @@ class Server {
       cv_.notify_one();
     }
     conn->alive.store(false);
+    // surface EOF to the peer immediately (a corrupt stream would
+    // otherwise leave the client blocked until the Conn is reaped)
+    ::shutdown(conn->fd, SHUT_RDWR);
   }
 
   int listen_fd_ = -1;

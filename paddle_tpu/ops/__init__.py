@@ -50,8 +50,12 @@ from .detection import (bipartite_match, box_clip, box_coder,  # noqa
                         iou_similarity, locality_aware_nms, matrix_nms,
                         multiclass_nms, prior_box, retinanet_detection_output,
                         retinanet_target_assign, roi_align, roi_pool,
-                        rpn_target_assign, sigmoid_focal_loss, ssd_loss,
+                        rpn_target_assign, ssd_loss,
                         target_assign, yolo_box, yolov3_loss)
+# NOTE: detection.sigmoid_focal_loss (multiclass, fg_num-normalized —
+# the RetinaNet assigner companion) is NOT re-exported here: loss.py's
+# element-wise binary sigmoid_focal_loss already owns the flat name.
+# Reach the detection variant via ops.detection / layers.
 from .conv_extra import *  # noqa: F401,F403
 from .tensor_array import (TensorArray, array_length,  # noqa: F401
                            array_read, array_to_lod_tensor, array_write,

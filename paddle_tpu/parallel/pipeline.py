@@ -113,7 +113,7 @@ class GPipeTrainStep:
     def __init__(self, embed: Layer, stage_layers: Sequence[Layer],
                  head: Layer, optimizer, loss_fn: Callable, mesh: Mesh,
                  num_microbatches: int, axis: str = "pp",
-                 seed: int = 0) -> None:
+                 remat_stages: bool = False, seed: int = 0) -> None:
         self.embed = embed
         self.head = head
         self.stage_layers = list(stage_layers)
@@ -172,6 +172,13 @@ class GPipeTrainStep:
         def stage_fn(stage_params, x_mb):
             return functional_call(template, stage_params, None, x_mb)
 
+        if remat_stages:
+            # GPipe's peak lives in the stored per-microbatch stage
+            # activations; rematerializing the stage body trades one
+            # extra stage forward in the backward pass for dropping
+            # those intermediates — the reference exposes the same knob
+            # as recompute+pipeline (DistributedStrategy.recompute)
+            stage_fn = jax.checkpoint(stage_fn)
         self._stage_fn = stage_fn
 
     def _forward(self, params, x):

@@ -255,3 +255,31 @@ with open(r"{out}" + f"-{{rank}}", "w") as fh:
         rc = launch_procs([sys.executable, script], nproc=2,
                           start_control_plane=False)
         assert rc == 3
+
+
+def test_gpipe_remat_stages_matches_plain(rng):
+    """remat_stages=True must be numerically identical (same schedule,
+    recomputed activations) while compiling successfully."""
+    from paddle_tpu.parallel import create_mesh
+    from paddle_tpu.parallel.pipeline import GPipeTrainStep
+    import paddle_tpu as pt
+
+    def build(remat):
+        pt.seed(5)
+        mesh = create_mesh({"pp": 2}, allow_submesh=True)
+        embed = pt.nn.Linear(4, 8)
+        stages = [pt.nn.Linear(8, 8) for _ in range(2)]
+        head = pt.nn.Linear(8, 3)
+        return GPipeTrainStep(
+            embed, stages, head, pt.optimizer.SGD(learning_rate=0.1),
+            lambda out, y: pt.nn.functional.cross_entropy(out, y),
+            mesh, num_microbatches=2, remat_stages=remat)
+
+    x = rng.normal(0, 1, (4, 4)).astype(np.float32)
+    y = rng.integers(0, 3, (4,)).astype(np.int64)
+    a = build(False)
+    b = build(True)
+    for _ in range(3):
+        la = float(a(x, labels=y)["loss"])
+        lb = float(b(x, labels=y)["loss"])
+        assert la == pytest.approx(lb, rel=1e-6), (la, lb)

@@ -203,3 +203,51 @@ def test_client_pipelining(artifact):
             for i in range(4):
                 np.testing.assert_allclose(outs[i], want[: i + 1],
                                            rtol=1e-5, atol=1e-5)
+
+
+def test_server_survives_garbage_stream(artifact):
+    """A client sending a corrupt magic/length must get disconnected
+    without wedging the server for others."""
+    import socket
+    import struct
+    d, x, want = artifact
+    pred = create_predictor(Config(d))
+    with Server(pred, wait_ms=1) as srv:
+        # garbage magic
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        s.sendall(b"NOPE" + b"\0" * 16)
+        # server closes the corrupt stream
+        s.settimeout(5)
+        assert s.recv(1) == b""
+        s.close()
+        # huge declared length (over kMaxPayload): also a clean close
+        s2 = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        s2.sendall(struct.pack("<IQI", 0x56535450, 1, 0xFFFFFFFF))
+        s2.settimeout(5)
+        assert s2.recv(1) == b""
+        s2.close()
+        # a well-formed client still gets served
+        with Client(port=srv.port) as cli:
+            out = cli.infer([x[:2]])[0]
+            assert out.shape == (2, 3)
+
+
+def test_server_client_death_drops_reply(artifact):
+    """Client disconnecting before its reply must not corrupt the
+    server (reply is dropped, next clients fine)."""
+    import socket
+    import struct
+    d, x, want = artifact
+    pred = create_predictor(Config(d))
+    with Server(pred, wait_ms=50, max_batch=4) as srv:
+        from paddle_tpu.inference import encode_tensors
+        payload = encode_tensors([x[:1]])
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        s.sendall(struct.pack("<IQI", 0x56535450, 7, len(payload))
+                  + payload)
+        s.close()  # gone before the batch window closes
+        import time as _t
+        _t.sleep(0.3)
+        with Client(port=srv.port) as cli:
+            out = cli.infer([x[:1]])[0]
+            assert out.shape == (1, 3)
