@@ -221,17 +221,56 @@ class Executor:
 
     def infer_from_dataset(self, program, dataset,
                            input_slots: Optional[Sequence[str]] = None,
-                           drop_last: bool = False):
+                           drop_last: bool = False,
+                           dump_fields: Optional[Sequence[str]] = None,
+                           dump_fields_path: Optional[str] = None):
         """Inference counterpart (ref: executor.py:1451): run a callable
-        program over every batch, return list of outputs."""
+        program over every batch, return list of outputs.
+
+        ``dump_fields``/``dump_fields_path`` mirror the reference
+        DeviceWorker dump (device_worker.cc DumpField: per-instance
+        tab-separated slot values + prediction written to a file, the
+        PS-job audit trail). Fields name input slots to echo; the
+        program output is always dumped as the last column.
+        """
         names = dataset.slot_names()
         if input_slots is None:
             input_slots = names
+        dump_f = None
+        if dump_fields_path is not None:
+            import os
+            os.makedirs(os.path.dirname(dump_fields_path) or ".",
+                        exist_ok=True)
+            dump_f = open(dump_fields_path, "w")
+            dump_fields = list(dump_fields or [])
         outs = []
-        for batch in dataset:
-            args = tuple(batch[n] for n in input_slots)
-            outs.append(program(*args))
+        try:
+            for batch in dataset:
+                args = tuple(batch[n] for n in input_slots)
+                out = program(*args)
+                outs.append(out)
+                if dump_f is not None:
+                    self._dump_batch(dump_f, batch, dump_fields, out)
+        finally:
+            if dump_f is not None:
+                dump_f.close()
         return outs
+
+    @staticmethod
+    def _dump_batch(f, batch, fields: Sequence[str], out) -> None:
+        """One line per instance: field:value... \t pred:... (the
+        reference's DumpField format, device_worker.cc)."""
+        arr = np.asarray(jax.tree.leaves(out)[0])
+        rows = arr.shape[0] if arr.ndim else 1
+        for i in range(rows):
+            cols = []
+            for name in fields:
+                v = np.asarray(batch[name])[i].ravel()
+                cols.append(name + ":" + ",".join(str(x) for x in v))
+            pred = arr[i].ravel() if arr.ndim else arr.ravel()
+            cols.append("pred:" + ",".join(f"{float(x):.6g}"
+                                           for x in pred))
+            f.write("\t".join(cols) + "\n")
 
 
 def _check_nan_inf(tree, what: str) -> None:

@@ -181,3 +181,32 @@ def test_infer_from_dataset(tmp_path):
                                   input_slots=["x"])
     assert len(outs) == 1 and outs[0].shape == (16, 1)
     ds.release()
+
+
+def test_infer_from_dataset_dump_fields(tmp_path):
+    """DeviceWorker dump parity (ref: device_worker.cc DumpField):
+    per-instance slot echo + prediction lines."""
+    import jax.numpy as jnp
+
+    files = _write_regression_files(str(tmp_path), n_files=1, rows=8)
+    ds = DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(4)
+    ds.set_thread(1)
+    ds.set_slots([("x", "dense", 4), ("y", "dense", 1)])
+    ds.set_filelist(files)
+
+    exe = pt.static.Executor()
+    dump_path = str(tmp_path / "dump" / "part-0")
+    outs = exe.infer_from_dataset(
+        lambda x: jnp.sum(x, axis=1, keepdims=True), ds,
+        input_slots=["x"], dump_fields=["x"],
+        dump_fields_path=dump_path)
+    assert len(outs) >= 1
+    lines = open(dump_path).read().strip().splitlines()
+    assert len(lines) == sum(np.asarray(o).shape[0] for o in outs)
+    first = lines[0].split("\t")
+    assert first[0].startswith("x:")
+    assert first[1].startswith("pred:")
+    fvals = [float(v) for v in first[0].split(":")[1].split(",")]
+    pval = float(first[1].split(":")[1])
+    assert pval == pytest.approx(sum(fvals), rel=1e-4)
