@@ -236,6 +236,10 @@ class Executor:
         names = dataset.slot_names()
         if input_slots is None:
             input_slots = names
+        if dump_fields and dump_fields_path is None:
+            raise ValueError(
+                "dump_fields given without dump_fields_path — the "
+                "audit dump would be silently dropped")
         dump_f = None
         if dump_fields_path is not None:
             import os
@@ -246,30 +250,40 @@ class Executor:
         outs = []
         try:
             for batch in dataset:
+                rows = batch[names[0]].shape[0]
+                if drop_last and rows < dataset._batch_size:
+                    continue
                 args = tuple(batch[n] for n in input_slots)
                 out = program(*args)
                 outs.append(out)
                 if dump_f is not None:
-                    self._dump_batch(dump_f, batch, dump_fields, out)
+                    self._dump_batch(dump_f, batch, dump_fields, out,
+                                     rows)
         finally:
             if dump_f is not None:
                 dump_f.close()
         return outs
 
     @staticmethod
-    def _dump_batch(f, batch, fields: Sequence[str], out) -> None:
+    def _dump_batch(f, batch, fields: Sequence[str], out,
+                    rows: int) -> None:
         """One line per instance: field:value... \t pred:... (the
-        reference's DumpField format, device_worker.cc)."""
-        arr = np.asarray(jax.tree.leaves(out)[0])
-        rows = arr.shape[0] if arr.ndim else 1
+        reference's DumpField format, device_worker.cc). The row count
+        comes from the BATCH (outputs may carry scalar aux leaves);
+        every output leaf with a matching leading dim contributes a
+        pred column."""
+        host_fields = {name: np.asarray(batch[name]) for name in fields}
+        pred_leaves = [np.asarray(leaf) for leaf in jax.tree.leaves(out)]
+        pred_leaves = [a for a in pred_leaves
+                       if a.ndim >= 1 and a.shape[0] == rows]
         for i in range(rows):
             cols = []
             for name in fields:
-                v = np.asarray(batch[name])[i].ravel()
+                v = host_fields[name][i].ravel()
                 cols.append(name + ":" + ",".join(str(x) for x in v))
-            pred = arr[i].ravel() if arr.ndim else arr.ravel()
-            cols.append("pred:" + ",".join(f"{float(x):.6g}"
-                                           for x in pred))
+            for a in pred_leaves:
+                cols.append("pred:" + ",".join(
+                    f"{float(x):.6g}" for x in a[i].ravel()))
             f.write("\t".join(cols) + "\n")
 
 

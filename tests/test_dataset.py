@@ -210,3 +210,25 @@ def test_infer_from_dataset_dump_fields(tmp_path):
     fvals = [float(v) for v in first[0].split(":")[1].split(",")]
     pval = float(first[1].split(":")[1])
     assert pval == pytest.approx(sum(fvals), rel=1e-4)
+
+
+def test_infer_dump_guards(tmp_path):
+    files = _write_regression_files(str(tmp_path), n_files=1, rows=10)
+    ds = DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(4)
+    ds.set_thread(1)
+    ds.set_slots([("x", "dense", 4), ("y", "dense", 1)])
+    ds.set_filelist(files)
+    import jax.numpy as jnp
+    exe = pt.static.Executor()
+    with pytest.raises(ValueError, match="dump_fields_path"):
+        exe.infer_from_dataset(lambda x: x, ds, input_slots=["x"],
+                               dump_fields=["x"])
+    # drop_last skips the 2-row tail (both in outputs and the dump)
+    ds.set_filelist(files)
+    dump_path = str(tmp_path / "d" / "part")
+    outs = exe.infer_from_dataset(
+        lambda x: jnp.sum(x, 1, keepdims=True), ds, input_slots=["x"],
+        drop_last=True, dump_fields=["x"], dump_fields_path=dump_path)
+    assert sum(np.asarray(o).shape[0] for o in outs) == 8
+    assert len(open(dump_path).read().strip().splitlines()) == 8
