@@ -432,6 +432,7 @@ psroi_pool = _det.psroi_pool
 prroi_pool = _det.prroi_pool
 roi_perspective_transform = _det.roi_perspective_transform
 deformable_conv = _convx.deformable_conv
+deformable_psroi_pooling = _F.deformable_roi_pooling  # reference op name
 generate_proposals = _det.generate_proposals
 rpn_target_assign = _det.rpn_target_assign
 retinanet_target_assign = _det.retinanet_target_assign
