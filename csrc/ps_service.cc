@@ -54,6 +54,8 @@ enum PsOp : uint8_t {
   kSparseSize = 7,
   kSave = 8,
   kLoad = 9,
+  kHeartbeat = 10,
+  kLiveness = 11,
 };
 
 enum Optim : int32_t { kSgd = 0, kAdagrad = 1, kAdam = 2, kSum = 3 };
@@ -434,9 +436,27 @@ class PsServer {
         return Status(fd, SaveTo(key) ? 0 : -1);
       case kLoad:
         return Status(fd, LoadFrom(key) ? 0 : -1);
+      case kHeartbeat: {
+        // worker liveness (ref: heart_beat_monitor.cc — pserver tracks
+        // per-worker beat times and flags silent workers)
+        std::lock_guard<std::mutex> lk(beat_mu_);
+        beats_[key] = std::chrono::steady_clock::now();
+        return Status(fd, 0);
+      }
+      case kLiveness: {
+        std::lock_guard<std::mutex> lk(beat_mu_);
+        auto it = beats_.find(key);
+        if (it == beats_.end()) return Status(fd, -1);  // never beat
+        auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+            std::chrono::steady_clock::now() - it->second).count();
+        return Status(fd, static_cast<int64_t>(ms));
+      }
     }
     return false;
   }
+
+  std::mutex beat_mu_;
+  std::map<std::string, std::chrono::steady_clock::time_point> beats_;
 
   std::shared_ptr<SparseTable> FindSparse(const std::string& key) {
     std::lock_guard<std::mutex> lk(mu_);
@@ -857,6 +877,24 @@ int pt_ps_load(int64_t h, const char* path) {
   if (!PsSend(c.get(), kLoad, path, "")) return -4;
   int64_t st;
   return ReadFull(c->fd(), &st, 8) ? static_cast<int>(st) : -4;
+}
+
+int64_t pt_ps_heartbeat(int64_t h, const char* worker) {
+  auto c = PsGet(h);
+  if (!c) return -4;
+  std::lock_guard<std::mutex> lk(c->mu());
+  if (!PsSend(c.get(), kHeartbeat, worker, "")) return -4;
+  int64_t st;
+  return ReadFull(c->fd(), &st, 8) ? st : -4;
+}
+
+int64_t pt_ps_liveness(int64_t h, const char* worker) {
+  auto c = PsGet(h);
+  if (!c) return -4;
+  std::lock_guard<std::mutex> lk(c->mu());
+  if (!PsSend(c.get(), kLiveness, worker, "")) return -4;
+  int64_t st;
+  return ReadFull(c->fd(), &st, 8) ? st : -4;
 }
 
 }  // extern "C"

@@ -132,6 +132,11 @@ int64_t pt_ps_sparse_size(int64_t h, const char* name);
 // Persist / restore all tables (binary file). 0 ok, -1 error.
 int pt_ps_save(int64_t h, const char* path);
 int pt_ps_load(int64_t h, const char* path);
+// Worker liveness (ref: heart_beat_monitor.cc). heartbeat records a
+// beat for `worker`; liveness returns ms since its last beat, or -1 if
+// it never beat (-4 transport error).
+int64_t pt_ps_heartbeat(int64_t h, const char* worker);
+int64_t pt_ps_liveness(int64_t h, const char* worker);
 
 // ---------------- inference serving transport ----------------
 // Native TCP front for the serving engine (serving.cc): framed

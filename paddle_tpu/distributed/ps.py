@@ -288,3 +288,59 @@ def run_server(port: int = 0, ready_callback: Optional[Callable] = None,
         finally:
             server.stop()
     return server
+
+
+class HeartbeatMonitor:
+    """Worker liveness over the PS (ref: heart_beat_monitor.cc — the
+    pserver-side monitor flagging workers that stop calling in).
+
+    Each worker runs ``start_beating(worker_id)`` (background thread,
+    one beat per ``interval_s``); any process can ask
+    ``dead_workers(workers, timeout_ms)``. Failure DETECTION half of
+    the elastic story — restart orchestration is
+    ``distributed.launch --elastic``.
+    """
+
+    def __init__(self, client, interval_s: float = 2.0) -> None:
+        self.client = client
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start_beating(self, worker_id: str) -> None:
+        if self._thread is not None:
+            raise RuntimeError("already beating")
+        self._stop.clear()  # allow stop() -> start_beating() restarts
+        self.client.heartbeat(worker_id)  # immediate first beat
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.client.heartbeat(worker_id)
+                except Exception:
+                    return  # connection gone; the monitor sees silence
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def dead_workers(self, workers, timeout_ms: int):
+        """Workers whose last beat is older than timeout_ms (or that
+        never beat)."""
+        dead = []
+        for w in workers:
+            ms = self.client.liveness_ms(w)
+            if ms is None or ms > timeout_ms:
+                dead.append(w)
+        return dead
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -137,6 +137,8 @@ def _load():
             "pt_ps_sparse_size": ([c.c_int64, c.c_char_p], c.c_int64),
             "pt_ps_save": ([c.c_int64, c.c_char_p], c.c_int),
             "pt_ps_load": ([c.c_int64, c.c_char_p], c.c_int),
+            "pt_ps_heartbeat": ([c.c_int64, c.c_char_p], c.c_int64),
+            "pt_ps_liveness": ([c.c_int64, c.c_char_p], c.c_int64),
             "pt_srv_start": ([c.c_int, c.c_int], c.c_int64),
             "pt_srv_port": ([c.c_int64], c.c_int),
             "pt_srv_stop": ([c.c_int64], None),
@@ -518,6 +520,23 @@ class PsClient:
         v = _load().pt_ps_sparse_size(self._h, name.encode())
         if v < 0:
             raise RuntimeError(f"ps sparse_size({name!r}) failed ({v})")
+        return int(v)
+
+    def heartbeat(self, worker: str) -> None:
+        """Record a liveness beat for `worker` on the server
+        (ref: heart_beat_monitor.cc UPDATE_CALLED_COUNT)."""
+        v = _load().pt_ps_heartbeat(self._h, worker.encode())
+        if v < 0:
+            raise RuntimeError(f"ps heartbeat({worker!r}) failed ({v})")
+
+    def liveness_ms(self, worker: str) -> Optional[int]:
+        """Milliseconds since `worker`'s last beat, or None if it never
+        beat (ref: heart_beat_monitor.cc CheckBeat)."""
+        v = _load().pt_ps_liveness(self._h, worker.encode())
+        if v == -1:
+            return None
+        if v < 0:
+            raise RuntimeError(f"ps liveness({worker!r}) failed ({v})")
         return int(v)
 
     def save(self, path: str) -> None:

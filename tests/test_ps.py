@@ -411,3 +411,53 @@ class TestMultiProcessPS:
                                        atol=1e-5)
         finally:
             s.stop()
+
+
+def test_heartbeat_monitor_detects_silent_worker():
+    """(ref: heart_beat_monitor.cc) beats keep a worker alive; silence
+    past the timeout flags it; unknown workers count as dead."""
+    import time as _t
+    from paddle_tpu.distributed.ps import HeartbeatMonitor
+    from paddle_tpu.native import PsClient, PsServer
+
+    with PsServer() as server:
+        cli = PsClient(port=server.port)
+        try:
+            mon = HeartbeatMonitor(cli, interval_s=0.1)
+            with mon:
+                mon.start_beating("w0")
+                _t.sleep(0.4)
+                assert mon.dead_workers(["w0"], timeout_ms=1000) == []
+                # w1 never beat
+                assert mon.dead_workers(["w0", "w1"],
+                                        timeout_ms=1000) == ["w1"]
+            # stopped: after the timeout elapses w0 goes dead
+            _t.sleep(0.5)
+            cli2 = PsClient(port=server.port)
+            try:
+                mon2 = HeartbeatMonitor(cli2)
+                assert mon2.dead_workers(["w0"], timeout_ms=300) == ["w0"]
+                assert mon2.dead_workers(["w0"], timeout_ms=60000) == []
+            finally:
+                cli2.close()
+        finally:
+            cli.close()
+
+
+def test_heartbeat_monitor_restartable():
+    import time as _t
+    from paddle_tpu.distributed.ps import HeartbeatMonitor
+    from paddle_tpu.native import PsClient, PsServer
+
+    with PsServer() as server:
+        cli = PsClient(port=server.port)
+        try:
+            mon = HeartbeatMonitor(cli, interval_s=0.05)
+            mon.start_beating("w0")
+            mon.stop()
+            mon.start_beating("w0")  # restart must keep beating
+            _t.sleep(0.4)
+            assert mon.dead_workers(["w0"], timeout_ms=250) == []
+            mon.stop()
+        finally:
+            cli.close()
