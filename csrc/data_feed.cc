@@ -13,6 +13,7 @@
 // dense slot: count == dim, float values; sparse slot: count int64 ids.
 
 #include "ptnative.h"
+#include "ptnative_internal.h"
 
 #include <algorithm>
 #include <atomic>
@@ -393,14 +394,7 @@ std::shared_ptr<DataFeed> GetFeed(int64_t h) {
   return it == g_feeds.end() ? nullptr : it->second;
 }
 
-std::vector<std::string> SplitSemicolon(const char* s) {
-  std::vector<std::string> out;
-  std::stringstream ss(s);
-  std::string item;
-  while (std::getline(ss, item, ';'))
-    if (!item.empty()) out.push_back(item);
-  return out;
-}
+using ptnative::SplitSemicolon;
 
 }  // namespace
 

@@ -138,6 +138,25 @@ int pt_ps_load(int64_t h, const char* path);
 int64_t pt_ps_heartbeat(int64_t h, const char* worker);
 int64_t pt_ps_liveness(int64_t h, const char* worker);
 
+// ---------------- text tokenizer ----------------
+// Threaded vocab building + whitespace-token encoding (tokenizer.cc;
+// the text analogue of the native data feed — reference fluid/string
+// utilities back its C++ readers). Ids are frequency-ranked with
+// lexicographic tie-break, matching the Python dataset builders.
+int64_t pt_tok_build(const char* files_semicolon, int64_t min_freq,
+                     int num_threads);
+void pt_tok_destroy(int64_t h);
+int64_t pt_tok_vocab_size(int64_t h);
+int64_t pt_tok_lookup(int64_t h, const char* word);  // -1 unknown
+int64_t pt_tok_word(int64_t h, int64_t id, char* buf, int64_t cap);
+// Returns token count (may exceed cap; only cap entries written).
+int64_t pt_tok_encode(int64_t h, const char* text, int64_t* out,
+                      int64_t cap, int64_t unk_id);
+int64_t pt_tok_encode_file(int64_t h, const char* path, int64_t* out,
+                           int64_t cap, int64_t unk_id);
+int pt_tok_save(int64_t h, const char* path);
+int64_t pt_tok_load(const char* path);
+
 // ---------------- inference serving transport ----------------
 // Native TCP front for the serving engine (serving.cc): framed
 // request/reply with pipelining, bounded queue with backpressure. The

@@ -1,0 +1,25 @@
+// Shared internal helpers for the native runtime TUs (not part of the
+// C API surface in ptnative.h).
+#ifndef PTNATIVE_INTERNAL_H_
+#define PTNATIVE_INTERNAL_H_
+
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace ptnative {
+
+// One parser for every semicolon-separated list argument of the C API
+// (file lists etc.) so the convention cannot drift between components.
+inline std::vector<std::string> SplitSemicolon(const char* s) {
+  std::vector<std::string> out;
+  std::stringstream ss(s);
+  std::string item;
+  while (std::getline(ss, item, ';'))
+    if (!item.empty()) out.push_back(item);
+  return out;
+}
+
+}  // namespace ptnative
+
+#endif  // PTNATIVE_INTERNAL_H_

@@ -139,6 +139,20 @@ def _load():
             "pt_ps_load": ([c.c_int64, c.c_char_p], c.c_int),
             "pt_ps_heartbeat": ([c.c_int64, c.c_char_p], c.c_int64),
             "pt_ps_liveness": ([c.c_int64, c.c_char_p], c.c_int64),
+            "pt_tok_build": ([c.c_char_p, c.c_int64, c.c_int], c.c_int64),
+            "pt_tok_destroy": ([c.c_int64], None),
+            "pt_tok_vocab_size": ([c.c_int64], c.c_int64),
+            "pt_tok_lookup": ([c.c_int64, c.c_char_p], c.c_int64),
+            "pt_tok_word": ([c.c_int64, c.c_int64, c.c_char_p, c.c_int64],
+                            c.c_int64),
+            "pt_tok_encode": ([c.c_int64, c.c_char_p,
+                               c.POINTER(c.c_int64), c.c_int64,
+                               c.c_int64], c.c_int64),
+            "pt_tok_encode_file": ([c.c_int64, c.c_char_p,
+                                    c.POINTER(c.c_int64), c.c_int64,
+                                    c.c_int64], c.c_int64),
+            "pt_tok_save": ([c.c_int64, c.c_char_p], c.c_int),
+            "pt_tok_load": ([c.c_char_p], c.c_int64),
             "pt_srv_start": ([c.c_int, c.c_int], c.c_int64),
             "pt_srv_port": ([c.c_int64], c.c_int),
             "pt_srv_stop": ([c.c_int64], None),
@@ -546,6 +560,91 @@ class PsClient:
     def load(self, path: str) -> None:
         if _load().pt_ps_load(self._h, path.encode()) != 0:
             raise RuntimeError(f"ps load({path!r}) failed")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# -------------------------------------------------------------- tokenizer
+
+class Tokenizer:
+    """Native corpus tokenizer/vocab (csrc/tokenizer.cc): threaded
+    frequency counting over files, whitespace encoding to ids. The
+    text analogue of NativeDataFeed — keeps corpus preprocessing off
+    the GIL (ref capability: fluid/string-backed C++ readers)."""
+
+    def __init__(self, handle: int):
+        if handle < 0:
+            raise RuntimeError("tokenizer build/load failed")
+        self._h = handle
+
+    @classmethod
+    def build(cls, files: Sequence[str], min_freq: int = 1,
+              num_threads: int = 4) -> "Tokenizer":
+        h = _load().pt_tok_build(";".join(files).encode(), min_freq,
+                                 num_threads)
+        return cls(h)
+
+    @classmethod
+    def load(cls, path: str) -> "Tokenizer":
+        return cls(_load().pt_tok_load(path.encode()))
+
+    def save(self, path: str) -> None:
+        if _load().pt_tok_save(self._h, path.encode()) != 0:
+            raise RuntimeError(f"tokenizer save to {path} failed")
+
+    def __len__(self) -> int:
+        v = _load().pt_tok_vocab_size(self._h)
+        if v < 0:
+            raise RuntimeError("tokenizer closed")
+        return int(v)
+
+    def lookup(self, word: str) -> Optional[int]:
+        v = _load().pt_tok_lookup(self._h, word.encode())
+        if v == -2:
+            raise RuntimeError("tokenizer closed")
+        return None if v == -1 else int(v)
+
+    def word(self, idx: int) -> str:
+        cap = 4096
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = _load().pt_tok_word(self._h, idx, buf, cap)
+            if n == -2:      # buffer too small, NOT a bad index
+                cap *= 8
+                continue
+            if n < 0:
+                raise IndexError(idx)
+            return buf.value.decode()
+
+    def _encode_with(self, fn, arg: bytes, unk_id: int) -> np.ndarray:
+        cap = 1 << 16
+        while True:
+            out = np.empty(cap, np.int64)
+            n = fn(self._h, arg,
+                   out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                   cap, unk_id)
+            if n < 0:
+                raise RuntimeError("tokenizer encode failed")
+            if n <= cap:
+                return out[:n].copy()
+            cap = int(n)
+
+    def encode(self, text: str, unk_id: int = -1) -> np.ndarray:
+        return self._encode_with(_load().pt_tok_encode, text.encode(),
+                                 unk_id)
+
+    def encode_file(self, path: str, unk_id: int = -1) -> np.ndarray:
+        return self._encode_with(_load().pt_tok_encode_file,
+                                 path.encode(), unk_id)
+
+    def close(self) -> None:
+        if self._h > 0:
+            _load().pt_tok_destroy(self._h)
+            self._h = -1
 
     def __enter__(self):
         return self

@@ -290,3 +290,62 @@ class TestControlPlaneFailurePaths:
         with native.ControlPlaneClient(port=cp_server.port) as c:
             c.set("still", b"alive")
             assert c.get("still") == b"alive"
+
+
+def test_tokenizer_matches_python_reference(tmp_path):
+    """Native vocab/encode vs a straight Python re-derivation
+    (frequency-ranked ids, lexicographic ties)."""
+    import collections
+    from paddle_tpu import native
+
+    texts = ["the cat sat on the mat\nthe dog sat\n",
+             "a cat and a dog and a bird\n"]
+    files = []
+    for i, t in enumerate(texts):
+        p = tmp_path / f"corpus-{i}.txt"
+        p.write_text(t)
+        files.append(str(p))
+
+    with native.Tokenizer.build(files, min_freq=1, num_threads=2) as tok:
+        freq = collections.Counter(" ".join(texts).split())
+        ref = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+        assert len(tok) == len(ref)
+        for i, (w, _) in enumerate(ref):
+            assert tok.lookup(w) == i, w
+            assert tok.word(i) == w
+        assert tok.lookup("zebra") is None
+        ids = tok.encode("the cat zebra", unk_id=999)
+        assert list(ids) == [tok.lookup("the"), tok.lookup("cat"), 999]
+        fids = tok.encode_file(files[0])
+        want = [tok.lookup(w) for w in texts[0].split()]
+        assert list(fids) == want
+        # round trip through save/load
+        vpath = str(tmp_path / "vocab.txt")
+        tok.save(vpath)
+    with native.Tokenizer.load(vpath) as tok2:
+        assert len(tok2) == len(ref)
+        assert tok2.lookup(ref[0][0]) == 0
+
+
+def test_tokenizer_min_freq_and_missing_file(tmp_path):
+    from paddle_tpu import native
+    p = tmp_path / "c.txt"
+    p.write_text("aa aa bb\n")
+    with native.Tokenizer.build([str(p)], min_freq=2) as tok:
+        assert len(tok) == 1 and tok.lookup("aa") == 0
+    with pytest.raises(RuntimeError):
+        native.Tokenizer.build([str(tmp_path / "nope.txt")])
+
+
+def test_tokenizer_closed_and_long_word(tmp_path):
+    from paddle_tpu import native
+    longword = "x" * 9000
+    p = tmp_path / "c.txt"
+    p.write_text(f"{longword} b\n")
+    tok = native.Tokenizer.build([str(p)])
+    assert tok.word(tok.lookup(longword)) == longword  # > 4096 bytes
+    tok.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        tok.lookup("b")
+    with pytest.raises(RuntimeError, match="closed"):
+        len(tok)
