@@ -149,6 +149,8 @@ void pt_tok_destroy(int64_t h);
 int64_t pt_tok_vocab_size(int64_t h);
 int64_t pt_tok_lookup(int64_t h, const char* word);  // -1 unknown
 int64_t pt_tok_word(int64_t h, int64_t id, char* buf, int64_t cap);
+// Per-id corpus counts (build-time only; empty for loaded vocabs).
+int64_t pt_tok_freqs(int64_t h, int64_t* out, int64_t cap);
 // Returns token count (may exceed cap; only cap entries written).
 int64_t pt_tok_encode(int64_t h, const char* text, int64_t* out,
                       int64_t cap, int64_t unk_id);

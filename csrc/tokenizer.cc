@@ -33,7 +33,8 @@ namespace {
 
 struct Tokenizer {
   std::unordered_map<std::string, int64_t> vocab;
-  std::vector<std::string> words;  // id -> word
+  std::vector<std::string> words;   // id -> word
+  std::vector<int64_t> freqs;       // id -> corpus count (0 if loaded)
 };
 
 using ptnative::SplitSemicolon;
@@ -123,9 +124,11 @@ int64_t pt_tok_build(const char* files_semicolon, int64_t min_freq,
             });
   auto tok = std::make_shared<Tokenizer>();
   tok->words.reserve(items.size());
+  tok->freqs.reserve(items.size());
   for (std::size_t i = 0; i < items.size(); ++i) {
     tok->vocab[items[i].first] = (int64_t)i;
     tok->words.push_back(items[i].first);
+    tok->freqs.push_back(items[i].second);
   }
   return Put(std::move(tok));
 }
@@ -149,11 +152,22 @@ int64_t pt_tok_lookup(int64_t h, const char* word) {
 
 int64_t pt_tok_word(int64_t h, int64_t id, char* buf, int64_t cap) {
   auto t = Get(h);
-  if (!t || id < 0 || id >= (int64_t)t->words.size()) return -1;
+  if (!t) return -3;  // bad/closed handle (distinct from bad index)
+  if (id < 0 || id >= (int64_t)t->words.size()) return -1;
   const std::string& w = t->words[(std::size_t)id];
   if ((int64_t)w.size() + 1 > cap) return -2;
   std::memcpy(buf, w.c_str(), w.size() + 1);
   return (int64_t)w.size();
+}
+
+// Copy per-id corpus counts into out (cap entries). Returns vocab
+// size; loaded-from-file vocabs have no counts (returns 0 entries).
+int64_t pt_tok_freqs(int64_t h, int64_t* out, int64_t cap) {
+  auto t = Get(h);
+  if (!t) return -3;
+  int64_t n = (int64_t)t->freqs.size();
+  for (int64_t i = 0; i < n && i < cap; ++i) out[i] = t->freqs[i];
+  return n;
 }
 
 // Encode whitespace tokens of `text` into out (cap entries); unknown

@@ -320,9 +320,11 @@ class Imikolov(Dataset):
     def __init__(self, mode: str = "train", data_type: str = "ngram",
                  window_size: int = 5, seq_len: int = 64,
                  min_word_freq: int = 50,
-                 data_home: Optional[str] = None) -> None:
+                 data_home: Optional[str] = None,
+                 use_native_tokenizer: bool = False) -> None:
         self.data_type = data_type
         self.window_size = window_size
+        self.use_native_tokenizer = use_native_tokenizer
         if mode == "synthetic":
             rng = np.random.default_rng(13)
             vocab = 200
@@ -344,22 +346,58 @@ class Imikolov(Dataset):
                         self._URL)
         fname = ("./simple-examples/data/ptb.train.txt" if mode == "train"
                  else "./simple-examples/data/ptb.valid.txt")
-        freq: dict = {}
-        lines_cache = []
         with tarfile.open(path, "r:*") as tar:
             # dict over the TRAIN split only (ref: build_dict(train()))
             f = tar.extractfile("./simple-examples/data/ptb.train.txt")
-            train_lines = f.read().decode("utf-8").splitlines()
-            for line in train_lines:
-                for w in line.strip().split():
-                    freq[w] = freq.get(w, 0) + 1
+            train_text = f.read().decode("utf-8")
+            train_lines = train_text.splitlines()
             if mode == "train":
                 lines_cache = train_lines
             else:
                 f = tar.extractfile(fname)
                 lines_cache = f.read().decode("utf-8").splitlines()
-        freq = {w: c for w, c in freq.items() if c > min_word_freq
-                and w != "<unk>"}
+        # The C++ tokenizer splits on ASCII whitespace (istream >>);
+        # Python str.split() also splits on Unicode whitespace. PTB is
+        # ASCII, but a user-staged corpus may not be — fall back to the
+        # Python path rather than silently diverge.
+        _uni_ws = "\u00a0\u1680\u2000\u2028\u2029\u202f\u205f\u3000\u0085"
+        if use_native_tokenizer and any(c in train_text for c in _uni_ws):
+            use_native_tokenizer = False
+        if use_native_tokenizer:
+            # threaded C++ counting (csrc/tokenizer.cc) — same
+            # frequency-ranked ordering as the Python path below, so the
+            # resulting word ids are identical (tested)
+            import os as _os
+            import tempfile
+
+            from ..native import Tokenizer
+            with tempfile.NamedTemporaryFile("w", suffix=".txt",
+                                             encoding="utf-8",
+                                             delete=False) as tf:
+                tf.write(train_text)
+                tmp_corpus = tf.name
+            try:
+                with Tokenizer.build([tmp_corpus], min_freq=1) as tok:
+                    # counts come straight from the build (one C call);
+                    # words via the saved vocab file (one I/O) instead
+                    # of a per-word ctypes round-trip
+                    cnts = tok.freqs()
+                    vpath = tmp_corpus + ".vocab"
+                    tok.save(vpath)
+                    with open(vpath, encoding="utf-8") as vf:
+                        vocab_words = vf.read().splitlines()
+                    _os.unlink(vpath)
+            finally:
+                _os.unlink(tmp_corpus)
+            freq = {w: int(c) for w, c in zip(vocab_words, cnts)
+                    if c > min_word_freq and w != "<unk>"}
+        else:
+            freq = {}
+            for line in train_lines:
+                for w in line.strip().split():
+                    freq[w] = freq.get(w, 0) + 1
+            freq = {w: c for w, c in freq.items() if c > min_word_freq
+                    and w != "<unk>"}
         words = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
         # ids: 0.. for words, then <s>, <e>, <unk> (ref ordering)
         self.word_idx = {w: i for i, (w, _) in enumerate(words)}

@@ -145,6 +145,8 @@ def _load():
             "pt_tok_lookup": ([c.c_int64, c.c_char_p], c.c_int64),
             "pt_tok_word": ([c.c_int64, c.c_int64, c.c_char_p, c.c_int64],
                             c.c_int64),
+            "pt_tok_freqs": ([c.c_int64, c.POINTER(c.c_int64), c.c_int64],
+                             c.c_int64),
             "pt_tok_encode": ([c.c_int64, c.c_char_p,
                                c.POINTER(c.c_int64), c.c_int64,
                                c.c_int64], c.c_int64),
@@ -616,9 +618,22 @@ class Tokenizer:
             if n == -2:      # buffer too small, NOT a bad index
                 cap *= 8
                 continue
+            if n == -3:
+                raise RuntimeError("tokenizer closed")
             if n < 0:
                 raise IndexError(idx)
             return buf.value.decode()
+
+    def freqs(self) -> np.ndarray:
+        """Per-id corpus counts from build (empty for loaded vocabs)."""
+        n = len(self)
+        out = np.zeros(n, np.int64)
+        v = _load().pt_tok_freqs(
+            self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n)
+        if v == -3:
+            raise RuntimeError("tokenizer closed")
+        return out[:max(0, int(v))]
 
     def _encode_with(self, fn, arg: bytes, unk_id: int) -> np.ndarray:
         cap = 1 << 16

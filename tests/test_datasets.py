@@ -228,3 +228,29 @@ def test_synthetic_imikolov_movielens_feed_models():
     ml = Movielens(mode="synthetic")
     row, rating = ml[0]
     assert row.shape == (6,) and 1 <= float(rating) <= 5
+
+
+def test_imikolov_native_tokenizer_parity(tmp_path):
+    """use_native_tokenizer=True must build the IDENTICAL vocab/ngrams
+    as the Python path (C++ counting, same freq-ranked ordering)."""
+    from paddle_tpu.datasets import Imikolov
+    train_text = ("the cat sat on the mat\n"
+                  "the dog sat on the log\n" * 30)
+    valid_text = "the cat sat\n"
+    path = tmp_path / "simple-examples.tgz"
+    with tarfile.open(path, "w:gz") as tar:
+        for name, text in (
+                ("./simple-examples/data/ptb.train.txt", train_text),
+                ("./simple-examples/data/ptb.valid.txt", valid_text)):
+            data = text.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    py = Imikolov(mode="train", window_size=3, min_word_freq=5,
+                  data_home=str(tmp_path))
+    nat = Imikolov(mode="train", window_size=3, min_word_freq=5,
+                   data_home=str(tmp_path), use_native_tokenizer=True)
+    assert py.word_idx == nat.word_idx
+    assert len(py) == len(nat)
+    np.testing.assert_array_equal(py.ctx, nat.ctx)
+    np.testing.assert_array_equal(py.nxt, nat.nxt)

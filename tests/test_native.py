@@ -349,3 +349,16 @@ def test_tokenizer_closed_and_long_word(tmp_path):
         tok.lookup("b")
     with pytest.raises(RuntimeError, match="closed"):
         len(tok)
+
+
+def test_tokenizer_freqs_and_closed_word(tmp_path):
+    from paddle_tpu import native
+    p = tmp_path / "c.txt"
+    p.write_text("b a a c a b\n")
+    tok = native.Tokenizer.build([str(p)])
+    f = tok.freqs()
+    # freq-ranked: a(3), b(2), c(1)
+    assert list(f) == [3, 2, 1]
+    tok.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        tok.word(0)
