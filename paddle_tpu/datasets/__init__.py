@@ -29,7 +29,8 @@ import numpy as np
 from ..data import Dataset
 
 __all__ = ["DATA_HOME", "MNIST", "FashionMNIST", "Cifar10", "Cifar100",
-           "UCIHousing", "Imdb", "Imikolov", "Movielens"]
+           "UCIHousing", "Imdb", "Imikolov", "Movielens", "WMT16",
+           "MQ2007"]
 
 
 def DATA_HOME() -> str:
@@ -511,3 +512,176 @@ class Movielens(Dataset):
 
     def __getitem__(self, i):
         return self.rows[i], self.ratings[i]
+
+
+class WMT16(Dataset):
+    """Multi30K EN-DE translation pairs (ref: dataset/wmt16.py — parses
+    wmt16.tar.gz's tab-separated "en<TAB>de" train/val/test members,
+    builds frequency-sorted dicts per language with <s>/<e>/<unk> at ids
+    0/1/2, yields (src_ids, trg_ids, trg_ids_next)).
+
+    Dense padded redesign: sequences pad to ``seq_len`` with <e> after
+    the end mark; per-row lengths ride along so losses can mask. The
+    (trg_ids, trg_ids_next) teacher-forcing pair follows the reference
+    exactly: trg = <s> + words, trg_next = words + <e>.
+    """
+
+    _URL = "http://paddlemodels.bj.bcebos.com/wmt/wmt16.tar.gz"
+    START, END, UNK = 0, 1, 2
+
+    def __init__(self, mode: str = "train", src_dict_size: int = 4000,
+                 trg_dict_size: int = 4000, src_lang: str = "en",
+                 seq_len: int = 50,
+                 data_home: Optional[str] = None) -> None:
+        self.seq_len = seq_len
+        if mode == "synthetic":
+            rng = np.random.default_rng(19)
+            n, v = 128, 200
+            self.src_dict = {f"w{i}": i for i in range(v)}
+            self.trg_dict = dict(self.src_dict)
+            self.src = rng.integers(3, v, (n, seq_len)).astype(np.int64)
+            self.trg = np.roll(self.src, 1, axis=1)
+            self.trg[:, 0] = self.START
+            self.trg_next = self.src.copy()
+            self.src_len = np.full((n,), seq_len, np.int64)
+            self.trg_len = np.full((n,), seq_len, np.int64)
+            return
+        home = data_home or os.path.join(DATA_HOME(), "wmt16")
+        path = _require(os.path.join(home, "wmt16.tar.gz"), self._URL)
+        member = {"train": "wmt16/train", "val": "wmt16/val",
+                  "test": "wmt16/test"}[mode]
+        src_col = 0 if src_lang == "en" else 1
+
+        # ONE pass over the gzip'd train member counts both language
+        # columns (dicts always come from train, whatever the mode)
+        freqs = ({}, {})
+        with tarfile.open(path, "r:*") as tar:
+            for raw in tar.extractfile("wmt16/train"):
+                parts = raw.decode("utf-8").strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                for col in (0, 1):
+                    for w in parts[col].split():
+                        freqs[col][w] = freqs[col].get(w, 0) + 1
+
+        def build_dict(col, size):
+            # ref ordering: specials then frequency-sorted, cut to size.
+            # Corpus tokens spelled like the specials are skipped — a
+            # literal "<unk>" would otherwise clobber id 2 (same filter
+            # Imikolov applies).
+            d = {"<s>": 0, "<e>": 1, "<unk>": 2}
+            for w, _ in sorted(freqs[col].items(), key=lambda kv: -kv[1]):
+                if len(d) >= size:
+                    break
+                if w not in d:
+                    d[w] = len(d)
+            return d
+
+        self.src_dict = build_dict(src_col, src_dict_size)
+        self.trg_dict = build_dict(1 - src_col, trg_dict_size)
+        src_rows, trg_rows, trg_next_rows = [], [], []
+        src_lens, trg_lens = [], []
+
+        def pad(ids):
+            row = np.full((seq_len,), self.END, np.int64)
+            n_ids = min(len(ids), seq_len)
+            row[:n_ids] = ids[:seq_len]
+            return row, n_ids
+
+        with tarfile.open(path, "r:*") as tar:
+            for raw in tar.extractfile(member):
+                parts = raw.decode("utf-8").strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                sw = parts[src_col].split()
+                tw = parts[1 - src_col].split()
+                src_ids = [self.START] + [
+                    self.src_dict.get(w, self.UNK) for w in sw] \
+                    + [self.END]
+                t_ids = [self.trg_dict.get(w, self.UNK) for w in tw]
+                trg_ids = [self.START] + t_ids
+                trg_next = t_ids + [self.END]
+                s_row, s_len = pad(src_ids)
+                t_row, t_len = pad(trg_ids)
+                tn_row, _ = pad(trg_next)
+                src_rows.append(s_row)
+                trg_rows.append(t_row)
+                trg_next_rows.append(tn_row)
+                src_lens.append(s_len)
+                trg_lens.append(t_len)
+        self.src = np.stack(src_rows)
+        self.trg = np.stack(trg_rows)
+        self.trg_next = np.stack(trg_next_rows)
+        self.src_len = np.asarray(src_lens, np.int64)
+        self.trg_len = np.asarray(trg_lens, np.int64)
+
+    def __len__(self):
+        return len(self.src)
+
+    def __getitem__(self, i):
+        return (self.src[i], self.trg[i], self.trg_next[i],
+                self.src_len[i], self.trg_len[i])
+
+
+class MQ2007(Dataset):
+    """LETOR MQ2007 learning-to-rank (ref: dataset/mq2007.py — parses
+    "rel qid:N 1:v .. 46:v #docid" lines; pairwise/listwise readers).
+
+    Dense layout: per-row (features [46], relevance, query_id); use
+    ``query_groups()`` for listwise batching (contiguous row ranges per
+    query, the analogue of the reference's per-query yield).
+    """
+
+    _URL = ("https://download.microsoft.com/download/E/7/E/"
+            "E7EABEF1-4C7B-4E31-ACE5-73927950ED5E/Querylevelnorm.rar")
+    N_FEATURES = 46
+
+    def __init__(self, mode: str = "train",
+                 data_home: Optional[str] = None) -> None:
+        if mode == "synthetic":
+            rng = np.random.default_rng(23)
+            n = 120
+            self.features = rng.normal(0, 1, (n, self.N_FEATURES)) \
+                .astype(np.float32)
+            self.labels = rng.integers(0, 3, (n,)).astype(np.int64)
+            self.qids = np.repeat(np.arange(n // 8), 8).astype(np.int64)[:n]
+            return
+        home = data_home or os.path.join(DATA_HOME(), "mq2007")
+        fname = {"train": "train.txt", "val": "vali.txt",
+                 "test": "test.txt"}[mode]
+        path = _require(os.path.join(home, fname), self._URL)
+        feats, labels, qids = [], [], []
+        with open(path) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                parts = line.split()
+                labels.append(int(parts[0]))
+                qids.append(int(parts[1].split(":", 1)[1]))
+                row = np.zeros((self.N_FEATURES,), np.float32)
+                for tok in parts[2:]:
+                    k, v = tok.split(":", 1)
+                    idx = int(k) - 1
+                    if 0 <= idx < self.N_FEATURES:
+                        row[idx] = float(v)
+                feats.append(row)
+        self.features = np.stack(feats)
+        self.labels = np.asarray(labels, np.int64)
+        self.qids = np.asarray(qids, np.int64)
+
+    def query_groups(self):
+        """[(qid, start, end)] contiguous ranges (listwise batching)."""
+        out = []
+        start = 0
+        for i in range(1, len(self.qids) + 1):
+            if i == len(self.qids) or self.qids[i] != self.qids[start]:
+                out.append((int(self.qids[start]), start, i))
+                start = i
+        return out
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, i):
+        return self.features[i], self.labels[i], self.qids[i]

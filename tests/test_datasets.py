@@ -254,3 +254,72 @@ def test_imikolov_native_tokenizer_parity(tmp_path):
     assert len(py) == len(nat)
     np.testing.assert_array_equal(py.ctx, nat.ctx)
     np.testing.assert_array_equal(py.nxt, nat.nxt)
+
+
+def test_wmt16_parses_tarball(tmp_path):
+    from paddle_tpu.datasets import WMT16
+    train = ("the cat\tdie katze\n"
+             "the dog\tder hund\n" * 10)
+    val = "a cat\teine katze\n"
+    path = tmp_path / "wmt16.tar.gz"
+    with tarfile.open(path, "w:gz") as tar:
+        for name, text in (("wmt16/train", train), ("wmt16/val", val)):
+            data = text.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    ds = WMT16(mode="train", seq_len=8, data_home=str(tmp_path))
+    # specials at 0/1/2; "the" most frequent source word -> id 3
+    assert ds.src_dict["<s>"] == 0 and ds.src_dict["the"] == 3
+    src, trg, trg_next, sl, tl = ds[0]
+    assert src[0] == 0                      # <s>
+    assert src[int(sl) - 1] == 1            # <e>
+    # teacher forcing: trg = <s>+words, trg_next = words+<e>
+    assert trg[0] == 0
+    np.testing.assert_array_equal(trg[1:int(tl)],
+                                  trg_next[:int(tl) - 1])
+    v = WMT16(mode="val", seq_len=8, data_home=str(tmp_path))
+    assert len(v) == 1
+    # "a" never appears in train: its val encoding must be <unk> (id 2)
+    assert "a" not in v.src_dict
+    vsrc = v[0][0]
+    assert vsrc[1] == 2  # <s>, then the unseen word -> <unk>
+    syn = WMT16(mode="synthetic")
+    s0 = syn[0]
+    assert s0[0].shape == (50,)
+
+
+def test_mq2007_parses_letor_format(tmp_path):
+    from paddle_tpu.datasets import MQ2007
+    lines = [
+        "2 qid:10 1:0.5 2:0.25 46:1.0 #docid = A",
+        "0 qid:10 1:0.1 3:0.75 #docid = B",
+        "1 qid:11 2:0.9 #docid = C",
+    ]
+    (tmp_path / "train.txt").write_text("\n".join(lines) + "\n")
+    ds = MQ2007(mode="train", data_home=str(tmp_path))
+    assert len(ds) == 3
+    f0, l0, q0 = ds[0]
+    assert l0 == 2 and q0 == 10
+    assert f0[0] == pytest.approx(0.5) and f0[45] == pytest.approx(1.0)
+    assert f0[2] == 0.0
+    groups = ds.query_groups()
+    assert groups == [(10, 0, 2), (11, 2, 3)]
+    syn = MQ2007(mode="synthetic")
+    assert syn[0][0].shape == (46,)
+
+
+
+def test_wmt16_literal_special_tokens_do_not_clobber(tmp_path):
+    from paddle_tpu.datasets import WMT16
+    train = "<unk> cat\tkatze x\n" * 5
+    path = tmp_path / "wmt16.tar.gz"
+    with tarfile.open(path, "w:gz") as tar:
+        info = tarfile.TarInfo("wmt16/train")
+        data = train.encode()
+        info.size = len(data)
+        tar.addfile(info, io.BytesIO(data))
+    ds = WMT16(mode="train", seq_len=6, data_home=str(tmp_path))
+    assert ds.src_dict["<unk>"] == 2          # special keeps its id
+    ids = sorted(ds.src_dict.values())
+    assert ids == list(range(len(ids)))       # no duplicate ids
