@@ -30,7 +30,7 @@ from ..data import Dataset
 
 __all__ = ["DATA_HOME", "MNIST", "FashionMNIST", "Cifar10", "Cifar100",
            "UCIHousing", "Imdb", "Imikolov", "Movielens", "WMT16",
-           "MQ2007"]
+           "MQ2007", "Conll05"]
 
 
 def DATA_HOME() -> str:
@@ -553,16 +553,18 @@ class WMT16(Dataset):
         src_col = 0 if src_lang == "en" else 1
 
         # ONE pass over the gzip'd train member counts both language
-        # columns (dicts always come from train, whatever the mode)
+        # columns (dicts always come from train, whatever the mode);
+        # the decoded lines are cached so mode="train" never re-streams
+        # the archive
+        self._line_cache = {}
         freqs = ({}, {})
-        with tarfile.open(path, "r:*") as tar:
-            for raw in tar.extractfile("wmt16/train"):
-                parts = raw.decode("utf-8").strip().split("\t")
-                if len(parts) != 2:
-                    continue
-                for col in (0, 1):
-                    for w in parts[col].split():
-                        freqs[col][w] = freqs[col].get(w, 0) + 1
+        for raw in self._member_lines(path, "wmt16/train"):
+            parts = raw.strip().split("\t")
+            if len(parts) != 2:
+                continue
+            for col in (0, 1):
+                for w in parts[col].split():
+                    freqs[col][w] = freqs[col].get(w, 0) + 1
 
         def build_dict(col, size):
             # ref ordering: specials then frequency-sorted, cut to size.
@@ -588,13 +590,15 @@ class WMT16(Dataset):
             row[:n_ids] = ids[:seq_len]
             return row, n_ids
 
-        with tarfile.open(path, "r:*") as tar:
-            for raw in tar.extractfile(member):
-                parts = raw.decode("utf-8").strip().split("\t")
+        for raw in self._member_lines(path, member):
+                parts = raw.strip().split("\t")
                 if len(parts) != 2:
                     continue
-                sw = parts[src_col].split()
-                tw = parts[1 - src_col].split()
+                # truncate WORDS first so <s>/<e> always survive — the
+                # padded row's invariant (row[len-1] == <e>) is what
+                # decode-until-<e> consumers key on
+                sw = parts[src_col].split()[: seq_len - 2]
+                tw = parts[1 - src_col].split()[: seq_len - 2]
                 src_ids = [self.START] + [
                     self.src_dict.get(w, self.UNK) for w in sw] \
                     + [self.END]
@@ -614,6 +618,13 @@ class WMT16(Dataset):
         self.trg_next = np.stack(trg_next_rows)
         self.src_len = np.asarray(src_lens, np.int64)
         self.trg_len = np.asarray(trg_lens, np.int64)
+
+    def _member_lines(self, path, member):
+        if member not in self._line_cache:
+            with tarfile.open(path, "r:*") as tar:
+                text = tar.extractfile(member).read().decode("utf-8")
+            self._line_cache[member] = text.splitlines()
+        return self._line_cache[member]
 
     def __len__(self):
         return len(self.src)
@@ -685,3 +696,148 @@ class MQ2007(Dataset):
 
     def __getitem__(self, i):
         return self.features[i], self.labels[i], self.qids[i]
+
+
+class Conll05(Dataset):
+    """CoNLL-2005 semantic role labeling (ref: dataset/conll05.py —
+    words.gz/props.gz pairs inside conll05st-tests.tar.gz; bracketed
+    span columns convert to BIO tags; one example per predicate).
+
+    Zero-egress adaptation: word/tag dicts build from the parsed corpus
+    (frequency-ranked, <unk>=0 like the reference's UNK_IDX) instead of
+    the reference's downloaded dict files. Yields dense padded
+    (word_ids [T], predicate_mark [T], tag_ids [T], length) — the exact
+    input contract of models.SRLBiLSTMCRF.
+    """
+
+    _URL = ("http://paddlemodels.bj.bcebos.com/conll05st/"
+            "conll05st-tests.tar.gz")
+
+    def __init__(self, mode: str = "test", seq_len: int = 64,
+                 data_home: Optional[str] = None,
+                 words_member: str = ("conll05st-release/test.wsj/words/"
+                                      "test.wsj.words.gz"),
+                 props_member: str = ("conll05st-release/test.wsj/props/"
+                                      "test.wsj.props.gz")) -> None:
+        self.seq_len = seq_len
+        if mode not in ("test", "synthetic"):
+            raise ValueError(
+                f"Conll05 mode={mode!r}: the public CoNLL-05 release "
+                "ships only the test splits (conll05st-tests.tar.gz); "
+                "use mode='test' (default members) or 'synthetic'")
+        if mode == "synthetic":
+            rng = np.random.default_rng(29)
+            n, v, t = 64, 120, 9
+            self.word_dict = {f"w{i}": i for i in range(v)}
+            self.label_dict = {f"T{i}": i for i in range(t)}
+            self.words = rng.integers(1, v, (n, seq_len)).astype(np.int64)
+            self.marks = (rng.random((n, seq_len)) < 0.1).astype(np.int64)
+            self.tags = rng.integers(0, t, (n, seq_len)).astype(np.int64)
+            self.lengths = np.full((n,), seq_len, np.int64)
+            return
+        home = data_home or os.path.join(DATA_HOME(), "conll05")
+        path = _require(os.path.join(home, "conll05st-tests.tar.gz"),
+                        self._URL)
+        sentences = self._parse(path, words_member, props_member)
+        # dicts: <unk>=0, then frequency-ranked words (ref UNK_IDX = 0)
+        freq: dict = {}
+        tagset = set()
+        for words, preds in sentences:
+            for w in words:
+                freq[w] = freq.get(w, 0) + 1
+            for _, bio in preds:
+                tagset.update(bio)
+        self.word_dict = {"<unk>": 0}
+        for w, _ in sorted(freq.items(), key=lambda kv: (-kv[1], kv[0])):
+            self.word_dict[w] = len(self.word_dict)
+        self.label_dict = {t: i for i, t in enumerate(sorted(tagset))}
+        rows_w, rows_m, rows_t, lens = [], [], [], []
+        for words, preds in sentences:
+            wid = [self.word_dict.get(w, 0) for w in words]
+            for verb_idx, bio in preds:
+                n_tok = min(len(words), seq_len)
+                w_row = np.zeros((seq_len,), np.int64)
+                m_row = np.zeros((seq_len,), np.int64)
+                t_row = np.zeros((seq_len,), np.int64)
+                w_row[:n_tok] = wid[:seq_len]
+                if verb_idx < seq_len:
+                    m_row[verb_idx] = 1
+                t_row[:n_tok] = [self.label_dict[b]
+                                 for b in bio[:seq_len]]
+                rows_w.append(w_row)
+                rows_m.append(m_row)
+                rows_t.append(t_row)
+                lens.append(n_tok)
+        self.words = np.stack(rows_w)
+        self.marks = np.stack(rows_m)
+        self.tags = np.stack(rows_t)
+        self.lengths = np.asarray(lens, np.int64)
+
+    @staticmethod
+    def _parse(path, words_member, props_member):
+        """[(words, [(verb_index, bio_tags)])] per sentence."""
+        with tarfile.open(path, "r:*") as tar:
+            wf = tar.extractfile(words_member)
+            pf = tar.extractfile(props_member)
+            words_text = gzip.decompress(wf.read()).decode("utf-8")
+            props_text = gzip.decompress(pf.read()).decode("utf-8")
+        w_lines = words_text.splitlines()
+        p_lines = props_text.splitlines()
+        if len(w_lines) != len(p_lines):
+            raise ValueError(
+                f"conll05 words/props line counts differ "
+                f"({len(w_lines)} vs {len(p_lines)}) — mispaired or "
+                "truncated files would silently misalign every tag")
+        sentences = []
+        cur_words: list = []
+        cur_props: list = []
+        for wline, pline in zip(w_lines, p_lines):
+            w = wline.strip()
+            p = pline.strip().split()
+            if not w:  # sentence boundary
+                if cur_words:
+                    sentences.append(
+                        Conll05._finish(cur_words, cur_props))
+                cur_words, cur_props = [], []
+                continue
+            cur_words.append(w)
+            cur_props.append(p)
+        if cur_words:
+            sentences.append(Conll05._finish(cur_words, cur_props))
+        return sentences
+
+    @staticmethod
+    def _finish(words, props):
+        """props rows: [verb_lemma_or_-, span_col_per_predicate...];
+        bracket spans -> BIO (the reference's corpus_reader walk)."""
+        n_pred = len(props[0]) - 1 if props else 0
+        preds = []
+        for col in range(1, n_pred + 1):
+            bio = []
+            cur = None
+            verb_idx = 0
+            for i, row in enumerate(props):
+                tok = row[col]
+                if tok.startswith("("):
+                    tag = tok[1:].split("*", 1)[0]
+                    bio.append("B-" + tag)
+                    cur = tag
+                    if tag == "V":
+                        verb_idx = i
+                    if tok.endswith(")"):
+                        cur = None
+                elif cur is not None:
+                    bio.append("I-" + cur)
+                    if tok.endswith(")"):
+                        cur = None
+                else:
+                    bio.append("O")
+            preds.append((verb_idx, bio))
+        return words, preds
+
+    def __len__(self):
+        return len(self.words)
+
+    def __getitem__(self, i):
+        return (self.words[i], self.marks[i], self.tags[i],
+                self.lengths[i])

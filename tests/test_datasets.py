@@ -323,3 +323,77 @@ def test_wmt16_literal_special_tokens_do_not_clobber(tmp_path):
     assert ds.src_dict["<unk>"] == 2          # special keeps its id
     ids = sorted(ds.src_dict.values())
     assert ids == list(range(len(ids)))       # no duplicate ids
+
+
+def test_conll05_bracket_to_bio(tmp_path):
+    from paddle_tpu.datasets import Conll05
+    # sentence: "the cat chased mice" with predicate "chased":
+    # props col: (A0* *) (V*) (A1*)
+    words = "the\ncat\nchased\nmice\n\n"
+    props = ("-    (A0*\n"
+             "-    *)\n"
+             "chase (V*)\n"
+             "-    (A1*)\n"
+             "\n")
+    import gzip as _gz
+    path = tmp_path / "conll05st-tests.tar.gz"
+    with tarfile.open(path, "w:gz") as tar:
+        for name, text in (("w.gz", words), ("p.gz", props)):
+            data = _gz.compress(text.encode())
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    ds = Conll05(mode="test", seq_len=8, data_home=str(tmp_path),
+                 words_member="w.gz", props_member="p.gz")
+    assert len(ds) == 1  # one predicate
+    w, m, t, ln = ds[0]
+    assert int(ln) == 4
+    inv = {v: k for k, v in ds.label_dict.items()}
+    bio = [inv[int(x)] for x in t[:4]]
+    assert bio == ["B-A0", "I-A0", "B-V", "B-A1"]
+    assert list(m[:4]) == [0, 0, 1, 0]  # predicate mark on the verb
+    # feeds the SRL model end to end
+    import paddle_tpu as pt
+    from paddle_tpu.models import SRLBiLSTMCRF
+    pt.seed(0)
+    model = SRLBiLSTMCRF(len(ds.word_dict), len(ds.label_dict),
+                         embed_dim=8, hidden=8, num_layers=1)
+    loss = model.loss(ds.words[:1].astype(np.int32),
+                      ds.marks[:1].astype(np.int32),
+                      ds.tags[:1].astype(np.int32),
+                      ds.lengths[:1].astype(np.int32))
+    assert np.isfinite(float(loss))
+
+
+def test_wmt16_truncation_keeps_end_mark(tmp_path):
+    from paddle_tpu.datasets import WMT16
+    long_src = " ".join(f"w{i}" for i in range(20))
+    train = f"{long_src}\tkurz\n" * 6
+    path = tmp_path / "wmt16.tar.gz"
+    with tarfile.open(path, "w:gz") as tar:
+        info = tarfile.TarInfo("wmt16/train")
+        data = train.encode()
+        info.size = len(data)
+        tar.addfile(info, io.BytesIO(data))
+    ds = WMT16(mode="train", seq_len=8, data_home=str(tmp_path))
+    src, trg, trg_next, sl, tl = ds[0]
+    assert int(sl) == 8
+    assert src[0] == 0 and src[int(sl) - 1] == 1  # <s>...<e> survive
+    assert trg_next[int(tl) - 1] == 1             # stop signal present
+
+
+def test_conll05_mode_and_mismatch_guards(tmp_path):
+    from paddle_tpu.datasets import Conll05
+    with pytest.raises(ValueError, match="mode"):
+        Conll05(mode="train", data_home=str(tmp_path))
+    import gzip as _gz
+    path = tmp_path / "conll05st-tests.tar.gz"
+    with tarfile.open(path, "w:gz") as tar:
+        for name, text in (("w.gz", "a\nb\n\n"), ("p.gz", "-\n\n")):
+            data = _gz.compress(text.encode())
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    with pytest.raises(ValueError, match="line counts differ"):
+        Conll05(mode="test", data_home=str(tmp_path),
+                words_member="w.gz", props_member="p.gz")
