@@ -18,6 +18,7 @@ real shapes/dtypes for smoke tests and CI.
 from __future__ import annotations
 
 import gzip
+import io
 import os
 import pickle
 import struct
@@ -30,7 +31,7 @@ from ..data import Dataset
 
 __all__ = ["DATA_HOME", "MNIST", "FashionMNIST", "Cifar10", "Cifar100",
            "UCIHousing", "Imdb", "Imikolov", "Movielens", "WMT16",
-           "MQ2007", "Conll05"]
+           "MQ2007", "Conll05", "Flowers", "VOC2012"]
 
 
 def DATA_HOME() -> str:
@@ -841,3 +842,173 @@ class Conll05(Dataset):
     def __getitem__(self, i):
         return (self.words[i], self.marks[i], self.tags[i],
                 self.lengths[i])
+
+
+class Flowers(Dataset):
+    """Oxford 102 flowers (ref: dataset/flowers.py — 102flowers.tgz of
+    jpg/*.jpg, imagelabels.mat 1-based labels, setid.mat split ids).
+
+    Images decode+resize at access time (PIL), [C, H, W] float32 in
+    [0, 1]; labels shift to 0-based.
+    """
+
+    _URL = ("http://www.robots.ox.ac.uk/~vgg/data/flowers/102/"
+            "102flowers.tgz (+ imagelabels.mat, setid.mat)")
+
+    def __init__(self, mode: str = "train", image_size: int = 64,
+                 transform=None, data_home: Optional[str] = None) -> None:
+        self.image_size = image_size
+        self.transform = transform
+        if mode == "synthetic":
+            rng = np.random.default_rng(31)
+            n = 32
+            self.images = rng.random((n, 3, image_size, image_size)) \
+                .astype(np.float32)
+            self.labels = rng.integers(0, 102, (n,)).astype(np.int64)
+            return
+        self.images = None
+        import scipy.io as sio
+        home = data_home or os.path.join(DATA_HOME(), "flowers")
+        tgz = _require(os.path.join(home, "102flowers.tgz"), self._URL)
+        labels_mat = _require(os.path.join(home, "imagelabels.mat"),
+                              self._URL)
+        setid_mat = _require(os.path.join(home, "setid.mat"), self._URL)
+        all_labels = sio.loadmat(labels_mat)["labels"].ravel() - 1
+        splits = sio.loadmat(setid_mat)
+        key = {"train": "trnid", "val": "valid", "test": "tstid"}[mode]
+        ids = splits[key].ravel()  # 1-based image ids
+        self._tgz = tgz
+        self._ids = ids
+        self.labels = all_labels[ids - 1].astype(np.int64)
+        # ONE long-lived TarFile per dataset: reopening a .tgz per item
+        # would re-decompress from byte 0 on every member seek (gzip has
+        # no random access) — O(archive) work per sample
+        self._tar = tarfile.open(tgz, "r:*")
+        self._members = {m.name: m for m in self._tar.getmembers()
+                         if m.name.endswith(".jpg")}
+        self._tar_lock = __import__("threading").Lock()
+
+    def _load_image(self, image_id: int) -> np.ndarray:
+        from PIL import Image
+        name = f"jpg/image_{image_id:05d}.jpg"
+        with self._tar_lock:  # TarFile seeks are not thread-safe
+            f = self._tar.extractfile(self._members[name])
+            data = f.read()
+        img = Image.open(io.BytesIO(data)).convert("RGB")
+        img = img.resize((self.image_size, self.image_size))
+        arr = np.asarray(img, np.float32) / 255.0
+        return np.transpose(arr, (2, 0, 1))
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, i):
+        if self.images is not None:  # synthetic
+            img = self.images[i]
+        else:
+            img = self._load_image(int(self._ids[i]))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[i]
+
+
+class VOC2012(Dataset):
+    """PASCAL VOC2012 detection (ref: dataset/voc2012.py — VOCdevkit
+    JPEGImages + Annotations XML; the reference yields segmentation,
+    PaddleCV's detection readers yield boxes — this serves the
+    detection family, feeding models.SSDLite directly).
+
+    Per item: (image [3, S, S] float32, gt_boxes [max_boxes, 4]
+    normalized corners 0-padded, gt_labels [max_boxes] with -1 padding;
+    class ids 1..20, 0 reserved for background). Images with MORE than
+    ``max_boxes`` objects are truncated to the first max_boxes (raise
+    the limit for crowded-scene training — VOC has images with 40+
+    boxes; the default 20 covers ~99% of trainval).
+    """
+
+    _URL = ("http://host.robots.ox.ac.uk/pascal/VOC/voc2012/"
+            "VOCtrainval_11-May-2012.tar")
+    CLASSES = ("aeroplane", "bicycle", "bird", "boat", "bottle", "bus",
+               "car", "cat", "chair", "cow", "diningtable", "dog",
+               "horse", "motorbike", "person", "pottedplant", "sheep",
+               "sofa", "train", "tvmonitor")
+
+    def __init__(self, mode: str = "train", image_size: int = 128,
+                 max_boxes: int = 20,
+                 data_home: Optional[str] = None) -> None:
+        self.image_size = image_size
+        self.max_boxes = max_boxes
+        self._cls_id = {c: i + 1 for i, c in enumerate(self.CLASSES)}
+        if mode == "synthetic":
+            rng = np.random.default_rng(37)
+            n = 16
+            self.images = rng.random((n, 3, image_size, image_size)) \
+                .astype(np.float32)
+            self.boxes = np.zeros((n, max_boxes, 4), np.float32)
+            self.labels = np.full((n, max_boxes), -1, np.int64)
+            for i in range(n):
+                k = rng.integers(1, 4)
+                c = rng.uniform(0.2, 0.8, (k, 2))
+                wh = rng.uniform(0.05, 0.15, (k, 2))
+                self.boxes[i, :k] = np.concatenate([c - wh, c + wh], 1)
+                self.labels[i, :k] = rng.integers(1, 21, (k,))
+            return
+        self.images = None
+        home = data_home or os.path.join(DATA_HOME(), "voc2012")
+        tar_path = _require(
+            os.path.join(home, "VOCtrainval_11-May-2012.tar"), self._URL)
+        self._tar_path = tar_path
+        base = "VOCdevkit/VOC2012"
+        split = {"train": "train", "val": "val",
+                 "trainval": "trainval"}[mode]
+        with tarfile.open(tar_path, "r:*") as tar:
+            names = tar.extractfile(
+                f"{base}/ImageSets/Main/{split}.txt") \
+                .read().decode().split()
+            self._names = names
+            self._members = {m.name: m for m in tar.getmembers()}
+        self._base = base
+
+    def _parse_item(self, name: str):
+        import xml.etree.ElementTree as ET
+
+        from PIL import Image
+        with tarfile.open(self._tar_path, "r:*") as tar:
+            xml_bytes = tar.extractfile(self._members[
+                f"{self._base}/Annotations/{name}.xml"]).read()
+            jpg_bytes = tar.extractfile(self._members[
+                f"{self._base}/JPEGImages/{name}.jpg"]).read()
+        root = ET.fromstring(xml_bytes)
+        w = float(root.find("size/width").text)
+        h = float(root.find("size/height").text)
+        boxes = np.zeros((self.max_boxes, 4), np.float32)
+        labels = np.full((self.max_boxes,), -1, np.int64)
+        k = 0
+        for obj in root.iter("object"):
+            if k >= self.max_boxes:
+                break
+            cls = obj.find("name").text.strip()
+            if cls not in self._cls_id:
+                continue
+            bb = obj.find("bndbox")
+            x1 = float(bb.find("xmin").text) / w
+            y1 = float(bb.find("ymin").text) / h
+            x2 = float(bb.find("xmax").text) / w
+            y2 = float(bb.find("ymax").text) / h
+            boxes[k] = (x1, y1, x2, y2)
+            labels[k] = self._cls_id[cls]
+            k += 1
+        img = Image.open(io.BytesIO(jpg_bytes)).convert("RGB") \
+            .resize((self.image_size, self.image_size))
+        arr = np.transpose(np.asarray(img, np.float32) / 255.0,
+                           (2, 0, 1))
+        return arr, boxes, labels
+
+    def __len__(self):
+        return len(self.labels) if self.images is not None \
+            else len(self._names)
+
+    def __getitem__(self, i):
+        if self.images is not None:  # synthetic
+            return self.images[i], self.boxes[i], self.labels[i]
+        return self._parse_item(self._names[i])
