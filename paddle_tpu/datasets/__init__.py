@@ -31,7 +31,7 @@ from ..data import Dataset
 
 __all__ = ["DATA_HOME", "MNIST", "FashionMNIST", "Cifar10", "Cifar100",
            "UCIHousing", "Imdb", "Imikolov", "Movielens", "WMT16",
-           "MQ2007", "Conll05", "Flowers", "VOC2012"]
+           "MQ2007", "Conll05", "Flowers", "VOC2012", "MovieReviews"]
 
 
 def DATA_HOME() -> str:
@@ -1012,3 +1012,85 @@ class VOC2012(Dataset):
         if self.images is not None:  # synthetic
             return self.images[i], self.boxes[i], self.labels[i]
         return self._parse_item(self._names[i])
+
+
+def _freq_vocab_and_pad(docs_words, freq, seq_len):
+    """Shared text contract: frequency-ranked vocab (ties
+    lexicographic), ids from 2 (0=pad, 1=OOV), dense pad/truncate to
+    seq_len. One definition so Imdb/MovieReviews cannot drift."""
+    vocab = sorted(freq, key=lambda w: (-freq[w], w))
+    word_idx = {w: i + 2 for i, w in enumerate(vocab)}
+    docs = np.zeros((len(docs_words), seq_len), np.int64)
+    for i, words in enumerate(docs_words):
+        ids = [word_idx.get(w, 1) for w in words[:seq_len]]
+        docs[i, :len(ids)] = ids
+    return word_idx, docs
+
+
+class MovieReviews(Dataset):
+    """NLTK movie_reviews sentiment corpus (ref: dataset/sentiment.py —
+    the reference shells out to nltk.download; zero-egress here: stage
+    the corpus directory (movie_reviews/{pos,neg}/*.txt) and this
+    parses it directly, same frequency-ranked vocab + (ids, 0/1 label)
+    contract, dense padded like Imdb).
+    """
+
+    _URL = ("https://www.nltk.org/nltk_data/ (movie_reviews corpus; "
+            "extract so DATA_HOME/sentiment/movie_reviews/{pos,neg} "
+            "hold the .txt files)")
+
+    def __init__(self, mode: str = "train", seq_len: int = 256,
+                 holdout: float = 0.1,
+                 data_home: Optional[str] = None) -> None:
+        self.seq_len = seq_len
+        if mode == "synthetic":
+            rng = np.random.default_rng(41)
+            n, vocab = 64, 300
+            self.word_idx = {f"w{i}": i for i in range(vocab)}
+            self.docs = rng.integers(2, vocab, (n, seq_len)) \
+                .astype(np.int64)
+            self.labels = (np.arange(n) % 2).astype(np.int64)
+            self.docs[self.labels == 1] //= 2
+            return
+        home = data_home or os.path.join(DATA_HOME(), "sentiment")
+        root = _require(os.path.join(home, "movie_reviews"), self._URL)
+        docs_words, labels = [], []
+        freq: dict = {}
+        for label, sub in ((1, "pos"), (0, "neg")):
+            subdir = os.path.join(root, sub)
+            if not os.path.isdir(subdir):
+                raise FileNotFoundError(
+                    f"expected {subdir} with .txt reviews ({self._URL})")
+            for fname in sorted(os.listdir(subdir)):
+                if not fname.endswith(".txt"):
+                    continue
+                with open(os.path.join(subdir, fname),
+                          encoding="utf-8", errors="ignore") as f:
+                    words = f.read().lower().split()
+                for w in words:
+                    freq[w] = freq.get(w, 0) + 1
+                docs_words.append(words)
+                labels.append(label)
+        self.word_idx, docs = _freq_vocab_and_pad(docs_words, freq,
+                                                  seq_len)
+        labels_np = np.asarray(labels, np.int64)
+        # deterministic STRATIFIED split: a per-class shuffled
+        # round-robin pick, so both classes appear in both splits even
+        # for tiny corpora (an iid Bernoulli draw cannot promise that)
+        take_test = np.zeros(len(docs), bool)
+        rng = np.random.default_rng(0)
+        for cls in (0, 1):
+            idx = np.flatnonzero(labels_np == cls)
+            rng.shuffle(idx)
+            n_test = max(1, int(round(len(idx) * holdout))) \
+                if len(idx) > 1 else 0
+            take_test[idx[:n_test]] = True
+        pick = take_test if mode == "test" else ~take_test
+        self.docs = docs[pick]
+        self.labels = labels_np[pick]
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, i):
+        return self.docs[i], self.labels[i]

@@ -479,3 +479,27 @@ def test_flowers_voc_synthetic():
     v = VOC2012(mode="synthetic", image_size=16, max_boxes=4)
     im, b, l = v[0]
     assert im.shape == (3, 16, 16) and b.shape == (4, 4)
+
+
+def test_movie_reviews_parses_folder_layout(tmp_path):
+    from paddle_tpu.datasets import MovieReviews
+    root = tmp_path / "movie_reviews"
+    (root / "pos").mkdir(parents=True)
+    (root / "neg").mkdir()
+    for i in range(4):
+        (root / "pos" / f"p{i}.txt").write_text(
+            "great wonderful film great")
+        (root / "neg" / f"n{i}.txt").write_text("awful boring film bad")
+    tr = MovieReviews(mode="train", seq_len=8, holdout=0.25,
+                      data_home=str(tmp_path))
+    te = MovieReviews(mode="test", seq_len=8, holdout=0.25,
+                      data_home=str(tmp_path))
+    assert len(tr) + len(te) == 8
+    # "great" (x8) and "film" (x8) tie -> lexicographic: film=2, great=3
+    assert tr.word_idx["film"] == 2 and tr.word_idx["great"] == 3
+    doc, lab = tr[0]
+    assert doc.shape == (8,)
+    assert set(np.unique(np.concatenate([tr.labels, te.labels]))) \
+        <= {0, 1}
+    with pytest.raises(FileNotFoundError):
+        MovieReviews(mode="train", data_home=str(tmp_path / "nope"))
