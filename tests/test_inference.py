@@ -251,3 +251,25 @@ def test_server_client_death_drops_reply(artifact):
         with Client(port=srv.port) as cli:
             out = cli.infer([x[:1]])[0]
             assert out.shape == (1, 3)
+
+
+def test_server_connection_churn_does_not_leak_fds(artifact):
+    """Many short-lived clients must not accumulate sockets/threads
+    (regression guard for the connection reaper in csrc/serving.cc)."""
+    import os
+    d, x, want = artifact
+    pred = create_predictor(Config(d))
+    with Server(pred, wait_ms=1) as srv:
+        def nfds():
+            return len(os.listdir("/proc/self/fd"))
+        # warm up a few connections so allocator/thread pools settle
+        for _ in range(5):
+            with Client(port=srv.port) as cli:
+                cli.infer([x[:1]])
+        base = nfds()
+        for _ in range(30):
+            with Client(port=srv.port) as cli:
+                cli.infer([x[:1]])
+        # the reaper runs on accept: fd count stays bounded (allow a
+        # small jitter for in-flight sockets in TIME_WAIT handling)
+        assert nfds() <= base + 4, (base, nfds())
