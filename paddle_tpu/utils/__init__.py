@@ -1,3 +1,5 @@
 from . import dlpack  # noqa: F401
 from . import crypto  # noqa: F401
 from . import op_bench  # noqa: F401
+
+from .install_check import run_check  # noqa: F401

@@ -205,3 +205,10 @@ def test_max_pool3d_with_index_recovers_positions():
     with pytest.raises(ValueError, match="too large"):
         max_pool3d_with_index(np.zeros((1, 1, 128, 128, 128),
                                        np.float32), 2, 2)
+
+
+def test_run_check_passes_on_virtual_mesh(capsys):
+    assert pt.utils.run_check() is True
+    out = capsys.readouterr().out
+    assert "installed and working" in out
+    assert "sharded step OK" in out  # 8 virtual devices in the suite
