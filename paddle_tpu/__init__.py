@@ -34,7 +34,7 @@ from .ops import sparse
 from .tensor import Tensor, to_tensor
 
 from . import amp, data, datasets, distribution, hapi, inference, io, \
-    jit, layers, metric, nn, optimizer
+    jit, layers, metric, nn, optimizer, reader
 from . import utils, vision  # noqa: F401
 from . import parallel
 from . import static
@@ -45,3 +45,4 @@ from . import slim  # noqa: F401
 
 # grad / no_grad utilities (dygraph parity)
 from .autograd import grad, no_grad, value_and_grad  # noqa: F401
+from .reader import batch  # noqa: F401  (paddle.batch parity)

@@ -212,3 +212,100 @@ def test_run_check_passes_on_virtual_mesh(capsys):
     out = capsys.readouterr().out
     assert "installed and working" in out
     assert "sharded step OK" in out  # 8 virtual devices in the suite
+
+
+class TestReaderDecorators:
+    """(ref: python/paddle/reader/tests/decorator_test.py patterns)."""
+
+    def _r(self, n=10):
+        def creator():
+            return iter(range(n))
+        return creator
+
+    def test_batch_and_drop_last(self):
+        out = list(pt.batch(self._r(10), 3)())
+        assert out == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+        out2 = list(pt.batch(self._r(10), 3, drop_last=True)())
+        assert out2[-1] == [6, 7, 8]
+        with pytest.raises(ValueError):
+            pt.batch(self._r(), 0)
+
+    def test_shuffle_cache_firstn_chain(self):
+        import paddle_tpu.reader as R
+        s = list(R.shuffle(self._r(20), 5)())
+        assert sorted(s) == list(range(20))
+        c = R.cache(self._r(5))
+        assert list(c()) == list(c())  # replayable
+        assert list(R.firstn(self._r(10), 3)()) == [0, 1, 2]
+        assert list(R.chain(self._r(2), self._r(2))()) == [0, 1, 0, 1]
+
+    def test_compose_and_alignment(self):
+        import paddle_tpu.reader as R
+        a = self._r(3)
+        def b():
+            return iter([(10, 20), (11, 21), (12, 22)])
+        out = list(R.compose(a, b)())
+        assert out == [(0, 10, 20), (1, 11, 21), (2, 12, 22)]
+        with pytest.raises(ValueError, match="different lengths"):
+            list(R.compose(self._r(3), self._r(4))())
+
+    def test_map_and_buffered(self):
+        import paddle_tpu.reader as R
+        out = list(R.map_readers(lambda x, y: x + y, self._r(4),
+                                 self._r(4))())
+        assert out == [0, 2, 4, 6]
+        assert list(R.buffered(self._r(50), 8)()) == list(range(50))
+
+    def test_buffered_propagates_errors(self):
+        import paddle_tpu.reader as R
+        def bad():
+            yield 1
+            raise RuntimeError("boom")
+        with pytest.raises(RuntimeError, match="boom"):
+            list(R.buffered(lambda: bad(), 4)())
+
+    def test_xmap_ordered_and_unordered(self):
+        import paddle_tpu.reader as R
+        mapped = R.xmap_readers(lambda x: x * 2, self._r(30), 4, 8,
+                                order=True)
+        assert list(mapped()) == [x * 2 for x in range(30)]
+        un = R.xmap_readers(lambda x: x * 2, self._r(30), 4, 8)
+        assert sorted(un()) == [x * 2 for x in range(30)]
+
+    def test_xmap_propagates_mapper_error(self):
+        import paddle_tpu.reader as R
+        def m(x):
+            if x == 5:
+                raise ValueError("bad sample")
+            return x
+        with pytest.raises(ValueError, match="bad sample"):
+            list(R.xmap_readers(m, self._r(10), 2, 4, order=True)())
+
+
+def test_reader_abandonment_releases_producers():
+    """Breaking out of buffered()/xmap() iteration must unblock the
+    background threads (regression: producers deadlocked on a full
+    queue forever)."""
+    import threading
+    import time as _t
+    import paddle_tpu.reader as R
+    before = threading.active_count()
+    for _ in range(5):
+        it = R.buffered(lambda: iter(range(10000)), 4)()
+        next(it), next(it)
+        it.close()  # abandon
+        it2 = R.xmap_readers(lambda x: x, lambda: iter(range(10000)),
+                             2, 4)()
+        next(it2)
+        it2.close()
+    _t.sleep(0.6)  # producers notice stop within their 0.1s poll
+    assert threading.active_count() <= before + 2, \
+        (before, threading.active_count())
+
+
+def test_compose_detects_one_longer_earlier_reader():
+    """zip()'s extra-consume hid the (longer, shorter) case."""
+    import paddle_tpu.reader as R
+    with pytest.raises(ValueError, match="different lengths"):
+        list(R.compose(lambda: iter(range(4)),
+                       lambda: iter(range(3)))())
