@@ -11,7 +11,7 @@ tensor ops at the root, ``nn`` layers, ``optimizer``, ``static``
 (Program/Executor), ``distributed``/``fleet``, ``amp``, ``io``, ``metric``.
 """
 
-from . import errors, flags
+from . import errors, flags, sysconfig, version
 from .flags import get_flags, set_flags
 from .version import __version__
 

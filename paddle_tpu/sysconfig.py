@@ -1,0 +1,29 @@
+"""Build-integration paths (ref: /root/reference/python/paddle/
+sysconfig.py get_include/get_lib — where extension authors find the
+native headers and shared library).
+
+Here the native surface is the C API in csrc/ptnative.h and the
+auto-built libptnative.so in the native package; extensions link
+against those the same way reference extensions link
+libpaddle_framework.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include() -> str:
+    """Directory containing ptnative.h (the native C API)."""
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(os.path.dirname(pkg), "csrc")
+
+
+def get_lib() -> str:
+    """Directory containing libptnative.so (built on first use)."""
+    from . import native
+    native.build()
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "native")

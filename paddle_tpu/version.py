@@ -1,2 +1,10 @@
-__version__ = "0.1.0"
+"""Version info (ref: python/paddle/version.py fields)."""
+
+__version__ = "0.2.0"
 full_version = __version__
+major, minor, patch = (int(x) for x in __version__.split("."))
+rc = 0
+
+
+def show() -> None:
+    print(f"paddle_tpu {full_version}")

@@ -309,3 +309,13 @@ def test_compose_detects_one_longer_earlier_reader():
     with pytest.raises(ValueError, match="different lengths"):
         list(R.compose(lambda: iter(range(4)),
                        lambda: iter(range(3)))())
+
+
+def test_sysconfig_and_version():
+    import os
+    inc = pt.sysconfig.get_include()
+    assert os.path.exists(os.path.join(inc, "ptnative.h"))
+    lib = pt.sysconfig.get_lib()
+    assert os.path.exists(os.path.join(lib, "libptnative.so"))
+    assert pt.version.full_version == pt.__version__
+    assert isinstance(pt.version.major, int)
