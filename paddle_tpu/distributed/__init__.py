@@ -6,3 +6,4 @@ from ..parallel import (all_gather, all_reduce, barrier, broadcast,
 from ..parallel.env import ParallelEnv
 from . import fleet
 from . import ps
+from .launch import spawn  # noqa: F401

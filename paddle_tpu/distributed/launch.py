@@ -25,7 +25,7 @@ import time
 from typing import Dict, List, Optional, Sequence
 
 __all__ = ["launch_procs", "launch_elastic", "terminate_local_procs",
-           "get_cluster_env"]
+           "get_cluster_env", "spawn"]
 
 
 def get_cluster_env(rank: int, world: int, cp_endpoint: str) \
@@ -144,6 +144,75 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return launch_elastic(cmd, args.nproc,
                               max_restarts=args.elastic)
     return launch_procs(cmd, args.nproc)
+
+
+def spawn(func, args=(), nprocs: int = 1, join: bool = True,
+          timeout: Optional[float] = None):
+    """Programmatic multi-process launcher
+    (ref: python/paddle/distributed/spawn.py paddle.distributed.spawn —
+    run ``func(*args)`` in ``nprocs`` processes with the cluster env
+    set, the API equivalent of the ``launch`` CLI).
+
+    ``func`` must be a module-level callable (pickled to workers). Each
+    worker gets PT_TRAINER_ID/PT_TRAINERS_NUM/PT_CP_ENDPOINT exactly as
+    the CLI would set them; call ``init_parallel_env()`` inside ``func``
+    to join the job. With ``join`` (default) blocks until every worker
+    exits — ``timeout`` bounds the TOTAL wall-clock — returns exit
+    codes, terminating the gang and raising if any worker fails (a
+    crashed rank must never deadlock the rest at a barrier). With
+    ``join=False`` returns (processes, control_plane_server); the
+    caller owns both.
+    """
+    import multiprocessing as mp
+
+    from ..native import ControlPlaneServer
+
+    ctx = mp.get_context("spawn")  # never fork a process holding jax
+    server = None
+    procs = []
+    try:
+        server = ControlPlaneServer()
+        endpoint = f"127.0.0.1:{server.port}"
+        for rank in range(nprocs):
+            env = get_cluster_env(rank, nprocs, endpoint)
+            p = ctx.Process(target=_spawn_entry,
+                            args=(func, args, env), daemon=False)
+            p.start()
+            procs.append(p)
+        if not join:
+            out_procs, out_server = procs, server
+            procs, server = [], None  # ownership transferred
+            return out_procs, out_server
+        # failure watch (launch_procs' poll-loop invariant): any dead
+        # worker with a nonzero code tears the gang down immediately
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            codes = [p.exitcode for p in procs]
+            if any(c not in (None, 0) for c in codes):
+                bad = [(i, c) for i, c in enumerate(codes)
+                       if c not in (None, 0)]
+                raise RuntimeError(
+                    f"spawn: workers failed (rank, code): {bad}")
+            if all(c == 0 for c in codes):
+                return codes
+            if deadline is not None and time.time() > deadline:
+                raise TimeoutError(
+                    f"spawn: workers still running after {timeout}s")
+            time.sleep(0.1)
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        if server is not None:
+            server.stop()
+
+
+def _spawn_entry(func, args, env) -> None:
+    """Worker bootstrap: install the cluster env BEFORE anything reads
+    it (module-level so the spawn context can pickle it)."""
+    import os as _os
+    _os.environ.update(env)
+    func(*args)
 
 
 if __name__ == "__main__":

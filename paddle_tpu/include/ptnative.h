@@ -1,0 +1,189 @@
+// C API of the native runtime for the TPU framework.
+//
+// Three native subsystems, mirroring the reference's native components
+// (cited from /root/reference):
+//  - control plane (control_plane.cc): TCP key-value rendezvous + barrier +
+//    atomic counters. Replaces the reference's bootstrap/coordination
+//    machinery: ncclUniqueId exchange over RPC
+//    (paddle/fluid/operators/collective/c_gen_nccl_id_op.cc:49),
+//    Gloo barriers (paddle/fluid/framework/fleet/gloo_wrapper.h:146) and the
+//    gRPC PS control path (paddle/fluid/operators/distributed/grpc/).
+//  - data feed (data_feed.cc): threaded slot-record parser + bounded batch
+//    channel + in-memory shuffle. Replaces MultiSlotDataFeed /
+//    InMemoryDataFeed (paddle/fluid/framework/data_feed.h:255,650) and the
+//    DatasetImpl load/shuffle path (paddle/fluid/framework/data_set.h:43).
+//  - monitor (monitor.cc): named atomic int64 stat registry. Replaces
+//    paddle/fluid/platform/monitor.h:33 (STAT_ADD etc.).
+//
+// The binding layer is plain C + ctypes (no pybind11 in the image), the
+// moral equivalent of the reference's paddle/fluid/pybind/pybind.cc surface.
+#ifndef PTNATIVE_H_
+#define PTNATIVE_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+// ---------------- control plane ----------------
+// Server. port==0 picks an ephemeral port. Returns handle >0, or -1.
+int64_t pt_cp_server_start(int port);
+int pt_cp_server_port(int64_t handle);
+void pt_cp_server_stop(int64_t handle);
+
+// Client. Retries connect until timeout_ms elapses. Returns handle >0 or -1.
+int64_t pt_cp_client_connect(const char* host, int port, int timeout_ms);
+void pt_cp_client_close(int64_t handle);
+
+// KV: set stores bytes; get copies value into buf (cap bytes) and returns the
+// value length, -1 on timeout/error, -2 if cap too small (length returned via
+// *need). block!=0 waits for the key to appear.
+int pt_cp_set(int64_t h, const char* key, const uint8_t* val, int64_t len);
+int64_t pt_cp_get(int64_t h, const char* key, uint8_t* buf, int64_t cap,
+                  int block, int timeout_ms);
+// Atomic fetch-add on an int64 cell (created at 0). Returns the new value.
+int64_t pt_cp_add(int64_t h, const char* key, int64_t delta);
+// Barrier across `world` participants identified by name. 0 ok, -1 timeout.
+int pt_cp_barrier(int64_t h, const char* name, int world, int timeout_ms);
+
+// ---------------- data feed ----------------
+// slots_desc: semicolon-separated "name:dense:<dim>" | "name:sparse:<max_len>"
+// Returns handle >0 or -1.
+int64_t pt_df_create(const char* slots_desc, int batch_size, int num_threads,
+                     int queue_capacity);
+void pt_df_destroy(int64_t h);
+int pt_df_set_files(int64_t h, const char* files_semicolon);
+// Streaming mode: parser threads read files and emit batches as they go.
+int pt_df_start(int64_t h);
+// In-memory mode (reference: InMemoryDataFeed::LoadIntoMemory
+// data_feed.h:650, DatasetImpl::LocalShuffle data_set.h:157).
+int64_t pt_df_load_into_memory(int64_t h);  // returns #records or -1
+void pt_df_local_shuffle(int64_t h, uint64_t seed);
+int pt_df_start_from_memory(int64_t h);
+// Exchange a contiguous range of in-memory records for global shuffle:
+// serialize records [begin,end) into buf; parse buf back in (append).
+int64_t pt_df_serialize_range(int64_t h, int64_t begin, int64_t end,
+                              uint8_t* buf, int64_t cap);
+int64_t pt_df_deserialize_append(int64_t h, const uint8_t* buf, int64_t len);
+int64_t pt_df_memory_size(int64_t h);
+void pt_df_clear_memory(int64_t h);
+
+// Fetch next batch. For slot i (declaration order):
+//  dense slot  -> dense_bufs[i] points at float[batch*dim]
+//  sparse slot -> sparse_bufs[i] points at int64[batch*max_len] (0-padded)
+//                 and len_bufs[i] at int64[batch]
+// Unused entries may be null. Returns actual batch rows (may be < batch at
+// epoch end), 0 when the epoch is exhausted, -1 on error.
+int pt_df_next(int64_t h, float** dense_bufs, int64_t** sparse_bufs,
+               int64_t** len_bufs);
+
+// ---------------- parameter server ----------------
+// In-process PS service over TCP (replaces the reference's
+// listen_and_serv gRPC server, paddle/fluid/operators/distributed_ops/
+// listen_and_serv_op.cc:352, and the large_scale_kv sparse table,
+// operators/distributed/large_scale_kv.h). Dense tables apply the
+// configured optimizer server-side on push (the reference runs per-grad
+// optimize sub-blocks on the pserver); sparse tables hold
+// lazily-initialized embedding rows keyed by int64 id.
+//
+// Optimizer codes: 0=sgd 1=adagrad 2=adam 3=sum (geo delta merge).
+// Sync semantics: sync_world>0 means a dense push ACCUMULATES and the
+// optimizer applies once sync_world pushes arrive (one "step"); the
+// table version then increments. pull(min_version) blocks until the
+// table version reaches min_version (0 = don't wait). sync_world==0 is
+// fully async: every push applies immediately (hogwild, like the
+// reference's async RunAsyncLoop listen_and_serv_op.cc:244).
+
+int64_t pt_ps_server_start(int port);
+int pt_ps_server_port(int64_t h);
+void pt_ps_server_stop(int64_t h);
+
+int64_t pt_ps_connect(const char* host, int port, int timeout_ms);
+void pt_ps_disconnect(int64_t h);
+
+// Create-or-get a dense table of n floats. init may be null (zeros).
+// hyper: [lr, beta1/rho, beta2, eps] (unused trailing entries ignored).
+int pt_ps_dense_init(int64_t h, const char* name, int64_t n,
+                     const float* init, int opt, const float* hyper,
+                     int sync_world);
+// Pull values. Blocks until version >= min_version (timeout_ms). Returns
+// current version (>=0) or -1 timeout / -4 transport error.
+int64_t pt_ps_dense_pull(int64_t h, const char* name, float* buf, int64_t n,
+                         int64_t min_version, int timeout_ms);
+// Push a gradient (or delta for opt=sum). Returns table version after the
+// push is recorded (>=0), -4 transport error.
+int64_t pt_ps_dense_push(int64_t h, const char* name, const float* grad,
+                         int64_t n);
+
+// Sparse table of `dim`-wide rows. Rows initialize uniform(-scale, scale)
+// deterministically per id (scale=0 -> zeros).
+int pt_ps_sparse_init(int64_t h, const char* name, int dim, int opt,
+                      const float* hyper, float init_scale);
+// Pull rows for ids[0..n): writes n*dim floats (dim sizes the wire read).
+int pt_ps_sparse_pull(int64_t h, const char* name, const int64_t* ids,
+                      int64_t n, int dim, float* buf);
+// Push per-row grads (n*dim floats); applies optimizer per row.
+int pt_ps_sparse_push(int64_t h, const char* name, const int64_t* ids,
+                      int64_t n, int dim, const float* grad);
+// Number of materialized rows (for tests/metrics).
+int64_t pt_ps_sparse_size(int64_t h, const char* name);
+
+// Persist / restore all tables (binary file). 0 ok, -1 error.
+int pt_ps_save(int64_t h, const char* path);
+int pt_ps_load(int64_t h, const char* path);
+// Worker liveness (ref: heart_beat_monitor.cc). heartbeat records a
+// beat for `worker`; liveness returns ms since its last beat, or -1 if
+// it never beat (-4 transport error).
+int64_t pt_ps_heartbeat(int64_t h, const char* worker);
+int64_t pt_ps_liveness(int64_t h, const char* worker);
+
+// ---------------- text tokenizer ----------------
+// Threaded vocab building + whitespace-token encoding (tokenizer.cc;
+// the text analogue of the native data feed — reference fluid/string
+// utilities back its C++ readers). Ids are frequency-ranked with
+// lexicographic tie-break, matching the Python dataset builders.
+int64_t pt_tok_build(const char* files_semicolon, int64_t min_freq,
+                     int num_threads);
+void pt_tok_destroy(int64_t h);
+int64_t pt_tok_vocab_size(int64_t h);
+int64_t pt_tok_lookup(int64_t h, const char* word);  // -1 unknown
+int64_t pt_tok_word(int64_t h, int64_t id, char* buf, int64_t cap);
+// Per-id corpus counts (build-time only; empty for loaded vocabs).
+int64_t pt_tok_freqs(int64_t h, int64_t* out, int64_t cap);
+// Returns token count (may exceed cap; only cap entries written).
+int64_t pt_tok_encode(int64_t h, const char* text, int64_t* out,
+                      int64_t cap, int64_t unk_id);
+int64_t pt_tok_encode_file(int64_t h, const char* path, int64_t* out,
+                           int64_t cap, int64_t unk_id);
+int pt_tok_save(int64_t h, const char* path);
+int64_t pt_tok_load(const char* path);
+
+// ---------------- inference serving transport ----------------
+// Native TCP front for the serving engine (serving.cc): framed
+// request/reply with pipelining, bounded queue with backpressure. The
+// payload is an opaque tensor codec owned by paddle_tpu/inference.
+int64_t pt_srv_start(int port, int queue_cap);
+int pt_srv_port(int64_t h);
+void pt_srv_stop(int64_t h);
+// Dequeue one request into buf: returns payload length, -1 timeout, -2
+// cap too small (request stays queued), 0 if stopping and drained.
+int64_t pt_srv_next(int64_t h, int timeout_ms, uint64_t* req_id,
+                    uint8_t* buf, int64_t cap);
+// Reply to a dequeued request. 0 ok, -1 unknown id, -3 client gone.
+int pt_srv_reply(int64_t h, uint64_t req_id, int64_t status,
+                 const uint8_t* data, int64_t len);
+int64_t pt_srv_pending(int64_t h);
+
+// ---------------- monitor ----------------
+void pt_mon_add(const char* name, int64_t v);
+int64_t pt_mon_get(const char* name);
+void pt_mon_reset(const char* name);
+// Write "name=value\n" lines; returns bytes written (or needed if cap==0).
+int64_t pt_mon_dump(char* buf, int64_t cap);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  // PTNATIVE_H_

@@ -66,6 +66,14 @@ def build(force: bool = False) -> str:
         if proc.returncode != 0:
             raise RuntimeError(
                 f"native build failed ({' '.join(cmd)}):\n{proc.stderr}")
+        # stage the public header next to the built .so so installed
+        # trees (no csrc/) still serve sysconfig.get_include()
+        import shutil
+        inc_dir = os.path.join(_PKG_DIR, os.pardir, "include")
+        inc_dir = os.path.abspath(inc_dir)
+        os.makedirs(inc_dir, exist_ok=True)
+        shutil.copy2(os.path.join(_CSRC, "ptnative.h"),
+                     os.path.join(inc_dir, "ptnative.h"))
     return _SO_PATH
 
 

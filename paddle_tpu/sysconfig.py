@@ -16,9 +16,20 @@ __all__ = ["get_include", "get_lib"]
 
 
 def get_include() -> str:
-    """Directory containing ptnative.h (the native C API)."""
-    pkg = os.path.dirname(os.path.abspath(__file__))
-    return os.path.join(os.path.dirname(pkg), "csrc")
+    """Directory containing ptnative.h: the source checkout's csrc/ when
+    present, else the header copy the native build stages inside the
+    package (installed wheels ship no csrc/ — same split native
+    _needs_build handles for the .so)."""
+    from .native import _CSRC
+    if os.path.isdir(_CSRC):
+        return _CSRC
+    pkg_inc = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "include")
+    if os.path.isdir(pkg_inc):
+        return pkg_inc
+    raise FileNotFoundError(
+        "no native headers found (csrc/ missing and no packaged "
+        "include/); reinstall with sources or run native.build()")
 
 
 def get_lib() -> str:
