@@ -630,3 +630,37 @@ def test_stacked_rnn_carries_initial_states(rng):
     for a, b in zip(fin_full, fin_seg):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-5)
+
+
+def test_layers_misc_utilities(rng):
+    # image_resize_short scales the short side
+    img = rng.normal(0, 1, (1, 3, 20, 40)).astype(np.float32)
+    out = L.image_resize_short(img, 10)
+    assert out.shape == (1, 3, 10, 20)
+    # create_parameter / create_global_var / create_tensor
+    p = L.create_parameter([4, 3], "float32")
+    assert p.shape == (4, 3)
+    b = L.create_parameter([3], "float32", is_bias=True)
+    assert np.allclose(np.asarray(b.value), 0.0)
+    g = L.create_global_var([2, 2], 7.0, "float32")
+    assert float(np.asarray(g)[0, 0]) == 7.0
+    assert L.create_tensor("float32").shape == ()
+    # autoincreased_step_counter
+    ctr = L.autoincreased_step_counter(begin=5, step=2)
+    assert (ctr(), ctr(), ctr()) == (5, 7, 9)
+
+
+def test_layers_py_reader_epoch_protocol():
+    r = L.py_reader(capacity=4, shapes=[[2]], dtypes=["float32"])
+    with pytest.raises(ValueError, match="decorate"):
+        r.start()
+    r.decorate_paddle_reader(lambda: iter([1, 2, 3]))
+    with pytest.raises(ValueError, match="start"):
+        iter(r)
+    r.start()
+    assert list(r) == [1, 2, 3]
+    r.reset()
+    with pytest.raises(ValueError, match="start"):
+        iter(r)
+    r.start()  # epoch 2 re-arms
+    assert list(r) == [1, 2, 3]
