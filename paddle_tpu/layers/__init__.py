@@ -555,18 +555,23 @@ def __getattr__(name):
 # ----------------------------------------------------- remaining fills
 def argmax(x, axis: int = 0):
     """(ref: fluid/layers/tensor.py:881 — fluid defaults to axis=0,
-    unlike the root namespace's axis=-1)."""
-    return jnp.argmax(x, axis=axis).astype(jnp.int64)
+    unlike the root namespace's axis=-1). Index dtype follows the JAX
+    default (int32 unless x64 is enabled; the reference emits int64)."""
+    return jnp.argmax(x, axis=axis)
 
 
 def argmin(x, axis: int = 0):
     """(ref: fluid/layers/tensor.py:920 — fluid defaults to axis=0)."""
-    return jnp.argmin(x, axis=axis).astype(jnp.int64)
+    return jnp.argmin(x, axis=axis)
 
 
 def expand(x, expand_times: Sequence[int], name=None):
     """(ref: fluid/layers/nn.py:10142 expand) — TILES each dim by
     ``expand_times`` (paddle 2.x ``expand`` broadcasts instead)."""
+    if len(expand_times) != x.ndim:
+        raise ValueError(
+            f"expand: expand_times has {len(expand_times)} entries for "
+            f"rank-{x.ndim} input (fluid requires one per dim)")
     return jnp.tile(x, tuple(int(t) for t in expand_times))
 
 
@@ -625,6 +630,63 @@ def sum(x):
             out = out + t
         return out
     return jnp.asarray(x)
+
+
+def cross_entropy(input, label, soft_label: bool = False,
+                  ignore_index: int = -100):
+    """(ref: fluid/layers/loss.py:206 cross_entropy) — fluid's op takes
+    PROBABILITY inputs (no softmax applied) and returns PER-SAMPLE
+    losses shaped like the label (the root/nn.functional cross_entropy
+    is the 2.x logits+mean-reduction op; do not confuse the two when
+    migrating). ``ignore_index`` zeroes those samples (hard labels)."""
+    logp = jnp.log(jnp.clip(input, 1e-20))
+    if soft_label:
+        return -(label * logp).sum(-1, keepdims=True)
+    lab = jnp.asarray(label)
+    squeeze_back = lab.ndim == input.ndim  # fluid's [N, 1] hard labels
+    if squeeze_back:
+        lab = jnp.squeeze(lab, -1)
+    safe = jnp.where(lab == ignore_index, 0, lab).astype(jnp.int32)
+    picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)
+    out = jnp.where((lab != ignore_index)[..., None], -picked, 0.0)
+    return out  # label-shaped: trailing singleton kept, fluid-style
+
+
+def dropout(x, dropout_prob: float, is_test: bool = False, seed=None,
+            name=None, dropout_implementation: str = "downgrade_in_infer"):
+    """(ref: fluid/layers/nn.py:1364 dropout) — fluid's default
+    implementation is ``downgrade_in_infer`` (train: mask only, no
+    1/(1-p) upscale; infer: scale by (1-p)); 2.x/nn.functional defaults
+    to ``upscale_in_train``. Both spellings accepted here."""
+    mode = {"downgrade_in_infer": "downscale_in_infer",
+            "downscale_in_infer": "downscale_in_infer",
+            "upscale_in_train": "upscale_in_train"}.get(
+        dropout_implementation)
+    if mode is None:
+        raise ValueError(
+            f"dropout: unknown dropout_implementation "
+            f"{dropout_implementation!r} (expected 'downgrade_in_infer' "
+            f"or 'upscale_in_train')")
+    return _F.dropout(x, dropout_prob, training=not is_test, mode=mode)
+
+
+def embedding(input, size, is_sparse: bool = False,
+              is_distributed: bool = False, padding_idx=None,
+              param_attr=None, dtype="float32", weight=None):
+    """(ref: fluid/layers/nn.py:380 embedding) — fluid's layer creates
+    its own table via LayerHelper; the functional world has no
+    parameter registry, so pass the table as ``weight`` explicitly (or
+    use nn.Embedding for a parameter-owning layer, same as layers.fc)."""
+    if weight is None:
+        raise ValueError(
+            "layers.embedding in the functional API needs an explicit "
+            "weight table (shape `size`); use nn.Embedding for a "
+            "parameter-owning layer")
+    if tuple(weight.shape) != tuple(size):
+        raise ValueError(
+            f"layers.embedding: weight shape {tuple(weight.shape)} != "
+            f"size {tuple(size)}")
+    return _F.embedding(input, weight, padding_idx=padding_idx)
 
 
 def pad(x, paddings: Sequence[int], pad_value: float = 0.0, name=None):

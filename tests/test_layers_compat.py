@@ -864,3 +864,32 @@ def test_fluid_semantics_divergent_names():
     assert float(np.asarray(p)[2, 0]) == 7.0
     with pytest.raises(ValueError, match="padding entries"):
         L.pad(np.zeros((2, 2), np.float32), [1, 1])
+    # expand validates rank like fluid (no silent dim prepend)
+    with pytest.raises(ValueError, match="one per dim"):
+        L.expand(np.ones((2, 3), np.float32), [4, 2, 3])
+    # cross_entropy: PROBABILITY inputs, per-sample [N,1] output
+    probs = np.asarray([[0.5, 0.25, 0.25], [0.1, 0.8, 0.1]], np.float32)
+    lab = np.asarray([[0], [1]], np.int64)
+    ce = np.asarray(L.cross_entropy(probs, lab))
+    assert ce.shape == (2, 1)
+    np.testing.assert_allclose(ce[:, 0], -np.log([0.5, 0.8]), rtol=1e-6)
+    soft = np.asarray(L.cross_entropy(probs, probs, soft_label=True))
+    assert soft.shape == (2, 1)
+    ig = np.asarray(L.cross_entropy(probs, np.asarray([[0], [-100]]),
+                                    ignore_index=-100))
+    assert float(ig[1, 0]) == 0.0
+    # dropout: fluid default downgrade_in_infer — infer scales by (1-p)
+    xs = np.ones((4, 4), np.float32)
+    np.testing.assert_allclose(
+        np.asarray(L.dropout(xs, 0.25, is_test=True)), 0.75)
+    tr = np.asarray(L.dropout(xs, 0.5))          # train: mask, NO upscale
+    assert set(np.unique(tr)) <= {0.0, 1.0}
+    with pytest.raises(ValueError, match="dropout_implementation"):
+        L.dropout(xs, 0.5, dropout_implementation="bogus")
+    # embedding: explicit table (fluid's LayerHelper creates one; the
+    # functional shim requires it like layers.fc)
+    table = np.arange(12, dtype=np.float32).reshape(4, 3)
+    emb = np.asarray(L.embedding(np.asarray([1, 3]), [4, 3], weight=table))
+    np.testing.assert_allclose(emb, table[[1, 3]])
+    with pytest.raises(ValueError, match="nn.Embedding"):
+        L.embedding(np.asarray([0]), [4, 3])
