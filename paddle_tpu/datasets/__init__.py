@@ -30,8 +30,9 @@ import numpy as np
 from ..data import Dataset
 
 __all__ = ["DATA_HOME", "MNIST", "FashionMNIST", "Cifar10", "Cifar100",
-           "UCIHousing", "Imdb", "Imikolov", "Movielens", "WMT16",
-           "MQ2007", "Conll05", "Flowers", "VOC2012", "MovieReviews"]
+           "UCIHousing", "Imdb", "Imikolov", "Movielens", "WMT14",
+           "WMT16", "MQ2007", "Conll05", "Flowers", "VOC2012",
+           "MovieReviews"]
 
 
 def DATA_HOME() -> str:
@@ -401,7 +402,12 @@ class Imikolov(Dataset):
             freq = {w: c for w, c in freq.items() if c > min_word_freq
                     and w != "<unk>"}
         words = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
-        # ids: 0.. for words, then <s>, <e>, <unk> (ref ordering)
+        # ids: 0.. for frequency-sorted corpus words, then <s>/<e>/<unk>
+        # appended. NOTE: internally consistent but NOT identical to the
+        # reference's build_dict ids (imikolov.py counts <s>/<e> once
+        # per line so they land frequency-ranked, and builds over
+        # train+valid); re-encode rather than mixing with
+        # reference-derived id artifacts.
         self.word_idx = {w: i for i, (w, _) in enumerate(words)}
         self.word_idx["<s>"] = len(self.word_idx)
         self.word_idx["<e>"] = len(self.word_idx)
@@ -626,6 +632,110 @@ class WMT16(Dataset):
                 text = tar.extractfile(member).read().decode("utf-8")
             self._line_cache[member] = text.splitlines()
         return self._line_cache[member]
+
+    def __len__(self):
+        return len(self.src)
+
+    def __getitem__(self, i):
+        return (self.src[i], self.trg[i], self.trg_next[i],
+                self.src_len[i], self.trg_len[i])
+
+
+class WMT14(Dataset):
+    """WMT14 EN-FR shrunk set (ref: dataset/wmt14.py:117 — the archive
+    ships PRE-BUILT ``src.dict``/``trg.dict`` members whose word id is
+    the line number (cut to ``dict_size``), plus tab-separated
+    "src<TAB>trg" data members; unlike wmt16 no dict is built from the
+    corpus). Reference semantics kept: <s>/<e>/<unk> at ids 0/1/2
+    (UNK_IDX=2), sequences longer than 80 tokens are dropped,
+    src = <s> + words + <e>, and the teacher-forcing pair is
+    trg = <s> + words / trg_next = words + <e>.
+
+    Dense padded redesign like WMT16: rows pad to ``seq_len`` with <e>
+    and per-row lengths ride along so losses can mask.
+    """
+
+    _URL = "http://paddlemodels.bj.bcebos.com/wmt/wmt14.tgz"
+    START, END, UNK = 0, 1, 2
+    _MAX_LEN = 80  # ref wmt14.py: "remove sequence whose length > 80"
+
+    def __init__(self, mode: str = "train", dict_size: int = 30000,
+                 seq_len: int = 50,
+                 data_home: Optional[str] = None) -> None:
+        self.seq_len = seq_len
+        if mode == "synthetic":
+            rng = np.random.default_rng(29)
+            n, v = 128, 200
+            self.src_dict = {f"w{i}": i for i in range(v)}
+            self.trg_dict = dict(self.src_dict)
+            self.src = rng.integers(3, v, (n, seq_len)).astype(np.int64)
+            self.trg = np.roll(self.src, 1, axis=1)
+            self.trg[:, 0] = self.START
+            self.trg_next = self.src.copy()
+            self.src_len = np.full((n,), seq_len, np.int64)
+            self.trg_len = np.full((n,), seq_len, np.int64)
+            return
+        home = data_home or os.path.join(DATA_HOME(), "wmt14")
+        path = _require(os.path.join(home, "wmt14.tgz"), self._URL)
+        member_suffix = {"train": "train/train", "test": "test/test",
+                         "gen": "gen/gen"}[mode]
+
+        def to_dict(lines):
+            # ref __read_to_dict: id = line number, cut to dict_size
+            return {ln.strip(): i for i, ln in enumerate(lines)
+                    if i < dict_size}
+
+        with tarfile.open(path, "r:*") as tar:
+            names = tar.getnames()
+
+            def one(suffix):
+                hits = [n for n in names if n.endswith(suffix)]
+                if len(hits) != 1:
+                    raise ValueError(
+                        f"wmt14 archive: expected exactly one member "
+                        f"ending in {suffix!r}, found {hits}")
+                return tar.extractfile(hits[0]).read().decode(
+                    "utf-8").splitlines()
+
+            self.src_dict = to_dict(one("src.dict"))
+            self.trg_dict = to_dict(one("trg.dict"))
+            data_lines = one(member_suffix)
+
+        def pad(ids):
+            row = np.full((seq_len,), self.END, np.int64)
+            n_ids = min(len(ids), seq_len)
+            row[:n_ids] = ids[:seq_len]
+            return row, n_ids
+
+        src_rows, trg_rows, trg_next_rows = [], [], []
+        src_lens, trg_lens = [], []
+        for raw in data_lines:
+            parts = raw.strip().split("\t")
+            if len(parts) != 2:
+                continue
+            src_ids = [self.src_dict.get(w, self.UNK)
+                       for w in ["<s>"] + parts[0].split() + ["<e>"]]
+            t_words = [self.trg_dict.get(w, self.UNK)
+                       for w in parts[1].split()]
+            if len(src_ids) > self._MAX_LEN or len(t_words) > self._MAX_LEN:
+                continue
+            trg_ids = [self.START] + t_words
+            trg_next = t_words + [self.END]
+            s_row, s_len = pad(src_ids)
+            t_row, t_len = pad(trg_ids)
+            tn_row, _ = pad(trg_next)
+            src_rows.append(s_row)
+            trg_rows.append(t_row)
+            trg_next_rows.append(tn_row)
+            src_lens.append(s_len)
+            trg_lens.append(t_len)
+        if not src_rows:
+            raise ValueError(f"wmt14 {mode}: no parseable pairs")
+        self.src = np.stack(src_rows)
+        self.trg = np.stack(trg_rows)
+        self.trg_next = np.stack(trg_next_rows)
+        self.src_len = np.asarray(src_lens, np.int64)
+        self.trg_len = np.asarray(trg_lens, np.int64)
 
     def __len__(self):
         return len(self.src)
@@ -882,15 +992,39 @@ class Flowers(Dataset):
         self.labels = all_labels[ids - 1].astype(np.int64)
         # ONE long-lived TarFile per dataset: reopening a .tgz per item
         # would re-decompress from byte 0 on every member seek (gzip has
-        # no random access) — O(archive) work per sample
-        self._tar = tarfile.open(tgz, "r:*")
-        self._members = {m.name: m for m in self._tar.getmembers()
-                         if m.name.endswith(".jpg")}
-        self._tar_lock = __import__("threading").Lock()
+        # no random access) — O(archive) work per sample. Opened LAZILY
+        # per process (not here) so the dataset pickles cleanly into
+        # multiprocess DataLoader workers; each process gets its own
+        # handle on first access.
+        self._tar = None
+        self._members = None
+        self._tar_lock = None
+
+    def __getstate__(self):
+        # drop the per-process tar handle/lock; workers reopen lazily
+        state = self.__dict__.copy()
+        state["_tar"] = state["_members"] = state["_tar_lock"] = None
+        return state
+
+    _TAR_INIT_LOCK = __import__("threading").Lock()
+
+    def _ensure_tar(self):
+        if self._tar is not None and self._tar_lock is not None:
+            return
+        with Flowers._TAR_INIT_LOCK:  # two threads racing first access
+            if self._tar_lock is None:
+                self._tar_lock = __import__("threading").Lock()
+            if self._tar is None:
+                self._members = None
+                tar = tarfile.open(self._tgz, "r:*")
+                self._members = {m.name: m for m in tar.getmembers()
+                                 if m.name.endswith(".jpg")}
+                self._tar = tar
 
     def _load_image(self, image_id: int) -> np.ndarray:
         from PIL import Image
         name = f"jpg/image_{image_id:05d}.jpg"
+        self._ensure_tar()
         with self._tar_lock:  # TarFile seeks are not thread-safe
             f = self._tar.extractfile(self._members[name])
             data = f.read()

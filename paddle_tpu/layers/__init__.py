@@ -667,7 +667,11 @@ def dropout(x, dropout_prob: float, is_test: bool = False, seed=None,
             f"dropout: unknown dropout_implementation "
             f"{dropout_implementation!r} (expected 'downgrade_in_infer' "
             f"or 'upscale_in_train')")
-    return _F.dropout(x, dropout_prob, training=not is_test, mode=mode)
+    # fluid's fixed seed => deterministic mask (reproducible runs);
+    # None => fresh key from the global stream each call
+    key = jax.random.key(seed) if seed is not None else None
+    return _F.dropout(x, dropout_prob, training=not is_test, mode=mode,
+                      key=key)
 
 
 def embedding(input, size, is_sparse: bool = False,

@@ -708,10 +708,22 @@ class ServingTransport:
             return None
         return rid.value, ctypes.string_at(self._buf, n)
 
-    def reply(self, req_id: int, payload: bytes, status: int = 0) -> None:
+    def reply(self, req_id: int, payload: bytes, status: int = 0) -> int:
+        """Send a reply. Returns the native rc (0 ok, -1 unknown id,
+        -3 client gone) and counts nonzero outcomes in the stat
+        registry — dropped replies used to be diagnosable only as
+        client-side timeouts."""
         buf = (ctypes.c_uint8 * max(1, len(payload))).from_buffer_copy(
             payload or b"\0")
-        _load().pt_srv_reply(self._h, req_id, status, buf, len(payload))
+        rc = _load().pt_srv_reply(self._h, req_id, status, buf,
+                                  len(payload))
+        if rc != 0:
+            from ..profiler import stat_add
+            stat_add("serving.dropped_replies")
+            stat_add("serving.reply_rc_unknown_id" if rc == -1
+                     else "serving.reply_rc_client_gone" if rc == -3
+                     else "serving.reply_rc_other")
+        return rc
 
     def pending(self) -> int:
         return _load().pt_srv_pending(self._h)

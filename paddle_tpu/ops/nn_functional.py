@@ -266,30 +266,44 @@ def adaptive_max_pool2d(x, output_size: IntOrPair):
     return jnp.stack(parts, axis=-2)
 
 
-def max_pool2d_with_index(x, kernel_size: IntOrPair,
-                          stride: Optional[IntOrPair] = None,
-                          padding: IntOrPair = 0):
-    """(ref: max_pool2d_with_index_op) returns (out, argmax flat indices)."""
-    out = max_pool2d(x, kernel_size, stride, padding)
-    n, c, h, w = x.shape
-    flat_idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
-    flat_idx = jnp.broadcast_to(flat_idx, x.shape)
-    # select index of max via reduce_window over (value, index) pairs
-    ksize = _pair(kernel_size)
-    stride_ = _pair(stride if stride is not None else kernel_size)
-    pads = _conv_padding(padding, 2)
+def _max_pool_with_index(x, kernel_size, stride, padding, spatial: int):
+    """Shared exact (value, flat-index) pair reduce_window for the
+    2d/3d *_with_index pools: int32 indices (no f32 mantissa loss),
+    deterministic ties toward the smaller index like the reference."""
+    spatial_shape = x.shape[2:2 + spatial]
+    size = 1
+    for s in spatial_shape:
+        size *= s
+    ksize = _pair(kernel_size, spatial)
+    strides_sp = _pair(stride if stride is not None else kernel_size,
+                       spatial)
+    pads = _conv_padding(padding, spatial)
     window = (1, 1) + ksize
-    strides = (1, 1) + stride_
+    strides = (1, 1) + strides_sp
+    padding_cfg = pads if isinstance(pads, str) else \
+        [(0, 0), (0, 0)] + list(pads)
+    idx = jnp.broadcast_to(
+        jnp.arange(size, dtype=jnp.int32).reshape(
+            (1, 1) + spatial_shape), x.shape)
+    neg_inf = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+        jnp.iinfo(x.dtype).min
 
     def reducer(a, b):
         av, ai = a
         bv, bi = b
-        take_b = bv > av
-        return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
+        take_a = (av > bv) | ((av == bv) & (ai < bi))
+        return (jnp.where(take_a, av, bv), jnp.where(take_a, ai, bi))
 
-    vals, idxs = lax.reduce_window(
-        (x, flat_idx), (-jnp.inf, jnp.float32(-1)), reducer, window, strides,
-        [(0, 0), (0, 0)] + list(pads))
+    return lax.reduce_window(
+        (x, idx), (jnp.asarray(neg_inf, x.dtype), jnp.int32(2**31 - 1)),
+        reducer, window, strides, padding_cfg)
+
+
+def max_pool2d_with_index(x, kernel_size: IntOrPair,
+                          stride: Optional[IntOrPair] = None,
+                          padding: IntOrPair = 0):
+    """(ref: max_pool2d_with_index_op) returns (out, argmax flat indices)."""
+    vals, idxs = _max_pool_with_index(x, kernel_size, stride, padding, 2)
     return vals, idxs.astype(jnp.int64)
 
 
@@ -978,22 +992,9 @@ def max_pool3d_with_index(x, kernel_size, stride=None, padding=0):
     """(ref: max_pool3d_with_index_op) values + flat argmax indices per
     window over NCDHW input.
 
-    Index recovery packs (value, position) into one f32 reduce_window
-    (value scaled by the spatial size, position subtracted to break
-    ties toward the smaller index). That packing needs value*size to
-    stay inside the f32 mantissa — guard rejects spatial sizes where
-    recovery would silently corrupt."""
-    vals = _pool(x, "max", kernel_size, stride, padding, False, True, 3,
-                 False)
-    n, c, d, h, w = x.shape
-    size = d * h * w
-    if size > (1 << 20):
-        raise ValueError(
-            f"max_pool3d_with_index: spatial size {size} too large for "
-            "exact f32 index packing (limit 2^20)")
-    flat_idx = jnp.arange(size, dtype=jnp.float32).reshape(d, h, w)
-    big = _pool(x.astype(jnp.float32) * size - flat_idx[None, None],
-                "max", kernel_size, stride, padding, False, True, 3,
-                False)
-    idx = (-(big - vals.astype(jnp.float32) * size)).astype(jnp.int32)
-    return vals, idx
+    One variadic reduce_window over (value, flat-index) pairs — exact
+    for arbitrary value magnitudes and spatial sizes (the previous
+    value*size−index f32 packing silently corrupted indices once
+    |value|*size left the 24-bit mantissa), ties toward the smaller
+    index like the reference."""
+    return _max_pool_with_index(x, kernel_size, stride, padding, 3)

@@ -129,10 +129,17 @@ def buffered(reader: Callable, size: int) -> Callable:
             except Exception as e:  # noqa: BLE001
                 err.append(e)
             finally:
-                try:
-                    q.put_nowait(_End)
-                except queue_mod.Full:
-                    pass  # consumer gone; stop flag already set
+                # The sentinel must use the same stop-aware retry loop
+                # as samples: with a full queue and a merely-slow (not
+                # gone) consumer, put_nowait would drop it — the
+                # consumer would drain the queue then block in q.get()
+                # forever and the stored exception would never surface.
+                while not stop.is_set():
+                    try:
+                        q.put(_End, timeout=0.1)
+                        break
+                    except queue_mod.Full:
+                        continue
         t = threading.Thread(target=produce, daemon=True)
         t.start()
         try:

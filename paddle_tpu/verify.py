@@ -181,9 +181,51 @@ def train_parity_10steps() -> dict:
             "losses": [round(v, 6) for v in losses_fw]}
 
 
+def _probe_backend(attempts: int = 3, timeout_s: int = 60) -> bool:
+    """Fail FAST (with retries) when the accelerator tunnel is hung —
+    a wedged PJRT init would otherwise block run_verification forever
+    and no artifact would be written, the exact outcome this module
+    exists to prevent. Probes in a subprocess so this process never
+    touches the backend until it's known good."""
+    import subprocess
+
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.default_backend())"],
+                capture_output=True, timeout=timeout_s, text=True)
+            if r.returncode == 0:
+                _log(f"backend probe {i}: "
+                     f"{r.stdout.strip().splitlines()[-1]}")
+                return True
+            tail = r.stderr.strip().splitlines()[-1][:200] if r.stderr \
+                else ""
+            _log(f"backend probe {i}: rc={r.returncode} {tail}")
+        except subprocess.TimeoutExpired:
+            _log(f"backend probe {i}: hung >{timeout_s}s (tunnel down?)")
+        if i + 1 < attempts:
+            time.sleep(10)
+    return False
+
+
 def run_verification(artifact_path: str = "VERIFY_TPU.json") -> dict:
     """Run every check and write the artifact. Returns the result dict;
-    ``result["ok"]`` is the overall verdict."""
+    ``result["ok"]`` is the overall verdict. If the backend is
+    unreachable, an artifact recording the outage is still written
+    (ok=False, backend="unreachable") instead of hanging."""
+    if not _probe_backend():
+        result = {"backend": "unreachable", "on_accel": False,
+                  "kernels_ok": False,
+                  "kernel_failures": ["backend unreachable (tunnel "
+                                      "down?): probes timed out"],
+                  "train_parity": {"ok": False}, "ok": False}
+        if artifact_path:
+            with open(artifact_path, "w") as f:
+                json.dump(result, f, indent=1)
+            _log(f"wrote {artifact_path} (backend unreachable)")
+        return result
+
     import jax
 
     backend = jax.default_backend()

@@ -428,6 +428,15 @@ def test_flowers_parses_real_formats(tmp_path):
     assert int(lab) == 0  # image 1 -> label 1 -> 0-based 0
     test = Flowers(mode="test", image_size=16, data_home=str(tmp_path))
     assert [int(l) for l in test.labels] == [3, 5]
+    # picklable for multiprocess DataLoader workers (the tar handle and
+    # lock are per-process, reopened lazily after unpickling)
+    import pickle
+    ds[1]  # force the tar open in this process first
+    clone = pickle.loads(pickle.dumps(ds))
+    img2, lab2 = clone[0]
+    np.testing.assert_allclose(np.asarray(img2), np.asarray(img),
+                               rtol=1e-6)
+    assert int(lab2) == 0
 
 
 def test_voc2012_parses_xml_and_feeds_ssd(tmp_path):
@@ -503,3 +512,42 @@ def test_movie_reviews_parses_folder_layout(tmp_path):
         <= {0, 1}
     with pytest.raises(FileNotFoundError):
         MovieReviews(mode="train", data_home=str(tmp_path / "nope"))
+
+
+def test_wmt14_prebuilt_dicts_and_length_filter(tmp_path):
+    """WMT14 (ref dataset/wmt14.py:117): dicts come PRE-BUILT from the
+    archive's src.dict/trg.dict members (id = line number), the data is
+    tab-separated src<TAB>trg, and >80-token sequences are dropped."""
+    from paddle_tpu.datasets import WMT14
+    src_dict = "<s>\n<e>\n<unk>\nthe\ncat\nsat\n"
+    trg_dict = "<s>\n<e>\n<unk>\nle\nchat\nassis\n"
+    long_src = " ".join(["the"] * 85)
+    train = ("the cat\tle chat\n"
+             "the sat\tle assis\n"
+             f"{long_src}\tle chat\n"      # dropped: src > 80 tokens
+             "malformed line no tab\n")    # dropped: not 2 columns
+    path = tmp_path / "wmt14.tgz"
+    with tarfile.open(path, "w:gz") as tar:
+        for name, text in (("wmt14/train/src.dict", src_dict),
+                           ("wmt14/train/trg.dict", trg_dict),
+                           ("wmt14/train/train", train)):
+            data = text.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    ds = WMT14(mode="train", dict_size=6, seq_len=8,
+               data_home=str(tmp_path))
+    assert len(ds) == 2                       # long + malformed dropped
+    assert ds.src_dict["the"] == 3 and ds.trg_dict["chat"] == 4
+    src, trg, trg_next, sl, tl = ds[0]
+    np.testing.assert_array_equal(src[:int(sl)], [0, 3, 4, 1])  # <s> the cat <e>
+    assert trg[0] == 0                        # <s> le chat
+    np.testing.assert_array_equal(trg[1:int(tl)], trg_next[:int(tl) - 1])
+    assert trg_next[int(tl) - 1] == 1         # ends with <e>
+    # dict_size cuts the dict: rebuild with size 4 -> "cat" unk's to 2
+    ds4 = WMT14(mode="train", dict_size=4, seq_len=8,
+                data_home=str(tmp_path))
+    s4 = ds4[0][0]
+    np.testing.assert_array_equal(s4[:4], [0, 3, 2, 1])
+    syn = WMT14(mode="synthetic")
+    assert syn[0][0].shape == (50,)

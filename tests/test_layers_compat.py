@@ -893,3 +893,70 @@ def test_fluid_semantics_divergent_names():
     np.testing.assert_allclose(emb, table[[1, 3]])
     with pytest.raises(ValueError, match="nn.Embedding"):
         L.embedding(np.asarray([0]), [4, 3])
+
+
+# ------------------------------------------------------------ fluid.nets
+
+def test_nets_simple_img_conv_pool():
+    """(ref: fluid/nets.py:29) conv → act → pool, numpy-checked shape
+    and max-pool semantics."""
+    from paddle_tpu import nets
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (2, 3, 8, 8)).astype(np.float32)
+    w = rng.normal(0, 0.1, (4, 3, 3, 3)).astype(np.float32)
+    out = nets.simple_img_conv_pool(x, 4, 3, pool_size=2, pool_stride=2,
+                                    conv_weight=w, conv_padding=1,
+                                    act="relu")
+    assert np.asarray(out).shape == (2, 4, 4, 4)
+    assert float(np.asarray(out).min()) >= 0.0       # relu then max-pool
+    g = nets.simple_img_conv_pool(x, 4, 3, pool_size=2, pool_stride=2,
+                                  conv_weight=w, conv_padding=1,
+                                  global_pooling=True)
+    assert np.asarray(g).shape == (2, 4, 1, 1)
+    with pytest.raises(ValueError, match="output channels"):
+        nets.simple_img_conv_pool(x, 8, 3, 2, 2, conv_weight=w)
+
+
+def test_nets_img_conv_group_vgg_block():
+    """(ref: fluid/nets.py:141) stacked conv+BN blocks then pool."""
+    from paddle_tpu import nets
+    rng = np.random.default_rng(1)
+    x = rng.normal(0, 1, (2, 3, 8, 8)).astype(np.float32)
+    ws = [rng.normal(0, 0.1, (8, 3, 3, 3)).astype(np.float32),
+          rng.normal(0, 0.1, (8, 8, 3, 3)).astype(np.float32)]
+    bn = [(np.ones(8, np.float32), np.zeros(8, np.float32),
+           np.zeros(8, np.float32), np.ones(8, np.float32))
+          for _ in range(2)]
+    out = nets.img_conv_group(x, [8, 8], pool_size=2, conv_weights=ws,
+                              bn_params=bn, conv_with_batchnorm=True,
+                              conv_act="relu", pool_stride=2)
+    assert np.asarray(out).shape == (2, 8, 4, 4)
+    with pytest.raises(ValueError, match="bn_params"):
+        nets.img_conv_group(x, [8, 8], 2, ws, conv_with_batchnorm=True)
+    with pytest.raises(ValueError, match="weights for"):
+        nets.img_conv_group(x, [8, 8, 8], 2, ws)
+
+
+def test_nets_sequence_conv_pool():
+    """(ref: fluid/nets.py:256) sequence_conv → act → sequence_pool
+    over dense padded [B, T, D] + lengths."""
+    from paddle_tpu import nets
+    rng = np.random.default_rng(2)
+    b, t, d, nf, fs = 3, 6, 4, 5, 3
+    x = rng.normal(0, 1, (b, t, d)).astype(np.float32)
+    length = np.asarray([6, 3, 1], np.int64)
+    w = rng.normal(0, 0.1, (fs * d, nf)).astype(np.float32)
+    out = nets.sequence_conv_pool(x, length, nf, fs, w, pool_type="max")
+    assert np.asarray(out).shape == (b, nf)
+    # padding rows beyond each length must not affect the pooled result
+    x2 = x.copy()
+    x2[1, 3:] = 99.0
+    out2 = nets.sequence_conv_pool(x2, length, nf, fs, w, pool_type="max")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2),
+                               rtol=1e-5)
+    with pytest.raises(ValueError, match="weight shape"):
+        nets.sequence_conv_pool(x, length, nf, fs,
+                                np.zeros((2, 2), np.float32))
+    # glu / scaled_dot_product_attention live here too (ref __all__)
+    assert callable(nets.glu) and callable(
+        nets.scaled_dot_product_attention)

@@ -202,9 +202,17 @@ def test_max_pool3d_with_index_recovers_positions():
                     assert np.isclose(v[0, c, di, hi, wi], win.max())
                     assert np.isclose(flat[i[0, c, di, hi, wi]],
                                       win.max())
-    with pytest.raises(ValueError, match="too large"):
-        max_pool3d_with_index(np.zeros((1, 1, 128, 128, 128),
-                                       np.float32), 2, 2)
+    # exact at large value magnitudes — the old f32 value*size packing
+    # silently corrupted indices once |x|*size left the 24-bit mantissa
+    # (ADVICE r2); the pair-reducer has no magnitude or size limit
+    big = (rng.normal(0, 1e6, (1, 1, 4, 4, 4))).astype(np.float32)
+    vb, ib = max_pool3d_with_index(big, 2, 2)
+    flat = big[0, 0].reshape(-1)
+    for di in range(2):
+        for hi in range(2):
+            for wi in range(2):
+                win = big[0, 0, di*2:di*2+2, hi*2:hi*2+2, wi*2:wi*2+2]
+                assert flat[ib[0, 0, di, hi, wi]] == win.max()
 
 
 def test_run_check_passes_on_virtual_mesh(capsys):
@@ -319,3 +327,23 @@ def test_sysconfig_and_version():
     assert os.path.exists(os.path.join(lib, "libptnative.so"))
     assert pt.version.full_version == pt.__version__
     assert isinstance(pt.version.major, int)
+
+
+def test_buffered_reader_exception_reaches_slow_consumer():
+    """ADVICE r2: if the producer raises while the queue is full (slow
+    consumer, not gone), the end sentinel must still be enqueued so the
+    consumer re-raises instead of blocking in q.get() forever."""
+    import time
+    from paddle_tpu.reader import buffered
+
+    def bad_reader():
+        for i in range(8):
+            yield i
+        raise RuntimeError("producer exploded")
+
+    got = []
+    with pytest.raises(RuntimeError, match="producer exploded"):
+        for item in buffered(bad_reader, 2)():
+            got.append(item)
+            time.sleep(0.05)  # keep the queue full while producer dies
+    assert got == list(range(8))
