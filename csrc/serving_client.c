@@ -1,0 +1,214 @@
+/* Single-file C client for the native serving transport — the
+ * framework's analogue of the reference's non-Python inference clients
+ * (/root/reference/paddle/fluid/inference/capi/c_api.cc,
+ * /root/reference/go/paddle/predictor.go). No dependencies beyond
+ * POSIX sockets; drop this file into any C/C++ project.
+ *
+ * Wire protocol (csrc/serving.cc, little-endian):
+ *   client -> server:  u32 magic 'PTSV' | u64 tag | u32 len | payload
+ *   server -> client:  u64 tag | i64 status | u32 len | payload
+ * Replies may arrive out of order when pipelining; this client issues
+ * monotonically increasing tags and matches replies by tag.
+ *
+ * Payload bytes are the tensor codec produced/consumed by
+ * paddle_tpu.inference.encode_tensors/decode_tensors; for raw use the
+ * payload is opaque. Compile a demo binary with -DPTSC_DEMO_MAIN.
+ *
+ * API (all return 0 on success, negative on error):
+ *   ptsc_connect(host, port)                 -> fd (>=0) or -errno
+ *   ptsc_request(fd, payload, len, &tag)     -> sends one frame
+ *   ptsc_wait_reply(fd, tag, buf, cap, &status, &out_len)
+ *   ptsc_infer(fd, payload, len, buf, cap, &status, &out_len)
+ *   ptsc_close(fd)
+ */
+
+#include <errno.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#define PTSC_MAGIC 0x56535450u /* 'PTSV' */
+
+#define PTSC_ERR_CONNECT -1
+#define PTSC_ERR_IO -2
+#define PTSC_ERR_PROTOCOL -3
+#define PTSC_ERR_TOOBIG -4
+
+/* Explicit little-endian field codecs — the wire protocol is LE
+ * (csrc/serving.cc) regardless of host byte order. */
+static void ptsc_put_u32(unsigned char *p, uint32_t v) {
+  p[0] = (unsigned char)(v);
+  p[1] = (unsigned char)(v >> 8);
+  p[2] = (unsigned char)(v >> 16);
+  p[3] = (unsigned char)(v >> 24);
+}
+
+static void ptsc_put_u64(unsigned char *p, uint64_t v) {
+  int i;
+  for (i = 0; i < 8; i++) p[i] = (unsigned char)(v >> (8 * i));
+}
+
+static uint32_t ptsc_get_u32(const unsigned char *p) {
+  return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+         ((uint32_t)p[3] << 24);
+}
+
+static uint64_t ptsc_get_u64(const unsigned char *p) {
+  uint64_t v = 0;
+  int i;
+  for (i = 7; i >= 0; i--) v = (v << 8) | p[i];
+  return v;
+}
+
+static int ptsc_write_all(int fd, const void *buf, size_t n) {
+  const char *p = (const char *)buf;
+  while (n > 0) {
+    ssize_t w = write(fd, p, n);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return PTSC_ERR_IO;
+    }
+    p += w;
+    n -= (size_t)w;
+  }
+  return 0;
+}
+
+static int ptsc_read_all(int fd, void *buf, size_t n) {
+  char *p = (char *)buf;
+  while (n > 0) {
+    ssize_t r = read(fd, p, n);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return PTSC_ERR_IO;
+    }
+    if (r == 0) return PTSC_ERR_IO; /* server closed */
+    p += r;
+    n -= (size_t)r;
+  }
+  return 0;
+}
+
+int ptsc_connect(const char *host, int port) {
+  char portstr[16];
+  struct addrinfo hints, *res = NULL, *ai;
+  int fd = -1, one = 1;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  snprintf(portstr, sizeof(portstr), "%d", port);
+  if (getaddrinfo(host, portstr, &hints, &res) != 0) return PTSC_ERR_CONNECT;
+  for (ai = res; ai != NULL; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) return PTSC_ERR_CONNECT;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+/* Per-connection tag counter. The transport matches replies by tag, so
+ * a single counter per process is fine for pipelining too (tags only
+ * need to be unique per connection; they are unique globally here). */
+static uint64_t ptsc_next_tag_counter = 0;
+
+int ptsc_request(int fd, const void *payload, uint32_t len, uint64_t *tag) {
+  unsigned char hdr[16];
+  uint64_t t = ++ptsc_next_tag_counter;
+  int rc;
+  ptsc_put_u32(hdr, PTSC_MAGIC);
+  ptsc_put_u64(hdr + 4, t);
+  ptsc_put_u32(hdr + 12, len);
+  if ((rc = ptsc_write_all(fd, hdr, sizeof(hdr))) != 0) return rc;
+  if (len > 0 && (rc = ptsc_write_all(fd, payload, len)) != 0) return rc;
+  if (tag) *tag = t;
+  return 0;
+}
+
+/* Read frames until the one tagged `tag` arrives. Out-of-order frames
+ * for other tags are discarded (single-outstanding-request callers
+ * never see any; pipelining callers should issue waits in send order
+ * per connection, as the reply stream interleaves). */
+int ptsc_wait_reply(int fd, uint64_t tag, void *buf, uint32_t cap,
+                    int64_t *status, uint32_t *out_len) {
+  unsigned char hdr[20];
+  for (;;) {
+    uint64_t rtag;
+    int64_t st;
+    uint32_t n;
+    int rc;
+    if ((rc = ptsc_read_all(fd, hdr, sizeof(hdr))) != 0) return rc;
+    rtag = ptsc_get_u64(hdr);
+    st = (int64_t)ptsc_get_u64(hdr + 8);
+    n = ptsc_get_u32(hdr + 16);
+    if (rtag == tag) {
+      if (n > cap) return PTSC_ERR_TOOBIG;
+      if (n > 0 && (rc = ptsc_read_all(fd, buf, n)) != 0) return rc;
+      if (status) *status = st;
+      if (out_len) *out_len = n;
+      return 0;
+    }
+    /* drain and drop a frame for another tag */
+    {
+      char sink[4096];
+      while (n > 0) {
+        uint32_t take = n > sizeof(sink) ? (uint32_t)sizeof(sink) : n;
+        if ((rc = ptsc_read_all(fd, sink, take)) != 0) return rc;
+        n -= take;
+      }
+    }
+  }
+}
+
+int ptsc_infer(int fd, const void *payload, uint32_t len, void *buf,
+               uint32_t cap, int64_t *status, uint32_t *out_len) {
+  uint64_t tag;
+  int rc = ptsc_request(fd, payload, len, &tag);
+  if (rc != 0) return rc;
+  return ptsc_wait_reply(fd, tag, buf, cap, status, out_len);
+}
+
+int ptsc_close(int fd) { return close(fd); }
+
+#ifdef PTSC_DEMO_MAIN
+#include <stdlib.h>
+/* Demo/test binary: send argv[3] (default "ping") as one request,
+ * print "status=<s> len=<n>" then the payload bytes to stdout.
+ * Usage: ptsc_demo <host> <port> [payload-string] */
+int main(int argc, char **argv) {
+  static char reply[1 << 22];
+  const char *msg;
+  uint32_t out_len = 0;
+  int64_t status = -999;
+  int fd, rc;
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s host port [payload]\n", argv[0]);
+    return 2;
+  }
+  msg = argc > 3 ? argv[3] : "ping";
+  fd = ptsc_connect(argv[1], atoi(argv[2]));
+  if (fd < 0) {
+    fprintf(stderr, "connect failed: %d\n", fd);
+    return 1;
+  }
+  rc = ptsc_infer(fd, msg, (uint32_t)strlen(msg), reply, sizeof(reply),
+                  &status, &out_len);
+  if (rc != 0) {
+    fprintf(stderr, "infer failed: %d\n", rc);
+    return 1;
+  }
+  printf("status=%lld len=%u\n", (long long)status, out_len);
+  fwrite(reply, 1, out_len, stdout);
+  ptsc_close(fd);
+  return 0;
+}
+#endif

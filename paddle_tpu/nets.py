@@ -88,6 +88,14 @@ def img_conv_group(input, conv_num_filter: Sequence[int], pool_size,
     if len(conv_weights) != n:
         raise ValueError(
             f"img_conv_group: {len(conv_weights)} weights for {n} convs")
+    fsizes = conv_filter_size if isinstance(conv_filter_size,
+                                            (list, tuple)) \
+        else [conv_filter_size] * n
+    for i, (w_, fs) in enumerate(zip(conv_weights, fsizes)):
+        if tuple(w_.shape[2:]) != (fs, fs):
+            raise ValueError(
+                f"img_conv_group: conv {i} kernel is "
+                f"{tuple(w_.shape[2:])} but conv_filter_size={fs}")
     if conv_with_batchnorm and (bn_params is None or len(bn_params) != n):
         raise ValueError(
             "img_conv_group: conv_with_batchnorm=True needs one "

@@ -6,6 +6,7 @@ Models the reference's inference tests
 api_impl_tester.cc: create predictor, feed ZeroCopyTensors, Run, clone
 and run concurrently)."""
 
+import os
 import threading
 
 import numpy as np
@@ -273,3 +274,47 @@ def test_server_connection_churn_does_not_leak_fds(artifact):
         # the reaper runs on accept: fd count stays bounded (allow a
         # small jitter for in-flight sockets in TIME_WAIT handling)
         assert nfds() <= base + 4, (base, nfds())
+
+
+def test_c_client_round_trip(tmp_path):
+    """The shipped C client (csrc/serving_client.c — the analogue of
+    the reference's capi/c_api.cc and go/paddle/predictor.go clients)
+    must round-trip the framed-TCP protocol against csrc/serving.cc:
+    compile the demo main, run it against a live transport, echo the
+    payload back with a status, check both directions byte-exact."""
+    import subprocess
+    import threading
+
+    from paddle_tpu.native import ServingTransport
+
+    src = os.path.join(os.path.dirname(__file__), "..", "csrc",
+                       "serving_client.c")
+    exe = str(tmp_path / "ptsc_demo")
+    subprocess.run(["cc", "-O2", "-DPTSC_DEMO_MAIN", "-o", exe, src],
+                   check=True, capture_output=True)
+
+    transport = ServingTransport(port=0, queue_cap=8)
+    stop = threading.Event()
+
+    def serve():
+        while not stop.is_set():
+            got = transport.next_request(timeout_ms=50)
+            if got is None:
+                continue
+            rid, payload = got
+            transport.reply(rid, b"echo:" + payload, status=0)
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    try:
+        out = subprocess.run(
+            [exe, "127.0.0.1", str(transport.port), "hello-from-c"],
+            capture_output=True, timeout=30)
+        assert out.returncode == 0, out.stderr.decode()
+        text = out.stdout.decode()
+        assert text.startswith("status=0 len=17\n"), text
+        assert text.endswith("echo:hello-from-c"), text
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        transport.stop()
