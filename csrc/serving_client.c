@@ -116,14 +116,24 @@ int ptsc_connect(const char *host, int port) {
   return fd;
 }
 
-/* Per-connection tag counter. The transport matches replies by tag, so
- * a single counter per process is fine for pipelining too (tags only
- * need to be unique per connection; they are unique globally here). */
+/* Process-global atomic tag counter: tags only need to be unique per
+ * connection, and a globally-unique atomic satisfies that even when
+ * several threads pipeline on the same fd. (Concurrent ptsc_wait_reply
+ * calls on one fd must still be externally serialized — two readers
+ * would each steal the other's frames.) */
+#if defined(__STDC_VERSION__) && __STDC_VERSION__ >= 201112L && \
+    !defined(__STDC_NO_ATOMICS__)
+#include <stdatomic.h>
+static _Atomic uint64_t ptsc_next_tag_counter = 0;
+#define PTSC_NEXT_TAG() (atomic_fetch_add(&ptsc_next_tag_counter, 1) + 1)
+#else
 static uint64_t ptsc_next_tag_counter = 0;
+#define PTSC_NEXT_TAG() (++ptsc_next_tag_counter)
+#endif
 
 int ptsc_request(int fd, const void *payload, uint32_t len, uint64_t *tag) {
   unsigned char hdr[16];
-  uint64_t t = ++ptsc_next_tag_counter;
+  uint64_t t = PTSC_NEXT_TAG();
   int rc;
   ptsc_put_u32(hdr, PTSC_MAGIC);
   ptsc_put_u64(hdr + 4, t);
@@ -151,7 +161,19 @@ int ptsc_wait_reply(int fd, uint64_t tag, void *buf, uint32_t cap,
     st = (int64_t)ptsc_get_u64(hdr + 8);
     n = ptsc_get_u32(hdr + 16);
     if (rtag == tag) {
-      if (n > cap) return PTSC_ERR_TOOBIG;
+      if (n > cap) {
+        /* drain the oversized payload before returning so the
+         * connection's frame stream stays aligned for later calls */
+        char sink[4096];
+        while (n > 0) {
+          uint32_t take = n > sizeof(sink) ? (uint32_t)sizeof(sink) : n;
+          if ((rc = ptsc_read_all(fd, sink, take)) != 0) return rc;
+          n -= take;
+        }
+        if (status) *status = st;
+        if (out_len) *out_len = 0;
+        return PTSC_ERR_TOOBIG;
+      }
       if (n > 0 && (rc = ptsc_read_all(fd, buf, n)) != 0) return rc;
       if (status) *status = st;
       if (out_len) *out_len = n;

@@ -3,8 +3,12 @@
 Performance target model (BASELINE.json configs 2/4: ResNet-50 ImageNet on
 v5e). Capability parity with the reference's SE-ResNeXt/ResNet book + dist
 tests (/root/reference/python/paddle/fluid/tests/unittests/dist_se_resnext.py
-uses the same conv/bn/pool op set). NCHW layout; BN buffers thread through
-the functional step.
+uses the same conv/bn/pool op set). Layout is selectable: NCHW (reference
+API parity, the default) or NHWC via ``data_format="NHWC"`` — on TPU the
+channels-last form keeps the feature dim on the (8, 128) lane axis so XLA
+tiles convs onto the MXU without inserting activation transposes (weights
+stay OIHW either way; checkpoints are layout-independent). BN buffers
+thread through the functional step.
 """
 
 from __future__ import annotations
@@ -18,15 +22,17 @@ class BasicBlock(nn.Layer):
     expansion = 1
 
     def __init__(self, inplanes: int, planes: int, stride: int = 1,
-                 downsample: Optional[nn.Layer] = None) -> None:
+                 downsample: Optional[nn.Layer] = None,
+                 data_format: str = "NCHW") -> None:
         super().__init__()
+        df = data_format
         self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride,
-                               padding=1, bias_attr=False)
-        self.bn1 = nn.BatchNorm2D(planes)
+                               padding=1, bias_attr=False, data_format=df)
+        self.bn1 = nn.BatchNorm2D(planes, data_format=df)
         self.relu = nn.ReLU()
         self.conv2 = nn.Conv2D(planes, planes, 3, padding=1,
-                               bias_attr=False)
-        self.bn2 = nn.BatchNorm2D(planes)
+                               bias_attr=False, data_format=df)
+        self.bn2 = nn.BatchNorm2D(planes, data_format=df)
         if downsample is not None:
             self.downsample = downsample
         self.has_downsample = downsample is not None
@@ -45,17 +51,22 @@ class BottleneckBlock(nn.Layer):
 
     def __init__(self, inplanes: int, planes: int, stride: int = 1,
                  downsample: Optional[nn.Layer] = None,
-                 groups: int = 1, base_width: int = 64) -> None:
+                 groups: int = 1, base_width: int = 64,
+                 data_format: str = "NCHW") -> None:
         super().__init__()
+        df = data_format
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
-        self.bn1 = nn.BatchNorm2D(width)
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False,
+                               data_format=df)
+        self.bn1 = nn.BatchNorm2D(width, data_format=df)
         self.conv2 = nn.Conv2D(width, width, 3, stride=stride, padding=1,
-                               groups=groups, bias_attr=False)
-        self.bn2 = nn.BatchNorm2D(width)
+                               groups=groups, bias_attr=False,
+                               data_format=df)
+        self.bn2 = nn.BatchNorm2D(width, data_format=df)
         self.conv3 = nn.Conv2D(width, planes * self.expansion, 1,
-                               bias_attr=False)
-        self.bn3 = nn.BatchNorm2D(planes * self.expansion)
+                               bias_attr=False, data_format=df)
+        self.bn3 = nn.BatchNorm2D(planes * self.expansion,
+                                  data_format=df)
         self.relu = nn.ReLU()
         if downsample is not None:
             self.downsample = downsample
@@ -74,44 +85,53 @@ class BottleneckBlock(nn.Layer):
 class ResNet(nn.Layer):
     def __init__(self, block: Type, layers: List[int],
                  num_classes: int = 1000, groups: int = 1,
-                 width_per_group: int = 64) -> None:
+                 width_per_group: int = 64,
+                 data_format: str = "NCHW") -> None:
         super().__init__()
+        if data_format not in ("NCHW", "NHWC"):
+            raise ValueError(f"data_format must be NCHW or NHWC, got "
+                             f"{data_format!r}")
+        self.data_format = data_format
+        df = data_format
         self.inplanes = 64
         self.groups = groups
         self.base_width = width_per_group
         self.conv1 = nn.Conv2D(3, 64, 7, stride=2, padding=3,
-                               bias_attr=False)
-        self.bn1 = nn.BatchNorm2D(64)
+                               bias_attr=False, data_format=df)
+        self.bn1 = nn.BatchNorm2D(64, data_format=df)
         self.relu = nn.ReLU()
-        self.maxpool = nn.MaxPool2D(3, 2, 1)
+        self.maxpool = nn.MaxPool2D(3, 2, 1, data_format=df)
         self.layer1 = self._make_layer(block, 64, layers[0])
         self.layer2 = self._make_layer(block, 128, layers[1], 2)
         self.layer3 = self._make_layer(block, 256, layers[2], 2)
         self.layer4 = self._make_layer(block, 512, layers[3], 2)
-        self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.avgpool = nn.AdaptiveAvgPool2D(1, data_format=df)
         self.flatten = nn.Flatten()
         self.fc = nn.Linear(512 * block.expansion, num_classes)
 
     def _make_layer(self, block: Type, planes: int, blocks: int,
                     stride: int = 1) -> nn.Sequential:
+        df = self.data_format
         downsample = None
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = nn.Sequential(
                 nn.Conv2D(self.inplanes, planes * block.expansion, 1,
-                          stride=stride, bias_attr=False),
-                nn.BatchNorm2D(planes * block.expansion),
+                          stride=stride, bias_attr=False, data_format=df),
+                nn.BatchNorm2D(planes * block.expansion, data_format=df),
             )
         layers = [block(self.inplanes, planes, stride, downsample,
-                        groups=self.groups, base_width=self.base_width)
+                        groups=self.groups, base_width=self.base_width,
+                        data_format=df)
                   if block is BottleneckBlock
-                  else block(self.inplanes, planes, stride, downsample)]
+                  else block(self.inplanes, planes, stride, downsample,
+                             data_format=df)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(
                 block(self.inplanes, planes, groups=self.groups,
-                      base_width=self.base_width)
+                      base_width=self.base_width, data_format=df)
                 if block is BottleneckBlock
-                else block(self.inplanes, planes))
+                else block(self.inplanes, planes, data_format=df))
         return nn.Sequential(*layers)
 
     def forward(self, x):
@@ -124,26 +144,37 @@ class ResNet(nn.Layer):
         return self.fc(x)
 
 
-def resnet18(num_classes: int = 1000) -> ResNet:
-    return ResNet(BasicBlock, [2, 2, 2, 2], num_classes)
+def resnet18(num_classes: int = 1000,
+             data_format: str = "NCHW") -> ResNet:
+    return ResNet(BasicBlock, [2, 2, 2, 2], num_classes,
+                  data_format=data_format)
 
 
-def resnet34(num_classes: int = 1000) -> ResNet:
-    return ResNet(BasicBlock, [3, 4, 6, 3], num_classes)
+def resnet34(num_classes: int = 1000,
+             data_format: str = "NCHW") -> ResNet:
+    return ResNet(BasicBlock, [3, 4, 6, 3], num_classes,
+                  data_format=data_format)
 
 
-def resnet50(num_classes: int = 1000) -> ResNet:
-    return ResNet(BottleneckBlock, [3, 4, 6, 3], num_classes)
+def resnet50(num_classes: int = 1000,
+             data_format: str = "NCHW") -> ResNet:
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], num_classes,
+                  data_format=data_format)
 
 
-def resnet101(num_classes: int = 1000) -> ResNet:
-    return ResNet(BottleneckBlock, [3, 4, 23, 3], num_classes)
+def resnet101(num_classes: int = 1000,
+             data_format: str = "NCHW") -> ResNet:
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], num_classes,
+                  data_format=data_format)
 
 
-def resnet152(num_classes: int = 1000) -> ResNet:
-    return ResNet(BottleneckBlock, [3, 8, 36, 3], num_classes)
+def resnet152(num_classes: int = 1000,
+             data_format: str = "NCHW") -> ResNet:
+    return ResNet(BottleneckBlock, [3, 8, 36, 3], num_classes,
+                  data_format=data_format)
 
 
-def resnext50_32x4d(num_classes: int = 1000) -> ResNet:
+def resnext50_32x4d(num_classes: int = 1000,
+                    data_format: str = "NCHW") -> ResNet:
     return ResNet(BottleneckBlock, [3, 4, 6, 3], num_classes, groups=32,
-                  width_per_group=4)
+                  width_per_group=4, data_format=data_format)

@@ -88,11 +88,17 @@ def img_conv_group(input, conv_num_filter: Sequence[int], pool_size,
     if len(conv_weights) != n:
         raise ValueError(
             f"img_conv_group: {len(conv_weights)} weights for {n} convs")
-    fsizes = conv_filter_size if isinstance(conv_filter_size,
-                                            (list, tuple)) \
-        else [conv_filter_size] * n
+    if isinstance(conv_filter_size, list):
+        fsizes = conv_filter_size
+        if len(fsizes) != n:
+            raise ValueError(
+                f"img_conv_group: {len(fsizes)} filter sizes for {n} "
+                f"convs")
+    else:  # one size (int or (kh, kw) tuple) shared by every conv
+        fsizes = [conv_filter_size] * n
     for i, (w_, fs) in enumerate(zip(conv_weights, fsizes)):
-        if tuple(w_.shape[2:]) != (fs, fs):
+        want = (fs, fs) if isinstance(fs, int) else tuple(fs)
+        if tuple(w_.shape[2:]) != want:
             raise ValueError(
                 f"img_conv_group: conv {i} kernel is "
                 f"{tuple(w_.shape[2:])} but conv_filter_size={fs}")

@@ -85,9 +85,12 @@ class Conv2D(_ConvNd):
                          spatial=2, data_format=data_format)
 
     def forward(self, x):
+        # weights are stored OIHW whatever the activation layout, so
+        # checkpoints are layout-independent (NHWC transposes the small
+        # filter inside XLA, never the activations)
         return F.conv2d(x, self.weight, self._bias(), self.stride,
                         self.padding, self.dilation, self.groups,
-                        self.data_format)
+                        self.data_format, weight_format="OIHW")
 
 
 class Conv3D(_ConvNd):
@@ -122,31 +125,36 @@ class Conv2DTranspose(_ConvNd):
 
 class MaxPool2D(Layer):
     def __init__(self, kernel_size, stride=None, padding=0,
-                 ceil_mode: bool = False) -> None:
+                 ceil_mode: bool = False,
+                 data_format: str = "NCHW") -> None:
         super().__init__()
         self.kernel_size = kernel_size
         self.stride = stride
         self.padding = padding
         self.ceil_mode = ceil_mode
+        self.data_format = data_format
 
     def forward(self, x):
         return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            self.ceil_mode)
+                            self.ceil_mode, self.data_format)
 
 
 class AvgPool2D(Layer):
     def __init__(self, kernel_size, stride=None, padding=0,
-                 ceil_mode: bool = False, exclusive: bool = True) -> None:
+                 ceil_mode: bool = False, exclusive: bool = True,
+                 data_format: str = "NCHW") -> None:
         super().__init__()
         self.kernel_size = kernel_size
         self.stride = stride
         self.padding = padding
         self.ceil_mode = ceil_mode
         self.exclusive = exclusive
+        self.data_format = data_format
 
     def forward(self, x):
         return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            self.ceil_mode, self.exclusive)
+                            self.ceil_mode, self.exclusive,
+                            self.data_format)
 
 
 class MaxPool3D(Layer):
@@ -178,12 +186,14 @@ class AvgPool3D(Layer):
 
 
 class AdaptiveAvgPool2D(Layer):
-    def __init__(self, output_size) -> None:
+    def __init__(self, output_size, data_format: str = "NCHW") -> None:
         super().__init__()
         self.output_size = output_size
+        self.data_format = data_format
 
     def forward(self, x):
-        return F.adaptive_avg_pool2d(x, self.output_size)
+        return F.adaptive_avg_pool2d(x, self.output_size,
+                                     self.data_format)
 
 
 class AdaptiveMaxPool2D(Layer):

@@ -53,11 +53,18 @@ def _conv_padding(padding, spatial: int):
 
 def conv2d(x, weight, bias=None, stride: IntOrPair = 1,
            padding: Union[str, IntOrPair] = 0, dilation: IntOrPair = 1,
-           groups: int = 1, data_format: str = "NCHW"):
+           groups: int = 1, data_format: str = "NCHW",
+           weight_format: Optional[str] = None):
+    """``weight_format`` defaults to the historical pairing (OIHW for
+    NCHW activations, HWIO for NHWC); pass ``weight_format="OIHW"``
+    with NHWC activations to run channels-last compute on the same
+    parameter layout the nn layers store (checkpoints stay
+    layout-independent — XLA transposes the small filter, not the
+    activations)."""
+    if weight_format is None:
+        weight_format = "OIHW" if data_format == "NCHW" else "HWIO"
     dn = lax.conv_dimension_numbers(
-        x.shape, weight.shape,
-        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
-        else ("NHWC", "HWIO", "NHWC"))
+        x.shape, weight.shape, (data_format, weight_format, data_format))
     out = lax.conv_general_dilated(
         x, weight, window_strides=_pair(stride),
         padding=_conv_padding(padding, 2),
@@ -155,23 +162,30 @@ def conv_shift(x, y):
 
 def _pool(x, kind: str, ksize: IntOrPair, stride: Optional[IntOrPair],
           padding: IntOrPair, ceil_mode: bool, exclusive: bool,
-          spatial: int, global_pool: bool):
+          spatial: int, global_pool: bool, channels_last: bool = False):
+    sp0 = 1 if channels_last else 2  # first spatial dim index
     if global_pool:
-        ksize = x.shape[2:2 + spatial]
+        ksize = x.shape[sp0:sp0 + spatial]
         stride = ksize
         padding = 0
     ksize = _pair(ksize, spatial)
     stride = _pair(stride if stride is not None else ksize, spatial)
     pads = _conv_padding(padding, spatial)
-    window = (1, 1) + ksize
-    strides = (1, 1) + stride
+    if channels_last:
+        window = (1,) + ksize + (1,)
+        strides = (1,) + stride + (1,)
+    else:
+        window = (1, 1) + ksize
+        strides = (1, 1) + stride
     if isinstance(pads, str):
         padding_cfg = pads
     else:
-        padding_cfg = [(0, 0), (0, 0)] + list(pads)
+        padding_cfg = [(0, 0)] + list(pads) + [(0, 0)] if channels_last \
+            else [(0, 0), (0, 0)] + list(pads)
         if ceil_mode:
+            spatial_dims = range(sp0, sp0 + spatial)
             padding_cfg = [
-                (lo, hi + (s - 1)) if i >= 2 else (lo, hi)
+                (lo, hi + (s - 1)) if i in spatial_dims else (lo, hi)
                 for i, ((lo, hi), s) in enumerate(zip(padding_cfg, strides))]
     if kind == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
@@ -192,16 +206,17 @@ def _pool(x, kind: str, ksize: IntOrPair, stride: Optional[IntOrPair],
 
 
 def max_pool2d(x, kernel_size: IntOrPair, stride: Optional[IntOrPair] = None,
-               padding: IntOrPair = 0, ceil_mode: bool = False):
+               padding: IntOrPair = 0, ceil_mode: bool = False,
+               data_format: str = "NCHW"):
     return _pool(x, "max", kernel_size, stride, padding, ceil_mode, True, 2,
-                 False)
+                 False, channels_last=data_format == "NHWC")
 
 
 def avg_pool2d(x, kernel_size: IntOrPair, stride: Optional[IntOrPair] = None,
                padding: IntOrPair = 0, ceil_mode: bool = False,
-               exclusive: bool = True):
+               exclusive: bool = True, data_format: str = "NCHW"):
     return _pool(x, "avg", kernel_size, stride, padding, ceil_mode,
-                 exclusive, 2, False)
+                 exclusive, 2, False, channels_last=data_format == "NHWC")
 
 
 def max_pool3d(x, kernel_size: IntOrPair, stride: Optional[IntOrPair] = None,
@@ -226,8 +241,18 @@ def pool2d(x, pool_size: IntOrPair = -1, pool_type: str = "max",
                  ceil_mode, exclusive, 2, global_pooling)
 
 
-def adaptive_avg_pool2d(x, output_size: IntOrPair):
+def adaptive_avg_pool2d(x, output_size: IntOrPair,
+                        data_format: str = "NCHW"):
     oh, ow = _pair(output_size)
+    if data_format == "NHWC":
+        n, h, w, c = x.shape
+        if h % oh == 0 and w % ow == 0:
+            return jnp.mean(x.reshape(n, oh, h // oh, ow, w // ow, c),
+                            axis=(2, 4))
+        # general case: compute channels-first, transpose back once
+        out = adaptive_avg_pool2d(jnp.transpose(x, (0, 3, 1, 2)),
+                                  output_size)
+        return jnp.transpose(out, (0, 2, 3, 1))
     n, c, h, w = x.shape
     if h % oh == 0 and w % ow == 0:
         return jnp.mean(x.reshape(n, c, oh, h // oh, ow, w // ow),

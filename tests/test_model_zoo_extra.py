@@ -394,3 +394,67 @@ def test_ernie_pretrains_end_to_end(rng):
         last = float(step(masked.astype(np.int32),
                           labels=(mlm, nsp))["loss"])
     assert last < first, (first, last)
+
+
+def test_resnet_nhwc_matches_nchw():
+    """Channels-last ResNet (the TPU bench layout) must compute the
+    same function as NCHW: weights are stored OIHW in both, so the
+    same seed yields identical params and eval outputs are bit-exact
+    (train mode differs only by batch-stat reduction order)."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.resnet import BasicBlock, ResNet
+
+    pt.seed(0)
+    m_nchw = ResNet(BasicBlock, [1, 1, 1, 1], num_classes=10)
+    pt.seed(0)
+    m_nhwc = ResNet(BasicBlock, [1, 1, 1, 1], num_classes=10,
+                    data_format="NHWC")
+    sd1, sd2 = m_nchw.state_dict(), m_nhwc.state_dict()
+    assert set(sd1) == set(sd2)  # layout-independent checkpoints
+    for k in sd1:
+        np.testing.assert_array_equal(np.asarray(sd1[k]),
+                                      np.asarray(sd2[k]))
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (2, 3, 32, 32)).astype(np.float32)
+    x_last = np.transpose(x, (0, 2, 3, 1))
+    m_nchw.eval()
+    m_nhwc.eval()
+    np.testing.assert_array_equal(np.asarray(m_nchw(x)),
+                                  np.asarray(m_nhwc(x_last)))
+    # train mode: same up to reduction order
+    m_nchw.train()
+    m_nhwc.train()
+    np.testing.assert_allclose(np.asarray(m_nchw(x)),
+                               np.asarray(m_nhwc(x_last)),
+                               rtol=5e-3, atol=5e-4)
+
+
+def test_resnet_nhwc_trains():
+    """A few SGD steps in channels-last converge identically to NCHW
+    (losses track within reduction-order noise)."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.resnet import BasicBlock, ResNet
+    from paddle_tpu.static import TrainStep
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(0, 1, (4, 3, 16, 16)).astype(np.float32)
+    y = rng.integers(0, 4, (4,)).astype(np.int64)
+    losses = {}
+    for df in ("NCHW", "NHWC"):
+        pt.seed(0)
+        m = ResNet(BasicBlock, [1, 1, 1, 1], num_classes=4,
+                   data_format=df)
+        step = TrainStep(m, pt.optimizer.SGD(learning_rate=0.05),
+                         lambda out, t: pt.nn.functional.cross_entropy(
+                             out, t))
+        data = x if df == "NCHW" else np.transpose(x, (0, 2, 3, 1))
+        losses[df] = [float(step(data, labels=y)["loss"])
+                      for _ in range(4)]
+    np.testing.assert_allclose(losses["NCHW"], losses["NHWC"],
+                               rtol=5e-3)
+    assert losses["NHWC"][-1] < losses["NHWC"][0]
