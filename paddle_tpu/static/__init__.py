@@ -354,13 +354,24 @@ class TrainStep:
         return ({"params": new_params, "buffers": new_buffers,
                  "opt": new_opt, "rng": rng}, metrics)
 
-    def __call__(self, *args, labels=(), **kwargs):
+    def _make_batch(self, args, labels, kwargs):
         from ..parallel.spmd import inject_host_lr
-        batch = inject_host_lr(
+        return inject_host_lr(
             {"args": args, "labels": as_label_tuple(labels),
              "kwargs": kwargs}, self.optimizer)
+
+    def __call__(self, *args, labels=(), **kwargs):
+        batch = self._make_batch(args, labels, kwargs)
         self.state, metrics = self._jitted(self.state, batch)
         return metrics
+
+    def compiled_hlo(self, *args, labels=(), **kwargs) -> str:
+        """Optimized-HLO text of the whole train step for these inputs
+        (no execution; state is NOT consumed). Backs structural perf
+        analysis — tools/perf_lab.py hlostats counts copy/transpose
+        ops here before spending chip time."""
+        batch = self._make_batch(args, labels, kwargs)
+        return self._jitted.lower(self.state, batch).compile().as_text()
 
     def reset_from_model(self) -> None:
         """Re-pull params/buffers from the eager model (the model is the
