@@ -5,7 +5,10 @@ Capability parity with the reference's hapi vision models
 mobilenetv1.py, mobilenetv2.py). Depthwise convolutions use the same
 grouped-conv lowering the reference's depthwise_conv2d op provides
 (operators/math/depthwise_conv.cu) — on TPU, XLA lowers
-feature_group_count convolutions directly.
+feature_group_count convolutions directly. ``data_format="NHWC"`` runs
+the whole stack channels-last (depthwise convs are elementwise over the
+lane axis there); weights stay OIHW so checkpoints are
+layout-independent, as in models/resnet.py.
 """
 
 from __future__ import annotations
@@ -16,11 +19,13 @@ __all__ = ["MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2"]
 
 
 def _conv_bn(in_c: int, out_c: int, kernel: int, stride: int = 1,
-             padding: int = 0, groups: int = 1) -> nn.Layer:
+             padding: int = 0, groups: int = 1,
+             data_format: str = "NCHW") -> nn.Layer:
     return nn.Sequential(
         nn.Conv2D(in_c, out_c, kernel, stride=stride, padding=padding,
-                  groups=groups, bias_attr=False),
-        nn.BatchNorm2D(out_c),
+                  groups=groups, bias_attr=False,
+                  data_format=data_format),
+        nn.BatchNorm2D(out_c, data_format=data_format),
         nn.ReLU6(),
     )
 
@@ -28,11 +33,13 @@ def _conv_bn(in_c: int, out_c: int, kernel: int, stride: int = 1,
 class _DepthwiseSeparable(nn.Layer):
     """(ref: mobilenetv1.py DepthwiseSeparable)."""
 
-    def __init__(self, in_c: int, out_c: int, stride: int) -> None:
+    def __init__(self, in_c: int, out_c: int, stride: int,
+                 data_format: str = "NCHW") -> None:
         super().__init__()
         self.depthwise = _conv_bn(in_c, in_c, 3, stride=stride, padding=1,
-                                  groups=in_c)
-        self.pointwise = _conv_bn(in_c, out_c, 1)
+                                  groups=in_c, data_format=data_format)
+        self.pointwise = _conv_bn(in_c, out_c, 1,
+                                  data_format=data_format)
 
     def forward(self, x):
         return self.pointwise(self.depthwise(x))
@@ -41,13 +48,17 @@ class _DepthwiseSeparable(nn.Layer):
 class MobileNetV1(nn.Layer):
     """(ref: hapi/vision/models/mobilenetv1.py MobileNetV1)."""
 
-    def __init__(self, num_classes: int = 1000,
-                 scale: float = 1.0) -> None:
+    def __init__(self, num_classes: int = 1000, scale: float = 1.0,
+                 data_format: str = "NCHW") -> None:
         super().__init__()
+        if data_format not in ("NCHW", "NHWC"):
+            raise ValueError(f"data_format must be NCHW or NHWC, got "
+                             f"{data_format!r}")
 
         def c(ch: int) -> int:
             return max(int(ch * scale), 8)
 
+        df = data_format
         cfg = [  # (in, out, stride)
             (c(32), c(64), 1), (c(64), c(128), 2), (c(128), c(128), 1),
             (c(128), c(256), 2), (c(256), c(256), 1),
@@ -55,10 +66,12 @@ class MobileNetV1(nn.Layer):
             *[(c(512), c(512), 1)] * 5,
             (c(512), c(1024), 2), (c(1024), c(1024), 1),
         ]
-        self.stem = _conv_bn(3, c(32), 3, stride=2, padding=1)
+        self.stem = _conv_bn(3, c(32), 3, stride=2, padding=1,
+                             data_format=df)
         self.blocks = nn.Sequential(
-            *[_DepthwiseSeparable(i, o, s) for i, o, s in cfg])
-        self.pool = nn.AdaptiveAvgPool2D(1)
+            *[_DepthwiseSeparable(i, o, s, data_format=df)
+              for i, o, s in cfg])
+        self.pool = nn.AdaptiveAvgPool2D(1, data_format=df)
         self.fc = nn.Linear(c(1024), num_classes)
 
     def forward(self, x):
@@ -72,17 +85,19 @@ class _InvertedResidual(nn.Layer):
     project, with a linear bottleneck and residual when shapes allow."""
 
     def __init__(self, in_c: int, out_c: int, stride: int,
-                 expand: int) -> None:
+                 expand: int, data_format: str = "NCHW") -> None:
         super().__init__()
+        df = data_format
         hidden = in_c * expand
         self.use_res = stride == 1 and in_c == out_c
         layers = []
         if expand != 1:
-            layers.append(_conv_bn(in_c, hidden, 1))
+            layers.append(_conv_bn(in_c, hidden, 1, data_format=df))
         layers.append(_conv_bn(hidden, hidden, 3, stride=stride,
-                               padding=1, groups=hidden))
-        layers.append(nn.Conv2D(hidden, out_c, 1, bias_attr=False))
-        layers.append(nn.BatchNorm2D(out_c))  # linear bottleneck: no act
+                               padding=1, groups=hidden, data_format=df))
+        layers.append(nn.Conv2D(hidden, out_c, 1, bias_attr=False,
+                                data_format=df))
+        layers.append(nn.BatchNorm2D(out_c, data_format=df))
         self.conv = nn.Sequential(*layers)
 
     def forward(self, x):
@@ -97,25 +112,31 @@ class MobileNetV2(nn.Layer):
     _CFG = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
             (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
 
-    def __init__(self, num_classes: int = 1000,
-                 scale: float = 1.0) -> None:
+    def __init__(self, num_classes: int = 1000, scale: float = 1.0,
+                 data_format: str = "NCHW") -> None:
         super().__init__()
+        if data_format not in ("NCHW", "NHWC"):
+            raise ValueError(f"data_format must be NCHW or NHWC, got "
+                             f"{data_format!r}")
 
         def c(ch: int) -> int:
             return max(int(ch * scale), 8)
 
+        df = data_format
         in_c = c(32)
-        self.stem = _conv_bn(3, in_c, 3, stride=2, padding=1)
+        self.stem = _conv_bn(3, in_c, 3, stride=2, padding=1,
+                             data_format=df)
         blocks = []
         for expand, out, reps, stride in self._CFG:
             for r in range(reps):
                 blocks.append(_InvertedResidual(
-                    in_c, c(out), stride if r == 0 else 1, expand))
+                    in_c, c(out), stride if r == 0 else 1, expand,
+                    data_format=df))
                 in_c = c(out)
         self.blocks = nn.Sequential(*blocks)
         last = max(c(1280), 1280) if scale > 1.0 else 1280
-        self.head = _conv_bn(in_c, last, 1)
-        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.head = _conv_bn(in_c, last, 1, data_format=df)
+        self.pool = nn.AdaptiveAvgPool2D(1, data_format=df)
         self.fc = nn.Linear(last, num_classes)
 
     def forward(self, x):
@@ -124,9 +145,13 @@ class MobileNetV2(nn.Layer):
         return self.fc(h)
 
 
-def mobilenet_v1(num_classes: int = 1000, scale: float = 1.0):
-    return MobileNetV1(num_classes=num_classes, scale=scale)
+def mobilenet_v1(num_classes: int = 1000, scale: float = 1.0,
+                 data_format: str = "NCHW"):
+    return MobileNetV1(num_classes=num_classes, scale=scale,
+                       data_format=data_format)
 
 
-def mobilenet_v2(num_classes: int = 1000, scale: float = 1.0):
-    return MobileNetV2(num_classes=num_classes, scale=scale)
+def mobilenet_v2(num_classes: int = 1000, scale: float = 1.0,
+                 data_format: str = "NCHW"):
+    return MobileNetV2(num_classes=num_classes, scale=scale,
+                       data_format=data_format)

@@ -3,6 +3,10 @@
 Capability parity with the reference's hapi vision model
 (/root/reference/python/paddle/incubate/hapi/vision/models/vgg.py —
 same make_layers config strings, optional batch norm).
+``data_format="NHWC"`` runs the conv stack channels-last; the pooled
+features are transposed back to channel-first order before the
+classifier flatten so the fc weights (and checkpoints) are identical
+across layouts.
 """
 
 from __future__ import annotations
@@ -24,17 +28,19 @@ _CFGS = {
 }
 
 
-def _make_layers(cfg: List[Union[int, str]],
-                 batch_norm: bool) -> nn.Layer:
+def _make_layers(cfg: List[Union[int, str]], batch_norm: bool,
+                 data_format: str = "NCHW") -> nn.Layer:
     layers: list = []
     in_c = 3
     for v in cfg:
         if v == "M":
-            layers.append(nn.MaxPool2D(kernel_size=2, stride=2))
+            layers.append(nn.MaxPool2D(kernel_size=2, stride=2,
+                                       data_format=data_format))
             continue
-        layers.append(nn.Conv2D(in_c, v, 3, padding=1))
+        layers.append(nn.Conv2D(in_c, v, 3, padding=1,
+                                data_format=data_format))
         if batch_norm:
-            layers.append(nn.BatchNorm2D(v))
+            layers.append(nn.BatchNorm2D(v, data_format=data_format))
         layers.append(nn.ReLU())
         in_c = v
     return nn.Sequential(*layers)
@@ -44,10 +50,15 @@ class VGG(nn.Layer):
     """(ref: hapi/vision/models/vgg.py VGG)."""
 
     def __init__(self, features: nn.Layer, num_classes: int = 1000,
-                 dropout: float = 0.5) -> None:
+                 dropout: float = 0.5,
+                 data_format: str = "NCHW") -> None:
         super().__init__()
+        if data_format not in ("NCHW", "NHWC"):
+            raise ValueError(f"data_format must be NCHW or NHWC, got "
+                             f"{data_format!r}")
+        self.data_format = data_format
         self.features = features
-        self.pool = nn.AdaptiveAvgPool2D(7)
+        self.pool = nn.AdaptiveAvgPool2D(7, data_format=data_format)
         self.classifier = nn.Sequential(
             nn.Linear(512 * 7 * 7, 4096), nn.ReLU(), nn.Dropout(dropout),
             nn.Linear(4096, 4096), nn.ReLU(), nn.Dropout(dropout),
@@ -56,25 +67,34 @@ class VGG(nn.Layer):
 
     def forward(self, x):
         h = self.pool(self.features(x))
+        if self.data_format == "NHWC":
+            # channel-first flatten order so the classifier weights
+            # match NCHW checkpoints exactly (tiny [B,7,7,512] transpose)
+            h = h.transpose((0, 3, 1, 2))
         return self.classifier(h.reshape((x.shape[0], -1)))
 
 
-def _vgg(cfg: str, batch_norm: bool, num_classes: int) -> VGG:
-    return VGG(_make_layers(_CFGS[cfg], batch_norm),
-               num_classes=num_classes)
+def _vgg(cfg: str, batch_norm: bool, num_classes: int,
+         data_format: str = "NCHW") -> VGG:
+    return VGG(_make_layers(_CFGS[cfg], batch_norm, data_format),
+               num_classes=num_classes, data_format=data_format)
 
 
-def vgg11(num_classes: int = 1000, batch_norm: bool = False) -> VGG:
-    return _vgg("A", batch_norm, num_classes)
+def vgg11(num_classes: int = 1000, batch_norm: bool = False,
+          data_format: str = "NCHW") -> VGG:
+    return _vgg("A", batch_norm, num_classes, data_format)
 
 
-def vgg13(num_classes: int = 1000, batch_norm: bool = False) -> VGG:
-    return _vgg("B", batch_norm, num_classes)
+def vgg13(num_classes: int = 1000, batch_norm: bool = False,
+          data_format: str = "NCHW") -> VGG:
+    return _vgg("B", batch_norm, num_classes, data_format)
 
 
-def vgg16(num_classes: int = 1000, batch_norm: bool = False) -> VGG:
-    return _vgg("D", batch_norm, num_classes)
+def vgg16(num_classes: int = 1000, batch_norm: bool = False,
+          data_format: str = "NCHW") -> VGG:
+    return _vgg("D", batch_norm, num_classes, data_format)
 
 
-def vgg19(num_classes: int = 1000, batch_norm: bool = False) -> VGG:
-    return _vgg("E", batch_norm, num_classes)
+def vgg19(num_classes: int = 1000, batch_norm: bool = False,
+          data_format: str = "NCHW") -> VGG:
+    return _vgg("E", batch_norm, num_classes, data_format)

@@ -257,17 +257,19 @@ def adaptive_avg_pool2d(x, output_size: IntOrPair,
     if h % oh == 0 and w % ow == 0:
         return jnp.mean(x.reshape(n, c, oh, h // oh, ow, w // ow),
                         axis=(3, 5))
-    # General case: mean over variable windows via interpolation-style bins
-    out = jnp.zeros((n, c, oh, ow), dtype=x.dtype)
-    rows = [(h * i) // oh for i in range(oh + 1)]
-    cols = [(w * j) // ow for j in range(ow + 1)]
+    # General case: mean over variable windows. Reference bin math
+    # (adaptive_pool: start=floor(i*H/out), end=ceil((i+1)*H/out)) —
+    # bins are never empty, so output_size > input repeats values
+    # instead of producing NaN means over empty slices.
+    rows = [((h * i) // oh, -(-(h * (i + 1)) // oh))
+            for i in range(oh)]
+    cols = [((w * j) // ow, -(-(w * (j + 1)) // ow))
+            for j in range(ow)]
     parts = []
-    for i in range(oh):
+    for r0, r1 in rows:
         row = []
-        for j in range(ow):
-            row.append(jnp.mean(
-                x[:, :, rows[i]:rows[i + 1], cols[j]:cols[j + 1]],
-                axis=(2, 3)))
+        for c0, c1 in cols:
+            row.append(jnp.mean(x[:, :, r0:r1, c0:c1], axis=(2, 3)))
         parts.append(jnp.stack(row, axis=-1))
     return jnp.stack(parts, axis=-2)
 
@@ -278,15 +280,14 @@ def adaptive_max_pool2d(x, output_size: IntOrPair):
     if h % oh == 0 and w % ow == 0:
         return jnp.max(x.reshape(n, c, oh, h // oh, ow, w // ow),
                        axis=(3, 5))
-    rows = [(h * i) // oh for i in range(oh + 1)]
-    cols = [(w * j) // ow for j in range(ow + 1)]
+    # non-empty reference bins (floor/ceil), as in adaptive_avg_pool2d
+    rows = [((h * i) // oh, -(-(h * (i + 1)) // oh)) for i in range(oh)]
+    cols = [((w * j) // ow, -(-(w * (j + 1)) // ow)) for j in range(ow)]
     parts = []
-    for i in range(oh):
+    for r0, r1 in rows:
         row = []
-        for j in range(ow):
-            row.append(jnp.max(
-                x[:, :, rows[i]:rows[i + 1], cols[j]:cols[j + 1]],
-                axis=(2, 3)))
+        for c0, c1 in cols:
+            row.append(jnp.max(x[:, :, r0:r1, c0:c1], axis=(2, 3)))
         parts.append(jnp.stack(row, axis=-1))
     return jnp.stack(parts, axis=-2)
 

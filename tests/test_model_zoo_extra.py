@@ -458,3 +458,65 @@ def test_resnet_nhwc_trains():
     np.testing.assert_allclose(losses["NCHW"], losses["NHWC"],
                                rtol=5e-3)
     assert losses["NHWC"][-1] < losses["NHWC"][0]
+
+
+def test_mobilenet_vgg_nhwc_match_nchw():
+    """Channels-last MobileNetV1/V2 and VGG compute the same function
+    as NCHW with identical (OIHW) weights; VGG's classifier flatten is
+    order-corrected so fc weights match NCHW checkpoints exactly."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.mobilenet import mobilenet_v1, mobilenet_v2
+    from paddle_tpu.models.vgg import vgg11
+
+    import pytest as _pytest
+
+    rng = np.random.default_rng(3)
+    # VGG's classifier flattens a 7x7x512 map, so its input must reach
+    # 7x7 after five stride-2 pools (224) for the layout-order check to
+    # be non-vacuous; mobilenets flatten [B,1,1,C] and can stay tiny.
+    x_small = rng.normal(0, 1, (2, 3, 32, 32)).astype(np.float32)
+    x_vgg = rng.normal(0, 1, (1, 3, 224, 224)).astype(np.float32)
+    for ctor, kw, x in (
+            (mobilenet_v1, dict(scale=0.25, num_classes=7), x_small),
+            (mobilenet_v2, dict(scale=0.25, num_classes=7), x_small),
+            (vgg11, dict(num_classes=7, batch_norm=True), x_vgg)):
+        pt.seed(0)
+        m1 = ctor(**kw)
+        pt.seed(0)
+        m2 = ctor(**kw, data_format="NHWC")
+        sd1, sd2 = m1.state_dict(), m2.state_dict()
+        assert set(sd1) == set(sd2)
+        for k in sd1:
+            np.testing.assert_array_equal(np.asarray(sd1[k]),
+                                          np.asarray(sd2[k]))
+        m1.eval()
+        m2.eval()
+        y1 = np.asarray(m1(x))
+        assert np.isfinite(y1).all()  # guards a vacuous NaN==NaN pass
+        np.testing.assert_array_equal(
+            y1, np.asarray(m2(np.transpose(x, (0, 2, 3, 1)))))
+    with _pytest.raises(ValueError, match="NCHW or NHWC"):
+        mobilenet_v1(data_format="NHCW")
+    with _pytest.raises(ValueError, match="NCHW or NHWC"):
+        vgg11(data_format="NHCW")
+
+
+def test_adaptive_pool_upsample_no_nan():
+    """output_size > input must repeat values via non-empty reference
+    bins (floor/ceil), not produce NaN means over empty slices."""
+    from paddle_tpu.ops.nn_functional import (adaptive_avg_pool2d,
+                                              adaptive_max_pool2d)
+    import numpy as np
+    x = np.full((1, 2, 1, 1), 3.5, np.float32)
+    up = np.asarray(adaptive_avg_pool2d(x, 7))
+    assert up.shape == (1, 2, 7, 7)
+    np.testing.assert_array_equal(up, 3.5)
+    np.testing.assert_array_equal(
+        np.asarray(adaptive_max_pool2d(x, 3)), 3.5)
+    # non-divisible downsample still averages correct windows
+    y = np.arange(5, dtype=np.float32).reshape(1, 1, 1, 5)
+    got = np.asarray(adaptive_avg_pool2d(y, (1, 2)))
+    # bins: [0,3) and [2,5) per floor/ceil math
+    np.testing.assert_allclose(got[0, 0, 0], [1.0, 3.0])
