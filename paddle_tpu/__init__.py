@@ -42,6 +42,8 @@ from .distributed import fleet  # noqa: F401
 from . import distributed  # noqa: F401
 from . import incubate  # noqa: F401
 from . import slim  # noqa: F401
+from . import fluid  # noqa: F401  (migration namespace; must be last)
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 
 # grad / no_grad utilities (dygraph parity)
 from .autograd import grad, no_grad, value_and_grad  # noqa: F401

@@ -1,0 +1,13 @@
+"""``fluid.backward`` (ref: python/paddle/fluid/backward.py) —
+autodiff is a functional transform in the TPU design;
+``gradients``/``append_backward`` map to ``paddle_tpu.autograd``."""
+
+from ..autograd import grad as gradients  # noqa: F401
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    raise NotImplementedError(
+        "append_backward records grad ops into a Program; in the "
+        "tracing design use jax.value_and_grad (or "
+        "paddle_tpu.static.TrainStep, which builds the whole "
+        "forward+backward+update program)")

@@ -1,0 +1,39 @@
+"""``fluid.core`` compatibility surface (ref: paddle/fluid/pybind/ —
+the reference's C++ binding module). The handful of names user code
+touches route to their TPU-native homes."""
+
+from ..core.place import CPUPlace, CUDAPlace, TPUPlace  # noqa: F401
+from ..static import Scope  # noqa: F401
+
+CUDAPinnedPlace = CPUPlace  # host staging is arena-managed here
+
+
+def globals():  # noqa: A001  (reference spelling)
+    """(ref: pybind global_var_getter) zero-arg mapping over the flag
+    registry: ``core.globals()['FLAGS_check_nan_inf']``."""
+    from ..flags import GLOBAL_FLAGS
+    return _FlagsView(GLOBAL_FLAGS)
+
+
+class _FlagsView:
+    def __init__(self, registry) -> None:
+        self._r = registry
+
+    def _key(self, name: str) -> str:
+        return name[6:] if name.startswith("FLAGS_") else name
+
+    def __getitem__(self, name: str):
+        return self._r.get(self._key(name))
+
+    def __setitem__(self, name: str, value) -> None:
+        self._r.set(self._key(name), value)
+
+    def __contains__(self, name: str) -> bool:
+        try:
+            self._r.get(self._key(name))
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+    def keys(self):
+        return self._r.names()

@@ -1,0 +1,7 @@
+"""``fluid.executor`` submodule spelling (ref:
+python/paddle/fluid/executor.py) — the real implementations live in
+``paddle_tpu.static``; ``from paddle_tpu.fluid.executor import
+Executor`` ports unchanged."""
+
+from ..static import Executor, global_scope  # noqa: F401
+from . import scope_guard  # noqa: F401

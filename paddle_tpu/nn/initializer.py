@@ -181,9 +181,27 @@ class Orthogonal(Initializer):
             key, tuple(shape), convert_dtype(dtype))
 
 
+def make_param(attr, default: "Initializer", shape, dtype):
+    """Resolve ``attr`` (initializer / number / callable / ParamAttr)
+    and build the Parameter, honoring ParamAttr's per-parameter
+    metadata (trainable / name / regularizer / need_clip) — a frozen
+    ``ParamAttr(trainable=False)`` must actually freeze the weight."""
+    from .layer import Parameter
+    value = _resolve(attr, default)(shape, dtype)
+    if hasattr(attr, "initializer"):  # ParamAttr-like
+        return Parameter(value,
+                         trainable=getattr(attr, "trainable", True),
+                         name=getattr(attr, "name", None),
+                         regularizer=getattr(attr, "regularizer", None),
+                         need_clip=getattr(attr, "need_clip", True))
+    return Parameter(value)
+
+
 def _resolve(init, default: Initializer) -> Initializer:
     if init is None:
         return default
+    if hasattr(init, "initializer"):  # ParamAttr / WeightNormParamAttr
+        return _resolve(init.initializer, default)
     if isinstance(init, Initializer):
         return init
     if isinstance(init, (int, float)):

@@ -27,15 +27,15 @@ class Linear(Layer):
         super().__init__()
         self.in_features = in_features
         self.out_features = out_features
-        w_init = I._resolve(weight_attr, I.XavierUniform())
-        self.weight = Parameter(
-            w_init((in_features, out_features), get_default_dtype()))
+        self.weight = I.make_param(weight_attr, I.XavierUniform(),
+                                   (in_features, out_features),
+                                   get_default_dtype())
         if bias_attr is False:
             self.bias = None
         else:
-            b_init = I._resolve(bias_attr, I.Constant(0.0))
-            self.bias = Parameter(b_init((out_features,),
-                                         get_default_dtype()))
+            self.bias = I.make_param(bias_attr, I.Constant(0.0),
+                                     (out_features,),
+                                     get_default_dtype())
 
     def forward(self, x):
         return F.linear(x, self.weight,
@@ -53,9 +53,9 @@ class Embedding(Layer):
         self.embedding_dim = embedding_dim
         self.padding_idx = padding_idx
         self.sparse = sparse
-        w_init = I._resolve(weight_attr, I.XavierNormal())
-        self.weight = Parameter(
-            w_init((num_embeddings, embedding_dim), get_default_dtype()))
+        self.weight = I.make_param(weight_attr, I.XavierNormal(),
+                                   (num_embeddings, embedding_dim),
+                                   get_default_dtype())
 
     def forward(self, x):
         return F.embedding(x, self.weight, self.padding_idx)
@@ -151,15 +151,16 @@ class Bilinear(Layer):
                  out_features: int, weight_attr=None,
                  bias_attr=None) -> None:
         super().__init__()
-        w_init = I._resolve(weight_attr, I.XavierUniform())
-        self.weight = Parameter(w_init(
-            (out_features, in1_features, in2_features), get_default_dtype()))
+        self.weight = I.make_param(
+            weight_attr, I.XavierUniform(),
+            (out_features, in1_features, in2_features),
+            get_default_dtype())
         if bias_attr is False:
             pass
         else:
-            b_init = I._resolve(bias_attr, I.Constant(0.0))
-            self.bias = Parameter(b_init((out_features,),
-                                         get_default_dtype()))
+            self.bias = I.make_param(bias_attr, I.Constant(0.0),
+                                     (out_features,),
+                                     get_default_dtype())
 
     def forward(self, x1, x2):
         from ...ops.math import bilinear_tensor_product

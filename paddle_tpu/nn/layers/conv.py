@@ -39,20 +39,20 @@ class _ConvNd(Layer):
         self.dilation = dilation
         self.groups = groups
         self.data_format = data_format
-        w_init = I._resolve(weight_attr, I.KaimingUniform())
         if transpose:
             w_shape = (in_channels, out_channels // groups) \
                 + self.kernel_size
         else:
             w_shape = (out_channels, in_channels // groups) \
                 + self.kernel_size
-        self.weight = Parameter(w_init(w_shape, get_default_dtype()))
+        self.weight = I.make_param(weight_attr, I.KaimingUniform(),
+                                   w_shape, get_default_dtype())
         if bias_attr is False:
             self.bias = None
         else:
-            b_init = I._resolve(bias_attr, I.Constant(0.0))
-            self.bias = Parameter(b_init((out_channels,),
-                                         get_default_dtype()))
+            self.bias = I.make_param(bias_attr, I.Constant(0.0),
+                                     (out_channels,),
+                                     get_default_dtype())
 
     def _bias(self):
         return self.bias if "bias" in self._parameters else None

@@ -37,13 +37,13 @@ class _BatchNormBase(Layer):
         if weight_attr is False:
             self.weight = None
         else:
-            self.weight = Parameter(
-                I._resolve(weight_attr, I.Constant(1.0))((num_features,), dt))
+            self.weight = I.make_param(weight_attr, I.Constant(1.0),
+                             (num_features,), dt)
         if bias_attr is False:
             self.bias = None
         else:
-            self.bias = Parameter(
-                I._resolve(bias_attr, I.Constant(0.0))((num_features,), dt))
+            self.bias = I.make_param(bias_attr, I.Constant(0.0),
+                             (num_features,), dt)
         self.register_buffer("_mean", jnp.zeros((num_features,), dt))
         self.register_buffer("_variance", jnp.ones((num_features,), dt))
 
@@ -116,15 +116,13 @@ class LayerNorm(Layer):
         if weight_attr is False:
             self.weight = None
         else:
-            self.weight = Parameter(
-                I._resolve(weight_attr, I.Constant(1.0))(
-                    self.normalized_shape, dt))
+            self.weight = I.make_param(weight_attr, I.Constant(1.0),
+                             self.normalized_shape, dt)
         if bias_attr is False:
             self.bias = None
         else:
-            self.bias = Parameter(
-                I._resolve(bias_attr, I.Constant(0.0))(
-                    self.normalized_shape, dt))
+            self.bias = I.make_param(bias_attr, I.Constant(0.0),
+                             self.normalized_shape, dt)
 
     def forward(self, x):
         w = self.weight if "weight" in self._parameters else None
@@ -142,13 +140,13 @@ class InstanceNorm2D(Layer):
         if weight_attr is False:
             self.weight = None
         else:
-            self.weight = Parameter(
-                I._resolve(weight_attr, I.Constant(1.0))((num_features,), dt))
+            self.weight = I.make_param(weight_attr, I.Constant(1.0),
+                             (num_features,), dt)
         if bias_attr is False:
             self.bias = None
         else:
-            self.bias = Parameter(
-                I._resolve(bias_attr, I.Constant(0.0))((num_features,), dt))
+            self.bias = I.make_param(bias_attr, I.Constant(0.0),
+                             (num_features,), dt)
         self.epsilon = epsilon
 
     def forward(self, x):
@@ -172,13 +170,13 @@ class GroupNorm(Layer):
         if weight_attr is False:
             self.weight = None
         else:
-            self.weight = Parameter(
-                I._resolve(weight_attr, I.Constant(1.0))((num_channels,), dt))
+            self.weight = I.make_param(weight_attr, I.Constant(1.0),
+                             (num_channels,), dt)
         if bias_attr is False:
             self.bias = None
         else:
-            self.bias = Parameter(
-                I._resolve(bias_attr, I.Constant(0.0))((num_channels,), dt))
+            self.bias = I.make_param(bias_attr, I.Constant(0.0),
+                             (num_channels,), dt)
 
     def forward(self, x):
         w = self.weight if "weight" in self._parameters else None

@@ -43,16 +43,14 @@ class LSTMCell(RNNCell):
         dt = get_default_dtype()
         k = 1.0 / (hidden_size ** 0.5)
         init = I.Uniform(-k, k)
-        self.weight_ih = Parameter(
-            I._resolve(weight_ih_attr, init)((input_size, 4 * hidden_size),
-                                             dt))
-        self.weight_hh = Parameter(
-            I._resolve(weight_hh_attr, init)((hidden_size, 4 * hidden_size),
-                                             dt))
-        self.bias_ih = Parameter(
-            I._resolve(bias_ih_attr, init)((4 * hidden_size,), dt))
-        self.bias_hh = Parameter(
-            I._resolve(bias_hh_attr, init)((4 * hidden_size,), dt))
+        self.weight_ih = I.make_param(
+            weight_ih_attr, init, (input_size, 4 * hidden_size), dt)
+        self.weight_hh = I.make_param(
+            weight_hh_attr, init, (hidden_size, 4 * hidden_size), dt)
+        self.bias_ih = I.make_param(bias_ih_attr, init,
+                                    (4 * hidden_size,), dt)
+        self.bias_hh = I.make_param(bias_hh_attr, init,
+                                    (4 * hidden_size,), dt)
 
     def forward(self, x, states: Optional[Tuple] = None):
         if states is None:

@@ -235,10 +235,11 @@ def avg_pool3d(x, kernel_size: IntOrPair, stride: Optional[IntOrPair] = None,
 def pool2d(x, pool_size: IntOrPair = -1, pool_type: str = "max",
            pool_stride: IntOrPair = 1, pool_padding: IntOrPair = 0,
            global_pooling: bool = False, ceil_mode: bool = False,
-           exclusive: bool = True):
+           exclusive: bool = True, data_format: str = "NCHW"):
     """Legacy fluid.layers.pool2d signature (ref: pool_op.cc)."""
     return _pool(x, pool_type, pool_size, pool_stride, pool_padding,
-                 ceil_mode, exclusive, 2, global_pooling)
+                 ceil_mode, exclusive, 2, global_pooling,
+                 channels_last=data_format == "NHWC")
 
 
 def adaptive_avg_pool2d(x, output_size: IntOrPair,

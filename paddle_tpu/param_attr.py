@@ -1,0 +1,47 @@
+"""ParamAttr (ref: python/paddle/fluid/param_attr.py:30).
+
+The reference attaches per-parameter config (name, initializer,
+regularizer, learning_rate, trainable, gradient clip) to LayerHelper
+parameter creation. In the TPU-native design the layer system owns
+naming and the optimizer owns regularization/clipping globally, so
+``ParamAttr`` carries the pieces that still have per-parameter meaning
+here — the initializer above all — and documents where the rest moved.
+``nn.initializer._resolve`` accepts a ParamAttr anywhere a
+``weight_attr``/``bias_attr`` is taken, so fluid-style call sites
+(``param_attr=fluid.ParamAttr(initializer=...)``) port unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class ParamAttr:
+    def __init__(self, name: Optional[str] = None, initializer=None,
+                 learning_rate: float = 1.0, regularizer=None,
+                 trainable: bool = True, do_model_average: bool = False,
+                 need_clip: bool = True) -> None:
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    def __repr__(self) -> str:  # debugging aid
+        return (f"ParamAttr(name={self.name!r}, "
+                f"initializer={self.initializer!r}, "
+                f"learning_rate={self.learning_rate}, "
+                f"trainable={self.trainable})")
+
+
+class WeightNormParamAttr(ParamAttr):
+    """(ref: param_attr.py:216) — weight-norm reparameterization is a
+    training-time transform here: use ``nn.utils.weight_norm`` on the
+    layer instead of a creation-time attr; this class is accepted (its
+    initializer is honored) so imports don't break."""
+
+    def __init__(self, dim: Optional[int] = None, **kw: Any) -> None:
+        super().__init__(**kw)
+        self.dim = dim

@@ -141,7 +141,13 @@ class Executor:
     def __init__(self, place=None) -> None:
         from ..core.place import get_device
         self.place = place if place is not None else get_device()
-        self.scope = global_scope()
+
+    @property
+    def scope(self) -> Scope:
+        # resolved at ACCESS time, not construction: fluid.scope_guard
+        # must cover Executors built before the guard (the reference
+        # executor reads the global scope per run, executor.py:1089)
+        return global_scope()
 
     def run(self, program: Program, feed: Optional[Dict[str, Any]] = None,
             fetch_list: Optional[Sequence[str]] = None,
