@@ -1,0 +1,42 @@
+"""Book ch.1 — fit a line: linear regression on UCI Housing
+(ref: python/paddle/fluid/tests/book/test_fit_a_line.py).
+
+Run: python examples/fit_a_line.py [--real-data]
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def main(epochs: int = 30, synthetic: bool = True, verbose: bool = True):
+    import paddle_tpu as pt
+    from paddle_tpu.datasets import UCIHousing
+    from paddle_tpu.static import TrainStep
+
+    ds = UCIHousing(mode="synthetic" if synthetic else "train")
+    x = np.stack([ds[i][0] for i in range(len(ds))]).astype(np.float32)
+    y = np.stack([ds[i][1] for i in range(len(ds))]).astype(np.float32)
+    # feature standardization like the reference's preprocessing
+    x = (x - x.mean(0)) / (x.std(0) + 1e-6)
+
+    pt.seed(0)
+    model = pt.nn.Linear(13, 1)
+    step = TrainStep(model, pt.optimizer.SGD(learning_rate=0.05),
+                     lambda out, t: ((out - t) ** 2).mean())
+    losses = []
+    for _ in range(epochs):
+        losses.append(float(step(x, labels=y)["loss"]))
+    if verbose:
+        print(f"fit_a_line: mse {losses[0]:.4f} -> {losses[-1]:.4f} "
+              f"over {epochs} epochs")
+    return {"first_loss": losses[0], "last_loss": losses[-1]}
+
+
+if __name__ == "__main__":
+    import argparse
+    p = argparse.ArgumentParser()
+    p.add_argument("--real-data", action="store_true")
+    p.add_argument("--epochs", type=int, default=30)
+    a = p.parse_args()
+    main(epochs=a.epochs, synthetic=not a.real_data)

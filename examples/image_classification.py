@@ -1,0 +1,54 @@
+"""Book ch.3 — image classification: ResNet on Cifar10
+(ref: python/paddle/fluid/tests/book/test_image_classification.py).
+
+On TPU use data_format="NHWC" (channels-last keeps the feature dim on
+the MXU lane axis; see README round-3 notes). Run:
+python examples/image_classification.py [--real-data] [--nhwc]
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def main(steps: int = 20, synthetic: bool = True, nhwc: bool = False,
+         verbose: bool = True):
+    import paddle_tpu as pt
+    from paddle_tpu.datasets import Cifar10
+    from paddle_tpu.models.resnet import ResNet, BasicBlock
+    from paddle_tpu.static import TrainStep
+
+    ds = Cifar10(mode="synthetic" if synthetic else "train")
+    n = min(len(ds), 128)
+    x = np.stack([np.asarray(ds[i][0]) for i in range(n)])
+    y = np.asarray([int(ds[i][1]) for i in range(n)], np.int64)
+    df = "NHWC" if nhwc else "NCHW"
+    if nhwc:
+        x = np.transpose(x, (0, 2, 3, 1))
+
+    pt.seed(0)
+    model = ResNet(BasicBlock, [1, 1, 1, 1], num_classes=10,
+                   data_format=df)
+    step = TrainStep(model, pt.optimizer.Momentum(learning_rate=0.02,
+                                                  momentum=0.9),
+                     lambda out, t: pt.nn.functional.cross_entropy(
+                         out, t))
+    losses = []
+    for i in range(steps):
+        b = (i * 32) % max(1, n - 32)
+        losses.append(float(step(x[b:b + 32],
+                                 labels=y[b:b + 32])["loss"]))
+    if verbose:
+        print(f"image_classification[{df}]: loss "
+              f"{losses[0]:.3f} -> {losses[-1]:.3f}")
+    return {"first_loss": losses[0], "last_loss": losses[-1]}
+
+
+if __name__ == "__main__":
+    import argparse
+    p = argparse.ArgumentParser()
+    p.add_argument("--real-data", action="store_true")
+    p.add_argument("--nhwc", action="store_true")
+    p.add_argument("--steps", type=int, default=20)
+    a = p.parse_args()
+    main(steps=a.steps, synthetic=not a.real_data, nhwc=a.nhwc)

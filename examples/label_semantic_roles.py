@@ -1,0 +1,55 @@
+"""Book ch.7 — label semantic roles: BiLSTM-CRF on CoNLL-05
+(ref: python/paddle/fluid/tests/book/test_label_semantic_roles.py).
+
+Run: python examples/label_semantic_roles.py [--real-data]
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def main(steps: int = 25, synthetic: bool = True, verbose: bool = True):
+    import paddle_tpu as pt
+    from paddle_tpu.datasets import Conll05
+    from paddle_tpu.models import SRLBiLSTMCRF
+    from paddle_tpu.static import TrainStep
+
+    ds = Conll05(mode="synthetic" if synthetic else "test")
+    n = min(len(ds), 32)
+    words = np.stack([ds[i][0] for i in range(n)]).astype(np.int32)
+    marks = np.stack([ds[i][1] for i in range(n)]).astype(np.int32)
+    tags = np.stack([ds[i][2] for i in range(n)]).astype(np.int32)
+    lens = np.asarray([int(ds[i][3]) for i in range(n)], np.int32)
+    vocab = int(words.max()) + 1
+    n_tags = int(tags.max()) + 1
+
+    pt.seed(0)
+    model = SRLBiLSTMCRF(vocab, n_tags, embed_dim=32, hidden=32,
+                         num_layers=1)
+
+    class Net(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.inner = model
+
+        def forward(self, w, m, t, ln):
+            return self.inner.loss(w, m, t, ln)
+
+    step = TrainStep(Net(), pt.optimizer.Adam(learning_rate=5e-3),
+                     lambda out: out)
+    losses = [float(step(words, marks, tags, lens, labels=())["loss"])
+              for _ in range(steps)]
+    if verbose:
+        print(f"label_semantic_roles: crf-nll {losses[0]:.3f} -> "
+              f"{losses[-1]:.3f}")
+    return {"first_loss": losses[0], "last_loss": losses[-1]}
+
+
+if __name__ == "__main__":
+    import argparse
+    p = argparse.ArgumentParser()
+    p.add_argument("--real-data", action="store_true")
+    p.add_argument("--steps", type=int, default=25)
+    a = p.parse_args()
+    main(steps=a.steps, synthetic=not a.real_data)

@@ -1,0 +1,39 @@
+"""Book ch.2 — recognize digits: LeNet on MNIST via the hapi Model API
+(ref: python/paddle/fluid/tests/book/test_recognize_digits.py).
+
+Run: python examples/recognize_digits.py [--real-data]
+"""
+
+from __future__ import annotations
+
+
+def main(epochs: int = 2, synthetic: bool = True, verbose: bool = True):
+    import paddle_tpu as pt
+    from paddle_tpu.datasets import MNIST
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.models import LeNet
+
+    ds = MNIST(mode="synthetic" if synthetic else "train")
+    loader = pt.data.DataLoader(ds, batch_size=64, shuffle=True)
+
+    pt.seed(0)
+    m = Model(LeNet())
+    m.prepare(optimizer=pt.optimizer.Adam(learning_rate=1e-3),
+              loss=pt.nn.CrossEntropyLoss(),
+              metrics=[pt.metric.Accuracy()])
+    hist = m.fit(loader, epochs=epochs, verbose=1 if verbose else 0)
+    res = m.evaluate(loader, verbose=0)
+    if verbose:
+        print(f"recognize_digits: loss {hist['loss'][-1]:.4f} "
+              f"eval_acc {res['eval_accuracy']:.3f}")
+    return {"last_loss": hist["loss"][-1],
+            "eval_accuracy": res["eval_accuracy"]}
+
+
+if __name__ == "__main__":
+    import argparse
+    p = argparse.ArgumentParser()
+    p.add_argument("--real-data", action="store_true")
+    p.add_argument("--epochs", type=int, default=2)
+    a = p.parse_args()
+    main(epochs=a.epochs, synthetic=not a.real_data)

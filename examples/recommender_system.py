@@ -1,0 +1,55 @@
+"""Book ch.5 — recommender system: dual-tower rating model on
+MovieLens (ref: python/paddle/fluid/tests/book/
+test_recommender_system.py).
+
+Run: python examples/recommender_system.py [--real-data]
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def main(steps: int = 40, synthetic: bool = True, verbose: bool = True):
+    import paddle_tpu as pt
+    from paddle_tpu.datasets import Movielens
+    from paddle_tpu.models import RecommenderSystem
+    from paddle_tpu.static import TrainStep
+
+    ds = Movielens(mode="synthetic" if synthetic else "train")
+    rows = np.stack([ds[i][0] for i in range(len(ds))]).astype(np.int32)
+    ratings = np.stack([ds[i][1] for i in range(len(ds))]) \
+        .astype(np.float32)
+    users, movies = rows[:, :4], rows[:, 4:]
+
+    pt.seed(0)
+    model = RecommenderSystem(
+        n_users=int(rows[:, 0].max()) + 1,
+        n_movies=int(rows[:, 4].max()) + 1,
+        embed_dim=16, hidden=64)
+
+    class Net(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.inner = model
+
+        def forward(self, u, mv, r):
+            return self.inner.loss(u, mv, r)
+
+    step = TrainStep(Net(), pt.optimizer.Adam(learning_rate=2e-3),
+                     lambda out: out)
+    losses = [float(step(users, movies, ratings, labels=())["loss"])
+              for _ in range(steps)]
+    if verbose:
+        print(f"recommender_system: mse {losses[0]:.3f} -> "
+              f"{losses[-1]:.3f}")
+    return {"first_loss": losses[0], "last_loss": losses[-1]}
+
+
+if __name__ == "__main__":
+    import argparse
+    p = argparse.ArgumentParser()
+    p.add_argument("--real-data", action="store_true")
+    p.add_argument("--steps", type=int, default=40)
+    a = p.parse_args()
+    main(steps=a.steps, synthetic=not a.real_data)

@@ -1,0 +1,52 @@
+"""Book ch.6 — understand sentiment: BiLSTM classifier on IMDB
+(ref: python/paddle/fluid/tests/book/notest_understand_sentiment.py).
+
+Run: python examples/understand_sentiment.py [--real-data]
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def main(steps: int = 30, synthetic: bool = True, verbose: bool = True):
+    import paddle_tpu as pt
+    from paddle_tpu.datasets import Imdb
+    from paddle_tpu.models import SentimentBiLSTM
+    from paddle_tpu.static import TrainStep
+
+    ds = Imdb(mode="synthetic" if synthetic else "train", seq_len=64)
+    n = min(len(ds), 128)
+    toks = np.stack([ds[i][0] for i in range(n)]).astype(np.int32)
+    y = np.asarray([int(ds[i][1]) for i in range(n)], np.int64)
+    vocab = max(len(ds.word_idx) + 2, int(toks.max()) + 1)
+
+    pt.seed(0)
+    model = SentimentBiLSTM(vocab, embed_dim=32, hidden=32,
+                            num_layers=1)
+
+    class Net(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.inner = model
+
+        def forward(self, t, lbl):
+            return self.inner.loss(t, lbl)
+
+    step = TrainStep(Net(), pt.optimizer.Adam(learning_rate=3e-3),
+                     lambda out: out)
+    losses = [float(step(toks, y, labels=())["loss"])
+              for _ in range(steps)]
+    if verbose:
+        print(f"understand_sentiment: xent {losses[0]:.3f} -> "
+              f"{losses[-1]:.3f}")
+    return {"first_loss": losses[0], "last_loss": losses[-1]}
+
+
+if __name__ == "__main__":
+    import argparse
+    p = argparse.ArgumentParser()
+    p.add_argument("--real-data", action="store_true")
+    p.add_argument("--steps", type=int, default=30)
+    a = p.parse_args()
+    main(steps=a.steps, synthetic=not a.real_data)

@@ -1,0 +1,42 @@
+"""Book ch.4 — word2vec: N-gram language model on imikolov (PTB)
+(ref: python/paddle/fluid/tests/book/test_word2vec.py).
+
+Run: python examples/word2vec.py [--real-data]
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def main(steps: int = 40, synthetic: bool = True, verbose: bool = True):
+    import paddle_tpu as pt
+    from paddle_tpu.datasets import Imikolov
+    from paddle_tpu.models import NGramLM
+    from paddle_tpu.static import TrainStep
+
+    ds = Imikolov(mode="synthetic" if synthetic else "train",
+                  data_type="ngram", window_size=5)
+    vocab = len(ds.word_idx) + 2
+    n = min(len(ds), 512)
+    ctx = np.stack([ds[i][0] for i in range(n)]).astype(np.int32)
+    nxt = np.asarray([int(ds[i][1]) for i in range(n)], np.int64)
+
+    pt.seed(0)
+    model = NGramLM(vocab, embed_dim=32, context=ctx.shape[1], hidden=64)
+    step = TrainStep(model, pt.optimizer.Adam(learning_rate=3e-3),
+                     lambda out, t: pt.nn.functional.cross_entropy(
+                         out, t))
+    losses = [float(step(ctx, labels=nxt)["loss"]) for _ in range(steps)]
+    if verbose:
+        print(f"word2vec: xent {losses[0]:.3f} -> {losses[-1]:.3f}")
+    return {"first_loss": losses[0], "last_loss": losses[-1]}
+
+
+if __name__ == "__main__":
+    import argparse
+    p = argparse.ArgumentParser()
+    p.add_argument("--real-data", action="store_true")
+    p.add_argument("--steps", type=int, default=40)
+    a = p.parse_args()
+    main(steps=a.steps, synthetic=not a.real_data)

@@ -104,5 +104,16 @@ class DataFeeder:
             raise ValueError(
                 f"DataFeeder: batch rows have {len(cols)} fields for "
                 f"{len(self.names)} feed names {self.names}")
-        return {n: self._np.stack([self._np.asarray(v) for v in col])
-                for n, col in zip(self.names, cols)}
+        out = {}
+        for n, col in zip(self.names, cols):
+            arrs = [self._np.asarray(v) for v in col]
+            if len({a.shape for a in arrs}) > 1:
+                raise ValueError(
+                    f"DataFeeder: field {n!r} has ragged sample shapes "
+                    f"{sorted({a.shape for a in arrs})}. LoD-style "
+                    "variable-length feeding is a dense redesign here: "
+                    "pad to a fixed seq_len and pass lengths as their "
+                    "own field (see paddle_tpu.ops.sequence — every op "
+                    "takes (x, length))")
+            out[n] = self._np.stack(arrs)
+        return out

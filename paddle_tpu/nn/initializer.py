@@ -182,13 +182,25 @@ class Orthogonal(Initializer):
 
 
 def make_param(attr, default: "Initializer", shape, dtype):
-    """Resolve ``attr`` (initializer / number / callable / ParamAttr)
-    and build the Parameter, honoring ParamAttr's per-parameter
-    metadata (trainable / name / regularizer / need_clip) — a frozen
-    ``ParamAttr(trainable=False)`` must actually freeze the weight."""
+    """Resolve ``attr`` (initializer / number / callable / str name /
+    ParamAttr) and build the Parameter, honoring ParamAttr's
+    per-parameter metadata (trainable / name / regularizer /
+    need_clip) — a frozen ``ParamAttr(trainable=False)`` must actually
+    freeze the weight. A bare string is fluid's name-only shorthand
+    (ref: ParamAttr._to_attr accepts str)."""
     from .layer import Parameter
+    if isinstance(attr, str):
+        from ..param_attr import ParamAttr
+        attr = ParamAttr(name=attr)
     value = _resolve(attr, default)(shape, dtype)
     if hasattr(attr, "initializer"):  # ParamAttr-like
+        if getattr(attr, "learning_rate", 1.0) != 1.0:
+            import warnings
+            warnings.warn(
+                "ParamAttr.learning_rate multipliers are not applied in "
+                "this framework (the optimizer uses one LR schedule); "
+                f"parameter {getattr(attr, 'name', None)!r} will train "
+                "at the global rate", UserWarning, stacklevel=3)
         return Parameter(value,
                          trainable=getattr(attr, "trainable", True),
                          name=getattr(attr, "name", None),
@@ -200,6 +212,8 @@ def make_param(attr, default: "Initializer", shape, dtype):
 def _resolve(init, default: Initializer) -> Initializer:
     if init is None:
         return default
+    if isinstance(init, str):  # fluid name-only shorthand
+        return default
     if hasattr(init, "initializer"):  # ParamAttr / WeightNormParamAttr
         return _resolve(init.initializer, default)
     if isinstance(init, Initializer):
@@ -209,3 +223,19 @@ def _resolve(init, default: Initializer) -> Initializer:
     if callable(init):
         return init
     raise TypeError(f"bad initializer {init!r}")
+
+
+# ----------------------------------------------------------------- aliases
+# Reference long-name spellings (ref: fluid/initializer.py:1004-1011;
+# XavierInitializer/MSRAInitializer default to uniform=True there, so
+# the aliases bind the uniform variants).
+ConstantInitializer = Constant
+UniformInitializer = Uniform
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+XavierInitializer = XavierUniform
+Xavier = XavierUniform
+MSRAInitializer = KaimingUniform
+MSRA = KaimingUniform
+BilinearInitializer = Bilinear
+NumpyArrayInitializer = Assign

@@ -1,0 +1,62 @@
+"""Smoke-run every book-chapter example with tiny settings — the
+examples directory is covered code, not drifting documentation
+(ref book suite: python/paddle/fluid/tests/book/)."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "examples"))
+
+
+def test_fit_a_line():
+    import fit_a_line
+    r = fit_a_line.main(epochs=15, verbose=False)
+    assert r["last_loss"] < r["first_loss"]
+
+
+def test_recognize_digits():
+    import recognize_digits
+    r = recognize_digits.main(epochs=1, verbose=False)
+    assert r["last_loss"] > 0
+
+
+def test_image_classification_both_layouts():
+    import image_classification
+    r = image_classification.main(steps=6, verbose=False)
+    assert r["last_loss"] < r["first_loss"] * 2  # moving, not diverged
+    r2 = image_classification.main(steps=3, nhwc=True, verbose=False)
+    assert r2["last_loss"] > 0
+
+
+def test_word2vec():
+    import word2vec
+    r = word2vec.main(steps=10, verbose=False)
+    assert r["last_loss"] < r["first_loss"]
+
+
+def test_recommender_system():
+    import recommender_system
+    r = recommender_system.main(steps=10, verbose=False)
+    assert r["last_loss"] < r["first_loss"]
+
+
+def test_understand_sentiment():
+    import understand_sentiment
+    r = understand_sentiment.main(steps=8, verbose=False)
+    assert r["last_loss"] > 0
+
+
+def test_label_semantic_roles():
+    import label_semantic_roles
+    r = label_semantic_roles.main(steps=6, verbose=False)
+    assert r["last_loss"] < r["first_loss"]
+
+
+def test_machine_translation():
+    import machine_translation
+    r = machine_translation.main(steps=8, verbose=False)
+    assert r["last_loss"] < r["first_loss"]
+    assert r["beam_shape"][1] == 2

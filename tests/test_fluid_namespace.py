@@ -174,3 +174,46 @@ def test_fluid_style_training_converges():
         params, state = opt.apply_gradients(params, grads, state)
         losses.append(float(loss))
     assert losses[-1] < losses[0] / 5
+
+
+def test_initializer_long_name_spellings():
+    """(ref: fluid/initializer.py:1004-1011 aliases; Xavier/MSRA
+    default to the uniform variants)."""
+    I = fluid.initializer
+    assert I.ConstantInitializer is I.Constant
+    assert I.NormalInitializer is I.Normal
+    assert I.UniformInitializer is I.Uniform
+    assert I.TruncatedNormalInitializer is I.TruncatedNormal
+    assert I.XavierInitializer is I.XavierUniform
+    assert I.MSRAInitializer is I.KaimingUniform
+    assert I.NumpyArrayInitializer is I.Assign
+    assert I.BilinearInitializer is I.Bilinear
+    lin = pt.nn.Linear(2, 2,
+                       weight_attr=I.ConstantInitializer(value=2.0))
+    np.testing.assert_allclose(np.asarray(lin.weight), 2.0)
+
+
+def test_string_weight_attr_is_name_shorthand():
+    """fluid's param_attr='shared_w' idiom: a bare string names the
+    parameter and keeps the default initializer."""
+    lin = pt.nn.Linear(3, 2, weight_attr="my_shared_w")
+    assert lin._parameters["weight"].name == "my_shared_w"
+    assert np.asarray(lin.weight).shape == (3, 2)
+
+
+def test_param_attr_learning_rate_warns_loudly():
+    """Per-parameter LR multipliers are not applied — that must be a
+    visible warning, not silent divergence from the reference."""
+    import warnings as w
+    pa = fluid.ParamAttr(learning_rate=2.0,
+                         initializer=fluid.initializer.Constant(0.0))
+    with w.catch_warnings(record=True) as caught:
+        w.simplefilter("always")
+        pt.nn.Linear(2, 2, weight_attr=pa)
+    assert any("learning_rate" in str(c.message) for c in caught)
+
+
+def test_data_feeder_ragged_sequences_clear_error():
+    df = fluid.DataFeeder(feed_list=["seq"])
+    with pytest.raises(ValueError, match="pad to a fixed seq_len"):
+        df.feed([(np.asarray([1, 2, 3]),), (np.asarray([4, 5]),)])
