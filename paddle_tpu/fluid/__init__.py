@@ -57,10 +57,18 @@ def scope_guard(scope: Scope):
 
 
 # real submodules so `from paddle_tpu.fluid.executor import Executor`
-# style imports port unchanged (ref: fluid/__init__.py:38,60,71)
+# style imports port unchanged (ref: fluid/__init__.py:35-78)
+from . import average  # noqa: E402,F401
 from . import backward  # noqa: E402,F401
+from . import contrib  # noqa: E402,F401
 from . import core  # noqa: E402,F401
 from . import executor  # noqa: E402,F401
+from . import framework  # noqa: E402,F401
+from . import transpiler  # noqa: E402,F401
+from . import unique_name  # noqa: E402,F401
+from .framework import Variable, in_dygraph_mode  # noqa: E402,F401
+from .transpiler import (DistributeTranspiler,  # noqa: E402,F401
+                         DistributeTranspilerConfig)
 
 # fluid.input re-exports (ref: fluid/input.py)
 embedding = layers.embedding

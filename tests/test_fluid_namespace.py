@@ -217,3 +217,45 @@ def test_data_feeder_ragged_sequences_clear_error():
     df = fluid.DataFeeder(feed_list=["seq"])
     with pytest.raises(ValueError, match="pad to a fixed seq_len"):
         df.feed([(np.asarray([1, 2, 3]),), (np.asarray([4, 5]),)])
+
+
+def test_fluid_aux_submodules():
+    """unique_name / framework / contrib / transpiler / average — the
+    rest of the reference's fluid top level (ref fluid/__init__.py)."""
+    from paddle_tpu.fluid import unique_name
+    with unique_name.guard():
+        assert unique_name.generate("fc") == "fc_0"
+        assert unique_name.generate("fc") == "fc_1"
+        assert unique_name.generate("bn") == "bn_0"
+    with unique_name.guard("infer_"):  # str arg = prefix (ref guard)
+        assert unique_name.generate("fc") == "infer_fc_0"
+    with pytest.raises(TypeError, match="prefix"):
+        with unique_name.guard(123):
+            pass
+    assert fluid.framework.in_dygraph_mode()
+    assert fluid.framework.Variable is fluid.Tensor
+    assert fluid.contrib.mixed_precision is not None  # amp
+    assert fluid.contrib.slim is not None
+    wa = fluid.average.WeightedAverage()
+    wa.add(2.0)
+    wa.add(4.0, weight=3)
+    assert float(wa.eval()) == pytest.approx(3.5)
+    # array numerator keeps the value shape (ref average.py)
+    wa2 = fluid.average.WeightedAverage()
+    wa2.add(np.asarray([1.0, 3.0]))
+    wa2.add(np.asarray([3.0, 5.0]))
+    np.testing.assert_allclose(wa2.eval(), [2.0, 4.0])
+    assert wa2.eval()[0] == 2.0  # indexable like the reference
+    with pytest.raises(ValueError, match="before any add"):
+        fluid.average.WeightedAverage().eval()
+    # PSDispatcher contract: dispatch(varlist) -> per-var endpoints
+    rr = fluid.transpiler.RoundRobin(["a", "b"])
+    assert rr.dispatch(["v1", "v2", "v3"]) == ["a", "b", "a"]
+    rr.reset()
+    assert rr.dispatch(["v4"]) == ["a"]
+    hn = fluid.transpiler.HashName(["a", "b"])
+    ep = hn.dispatch(["v1", "v2"])
+    assert len(ep) == 2 and set(ep) <= {"a", "b"}
+    assert hn.dispatch(["v1"])[0] == ep[0]  # stable placement
+    with pytest.raises(NotImplementedError, match="ShardedTrainStep"):
+        fluid.DistributeTranspiler().transpile(None)
