@@ -1,0 +1,153 @@
+"""MultiSlot data generators (ref:
+python/paddle/fluid/incubate/data_generator/__init__.py).
+
+User subclasses implement ``generate_sample(line)`` returning an
+iterator that yields ``[(slot_name, [values]), ...]`` per sample; the
+generator renders the native slot line format consumed by
+``csrc/data_feed.cc`` (``<count> v1 ... vcount`` per slot, slots in
+declaration order) and the ``data.DatasetFactory`` pipeline.
+
+The reference streams stdin->stdout so generators plug into its
+MPI/yarn file pipelines; both that mode (``run_from_stdin``) and a
+direct files mode (``run_from_files``) are provided.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Callable, Iterable, Optional, Sequence
+
+
+class DataGenerator:
+    def __init__(self) -> None:
+        self._proto_info: Optional[list] = None
+        self.batch_size_ = 32
+
+    # -------------------------------------------------------- user API
+    def set_batch(self, batch_size: int) -> None:
+        self.batch_size_ = int(batch_size)
+
+    def generate_sample(self, line: Optional[str]) -> Callable:
+        """Return an iterator function yielding one or more samples —
+        each ``[(slot_name, [values]), ...]`` — for one input line
+        (``line is None`` for generators that synthesize data)."""
+        raise NotImplementedError(
+            "subclass DataGenerator and implement generate_sample")
+
+    def generate_batch(self, samples: Sequence) -> Iterable:
+        """Optional batch-level hook (ref parity): receives
+        ``batch_size_`` samples, yields samples. Default passthrough."""
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    # ------------------------------------------------------ renderers
+    def _gen_str(self, line) -> str:
+        raise NotImplementedError
+
+    # --------------------------------------------------------- drivers
+    def run_from_stdin(self) -> None:
+        """stdin lines -> slot-format stdout (the reference's pipeline
+        mode)."""
+        self._proto_info = None  # fresh schema per run
+        self._drive(sys.stdin, sys.stdout)
+
+    def run_from_files(self, inputs: Sequence[str], output: str) -> None:
+        """Render input text files into one slot-format dataset file
+        consumable by DatasetFactory/InMemoryDataset. Files chain into
+        ONE stream so a generate_batch override sees full batches
+        across file boundaries (reference single-stream behavior)."""
+        import itertools
+
+        self._proto_info = None  # fresh schema per run
+
+        def lines():
+            for path in inputs:
+                with open(path) as f:
+                    yield from f
+
+        with open(output, "w") as out:
+            self._drive(itertools.chain(lines()), out)
+
+    def _drive(self, lines: Iterable[str], out) -> None:
+        batch = []
+        for line in lines:
+            it = self.generate_sample(line)
+            for sample in it():
+                batch.append(sample)
+                if len(batch) >= self.batch_size_:
+                    self._flush(batch, out)
+                    batch = []
+        if batch:
+            self._flush(batch, out)
+
+    def _flush(self, batch, out) -> None:
+        for sample in self.generate_batch(batch)():
+            out.write(self._gen_str(sample))
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Numeric slots: int ids (sparse) or floats (dense). Output per
+    sample: ``count v1 ... vcount`` for every slot, one line."""
+
+    def _gen_str(self, line) -> str:
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "generate_sample must yield [(name, [values]), ...]; "
+                f"got {type(line).__name__}")
+        def kind_of(elements):
+            return "float" if any(isinstance(v, float)
+                                  for v in elements) else "uint64"
+
+        if self._proto_info is None:
+            self._proto_info = []
+            for name, elements in line:
+                if not isinstance(name, str):
+                    raise ValueError(
+                        f"slot name must be str, got {name!r}")
+                self._proto_info.append((name, kind_of(elements)))
+        else:
+            if len(line) != len(self._proto_info):
+                raise ValueError(
+                    f"sample has {len(line)} slots; first sample had "
+                    f"{len(self._proto_info)}")
+            for i, ((name, elements), (want, want_kind)) in enumerate(
+                    zip(line, self._proto_info)):
+                if name != want:
+                    raise ValueError(
+                        f"slot order changed: got {name!r}, expected "
+                        f"{want!r}")
+                kind = kind_of(elements)
+                if kind == "float" and want_kind == "uint64":
+                    # drift int->float corrupts the typed feed; the
+                    # reference upgrades the slot only pre-emptively —
+                    # here the schema froze on sample 1
+                    raise ValueError(
+                        f"slot {name!r} was uint64 from the first "
+                        f"sample but sample has float values; keep "
+                        f"one type per slot (cast ids to int or make "
+                        f"every sample float)")
+                self._proto_info[i] = (want, want_kind)
+        parts = []
+        for name, elements in line:
+            if not elements:
+                raise ValueError(f"slot {name!r} has no values")
+            parts.append(str(len(elements)))
+            parts.extend(str(v) for v in elements)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """Values are pre-stringified by the user (fast path, no type
+    bookkeeping — ref MultiSlotStringDataGenerator)."""
+
+    def _gen_str(self, line) -> str:
+        if not isinstance(line, (list, tuple)):
+            raise ValueError(
+                "generate_sample must yield [(name, [strs]), ...]")
+        parts = []
+        for _name, elements in line:
+            parts.append(str(len(elements)))
+            parts.extend(str(v) for v in elements)
+        return " ".join(parts) + "\n"

@@ -64,6 +64,7 @@ from . import contrib  # noqa: E402,F401
 from . import core  # noqa: E402,F401
 from . import executor  # noqa: E402,F401
 from . import framework  # noqa: E402,F401
+from . import incubate  # noqa: E402,F401
 from . import transpiler  # noqa: E402,F401
 from . import unique_name  # noqa: E402,F401
 from .framework import Variable, in_dygraph_mode  # noqa: E402,F401
@@ -91,7 +92,13 @@ def program_guard(main_program=None, startup_program=None):
 
 
 def is_compiled_with_cuda() -> bool:
-    return False
+    """One answer for both spellings (fluid.is_compiled_with_cuda and
+    fluid.framework.is_compiled_with_cuda): True when an accelerator
+    is available — CUDAPlace aliases TPUPlace here, so ported
+    'CUDAPlace(0) if is_compiled_with_cuda() else CPUPlace()' device
+    selection keeps choosing the accelerator."""
+    from ..core.place import is_compiled_with_cuda as _icc
+    return _icc()
 
 
 class DataFeeder:

@@ -1,0 +1,5 @@
+"""``fluid.incubate`` (ref: python/paddle/fluid/incubate/__init__.py)
+— fleet and data_generator live here in 1.8-era user code."""
+
+from . import data_generator  # noqa: F401
+from . import fleet  # noqa: F401

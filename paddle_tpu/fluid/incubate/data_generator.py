@@ -1,0 +1,6 @@
+"""``fluid.incubate.data_generator`` (ref: incubate/data_generator/
+__init__.py) — re-exports the framework's MultiSlot generators."""
+
+from ...data.data_generator import (DataGenerator,  # noqa: F401
+                                    MultiSlotDataGenerator,
+                                    MultiSlotStringDataGenerator)

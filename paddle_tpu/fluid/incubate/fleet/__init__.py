@@ -1,0 +1,6 @@
+"""``fluid.incubate.fleet`` (ref: incubate/fleet/) — the 1.8 fleet
+import tree; all roads lead to the framework's fleet singleton."""
+
+from . import base  # noqa: F401
+from . import collective  # noqa: F401
+from . import parameter_server  # noqa: F401

@@ -1,0 +1,6 @@
+"""``fluid.incubate.fleet.base.role_maker`` (ref: incubate/fleet/base/
+role_maker.py) — role makers resolve rank/size/endpoints from the
+environment the launcher sets."""
+
+from .....distributed.fleet.base import (  # noqa: F401
+    PaddleCloudRoleMaker, UserDefinedRoleMaker)

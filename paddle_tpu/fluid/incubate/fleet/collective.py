@@ -1,0 +1,7 @@
+"""``from paddle.fluid.incubate.fleet.collective import fleet`` —
+the 1.8 collective-training entry (ref: incubate/fleet/collective/
+__init__.py). Routes to the framework fleet singleton; the NCCL
+collective transport is XLA collectives over the device mesh here."""
+
+from ....distributed.fleet import (DistributedStrategy,  # noqa: F401
+                                   fleet)

@@ -1,0 +1,12 @@
+"""``from paddle.fluid.incubate.fleet.parameter_server
+.distribute_transpiler import fleet`` — the 1.8 PS-mode entry (ref:
+incubate/fleet/parameter_server/distribute_transpiler/__init__.py).
+The PS stack here is ``paddle_tpu.distributed.ps`` over the native
+control plane + csrc/ps_service.cc; the fleet singleton drives it via
+DistributedStrategy(ps_mode=...)."""
+
+from .....distributed.fleet import (DistributedStrategy,  # noqa: F401
+                                    fleet)
+from ..... import distributed as _distributed
+
+ps = _distributed.ps  # the sync/async/geo PS runtime

@@ -25,7 +25,9 @@ def test_top_level_surface_resolves():
     assert fluid.executor.Executor is fluid.Executor
     assert fluid.metrics.Accuracy is not None
     assert fluid.backward.gradients is not None
-    assert not fluid.is_compiled_with_cuda()
+    # both spellings agree (accelerator-aware; CUDAPlace==TPUPlace)
+    assert fluid.is_compiled_with_cuda() == \
+        fluid.framework.is_compiled_with_cuda()
 
 
 def test_graph_construction_redirects_are_loud():
