@@ -74,6 +74,30 @@ def _accelerator_available() -> bool:
     return any(d.platform in ("tpu", "axon") for d in jax.devices())
 
 
+def accelerator_configured() -> bool:
+    """Cheap, NON-BLOCKING accelerator check for device-selection code:
+    never initializes the backend (a wedged accelerator tunnel must not
+    hang ``is_compiled_with_cuda()``-style probes). If a backend is
+    already live, answer from its devices; otherwise answer from the
+    configured platform list (env/config) without touching PJRT."""
+    from jax._src import xla_bridge
+    if getattr(xla_bridge, "_backends", None):
+        try:
+            return _accelerator_available()
+        except Exception:  # noqa: BLE001 — init raced and failed
+            return False
+    import os
+    plats = (os.environ.get("JAX_PLATFORMS") or "")
+    try:
+        cfg = jax.config.read("jax_platforms")
+        if cfg:
+            plats = cfg
+    except Exception:  # noqa: BLE001
+        pass
+    return any(p in plats.lower()
+               for p in ("tpu", "axon", "cuda", "gpu"))
+
+
 def is_compiled_with_tpu() -> bool:
     return _accelerator_available()
 

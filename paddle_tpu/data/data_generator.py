@@ -58,8 +58,6 @@ class DataGenerator:
         consumable by DatasetFactory/InMemoryDataset. Files chain into
         ONE stream so a generate_batch override sees full batches
         across file boundaries (reference single-stream behavior)."""
-        import itertools
-
         self._proto_info = None  # fresh schema per run
 
         def lines():
@@ -68,13 +66,15 @@ class DataGenerator:
                     yield from f
 
         with open(output, "w") as out:
-            self._drive(itertools.chain(lines()), out)
+            self._drive(lines(), out)
 
     def _drive(self, lines: Iterable[str], out) -> None:
         batch = []
         for line in lines:
             it = self.generate_sample(line)
             for sample in it():
+                if sample is None:
+                    continue  # ref parity: None drops a malformed line
                 batch.append(sample)
                 if len(batch) >= self.batch_size_:
                     self._flush(batch, out)
@@ -112,8 +112,8 @@ class MultiSlotDataGenerator(DataGenerator):
                 raise ValueError(
                     f"sample has {len(line)} slots; first sample had "
                     f"{len(self._proto_info)}")
-            for i, ((name, elements), (want, want_kind)) in enumerate(
-                    zip(line, self._proto_info)):
+            for (name, elements), (want, want_kind) in zip(
+                    line, self._proto_info):
                 if name != want:
                     raise ValueError(
                         f"slot order changed: got {name!r}, expected "
@@ -128,7 +128,6 @@ class MultiSlotDataGenerator(DataGenerator):
                         f"sample but sample has float values; keep "
                         f"one type per slot (cast ids to int or make "
                         f"every sample float)")
-                self._proto_info[i] = (want, want_kind)
         parts = []
         for name, elements in line:
             if not elements:

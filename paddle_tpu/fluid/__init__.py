@@ -93,12 +93,14 @@ def program_guard(main_program=None, startup_program=None):
 
 def is_compiled_with_cuda() -> bool:
     """One answer for both spellings (fluid.is_compiled_with_cuda and
-    fluid.framework.is_compiled_with_cuda): True when an accelerator
-    is available — CUDAPlace aliases TPUPlace here, so ported
+    fluid.framework.is_compiled_with_cuda): True when an accelerator is
+    configured — CUDAPlace aliases TPUPlace here, so ported
     'CUDAPlace(0) if is_compiled_with_cuda() else CPUPlace()' device
-    selection keeps choosing the accelerator."""
-    from ..core.place import is_compiled_with_cuda as _icc
-    return _icc()
+    selection keeps choosing the accelerator. NON-BLOCKING: never
+    initializes the backend, so a wedged tunnel can't hang device
+    selection."""
+    from ..core.place import accelerator_configured
+    return accelerator_configured()
 
 
 class DataFeeder:

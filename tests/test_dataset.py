@@ -358,3 +358,25 @@ def test_data_generator_schema_guards(tmp_path):
     s.set_batch(4)
     s.run_from_files([f1, f2], os.path.join(str(tmp_path), "o4.txt"))
     assert seen == [4, 2], seen
+
+
+def test_data_generator_none_sample_skipped(tmp_path):
+    """Reference parity: yielding None drops a malformed line instead
+    of aborting the render."""
+    from paddle_tpu.data.data_generator import MultiSlotDataGenerator
+
+    class G(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                if line.strip() == "bad":
+                    yield None
+                else:
+                    yield [("x", [int(line.strip())])]
+            return it
+
+    src = os.path.join(str(tmp_path), "raw.txt")
+    with open(src, "w") as f:
+        f.write("1\nbad\n2\n")
+    out = os.path.join(str(tmp_path), "o.txt")
+    G().run_from_files([src], out)
+    assert open(out).read().splitlines() == ["1 1", "1 2"]
