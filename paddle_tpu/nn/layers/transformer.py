@@ -50,6 +50,12 @@ class MultiHeadAttention(Layer):
 
     def forward(self, query, key=None, value=None, attn_mask=None,
                 causal: bool = False):
+        # Layout note: a transpose-free [B, T, H, D] variant exists
+        # (ops.attention.attention_bthd) but measured structurally
+        # WORSE on compiled HLO (tools/perf_lab.py hlostats: 136->144
+        # transposes on bert4L — XLA re-transposes inside dot_general
+        # anyway), so the BHTD split stays until a real-chip A/B says
+        # otherwise.
         key = query if key is None else key
         value = key if value is None else value
         q = self._split(self.q_proj(query))

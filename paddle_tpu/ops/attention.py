@@ -30,6 +30,14 @@ def scaled_dot_product_attention(q, k, v, mask=None,
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     logits = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+    weights = _attention_weights(logits, mask, causal, dropout_p,
+                                 training, key)
+    return jnp.einsum("...qk,...kd->...qd", weights, v)
+
+
+def _attention_weights(logits, mask, causal, dropout_p, training, key):
+    """Shared post-logits tail (causal fill, mask, softmax, dropout) —
+    one definition so the BHTD and BTHD paths cannot drift."""
     if causal:
         tq, tk = logits.shape[-2], logits.shape[-1]
         causal_mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
@@ -47,7 +55,29 @@ def scaled_dot_product_attention(q, k, v, mask=None,
         from .nn_functional import dropout_keep_mask
         keep = dropout_keep_mask(key, 1.0 - dropout_p, weights.shape)
         weights = jnp.where(keep, weights / (1.0 - dropout_p), 0.0)
-    return jnp.einsum("...qk,...kd->...qd", weights, v)
+    return weights
+
+
+def attention_bthd(q, k, v, mask=None, scale: Optional[float] = None,
+                   causal: bool = False, dropout_p: float = 0.0,
+                   training: bool = False, key=None):
+    """Attention on [B, T, H, D] inputs WITHOUT explicit transposes:
+    the head axis rides the dot_general batch dims. Chip-A/B candidate
+    only — on compiled CPU HLO it measured structurally WORSE than the
+    BHTD path (hlostats: 136->144 transposes on bert4L; XLA
+    re-transposes inside dot_general), so MultiHeadAttention keeps the
+    BHTD split. Math identical to scaled_dot_product_attention (the
+    post-logits tail is shared).
+
+    mask broadcasts to [B, H, Tq, Tk] (same contract as the BHTD
+    path). Returns [B, T, H, D]."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    weights = _attention_weights(logits, mask, causal, dropout_p,
+                                 training, key)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 
 
 def multihead_matmul(x, w_qkv, b_qkv, num_heads: int, mask=None,

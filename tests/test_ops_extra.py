@@ -593,3 +593,31 @@ def test_filter_by_instag():
     assert np.asarray(xf)[1].sum() == 0.0  # filtered row zeroed
     np.testing.assert_array_equal(np.asarray(xf)[0], np.asarray(x)[0])
     np.testing.assert_array_equal(np.asarray(w), [1.0, 0.0, 1.0, 1.0])
+
+
+def test_attention_bthd_matches_bhtd():
+    """attention_bthd ([B,T,H,D], no moveaxis) computes the identical
+    function to scaled_dot_product_attention's BHTD contract — kept as
+    a chip-A/B candidate (hlostats measured it structurally worse on
+    CPU HLO; see nn/layers/transformer.py note)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.attention import (attention_bthd,
+                                          scaled_dot_product_attention)
+
+    rng = np.random.default_rng(0)
+    b, h, t, d = 2, 3, 8, 4
+    q = rng.normal(0, 1, (b, t, h, d)).astype(np.float32)
+    k = rng.normal(0, 1, (b, t, h, d)).astype(np.float32)
+    v = rng.normal(0, 1, (b, t, h, d)).astype(np.float32)
+    for kw in ({"causal": True}, {},
+               {"mask": jnp.asarray(
+                   rng.normal(0, 1, (b, h, t, t)).astype(np.float32))},
+               {"mask": jnp.asarray(rng.random((b, 1, t, t)) > 0.3)}):
+        ref = scaled_dot_product_attention(
+            jnp.moveaxis(q, 2, 1), jnp.moveaxis(k, 2, 1),
+            jnp.moveaxis(v, 2, 1), **kw)
+        got = attention_bthd(q, k, v, **kw)
+        np.testing.assert_allclose(np.asarray(jnp.moveaxis(ref, 1, 2)),
+                                   np.asarray(got), rtol=1e-5,
+                                   atol=1e-6)

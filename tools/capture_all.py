@@ -1,0 +1,85 @@
+"""One-shot measurement campaign for when the accelerator is up.
+
+Runs, in order of value per chip-minute (each stage independently
+time-capped so a mid-campaign tunnel drop still leaves artifacts):
+  1. verification  -> VERIFY_TPU.json  (compiled kernels + train parity)
+  2. BERT bench    -> CAPTURE_bert.json
+  3. ResNet bench  -> CAPTURE_resnet.json
+  4. flash sweep   -> CAPTURE_flash.json
+
+Usage: python tools/capture_all.py [stage ...]   (default: all)
+Each stage is a subprocess of bench.py so a wedged PJRT init or OOM
+kills only that stage; stdout JSON lines are parsed and collected into
+CAPTURE_SUMMARY.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STAGES = {
+    "verify": (["verify"], 1200),
+    "bert": ([], 3000),
+    "resnet": (["resnet50"], 3000),
+    "flash": (["flash"], 1800),
+}
+
+
+def log(msg: str) -> None:
+    print(f"[capture] {msg}", file=sys.stderr, flush=True)
+
+
+def run_stage(name: str) -> dict:
+    args, budget = STAGES[name]
+    t0 = time.time()
+    log(f"stage {name}: starting (budget {budget}s)")
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "bench.py"), *args],
+            capture_output=True, text=True, timeout=budget, cwd=ROOT)
+    except subprocess.TimeoutExpired:
+        log(f"stage {name}: TIMED OUT after {budget}s")
+        return {"stage": name, "ok": False, "error": f"timeout {budget}s"}
+    parsed = None
+    for line in (r.stdout or "").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    out = {"stage": name, "ok": r.returncode == 0 and parsed is not None,
+           "rc": r.returncode, "parsed": parsed,
+           "elapsed_s": round(time.time() - t0, 1),
+           "stderr_tail": (r.stderr or "").splitlines()[-8:]}
+    result_path = os.path.join(ROOT, f"CAPTURE_{name}.json")
+    with open(result_path, "w") as f:
+        json.dump(out, f, indent=1)
+    log(f"stage {name}: rc={r.returncode} parsed={parsed} "
+        f"({out['elapsed_s']}s) -> {result_path}")
+    return out
+
+
+def main() -> None:
+    wanted = sys.argv[1:] or ["verify", "bert", "resnet", "flash"]
+    unknown = [w for w in wanted if w not in STAGES]
+    if unknown:
+        raise SystemExit(f"unknown stages {unknown}; pick from "
+                         f"{sorted(STAGES)}")
+    results = [run_stage(name) for name in wanted]
+    summary = {"when": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+               "results": results}
+    with open(os.path.join(ROOT, "CAPTURE_SUMMARY.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+    log(f"campaign done: {[(r['stage'], r['ok']) for r in results]}")
+    sys.exit(0 if all(r["ok"] for r in results) else 1)
+
+
+if __name__ == "__main__":
+    main()
