@@ -60,3 +60,15 @@ def test_machine_translation():
     r = machine_translation.main(steps=8, verbose=False)
     assert r["last_loss"] < r["first_loss"]
     assert r["beam_shape"][1] == 2
+
+
+def test_distributed_data_parallel():
+    import distributed_data_parallel
+    r = distributed_data_parallel.main(steps=4, verbose=False)
+    assert r["n_devices"] == 8  # virtual mesh in CI
+    assert {"dp", "dp_mp", "dcn_dp"} <= set(r)
+
+
+def test_inference_serving():
+    import inference_serving
+    assert inference_serving.main(verbose=False)["ok"]
