@@ -1,4 +1,4 @@
-"""Pallas flash attention (forward) with custom VJP.
+"""Pallas flash attention (forward AND backward) with custom VJP.
 
 TPU-native replacement for the reference's fused attention CUDA kernels
 (/root/reference/paddle/fluid/operators/fused/multihead_matmul_op.cu,
@@ -10,8 +10,13 @@ feasible on TPU.
 
 Layout: q, k, v are [B, H, T, D]. Grid is (B*H, Tq/BLOCK_Q); the kernel
 scans K/V blocks with lax.fori_loop carrying (acc, row_max, row_sum).
-Backward uses the standard recompute-based flash backward expressed with
-jax ops inside a custom_vjp (fwd saves only out + logsumexp).
+Backward is the recompute-based flash backward as TWO Pallas kernels
+(fwd saves only out + logsumexp; delta = rowsum(dO*O) is one cheap XLA
+reduction): a dq kernel blocked over queries scanning K/V, and a dk/dv
+kernel blocked over keys scanning Q/dO. Scores are recomputed blockwise
+in VMEM, so the backward keeps the O(T) memory property too — the
+previous XLA einsum backward materialized the full [B, H, T, T] scores
+in fp32, which silently forfeited long-context training.
 """
 
 from __future__ import annotations
@@ -156,27 +161,198 @@ def _fwd(q, k, v, causal, scale, interpret):
     return out, (q, k, v, out, lse, scale)
 
 
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, scale: float, causal: bool, block_k: int,
+                   seq_k: int, seq_q: int):
+    q = q_ref[0].astype(jnp.float32)                   # [BQ, D]
+    do = do_ref[0].astype(jnp.float32)                 # [BQ, D]
+    lse = lse_ref[0]                                   # [BQ, 1] f32
+    delta = delta_ref[0]                               # [BQ, 1] f32
+    block_q = q.shape[0]
+    i_q = pl.program_id(1)
+    num_k = pl.cdiv(seq_k, block_k)
+    causal_offset = seq_k - seq_q
+
+    def body(j, dq_acc):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [BQ, BK]
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = k_pos < seq_k
+        if causal:
+            q_pos = i_q * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = jnp.logical_and(valid,
+                                    q_pos + causal_offset >= k_pos)
+        s = jnp.where(valid, s, _NEG_INF)
+        p = jnp.exp(s - lse)                            # probs, 0 at -inf
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [BQ, BK]
+        dsc = p * (dp - delta) * scale
+        return dq_acc + jax.lax.dot_general(
+            dsc, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        max_k = (i_q + 1) * block_q - 1 + causal_offset
+        upper = jnp.clip(max_k // block_k + 1, 1, num_k)
+    else:
+        upper = num_k
+    d = q.shape[-1]
+    dq = jax.lax.fori_loop(0, upper, body,
+                           jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale: float, causal: bool,
+                    block_q: int, seq_k: int, seq_q: int):
+    # Padded-q correctness: dO and delta are zero-padded, so a padded
+    # query row contributes p^T@dO = 0 to dv and p*(0-0) = 0 to dk —
+    # no explicit q-validity mask is needed.
+    k = k_ref[0].astype(jnp.float32)                   # [BK, D]
+    v = v_ref[0].astype(jnp.float32)                   # [BK, D]
+    block_k = k.shape[0]
+    j_k = pl.program_id(1)
+    seq_q_pad = q_ref.shape[1]
+    num_q = seq_q_pad // block_q
+    causal_offset = seq_k - seq_q
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), :]   # [BQ, 1]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # [BQ, BK]
+        k_pos = j_k * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = k_pos < seq_k
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = jnp.logical_and(valid,
+                                    q_pos + causal_offset >= k_pos)
+        s = jnp.where(valid, s, _NEG_INF)
+        p = jnp.exp(s - lse)                                # [BQ, BK]
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [BK, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [BQ, BK]
+        dsc = p * (dp - delta) * scale
+        dk_acc = dk_acc + jax.lax.dot_general(
+            dsc, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [BK, D]
+        return dk_acc, dv_acc
+
+    if causal:
+        # first q block whose last visible key reaches this k block:
+        # q_pos + offset >= j*BK  =>  q_pos >= j*BK - offset
+        lower = jnp.clip((j_k * block_k - causal_offset) // block_q,
+                         0, num_q)
+    else:
+        lower = 0
+    d = k.shape[-1]
+    zeros = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(lower, num_q, body, (zeros, zeros))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, scale: float, causal: bool,
+                    interpret: bool = False):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    bq = min(BLOCK_Q, tq)
+    bk = min(BLOCK_K, tk)
+    tq_p = pl.cdiv(tq, bq) * bq
+    tk_p = pl.cdiv(tk, bk) * bk
+
+    def flat(x, t, tp):
+        x = x.reshape(b * h, t, -1)
+        return jnp.pad(x, ((0, 0), (0, tp - t), (0, 0))) \
+            if tp != t else x
+
+    qr, dor = flat(q, tq, tq_p), flat(g, tq, tq_p)
+    kr, vr = flat(k, tk, tk_p), flat(v, tk, tk_p)
+    # delta = rowsum(dO * O): one elementwise+reduce in XLA, [bh, tq, 1]
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(b * h, tq, 1)
+    delta = flat(delta, tq, tq_p)
+    lse_r = flat(lse.reshape(b, h, tq, 1).astype(jnp.float32), tq, tq_p)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=bk, seq_k=tk, seq_q=tq),
+        grid=(b * h, tq_p // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda g_, i: (g_, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tk_p, d), lambda g_, i: (g_, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tk_p, d), lambda g_, i: (g_, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), lambda g_, i: (g_, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), lambda g_, i: (g_, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), lambda g_, i: (g_, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda g_, i: (g_, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr, dor, lse_r, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, seq_k=tk, seq_q=tq),
+        grid=(b * h, tk_p // bk),
+        in_specs=[
+            pl.BlockSpec((1, tq_p, d), lambda g_, j: (g_, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda g_, j: (g_, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda g_, j: (g_, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tq_p, d), lambda g_, j: (g_, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tq_p, 1), lambda g_, j: (g_, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tq_p, 1), lambda g_, j: (g_, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda g_, j: (g_, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda g_, j: (g_, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tk_p, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, tk_p, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lse_r, delta)
+
+    return (dq[:, :tq].reshape(b, h, tq, d),
+            dk[:, :tk].reshape(b, h, tk, d),
+            dv[:, :tk].reshape(b, h, tk, d))
+
+
 def _bwd(causal, scale_arg, interpret, res, g):
     q, k, v, out, lse, scale = res
-    # Recompute-based backward (flash-attention equations) in fp32.
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    gf = g.astype(jnp.float32)
-    of = out.astype(jnp.float32)
-    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
-    if causal:
-        tq, tk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
-        s = jnp.where(mask, s, _NEG_INF)
-    p = jnp.exp(s - lse[..., None])                       # softmax probs
-    dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
-    dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
-    delta = jnp.sum(of * gf, axis=-1, keepdims=True)      # rowwise dot
-    ds = p * (dp - delta) * scale
-    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
-    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return _flash_backward(q, k, v, out, lse, g, scale, causal,
+                           interpret)
 
 
 flash_attention.defvjp(_fwd, _bwd)

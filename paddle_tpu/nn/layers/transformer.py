@@ -48,6 +48,22 @@ class MultiHeadAttention(Layer):
         return jnp.moveaxis(
             x.reshape(b, t, self.num_heads, self.head_dim), 2, 1)
 
+    def _qkv_self(self, x):
+        """Self-attention projections as ONE [d, 3d] matmul: the q/k/v
+        weights are concatenated at trace time (XLA folds the concat of
+        constants-at-step-scope into the dot operand), so the MXU sees a
+        single large GEMM instead of three d×d ones — the same shape the
+        reference's fused multihead_matmul_op.cu feeds cuBLAS. Parameter
+        structure (q_proj/k_proj/v_proj) and checkpoints are unchanged;
+        per-column math is identical (test_fused_qkv)."""
+        w = jnp.concatenate([self.q_proj.weight, self.k_proj.weight,
+                             self.v_proj.weight], axis=1)
+        biases = [self.q_proj.bias, self.k_proj.bias, self.v_proj.bias]
+        b = jnp.concatenate(biases) if all(
+            bb is not None for bb in biases) else None
+        qkv = F.linear(x, w, b)
+        return jnp.split(qkv, 3, axis=-1)
+
     def forward(self, query, key=None, value=None, attn_mask=None,
                 causal: bool = False):
         # Layout note: a transpose-free [B, T, H, D] variant exists
@@ -56,11 +72,21 @@ class MultiHeadAttention(Layer):
         # transposes on bert4L — XLA re-transposes inside dot_general
         # anyway), so the BHTD split stays until a real-chip A/B says
         # otherwise.
+        fusable = (key is None and value is None
+                   and self.q_proj.in_features == self.k_proj.in_features
+                   == self.v_proj.in_features
+                   and ((self.q_proj.bias is None)
+                        == (self.k_proj.bias is None)
+                        == (self.v_proj.bias is None)))
         key = query if key is None else key
         value = key if value is None else value
-        q = self._split(self.q_proj(query))
-        k = self._split(self.k_proj(key))
-        v = self._split(self.v_proj(value))
+        if fusable:
+            qp, kp, vp = self._qkv_self(query)
+            q, k, v = self._split(qp), self._split(kp), self._split(vp)
+        else:
+            q = self._split(self.q_proj(query))
+            k = self._split(self.k_proj(key))
+            v = self._split(self.v_proj(value))
         from ...kernels import maybe_flash_attention
         out = maybe_flash_attention(
             q, k, v, mask=attn_mask, causal=causal,

@@ -336,6 +336,7 @@ class TrainStep:
             "rng": _random.make_key(seed),
         }
         self._jitted = jax.jit(self._step, donate_argnums=(0,))
+        self._jitted_multi = jax.jit(self._multi, donate_argnums=(0,))
 
     def _step(self, state, batch):
         params = state["params"]
@@ -360,6 +361,21 @@ class TrainStep:
         return ({"params": new_params, "buffers": new_buffers,
                  "opt": new_opt, "rng": rng}, metrics)
 
+    def _multi(self, state, batches, lr):
+        # iterations-per-loop: K optimizer steps inside ONE compiled
+        # program (TF TPU's iterations_per_loop / t5x steps_per_loop).
+        # On remote-dispatch backends each dispatch pays per-buffer
+        # runtime copies (profiled ~19% of the BERT step, README); a
+        # lax.scan amortizes that over K steps while keeping RNG/step
+        # semantics identical to K sequential calls (the body is the
+        # same _step; parity-tested in test_train_step_multi).
+        def body(st, xs):
+            if lr is not None:
+                xs = dict(xs, lr=lr)
+            return self._step(st, xs)
+
+        return jax.lax.scan(body, state, batches)
+
     def _make_batch(self, args, labels, kwargs):
         from ..parallel.spmd import inject_host_lr
         return inject_host_lr(
@@ -369,6 +385,21 @@ class TrainStep:
     def __call__(self, *args, labels=(), **kwargs):
         batch = self._make_batch(args, labels, kwargs)
         self.state, metrics = self._jitted(self.state, batch)
+        return metrics
+
+    def run_steps(self, *args, labels=(), **kwargs):
+        """Run K fused optimizer steps in one dispatch: every leaf of
+        ``args``/``labels``/``kwargs`` carries a leading steps axis K
+        (stack K per-step batches). Returns metrics whose leaves are
+        stacked [K] (``metrics["loss"][-1]`` is the latest). A host-LR
+        scheduler's live value is held constant across the K steps of
+        one dispatch (scheduler granularity becomes K steps)."""
+        from ..parallel.spmd import host_lr_of
+        batch = {"args": args, "labels": as_label_tuple(labels),
+                 "kwargs": kwargs}
+        lr = host_lr_of(self.optimizer)
+        lr = None if lr is None else jnp.float32(lr)
+        self.state, metrics = self._jitted_multi(self.state, batch, lr)
         return metrics
 
     def compiled_hlo(self, *args, labels=(), **kwargs) -> str:

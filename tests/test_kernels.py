@@ -156,6 +156,67 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-3, atol=2e-3)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("tq,tk", [(256, 256), (100, 100), (32, 96)])
+    def test_backward_blocked(self, rng, causal, tq, tk):
+        """Pallas backward across block boundaries, unaligned tails and
+        cross-length causal (bottom-right alignment) — grads must match
+        jax.grad through the XLA reference attention."""
+        from paddle_tpu.kernels import flash_attention as fa
+        orig_q, orig_k = fa.BLOCK_Q, fa.BLOCK_K
+        fa.BLOCK_Q, fa.BLOCK_K = 64, 64
+        try:
+            q = rng.standard_normal((1, 2, tq, 32)).astype(np.float32)
+            k = rng.standard_normal((1, 2, tk, 32)).astype(np.float32)
+            v = rng.standard_normal((1, 2, tk, 32)).astype(np.float32)
+
+            def loss_flash(q_, k_, v_):
+                return jnp.sum(
+                    fa.flash_attention(q_, k_, v_, causal, None, True)
+                    ** 2)
+
+            def loss_ref(q_, k_, v_):
+                return jnp.sum(self._reference(q_, k_, v_, causal) ** 2)
+
+            gf = jax.grad(loss_flash, argnums=(0, 1, 2))(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+            gr = jax.grad(loss_ref, argnums=(0, 1, 2))(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+            for a, b, name in zip(gf, gr, "qkv"):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3,
+                    err_msg=f"d{name} tq={tq} tk={tk} causal={causal}")
+        finally:
+            fa.BLOCK_Q, fa.BLOCK_K = orig_q, orig_k
+
+    def test_backward_bf16(self, rng):
+        """bf16 inputs (the production dtype): grads come back bf16 and
+        close to the fp32 reference at bf16 tolerance."""
+        from paddle_tpu.kernels.flash_attention import flash_attention
+
+        q = rng.standard_normal((1, 2, 128, 64)).astype(np.float32)
+        k = rng.standard_normal((1, 2, 128, 64)).astype(np.float32)
+        v = rng.standard_normal((1, 2, 128, 64)).astype(np.float32)
+        qb, kb, vb = (jnp.asarray(a, jnp.bfloat16) for a in (q, k, v))
+
+        def loss_flash(q_, k_, v_):
+            return jnp.sum(
+                flash_attention(q_, k_, v_, False, None, True)
+                .astype(jnp.float32) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(qb, kb, vb)
+        assert all(g.dtype == jnp.bfloat16 for g in gf)
+
+        def loss_ref(q_, k_, v_):
+            return jnp.sum(self._reference(q_, k_, v_) ** 2)
+
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(
+                np.asarray(a, dtype=np.float32), np.asarray(b),
+                rtol=0.1, atol=0.1)
+
 
 class TestFusedAdam:
     def test_matches_unfused(self, rng):

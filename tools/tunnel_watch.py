@@ -1,0 +1,65 @@
+"""Watch for the accelerator tunnel to come back, then run the capture
+campaign (tools/capture_all.py) once and exit.
+
+Each probe runs ``jax.default_backend()`` in a subprocess with a hard
+timeout so a wedged PJRT init never hangs the watcher. Probe cadence is
+~3 min; every outcome is appended to tools/tunnel_watch.log with a
+timestamp so the outage window is documented for the round ledger.
+
+Usage: python tools/tunnel_watch.py [stage ...]
+Stages are forwarded to capture_all.py (default: the full campaign).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(ROOT, "tools", "tunnel_watch.log")
+
+
+def log(msg: str) -> None:
+    line = f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())} {msg}"
+    print(line, file=sys.stderr, flush=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def probe(timeout_s: int = 60) -> str | None:
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            capture_output=True, timeout=timeout_s, text=True)
+    except subprocess.TimeoutExpired:
+        return None
+    if r.returncode == 0 and r.stdout.strip():
+        return r.stdout.strip().splitlines()[-1]
+    return None
+
+
+def main() -> None:
+    stages = sys.argv[1:]
+    log(f"watch start (stages={stages or 'all'})")
+    n = 0
+    while True:
+        backend = probe()
+        if backend in ("tpu", "axon"):
+            log(f"probe {n}: backend={backend} — tunnel UP; "
+                f"starting capture campaign")
+            r = subprocess.run(
+                [sys.executable,
+                 os.path.join(ROOT, "tools", "capture_all.py"), *stages],
+                cwd=ROOT)
+            log(f"capture campaign rc={r.returncode}")
+            sys.exit(r.returncode)
+        log(f"probe {n}: {'backend=' + backend if backend else 'down'}")
+        n += 1
+        time.sleep(150)
+
+
+if __name__ == "__main__":
+    main()
