@@ -460,7 +460,13 @@ def main() -> None:
         }))
         sys.exit(0 if res["ok"] else 1)
 
-    if on_accel:
+    skip_validate = os.environ.get(
+        "PT_BENCH_SKIP_VALIDATE", "").strip().lower() in (
+        "1", "true", "yes", "on")
+    if on_accel and not skip_validate:
+        # capture campaigns set PT_BENCH_SKIP_VALIDATE after the verify
+        # stage has already produced VERIFY_TPU.json — revalidating in
+        # every timing stage spends chip-minutes on known-good kernels
         log("validating Pallas kernels in compiled mode "
             "(paddle_tpu.verify)...")
         from paddle_tpu.verify import validate_kernels_on_tpu

@@ -2,12 +2,20 @@
 
 Runs, in order of value per chip-minute (each stage independently
 time-capped so a mid-campaign tunnel drop still leaves artifacts):
-  1. verification  -> VERIFY_TPU.json  (compiled kernels + train parity)
-  2. BERT bench    -> CAPTURE_bert.json
-  3. ResNet bench  -> CAPTURE_resnet.json
-  4. flash sweep   -> CAPTURE_flash.json
+  1. verification       -> VERIFY_TPU.json  (compiled kernels + parity)
+  2. pinned BERT        -> CAPTURE_bert_fused_b32.json   (best-guess cfg)
+  3. pinned ResNet      -> CAPTURE_resnet_nhwc_b128.json (best-guess cfg)
+  4. comparison configs -> per-leaf BERT, NCHW ResNet
+  5. flash sweep        -> CAPTURE_flash.json
 
-Usage: python tools/capture_all.py [stage ...]   (default: all)
+Pinned stages (PT_BENCH_* env) keep each subprocess to ONE compile+time
+cycle, so a tunnel drop mid-campaign costs one bounded stage instead of
+a 50-minute autotune (round-3 lesson: the unpinned bert stage timed out
+at 3000s and, because partial output was discarded, left nothing).
+Timeouts now preserve the stage's partial stdout/stderr — the per-config
+ms/step lines bench.py logs as it goes survive a mid-stage hang.
+
+Usage: python tools/capture_all.py [stage ...]   (default: DEFAULT_PLAN)
 Each stage is a subprocess of bench.py so a wedged PJRT init or OOM
 kills only that stage; stdout JSON lines are parsed and collected into
 CAPTURE_SUMMARY.json.
@@ -23,59 +31,107 @@ import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# name -> (bench.py argv, extra env, budget seconds)
+_SKIP = {"PT_BENCH_SKIP_VALIDATE": "1"}  # verify stage covers kernels
 STAGES = {
-    "verify": (["verify"], 1200),
-    "bert": ([], 3000),
-    "resnet": (["resnet50"], 3000),
-    "flash": (["flash"], 1800),
+    "verify": (["verify"], {}, 1200),
+    "bert_fused_b32": ([], {**_SKIP, "PT_BENCH_BERT_BATCH": "32",
+                            "PT_BENCH_FUSED": "1"}, 1800),
+    "resnet_nhwc_b128": (["resnet50"],
+                         {**_SKIP, "PT_BENCH_RESNET_BATCH": "128",
+                          "PT_BENCH_LAYOUT": "NHWC",
+                          "PT_BENCH_FUSED": "1"}, 1800),
+    "bert_perleaf_b32": ([], {**_SKIP, "PT_BENCH_BERT_BATCH": "32",
+                              "PT_BENCH_FUSED": "0"}, 1200),
+    "resnet_nchw_b128": (["resnet50"],
+                         {**_SKIP, "PT_BENCH_RESNET_BATCH": "128",
+                          "PT_BENCH_LAYOUT": "NCHW",
+                          "PT_BENCH_FUSED": "1"}, 1200),
+    "flash": (["flash"], _SKIP, 1800),
+    # unpinned autotunes (the driver's default bench path)
+    "bert": ([], {}, 3000),
+    "resnet": (["resnet50"], {}, 3000),
 }
+DEFAULT_PLAN = ["verify", "bert_fused_b32", "resnet_nhwc_b128",
+                "bert_perleaf_b32", "resnet_nchw_b128", "flash"]
 
 
 def log(msg: str) -> None:
     print(f"[capture] {msg}", file=sys.stderr, flush=True)
 
 
+def _text(v) -> str:
+    if v is None:
+        return ""
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    return v
+
+
 def run_stage(name: str) -> dict:
-    args, budget = STAGES[name]
+    args, env, budget = STAGES[name]
     t0 = time.time()
     log(f"stage {name}: starting (budget {budget}s)")
+    stdout, stderr, rc, timed_out = "", "", None, False
     try:
         r = subprocess.run(
             [sys.executable, os.path.join(ROOT, "bench.py"), *args],
-            capture_output=True, text=True, timeout=budget, cwd=ROOT)
-    except subprocess.TimeoutExpired:
-        log(f"stage {name}: TIMED OUT after {budget}s")
-        return {"stage": name, "ok": False, "error": f"timeout {budget}s"}
+            capture_output=True, text=True, timeout=budget, cwd=ROOT,
+            env={**os.environ, **env})
+        stdout, stderr, rc = r.stdout, r.stderr, r.returncode
+    except subprocess.TimeoutExpired as e:
+        # partial output is the whole point: bench.py logs each
+        # config's ms/step to stderr as it measures
+        stdout, stderr = _text(e.stdout), _text(e.stderr)
+        timed_out = True
+        log(f"stage {name}: TIMED OUT after {budget}s "
+            f"(keeping partial output)")
     parsed = None
-    for line in (r.stdout or "").splitlines():
+    for line in (stdout or "").splitlines():
         line = line.strip()
         if line.startswith("{"):
             try:
                 parsed = json.loads(line)
             except json.JSONDecodeError:
                 continue
-    out = {"stage": name, "ok": r.returncode == 0 and parsed is not None,
-           "rc": r.returncode, "parsed": parsed,
+    # a stage that printed its result JSON and then wedged in PJRT
+    # teardown still produced a usable measurement — don't re-run it
+    out = {"stage": name,
+           "ok": parsed is not None and (rc == 0 or timed_out),
+           "rc": rc, "timed_out": timed_out, "parsed": parsed,
            "elapsed_s": round(time.time() - t0, 1),
-           "stderr_tail": (r.stderr or "").splitlines()[-8:]}
+           "env": env,
+           "stderr_tail": (stderr or "").splitlines()[-25:]}
     result_path = os.path.join(ROOT, f"CAPTURE_{name}.json")
     with open(result_path, "w") as f:
         json.dump(out, f, indent=1)
-    log(f"stage {name}: rc={r.returncode} parsed={parsed} "
+    log(f"stage {name}: rc={rc} parsed={parsed} "
         f"({out['elapsed_s']}s) -> {result_path}")
     return out
 
 
 def main() -> None:
-    wanted = sys.argv[1:] or ["verify", "bert", "resnet", "flash"]
+    wanted = sys.argv[1:] or DEFAULT_PLAN
     unknown = [w for w in wanted if w not in STAGES]
     if unknown:
         raise SystemExit(f"unknown stages {unknown}; pick from "
                          f"{sorted(STAGES)}")
     results = [run_stage(name) for name in wanted]
+    # merge into any existing summary so a retry campaign over the
+    # remaining stages doesn't erase earlier stages' records
+    summary_path = os.path.join(ROOT, "CAPTURE_SUMMARY.json")
+    by_stage: dict = {}
+    try:
+        with open(summary_path) as f:
+            for r in json.load(f).get("results", []):
+                by_stage[r.get("stage")] = r
+    except (OSError, json.JSONDecodeError):
+        pass
+    for r in results:
+        by_stage[r["stage"]] = r
     summary = {"when": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-               "results": results}
-    with open(os.path.join(ROOT, "CAPTURE_SUMMARY.json"), "w") as f:
+               "results": list(by_stage.values())}
+    with open(summary_path, "w") as f:
         json.dump(summary, f, indent=1)
     log(f"campaign done: {[(r['stage'], r['ok']) for r in results]}")
     sys.exit(0 if all(r["ok"] for r in results) else 1)

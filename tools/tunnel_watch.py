@@ -1,17 +1,24 @@
 """Watch for the accelerator tunnel to come back, then run the capture
-campaign (tools/capture_all.py) once and exit.
+campaign (tools/capture_all.py) for whichever stages still lack a good
+artifact, looping until every wanted stage has one.
 
 Each probe runs ``jax.default_backend()`` in a subprocess with a hard
 timeout so a wedged PJRT init never hangs the watcher. Probe cadence is
 ~3 min; every outcome is appended to tools/tunnel_watch.log with a
 timestamp so the outage window is documented for the round ledger.
 
+The round-3 tunnel flaps (up for minutes, down for hours), so a single
+campaign run is not enough: after each attempt the watcher re-reads the
+CAPTURE_*.json artifacts and retries only the stages that are still
+missing or not ok.
+
 Usage: python tools/tunnel_watch.py [stage ...]
-Stages are forwarded to capture_all.py (default: the full campaign).
+Stages are forwarded to capture_all.py (default: its DEFAULT_PLAN).
 """
 
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
@@ -19,6 +26,13 @@ import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(ROOT, "tools", "tunnel_watch.log")
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+from capture_all import DEFAULT_PLAN, STAGES  # noqa: E402
+
+# a stage that fails deterministically (e.g. a pinned batch that OOMs)
+# must not burn its full chip-time budget forever — give up after this
+# many campaign attempts that included it
+MAX_ATTEMPTS_PER_STAGE = 4
 
 
 def log(msg: str) -> None:
@@ -41,24 +55,54 @@ def probe(timeout_s: int = 60) -> str | None:
     return None
 
 
+def missing_stages(wanted: list[str]) -> list[str]:
+    out = []
+    for name in wanted:
+        path = os.path.join(ROOT, f"CAPTURE_{name}.json")
+        try:
+            with open(path) as f:
+                if json.load(f).get("ok"):
+                    continue
+        except (OSError, json.JSONDecodeError):
+            pass
+        out.append(name)
+    return out
+
+
 def main() -> None:
-    stages = sys.argv[1:]
-    log(f"watch start (stages={stages or 'all'})")
+    wanted = sys.argv[1:] or list(DEFAULT_PLAN)
+    unknown = [w for w in wanted if w not in STAGES]
+    if unknown:
+        raise SystemExit(f"unknown stages {unknown}; pick from "
+                         f"{sorted(STAGES)}")
+    log(f"watch start (stages={wanted})")
     n = 0
+    attempts: dict[str, int] = {}
     while True:
+        todo = [s for s in missing_stages(wanted)
+                if attempts.get(s, 0) < MAX_ATTEMPTS_PER_STAGE]
+        if not todo:
+            done = [s for s in wanted
+                    if s not in missing_stages(wanted)]
+            log(f"nothing left to try (good artifacts: {done}; "
+                f"given up: {sorted(set(wanted) - set(done))}); exiting")
+            sys.exit(0 if len(done) == len(wanted) else 1)
         backend = probe()
         if backend in ("tpu", "axon"):
             log(f"probe {n}: backend={backend} — tunnel UP; "
-                f"starting capture campaign")
+                f"capturing {todo}")
+            for s in todo:
+                attempts[s] = attempts.get(s, 0) + 1
             r = subprocess.run(
                 [sys.executable,
-                 os.path.join(ROOT, "tools", "capture_all.py"), *stages],
+                 os.path.join(ROOT, "tools", "capture_all.py"), *todo],
                 cwd=ROOT)
             log(f"capture campaign rc={r.returncode}")
-            sys.exit(r.returncode)
+            time.sleep(60)  # don't spin if a stage fails for a
+            continue        # non-tunnel reason; re-check artifacts
         log(f"probe {n}: {'backend=' + backend if backend else 'down'}")
         n += 1
-        time.sleep(150)
+        time.sleep(180)
 
 
 if __name__ == "__main__":
