@@ -39,8 +39,22 @@ class IterableDataset:
 
 
 class TensorDataset(Dataset):
+    """Dataset wrapping same-length arrays. Accepts both the reference's
+    list form ``TensorDataset([x, y])`` and varargs ``TensorDataset(x, y)``."""
+
     def __init__(self, *arrays) -> None:
+        if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+            arrays = tuple(arrays[0])
         self.arrays = [np.asarray(a) for a in arrays]
+        if any(a.ndim == 0 for a in self.arrays):
+            raise ValueError(
+                "TensorDataset entries must be indexable along a first "
+                "dimension; got a scalar (pass arrays, e.g. "
+                "TensorDataset([x, y]) or TensorDataset(x, y))")
+        if any(len(a) != len(self.arrays[0]) for a in self.arrays[1:]):
+            raise ValueError(
+                "TensorDataset arrays must share their first dimension: "
+                f"got lengths {[len(a) for a in self.arrays]}")
 
     def __getitem__(self, idx: int):
         return tuple(a[idx] for a in self.arrays)

@@ -159,6 +159,12 @@ define_flag("use_pallas_adam", False,
 define_flag("use_pallas_layer_norm", True,
             "Use the Pallas layer_norm kernel (subject to the master "
             "switch).")
+define_flag("fused_qkv_projection", True,
+            "Compute self-attention q/k/v as one [d, 3d] matmul via "
+            "trace-time weight concat (checkpoint layout unchanged). "
+            "A/B lever: round-2 chip measurement said -3% for the "
+            "separate-projections era; round-3 HLO shows fewer "
+            "dots/transposes — toggle per chip session.")
 define_flag("flash_attention_min_seq", 4096,
             "Key-sequence length at or above which attention routes to the "
             "Pallas flash kernel (below it XLA's fused attention is faster "

@@ -72,7 +72,9 @@ class MultiHeadAttention(Layer):
         # transposes on bert4L — XLA re-transposes inside dot_general
         # anyway), so the BHTD split stays until a real-chip A/B says
         # otherwise.
-        fusable = (key is None and value is None
+        from ...flags import GLOBAL_FLAGS
+        fusable = (GLOBAL_FLAGS.get("fused_qkv_projection")
+                   and key is None and value is None
                    and self.q_proj.in_features == self.k_proj.in_features
                    == self.v_proj.in_features
                    and ((self.q_proj.bias is None)

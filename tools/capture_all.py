@@ -31,8 +31,19 @@ import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# name -> (bench.py argv, extra env, budget seconds)
+# name -> (argv after bench.py, extra env, budget seconds[, script])
+# script (default bench.py) lets a stage run a different tool — the
+# profiler stages drive tools/profile_step.py.
 _SKIP = {"PT_BENCH_SKIP_VALIDATE": "1"}  # verify stage covers kernels
+_SPL1 = {"PT_BENCH_STEPS_PER_LOOP": "1"}  # measured ~1.0x; skip re-timing
+
+
+def _bert(batch, fused, qkv):
+    return ([], {**_SKIP, **_SPL1, "PT_BENCH_BERT_BATCH": str(batch),
+                 "PT_BENCH_FUSED": fused,
+                 "FLAGS_fused_qkv_projection": qkv}, 900)
+
+
 STAGES = {
     "verify": (["verify"], {}, 1200),
     "bert_fused_b32": ([], {**_SKIP, "PT_BENCH_BERT_BATCH": "32",
@@ -48,12 +59,36 @@ STAGES = {
                           "PT_BENCH_LAYOUT": "NCHW",
                           "PT_BENCH_FUSED": "1"}, 1200),
     "flash": (["flash"], _SKIP, 1800),
+    # round-3 regression hunt: fused_state measured -26% (b32), so the
+    # remaining suspects for the 121.8k -> 97.1k/b32 gap are fused QKV
+    # and per-chip batch. b8_perleaf_noqkv IS the round-2 config.
+    "bert_b8_perleaf_noqkv": _bert(8, "0", "0"),
+    "bert_b8_perleaf_qkv": _bert(8, "0", "1"),
+    "bert_b16_perleaf_noqkv": _bert(16, "0", "0"),
+    "bert_b32_perleaf_noqkv": _bert(32, "0", "0"),
+    "resnet_nhwc_b128_perleaf": (
+        ["resnet50"], {**_SKIP, **_SPL1, "PT_BENCH_RESNET_BATCH": "128",
+                       "PT_BENCH_LAYOUT": "NHWC",
+                       "PT_BENCH_FUSED": "0"}, 900),
+    "resnet_nhwc_b256_perleaf": (
+        ["resnet50"], {**_SKIP, **_SPL1, "PT_BENCH_RESNET_BATCH": "256",
+                       "PT_BENCH_LAYOUT": "NHWC",
+                       "PT_BENCH_FUSED": "0"}, 900),
+    "profile_bert": (["bert", "8"], {}, 900, "tools/profile_step.py"),
+    "profile_resnet": (["resnet", "128"],
+                       {"PT_PROF_LAYOUT": "NHWC"}, 900,
+                       "tools/profile_step.py"),
     # unpinned autotunes (the driver's default bench path)
     "bert": ([], {}, 3000),
     "resnet": (["resnet50"], {}, 3000),
 }
 DEFAULT_PLAN = ["verify", "bert_fused_b32", "resnet_nhwc_b128",
                 "bert_perleaf_b32", "resnet_nchw_b128", "flash"]
+DIAG_PLAN = ["bert_b8_perleaf_noqkv", "bert_b8_perleaf_qkv",
+             "bert_b16_perleaf_noqkv", "bert_b32_perleaf_noqkv",
+             "resnet_nhwc_b128_perleaf", "flash",
+             "profile_bert", "profile_resnet",
+             "resnet_nhwc_b256_perleaf"]
 
 
 def log(msg: str) -> None:
@@ -69,13 +104,15 @@ def _text(v) -> str:
 
 
 def run_stage(name: str) -> dict:
-    args, env, budget = STAGES[name]
+    spec = STAGES[name]
+    args, env, budget = spec[:3]
+    script = spec[3] if len(spec) > 3 else "bench.py"
     t0 = time.time()
     log(f"stage {name}: starting (budget {budget}s)")
     stdout, stderr, rc, timed_out = "", "", None, False
     try:
         r = subprocess.run(
-            [sys.executable, os.path.join(ROOT, "bench.py"), *args],
+            [sys.executable, os.path.join(ROOT, script), *args],
             capture_output=True, text=True, timeout=budget, cwd=ROOT,
             env={**os.environ, **env})
         stdout, stderr, rc = r.stdout, r.stderr, r.returncode
@@ -94,13 +131,17 @@ def run_stage(name: str) -> dict:
                 parsed = json.loads(line)
             except json.JSONDecodeError:
                 continue
-    # a stage that printed its result JSON and then wedged in PJRT
-    # teardown still produced a usable measurement — don't re-run it
+    # a stage that printed its result JSON and then wedged (or crashed)
+    # in PJRT teardown still produced a usable measurement — don't
+    # re-run it regardless of rc. Profiler stages emit a text rollup,
+    # not a JSON line: rc==0 is their ok.
+    stage_ok = parsed is not None or (script != "bench.py" and rc == 0)
     out = {"stage": name,
-           "ok": parsed is not None and (rc == 0 or timed_out),
+           "ok": stage_ok,
            "rc": rc, "timed_out": timed_out, "parsed": parsed,
            "elapsed_s": round(time.time() - t0, 1),
            "env": env,
+           "stdout_tail": (stdout or "").splitlines()[-45:],
            "stderr_tail": (stderr or "").splitlines()[-25:]}
     result_path = os.path.join(ROOT, f"CAPTURE_{name}.json")
     with open(result_path, "w") as f:
@@ -110,8 +151,22 @@ def run_stage(name: str) -> dict:
     return out
 
 
+def resolve_plan(names: list) -> list:
+    """Expand plan aliases ('default', 'diag') into stage lists; shared
+    with tunnel_watch so both entry points accept the same argv."""
+    out: list = []
+    for n in names:
+        if n == "default":
+            out.extend(DEFAULT_PLAN)
+        elif n == "diag":
+            out.extend(DIAG_PLAN)
+        else:
+            out.append(n)
+    return out
+
+
 def main() -> None:
-    wanted = sys.argv[1:] or DEFAULT_PLAN
+    wanted = resolve_plan(sys.argv[1:] or list(DEFAULT_PLAN))
     unknown = [w for w in wanted if w not in STAGES]
     if unknown:
         raise SystemExit(f"unknown stages {unknown}; pick from "

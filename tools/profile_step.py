@@ -1,0 +1,143 @@
+"""Capture an on-chip profile of one bench model's train step and
+aggregate op self-times from the perfetto trace.
+
+Usage: python tools/profile_step.py {bert|resnet} [batch]
+Writes profiles/<model>/... and prints the top-30 ops by total duration
+plus a category rollup (matmul/conv/copy/transpose/elementwise/other) —
+the same aggregation the round-2 README profile used.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def capture(model: str, batch: int) -> str:
+    import numpy as np
+
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.static import TrainStep
+
+    outdir = os.path.join(ROOT, "profiles", model)
+    os.makedirs(outdir, exist_ok=True)
+    rng = np.random.default_rng(0)
+    if model == "bert":
+        from paddle_tpu.models import (BertConfig, BertForPretraining,
+                                       pretraining_loss)
+        config = BertConfig()
+        seq = 512
+        pt.seed(0)
+        m = BertForPretraining(config)
+        m.to(dtype="bfloat16")
+        o = pt.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01)
+        step = TrainStep(m, o, lambda out, a, b: pretraining_loss(out, a, b))
+        ids = rng.integers(0, config.vocab_size, (batch, seq)).astype("int32")
+        mlm = rng.integers(0, config.vocab_size, (batch, seq)).astype("int64")
+        nsp = rng.integers(0, 2, (batch,)).astype("int64")
+        run = lambda: step(ids, labels=(mlm, nsp))
+    else:
+        import jax.numpy as jnp
+        from paddle_tpu.models.resnet import resnet50
+        layout = os.environ.get("PT_PROF_LAYOUT", "NCHW")
+        pt.seed(0)
+        m = resnet50(data_format=layout)
+        m.to(dtype="bfloat16")
+        o = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+        step = TrainStep(m, o, lambda out, t:
+                         pt.nn.functional.cross_entropy(out, t))
+        x = rng.normal(0, 1, (batch, 3, 224, 224))
+        if layout == "NHWC":
+            x = np.transpose(x, (0, 2, 3, 1))
+        x = jnp.asarray(x, jnp.bfloat16)
+        y = rng.integers(0, 1000, (batch,)).astype("int64")
+        run = lambda: step(x, labels=y)
+
+    # warm up (compile) outside the trace
+    for _ in range(3):
+        float(run()["loss"])
+    with jax.profiler.trace(outdir):
+        for _ in range(5):
+            r = run()
+        float(r["loss"])
+    return outdir
+
+
+def aggregate(outdir: str) -> None:
+    traces = sorted(glob.glob(os.path.join(
+        outdir, "**", "*.trace.json.gz"), recursive=True))
+    if not traces:
+        print(f"no trace.json.gz under {outdir}", file=sys.stderr)
+        return
+    with gzip.open(traces[-1], "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", [])
+    # keep only TPU-side complete events (device op lanes), not host
+    pid_names = {e.get("pid"): e.get("args", {}).get("name", "")
+                 for e in events if e.get("ph") == "M"
+                 and e.get("name") == "process_name"}
+    device_pids = {p for p, n in pid_names.items()
+                   if "TPU" in n or "tpu" in n or "/device" in n.lower()
+                   or "XLA" in n}
+    durs: dict = defaultdict(float)
+    counts: dict = defaultdict(int)
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        if device_pids and e.get("pid") not in device_pids:
+            continue
+        name = e.get("name", "?")
+        d = float(e.get("dur", 0.0))
+        durs[name] += d
+        counts[name] += 1
+        total += d
+
+    def category(name: str) -> str:
+        n = name.lower()
+        if "conv" in n:
+            return "conv"
+        if "dot" in n or "matmul" in n or "fusion" in n and "dot" in n:
+            return "matmul/fusion"
+        if "copy" in n:
+            return "copy"
+        if "transpose" in n:
+            return "transpose"
+        if any(k in n for k in ("fused", "fusion", "loop", "add",
+                                "mul", "sub", "div", "select")):
+            return "elementwise/fusion"
+        if any(k in n for k in ("reduce", "scatter", "gather",
+                                "dynamic", "slice", "iota", "rng",
+                                "convert", "broadcast")):
+            return "data-movement/reduce"
+        return "other"
+
+    cats: dict = defaultdict(float)
+    for name, d in durs.items():
+        cats[category(name)] += d
+    print(f"\n== device op time rollup (total {total / 1e3:.2f} ms over "
+          f"trace) ==")
+    for c, d in sorted(cats.items(), key=lambda kv: -kv[1]):
+        print(f"  {c:24s} {d / 1e3:9.2f} ms  {d / total * 100:5.1f}%")
+    print("\n== top 30 ops by total duration ==")
+    for name, d in sorted(durs.items(), key=lambda kv: -kv[1])[:30]:
+        print(f"  {d / 1e3:9.2f} ms  x{counts[name]:<5d} {name[:100]}")
+
+
+def main() -> None:
+    model = sys.argv[1] if len(sys.argv) > 1 else "bert"
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else \
+        (8 if model == "bert" else 64)
+    outdir = capture(model, batch)
+    aggregate(outdir)
+
+
+if __name__ == "__main__":
+    main()

@@ -27,7 +27,7 @@ import time
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(ROOT, "tools", "tunnel_watch.log")
 sys.path.insert(0, os.path.join(ROOT, "tools"))
-from capture_all import DEFAULT_PLAN, STAGES  # noqa: E402
+from capture_all import DEFAULT_PLAN, STAGES, resolve_plan  # noqa: E402
 
 # a stage that fails deterministically (e.g. a pinned batch that OOMs)
 # must not burn its full chip-time budget forever — give up after this
@@ -69,8 +69,28 @@ def missing_stages(wanted: list[str]) -> list[str]:
     return out
 
 
+def _stage_ran(name: str) -> bool:
+    """True when the stage's artifact shows it actually executed on the
+    chip (as opposed to aborting on the probe because the tunnel dropped
+    mid-campaign, rc=3, or timing out with nothing measured) — only real
+    runs count against MAX_ATTEMPTS_PER_STAGE, so a flapping tunnel can
+    never permanently abandon a stage that was starved of chip time."""
+    try:
+        with open(os.path.join(ROOT, f"CAPTURE_{name}.json")) as f:
+            d = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return False
+    if d.get("ok"):
+        return True
+    if d.get("rc") == 3:  # bench probe-fail fast abort
+        return False
+    if d.get("timed_out") and d.get("parsed") is None:
+        return False  # hung mid-run: indistinguishable from an outage
+    return True
+
+
 def main() -> None:
-    wanted = sys.argv[1:] or list(DEFAULT_PLAN)
+    wanted = resolve_plan(sys.argv[1:] or list(DEFAULT_PLAN))
     unknown = [w for w in wanted if w not in STAGES]
     if unknown:
         raise SystemExit(f"unknown stages {unknown}; pick from "
@@ -91,12 +111,13 @@ def main() -> None:
         if backend in ("tpu", "axon"):
             log(f"probe {n}: backend={backend} — tunnel UP; "
                 f"capturing {todo}")
-            for s in todo:
-                attempts[s] = attempts.get(s, 0) + 1
             r = subprocess.run(
                 [sys.executable,
                  os.path.join(ROOT, "tools", "capture_all.py"), *todo],
                 cwd=ROOT)
+            for s in todo:
+                if _stage_ran(s):
+                    attempts[s] = attempts.get(s, 0) + 1
             log(f"capture campaign rc={r.returncode}")
             time.sleep(60)  # don't spin if a stage fails for a
             continue        # non-tunnel reason; re-check artifacts
