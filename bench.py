@@ -142,7 +142,10 @@ def bench_bert(on_accel: bool) -> None:
             raise SystemExit(
                 f"PT_BENCH_FUSED={pin!r}: expected 0/1/true/false")
     elif on_accel:
-        candidates = [True, False]
+        # per-leaf first: measured 97.1k vs 77.1k tok/s (b32, v5e,
+        # CAPTURE_bert_perleaf_b32 vs _fused_b32) — if the selection
+        # cap trips, the winner is already in hand
+        candidates = [False, True]
     else:
         candidates = [False]
     best = None
@@ -257,8 +260,10 @@ def bench_resnet(on_accel: bool) -> None:
     pin_fused = os.environ.get("PT_BENCH_FUSED")
     layouts = [pin_layout.strip().upper()] if pin_layout else \
         (["NHWC", "NCHW"] if on_accel else ["NCHW"])
+    # per-leaf momentum first (BERT chip evidence says fused state costs
+    # ~26% on this runtime; ResNet per-leaf stage queued to confirm)
     fuseds = [pin_fused.strip() in ("1", "true", "yes", "on")] \
-        if pin_fused else ([True, False] if on_accel else [False])
+        if pin_fused else ([False, True] if on_accel else [False])
     candidates = [(df, fu) for df in layouts for fu in fuseds]
     best = None
     select_t0 = time.perf_counter()

@@ -40,7 +40,12 @@ class IterableDataset:
 
 class TensorDataset(Dataset):
     """Dataset wrapping same-length arrays. Accepts both the reference's
-    list form ``TensorDataset([x, y])`` and varargs ``TensorDataset(x, y)``."""
+    list form ``TensorDataset([x, y])`` and varargs ``TensorDataset(x, y)``.
+
+    Note the list form follows the reference contract (a list OF
+    tensors): ``TensorDataset([[1, 2], [3, 4]])`` is two length-2
+    entries yielding samples ``(1, 3)`` and ``(2, 4)`` — to wrap a
+    single 2-D array, pass it as one array: ``TensorDataset(arr)``."""
 
     def __init__(self, *arrays) -> None:
         if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):

@@ -142,13 +142,15 @@ define_flag("use_pallas_kernels", True,
 define_flag("optimizer_fused_state", False,
             "Pack optimizer state (m/v/master) into flat fp32 vectors: "
             "one elementwise update over 3 buffers instead of 3 buffers "
-            "PER parameter (~600 for BERT-base). Targets the per-buffer "
-            "runtime overhead seen on the axon dispatch path (profiled "
-            "~1.1k copy + 1.9k slice ops/step at 2us each). Off until "
-            "real-TPU measurements confirm the win; Lamb/Lars and "
-            "RowSlices-sparse paths always stay per-leaf. "
-            "(ref capability: merged/multi-tensor optimizers, "
-            "incubate multi_tensor_apply.)")
+            "PER parameter (~600 for BERT-base). MEASURED A REGRESSION "
+            "on real v5e (round 3): BERT-base b32xs512 97.1k tok/s "
+            "per-leaf vs 77.1k fused (-26%) — the in-graph pack/unpack "
+            "slices cost more than the dispatch copies they save, and "
+            "steps-per-loop measured per-dispatch overhead at ~0 anyway. "
+            "Stays available for runtimes where per-buffer dispatch IS "
+            "the bottleneck; Lamb/Lars and RowSlices-sparse paths always "
+            "stay per-leaf. (ref capability: merged/multi-tensor "
+            "optimizers, incubate multi_tensor_apply.)")
 define_flag("use_pallas_adam", False,
             "Use the Pallas fused-adam kernel. Off by default: measured on "
             "v5e the flatten/unflatten layout copies it forces on 2-D "

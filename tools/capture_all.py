@@ -131,11 +131,15 @@ def run_stage(name: str) -> dict:
                 parsed = json.loads(line)
             except json.JSONDecodeError:
                 continue
-    # a stage that printed its result JSON and then wedged (or crashed)
-    # in PJRT teardown still produced a usable measurement — don't
-    # re-run it regardless of rc. Profiler stages emit a text rollup,
-    # not a JSON line: rc==0 is their ok.
-    stage_ok = parsed is not None or (script != "bench.py" and rc == 0)
+    # a stage that printed its result JSON and then wedged (timeout) or
+    # crashed (negative rc = signal) in PJRT teardown still produced a
+    # usable measurement — but a DELIBERATE failure exit (verify prints
+    # value 0.0 then sys.exit(1)) must stay not-ok so the watcher
+    # retries it. Profiler stages emit a text rollup: rc==0 is their ok.
+    stage_ok = (parsed is not None
+                and (rc == 0 or timed_out
+                     or (rc is not None and rc < 0))) or \
+        (script != "bench.py" and rc == 0)
     out = {"stage": name,
            "ok": stage_ok,
            "rc": rc, "timed_out": timed_out, "parsed": parsed,

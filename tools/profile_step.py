@@ -74,8 +74,10 @@ def aggregate(outdir: str) -> None:
     traces = sorted(glob.glob(os.path.join(
         outdir, "**", "*.trace.json.gz"), recursive=True))
     if not traces:
+        # a profiler stage with no trace produced no data — exit nonzero
+        # so capture_all records it not-ok and the watcher retries
         print(f"no trace.json.gz under {outdir}", file=sys.stderr)
-        return
+        sys.exit(2)
     with gzip.open(traces[-1], "rt") as f:
         data = json.load(f)
     events = data.get("traceEvents", [])
@@ -104,7 +106,7 @@ def aggregate(outdir: str) -> None:
         n = name.lower()
         if "conv" in n:
             return "conv"
-        if "dot" in n or "matmul" in n or "fusion" in n and "dot" in n:
+        if "dot" in n or "matmul" in n:
             return "matmul/fusion"
         if "copy" in n:
             return "copy"
@@ -132,6 +134,19 @@ def aggregate(outdir: str) -> None:
 
 
 def main() -> None:
+    # same probe + rc=3 fast-abort protocol as bench.py, so the watcher
+    # can tell a tunnel outage from a real failed attempt
+    sys.path.insert(0, ROOT)
+    from bench import _probe_backend
+    if not _probe_backend():
+        print("[profile] backend unreachable; aborting (rc=3)",
+              file=sys.stderr)
+        sys.exit(3)
+    import jax
+    if not any(d.platform in ("tpu", "axon") for d in jax.devices()):
+        print("[profile] no accelerator device (CPU fallback would "
+              "record a host-only trace); aborting", file=sys.stderr)
+        sys.exit(3)
     model = sys.argv[1] if len(sys.argv) > 1 else "bert"
     batch = int(sys.argv[2]) if len(sys.argv) > 2 else \
         (8 if model == "bert" else 64)

@@ -70,23 +70,21 @@ def missing_stages(wanted: list[str]) -> list[str]:
 
 
 def _stage_ran(name: str) -> bool:
-    """True when the stage's artifact shows it actually executed on the
-    chip (as opposed to aborting on the probe because the tunnel dropped
-    mid-campaign, rc=3, or timing out with nothing measured) — only real
-    runs count against MAX_ATTEMPTS_PER_STAGE, so a flapping tunnel can
-    never permanently abandon a stage that was starved of chip time."""
+    """True when the stage's artifact shows it actually got chip time —
+    only those runs count against MAX_ATTEMPTS_PER_STAGE. A stage that
+    aborted on its backend probe (rc=3) never ran: the tunnel dropped
+    between the watcher's probe and the stage's turn in the campaign, so
+    a flapping tunnel can't permanently abandon stages it starved.
+    Timeouts DO count: a mid-run tunnel drop can look like one, but only
+    for the single stage that was executing (later stages fail rc=3), so
+    a deterministically-hanging stage still exhausts its attempts
+    instead of burning its budget forever."""
     try:
         with open(os.path.join(ROOT, f"CAPTURE_{name}.json")) as f:
             d = json.load(f)
     except (OSError, json.JSONDecodeError):
         return False
-    if d.get("ok"):
-        return True
-    if d.get("rc") == 3:  # bench probe-fail fast abort
-        return False
-    if d.get("timed_out") and d.get("parsed") is None:
-        return False  # hung mid-run: indistinguishable from an outage
-    return True
+    return d.get("rc") != 3
 
 
 def main() -> None:
