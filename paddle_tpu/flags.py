@@ -171,6 +171,12 @@ define_flag("flash_attention_min_seq", 4096,
             "Key-sequence length at or above which attention routes to the "
             "Pallas flash kernel (below it XLA's fused attention is faster "
             "on v5e; the flash kernel is always O(T) memory).")
+define_flag("resnet_space_to_depth_stem", False,
+            "Rewrite the ResNet 7x7/s2 stem conv as an exact 4x4/s1 "
+            "conv over space-to-depth-folded 12-channel input (the "
+            "MLPerf TPU trick: 3 input channels waste MXU lanes). NHWC "
+            "only; checkpoints unchanged. A/B candidate pending chip "
+            "measurement.")
 define_flag("use_fast_rng", True,
             "On TPU, use the hardware RngBitGenerator PRNG ('rbg') for "
             "jax.random keys instead of threefry. Dropout-heavy training "

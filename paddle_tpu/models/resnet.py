@@ -15,7 +15,40 @@ from __future__ import annotations
 
 from typing import List, Optional, Type, Union
 
+import jax.numpy as jnp
+from jax import lax
+
 from .. import nn
+from ..flags import GLOBAL_FLAGS
+
+
+def _space_to_depth_stem(x, weight_oihw):
+    """The MLPerf TPU stem transform: the 7x7/stride-2 conv over 3 input
+    channels wastes MXU channel lanes (3 of the 8-padded lanes carry
+    data). Rearranged EXACTLY as a 4x4/stride-1 conv over 12 channels:
+    pad the kernel to 8x8 (zero row/col at index 0), then fold each 2x2
+    input block into channels. NHWC only; parameter layout (OIHW 64x3x7x7)
+    and checkpoints unchanged — the weight is transformed at trace time.
+
+    out[n,i,j,o] = sum_{a,b,p,q,c} s2d(x)[n,i+a-2,j+b-2,(p,q,c)]
+                   * W'[2a+p,2b+q,c,o]      (derivation: dy'=2a+p)
+    """
+    n, h, w, c = x.shape
+    # s2d: [N,H,W,3] -> [N,H/2,W/2,12], channel index = (p, q, c)
+    xs = x.reshape(n, h // 2, 2, w // 2, 2, c)
+    xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2, 4 * c)
+    # OIHW [64,3,7,7] -> HWIO [7,7,3,64] -> zero-pad to [8,8,3,64]
+    wk = jnp.transpose(weight_oihw, (2, 3, 1, 0))
+    wk = jnp.pad(wk, ((1, 0), (1, 0), (0, 0), (0, 0)))
+    # [8,8,3,64] -> [a,p,b,q,c,o] -> [4,4,(p,q,c),64]
+    kh, kw, ci, co = wk.shape
+    wk = wk.reshape(kh // 2, 2, kw // 2, 2, ci, co)
+    wk = wk.transpose(0, 2, 1, 3, 4, 5).reshape(kh // 2, kw // 2,
+                                                4 * ci, co)
+    return lax.conv_general_dilated(
+        xs, wk.astype(x.dtype), window_strides=(1, 1),
+        padding=((2, 1), (2, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
 class BasicBlock(nn.Layer):
@@ -135,7 +168,13 @@ class ResNet(nn.Layer):
         return nn.Sequential(*layers)
 
     def forward(self, x):
-        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        if (GLOBAL_FLAGS.get("resnet_space_to_depth_stem")
+                and self.data_format == "NHWC"
+                and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0):
+            x = _space_to_depth_stem(x, self.conv1.weight)
+        else:
+            x = self.conv1(x)
+        x = self.maxpool(self.relu(self.bn1(x)))
         x = self.layer1(x)
         x = self.layer2(x)
         x = self.layer3(x)

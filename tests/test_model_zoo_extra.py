@@ -520,3 +520,37 @@ def test_adaptive_pool_upsample_no_nan():
     got = np.asarray(adaptive_avg_pool2d(y, (1, 2)))
     # bins: [0,3) and [2,5) per floor/ceil math
     np.testing.assert_allclose(got[0, 0, 0], [1.0, 3.0])
+
+
+def test_resnet_space_to_depth_stem_exact():
+    """The MLPerf s2d stem rewrite (flag resnet_space_to_depth_stem)
+    must compute the SAME function as the 7x7/s2 stem conv: the padded
+    kernel's zero row/col kills the out-of-range taps, so outputs match
+    to fp32 conv reassociation tolerance on every spatial position
+    (borders included)."""
+    from paddle_tpu.models.resnet import BasicBlock, ResNet
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (2, 24, 24, 3)).astype(np.float32)
+
+    pt.seed(0)
+    m = ResNet(BasicBlock, [1, 1, 1, 1], num_classes=10,
+               data_format="NHWC")
+    m.eval()
+    try:
+        pt.set_flags({"resnet_space_to_depth_stem": False})
+        base = np.asarray(m(x))
+        pt.set_flags({"resnet_space_to_depth_stem": True})
+        s2d = np.asarray(m(x))
+    finally:
+        pt.set_flags({"resnet_space_to_depth_stem": False})
+    np.testing.assert_allclose(s2d, base, rtol=2e-5, atol=2e-5)
+
+    # odd spatial sizes must fall back to the plain stem, not crash
+    x_odd = rng.normal(0, 1, (1, 23, 23, 3)).astype(np.float32)
+    try:
+        pt.set_flags({"resnet_space_to_depth_stem": True})
+        out_odd = np.asarray(m(x_odd))
+    finally:
+        pt.set_flags({"resnet_space_to_depth_stem": False})
+    assert out_odd.shape == (1, 10)

@@ -74,7 +74,13 @@ STAGES = {
         ["resnet50"], {**_SKIP, **_SPL1, "PT_BENCH_RESNET_BATCH": "256",
                        "PT_BENCH_LAYOUT": "NHWC",
                        "PT_BENCH_FUSED": "0"}, 900),
+    "resnet_nhwc_b128_s2d": (
+        ["resnet50"], {**_SKIP, **_SPL1, "PT_BENCH_RESNET_BATCH": "128",
+                       "PT_BENCH_LAYOUT": "NHWC", "PT_BENCH_FUSED": "0",
+                       "FLAGS_resnet_space_to_depth_stem": "1"}, 900),
     "profile_bert": (["bert", "8"], {}, 900, "tools/profile_step.py"),
+    "profile_bert_b32": (["bert", "32"], {}, 900,
+                         "tools/profile_step.py"),
     "profile_resnet": (["resnet", "128"],
                        {"PT_PROF_LAYOUT": "NHWC"}, 900,
                        "tools/profile_step.py"),
@@ -87,8 +93,8 @@ DEFAULT_PLAN = ["verify", "bert_fused_b32", "resnet_nhwc_b128",
 DIAG_PLAN = ["bert_b8_perleaf_noqkv", "bert_b8_perleaf_qkv",
              "bert_b16_perleaf_noqkv", "bert_b32_perleaf_noqkv",
              "resnet_nhwc_b128_perleaf", "flash",
-             "profile_bert", "profile_resnet",
-             "resnet_nhwc_b256_perleaf"]
+             "profile_bert", "profile_bert_b32", "profile_resnet",
+             "resnet_nhwc_b256_perleaf", "resnet_nhwc_b128_s2d"]
 
 
 def log(msg: str) -> None:
