@@ -394,6 +394,83 @@ def bench_flash_attention(on_accel: bool) -> None:
     }))
 
 
+def bench_flash_train(on_accel: bool) -> None:
+    """Training-mode flash crossover: fwd+bwd at BERT geometry (head
+    dim 64, attention dropout 0.1) — the numbers that set
+    flash_attention_min_seq for the flagship model, which the fwd-only
+    d128 sweep does not represent (the XLA backward re-materializes the
+    [T, T] probs in fp32; flash recomputes them blockwise)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.kernels.flash_attention import flash_attention
+    from paddle_tpu.ops.attention import scaled_dot_product_attention
+
+    rng = np.random.default_rng(0)
+    b, h, d = (4, 12, 64) if on_accel else (1, 2, 64)
+    pd = 0.1
+    seqs = (512, 1024, 2048, 4096, 8192) if on_accel else (256,)
+    seed = jnp.asarray([[7]], jnp.int32)
+    results = {}
+    for t in seqs:
+        q = jnp.asarray(rng.normal(0, 1, (b, h, t, d)), jnp.bfloat16)
+
+        def loss_flash(q_):
+            return jnp.sum(flash_attention(
+                q_, q_, q_, False, None, not on_accel, pd, seed)
+                .astype(jnp.float32))
+
+        def loss_xla(q_):
+            key = jax.random.PRNGKey(7)
+            return jnp.sum(scaled_dot_product_attention(
+                q_, q_, q_, dropout_p=pd, training=True, key=key)
+                .astype(jnp.float32))
+
+        def run(loss):
+            f = jax.jit(jax.grad(loss))
+            for _ in range(3):
+                f(q)[0, 0, 0, 0].block_until_ready()
+            n = 10
+            t0 = time.perf_counter()
+            for _ in range(n):
+                r = f(q)
+            float(r[0, 0, 0, 0])
+            return (time.perf_counter() - t0) / n * 1e3
+
+        def timed(loss, name):
+            try:
+                return run(loss)
+            except Exception as e:  # noqa: BLE001
+                if looks_oom(e):
+                    log(f"seq {t}: {name} OOM; recording None")
+                    return None
+                raise
+
+        xla_ms = timed(loss_xla, "xla")
+        flash_ms = timed(loss_flash, "flash")
+        results[t] = (xla_ms, flash_ms)
+        if xla_ms and flash_ms:
+            log(f"seq {t}: train xla {xla_ms:.2f}ms  flash "
+                f"{flash_ms:.2f}ms  speedup {xla_ms / flash_ms:.2f}x")
+        elif flash_ms:
+            log(f"seq {t}: xla OOM, flash {flash_ms:.2f}ms")
+    both = [t for t, (a, c) in results.items() if a and c]
+    t_big = max(both) if both else seqs[0]
+    xla_ms, flash_ms = results[t_big]
+    speed = round(xla_ms / flash_ms, 3) if (xla_ms and flash_ms) else 0.0
+    crossover = [t for t, (a, c) in results.items()
+                 if a and c and c < a]
+    log(f"flash train-mode wins at seqs {crossover}")
+    print(json.dumps({
+        "metric": f"flash-attention train fwd+bwd speedup vs XLA "
+                  f"@seq{t_big} (d64+dropout)",
+        "value": speed,
+        "unit": "x",
+        "vs_baseline": speed,
+    }))
+
+
 def _probe_backend(attempts: int = 3, timeout_s: int = 60) -> bool:
     """Fail FAST (with retries) if the accelerator tunnel is hung or
     down, instead of hanging until the driver's timeout (round 1's
@@ -481,6 +558,8 @@ def main() -> None:
         bench_resnet(on_accel)
     elif which == "flash":
         bench_flash_attention(on_accel)
+    elif which == "flash_train":
+        bench_flash_train(on_accel)
     else:
         bench_bert(on_accel)
 

@@ -48,16 +48,35 @@ def maybe_flash_attention(q, k, v, mask=None, scale: Optional[float] = None,
                           training: bool = False):
     """q/k/v: [B, H, T, D].
 
-    Routing measured on v5e: XLA's attention wins below ~4k sequence
-    (and for head dims that underfill the 128-lane MXU); the flash kernel
-    wins beyond it and, more importantly, keeps memory O(T) instead of
-    materializing the [T, T] scores, so long context doesn't OOM.
+    Routing measured on v5e: XLA's attention wins below the
+    flash_attention_min_seq crossover; the flash kernel wins beyond it
+    and, more importantly, keeps memory O(T) instead of materializing
+    the [T, T] scores, so long context doesn't OOM. Attention dropout
+    runs INSIDE the kernel (counter-based mask, same bits in the
+    recompute backward), so training models like BERT (head dim 64,
+    attn dropout 0.1) stay on the flash path at long sequence.
     """
     from ..ops.attention import scaled_dot_product_attention as ref_impl
-    if (pallas_enabled() and dropout_p == 0.0 and mask is None
-            and q.ndim == 4 and q.shape[-1] % 128 == 0
+    d = q.shape[-1]
+    # d%128 keeps MXU lanes full (measured routing). Narrower head dims
+    # (BERT's 64) route only where flash's O(T) memory is the point:
+    # training (the XLA backward materializes [T,T] probs in fp32) or
+    # eval at lengths where the fwd scores alone are HBM-scale.
+    d_ok = d % 128 == 0 or (d % 8 == 0
+                            and (training or k.shape[2] >= 8192))
+    if (pallas_enabled() and mask is None and q.ndim == 4 and d_ok
             and k.shape[2] >= GLOBAL_FLAGS.get("flash_attention_min_seq")):
         from .flash_attention import flash_attention
+        if dropout_p > 0.0 and training:
+            import jax.numpy as jnp
+
+            from ..core import random as _random
+            seed = jax.random.randint(
+                _random.next_key("dropout"), (1, 1), 0, 2 ** 31 - 1,
+                dtype=jnp.int32)
+            return flash_attention(q, k, v, seed=seed, causal=causal,
+                                   scale=scale,
+                                   dropout_p=float(dropout_p))
         return flash_attention(q, k, v, causal=causal, scale=scale)
     return ref_impl(q, k, v, mask=mask, scale=scale, causal=causal,
                     dropout_p=dropout_p, training=training)

@@ -34,11 +34,38 @@ BLOCK_K = 256
 _NEG_INF = -1e30
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+def _fmix32(x):
+    """murmur3 finalizer: full-avalanche 32-bit mix (uint32 in/out)."""
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> jnp.uint32(13))
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> jnp.uint32(16))
+    return x
+
+
+def _dropout_keep(seed, g, q_pos, k_pos, dropout_p: float):
+    """Counter-based keep mask: bits are a pure hash of (seed, head,
+    global q/k position), so the SAME mask regenerates bitwise in the
+    forward and in both recompute backward kernels — no PRNG state, and
+    it runs identically under the Pallas interpreter on CPU."""
+    h = _fmix32(seed.astype(jnp.uint32) ^
+                _fmix32(jnp.uint32(g) + jnp.uint32(0x9E3779B9)))
+    # mix the two coordinates through separate rounds (a single linear
+    # q*T+k counter would alias positions once seq_q*seq_k > 2^32)
+    u = _fmix32(q_pos.astype(jnp.uint32) + h)
+    bits = _fmix32(u ^ (k_pos.astype(jnp.uint32)
+                        * jnp.uint32(0x9E3779B9)))
+    threshold = jnp.uint32(min(int(dropout_p * 4294967296.0), 4294967295))
+    return bits >= threshold
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref, *,
                       scale: float, causal: bool, block_k: int,
-                      seq_k: int, seq_q: int):
+                      seq_k: int, seq_q: int, dropout_p: float):
     q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
     block_q = q.shape[0]
+    g = pl.program_id(0)
     i_q = pl.program_id(1)
 
     num_k = pl.cdiv(seq_k, block_k)
@@ -56,9 +83,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         k_pos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         valid = k_pos < seq_k                          # tail-block mask
+        q_pos = i_q * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
         if causal:
-            q_pos = i_q * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
             valid = jnp.logical_and(valid,
                                     q_pos + causal_offset >= k_pos)
         s = jnp.where(valid, s, _NEG_INF)
@@ -66,7 +93,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
+        # l accumulates the full softmax denominator (undropped p);
+        # dropout zeroes entries only in the numerator accumulator
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_p > 0.0:
+            keep = _dropout_keep(seed_ref[0, 0], g, q_pos, k_pos,
+                                 dropout_p)
+            p = jnp.where(keep, p, 0.0)
         acc = acc * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -84,12 +117,21 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         upper = num_k
     acc, m_fin, l_fin = jax.lax.fori_loop(0, upper, body, (acc0, m0, l0))
     safe_l = jnp.maximum(l_fin, 1e-30)
-    o_ref[0] = (acc / safe_l).astype(o_ref.dtype)
+    out = acc / safe_l
+    if dropout_p > 0.0:
+        out = out / (1.0 - dropout_p)
+    o_ref[0] = out.astype(o_ref.dtype)
     lse_ref[0] = m_fin + jnp.log(safe_l)  # [BQ, 1]
 
 
-def _flash_forward(q, k, v, scale: float, causal: bool,
-                   interpret: bool = False):
+def _seed_arr(seed):
+    if seed is None:
+        return jnp.zeros((1, 1), jnp.int32)
+    return jnp.asarray(seed, jnp.int32).reshape(1, 1)
+
+
+def _flash_forward(q, k, v, seed, scale: float, causal: bool,
+                   dropout_p: float, interpret: bool = False):
     b, h, tq, d = q.shape
     tk = k.shape[2]
     bq = min(BLOCK_Q, tq)
@@ -112,7 +154,7 @@ def _flash_forward(q, k, v, scale: float, causal: bool,
     grid = (b * h, tq_p // bq)
     kernel = functools.partial(_flash_fwd_kernel, scale=scale,
                                causal=causal, block_k=bk, seq_k=tk,
-                               seq_q=tq)
+                               seq_q=tq, dropout_p=dropout_p)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -123,6 +165,8 @@ def _flash_forward(q, k, v, scale: float, causal: bool,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, tk_p, d), lambda g, i: (g, 0, 0),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda g, i: (0, 0),
+                         memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda g, i: (g, i, 0),
@@ -138,37 +182,49 @@ def _flash_forward(q, k, v, scale: float, causal: bool,
             jax.ShapeDtypeStruct((b * h, tq_p, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(qr, kr, vr)
+    )(qr, kr, vr, _seed_arr(seed))
     return (out[:, :tq].reshape(b, h, tq, d),
             lse[:, :tq, 0].reshape(b, h, tq))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
-                    interpret: bool = False):
-    """Fused attention: softmax(QK^T * scale [+ causal mask]) V."""
+                    interpret: bool = False, dropout_p: float = 0.0,
+                    seed=None):
+    """Fused attention: dropout(softmax(QK^T * scale [+ causal mask])) V.
+
+    ``dropout_p`` > 0 applies post-softmax dropout INSIDE the kernel
+    (capability ref: multihead_matmul fused attention + the reference's
+    attention dropout); the keep mask is a counter-based hash of
+    (seed, head, position), regenerated bitwise in the recompute
+    backward. ``seed``: int32 scalar/array; required when dropout_p > 0.
+    """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    out, _ = _flash_forward(q, k, v, scale, causal, interpret)
+    out, _ = _flash_forward(q, k, v, seed, scale, causal, dropout_p,
+                            interpret)
     return out
 
 
-def _fwd(q, k, v, causal, scale, interpret):
+def _fwd(q, k, v, causal, scale, interpret, dropout_p, seed):
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    out, lse = _flash_forward(q, k, v, scale, causal, interpret)
-    return out, (q, k, v, out, lse, scale)
+    out, lse = _flash_forward(q, k, v, seed, scale, causal, dropout_p,
+                              interpret)
+    return out, (q, k, v, seed, out, lse, scale)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, *, scale: float, causal: bool, block_k: int,
-                   seq_k: int, seq_q: int):
+                   seed_ref, dq_ref, *, scale: float, causal: bool,
+                   block_k: int, seq_k: int, seq_q: int,
+                   dropout_p: float):
     q = q_ref[0].astype(jnp.float32)                   # [BQ, D]
     do = do_ref[0].astype(jnp.float32)                 # [BQ, D]
     lse = lse_ref[0]                                   # [BQ, 1] f32
     delta = delta_ref[0]                               # [BQ, 1] f32
     block_q = q.shape[0]
+    g = pl.program_id(0)
     i_q = pl.program_id(1)
     num_k = pl.cdiv(seq_k, block_k)
     causal_offset = seq_k - seq_q
@@ -182,9 +238,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k_pos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         valid = k_pos < seq_k
+        q_pos = i_q * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
         if causal:
-            q_pos = i_q * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
             valid = jnp.logical_and(valid,
                                     q_pos + causal_offset >= k_pos)
         s = jnp.where(valid, s, _NEG_INF)
@@ -192,6 +248,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)          # [BQ, BK]
+        if dropout_p > 0.0:
+            # same mask as the forward: dP = keep * dp / (1-p_drop);
+            # delta already equals rowsum(P_dropped * dp) via dO.O
+            keep = _dropout_keep(seed_ref[0, 0], g, q_pos, k_pos,
+                                 dropout_p)
+            dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
         dsc = p * (dp - delta) * scale
         return dq_acc + jax.lax.dot_general(
             dsc, k, (((1,), (0,)), ((), ())),
@@ -209,14 +271,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale: float, causal: bool,
-                    block_q: int, seq_k: int, seq_q: int):
+                    seed_ref, dk_ref, dv_ref, *, scale: float,
+                    causal: bool, block_q: int, seq_k: int, seq_q: int,
+                    dropout_p: float):
     # Padded-q correctness: dO and delta are zero-padded, so a padded
     # query row contributes p^T@dO = 0 to dv and p*(0-0) = 0 to dk —
     # no explicit q-validity mask is needed.
     k = k_ref[0].astype(jnp.float32)                   # [BK, D]
     v = v_ref[0].astype(jnp.float32)                   # [BK, D]
     block_k = k.shape[0]
+    g = pl.program_id(0)
     j_k = pl.program_id(1)
     seq_q_pad = q_ref.shape[1]
     num_q = seq_q_pad // block_q
@@ -234,19 +298,27 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k_pos = j_k * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         valid = k_pos < seq_k
+        q_pos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
         if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
             valid = jnp.logical_and(valid,
                                     q_pos + causal_offset >= k_pos)
         s = jnp.where(valid, s, _NEG_INF)
         p = jnp.exp(s - lse)                                # [BQ, BK]
-        dv_acc = dv_acc + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)             # [BK, D]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)             # [BQ, BK]
+        if dropout_p > 0.0:
+            keep = _dropout_keep(seed_ref[0, 0], g, q_pos, k_pos,
+                                 dropout_p)
+            inv = 1.0 - dropout_p
+            p_v = jnp.where(keep, p / inv, 0.0)   # dropped+scaled probs
+            dp = jnp.where(keep, dp / inv, 0.0)
+        else:
+            p_v = p
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p_v, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # [BK, D]
         dsc = p * (dp - delta) * scale
         dk_acc = dk_acc + jax.lax.dot_general(
             dsc, q, (((0,), (0,)), ((), ())),
@@ -267,7 +339,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, g, scale: float, causal: bool,
+def _flash_backward(q, k, v, seed, out, lse, g, scale: float,
+                    causal: bool, dropout_p: float,
                     interpret: bool = False):
     b, h, tq, d = q.shape
     tk = k.shape[2]
@@ -289,9 +362,11 @@ def _flash_backward(q, k, v, out, lse, g, scale: float, causal: bool,
     delta = flat(delta, tq, tq_p)
     lse_r = flat(lse.reshape(b, h, tq, 1).astype(jnp.float32), tq, tq_p)
 
+    seed_a = _seed_arr(seed)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_k=bk, seq_k=tk, seq_q=tq),
+                          block_k=bk, seq_k=tk, seq_q=tq,
+                          dropout_p=dropout_p),
         grid=(b * h, tq_p // bq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda g_, i: (g_, i, 0),
@@ -306,16 +381,19 @@ def _flash_backward(q, k, v, out, lse, g, scale: float, causal: bool,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bq, 1), lambda g_, i: (g_, i, 0),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda g_, i: (0, 0),
+                         memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda g_, i: (g_, i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
         interpret=interpret,
-    )(qr, kr, vr, dor, lse_r, delta)
+    )(qr, kr, vr, dor, lse_r, delta, seed_a)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq, seq_k=tk, seq_q=tq),
+                          block_q=bq, seq_k=tk, seq_q=tq,
+                          dropout_p=dropout_p),
         grid=(b * h, tk_p // bk),
         in_specs=[
             pl.BlockSpec((1, tq_p, d), lambda g_, j: (g_, 0, 0),
@@ -330,6 +408,8 @@ def _flash_backward(q, k, v, out, lse, g, scale: float, causal: bool,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, tq_p, 1), lambda g_, j: (g_, 0, 0),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda g_, j: (0, 0),
+                         memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda g_, j: (g_, j, 0),
@@ -342,17 +422,23 @@ def _flash_backward(q, k, v, out, lse, g, scale: float, causal: bool,
             jax.ShapeDtypeStruct((b * h, tk_p, d), v.dtype),
         ],
         interpret=interpret,
-    )(qr, kr, vr, dor, lse_r, delta)
+    )(qr, kr, vr, dor, lse_r, delta, seed_a)
 
     return (dq[:, :tq].reshape(b, h, tq, d),
             dk[:, :tk].reshape(b, h, tk, d),
             dv[:, :tk].reshape(b, h, tk, d))
 
 
-def _bwd(causal, scale_arg, interpret, res, g):
-    q, k, v, out, lse, scale = res
-    return _flash_backward(q, k, v, out, lse, g, scale, causal,
-                           interpret)
+def _bwd(causal, scale_arg, interpret, dropout_p, res, g):
+    import numpy as np
+
+    q, k, v, seed, out, lse, scale = res
+    dq, dk, dv = _flash_backward(q, k, v, seed, out, lse, g, scale,
+                                 causal, dropout_p, interpret)
+    # seed is integer-valued: its cotangent is the symbolic-zero float0
+    dseed = None if seed is None else \
+        np.zeros(jnp.shape(jnp.asarray(seed)), jax.dtypes.float0)
+    return dq, dk, dv, dseed
 
 
 flash_attention.defvjp(_fwd, _bwd)

@@ -84,6 +84,46 @@ def validate_kernels_on_tpu() -> list:
     except Exception as e:  # noqa: BLE001
         failures.append(f"flash_attention: {e}")
 
+    # flash attention with BERT geometry: head dim 64 + in-kernel dropout
+    # (fwd value check via the mask-extraction identity; bwd must run
+    # compiled and produce finite grads matching the same-mask reference)
+    try:
+        from paddle_tpu.kernels.flash_attention import flash_attention
+        d64 = 64
+        q = jnp.asarray(rng.normal(0, 1, (1, 2, 256, d64)), jnp.float32)
+        k = jnp.asarray(rng.normal(0, 1, (1, 2, 256, d64)), jnp.float32)
+        v = jnp.asarray(rng.normal(0, 1, (1, 2, 256, d64)), jnp.float32)
+        seed = jnp.asarray([[42]], jnp.int32)
+        pd = 0.1
+        # extract the keep mask with v=I, then check grads vs a
+        # same-mask XLA reference
+        eye = jnp.broadcast_to(jnp.eye(256, dtype=q.dtype),
+                               (1, 2, 256, 256))
+        dropped = flash_attention(q, k, eye, False, None, False, pd, seed)
+        keep = jnp.asarray(np.asarray(dropped) != 0.0)
+        rate = float(np.asarray(keep, np.float32).mean())
+        assert abs(rate - (1 - pd)) < 0.02, f"keep rate {rate}"
+
+        def da_pallas(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, False, None, False,
+                                           pd, seed) ** 2)
+
+        def da_ref(q, k, v):
+            logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (d64 ** 0.5)
+            p = jax.nn.softmax(logits, axis=-1)
+            p = jnp.where(keep, p / (1 - pd), 0.0)
+            return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", p, v) ** 2)
+
+        vp, gp = jax.value_and_grad(da_pallas, argnums=(0, 1, 2))(q, k, v)
+        vr, gr = jax.value_and_grad(da_ref, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(float(vp), float(vr), rtol=2e-3)
+        for a, c in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                       rtol=5e-3, atol=5e-3)
+        _log("kernel-validate flash_attention d64+dropout: OK")
+    except Exception as e:  # noqa: BLE001
+        failures.append(f"flash_attention_d64_dropout: {e}")
+
     # fused adam vs elementwise composition
     try:
         from paddle_tpu.kernels.fused_adam import fused_adam_flat

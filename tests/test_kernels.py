@@ -244,3 +244,142 @@ class TestFusedAdam:
                                    atol=1e-7)
         np.testing.assert_allclose(np.asarray(p_new), p_ref, rtol=1e-5,
                                    atol=1e-6)
+
+
+class TestFlashAttentionDropout:
+    """In-kernel attention dropout: the keep mask is a pure hash of
+    (seed, head, position), so the forward mask can be EXTRACTED by
+    running with v = I (output rows become the dropped+scaled prob
+    rows) and the backward verified against a same-mask reference."""
+
+    def _probs_and_mask(self, q, k, dropout_p, seed, causal=False):
+        """Returns (ref_probs, keep_mask) via the v=I trick."""
+        from paddle_tpu.kernels.flash_attention import flash_attention
+        t = q.shape[2]
+        eye = jnp.broadcast_to(jnp.eye(t, dtype=q.dtype),
+                               q.shape[:2] + (t, t))
+        dropped = flash_attention(q, k, eye, causal, None, True,
+                                  dropout_p, seed)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (q.shape[-1]**0.5)
+        ref_probs = jax.nn.softmax(logits, axis=-1)
+        return np.asarray(ref_probs), np.asarray(dropped) != 0.0
+
+    def test_mask_statistics_and_exactness(self, rng):
+        from paddle_tpu.kernels import flash_attention as fa
+        orig = fa.BLOCK_Q, fa.BLOCK_K
+        fa.BLOCK_Q, fa.BLOCK_K = 32, 32
+        try:
+            pd = 0.25
+            q = jnp.asarray(rng.standard_normal((1, 2, 64, 64)),
+                            jnp.float32)
+            k = jnp.asarray(rng.standard_normal((1, 2, 64, 64)),
+                            jnp.float32)
+            seed = jnp.asarray([[123]], jnp.int32)
+            probs, keep = self._probs_and_mask(q, k, pd, seed)
+            # kept entries carry EXACTLY prob/(1-pd); dropped are zero
+            eye = jnp.broadcast_to(jnp.eye(64, dtype=q.dtype),
+                                   (1, 2, 64, 64))
+            out = np.asarray(fa.flash_attention(q, k, eye, False, None,
+                                                True, pd, seed))
+            expect = np.where(keep, probs / (1 - pd), 0.0)
+            np.testing.assert_allclose(out, expect, rtol=2e-4, atol=1e-6)
+            # keep rate approximates 1-pd (8192 Bernoulli draws)
+            rate = keep.mean()
+            assert abs(rate - (1 - pd)) < 0.03, rate
+            # a different seed gives a different mask; same seed, same mask
+            _, keep2 = self._probs_and_mask(q, k, pd,
+                                            jnp.asarray([[77]], jnp.int32))
+            assert (keep2 != keep).mean() > 0.05
+            _, keep3 = self._probs_and_mask(q, k, pd, seed)
+            np.testing.assert_array_equal(keep, keep3)
+            # heads see different masks (head index feeds the hash)
+            assert (keep[0, 0] != keep[0, 1]).mean() > 0.05
+        finally:
+            fa.BLOCK_Q, fa.BLOCK_K = orig
+
+    def test_backward_matches_same_mask_reference(self, rng):
+        from paddle_tpu.kernels import flash_attention as fa
+        orig = fa.BLOCK_Q, fa.BLOCK_K
+        fa.BLOCK_Q, fa.BLOCK_K = 32, 32
+        try:
+            pd = 0.2
+            q = jnp.asarray(rng.standard_normal((1, 2, 64, 64)),
+                            jnp.float32)
+            k = jnp.asarray(rng.standard_normal((1, 2, 64, 64)),
+                            jnp.float32)
+            v = jnp.asarray(rng.standard_normal((1, 2, 64, 64)),
+                            jnp.float32)
+            w = jnp.asarray(rng.standard_normal((1, 2, 64, 64)),
+                            jnp.float32)
+            seed = jnp.asarray([[5]], jnp.int32)
+            _, keep = self._probs_and_mask(q, k, pd, seed)
+            keep = jnp.asarray(keep)
+
+            def loss_flash(q_, k_, v_):
+                out = fa.flash_attention(q_, k_, v_, False, None, True,
+                                         pd, seed)
+                return jnp.sum(out * w)
+
+            def loss_ref(q_, k_, v_):
+                logits = jnp.einsum("bhqd,bhkd->bhqk", q_, k_) \
+                    / (q_.shape[-1] ** 0.5)
+                p = jax.nn.softmax(logits, axis=-1)
+                p = jnp.where(keep, p / (1 - pd), 0.0)
+                return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", p, v_) * w)
+
+            lf = loss_flash(q, k, v)
+            lr_ = loss_ref(q, k, v)
+            np.testing.assert_allclose(float(lf), float(lr_), rtol=2e-4)
+            gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+            gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+            for a, b, name in zip(gf, gr, "qkv"):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3,
+                    err_msg=f"d{name}")
+        finally:
+            fa.BLOCK_Q, fa.BLOCK_K = orig
+
+    def test_causal_dropout_backward(self, rng):
+        """Dropout composed with causal masking and unaligned tails."""
+        from paddle_tpu.kernels import flash_attention as fa
+        orig = fa.BLOCK_Q, fa.BLOCK_K
+        fa.BLOCK_Q, fa.BLOCK_K = 32, 32
+        try:
+            pd = 0.15
+            tq = tk = 80  # unaligned tail
+            q = jnp.asarray(rng.standard_normal((1, 1, tq, 80)),
+                            jnp.float32)
+            k = jnp.asarray(rng.standard_normal((1, 1, tk, 80)),
+                            jnp.float32)
+            v = jnp.asarray(rng.standard_normal((1, 1, tk, 80)),
+                            jnp.float32)
+            seed = jnp.asarray([[9]], jnp.int32)
+            probs, keep = self._probs_and_mask(q, k, pd, seed,
+                                               causal=True)
+            keep = jnp.asarray(keep)
+
+            def loss_flash(q_, k_, v_):
+                out = fa.flash_attention(q_, k_, v_, True, None, True,
+                                         pd, seed)
+                return jnp.sum(out ** 2)
+
+            def loss_ref(q_, k_, v_):
+                logits = jnp.einsum("bhqd,bhkd->bhqk", q_, k_) \
+                    / (q_.shape[-1] ** 0.5)
+                cm = jnp.tril(jnp.ones((tq, tk), bool))
+                logits = jnp.where(cm, logits, -1e30)
+                p = jax.nn.softmax(logits, axis=-1)
+                p = jnp.where(keep, p / (1 - pd), 0.0)
+                return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", p, v_) ** 2)
+
+            np.testing.assert_allclose(float(loss_flash(q, k, v)),
+                                       float(loss_ref(q, k, v)),
+                                       rtol=2e-4)
+            gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+            gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+            for a, b, name in zip(gf, gr, "qkv"):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3,
+                    err_msg=f"d{name}")
+        finally:
+            fa.BLOCK_Q, fa.BLOCK_K = orig

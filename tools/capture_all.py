@@ -59,6 +59,7 @@ STAGES = {
                           "PT_BENCH_LAYOUT": "NCHW",
                           "PT_BENCH_FUSED": "1"}, 1200),
     "flash": (["flash"], _SKIP, 1800),
+    "flash_train": (["flash_train"], _SKIP, 1800),
     # round-3 regression hunt: fused_state measured -26% (b32), so the
     # remaining suspects for the 121.8k -> 97.1k/b32 gap are fused QKV
     # and per-chip batch. b8_perleaf_noqkv IS the round-2 config.
@@ -92,7 +93,7 @@ DEFAULT_PLAN = ["verify", "bert_fused_b32", "resnet_nhwc_b128",
                 "bert_perleaf_b32", "resnet_nchw_b128", "flash"]
 DIAG_PLAN = ["bert_b8_perleaf_noqkv", "bert_b8_perleaf_qkv",
              "bert_b16_perleaf_noqkv", "bert_b32_perleaf_noqkv",
-             "resnet_nhwc_b128_perleaf", "flash",
+             "resnet_nhwc_b128_perleaf", "flash", "flash_train",
              "profile_bert", "profile_bert_b32", "profile_resnet",
              "resnet_nhwc_b256_perleaf", "resnet_nhwc_b128_s2d"]
 
