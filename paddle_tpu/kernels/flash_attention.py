@@ -198,8 +198,13 @@ def flash_attention(q, k, v, causal: bool = False,
     (capability ref: multihead_matmul fused attention + the reference's
     attention dropout); the keep mask is a counter-based hash of
     (seed, head, position), regenerated bitwise in the recompute
-    backward. ``seed``: int32 scalar/array; required when dropout_p > 0.
+    backward. ``seed``: int32 scalar/array; required when dropout_p > 0
+    (a fixed implicit seed would silently drop the same entries every
+    step).
     """
+    if dropout_p > 0.0 and seed is None:
+        raise ValueError("flash_attention: dropout_p > 0 requires a "
+                         "seed (vary it per step)")
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     out, _ = _flash_forward(q, k, v, seed, scale, causal, dropout_p,
@@ -208,6 +213,9 @@ def flash_attention(q, k, v, causal: bool = False,
 
 
 def _fwd(q, k, v, causal, scale, interpret, dropout_p, seed):
+    if dropout_p > 0.0 and seed is None:
+        raise ValueError("flash_attention: dropout_p > 0 requires a "
+                         "seed (vary it per step)")
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     out, lse = _flash_forward(q, k, v, seed, scale, causal, dropout_p,
@@ -341,7 +349,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_backward(q, k, v, seed, out, lse, g, scale: float,
                     causal: bool, dropout_p: float,
-                    interpret: bool = False):
+                    interpret: bool = False, dlse=None):
     b, h, tq, d = q.shape
     tk = k.shape[2]
     bq = min(BLOCK_Q, tq)
@@ -356,9 +364,13 @@ def _flash_backward(q, k, v, seed, out, lse, g, scale: float,
 
     qr, dor = flat(q, tq, tq_p), flat(g, tq, tq_p)
     kr, vr = flat(k, tk, tk_p), flat(v, tk, tk_p)
-    # delta = rowsum(dO * O): one elementwise+reduce in XLA, [bh, tq, 1]
+    # delta = rowsum(dO * O): one elementwise+reduce in XLA, [bh, tq, 1].
+    # An lse cotangent folds in here: ds = p*(dP - (delta - dlse))*scale
+    # (d lse_i/ds_ij = p_ij), so no kernel change is needed.
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1).reshape(b * h, tq, 1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32).reshape(b * h, tq, 1)
     delta = flat(delta, tq, tq_p)
     lse_r = flat(lse.reshape(b, h, tq, 1).astype(jnp.float32), tq, tq_p)
 
@@ -442,3 +454,40 @@ def _bwd(causal, scale_arg, interpret, dropout_p, res, g):
 
 
 flash_attention.defvjp(_fwd, _bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention_with_lse(q, k, v, causal: bool = False,
+                             scale: Optional[float] = None,
+                             interpret: bool = False):
+    """Flash attention returning ``(out, lse)`` with BOTH outputs
+    differentiable — the building block for combining partial-attention
+    results over sharded K/V (ring attention): given per-chunk
+    ``(o_i, lse_i)``, the exact full-attention output is
+    ``sum(o_i * exp(lse_i - m)) / sum(exp(lse_i - m))``, and gradients
+    flow through the lse weights.
+
+    The lse cotangent needs NO extra kernel: ``d lse/ds = p`` folds into
+    the backward's delta term, ``ds = p*(dP - (delta - dlse))*scale``.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _flash_forward(q, k, v, None, scale, causal, 0.0, interpret)
+
+
+def _fwd_lse(q, k, v, causal, scale, interpret):
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    out, lse = _flash_forward(q, k, v, None, scale, causal, 0.0,
+                              interpret)
+    return (out, lse), (q, k, v, out, lse, scale)
+
+
+def _bwd_lse(causal, scale_arg, interpret, res, g):
+    q, k, v, out, lse, scale = res
+    do, dlse = g
+    return _flash_backward(q, k, v, None, out, lse, do, scale, causal,
+                           0.0, interpret, dlse=dlse)
+
+
+flash_attention_with_lse.defvjp(_fwd_lse, _bwd_lse)

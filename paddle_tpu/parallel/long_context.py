@@ -93,13 +93,105 @@ def _ring_attention_local(q, k, v, axis: str, causal: bool,
     return (acc / jnp.maximum(l_fin, 1e-30)).astype(q.dtype)
 
 
+def _ring_attention_local_flash(q, k, v, axis: str, causal: bool,
+                                scale: Optional[float],
+                                interpret: bool):
+    """Ring attention whose per-hop local attention is the Pallas flash
+    kernel — O(Tl) memory on-rank instead of the XLA fold's [Tl, Tl]
+    score blocks, so each rank can hold a much longer local context.
+
+    Per hop the kernel returns ``(o_i, lse_i)``; the exact merge is
+    ``out = sum_i exp(lse_i - m) o_i / sum_i exp(lse_i - m)`` (both
+    outputs differentiable — kernels.flash_attention_with_lse folds the
+    lse cotangent into the backward's delta). Causal routing per hop:
+    K/V originating before this rank attend fully, the diagonal hop
+    runs the kernel's causal mode, later ranks are skipped with
+    lse = -inf (zero weight).
+    """
+    from ..kernels.flash_attention import (_NEG_INF,
+                                           flash_attention_with_lse)
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    b, h, t_local, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def partial_attn(k_cur, v_cur, step):
+        if not causal:
+            o, lse = flash_attention_with_lse(q, k_cur, v_cur, False,
+                                              scale, interpret)
+            return o.astype(jnp.float32), lse
+        src = (idx - step) % n
+
+        def diag(_):
+            return flash_attention_with_lse(q, k_cur, v_cur, True,
+                                            scale, interpret)
+
+        def full(_):
+            return flash_attention_with_lse(q, k_cur, v_cur, False,
+                                            scale, interpret)
+
+        def skip(_):
+            return (jnp.zeros((b, h, t_local, d), q.dtype),
+                    jnp.full((b, h, t_local), _NEG_INF, jnp.float32))
+
+        o, lse = lax.cond(
+            src == idx, diag,
+            lambda u: lax.cond(src < idx, full, skip, u), None)
+        return o.astype(jnp.float32), lse
+
+    def merge(carry, o, lse):
+        acc, m_prev, l_prev = carry
+        lse_e = lse[..., None]                       # [B, H, Tl, 1]
+        m_new = jnp.maximum(m_prev, lse_e)
+        w_prev = jnp.exp(m_prev - m_new)
+        w_cur = jnp.exp(lse_e - m_new)
+        return (acc * w_prev + o * w_cur,
+                m_new,
+                l_prev * w_prev + w_cur)
+
+    def block(carry, step):
+        acc_ml, k_cur, v_cur = carry
+        o, lse = partial_attn(k_cur, v_cur, step)
+        acc_ml = merge(acc_ml, o, lse)
+        k_next = lax.ppermute(k_cur, axis, perm)
+        v_next = lax.ppermute(v_cur, axis, perm)
+        return (acc_ml, k_next, v_next), None
+
+    acc0 = jnp.zeros((b, h, t_local, d), jnp.float32)
+    m0 = jnp.full((b, h, t_local, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t_local, 1), jnp.float32)
+    carry = ((acc0, m0, l0), k, v)
+    if n > 1:
+        carry, _ = lax.scan(block, carry, jnp.arange(n - 1))
+    (acc, m_prev, l_prev), k_last, v_last = carry
+    o, lse = partial_attn(k_last, v_last, n - 1)
+    acc, _, l_fin = merge((acc, m_prev, l_prev), o, lse)
+    return (acc / jnp.maximum(l_fin, 1e-30)).astype(q.dtype)
+
+
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
-                   causal: bool = False, scale: Optional[float] = None):
+                   causal: bool = False, scale: Optional[float] = None,
+                   use_flash: Optional[bool] = None,
+                   interpret: bool = False):
     """Context-parallel attention over full [B, H, T, D] inputs; T is
-    sharded over ``axis``, output keeps the same sharding."""
+    sharded over ``axis``, output keeps the same sharding.
+
+    ``use_flash`` selects the per-hop implementation: the Pallas flash
+    kernel (O(Tl) on-rank memory) or the XLA online-softmax fold.
+    Default (None) routes like kernels.maybe_flash_attention: flash on
+    TPU when the pallas master switch is on. ``interpret`` runs the
+    kernel under the Pallas interpreter (CPU tests)."""
+    if use_flash is None:
+        from ..kernels import pallas_enabled
+        use_flash = pallas_enabled() and q.shape[-1] % 8 == 0
     spec = P(None, None, axis, None)
 
     def fn(q_, k_, v_):
+        if use_flash:
+            return _ring_attention_local_flash(q_, k_, v_, axis, causal,
+                                               scale, interpret)
         return _ring_attention_local(q_, k_, v_, axis, causal, scale)
 
     return _shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),

@@ -95,12 +95,21 @@ def validate_kernels_on_tpu() -> list:
         v = jnp.asarray(rng.normal(0, 1, (1, 2, 256, d64)), jnp.float32)
         seed = jnp.asarray([[42]], jnp.int32)
         pd = 0.1
-        # extract the keep mask with v=I, then check grads vs a
-        # same-mask XLA reference
-        eye = jnp.broadcast_to(jnp.eye(256, dtype=q.dtype),
-                               (1, 2, 256, 256))
-        dropped = flash_attention(q, k, eye, False, None, False, pd, seed)
-        keep = jnp.asarray(np.asarray(dropped) != 0.0)
+        # extract the keep mask via one-hot V column blocks (v must share
+        # q's head dim, so the t x t identity goes in d64-wide slices):
+        # out[:, :, :, :] for v = E_j recovers dropped probs for keys
+        # j*64 .. j*64+63
+        t = 256
+        eye_t = np.eye(t, dtype=np.float32)
+        cols = []
+        for j in range(t // d64):
+            e_j = jnp.broadcast_to(
+                jnp.asarray(eye_t[:, j * d64:(j + 1) * d64]),
+                (1, 2, t, d64))
+            cols.append(np.asarray(flash_attention(
+                q, k, e_j, False, None, False, pd, seed)))
+        dropped = np.concatenate(cols, axis=-1)        # [1,2,t,t]
+        keep = jnp.asarray(dropped != 0.0)
         rate = float(np.asarray(keep, np.float32).mean())
         assert abs(rate - (1 - pd)) < 0.02, f"keep rate {rate}"
 

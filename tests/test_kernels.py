@@ -383,3 +383,39 @@ class TestFlashAttentionDropout:
                     err_msg=f"d{name}")
         finally:
             fa.BLOCK_Q, fa.BLOCK_K = orig
+
+
+class TestFlashWithLse:
+    def test_lse_outputs_and_grads(self, rng):
+        """(out, lse) variant: lse matches logsumexp of scaled logits and
+        BOTH cotangents flow (the lse cotangent folds into delta)."""
+        from paddle_tpu.kernels.flash_attention import \
+            flash_attention_with_lse
+
+        q = jnp.asarray(rng.standard_normal((1, 2, 64, 32)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((1, 2, 64, 32)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((1, 2, 64, 32)), jnp.float32)
+        w1 = jnp.asarray(rng.standard_normal((1, 2, 64, 32)), jnp.float32)
+        w2 = jnp.asarray(rng.standard_normal((1, 2, 64)), jnp.float32)
+        scale = 1.0 / (32 ** 0.5)
+
+        def loss_flash(q_, k_, v_):
+            o, lse = flash_attention_with_lse(q_, k_, v_, False, None,
+                                              True)
+            return jnp.sum(o * w1) + jnp.sum(lse * w2)
+
+        def loss_ref(q_, k_, v_):
+            logits = jnp.einsum("bhqd,bhkd->bhqk", q_, k_) * scale
+            p = jax.nn.softmax(logits, axis=-1)
+            o = jnp.einsum("bhqk,bhkd->bhqd", p, v_)
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            return jnp.sum(o * w1) + jnp.sum(lse * w2)
+
+        np.testing.assert_allclose(float(loss_flash(q, k, v)),
+                                   float(loss_ref(q, k, v)), rtol=2e-4)
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gr, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3,
+                err_msg=f"d{name}")
