@@ -171,6 +171,13 @@ define_flag("flash_attention_min_seq", 4096,
             "Key-sequence length at or above which attention routes to the "
             "Pallas flash kernel (below it XLA's fused attention is faster "
             "on v5e; the flash kernel is always O(T) memory).")
+define_flag("transformer_remat", False,
+            "Rematerialize each TransformerEncoder layer in the "
+            "backward (jax.checkpoint): ~1/3 more FLOPs for O(layers) "
+            "less activation HBM. A/B lever for large-batch training "
+            "where XLA otherwise spills. (ref capability: "
+            "recompute/checkpointing strategy, fleet "
+            "DistributedStrategy.recompute.)")
 define_flag("resnet_space_to_depth_stem", False,
             "Rewrite the ResNet 7x7/s2 stem conv as an exact 4x4/s1 "
             "conv over space-to-depth-folded 12-channel input (the "

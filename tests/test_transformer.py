@@ -1,0 +1,39 @@
+
+
+def test_transformer_remat_parity():
+    """transformer_remat must not change the computed function: same
+    loss and same grads (dropout keys come from the same counted
+    stream in the same trace order, and jax.checkpoint replays the
+    traced jaxpr, so masks match exactly)."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.static import TrainStep
+    from paddle_tpu.models import (BertConfig, BertForPretraining,
+                                   pretraining_loss)
+
+    config = BertConfig(num_hidden_layers=2, hidden_size=64,
+                        num_attention_heads=2, intermediate_size=128,
+                        vocab_size=512, max_position_embeddings=64)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 512, (2, 32)).astype(np.int32)
+    mlm = rng.integers(0, 512, (2, 32)).astype(np.int64)
+    nsp = rng.integers(0, 2, (2,)).astype(np.int64)
+
+    def one_step(remat):
+        pt.set_flags({"transformer_remat": remat})
+        try:
+            pt.seed(0)
+            m = BertForPretraining(config)
+            o = pt.optimizer.AdamW(learning_rate=1e-3)
+            step = TrainStep(m, o, lambda out, a, b:
+                             pretraining_loss(out, a, b))
+            losses = [float(step(ids, labels=(mlm, nsp))["loss"])
+                      for _ in range(3)]
+            return losses
+        finally:
+            pt.set_flags({"transformer_remat": False})
+
+    base = one_step(False)
+    remat = one_step(True)
+    np.testing.assert_allclose(remat, base, rtol=1e-5, atol=1e-6)

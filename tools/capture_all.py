@@ -79,6 +79,16 @@ STAGES = {
         ["resnet50"], {**_SKIP, **_SPL1, "PT_BENCH_RESNET_BATCH": "128",
                        "PT_BENCH_LAYOUT": "NHWC", "PT_BENCH_FUSED": "0",
                        "FLAGS_resnet_space_to_depth_stem": "1"}, 900),
+    "bert_b32_remat": ([], {**_SKIP, **_SPL1,
+                            "PT_BENCH_BERT_BATCH": "32",
+                            "PT_BENCH_FUSED": "0",
+                            "FLAGS_fused_qkv_projection": "0",
+                            "FLAGS_transformer_remat": "1"}, 900),
+    "bert_b64_remat": ([], {**_SKIP, **_SPL1,
+                            "PT_BENCH_BERT_BATCH": "64",
+                            "PT_BENCH_FUSED": "0",
+                            "FLAGS_fused_qkv_projection": "0",
+                            "FLAGS_transformer_remat": "1"}, 900),
     "profile_bert": (["bert", "8"], {}, 900, "tools/profile_step.py"),
     "profile_bert_b32": (["bert", "32"], {}, 900,
                          "tools/profile_step.py"),
@@ -95,7 +105,8 @@ DIAG_PLAN = ["bert_b8_perleaf_noqkv", "bert_b8_perleaf_qkv",
              "bert_b16_perleaf_noqkv", "bert_b32_perleaf_noqkv",
              "resnet_nhwc_b128_perleaf", "flash", "flash_train",
              "profile_bert", "profile_bert_b32", "profile_resnet",
-             "resnet_nhwc_b256_perleaf", "resnet_nhwc_b128_s2d"]
+             "resnet_nhwc_b256_perleaf", "resnet_nhwc_b128_s2d",
+             "bert_b32_remat", "bert_b64_remat"]
 
 
 def log(msg: str) -> None:
