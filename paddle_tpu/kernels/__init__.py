@@ -57,6 +57,8 @@ def maybe_flash_attention(q, k, v, mask=None, scale: Optional[float] = None,
     attn dropout 0.1) stay on the flash path at long sequence.
     """
     from ..ops.attention import scaled_dot_product_attention as ref_impl
+    import jax.numpy as jnp
+
     d = q.shape[-1]
     # d%128 keeps MXU lanes full (measured routing). Narrower head dims
     # (BERT's 64) route only where flash's O(T) memory is the point:
@@ -64,19 +66,35 @@ def maybe_flash_attention(q, k, v, mask=None, scale: Optional[float] = None,
     # eval at lengths where the fwd scores alone are HBM-scale.
     d_ok = d % 128 == 0 or (d % 8 == 0
                             and (training or k.shape[2] >= 8192))
-    if (pallas_enabled() and mask is None and q.ndim == 4 and d_ok
+    # key-padding masks [B, 1, 1, Tk] (the exact shape BertModel/
+    # variable-length batches produce) run INSIDE the kernel as an
+    # additive key bias; broadcastable or richer mask shapes fall back
+    # to the XLA path. Conversion happens only on the routed branch.
+    mask_ok = mask is None or (
+        getattr(mask, "ndim", 0) == 4
+        and mask.shape[0] == q.shape[0]
+        and mask.shape[1] == 1 and mask.shape[2] == 1
+        and mask.shape[3] == k.shape[2])
+    if (pallas_enabled() and mask_ok and q.ndim == 4 and d_ok
             and k.shape[2] >= GLOBAL_FLAGS.get("flash_attention_min_seq")):
-        from .flash_attention import flash_attention
+        from .flash_attention import _NEG_INF, flash_attention
+        kv_bias = None
+        if mask is not None:
+            if mask.dtype == jnp.bool_:
+                kv_bias = jnp.where(mask[:, 0, 0, :], 0.0,
+                                    jnp.float32(_NEG_INF))
+            else:
+                kv_bias = mask[:, 0, 0, :].astype(jnp.float32)
         if dropout_p > 0.0 and training:
-            import jax.numpy as jnp
-
             from ..core import random as _random
             seed = jax.random.randint(
                 _random.next_key("dropout"), (1, 1), 0, 2 ** 31 - 1,
                 dtype=jnp.int32)
             return flash_attention(q, k, v, seed=seed, causal=causal,
                                    scale=scale,
-                                   dropout_p=float(dropout_p))
-        return flash_attention(q, k, v, causal=causal, scale=scale)
+                                   dropout_p=float(dropout_p),
+                                   kv_bias=kv_bias)
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               kv_bias=kv_bias)
     return ref_impl(q, k, v, mask=mask, scale=scale, causal=causal,
                     dropout_p=dropout_p, training=training)

@@ -60,9 +60,10 @@ def _dropout_keep(seed, g, q_pos, k_pos, dropout_p: float):
     return bits >= threshold
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref, *,
-                      scale: float, causal: bool, block_k: int,
-                      seq_k: int, seq_q: int, dropout_p: float):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, seed_ref, bias_ref, o_ref,
+                      lse_ref, *, scale: float, causal: bool,
+                      block_k: int, seq_k: int, seq_q: int,
+                      dropout_p: float, has_bias: bool):
     q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
     block_q = q.shape[0]
     g = pl.program_id(0)
@@ -80,6 +81,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref, *,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # [BQ, BK]
+        if has_bias:
+            # [1, BK] additive key bias (this batch row) broadcasts
+            s = s + bias_ref[0, :, pl.ds(j * block_k, block_k)]
         k_pos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         valid = k_pos < seq_k                          # tail-block mask
@@ -130,8 +134,20 @@ def _seed_arr(seed):
     return jnp.asarray(seed, jnp.int32).reshape(1, 1)
 
 
+def _bias_arr(kv_bias, b, tk, tk_p):
+    """[B, Tk] additive key bias -> padded [B, 1, tk_p] f32 (the middle
+    unit dim satisfies Mosaic block tiling, like the lse layout)."""
+    if kv_bias is None:
+        return jnp.zeros((1, 1, tk_p), jnp.float32)
+    bias = jnp.asarray(kv_bias, jnp.float32).reshape(b, 1, tk)
+    if tk_p != tk:
+        bias = jnp.pad(bias, ((0, 0), (0, 0), (0, tk_p - tk)))
+    return bias
+
+
 def _flash_forward(q, k, v, seed, scale: float, causal: bool,
-                   dropout_p: float, interpret: bool = False):
+                   dropout_p: float, interpret: bool = False,
+                   kv_bias=None):
     b, h, tq, d = q.shape
     tk = k.shape[2]
     bq = min(BLOCK_Q, tq)
@@ -152,9 +168,14 @@ def _flash_forward(q, k, v, seed, scale: float, causal: bool,
         kr = jnp.pad(kr, ((0, 0), (0, tk_p - tk), (0, 0)))
         vr = jnp.pad(vr, ((0, 0), (0, tk_p - tk), (0, 0)))
     grid = (b * h, tq_p // bq)
+    has_bias = kv_bias is not None
+    # bias rows are per batch element: block index g // h (h static)
+    bias_map = (lambda g, i: (g // h, 0, 0)) if has_bias else \
+        (lambda g, i: (0, 0, 0))
     kernel = functools.partial(_flash_fwd_kernel, scale=scale,
                                causal=causal, block_k=bk, seq_k=tk,
-                               seq_q=tq, dropout_p=dropout_p)
+                               seq_q=tq, dropout_p=dropout_p,
+                               has_bias=has_bias)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -167,6 +188,8 @@ def _flash_forward(q, k, v, seed, scale: float, causal: bool,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1), lambda g, i: (0, 0),
                          memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, tk_p), bias_map,
+                         memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda g, i: (g, i, 0),
@@ -182,7 +205,7 @@ def _flash_forward(q, k, v, seed, scale: float, causal: bool,
             jax.ShapeDtypeStruct((b * h, tq_p, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(qr, kr, vr, _seed_arr(seed))
+    )(qr, kr, vr, _seed_arr(seed), _bias_arr(kv_bias, b, tk, tk_p))
     return (out[:, :tq].reshape(b, h, tq, d),
             lse[:, :tq, 0].reshape(b, h, tq))
 
@@ -191,8 +214,9 @@ def _flash_forward(q, k, v, seed, scale: float, causal: bool,
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
                     interpret: bool = False, dropout_p: float = 0.0,
-                    seed=None):
-    """Fused attention: dropout(softmax(QK^T * scale [+ causal mask])) V.
+                    seed=None, kv_bias=None):
+    """Fused attention:
+    dropout(softmax(QK^T * scale + kv_bias [+ causal mask])) V.
 
     ``dropout_p`` > 0 applies post-softmax dropout INSIDE the kernel
     (capability ref: multihead_matmul fused attention + the reference's
@@ -201,6 +225,10 @@ def flash_attention(q, k, v, causal: bool = False,
     backward. ``seed``: int32 scalar/array; required when dropout_p > 0
     (a fixed implicit seed would silently drop the same entries every
     step).
+
+    ``kv_bias``: [B, Tk] additive key bias (0 keep / large-negative
+    masked) — the key-padding mask of variable-length batches. Treated
+    as non-trainable: its cotangent is zero.
     """
     if dropout_p > 0.0 and seed is None:
         raise ValueError("flash_attention: dropout_p > 0 requires a "
@@ -208,25 +236,25 @@ def flash_attention(q, k, v, causal: bool = False,
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     out, _ = _flash_forward(q, k, v, seed, scale, causal, dropout_p,
-                            interpret)
+                            interpret, kv_bias)
     return out
 
 
-def _fwd(q, k, v, causal, scale, interpret, dropout_p, seed):
+def _fwd(q, k, v, causal, scale, interpret, dropout_p, seed, kv_bias):
     if dropout_p > 0.0 and seed is None:
         raise ValueError("flash_attention: dropout_p > 0 requires a "
                          "seed (vary it per step)")
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     out, lse = _flash_forward(q, k, v, seed, scale, causal, dropout_p,
-                              interpret)
-    return out, (q, k, v, seed, out, lse, scale)
+                              interpret, kv_bias)
+    return out, (q, k, v, seed, kv_bias, out, lse, scale)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   seed_ref, dq_ref, *, scale: float, causal: bool,
-                   block_k: int, seq_k: int, seq_q: int,
-                   dropout_p: float):
+                   seed_ref, bias_ref, dq_ref, *, scale: float,
+                   causal: bool, block_k: int, seq_k: int, seq_q: int,
+                   dropout_p: float, has_bias: bool):
     q = q_ref[0].astype(jnp.float32)                   # [BQ, D]
     do = do_ref[0].astype(jnp.float32)                 # [BQ, D]
     lse = lse_ref[0]                                   # [BQ, 1] f32
@@ -243,6 +271,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [BQ, BK]
+        if has_bias:
+            s = s + bias_ref[0, :, pl.ds(j * block_k, block_k)]
         k_pos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         valid = k_pos < seq_k
@@ -279,9 +309,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    seed_ref, dk_ref, dv_ref, *, scale: float,
+                    seed_ref, bias_ref, dk_ref, dv_ref, *, scale: float,
                     causal: bool, block_q: int, seq_k: int, seq_q: int,
-                    dropout_p: float):
+                    dropout_p: float, has_bias: bool):
     # Padded-q correctness: dO and delta are zero-padded, so a padded
     # query row contributes p^T@dO = 0 to dv and p*(0-0) = 0 to dk —
     # no explicit q-validity mask is needed.
@@ -303,6 +333,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale     # [BQ, BK]
+        if has_bias:
+            # this kernel's k block is fixed (j_k); bias slice likewise
+            s = s + bias_ref[0, :, pl.ds(j_k * block_k, block_k)]
         k_pos = j_k * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         valid = k_pos < seq_k
@@ -349,7 +382,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_backward(q, k, v, seed, out, lse, g, scale: float,
                     causal: bool, dropout_p: float,
-                    interpret: bool = False, dlse=None):
+                    interpret: bool = False, dlse=None, kv_bias=None):
     b, h, tq, d = q.shape
     tk = k.shape[2]
     bq = min(BLOCK_Q, tq)
@@ -375,10 +408,14 @@ def _flash_backward(q, k, v, seed, out, lse, g, scale: float,
     lse_r = flat(lse.reshape(b, h, tq, 1).astype(jnp.float32), tq, tq_p)
 
     seed_a = _seed_arr(seed)
+    has_bias = kv_bias is not None
+    bias_a = _bias_arr(kv_bias, b, tk, tk_p)
+    bias_map = (lambda g_, i: (g_ // h, 0, 0)) if has_bias else \
+        (lambda g_, i: (0, 0, 0))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_k=bk, seq_k=tk, seq_q=tq,
-                          dropout_p=dropout_p),
+                          dropout_p=dropout_p, has_bias=has_bias),
         grid=(b * h, tq_p // bq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda g_, i: (g_, i, 0),
@@ -395,17 +432,19 @@ def _flash_backward(q, k, v, seed, out, lse, g, scale: float,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1), lambda g_, i: (0, 0),
                          memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, tk_p), bias_map,
+                         memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda g_, i: (g_, i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
         interpret=interpret,
-    )(qr, kr, vr, dor, lse_r, delta, seed_a)
+    )(qr, kr, vr, dor, lse_r, delta, seed_a, bias_a)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=bq, seq_k=tk, seq_q=tq,
-                          dropout_p=dropout_p),
+                          dropout_p=dropout_p, has_bias=has_bias),
         grid=(b * h, tk_p // bk),
         in_specs=[
             pl.BlockSpec((1, tq_p, d), lambda g_, j: (g_, 0, 0),
@@ -422,6 +461,8 @@ def _flash_backward(q, k, v, seed, out, lse, g, scale: float,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1), lambda g_, j: (0, 0),
                          memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, tk_p), bias_map,
+                         memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda g_, j: (g_, j, 0),
@@ -434,7 +475,7 @@ def _flash_backward(q, k, v, seed, out, lse, g, scale: float,
             jax.ShapeDtypeStruct((b * h, tk_p, d), v.dtype),
         ],
         interpret=interpret,
-    )(qr, kr, vr, dor, lse_r, delta, seed_a)
+    )(qr, kr, vr, dor, lse_r, delta, seed_a, bias_a)
 
     return (dq[:, :tq].reshape(b, h, tq, d),
             dk[:, :tk].reshape(b, h, tk, d),
@@ -444,13 +485,16 @@ def _flash_backward(q, k, v, seed, out, lse, g, scale: float,
 def _bwd(causal, scale_arg, interpret, dropout_p, res, g):
     import numpy as np
 
-    q, k, v, seed, out, lse, scale = res
+    q, k, v, seed, kv_bias, out, lse, scale = res
     dq, dk, dv = _flash_backward(q, k, v, seed, out, lse, g, scale,
-                                 causal, dropout_p, interpret)
+                                 causal, dropout_p, interpret,
+                                 kv_bias=kv_bias)
     # seed is integer-valued: its cotangent is the symbolic-zero float0
     dseed = None if seed is None else \
         np.zeros(jnp.shape(jnp.asarray(seed)), jax.dtypes.float0)
-    return dq, dk, dv, dseed
+    # the key bias is a mask, not a trainable input: zero cotangent
+    dbias = None if kv_bias is None else jnp.zeros_like(kv_bias)
+    return dq, dk, dv, dseed, dbias
 
 
 flash_attention.defvjp(_fwd, _bwd)

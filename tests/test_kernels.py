@@ -419,3 +419,95 @@ class TestFlashWithLse:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3,
                 err_msg=f"d{name}")
+
+
+class TestFlashKvBias:
+    """Key-padding mask as in-kernel additive bias."""
+
+    def test_matches_masked_reference(self, rng):
+        from paddle_tpu.kernels import flash_attention as fa
+        from paddle_tpu.ops.attention import scaled_dot_product_attention
+        orig = fa.BLOCK_Q, fa.BLOCK_K
+        fa.BLOCK_Q, fa.BLOCK_K = 32, 32
+        try:
+            b, h, t, d = 2, 2, 96, 32
+            q = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+            k = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+            v = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+            # per-example valid lengths 60 and 96
+            lens = np.array([60, 96])
+            keep = (np.arange(t)[None, :] < lens[:, None])
+            bias = jnp.asarray(np.where(keep, 0.0, -1e30), jnp.float32)
+            mask4 = bias[:, None, None, :]
+            ref = scaled_dot_product_attention(q, k, v, mask=mask4)
+            got = fa.flash_attention(q, k, v, False, None, True, 0.0,
+                                     None, bias)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       rtol=2e-4, atol=2e-4)
+
+            # grads: padded-key columns must get zero dk/dv
+            def loss_flash(q_, k_, v_):
+                return jnp.sum(fa.flash_attention(
+                    q_, k_, v_, False, None, True, 0.0, None, bias) ** 2)
+
+            def loss_ref(q_, k_, v_):
+                return jnp.sum(scaled_dot_product_attention(
+                    q_, k_, v_, mask=mask4) ** 2)
+
+            gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+            gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+            for a, c, name in zip(gf, gr, "qkv"):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(c), rtol=2e-3, atol=2e-3,
+                    err_msg=f"d{name}")
+            assert np.abs(np.asarray(gf[1])[0, :, 60:, :]).max() == 0.0
+        finally:
+            fa.BLOCK_Q, fa.BLOCK_K = orig
+
+    def test_bias_with_dropout_and_causal(self, rng):
+        """bias + causal + in-kernel dropout compose: same-mask
+        reference built from the extracted keep mask."""
+        from paddle_tpu.kernels import flash_attention as fa
+        orig = fa.BLOCK_Q, fa.BLOCK_K
+        fa.BLOCK_Q, fa.BLOCK_K = 32, 32
+        try:
+            b, h, t = 1, 2, 64
+            d = t  # v=I mask extraction needs square
+            pd = 0.2
+            q = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+            k = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+            v = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+            seed = jnp.asarray([[3]], jnp.int32)
+            keep_keys = (np.arange(t) < 50)
+            bias = jnp.asarray(np.where(keep_keys, 0.0, -1e30),
+                               jnp.float32)[None, :]
+            eye = jnp.broadcast_to(jnp.eye(t, dtype=q.dtype),
+                                   (b, h, t, t))
+            dropped = np.asarray(fa.flash_attention(
+                q, k, eye, True, None, True, pd, seed, bias))
+            keep_drop = jnp.asarray(dropped != 0.0)
+
+            def loss_flash(q_, k_, v_):
+                return jnp.sum(fa.flash_attention(
+                    q_, k_, v_, True, None, True, pd, seed, bias) ** 2)
+
+            def loss_ref(q_, k_, v_):
+                logits = jnp.einsum("bhqd,bhkd->bhqk", q_, k_) \
+                    / (d ** 0.5) + bias[:, None, None, :]
+                cm = jnp.tril(jnp.ones((t, t), bool))
+                logits = jnp.where(cm, logits, -1e30)
+                p = jax.nn.softmax(logits, axis=-1)
+                p = jnp.where(keep_drop, p / (1 - pd), 0.0)
+                return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", p, v_) ** 2)
+
+            np.testing.assert_allclose(float(loss_flash(q, k, v)),
+                                       float(loss_ref(q, k, v)),
+                                       rtol=2e-4)
+            gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+            gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+            for a, c, name in zip(gf, gr, "qkv"):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(c), rtol=2e-3, atol=2e-3,
+                    err_msg=f"d{name}")
+        finally:
+            fa.BLOCK_Q, fa.BLOCK_K = orig
