@@ -43,6 +43,27 @@ def maybe_layer_norm(x, weight, bias, epsilon: float, begin_norm_axis: int):
     return ref_impl(x, weight, bias, epsilon, begin_norm_axis)
 
 
+def _is_key_padding_mask(mask, q, k) -> bool:
+    """True for exactly-shaped [B, 1, 1, Tk] masks (no broadcasting)."""
+    return (getattr(mask, "ndim", 0) == 4
+            and mask.shape[0] == q.shape[0]
+            and mask.shape[1] == 1 and mask.shape[2] == 1
+            and mask.shape[3] == k.shape[2])
+
+
+def _mask_to_kv_bias(mask):
+    """[B, 1, 1, Tk] mask -> [B, Tk] additive f32 bias for the flash
+    kernel. Bool masks are KEEP masks (True = attend); float masks are
+    already additive. Pure helper so the polarity/slicing is testable
+    off-TPU."""
+    import jax.numpy as jnp
+
+    from .flash_attention import _NEG_INF
+    if mask.dtype == jnp.bool_:
+        return jnp.where(mask[:, 0, 0, :], 0.0, jnp.float32(_NEG_INF))
+    return mask[:, 0, 0, :].astype(jnp.float32)
+
+
 def maybe_flash_attention(q, k, v, mask=None, scale: Optional[float] = None,
                           causal: bool = False, dropout_p: float = 0.0,
                           training: bool = False):
@@ -70,21 +91,11 @@ def maybe_flash_attention(q, k, v, mask=None, scale: Optional[float] = None,
     # variable-length batches produce) run INSIDE the kernel as an
     # additive key bias; broadcastable or richer mask shapes fall back
     # to the XLA path. Conversion happens only on the routed branch.
-    mask_ok = mask is None or (
-        getattr(mask, "ndim", 0) == 4
-        and mask.shape[0] == q.shape[0]
-        and mask.shape[1] == 1 and mask.shape[2] == 1
-        and mask.shape[3] == k.shape[2])
+    mask_ok = mask is None or _is_key_padding_mask(mask, q, k)
     if (pallas_enabled() and mask_ok and q.ndim == 4 and d_ok
             and k.shape[2] >= GLOBAL_FLAGS.get("flash_attention_min_seq")):
-        from .flash_attention import _NEG_INF, flash_attention
-        kv_bias = None
-        if mask is not None:
-            if mask.dtype == jnp.bool_:
-                kv_bias = jnp.where(mask[:, 0, 0, :], 0.0,
-                                    jnp.float32(_NEG_INF))
-            else:
-                kv_bias = mask[:, 0, 0, :].astype(jnp.float32)
+        from .flash_attention import flash_attention
+        kv_bias = None if mask is None else _mask_to_kv_bias(mask)
         if dropout_p > 0.0 and training:
             from ..core import random as _random
             seed = jax.random.randint(

@@ -334,8 +334,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale     # [BQ, BK]
         if has_bias:
-            # this kernel's k block is fixed (j_k); bias slice likewise
-            s = s + bias_ref[0, :, pl.ds(j_k * block_k, block_k)]
+            # this kernel's k block is fixed, so the BlockSpec already
+            # delivered exactly the [1, BK] bias slice for j_k
+            s = s + bias_ref[0]
         k_pos = j_k * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         valid = k_pos < seq_k
@@ -461,7 +462,11 @@ def _flash_backward(q, k, v, seed, out, lse, g, scale: float,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1), lambda g_, j: (0, 0),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, tk_p), bias_map,
+            # this kernel's k block is fixed per program: deliver only
+            # the bk-wide bias slice instead of the whole padded row
+            pl.BlockSpec((1, 1, bk),
+                         (lambda g_, j: (g_ // h, 0, j)) if has_bias
+                         else (lambda g_, j: (0, 0, 0)),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[

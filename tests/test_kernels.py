@@ -511,3 +511,26 @@ class TestFlashKvBias:
                     err_msg=f"d{name}")
         finally:
             fa.BLOCK_Q, fa.BLOCK_K = orig
+
+
+def test_mask_to_kv_bias_helpers():
+    """Routing-layer mask conversion is pure and CPU-testable: bool
+    masks are KEEP masks (True=attend -> bias 0, False -> -1e30);
+    float masks pass through additively; only exact [B,1,1,Tk] shapes
+    qualify (broadcastable shapes fall back to the XLA path)."""
+    from paddle_tpu.kernels import _is_key_padding_mask, _mask_to_kv_bias
+
+    q = jnp.zeros((2, 2, 8, 4))
+    k = jnp.zeros((2, 2, 16, 4))
+    m_bool = jnp.asarray(np.array(
+        [[True] * 10 + [False] * 6, [True] * 16])[:, None, None, :])
+    assert _is_key_padding_mask(m_bool, q, k)
+    bias = np.asarray(_mask_to_kv_bias(m_bool))
+    assert (bias[0, :10] == 0).all()
+    assert (bias[0, 10:] < -1e29).all()
+    assert (bias[1] == 0).all()
+    m_add = jnp.zeros((2, 1, 1, 16), jnp.float32) - 5.0
+    np.testing.assert_allclose(np.asarray(_mask_to_kv_bias(m_add)), -5.0)
+    assert not _is_key_padding_mask(jnp.zeros((1, 1, 1, 16)), q, k)
+    assert not _is_key_padding_mask(jnp.zeros((2, 1, 1, 8)), q, k)
+    assert not _is_key_padding_mask(jnp.zeros((2, 1, 8, 16)), q, k)
