@@ -229,8 +229,8 @@ def bench_resnet(on_accel: bool) -> None:
 
     def build(df: str, fused: bool, s2d: bool, x_nchw):
         pt.seed(0)
-        pt.set_flags({"resnet_space_to_depth_stem": s2d})
         model = resnet50(data_format=df)
+        model.s2d_stem = s2d  # per-model pin; no global flag mutation
         model.to(dtype="bfloat16")
         opt = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
                                     fused_state=fused)
@@ -259,7 +259,7 @@ def bench_resnet(on_accel: bool) -> None:
     batches = [int(batch_env)] if batch_env else \
         ([64, 128, 256] if on_accel else [4])
     s2d_pin = pt.get_flags("resnet_space_to_depth_stem")[
-        "resnet_space_to_depth_stem"]  # restored in the finally below
+        "resnet_space_to_depth_stem"]
     candidates = [(b_, df, fu, s2d_pin and df == "NHWC")
                   for b_ in batches for df in layouts for fu in fuseds]
     # keep the sweep bounded: batch dim rides the first layout/fused
@@ -319,9 +319,6 @@ def bench_resnet(on_accel: bool) -> None:
     achieved_tflops = images_per_sec * 3 * fwd_gflops / 1e3
     target_tflops = 0.8 * 197.0
     log(f"{images_per_sec:.1f} images/s = {achieved_tflops:.1f} TFLOPs")
-    # build() flips the global s2d flag per candidate; hand back the
-    # env-pinned value (the winner's trace already captured its own)
-    pt.set_flags({"resnet_space_to_depth_stem": s2d_pin})
     print(json.dumps({
         "metric": "ResNet-50 train images/sec/chip",
         "value": round(images_per_sec, 1),

@@ -151,6 +151,14 @@ define_flag("optimizer_fused_state", False,
             "the bottleneck; Lamb/Lars and RowSlices-sparse paths always "
             "stay per-leaf. (ref capability: merged/multi-tensor "
             "optimizers, incubate multi_tensor_apply.)")
+define_flag("optimizer_moment_dtype", "float32",
+            "Storage dtype for Adam-family first/second moments "
+            "(float32 | bfloat16). bfloat16 halves optimizer-state HBM "
+            "traffic (~1.3 GB/step on BERT-base); update math still "
+            "runs in fp32 and the fp32 master weights are unaffected, "
+            "so the only loss is ~0.4% relative rounding on stored "
+            "m/v. Read at optimizer init. (ref capability: "
+            "multi_precision / master-weight family.)")
 define_flag("use_pallas_adam", False,
             "Use the Pallas fused-adam kernel. Off by default: measured on "
             "v5e the flatten/unflatten layout copies it forces on 2-D "

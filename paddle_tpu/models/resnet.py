@@ -125,6 +125,8 @@ class ResNet(nn.Layer):
             raise ValueError(f"data_format must be NCHW or NHWC, got "
                              f"{data_format!r}")
         self.data_format = data_format
+        # None = follow FLAGS_resnet_space_to_depth_stem; True/False pins
+        self.s2d_stem: Optional[bool] = None
         df = data_format
         self.inplanes = 64
         self.groups = groups
@@ -168,8 +170,11 @@ class ResNet(nn.Layer):
         return nn.Sequential(*layers)
 
     def forward(self, x):
-        if (GLOBAL_FLAGS.get("resnet_space_to_depth_stem")
-                and self.data_format == "NHWC"
+        # per-model override beats the global flag (lets a bench A/B
+        # candidates without mutating process state)
+        use_s2d = self.s2d_stem if self.s2d_stem is not None \
+            else GLOBAL_FLAGS.get("resnet_space_to_depth_stem")
+        if (use_s2d and self.data_format == "NHWC"
                 and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0):
             x = _space_to_depth_stem(x, self.conv1.weight)
         else:

@@ -35,6 +35,23 @@ def _tree_map(fn, *trees):
                         is_leaf=lambda x: isinstance(x, RowSlices))
 
 
+def _moment_dtype(default):
+    """Storage dtype for Adam-family moments: FLAGS_optimizer_moment_
+    dtype=bfloat16 halves the m/v HBM traffic (update math stays fp32;
+    fp32 masters unaffected)."""
+    from ..flags import GLOBAL_FLAGS
+    val = GLOBAL_FLAGS.get("optimizer_moment_dtype")
+    if val == "bfloat16":
+        return jnp.bfloat16
+    if val != "float32":
+        # a typo'd value silently measuring the fp32 baseline would
+        # corrupt exactly the A/B evidence this flag exists to produce
+        raise ValueError(
+            f"optimizer_moment_dtype={val!r}: expected 'float32' or "
+            "'bfloat16'")
+    return default
+
+
 def _as_f32(x):
     """Upcast a low-precision leaf to fp32 for optimizer math.
 
@@ -430,7 +447,8 @@ class Adam(Optimizer):
         self.lazy_mode = lazy_mode
 
     def init_slots(self, p):
-        return {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p)}
+        return {"m": jnp.zeros(p.shape, _moment_dtype(p.dtype)),
+                "v": jnp.zeros(p.shape, _moment_dtype(p.dtype))}
 
     def _bias_correct_lr(self, lr_t, step):
         step_f = step.astype(jnp.float32)
@@ -453,11 +471,15 @@ class Adam(Optimizer):
                 self.epsilon)
             return (p_new.reshape(p.shape),
                     {"m": m.reshape(p.shape), "v": v.reshape(p.shape)})
-        m = self.beta1 * slots["m"] + (1 - self.beta1) * g
-        v = self.beta2 * slots["v"] + (1 - self.beta2) * jnp.square(g)
+        # moments may be STORED low-precision (FLAGS_optimizer_moment_
+        # dtype): math always runs fp32, storage casts back
+        m_dt, v_dt = slots["m"].dtype, slots["v"].dtype
+        m = self.beta1 * _as_f32(slots["m"]) + (1 - self.beta1) * g
+        v = self.beta2 * _as_f32(slots["v"]) \
+            + (1 - self.beta2) * jnp.square(g)
         lr_c = self._bias_correct_lr(lr_t, step)
         new_p = p - lr_c * m / (jnp.sqrt(v) + self.epsilon)
-        return new_p, {"m": m, "v": v}
+        return new_p, {"m": m.astype(m_dt), "v": v.astype(v_dt)}
 
     def update_sparse(self, p, g: RowSlices, slots, lr_t, step):
         if not self.lazy_mode:
@@ -468,17 +490,20 @@ class Adam(Optimizer):
         safe_rows = jnp.minimum(g.rows, p.shape[0] - 1)
         valid = (g.rows < p.shape[0])[:, None].astype(p.dtype)
         g_rows = g.values.astype(p.dtype) * valid
-        m_rows = self.beta1 * m[safe_rows] + (1 - self.beta1) * g_rows
-        v_rows = self.beta2 * v[safe_rows] + (1 - self.beta2) \
+        m_rows = self.beta1 * _as_f32(m[safe_rows]) \
+            + (1 - self.beta1) * g_rows
+        v_rows = self.beta2 * _as_f32(v[safe_rows]) + (1 - self.beta2) \
             * jnp.square(g_rows)
         p_rows = p[safe_rows] - lr_c * m_rows / (jnp.sqrt(v_rows)
                                                  + self.epsilon)
         return (p.at[safe_rows].set(p[safe_rows] * (1 - valid)
                                     + p_rows * valid),
-                {"m": m.at[safe_rows].set(m[safe_rows] * (1 - valid)
-                                          + m_rows * valid),
-                 "v": v.at[safe_rows].set(v[safe_rows] * (1 - valid)
-                                          + v_rows * valid)})
+                {"m": m.at[safe_rows].set(
+                    (_as_f32(m[safe_rows]) * (1 - valid)
+                     + m_rows * valid).astype(m.dtype)),
+                 "v": v.at[safe_rows].set(
+                    (_as_f32(v[safe_rows]) * (1 - valid)
+                     + v_rows * valid).astype(v.dtype))})
 
 
 class AdamW(Adam):

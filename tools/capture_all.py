@@ -89,6 +89,12 @@ STAGES = {
                             "PT_BENCH_FUSED": "0",
                             "FLAGS_fused_qkv_projection": "0",
                             "FLAGS_transformer_remat": "1"}, 900),
+    "bert_b8_bf16mv": ([], {**_SKIP, **_SPL1,
+                            "PT_BENCH_BERT_BATCH": "8",
+                            "PT_BENCH_FUSED": "0",
+                            "FLAGS_fused_qkv_projection": "0",
+                            "FLAGS_optimizer_moment_dtype": "bfloat16"},
+                       900),
     "profile_bert": (["bert", "8"], {}, 900, "tools/profile_step.py"),
     "profile_bert_b32": (["bert", "32"], {}, 900,
                          "tools/profile_step.py"),
@@ -106,7 +112,7 @@ DIAG_PLAN = ["bert_b8_perleaf_noqkv", "bert_b8_perleaf_qkv",
              "resnet_nhwc_b128_perleaf", "flash", "flash_train",
              "profile_bert", "profile_bert_b32", "profile_resnet",
              "resnet_nhwc_b256_perleaf", "resnet_nhwc_b128_s2d",
-             "bert_b32_remat", "bert_b64_remat"]
+             "bert_b32_remat", "bert_b64_remat", "bert_b8_bf16mv"]
 
 
 def log(msg: str) -> None:
