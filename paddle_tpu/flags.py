@@ -144,7 +144,7 @@ define_flag("optimizer_fused_state", False,
             "one elementwise update over 3 buffers instead of 3 buffers "
             "PER parameter (~600 for BERT-base). MEASURED A REGRESSION "
             "on real v5e (round 3): BERT-base b32xs512 97.1k tok/s "
-            "per-leaf vs 77.1k fused (-26%) — the in-graph pack/unpack "
+            "per-leaf vs 77.1k fused (per-leaf +26%) — the in-graph pack/unpack "
             "slices cost more than the dispatch copies they save, and "
             "steps-per-loop measured per-dispatch overhead at ~0 anyway. "
             "Stays available for runtimes where per-buffer dispatch IS "

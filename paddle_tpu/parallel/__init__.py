@@ -18,3 +18,4 @@ from .mesh import (batch_sharding, create_mesh, create_multislice_mesh,
 from .spmd import ShardedTrainStep, make_param_specs, megatron_param_rule
 from .localsgd import LocalSGDStep  # noqa: E402,F401
 from .dgc import DGCTrainStep, dgc_allreduce, topk_sparsify  # noqa: E402,F401
+from .long_context import ring_attention, ulysses_attention  # noqa: E402,F401

@@ -1,0 +1,121 @@
+"""Derive recommended bench/flag defaults from capture artifacts.
+
+Reads the CAPTURE_*.json files produced by tools/capture_all.py and
+prints, for every A/B the diag plan encodes, the measured winner and
+the concrete default it implies (bench candidate order, flag value).
+Purely a reporting tool — it changes nothing; the builder applies the
+recommendations by hand so each flip lands with its evidence quoted.
+
+Usage: python tools/recommend.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load(stage: str):
+    try:
+        with open(os.path.join(ROOT, f"CAPTURE_{stage}.json")) as f:
+            d = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not d.get("ok") or not d.get("parsed"):
+        return None
+    return d["parsed"].get("value")
+
+
+def tok(stage):
+    return load(stage)  # tokens/sec (higher better)
+
+
+def main() -> None:
+    rows = []
+
+    def compare(name, a_stage, b_stage, a_label, b_label,
+                implies_fmt):
+        a, b_ = tok(a_stage), tok(b_stage)
+        if a is None or b_ is None:
+            missing = [s for s, v in ((a_stage, a), (b_stage, b_))
+                       if v is None]
+            rows.append((name, f"PENDING (missing {missing})", ""))
+            return None
+        win, lose = (a_label, b_label) if a >= b_ else (b_label, a_label)
+        ratio = max(a, b_) / max(min(a, b_), 1e-9)
+        rows.append((name, f"{win} wins {ratio:.2f}x "
+                     f"({a_label}={a:.0f} vs {b_label}={b_:.0f})",
+                     implies_fmt.format(win=win)))
+        return win
+
+    # fused QKV at b8 (round-2 chip said -3%, round-3 HLO said better)
+    compare("fused QKV projection (b8)",
+            "bert_b8_perleaf_qkv", "bert_b8_perleaf_noqkv",
+            "qkv_on", "qkv_off",
+            "flags.fused_qkv_projection default = {win}")
+    # batch scaling, per-leaf
+    vals = {b: tok(f"bert_b{b}_perleaf_noqkv") for b in (8, 16, 32)}
+    if all(v is not None for v in vals.values()):
+        order = sorted(vals, key=lambda b: -vals[b])
+        rows.append(("BERT batch order (per-leaf, noqkv)",
+                     " > ".join(f"b{b}={vals[b]:.0f}" for b in order),
+                     f"bench batch_opts = {order}"))
+    else:
+        rows.append(("BERT batch order",
+                     f"PENDING ({ {b: v for b, v in vals.items()} })",
+                     ""))
+    # remat
+    b32 = tok("bert_b32_perleaf_noqkv")
+    r32 = tok("bert_b32_remat")
+    if b32 is not None and r32 is not None:
+        rows.append(("transformer_remat (b32)",
+                     f"{'remat' if r32 > b32 else 'no-remat'} wins "
+                     f"({r32:.0f} vs {b32:.0f})",
+                     f"flags.transformer_remat default = {r32 > b32}"))
+    r64 = tok("bert_b64_remat")
+    if r64 is not None:
+        rows.append(("remat-enabled b64",
+                     f"{r64:.0f} tok/s", "larger-batch headroom check"))
+    # bf16 moments
+    b8 = tok("bert_b8_perleaf_noqkv")
+    mv = tok("bert_b8_bf16mv")
+    if b8 is not None and mv is not None:
+        rows.append(("optimizer_moment_dtype bf16 (b8)",
+                     f"{'bf16' if mv > b8 else 'fp32'} wins "
+                     f"({mv:.0f} vs {b8:.0f})",
+                     "flags.optimizer_moment_dtype default = "
+                     f"{'bfloat16' if mv > b8 else 'float32'}"))
+    # resnet
+    compare("ResNet s2d stem (b128 NHWC)",
+            "resnet_nhwc_b128_s2d", "resnet_nhwc_b128_perleaf",
+            "s2d", "plain",
+            "flags.resnet_space_to_depth_stem default = "
+            "{win}" .replace("{win}", "(s2d wins?)"))
+    r256 = tok("resnet_nhwc_b256_perleaf")
+    r128 = tok("resnet_nhwc_b128_perleaf")
+    if r256 is not None and r128 is not None:
+        rows.append(("ResNet batch 256 vs 128 (img/s)",
+                     f"b256={r256:.0f} vs b128={r128:.0f}",
+                     "bench batches order"))
+    # flash crossover: report the stage's speedup metrics
+    for st in ("flash", "flash_train"):
+        v = load(st)
+        if v is not None:
+            rows.append((f"{st} speedup at top seq", f"{v}x",
+                         "flash_attention_min_seq from the per-seq "
+                         "stderr table in the capture artifact"))
+        else:
+            rows.append((f"{st}", "PENDING", ""))
+
+    w = max(len(r[0]) for r in rows) + 2
+    for name, result, implies in rows:
+        line = f"{name:<{w}} {result}"
+        if implies:
+            line += f"   -> {implies}"
+        print(line)
+
+
+if __name__ == "__main__":
+    main()
