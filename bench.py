@@ -29,17 +29,26 @@ def log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
-def warmup_and_time(step_once, iters: int):
+def warmup_and_time(step_once, iters: int, settle_s: float = 1.0):
     """Warm up until compiles settle (donated-state layouts reach their
     fixpoint after a few calls), then time ``iters`` calls. Syncs by
     fetching the loss value — block_until_ready is not a reliable sync
-    over remote-dispatch backends. Returns seconds per iteration."""
-    for i in range(6):
+    over remote-dispatch backends. Returns seconds per iteration.
+
+    Requires TWO consecutive sub-second calls before timing: the
+    donated-state layout fixpoint can trigger a recompile on call 2-3,
+    and a single fast call would let that recompile land inside the
+    timed region and corrupt the measurement. ``settle_s`` is the
+    "settled" threshold — callers timing K-steps-per-dispatch scale it
+    by K so a steady multi-step dispatch still exits early."""
+    fast = 0
+    for i in range(8):
         t0 = time.perf_counter()
         float(step_once()["loss"])
         dt = time.perf_counter() - t0
         log(f"warmup {i}: {dt:.2f}s")
-        if dt < 1.0:
+        fast = fast + 1 if dt < settle_s else 0
+        if fast >= 2:
             break
     log(f"timing {iters} steps...")
     t0 = time.perf_counter()
@@ -73,7 +82,7 @@ def maybe_steps_per_loop(step, stacked, dt_single: float, iters: int,
         dt_multi = warmup_and_time(
             lambda: {"loss": step.run_steps(
                 *args, labels=labels)["loss"][-1]},
-            iters // spl + 1) / spl
+            iters // spl + 1, settle_s=float(spl)) / spl
     except Exception as e:  # noqa: BLE001
         if not looks_oom(e):
             raise
