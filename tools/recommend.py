@@ -11,21 +11,12 @@ Usage: python tools/recommend.py
 
 from __future__ import annotations
 
-import json
 import os
+import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def load(stage: str):
-    try:
-        with open(os.path.join(ROOT, f"CAPTURE_{stage}.json")) as f:
-            d = json.load(f)
-    except (OSError, json.JSONDecodeError):
-        return None
-    if not d.get("ok") or not d.get("parsed"):
-        return None
-    return d["parsed"].get("value")
+sys.path.insert(0, ROOT)
+from bench import capture_value as load  # noqa: E402 (one shared reader)
 
 
 def tok(stage):
