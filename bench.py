@@ -29,6 +29,20 @@ def log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+def device_kind() -> str:
+    import jax
+    try:
+        return str(jax.devices()[0].device_kind)
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
+def emit(result: dict) -> None:
+    """Print the one-line JSON result, stamped with the chip identity so
+    capture artifacts are only ever auto-applied on the same hardware."""
+    print(json.dumps(dict(result, device=device_kind())))
+
+
 def warmup_and_time(step_once, iters: int, settle_s: float = 1.0):
     """Warm up until compiles settle (donated-state layouts reach their
     fixpoint after a few calls), then time ``iters`` calls. Syncs by
@@ -61,7 +75,7 @@ def warmup_and_time(step_once, iters: int, settle_s: float = 1.0):
 _capture_cache: dict = {}
 
 
-def capture_value(stage: str):
+def capture_value(stage: str, any_device: bool = False):
     """Measured value from a prior capture campaign artifact
     (CAPTURE_<stage>.json), or None. Lets the bench apply measured
     winners — candidate ordering and flag choices — automatically when
@@ -70,18 +84,23 @@ def capture_value(stage: str):
     tools/recommend.py (one reader for the artifact contract)."""
     import os
 
-    if stage in _capture_cache:
-        return _capture_cache[stage]
+    key = (stage, any_device)
+    if key in _capture_cache:
+        return _capture_cache[key]
     val = None
     try:
         with open(os.path.join(os.path.dirname(os.path.abspath(
                 __file__)), f"CAPTURE_{stage}.json")) as f:
             d = json.load(f)
         if d.get("ok") and d.get("parsed"):
-            val = d["parsed"].get("value")
+            # only trust artifacts measured on THIS hardware: the files
+            # are git-tracked, so a clone on a different chip would
+            # otherwise inherit v5e-tuned pins
+            if any_device or d["parsed"].get("device") == device_kind():
+                val = d["parsed"].get("value")
     except (OSError, json.JSONDecodeError):
         pass
-    _capture_cache[stage] = val
+    _capture_cache[key] = val
     return val
 
 
@@ -277,12 +296,12 @@ def bench_bert(on_accel: bool) -> None:
     target_tflops = 0.8 * 197.0  # 80% of v5e bf16 peak
     log(f"{tokens_per_sec:.0f} tok/s = {achieved_tflops:.1f} TFLOPs "
         f"({achieved_tflops / 197.0 * 100:.1f}% v5e MFU)")
-    print(json.dumps({
+    emit({
         "metric": "BERT-base pretrain tokens/sec/chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec",
         "vs_baseline": round(achieved_tflops / target_tflops, 4),
-    }))
+    })
 
 
 def bench_resnet(on_accel: bool) -> None:
@@ -410,12 +429,12 @@ def bench_resnet(on_accel: bool) -> None:
     achieved_tflops = images_per_sec * 3 * fwd_gflops / 1e3
     target_tflops = 0.8 * 197.0
     log(f"{images_per_sec:.1f} images/s = {achieved_tflops:.1f} TFLOPs")
-    print(json.dumps({
+    emit({
         "metric": "ResNet-50 train images/sec/chip",
         "value": round(images_per_sec, 1),
         "unit": "images/sec",
         "vs_baseline": round(achieved_tflops / target_tflops, 4),
-    }))
+    })
 
 
 def bench_flash_attention(on_accel: bool) -> None:
@@ -487,12 +506,12 @@ def bench_flash_attention(on_accel: bool) -> None:
     oom_lens = [t for t, (a, b) in results.items() if b and not a]
     if oom_lens:
         log(f"flash ran where XLA could not: seqs {oom_lens}")
-    print(json.dumps({
+    emit({
         "metric": f"flash-attention fwd speedup vs XLA @seq{t_big}",
         "value": speed,
         "unit": "x",
         "vs_baseline": speed,
-    }))
+    })
 
 
 def bench_flash_train(on_accel: bool) -> None:
@@ -563,13 +582,13 @@ def bench_flash_train(on_accel: bool) -> None:
     crossover = [t for t, (a, c) in results.items()
                  if a and c and c < a]
     log(f"flash train-mode wins at seqs {crossover}")
-    print(json.dumps({
+    emit({
         "metric": f"flash-attention train fwd+bwd speedup vs XLA "
                   f"@seq{t_big} (d64+dropout)",
         "value": speed,
         "unit": "x",
         "vs_baseline": speed,
-    }))
+    })
 
 
 def _probe_backend(attempts: int = 3, timeout_s: int = 60) -> bool:
@@ -635,12 +654,12 @@ def main() -> None:
         # when there is no time budget for a full bench
         from paddle_tpu.verify import run_verification
         res = run_verification()
-        print(json.dumps({
+        emit({
             "metric": "hardware verification (kernels + 10-step parity)",
             "value": 1.0 if res["ok"] else 0.0,
             "unit": "ok",
             "vs_baseline": 1.0 if res["ok"] else 0.0,
-        }))
+        })
         sys.exit(0 if res["ok"] else 1)
 
     skip_validate = os.environ.get(

@@ -72,3 +72,9 @@ def test_distributed_data_parallel():
 def test_inference_serving():
     import inference_serving
     assert inference_serving.main(verbose=False)["ok"]
+
+
+def test_long_context():
+    import long_context
+    err = long_context.main(seq=256, verbose=False, interpret=True)
+    assert err < 2e-4
