@@ -534,3 +534,65 @@ def test_mask_to_kv_bias_helpers():
     assert not _is_key_padding_mask(jnp.zeros((1, 1, 1, 16)), q, k)
     assert not _is_key_padding_mask(jnp.zeros((2, 1, 1, 8)), q, k)
     assert not _is_key_padding_mask(jnp.zeros((2, 1, 8, 16)), q, k)
+
+
+
+def test_train_step_through_flash_path(monkeypatch):
+    """End-to-end: a BERT train step with attention routed through the
+    Pallas flash kernel (interpret mode), in-kernel dropout seeded from
+    the traced RNG stream, under jit + grad + donated state — the exact
+    integration the chip exercises at long sequence. Loss trajectory
+    must track the XLA-attention step closely (same per-layer dropout
+    stream, different mask bits, so trajectories agree loosely but both
+    must decrease)."""
+    import functools
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import kernels
+    from paddle_tpu.kernels import flash_attention as fa_mod
+    from paddle_tpu.models import (BertConfig, BertForPretraining,
+                                   pretraining_loss)
+    from paddle_tpu.static import TrainStep
+
+    config = BertConfig(num_hidden_layers=2, hidden_size=64,
+                        num_attention_heads=2, intermediate_size=128,
+                        vocab_size=512, max_position_embeddings=64)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 512, (2, 64)).astype(np.int32)
+    mlm = rng.integers(0, 512, (2, 64)).astype(np.int64)
+    nsp = rng.integers(0, 2, (2,)).astype(np.int64)
+
+    prior_min_seq = pt.get_flags("flash_attention_min_seq")[
+        "flash_attention_min_seq"]
+
+    def run(flash: bool):
+        if flash:
+            monkeypatch.setattr(kernels, "_on_tpu", lambda: True)
+            monkeypatch.setattr(
+                fa_mod, "flash_attention",
+                functools.partial(fa_mod.flash_attention,
+                                  interpret=True))
+            pt.set_flags({"flash_attention_min_seq": 1})
+        try:
+            pt.seed(0)
+            m = BertForPretraining(config)
+            o = pt.optimizer.AdamW(learning_rate=1e-3)
+            step = TrainStep(m, o, lambda out, a, b:
+                             pretraining_loss(out, a, b))
+            return [float(step(ids, labels=(mlm, nsp))["loss"])
+                    for _ in range(4)]
+        finally:
+            if flash:
+                pt.set_flags(
+                    {"flash_attention_min_seq": prior_min_seq})
+                monkeypatch.undo()
+
+    base = run(False)
+    fl = run(True)
+    assert base[-1] < base[0], base
+    assert fl[-1] < fl[0], fl
+    # same model/data/optimizer; only attention impl + dropout bits
+    # differ — trajectories must agree to dropout-noise tolerance
+    np.testing.assert_allclose(fl, base, rtol=0.1)
