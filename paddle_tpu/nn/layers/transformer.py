@@ -90,13 +90,26 @@ class MultiHeadAttention(Layer):
             q = self._split(self.q_proj(query))
             k = self._split(self.k_proj(key))
             v = self._split(self.v_proj(value))
-        from ...kernels import maybe_flash_attention
-        out = maybe_flash_attention(
-            q, k, v, mask=attn_mask, causal=causal,
-            dropout_p=self.dropout, training=self.training)
+        if self.need_weights:
+            # the reference returns (out, attention weights); weights
+            # require materializing the [B, H, Tq, Tk] probs, so this
+            # path stays on the XLA composition by construction
+            from ...ops.attention import scaled_dot_product_attention
+            out, weights = scaled_dot_product_attention(
+                q, k, v, mask=attn_mask, causal=causal,
+                dropout_p=self.dropout, training=self.training,
+                return_weights=True)
+        else:
+            from ...kernels import maybe_flash_attention
+            out = maybe_flash_attention(
+                q, k, v, mask=attn_mask, causal=causal,
+                dropout_p=self.dropout, training=self.training)
         b, h, t, d = out.shape
         out = jnp.moveaxis(out, 1, 2).reshape(b, t, h * d)
-        return self.out_proj(out)
+        out = self.out_proj(out)
+        if self.need_weights:
+            return out, weights
+        return out
 
 
 class TransformerEncoderLayer(Layer):

@@ -23,16 +23,23 @@ def scaled_dot_product_attention(q, k, v, mask=None,
                                  scale: Optional[float] = None,
                                  causal: bool = False,
                                  dropout_p: float = 0.0,
-                                 training: bool = False, key=None):
+                                 training: bool = False, key=None,
+                                 return_weights: bool = False):
     """q,k,v: [B, H, T, D] (or any [..., T, D]). mask broadcasts to
-    [..., Tq, Tk]; additive if float, boolean keep-mask otherwise."""
+    [..., Tq, Tk]; additive if float, boolean keep-mask otherwise.
+    ``return_weights=True`` additionally returns the (post-dropout)
+    attention probabilities — the one definition MultiHeadAttention's
+    need_weights path shares, so the two cannot drift."""
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     logits = jnp.einsum("...qd,...kd->...qk", q, k) * scale
     weights = _attention_weights(logits, mask, causal, dropout_p,
                                  training, key)
-    return jnp.einsum("...qk,...kd->...qd", weights, v)
+    out = jnp.einsum("...qk,...kd->...qd", weights, v)
+    if return_weights:
+        return out, weights
+    return out
 
 
 def _attention_weights(logits, mask, causal, dropout_p, training, key):

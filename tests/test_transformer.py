@@ -37,3 +37,28 @@ def test_transformer_remat_parity():
     base = one_step(False)
     remat = one_step(True)
     np.testing.assert_allclose(remat, base, rtol=1e-5, atol=1e-6)
+
+
+
+def test_mha_need_weights():
+    """need_weights=True returns (out, weights) like the reference;
+    out matches the default path and weights are the softmax probs."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(0, 1, (2, 6, 16)), jnp.float32)
+    pt.seed(0)
+    m0 = pt.nn.MultiHeadAttention(16, 2)
+    pt.seed(0)
+    m1 = pt.nn.MultiHeadAttention(16, 2, need_weights=True)
+    m0.eval()
+    m1.eval()
+    out0 = m0(x)
+    out1, w = m1(x)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out0),
+                               rtol=2e-5, atol=2e-5)
+    assert w.shape == (2, 2, 6, 6)
+    np.testing.assert_allclose(np.asarray(w.sum(-1)), 1.0, rtol=1e-5)
