@@ -97,13 +97,43 @@ class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None,
                  weight_decay: Optional[float] = None, grad_clip=None,
                  name: Optional[str] = None,
-                 fused_state: Optional[bool] = None) -> None:
+                 fused_state: Optional[bool] = None,
+                 regularization=None) -> None:
         self.learning_rate = learning_rate
         self._parameter_list = list(parameters) if parameters else None
+        # the reference's ``regularization=L2Decay(...)`` spelling is an
+        # alias for weight_decay; both floats and regularizer objects
+        # (called as reg(param, grad)) are accepted either way
+        if weight_decay is None and regularization is not None:
+            weight_decay = regularization
         self.weight_decay = weight_decay
         self.grad_clip = grad_clip
         self._fused_state = fused_state
         self._eager_state = None
+        # per-parameter ParamAttr metadata (set_param_meta): {name:
+        # (need_clip, regularizer)}; consumed when grads/params are
+        # name-keyed dicts (the TrainStep contract)
+        self._param_meta: Dict[str, Any] = {}
+
+    def set_param_meta(self, meta) -> None:
+        """Record per-parameter ParamAttr metadata: ``{name:
+        (need_clip, regularizer)}``. need_clip=False excludes that
+        parameter from grad_clip; a per-param regularizer replaces the
+        optimizer-level weight_decay for that parameter (reference
+        semantics: ParamAttr.regularizer overrides optimizer
+        regularization)."""
+        self._param_meta = dict(meta)
+
+    def _decay_grad(self, g, p32, reg=None):
+        """Apply weight decay to a grad: per-param regularizer if set,
+        else the optimizer-level weight_decay (float coefficient or a
+        regularizer object called as reg(param, grad))."""
+        wd = reg if reg is not None else self.weight_decay
+        if not wd:
+            return g
+        if callable(wd):
+            return wd(p32, g)
+        return g + wd * p32
 
     def _use_fused(self) -> bool:
         if not self._elementwise_update:
@@ -178,27 +208,54 @@ class Optimizer:
         grads = jax.tree.map(
             _g32, grads,
             is_leaf=lambda x: x is None or isinstance(x, RowSlices))
+        meta = self._param_meta if isinstance(grads, dict) else {}
         if self.grad_clip is not None:
-            grads = self.grad_clip(grads)
+            no_clip = {n for n, (nc, _) in meta.items() if not nc}
+            if no_clip:
+                # excluded params keep their raw grads and do not feed
+                # the (global) norm (ref: ParamAttr need_clip=False)
+                subset = {n: g for n, g in grads.items()
+                          if n not in no_clip}
+                if subset:  # all-excluded: nothing to clip
+                    grads = {**grads, **self.grad_clip(subset)}
+            else:
+                grads = self.grad_clip(grads)
 
         flat_p, treedef = jax.tree.flatten(
             params, is_leaf=lambda x: isinstance(x, RowSlices))
         flat_g = treedef.flatten_up_to(grads)
         flat_s = treedef.flatten_up_to(state["slots"])
+        if meta:
+            # align per-leaf regularizers with the flat order via the
+            # actual tree paths (works for nested dicts too; unmatched
+            # paths just get no per-param regularizer)
+            from jax.tree_util import tree_flatten_with_path
+            paths, _ = tree_flatten_with_path(
+                params, is_leaf=lambda x: isinstance(x, RowSlices))
+            regs = [meta.get(".".join(str(getattr(k, "key", k))
+                                      for k in path),
+                             (True, None))[1]
+                    for path, _leaf in paths]
+        else:
+            regs = [None] * len(flat_p)
 
         if "fused" in state:
+            if any(r is not None for r in regs):
+                raise ValueError(
+                    "per-parameter regularizers are not supported with "
+                    "optimizer_fused_state; set fused_state=False")
             return self._apply_fused(flat_p, flat_g, flat_s, treedef,
                                      state, lr_t, step)
 
         new_p, new_s = [], []
-        for p, g, s in zip(flat_p, flat_g, flat_s):
-            np_, ns_ = self._update_leaf(p, g, s, lr_t, step)
+        for p, g, s, r in zip(flat_p, flat_g, flat_s, regs):
+            np_, ns_ = self._update_leaf(p, g, s, lr_t, step, reg=r)
             new_p.append(np_)
             new_s.append(ns_)
         return (jax.tree.unflatten(treedef, new_p),
                 {"step": step, "slots": jax.tree.unflatten(treedef, new_s)})
 
-    def _update_leaf(self, p, g, s, lr_t, step):
+    def _update_leaf(self, p, g, s, lr_t, step, reg=None):
         """One per-leaf update (shared by the per-leaf and fused paths'
         non-eligible branch): fp32 master handling, RowSlices dispatch,
         decay, cast back to the param dtype."""
@@ -214,8 +271,7 @@ class Optimizer:
         if isinstance(g, RowSlices):
             np_, ns_ = self.update_sparse(p32, g, s_upd, lr_t, step)
         else:
-            if self.weight_decay:
-                g = g + self.weight_decay * p32
+            g = self._decay_grad(g, p32, reg)
             np_, ns_ = self.update(p32, g, s_upd, lr_t, step)
         if has_master:
             ns_ = dict(ns_, master=np_)
@@ -267,7 +323,7 @@ class Optimizer:
         if self.weight_decay:
             decay = master if not any_sparse else \
                 master * jnp.concatenate(decay_parts)
-            gflat = gflat + self.weight_decay * decay
+            gflat = self._decay_grad(gflat, decay)
         if mask_flat is not None:
             # after decay: a frozen leaf must be an exact no-op, decay
             # included
@@ -512,6 +568,14 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, weight_decay: float = 0.01,
                  apply_decay_param_fun=None, **kw) -> None:
+        if "regularization" in kw:
+            # the base class would fold it into coupled weight_decay,
+            # which the next line resets — reject loudly instead of
+            # silently training without decay
+            raise TypeError(
+                "AdamW uses DECOUPLED weight decay: pass weight_decay="
+                "<float> (regularization= is the coupled-L2 spelling; "
+                "use Adam for that)")
         kw.pop("weight_decay", None)
         super().__init__(learning_rate, beta1, beta2, epsilon, **kw)
         self.decoupled_weight_decay = weight_decay

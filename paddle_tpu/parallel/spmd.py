@@ -134,6 +134,8 @@ class ShardedTrainStep:
                  zero_stage: int = 0, dp_axis: str = "dp") -> None:
         self.model = model
         self.optimizer = optimizer
+        from ..static import _wire_param_meta
+        _wire_param_meta(model, optimizer)
         self.loss_fn = loss_fn
         self.mesh = mesh
         self.batch_spec = batch_spec

@@ -307,6 +307,22 @@ def _check_nan_inf(tree, what: str) -> None:
 # TrainStep — the fused train program builder
 # ---------------------------------------------------------------------------
 
+
+
+def _wire_param_meta(model, optimizer) -> None:
+    """Hand per-parameter ParamAttr metadata (need_clip, regularizer)
+    to the optimizer, keyed like param_dict — reference semantics:
+    need_clip=False skips grad clip; a param regularizer overrides the
+    optimizer-level regularization for that parameter."""
+    meta = {}
+    for n, p in model.named_parameters():
+        need_clip = getattr(p, "need_clip", True)
+        reg = getattr(p, "regularizer", None)
+        if not need_clip or reg is not None:
+            meta[n] = (need_clip, reg)
+    if meta:
+        optimizer.set_param_meta(meta)
+
 class TrainStep:
     """Compile model+loss+optimizer into one donated-state XLA program.
 
@@ -325,6 +341,7 @@ class TrainStep:
                  Callable]] = None, seed: int = 0) -> None:
         self.model = model
         self.optimizer = optimizer
+        _wire_param_meta(model, optimizer)
         self.loss_fn = loss_fn
         self.extra_metrics = extra_metrics or {}
         params = model.param_dict()

@@ -108,3 +108,127 @@ def test_bf16_moments_fused_and_sparse_paths():
         assert (untouched == 0).all()
     finally:
         pt.set_flags({"optimizer_moment_dtype": "float32"})
+
+
+
+def test_param_attr_need_clip_and_regularizer():
+    """ParamAttr metadata is honored through TrainStep: need_clip=False
+    excludes a param from global-norm clipping; a per-param L2Decay
+    overrides the optimizer-level weight decay for that param only."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.clip import ClipGradByGlobalNorm
+    from paddle_tpu.optimizer import SGD
+
+    # --- need_clip: excluded param keeps its raw gradient
+    opt = SGD(learning_rate=1.0,
+              grad_clip=ClipGradByGlobalNorm(0.1))
+    opt.set_param_meta({"b": (False, None)})
+    params = {"w": jnp.ones((4,)), "b": jnp.ones((2,))}
+    grads = {"w": jnp.full((4,), 3.0), "b": jnp.full((2,), 3.0)}
+    state = opt.init(params)
+    new_p, _ = opt.apply_gradients(params, grads, state)
+    # b's grad is NOT clipped: update is exactly lr*3
+    np.testing.assert_allclose(np.asarray(new_p["b"]), 1.0 - 3.0,
+                               rtol=1e-6)
+    # w's grad IS clipped to global-norm 0.1 over w alone
+    w_upd = 1.0 - np.asarray(new_p["w"])
+    np.testing.assert_allclose(np.linalg.norm(w_upd), 0.1, rtol=1e-5)
+
+    # --- per-param regularizer overrides optimizer-level decay
+    opt2 = SGD(learning_rate=1.0, weight_decay=0.5)
+    opt2.set_param_meta({"b": (True, pt.regularizer.L2Decay(0.0))})
+    state2 = opt2.init(params)
+    zero_g = {"w": jnp.zeros((4,)), "b": jnp.zeros((2,))}
+    new_p2, _ = opt2.apply_gradients(params, zero_g, state2)
+    # w decayed by 0.5, b's zero-coeff regularizer wins (no decay)
+    np.testing.assert_allclose(np.asarray(new_p2["w"]), 0.5, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(new_p2["b"]), 1.0, rtol=1e-6)
+
+
+def test_regularization_object_as_weight_decay():
+    """The reference's regularization=L2Decay(c) spelling works, as
+    does weight_decay=L2Decay(c): both decay like the float coeff."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.optimizer import Momentum
+
+    params = {"w": jnp.ones((4,))}
+    grads = {"w": jnp.zeros((4,))}
+
+    outs = []
+    for kw in ({"weight_decay": 0.1},
+               {"weight_decay": pt.regularizer.L2Decay(0.1)},
+               {"regularization": pt.regularizer.L2Decay(0.1)}):
+        opt = Momentum(learning_rate=1.0, momentum=0.0, **kw)
+        st = opt.init(params)
+        new_p, _ = opt.apply_gradients(params, grads, st)
+        outs.append(np.asarray(new_p["w"]))
+    np.testing.assert_allclose(outs[1], outs[0], rtol=1e-6)
+    np.testing.assert_allclose(outs[2], outs[0], rtol=1e-6)
+
+
+def test_param_attr_metadata_through_train_step():
+    """End to end: a Layer built with ParamAttr(need_clip=False,
+    regularizer=...) trains through TrainStep with the metadata wired
+    into the optimizer automatically."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.clip import ClipGradByGlobalNorm
+    from paddle_tpu.static import TrainStep
+
+    pt.seed(0)
+    net = pt.nn.Linear(
+        4, 2,
+        weight_attr=pt.ParamAttr(regularizer=pt.regularizer.L2Decay(0.1)),
+        bias_attr=pt.ParamAttr(need_clip=False))
+    opt = pt.optimizer.SGD(learning_rate=0.1,
+                           grad_clip=ClipGradByGlobalNorm(1.0))
+    step = TrainStep(net, opt,
+                     lambda out, t: pt.nn.functional.mse_loss(out, t))
+    assert opt._param_meta, "TrainStep must wire ParamAttr metadata"
+    assert "weight" in next(iter(opt._param_meta))  or any(
+        "weight" in k for k in opt._param_meta)
+    x = np.random.default_rng(0).normal(0, 1, (8, 4)).astype(np.float32)
+    y = np.random.default_rng(1).normal(0, 1, (8, 2)).astype(np.float32)
+    l0 = float(step(x, labels=y)["loss"])
+    l1 = float(step(x, labels=y)["loss"])
+    assert l1 < l0
+
+
+
+def test_param_meta_edge_cases():
+    """All-params-excluded clipping is a no-op (not a crash), per-param
+    regularizers align through NESTED dict pytrees, and AdamW rejects
+    the coupled regularization= spelling loudly."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    import pytest
+    from paddle_tpu.clip import ClipGradByGlobalNorm
+    from paddle_tpu.optimizer import SGD, AdamW
+
+    opt = SGD(learning_rate=1.0, grad_clip=ClipGradByGlobalNorm(0.1))
+    opt.set_param_meta({"w": (False, None), "b": (False, None)})
+    p = {"w": jnp.ones((4,)), "b": jnp.ones((2,))}
+    g = {"w": jnp.full((4,), 3.0), "b": jnp.full((2,), 3.0)}
+    new_p, _ = opt.apply_gradients(p, g, opt.init(p))
+    np.testing.assert_allclose(np.asarray(new_p["w"]), -2.0)
+
+    opt2 = SGD(learning_rate=1.0)
+    opt2.set_param_meta({"layer.w": (True, pt.regularizer.L2Decay(0.5))})
+    p2 = {"layer": {"w": jnp.ones((3,)), "b": jnp.ones((2,))}}
+    g2 = {"layer": {"w": jnp.zeros((3,)), "b": jnp.zeros((2,))}}
+    np2, _ = opt2.apply_gradients(p2, g2, opt2.init(p2))
+    np.testing.assert_allclose(np.asarray(np2["layer"]["w"]), 0.5)
+    np.testing.assert_allclose(np.asarray(np2["layer"]["b"]), 1.0)
+
+    with pytest.raises(TypeError):
+        AdamW(learning_rate=1e-3,
+              regularization=pt.regularizer.L2Decay(0.01))
