@@ -124,6 +124,17 @@ class Optimizer:
         regularization)."""
         self._param_meta = dict(meta)
 
+    def _with_zeroed_attr(self, attr: str, fn):
+        """Run ``fn`` with ``self.<attr>`` temporarily set to 0.0 —
+        trace-time only (the per-leaf loop is sequential Python), used
+        by name-filtered decay exclusions."""
+        saved = getattr(self, attr)
+        setattr(self, attr, 0.0)
+        try:
+            return fn()
+        finally:
+            setattr(self, attr, saved)
+
     def _decay_grad(self, g, p32, reg=None):
         """Apply weight decay to a grad: per-param regularizer if set,
         else the optimizer-level weight_decay (float coefficient or a
@@ -209,53 +220,65 @@ class Optimizer:
             _g32, grads,
             is_leaf=lambda x: x is None or isinstance(x, RowSlices))
         meta = self._param_meta if isinstance(grads, dict) else {}
-        if self.grad_clip is not None:
-            no_clip = {n for n, (nc, _) in meta.items() if not nc}
-            if no_clip:
-                # excluded params keep their raw grads and do not feed
-                # the (global) norm (ref: ParamAttr need_clip=False)
-                subset = {n: g for n, g in grads.items()
-                          if n not in no_clip}
-                if subset:  # all-excluded: nothing to clip
-                    grads = {**grads, **self.grad_clip(subset)}
-            else:
-                grads = self.grad_clip(grads)
-
         flat_p, treedef = jax.tree.flatten(
             params, is_leaf=lambda x: isinstance(x, RowSlices))
         flat_g = treedef.flatten_up_to(grads)
         flat_s = treedef.flatten_up_to(state["slots"])
-        if meta:
-            # align per-leaf regularizers with the flat order via the
-            # actual tree paths (works for nested dicts too; unmatched
-            # paths just get no per-param regularizer)
+        need_names = bool(meta) or \
+            getattr(self, "apply_decay_param_fun", None) is not None or \
+            getattr(self, "exclude_fn", None) is not None
+        if need_names:
+            # align per-leaf regularizers/names with the flat order via
+            # the actual tree paths (works for nested dicts too;
+            # unmatched paths just get defaults)
             from jax.tree_util import tree_flatten_with_path
             paths, _ = tree_flatten_with_path(
                 params, is_leaf=lambda x: isinstance(x, RowSlices))
-            regs = [meta.get(".".join(str(getattr(k, "key", k))
-                                      for k in path),
-                             (True, None))[1]
-                    for path, _leaf in paths]
+            names = [".".join(str(getattr(k, "key", k)) for k in path)
+                     for path, _leaf in paths]
+            regs = [meta.get(n, (True, None))[1] for n in names]
         else:
+            names = [None] * len(flat_p)
             regs = [None] * len(flat_p)
+
+        if self.grad_clip is not None:
+            no_clip = {n for n, (nc, _) in meta.items() if not nc}
+            if no_clip and need_names:
+                # excluded params keep their raw grads and do not feed
+                # the (global) norm (ref: ParamAttr need_clip=False);
+                # clipping runs on an index-keyed flat view so nesting
+                # cannot hide an exclusion
+                sub = {i: g for i, (g, n) in
+                       enumerate(zip(flat_g, names)) if n not in no_clip}
+                if sub:  # all-excluded: nothing to clip
+                    clipped = self.grad_clip(sub)
+                    flat_g = [clipped.get(i, g)
+                              for i, g in enumerate(flat_g)]
+            else:
+                flat_g = treedef.flatten_up_to(self.grad_clip(grads))
 
         if "fused" in state:
             if any(r is not None for r in regs):
                 raise ValueError(
                     "per-parameter regularizers are not supported with "
                     "optimizer_fused_state; set fused_state=False")
+            if getattr(self, "apply_decay_param_fun", None) is not None:
+                raise ValueError(
+                    "apply_decay_param_fun needs per-parameter updates; "
+                    "set fused_state=False")
             return self._apply_fused(flat_p, flat_g, flat_s, treedef,
                                      state, lr_t, step)
 
         new_p, new_s = [], []
-        for p, g, s, r in zip(flat_p, flat_g, flat_s, regs):
-            np_, ns_ = self._update_leaf(p, g, s, lr_t, step, reg=r)
+        for p, g, s, r, n in zip(flat_p, flat_g, flat_s, regs, names):
+            np_, ns_ = self._update_leaf(p, g, s, lr_t, step, reg=r,
+                                         name=n)
             new_p.append(np_)
             new_s.append(ns_)
         return (jax.tree.unflatten(treedef, new_p),
                 {"step": step, "slots": jax.tree.unflatten(treedef, new_s)})
 
-    def _update_leaf(self, p, g, s, lr_t, step, reg=None):
+    def _update_leaf(self, p, g, s, lr_t, step, reg=None, name=None):
         """One per-leaf update (shared by the per-leaf and fused paths'
         non-eligible branch): fp32 master handling, RowSlices dispatch,
         decay, cast back to the param dtype."""
@@ -384,6 +407,16 @@ class Optimizer:
         if grads is None:
             raise ValueError("eager step() needs grads aligned with "
                              "the optimizer's parameter list")
+        if self._param_meta or \
+                getattr(self, "apply_decay_param_fun", None) is not None \
+                or getattr(self, "exclude_fn", None) is not None:
+            # eager grads are index-keyed, so name filters would match
+            # nothing and silently mis-apply decay — refuse instead
+            raise NotImplementedError(
+                "name-based decay/clip filters (ParamAttr metadata, "
+                "apply_decay_param_fun, exclude_from_weight_decay_fn) "
+                "need name-keyed grads; train through TrainStep or call "
+                "apply_gradients with a name-keyed dict")
         values = {i: p.value for i, p in params.items()}
         gdict = {i: g for (i, _), g in zip(params.items(), grads)}
         if self._eager_state is None:
@@ -568,10 +601,10 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, weight_decay: float = 0.01,
                  apply_decay_param_fun=None, **kw) -> None:
-        if "regularization" in kw:
+        if kw.pop("regularization", None) is not None:
             # the base class would fold it into coupled weight_decay,
             # which the next line resets — reject loudly instead of
-            # silently training without decay
+            # silently training without decay (explicit None is fine)
             raise TypeError(
                 "AdamW uses DECOUPLED weight decay: pass weight_decay="
                 "<float> (regularization= is the coupled-L2 spelling; "
@@ -586,6 +619,16 @@ class AdamW(Adam):
         new_p, new_slots = super().update(p, g, slots, lr_t, step)
         new_p = new_p - lr_t * self.decoupled_weight_decay * p
         return new_p, new_slots
+
+    def _update_leaf(self, p, g, s, lr_t, step, reg=None, name=None):
+        fn = self.apply_decay_param_fun
+        if fn is not None and name is not None and not fn(name):
+            # reference: apply_decay_param_fun(name) False => NO decay
+            return self._with_zeroed_attr(
+                "decoupled_weight_decay",
+                lambda: super(AdamW, self)._update_leaf(
+                    p, g, s, lr_t, step, reg, name))
+        return super()._update_leaf(p, g, s, lr_t, step, reg, name)
 
 
 class Adamax(Optimizer):
@@ -700,6 +743,17 @@ class Lamb(Optimizer):
 
     def init_slots(self, p):
         return {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p)}
+
+    def _update_leaf(self, p, g, s, lr_t, step, reg=None, name=None):
+        if self.exclude_fn is not None and name is not None \
+                and self.exclude_fn(name):
+            # reference: exclude_from_weight_decay_fn(name) True =>
+            # no lamb weight decay for this parameter
+            return self._with_zeroed_attr(
+                "lamb_weight_decay",
+                lambda: super(Lamb, self)._update_leaf(
+                    p, g, s, lr_t, step, reg, name))
+        return super()._update_leaf(p, g, s, lr_t, step, reg, name)
 
     def update(self, p, g, slots, lr_t, step):
         g = g.astype(p.dtype)

@@ -232,3 +232,81 @@ def test_param_meta_edge_cases():
     with pytest.raises(TypeError):
         AdamW(learning_rate=1e-3,
               regularization=pt.regularizer.L2Decay(0.01))
+
+
+
+def test_adamw_apply_decay_param_fun_and_lamb_exclude():
+    """AdamW's apply_decay_param_fun (True = decay) and Lamb's
+    exclude_from_weight_decay_fn (True = no decay) are honored per
+    parameter name — the standard BERT practice of excluding bias and
+    LayerNorm params from decay."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    from paddle_tpu.optimizer import AdamW, Lamb
+
+    params = {"w": jnp.ones((4,)), "bias": jnp.ones((4,))}
+    zero_g = {"w": jnp.zeros((4,)), "bias": jnp.zeros((4,))}
+
+    opt = AdamW(learning_rate=1.0, weight_decay=0.1,
+                apply_decay_param_fun=lambda n: "bias" not in n)
+    new_p, _ = opt.apply_gradients(params, zero_g, opt.init(params))
+    np.testing.assert_allclose(np.asarray(new_p["w"]), 0.9, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(new_p["bias"]), 1.0,
+                               rtol=1e-6)
+
+    # filter still in force on the SECOND step (trace-time flip must
+    # restore the coefficient between leaves/steps)
+    st = opt.init(params)
+    p1, st = opt.apply_gradients(params, zero_g, st)
+    p2, _ = opt.apply_gradients(p1, zero_g, st)
+    np.testing.assert_allclose(np.asarray(p2["w"]), 0.81, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(p2["bias"]), 1.0, rtol=1e-6)
+
+    # non-uniform tensors so decay changes the trust-normalized
+    # DIRECTION; the excluded leaf must match a zero-decay run exactly
+    rng = np.random.default_rng(0)
+    pr = {"w": jnp.asarray(rng.normal(1, 0.3, (4,)), jnp.float32),
+          "bias": jnp.asarray(rng.normal(1, 0.3, (4,)), jnp.float32)}
+    g = {"w": jnp.asarray(rng.normal(0, 0.1, (4,)), jnp.float32),
+         "bias": jnp.asarray(rng.normal(0, 0.1, (4,)), jnp.float32)}
+    lamb = Lamb(learning_rate=0.001, lamb_weight_decay=0.1,
+                exclude_from_weight_decay_fn=lambda n: "bias" in n)
+    lamb0 = Lamb(learning_rate=0.001, lamb_weight_decay=0.0)
+    lp, _ = lamb.apply_gradients(pr, g, lamb.init(pr))
+    lp0, _ = lamb0.apply_gradients(pr, g, lamb0.init(pr))
+    np.testing.assert_allclose(np.asarray(lp["bias"]),
+                               np.asarray(lp0["bias"]), rtol=1e-6)
+    assert not np.allclose(np.asarray(lp["w"]), np.asarray(lp0["w"]))
+
+
+
+def test_need_clip_nested_and_eager_guard():
+    """need_clip exclusions work through NESTED grad dicts (index-keyed
+    flat clipping), AdamW accepts an explicit regularization=None, and
+    the eager step() path refuses name filters loudly instead of
+    silently mis-applying decay to index-keyed grads."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    import pytest
+    from paddle_tpu.clip import ClipGradByGlobalNorm
+    from paddle_tpu.optimizer import SGD, AdamW
+
+    opt = SGD(learning_rate=1.0, grad_clip=ClipGradByGlobalNorm(0.1))
+    opt.set_param_meta({"layer.b": (False, None)})
+    p = {"layer": {"w": jnp.ones((4,)), "b": jnp.ones((2,))}}
+    g = {"layer": {"w": jnp.full((4,), 3.0), "b": jnp.full((2,), 3.0)}}
+    new_p, _ = opt.apply_gradients(p, g, opt.init(p))
+    np.testing.assert_allclose(np.asarray(new_p["layer"]["b"]), -2.0)
+    w_upd = 1.0 - np.asarray(new_p["layer"]["w"])
+    np.testing.assert_allclose(np.linalg.norm(w_upd), 0.1, rtol=1e-5)
+
+    AdamW(learning_rate=1e-3, regularization=None)  # explicit None ok
+
+    opt2 = AdamW(learning_rate=1e-3,
+                 apply_decay_param_fun=lambda n: True,
+                 parameters=[pt.nn.Parameter(jnp.ones((2,)))])
+    with pytest.raises(NotImplementedError):
+        opt2.step([jnp.ones((2,))])
