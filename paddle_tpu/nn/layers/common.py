@@ -43,7 +43,14 @@ class Linear(Layer):
 
 
 class Embedding(Layer):
-    """(ref: lookup_table_v2_op.cc; dygraph/nn.py Embedding)."""
+    """(ref: lookup_table_v2_op.cc; dygraph/nn.py Embedding).
+
+    ``sparse`` is accepted for API parity and intentionally does not
+    change the gradient representation: on TPU a dense scatter-add
+    embedding gradient is the efficient XLA lowering (the reference's
+    selected-rows path optimizes CPU/PS training — that capability
+    lives in the lazy-mode optimizers over ops.sparse.RowSlices and the
+    parameter-server sparse tables instead)."""
 
     def __init__(self, num_embeddings: int, embedding_dim: int,
                  padding_idx: Optional[int] = None, sparse: bool = False,

@@ -220,13 +220,21 @@ class Optimizer:
             _g32, grads,
             is_leaf=lambda x: x is None or isinstance(x, RowSlices))
         meta = self._param_meta if isinstance(grads, dict) else {}
+        has_name_filter = \
+            getattr(self, "apply_decay_param_fun", None) is not None or \
+            getattr(self, "exclude_fn", None) is not None
+        if has_name_filter and not isinstance(params, dict):
+            # positional pytrees name leaves "[0]", "[1].bias", ... —
+            # a name filter would silently mis-apply decay (same hazard
+            # the eager step() guard refuses)
+            raise NotImplementedError(
+                "apply_decay_param_fun / exclude_from_weight_decay_fn "
+                "need name-keyed dict params (the TrainStep contract)")
         flat_p, treedef = jax.tree.flatten(
             params, is_leaf=lambda x: isinstance(x, RowSlices))
         flat_g = treedef.flatten_up_to(grads)
         flat_s = treedef.flatten_up_to(state["slots"])
-        need_names = bool(meta) or \
-            getattr(self, "apply_decay_param_fun", None) is not None or \
-            getattr(self, "exclude_fn", None) is not None
+        need_names = bool(meta) or has_name_filter
         if need_names:
             # align per-leaf regularizers/names with the flat order via
             # the actual tree paths (works for nested dicts too;
@@ -243,7 +251,7 @@ class Optimizer:
 
         if self.grad_clip is not None:
             no_clip = {n for n, (nc, _) in meta.items() if not nc}
-            if no_clip and need_names:
+            if no_clip:  # implies meta, hence need_names
                 # excluded params keep their raw grads and do not feed
                 # the (global) norm (ref: ParamAttr need_clip=False);
                 # clipping runs on an index-keyed flat view so nesting

@@ -47,6 +47,10 @@ class LRScheduler:
 
     def step(self, epoch: Optional[int] = None) -> None:
         self.last_epoch = epoch if epoch is not None else self.last_epoch + 1
+        if self.verbose:
+            # reference parity: announce the new LR on every step
+            print(f"Epoch {self.last_epoch}: {type(self).__name__} set "
+                  f"learning rate to {self.get_lr()}.")
 
     def state_dict(self):
         return {"last_epoch": self.last_epoch}
