@@ -348,9 +348,23 @@ class Model:
         return out
 
     def predict(self, loader) -> List:
-        return [np.asarray(self.predict_batch(list(b)[:-1]
-                                              if isinstance(b, tuple)
-                                              else b)) for b in loader]
+        # lag-1 conversion: batch N's (blocking) np.asarray runs after
+        # batch N+1 has been dispatched, overlapping transfer with
+        # compute while keeping device residency at one batch —
+        # converting inline would serialize, converting at the end
+        # would hold every output on device (O(dataset) HBM, the
+        # pattern evaluate() documents against)
+        results: List = []
+        pending = None
+        for b in loader:
+            out = self.predict_batch(list(b)[:-1]
+                                     if isinstance(b, tuple) else b)
+            if pending is not None:
+                results.append(np.asarray(pending))
+            pending = out
+        if pending is not None:
+            results.append(np.asarray(pending))
+        return results
 
     def save(self, path: str, training: bool = True,
              input_spec=None) -> None:
