@@ -56,6 +56,9 @@ _BUILTIN_DTYPES = {
 def save(state: Any, path: str, step: Optional[int] = None,
          overwrite: bool = True) -> None:
     """Save a pytree (state dict, TrainStep.state, ...) to ``path``."""
+    # a trailing separator would stage the tmp dir INSIDE the target,
+    # which the overwrite rmtree then destroys mid-save
+    path = os.path.normpath(path)
     tmp = path + ".tmp"
     if os.path.exists(tmp):
         shutil.rmtree(tmp)
@@ -90,6 +93,7 @@ def save(state: Any, path: str, step: Optional[int] = None,
 
 
 def load(path: str, target: Optional[Any] = None) -> Any:
+    path = os.path.normpath(path)
     """Load a checkpoint. With ``target`` (a pytree of the same structure),
     leaves are restored into that structure; otherwise returns a flat
     name→array dict."""
@@ -240,3 +244,60 @@ def load_inference_model(dirname: str, model=None):
                               for k, v in params.items()}, strict=False)
         return model
     return params
+
+
+def _array_like(v) -> bool:
+    return hasattr(v, "shape") and hasattr(v, "dtype")
+
+
+def save_persistables(executor, dirname: str, main_program=None,
+                      filename: Optional[str] = None) -> None:
+    """Save every persistable variable reachable from the executor's
+    scope (ref: io.py save_persistables:491). In this design the scope
+    IS the persistent state — parameters, optimizer slots, stats — so
+    the snapshot covers exactly what the reference's persistable flag
+    selects. ``main_program``/``filename`` are accepted for signature
+    parity (the directory format already stores one manifest + one file
+    per leaf)."""
+    # walk the scope chain parents-first so child bindings shadow —
+    # find_var resolves through parents, and so must the snapshot
+    chain = []
+    sc = executor.scope
+    while sc is not None:
+        chain.append(sc)
+        sc = sc._parent
+    state: Dict[str, Any] = {}
+    for sc in reversed(chain):
+        for k, v in sc.as_dict().items():
+            if _array_like(v):
+                state[k] = v
+    if not state:
+        raise ValueError(
+            "save_persistables: no array variables reachable from the "
+            "executor's scope — nothing to checkpoint")
+    save(state, dirname)
+
+
+def save_params(executor, dirname: str, main_program=None,
+                filename: Optional[str] = None) -> None:
+    """Reference save_params (io.py:185) saves only Parameters; the
+    scope design carries no parameter/persistable distinction, so this
+    is the same snapshot as :func:`save_persistables` — reference code
+    calling either gets a working checkpoint (the difference there is
+    excluding optimizer state, which costs only disk here)."""
+    save_persistables(executor, dirname, main_program, filename)
+
+
+def load_persistables(executor, dirname: str, main_program=None,
+                      filename: Optional[str] = None) -> None:
+    """Restore a :func:`save_persistables` snapshot into the executor's
+    scope (ref: io.py load_persistables:734)."""
+    state = load(dirname)
+    for k, v in state.items():
+        executor.scope.set_var(k, v)
+
+
+def load_params(executor, dirname: str, main_program=None,
+                filename: Optional[str] = None) -> None:
+    """Alias of :func:`load_persistables` (see :func:`save_params`)."""
+    load_persistables(executor, dirname, main_program, filename)

@@ -261,3 +261,83 @@ def test_fluid_aux_submodules():
     assert hn.dispatch(["v1"])[0] == ep[0]  # stable placement
     with pytest.raises(NotImplementedError, match="ShardedTrainStep"):
         fluid.DistributeTranspiler().transpile(None)
+
+
+
+def test_save_load_persistables_scope_round_trip(tmp_path):
+    """fluid.io.save_persistables / load_persistables snapshot and
+    restore the executor's scope (params + any array state); the
+    save_params spellings alias them."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    import paddle_tpu.fluid as fluid
+
+    scope = fluid.global_scope().new_scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.scope.set_var("w", jnp.arange(6.0).reshape(2, 3))
+        exe.scope.set_var("opt_m", jnp.ones((2, 3)) * 0.5)
+        exe.scope.set_var("not_an_array", "metadata string")
+        d = str(tmp_path / "ckpt")
+        fluid.io.save_persistables(exe, d)
+        exe.scope.set_var("w", jnp.zeros((2, 3)))
+        fluid.io.load_persistables(exe, d)
+        np.testing.assert_allclose(
+            np.asarray(exe.scope.find_var("w")),
+            np.arange(6.0).reshape(2, 3))
+        np.testing.assert_allclose(
+            np.asarray(exe.scope.find_var("opt_m")), 0.5)
+        # aliases
+        d2 = str(tmp_path / "ckpt2")
+        fluid.io.save_params(exe, d2)
+        exe.scope.set_var("w", jnp.zeros((2, 3)))
+        fluid.io.load_params(exe, d2)
+        np.testing.assert_allclose(
+            np.asarray(exe.scope.find_var("w")),
+            np.arange(6.0).reshape(2, 3))
+
+
+
+def test_persistables_trailing_slash_and_parent_chain(tmp_path):
+    """Reference-idiomatic trailing-slash dirnames don't destroy prior
+    checkpoints; the snapshot walks the scope parent chain (find_var
+    semantics); an empty snapshot raises instead of silently saving
+    nothing."""
+    import os
+
+    import numpy as np
+
+    import jax.numpy as jnp
+    import paddle_tpu.fluid as fluid
+    import pytest
+    from paddle_tpu.static import Scope
+
+    outer = fluid.global_scope().new_scope()
+    with fluid.scope_guard(outer):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.scope.set_var("w", jnp.ones((2,)))
+        ck = str(tmp_path / "ckpt") + os.sep
+        fluid.io.save_persistables(exe, ck)
+        fluid.io.save_persistables(exe, ck)  # overwrite must survive
+        exe.scope.set_var("w", jnp.zeros((2,)))
+        fluid.io.load_persistables(exe, ck)
+        np.testing.assert_allclose(
+            np.asarray(exe.scope.find_var("w")), 1.0)
+
+        # parent-chain visibility: save from a CHILD scope
+        inner = outer.new_scope()
+        with fluid.scope_guard(inner):
+            exe2 = fluid.Executor(fluid.CPUPlace())
+            ck2 = str(tmp_path / "ckpt2")
+            fluid.io.save_persistables(exe2, ck2)  # w is in the parent
+            outer.set_var("w", jnp.zeros((2,)))
+            fluid.io.load_persistables(exe2, ck2)
+            np.testing.assert_allclose(
+                np.asarray(exe2.scope.find_var("w")), 1.0)
+
+    class _Empty:
+        scope = Scope()
+
+    with pytest.raises(ValueError):
+        fluid.io.save_persistables(_Empty(), str(tmp_path / "ck3"))
