@@ -59,6 +59,13 @@ def simple_img_conv_pool(input, num_filters: int, filter_size,
             f"simple_img_conv_pool: conv_weight has "
             f"{conv_weight.shape[0]} output channels, expected "
             f"{num_filters}")
+    fs = (filter_size, filter_size) if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    if tuple(conv_weight.shape[2:]) != fs:
+        raise ValueError(
+            f"simple_img_conv_pool: conv_weight kernel "
+            f"{tuple(conv_weight.shape[2:])} does not match "
+            f"filter_size {fs}")
     out = _F.conv2d(input, conv_weight, conv_bias, stride=conv_stride,
                     padding=conv_padding, dilation=conv_dilation,
                     groups=conv_groups)

@@ -100,6 +100,7 @@ class Layer:
     def __init__(self) -> None:
         object.__setattr__(self, "_parameters", OrderedDict())
         object.__setattr__(self, "_buffers", OrderedDict())
+        object.__setattr__(self, "_non_persistable_buffers", set())
         object.__setattr__(self, "_sub_layers", OrderedDict())
         object.__setattr__(self, "training", True)
         object.__setattr__(self, "_forward_pre_hooks", OrderedDict())
@@ -174,7 +175,16 @@ class Layer:
     def register_buffer(self, name: str, value, persistable: bool = True):
         self._buffers[name] = jnp.asarray(value) if value is not None \
             else None
+        if not persistable:
+            # reference parity: non-persistable buffers still thread
+            # through the functional step but stay out of state_dict
+            self._non_persistable_buffers.add(name)
+        else:
+            self._non_persistable_buffers.discard(name)
         return self._buffers[name]
+
+    def _persistable_buffer(self, name: str) -> bool:
+        return name not in self._non_persistable_buffers
 
     def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
         self._sub_layers[name] = sublayer
@@ -255,8 +265,12 @@ class Layer:
                 continue
             out[name] = p.value
         if include_buffers:
+            # resolve buffer owners via the sublayer store (immune to
+            # attribute shadowing), shared with set_state_dict/bind
+            slots = self._named_buffer_slots()
             for name, b in self.named_buffers():
-                if b is not None:
+                owner, leaf = slots[name]
+                if b is not None and owner._persistable_buffer(leaf):
                     out[name] = b
         return out
 

@@ -116,11 +116,13 @@ class Conv2DTranspose(_ConvNd):
                          padding, dilation, groups, weight_attr, bias_attr,
                          spatial=2, transpose=True,
                          output_padding=output_padding)
+        self.data_format = data_format
 
     def forward(self, x):
         return F.conv2d_transpose(x, self.weight, self._bias(), self.stride,
                                   self.padding, self.output_padding,
-                                  self.dilation, self.groups)
+                                  self.dilation, self.groups,
+                                  data_format=self.data_format)
 
 
 class MaxPool2D(Layer):

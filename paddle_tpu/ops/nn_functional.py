@@ -135,14 +135,18 @@ def conv2d_transpose(x, weight, bias=None, stride: IntOrPair = 1,
         w = jnp.swapaxes(w, 1, 2).reshape(groups * og, i // groups, khs, kws)
     else:
         w = jnp.swapaxes(w, 0, 1)  # [O, I, kh, kw]
-    dn = lax.conv_dimension_numbers(x.shape, w.shape,
-                                    ("NCHW", "OIHW", "NCHW"))
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"conv2d_transpose: data_format must be NCHW "
+                         f"or NHWC, got {data_format!r}")
+    dn = lax.conv_dimension_numbers(
+        x.shape, w.shape, (data_format, "OIHW", data_format))
     out = lax.conv_general_dilated(
         x, w, window_strides=(1, 1), padding=[pad_t, pad_l],
         lhs_dilation=stride, rhs_dilation=dilation,
         dimension_numbers=dn, feature_group_count=groups)
     if bias is not None:
-        out = out + bias.reshape(1, -1, 1, 1)
+        out = out + (bias.reshape(1, -1, 1, 1) if data_format == "NCHW"
+                     else bias.reshape(1, 1, 1, -1))
     return out
 
 

@@ -1,0 +1,82 @@
+"""Parity fixes: conv2d_transpose NHWC, persistable buffers, nets validation."""
+
+
+
+def test_conv2d_transpose_nhwc_and_persistable_buffers():
+    """conv2d_transpose honors data_format=NHWC (was silently computed
+    as NCHW); register_buffer(persistable=False) keeps the buffer out
+    of state_dict while still threading it through named_buffers."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    import pytest
+    from paddle_tpu.ops import nn_functional as F
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(0, 1, (2, 3, 8, 8)), jnp.float32)
+    w = jnp.asarray(rng.normal(0, 1, (3, 5, 3, 3)), jnp.float32)
+    b = jnp.asarray(rng.normal(0, 1, (5,)), jnp.float32)
+    out = F.conv2d_transpose(x, w, b, stride=2, padding=1,
+                             output_padding=1)
+    out_l = F.conv2d_transpose(jnp.transpose(x, (0, 2, 3, 1)), w, b,
+                               stride=2, padding=1, output_padding=1,
+                               data_format="NHWC")
+    np.testing.assert_allclose(
+        np.asarray(out_l),
+        np.transpose(np.asarray(out), (0, 2, 3, 1)),
+        rtol=2e-5, atol=2e-5)
+    with pytest.raises(ValueError):
+        F.conv2d_transpose(x, w, data_format="NCL")
+
+    class M(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.register_buffer("stat", jnp.ones((2,)))
+            self.register_buffer("scratch", jnp.zeros((2,)),
+                                 persistable=False)
+
+    m = M()
+    sd = m.state_dict()
+    assert "stat" in sd and "scratch" not in sd
+    assert "scratch" in dict(m.named_buffers())
+
+    from paddle_tpu import nets
+    with pytest.raises(ValueError):
+        nets.simple_img_conv_pool(x, 5, 5, 2, 2, jnp.zeros((5, 3, 3, 3)))
+
+
+
+def test_conv2dtranspose_layer_nhwc_and_shadow_safe_state_dict():
+    """nn.Conv2DTranspose forwards data_format (was silently NCHW);
+    state_dict buffer-persistence resolution survives sublayer names
+    that shadow Layer attributes."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+
+    rng = np.random.default_rng(0)
+    pt.seed(0)
+    m1 = pt.nn.Conv2DTranspose(3, 5, 3, stride=2, padding=1,
+                               output_padding=1)
+    pt.seed(0)
+    m2 = pt.nn.Conv2DTranspose(3, 5, 3, stride=2, padding=1,
+                               output_padding=1, data_format="NHWC")
+    x = jnp.asarray(rng.normal(0, 1, (2, 3, 8, 8)), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(m2(jnp.transpose(x, (0, 2, 3, 1)))),
+        np.transpose(np.asarray(m1(x)), (0, 2, 3, 1)),
+        rtol=2e-5, atol=2e-5)
+
+    class Sub(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.register_buffer("b", jnp.ones((2,)))
+
+    class M(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.add_sublayer("apply", Sub())  # shadows Layer.apply
+
+    assert "apply.b" in M().state_dict()
