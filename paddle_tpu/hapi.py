@@ -307,6 +307,8 @@ class Model:
         return self._eval_step
 
     def evaluate(self, eval_loader, verbose: int = 1) -> Dict[str, float]:
+        if verbose:
+            print("Eval begin...")
         params, buffers = self._current_state()
         ev = self._get_eval_step()
         for m in self._metrics:
@@ -337,6 +339,14 @@ class Model:
             m.update(computed)
         for m in self._metrics:
             result[f"eval_{m.name()}"] = m.accumulate()
+        if verbose:
+            def _fmt(v):
+                try:
+                    return f"{v:.4f}"
+                except (TypeError, ValueError):  # list-valued metrics
+                    return str(v)
+            print("Eval done: " + " - ".join(
+                f"{k}: {_fmt(v)}" for k, v in result.items()))
         return result
 
     def predict_batch(self, inputs):

@@ -28,6 +28,15 @@ __all__ = ["simple_img_conv_pool", "img_conv_group",
            "sequence_conv_pool", "glu", "scaled_dot_product_attention"]
 
 
+def _check_kernel(weight, filter_size, fn_name: str) -> None:
+    fs = (filter_size, filter_size) if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    if tuple(weight.shape[2:]) != fs:
+        raise ValueError(
+            f"{fn_name}: conv weight kernel {tuple(weight.shape[2:])} "
+            f"does not match filter_size {fs}")
+
+
 def _apply_act(x, act: Optional[str]):
     return x if act is None else getattr(_act, act)(x)
 
@@ -59,13 +68,7 @@ def simple_img_conv_pool(input, num_filters: int, filter_size,
             f"simple_img_conv_pool: conv_weight has "
             f"{conv_weight.shape[0]} output channels, expected "
             f"{num_filters}")
-    fs = (filter_size, filter_size) if isinstance(filter_size, int) \
-        else tuple(filter_size)
-    if tuple(conv_weight.shape[2:]) != fs:
-        raise ValueError(
-            f"simple_img_conv_pool: conv_weight kernel "
-            f"{tuple(conv_weight.shape[2:])} does not match "
-            f"filter_size {fs}")
+    _check_kernel(conv_weight, filter_size, "simple_img_conv_pool")
     out = _F.conv2d(input, conv_weight, conv_bias, stride=conv_stride,
                     padding=conv_padding, dilation=conv_dilation,
                     groups=conv_groups)
@@ -104,11 +107,7 @@ def img_conv_group(input, conv_num_filter: Sequence[int], pool_size,
     else:  # one size (int or (kh, kw) tuple) shared by every conv
         fsizes = [conv_filter_size] * n
     for i, (w_, fs) in enumerate(zip(conv_weights, fsizes)):
-        want = (fs, fs) if isinstance(fs, int) else tuple(fs)
-        if tuple(w_.shape[2:]) != want:
-            raise ValueError(
-                f"img_conv_group: conv {i} kernel is "
-                f"{tuple(w_.shape[2:])} but conv_filter_size={fs}")
+        _check_kernel(w_, fs, f"img_conv_group conv {i}")
     if conv_with_batchnorm and (bn_params is None or len(bn_params) != n):
         raise ValueError(
             "img_conv_group: conv_with_batchnorm=True needs one "

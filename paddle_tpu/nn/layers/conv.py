@@ -115,8 +115,8 @@ class Conv2DTranspose(_ConvNd):
         super().__init__(in_channels, out_channels, kernel_size, stride,
                          padding, dilation, groups, weight_attr, bias_attr,
                          spatial=2, transpose=True,
-                         output_padding=output_padding)
-        self.data_format = data_format
+                         output_padding=output_padding,
+                         data_format=data_format)
 
     def forward(self, x):
         return F.conv2d_transpose(x, self.weight, self._bias(), self.stride,

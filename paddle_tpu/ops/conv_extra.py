@@ -173,6 +173,10 @@ def var_conv_2d(x, row_length, col_length, weight, out_channels: int,
     padded (ref: var_conv_2d_op.cc). Masked dense conv: positions past
     each example's (row, col) extent are zeroed before and after."""
     n, c, h, w = x.shape
+    if weight.shape[0] != out_channels:
+        raise ValueError(
+            f"var_conv_2d: weight has {weight.shape[0]} output "
+            f"channels, expected out_channels={out_channels}")
     rm = jnp.arange(h)[None, :] < row_length.reshape(-1, 1)
     cm = jnp.arange(w)[None, :] < col_length.reshape(-1, 1)
     m = (rm[:, None, :, None] & cm[:, None, None, :]).astype(x.dtype)
@@ -193,6 +197,11 @@ def tree_conv(nodes, edges, weight, max_depth: Optional[int] = None):
     [B, N, D]; edges [B, E, 2] (parent, child) int pairs (−1 padded);
     weight [D, 3, out]. Continuous binary-tree position weights η_t/η_l/η_r
     from the paper, computed over each node's children."""
+    if max_depth is not None and max_depth > 2:
+        raise NotImplementedError(
+            "tree_conv: this implementation convolves depth-1 patches "
+            "(each node with its direct children, the TBCNN default); "
+            f"max_depth={max_depth} windows are not supported")
     b, n, d = nodes.shape
     out_dim = weight.shape[2]
     parent = edges[..., 0]

@@ -41,6 +41,9 @@ def argmin(x, axis: int = -1, keepdim: bool = False, dtype="int64"):
 
 def topk(x, k: int, axis: int = -1, largest: bool = True,
          sorted: bool = True):
+    """(ref: top_k_v2_op). ``sorted=False`` merely PERMITS unsorted
+    results in the reference; XLA's top_k always returns sorted values,
+    which satisfies both spellings."""
     axis = axis % x.ndim
     if axis != x.ndim - 1:
         xt = jnp.moveaxis(x, axis, -1)

@@ -294,6 +294,10 @@ def sequence_topk_avg_pooling(x, row_length, col_length, topks,
     matrices: per row, average of top-k column scores for each k in
     ``topks``; output [B, R, C*len(topks)] masked by row/col lengths."""
     b, c, r, cc = x.shape
+    if c != channel_num:
+        raise ValueError(
+            f"sequence_topk_avg_pooling: x has {c} channels, expected "
+            f"channel_num={channel_num}")
     cm = jnp.arange(cc)[None, :] < col_length.reshape(-1, 1)  # [B, Cc]
     neg = jnp.finfo(x.dtype).min
     masked = jnp.where(cm[:, None, None, :], x, neg)
