@@ -40,7 +40,48 @@ def device_kind() -> str:
 def emit(result: dict) -> None:
     """Print the one-line JSON result, stamped with the chip identity so
     capture artifacts are only ever auto-applied on the same hardware."""
-    print(json.dumps(dict(result, device=device_kind())))
+    print(json.dumps(dict(result, device=device_kind())), flush=True)
+
+
+def emit_partial(result: dict) -> None:
+    """Best-so-far result, printed IMMEDIATELY after each timed
+    candidate. Three consecutive rounds produced a null driver artifact
+    because the one JSON line only appeared after the full
+    select->rebuild->time pipeline survived; a mid-run tunnel drop or
+    driver timeout lost everything. Now every measured number is (a) on
+    stdout the moment it exists — consumers keep the LAST JSON line, so
+    a later better/final emit supersedes it — and (b) mirrored
+    atomically to BENCH_partial.json so even a hard kill leaves the
+    number on disk."""
+    import os
+
+    res = dict(result, device=device_kind(), partial=True,
+               when=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+    print(json.dumps(res), flush=True)
+    tmp = _PARTIAL_PATH + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(res, f)
+        os.replace(tmp, _PARTIAL_PATH)
+    except OSError:
+        pass  # the stdout line is the primary channel
+
+
+import os as _os
+
+_PARTIAL_PATH = _os.path.join(
+    _os.path.dirname(_os.path.abspath(__file__)), "BENCH_partial.json")
+
+_deadline = [None]
+
+
+def budget_left() -> float:
+    """Seconds before the soft deadline (PT_BENCH_BUDGET_S, default
+    1200). Sweeps check this to skip optional refinement stages — the
+    mandatory first measurement always runs regardless."""
+    if _deadline[0] is None:
+        return float("inf")
+    return _deadline[0] - time.perf_counter()
 
 
 def warmup_and_time(step_once, iters: int, settle_s: float = 1.0):
@@ -236,6 +277,23 @@ def bench_bert(on_accel: bool) -> None:
                 f"({mv:.0f} vs {q_off:.0f} tok/s)")
     candidates = [(b_, f_) for b_ in batch_opts for f_ in fused_opts]
     log(f"BERT-base pretrain, seq={seq} candidates {candidates}")
+
+    n_params_box = [None]
+
+    def note_params(model):
+        if n_params_box[0] is None:
+            n_params_box[0] = sum(
+                int(np.prod(p.shape)) for p in model.parameters())
+
+    def result_for(tokens_per_sec: float) -> dict:
+        achieved = tokens_per_sec * 6 * n_params_box[0] / 1e12
+        return {
+            "metric": "BERT-base pretrain tokens/sec/chip",
+            "value": round(tokens_per_sec, 1),
+            "unit": "tokens/sec",
+            "vs_baseline": round(achieved / (0.8 * 197.0), 4),
+        }
+
     best = None
     select_t0 = time.perf_counter()
     if len(candidates) > 1:
@@ -247,6 +305,7 @@ def bench_bert(on_accel: bool) -> None:
             model = step = None
             try:
                 model, step = build(fused)
+                note_params(model)
                 dt_c = warmup_and_time(
                     lambda: step(ids, labels=(mlm, nsp)),
                     8 if on_accel else 2)
@@ -255,6 +314,7 @@ def bench_bert(on_accel: bool) -> None:
                     f"({batch * seq / dt_c / 1e3:.1f}k tok/s)")
                 if best is None or dt_c / batch < best[0] / best[2]:
                     best = (dt_c, fused, batch)
+                    emit_partial(result_for(batch * seq / dt_c))
             except Exception as e:  # noqa: BLE001
                 if not looks_oom(e):
                     raise
@@ -264,13 +324,14 @@ def bench_bert(on_accel: bool) -> None:
                 # building the next one — holding both doubles HBM
                 model = step = None
             elapsed = time.perf_counter() - select_t0
-            if elapsed > 300 and i + 1 < len(candidates) \
-                    and best is not None:
+            if (elapsed > 300 or budget_left() < 90) \
+                    and i + 1 < len(candidates) and best is not None:
                 # cold compiles ate the budget: better one finished
                 # number than a driver timeout (round-1 failure mode).
                 # Skipped candidates get measured next round from a
                 # warm cache.
-                log(f"selection already took {elapsed:.0f}s; "
+                log(f"selection already took {elapsed:.0f}s "
+                    f"(budget_left {budget_left():.0f}s); "
                     f"skipping {candidates[i + 1:]}")
                 break
         if best is None:
@@ -282,26 +343,25 @@ def bench_bert(on_accel: bool) -> None:
     log(f"timing with batch={batch} fused_state={fused} (winner "
         f"rebuild; compile cache makes this cheap)")
     model, step = build(fused)
+    note_params(model)
 
     dt = warmup_and_time(lambda: step(ids, labels=(mlm, nsp)),
                          30 if on_accel else 3)
-    dt = maybe_steps_per_loop(
-        step,
-        lambda K: ((np.stack([ids] * K),),
-                   (np.stack([mlm] * K), np.stack([nsp] * K))),
-        dt, 30 if on_accel else 3, 8 if on_accel else 2)
+    emit_partial(result_for(batch * seq / dt))
+    if budget_left() > 120:
+        dt = maybe_steps_per_loop(
+            step,
+            lambda K: ((np.stack([ids] * K),),
+                       (np.stack([mlm] * K), np.stack([nsp] * K))),
+            dt, 30 if on_accel else 3, 8 if on_accel else 2)
+    else:
+        log(f"budget_left {budget_left():.0f}s: skipping "
+            f"steps_per_loop re-timing (measured ~1.0x in r3)")
     tokens_per_sec = batch * seq / dt
-    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
-    achieved_tflops = tokens_per_sec * 6 * n_params / 1e12
-    target_tflops = 0.8 * 197.0  # 80% of v5e bf16 peak
+    achieved_tflops = tokens_per_sec * 6 * n_params_box[0] / 1e12
     log(f"{tokens_per_sec:.0f} tok/s = {achieved_tflops:.1f} TFLOPs "
         f"({achieved_tflops / 197.0 * 100:.1f}% v5e MFU)")
-    emit({
-        "metric": "BERT-base pretrain tokens/sec/chip",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/sec",
-        "vs_baseline": round(achieved_tflops / target_tflops, 4),
-    })
+    emit(result_for(tokens_per_sec))
 
 
 def bench_resnet(on_accel: bool) -> None:
@@ -378,6 +438,19 @@ def bench_resnet(on_accel: bool) -> None:
                   if c[0] == batches[0] or
                   (c[1] == layouts[0] and c[2] == fuseds[0])]
     log(f"ResNet-50 train, image={hw}x{hw} candidates {candidates}")
+
+    # ResNet-50 fwd ≈ 4.1 GFLOPs/image at 224x224; train ≈ 3x fwd
+    fwd_gflops = 4.1 * (hw / 224.0) ** 2
+
+    def result_for(images_per_sec: float) -> dict:
+        achieved = images_per_sec * 3 * fwd_gflops / 1e3
+        return {
+            "metric": "ResNet-50 train images/sec/chip",
+            "value": round(images_per_sec, 1),
+            "unit": "images/sec",
+            "vs_baseline": round(achieved / (0.8 * 197.0), 4),
+        }
+
     best = None
     select_t0 = time.perf_counter()
     if len(candidates) > 1:
@@ -396,6 +469,7 @@ def bench_resnet(on_accel: bool) -> None:
                     f"({batch / dt_c:.0f} img/s)")
                 if best is None or dt_c / batch < best[0] / best[4]:
                     best = (dt_c, df, fu, s2d, batch)
+                    emit_partial(result_for(batch / dt_c))
             except Exception as e:  # noqa: BLE001
                 if not looks_oom(e):
                     raise
@@ -403,9 +477,10 @@ def bench_resnet(on_accel: bool) -> None:
             finally:
                 step = x = None
             elapsed = time.perf_counter() - select_t0
-            if elapsed > 300 and i + 1 < len(candidates) \
-                    and best is not None:
-                log(f"selection took {elapsed:.0f}s; skipping "
+            if (elapsed > 300 or budget_left() < 90) \
+                    and i + 1 < len(candidates) and best is not None:
+                log(f"selection took {elapsed:.0f}s (budget_left "
+                    f"{budget_left():.0f}s); skipping "
                     f"{candidates[i + 1:]}")
                 break
         if best is None:
@@ -420,21 +495,19 @@ def bench_resnet(on_accel: bool) -> None:
 
     dt = warmup_and_time(lambda: step(x, labels=y),
                          20 if on_accel else 3)
-    dt = maybe_steps_per_loop(
-        step, lambda K: ((jnp.stack([x] * K),), (np.stack([y] * K),)),
-        dt, 20 if on_accel else 3, 4 if on_accel else 2)
+    emit_partial(result_for(batch / dt))
+    if budget_left() > 120:
+        dt = maybe_steps_per_loop(
+            step, lambda K: ((jnp.stack([x] * K),),
+                             (np.stack([y] * K),)),
+            dt, 20 if on_accel else 3, 4 if on_accel else 2)
+    else:
+        log(f"budget_left {budget_left():.0f}s: skipping "
+            f"steps_per_loop re-timing")
     images_per_sec = batch / dt
-    # ResNet-50 fwd ≈ 4.1 GFLOPs/image at 224x224; train ≈ 3x fwd
-    fwd_gflops = 4.1 * (hw / 224.0) ** 2
     achieved_tflops = images_per_sec * 3 * fwd_gflops / 1e3
-    target_tflops = 0.8 * 197.0
     log(f"{images_per_sec:.1f} images/s = {achieved_tflops:.1f} TFLOPs")
-    emit({
-        "metric": "ResNet-50 train images/sec/chip",
-        "value": round(images_per_sec, 1),
-        "unit": "images/sec",
-        "vs_baseline": round(achieved_tflops / target_tflops, 4),
-    })
+    emit(result_for(images_per_sec))
 
 
 def bench_flash_attention(on_accel: bool) -> None:
@@ -492,6 +565,12 @@ def bench_flash_attention(on_accel: bool) -> None:
         if xla_ms and flash_ms:
             log(f"seq {t}: xla {xla_ms:.2f}ms  flash {flash_ms:.2f}ms  "
                 f"speedup {xla_ms / flash_ms:.2f}x")
+            emit_partial({
+                "metric": f"flash-attention fwd speedup vs XLA @seq{t}",
+                "value": round(xla_ms / flash_ms, 3),
+                "unit": "x",
+                "vs_baseline": round(xla_ms / flash_ms, 3),
+            })
         elif flash_ms:
             log(f"seq {t}: xla OOM, flash {flash_ms:.2f}ms "
                 f"(O(T) memory is the datapoint)")
@@ -573,6 +652,13 @@ def bench_flash_train(on_accel: bool) -> None:
         if xla_ms and flash_ms:
             log(f"seq {t}: train xla {xla_ms:.2f}ms  flash "
                 f"{flash_ms:.2f}ms  speedup {xla_ms / flash_ms:.2f}x")
+            emit_partial({
+                "metric": f"flash-attention train fwd+bwd speedup vs "
+                          f"XLA @seq{t} (d64+dropout)",
+                "value": round(xla_ms / flash_ms, 3),
+                "unit": "x",
+                "vs_baseline": round(xla_ms / flash_ms, 3),
+            })
         elif flash_ms:
             log(f"seq {t}: xla OOM, flash {flash_ms:.2f}ms")
     both = [t for t, (a, c) in results.items() if a and c]
@@ -662,9 +748,42 @@ def main() -> None:
         })
         sys.exit(0 if res["ok"] else 1)
 
+    _deadline[0] = time.perf_counter() + float(
+        os.environ.get("PT_BENCH_BUDGET_S", "1200"))
+    try:
+        # a stale best-so-far from a previous run must not be
+        # attributable to this one — the stdout lines are per-run, the
+        # disk mirror has to be too
+        os.unlink(_PARTIAL_PATH)
+    except OSError:
+        pass
+
     skip_validate = os.environ.get(
         "PT_BENCH_SKIP_VALIDATE", "").strip().lower() in (
         "1", "true", "yes", "on")
+    if on_accel and not skip_validate:
+        # a good VERIFY_TPU.json already proves the kernels in compiled
+        # mode; revalidating spends the short tunnel window's
+        # chip-minutes on known-good kernels. Trust it only with an
+        # EXACT device match (same rule as capture_value: tracked
+        # artifacts from another chip mean nothing here) and a matching
+        # kernel-source hash (a kernel edit invalidates the verdict).
+        # Unstamped pre-r4 artifacts don't skip — one revalidation
+        # rewrites a stamped one.
+        from paddle_tpu.verify import (default_artifact_path,
+                                       kernels_source_hash)
+        try:
+            with open(default_artifact_path()) as f:
+                v = json.load(f)
+            if v.get("ok") and v.get("kernels_ok") and \
+                    v.get("device") == device_kind() and \
+                    v.get("kernel_hash") == kernels_source_hash():
+                skip_validate = True
+                log(f"skipping kernel validation: VERIFY_TPU.json ok "
+                    f"(device={v['device']}, "
+                    f"kernel_hash={v['kernel_hash']})")
+        except (OSError, json.JSONDecodeError):
+            pass
     if on_accel and not skip_validate:
         # capture campaigns set PT_BENCH_SKIP_VALIDATE after the verify
         # stage has already produced VERIFY_TPU.json — revalidating in

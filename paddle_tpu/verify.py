@@ -258,11 +258,42 @@ def _probe_backend(attempts: int = 3, timeout_s: int = 60) -> bool:
     return False
 
 
-def run_verification(artifact_path: str = "VERIFY_TPU.json") -> dict:
+def kernels_source_hash() -> str:
+    """Stable hash of the Pallas kernel sources. Stamped into the
+    verification artifact so bench.py only trusts a cached "kernels ok"
+    verdict while the kernel code is byte-identical to what was
+    validated — any kernel edit invalidates the skip."""
+    import hashlib
+    import os
+
+    kdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "kernels")
+    h = hashlib.sha256()
+    for name in sorted(os.listdir(kdir)):
+        if name.endswith(".py"):
+            with open(os.path.join(kdir, name), "rb") as f:
+                h.update(name.encode())
+                h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def default_artifact_path() -> str:
+    """Repo-root VERIFY_TPU.json — one canonical location regardless of
+    cwd, so a verify run from anywhere refreshes the same artifact
+    bench.py reads."""
+    import os
+
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "VERIFY_TPU.json")
+
+
+def run_verification(artifact_path: str | None = None) -> dict:
     """Run every check and write the artifact. Returns the result dict;
     ``result["ok"]`` is the overall verdict. If the backend is
     unreachable, an artifact recording the outage is still written
     (ok=False, backend="unreachable") instead of hanging."""
+    if artifact_path is None:
+        artifact_path = default_artifact_path()
     if not _probe_backend():
         result = {"backend": "unreachable", "on_accel": False,
                   "kernels_ok": False,
@@ -284,8 +315,14 @@ def run_verification(artifact_path: str = "VERIFY_TPU.json") -> dict:
     kernel_failures = validate_kernels_on_tpu() if on_accel else \
         ["skipped: no accelerator (Mosaic lowers only on TPU)"]
     parity = train_parity_10steps()
+    try:
+        device = str(jax.devices()[0].device_kind)
+    except Exception:  # noqa: BLE001
+        device = "unknown"
     result = {
         "backend": backend,
+        "device": device,
+        "kernel_hash": kernels_source_hash(),
         "on_accel": on_accel,
         "kernels_ok": on_accel and not kernel_failures,
         "kernel_failures": kernel_failures,

@@ -135,10 +135,15 @@ def run_stage(name: str) -> dict:
     log(f"stage {name}: starting (budget {budget}s)")
     stdout, stderr, rc, timed_out = "", "", None, False
     try:
+        # tell bench.py its real deadline (minus a margin for probe +
+        # import) so its soft-budget bails fire BEFORE the hard kill —
+        # a stage that overruns still emits its best-so-far JSON line
+        stage_env = {"PT_BENCH_BUDGET_S": str(max(60, budget - 120)),
+                     **env}
         r = subprocess.run(
             [sys.executable, os.path.join(ROOT, script), *args],
             capture_output=True, text=True, timeout=budget, cwd=ROOT,
-            env={**os.environ, **env})
+            env={**os.environ, **stage_env})
         stdout, stderr, rc = r.stdout, r.stderr, r.returncode
     except subprocess.TimeoutExpired as e:
         # partial output is the whole point: bench.py logs each
