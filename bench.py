@@ -21,6 +21,7 @@ runs just that stage.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -53,8 +54,6 @@ def emit_partial(result: dict) -> None:
     a later better/final emit supersedes it — and (b) mirrored
     atomically to BENCH_partial.json so even a hard kill leaves the
     number on disk."""
-    import os
-
     res = dict(result, device=device_kind(), partial=True,
                when=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
     print(json.dumps(res), flush=True)
@@ -67,10 +66,8 @@ def emit_partial(result: dict) -> None:
         pass  # the stdout line is the primary channel
 
 
-import os as _os
-
-_PARTIAL_PATH = _os.path.join(
-    _os.path.dirname(_os.path.abspath(__file__)), "BENCH_partial.json")
+_PARTIAL_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_partial.json")
 
 _deadline = [None]
 
@@ -123,8 +120,6 @@ def capture_value(stage: str, any_device: bool = False):
     the diag campaign has already run on this chip; every choice made
     from an artifact is logged with its evidence. Shared with
     tools/recommend.py (one reader for the artifact contract)."""
-    import os
-
     key = (stage, any_device)
     if key in _capture_cache:
         return _capture_cache[key]
@@ -169,8 +164,6 @@ def maybe_steps_per_loop(step, stacked, dt_single: float, iters: int,
     round-2 profile blamed for ~19% of the BERT step) and return the
     better per-step seconds. ``stacked`` maps K -> (args, labels);
     PT_BENCH_STEPS_PER_LOOP pins K (1 disables)."""
-    import os
-
     spl_env = os.environ.get("PT_BENCH_STEPS_PER_LOOP")
     spl = int(spl_env) if spl_env else default_spl
     if spl <= 1:
@@ -192,8 +185,6 @@ def maybe_steps_per_loop(step, stacked, dt_single: float, iters: int,
 
 
 def bench_bert(on_accel: bool) -> None:
-    import os
-
     import numpy as np
 
     import paddle_tpu as pt
@@ -365,8 +356,6 @@ def bench_bert(on_accel: bool) -> None:
 
 
 def bench_resnet(on_accel: bool) -> None:
-    import os
-
     import numpy as np
 
     import paddle_tpu as pt
@@ -718,8 +707,6 @@ def main() -> None:
         log("accelerator backend unreachable after retries; aborting "
             "fast so the driver can rerun (no fabricated numbers)")
         sys.exit(3)
-
-    import os
 
     import jax
 

@@ -1,0 +1,176 @@
+"""Host-boundary LoD <-> dense-padded conversion.
+
+The reference's LoD (level-of-detail) layout packs ragged sequences into
+one flat buffer plus recursive offset tables
+(/root/reference/paddle/fluid/framework/lod_tensor.h:104;
+python/paddle/fluid/lod_tensor.py:24 ``create_lod_tensor``). XLA needs
+static shapes, so on TPU the ragged layout exists ONLY at the host
+boundary: :class:`RaggedBatch` converts packed LoD data to the dense
+padded ``[batch, max_len, ...] + lengths [batch]`` layout every op in
+``ops/sequence.py`` consumes, and back.
+
+Multi-level LoD: the innermost level segments tokens into sequences and
+becomes the dense batch; every OUTER level groups sequences and is kept
+as a plain lengths vector (``outer_lengths``). Hierarchical ops (e.g.
+pool over level 0 of a 2-level tensor) are then two dense calls: pool
+the inner batch, regroup with the outer lengths — the same
+decomposition the reference performs internally over its offset tables.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+class RaggedBatch:
+    """Dense padded view of a ragged batch: ``data`` [B, T, ...] with
+    rows zero-padded past their length, ``lengths`` [B] int32, and, for
+    multi-level LoD sources, ``outer_lengths`` — one lengths vector per
+    collapsed outer level, outermost first."""
+
+    def __init__(self, data, lengths, outer_lengths=None):
+        self.data = np.asarray(data)
+        self.lengths = np.asarray(lengths, dtype=np.int32).reshape(-1)
+        if self.data.shape[0] != self.lengths.shape[0]:
+            raise ValueError(
+                f"data batch {self.data.shape[0]} != lengths batch "
+                f"{self.lengths.shape[0]}")
+        if self.data.ndim >= 2 and self.lengths.size and \
+                int(self.lengths.max(initial=0)) > self.data.shape[1]:
+            raise ValueError(
+                f"length {int(self.lengths.max())} exceeds padded time "
+                f"dim {self.data.shape[1]}")
+        self.outer_lengths = [
+            np.asarray(o, dtype=np.int32).reshape(-1)
+            for o in (outer_lengths or [])]
+
+    # -- constructors -------------------------------------------------
+    @classmethod
+    def from_list(cls, seqs: Sequence,
+                  max_len: Optional[int] = None) -> "RaggedBatch":
+        """From per-row arrays (each [Ti, ...])."""
+        seqs = [np.asarray(s) for s in seqs]
+        lengths = np.asarray([s.shape[0] for s in seqs], np.int32)
+        t = max_len if max_len is not None else \
+            (int(lengths.max()) if len(seqs) else 0)
+        feat = seqs[0].shape[1:] if seqs else ()
+        dtype = seqs[0].dtype if seqs else np.float32
+        out = np.zeros((len(seqs), t) + feat, dtype=dtype)
+        for i, s in enumerate(seqs):
+            if s.shape[1:] != feat:
+                raise ValueError(
+                    f"row {i} feature shape {s.shape[1:]} != {feat}")
+            if s.shape[0] > t:
+                raise ValueError(
+                    f"row {i} length {s.shape[0]} exceeds max_len {t}")
+            out[i, :s.shape[0]] = s
+        return cls(out, lengths)
+
+    @classmethod
+    def from_lod(cls, flat, recursive_seq_lens: List[List[int]],
+                 max_len: Optional[int] = None) -> "RaggedBatch":
+        """From the reference's packed layout: ``flat`` [sum(lens), ...]
+        plus per-level lengths (the reference's recursive_seq_lens —
+        lengths-based LoD, outermost level first). The innermost level
+        becomes the dense batch; outer levels ride along as
+        ``outer_lengths``."""
+        flat = np.asarray(flat)
+        if not recursive_seq_lens:
+            raise ValueError("recursive_seq_lens must have >= 1 level")
+        for lv, lens in enumerate(recursive_seq_lens[:-1]):
+            if int(np.sum(lens)) != len(recursive_seq_lens[lv + 1]):
+                raise ValueError(
+                    f"level {lv} lengths sum {int(np.sum(lens))} != "
+                    f"level {lv + 1} count "
+                    f"{len(recursive_seq_lens[lv + 1])} (each outer "
+                    f"entry must cover the next level's sequences)")
+        inner = np.asarray(recursive_seq_lens[-1], np.int64)
+        if int(inner.sum()) != flat.shape[0]:
+            raise ValueError(
+                f"innermost lengths sum {int(inner.sum())} != flat rows "
+                f"{flat.shape[0]}")
+        offsets = np.concatenate([[0], np.cumsum(inner)])
+        rows = [flat[offsets[i]:offsets[i + 1]]
+                for i in range(len(inner))]
+        rb = cls.from_list(rows, max_len=max_len)
+        rb.outer_lengths = [np.asarray(o, np.int32)
+                            for o in recursive_seq_lens[:-1]]
+        return rb
+
+    # -- exporters ----------------------------------------------------
+    def to_list(self) -> List[np.ndarray]:
+        return [self.data[i, :int(n)] for i, n in enumerate(self.lengths)]
+
+    def flat(self) -> np.ndarray:
+        """Packed [sum(lengths), ...] buffer (the reference's layout)."""
+        rows = self.to_list()
+        return np.concatenate(rows, axis=0) if rows else \
+            self.data.reshape((0,) + self.data.shape[2:])
+
+    def recursive_seq_lens(self) -> List[List[int]]:
+        return [o.tolist() for o in self.outer_lengths] + \
+            [self.lengths.tolist()]
+
+    def regroup_outer(self) -> "RaggedBatch":
+        """Collapse the innermost grouping one level up: rows become the
+        per-outer-group concatenations (lengths in tokens), using the
+        last ``outer_lengths`` vector. This is how a hierarchical op
+        walks outward after pooling the inner level."""
+        if not self.outer_lengths:
+            raise ValueError("no outer level to regroup by")
+        group = self.outer_lengths[-1]
+        rows = self.to_list()
+        out_rows, i = [], 0
+        for g in group:
+            g = int(g)
+            chunk = rows[i:i + g]
+            out_rows.append(np.concatenate(chunk, axis=0) if chunk else
+                            np.zeros((0,) + self.data.shape[2:],
+                                     self.data.dtype))
+            i += g
+        rb = RaggedBatch.from_list(out_rows)
+        rb.outer_lengths = list(self.outer_lengths[:-1])
+        return rb
+
+    def __repr__(self) -> str:
+        return (f"RaggedBatch(data={self.data.shape}, "
+                f"lengths={self.lengths.tolist()}, "
+                f"outer_levels={len(self.outer_lengths)})")
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None) -> RaggedBatch:
+    """Reference-compatible constructor
+    (ref: python/paddle/fluid/lod_tensor.py:24). ``data`` may be a
+    packed ndarray, a (possibly nested) list of sequences, or an
+    existing RaggedBatch (re-segmented). ``place`` is accepted for
+    signature parity; host conversion is place-independent and the
+    arrays move to device when an op consumes them."""
+    if isinstance(data, RaggedBatch):
+        return RaggedBatch.from_lod(data.flat(), recursive_seq_lens)
+    if isinstance(data, (list, tuple)):
+        # reference semantics: a list of sequences is packed along the
+        # token axis; rows of scalar tokens become a [N, 1] column (the
+        # reference appends a trailing unit dim to nested lists), rows
+        # with feature dims concatenate unchanged
+        rows = [np.asarray(r) for r in data]
+        flat = np.concatenate(
+            [r.reshape(-1, 1) if r.ndim <= 1 else r for r in rows],
+            axis=0)
+        return RaggedBatch.from_lod(flat, recursive_seq_lens)
+    data = np.asarray(data)
+    if data.ndim == 1:
+        data = data.reshape(-1, 1)
+    return RaggedBatch.from_lod(data, recursive_seq_lens)
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape,
+                                place=None, low=0, high=10,
+                                seed=None) -> RaggedBatch:
+    """(ref: python/paddle/fluid/lod_tensor.py:102)."""
+    total = int(np.sum(recursive_seq_lens[-1]))
+    rng = np.random.default_rng(seed)
+    flat = rng.integers(low, high + 1,
+                        (total,) + tuple(base_shape)).astype(np.int64)
+    return RaggedBatch.from_lod(flat, recursive_seq_lens)

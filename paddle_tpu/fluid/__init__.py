@@ -27,6 +27,8 @@ from .. import reader  # noqa: F401
 from .. import regularizer  # noqa: F401
 from .. import metric as metrics  # noqa: F401
 from ..autograd import grad as _grad  # noqa: F401
+from ..core.lod import (RaggedBatch, create_lod_tensor,  # noqa: F401
+                        create_random_int_lodtensor)
 from ..core.place import (CPUPlace, CUDAPlace,  # noqa: F401
                           TPUPlace)
 

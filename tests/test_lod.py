@@ -1,0 +1,87 @@
+"""Host-boundary LoD conversion (core/lod.py RaggedBatch +
+fluid.create_lod_tensor) — the packed<->dense contract behind the
+docs/op_coverage.md LoD residual audit."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core.lod import (RaggedBatch, create_lod_tensor,
+                                 create_random_int_lodtensor)
+
+
+def test_from_list_round_trip():
+    rows = [np.arange(6, dtype=np.float32).reshape(3, 2),
+            np.ones((1, 2), np.float32),
+            np.zeros((0, 2), np.float32)]
+    rb = RaggedBatch.from_list(rows)
+    assert rb.data.shape == (3, 3, 2)
+    assert rb.lengths.tolist() == [3, 1, 0]
+    back = rb.to_list()
+    for a, b in zip(rows, back):
+        np.testing.assert_array_equal(a, b)
+    # padding past length is zero
+    assert float(np.abs(rb.data[1, 1:]).sum()) == 0.0
+
+
+def test_from_lod_single_level_matches_flat():
+    flat = np.arange(10, dtype=np.float32).reshape(5, 2)
+    rb = RaggedBatch.from_lod(flat, [[2, 3]])
+    assert rb.lengths.tolist() == [2, 3]
+    np.testing.assert_array_equal(rb.flat(), flat)
+    assert rb.recursive_seq_lens() == [[2, 3]]
+
+
+def test_from_lod_multi_level_and_regroup():
+    # level0 groups [2, 1] sequences; level1 token lengths [2, 1, 2]
+    flat = np.arange(5, dtype=np.float32).reshape(5, 1)
+    rb = RaggedBatch.from_lod(flat, [[2, 1], [2, 1, 2]])
+    assert rb.lengths.tolist() == [2, 1, 2]
+    assert rb.recursive_seq_lens() == [[2, 1], [2, 1, 2]]
+    outer = rb.regroup_outer()
+    # group 0 = seqs 0+1 (3 tokens), group 1 = seq 2 (2 tokens)
+    assert outer.lengths.tolist() == [3, 2]
+    np.testing.assert_array_equal(outer.flat(), flat)
+    assert outer.outer_lengths == []
+
+
+def test_lod_validation_errors():
+    flat = np.zeros((5, 2), np.float32)
+    with pytest.raises(ValueError, match="innermost lengths sum"):
+        RaggedBatch.from_lod(flat, [[2, 2]])
+    with pytest.raises(ValueError, match="must cover the next level"):
+        RaggedBatch.from_lod(flat, [[3], [2, 3]])
+    with pytest.raises(ValueError, match="exceeds padded"):
+        RaggedBatch(np.zeros((2, 3, 1)), [4, 1])
+    with pytest.raises(ValueError, match="no outer level"):
+        RaggedBatch.from_lod(flat, [[2, 3]]).regroup_outer()
+
+
+def test_create_lod_tensor_reference_signature():
+    import paddle_tpu.fluid as fluid
+
+    t = fluid.create_lod_tensor(np.zeros((5, 30), np.float32), [[2, 3]],
+                                fluid.CPUPlace())
+    assert isinstance(t, RaggedBatch)
+    assert t.data.shape == (2, 3, 30)
+    # re-segmenting an existing RaggedBatch
+    t2 = fluid.create_lod_tensor(t, [[1, 4]])
+    assert t2.lengths.tolist() == [1, 4]
+    np.testing.assert_array_equal(t2.flat(), t.flat())
+
+
+def test_create_random_int_lodtensor():
+    t = create_random_int_lodtensor([[2, 3]], base_shape=[1], low=0,
+                                    high=4, seed=0)
+    assert t.data.shape == (2, 3, 1)
+    assert t.flat().shape == (5, 1)
+    assert t.flat().min() >= 0 and t.flat().max() <= 4
+
+
+def test_dense_ops_consume_ragged_batch():
+    from paddle_tpu.ops.sequence import sequence_pool
+
+    rb = RaggedBatch.from_list([np.ones((2, 4), np.float32),
+                                3 * np.ones((3, 4), np.float32)])
+    out = np.asarray(sequence_pool(rb.data, rb.lengths, "sum"))
+    np.testing.assert_allclose(out[0], 2.0 * np.ones(4))
+    np.testing.assert_allclose(out[1], 9.0 * np.ones(4))

@@ -113,6 +113,24 @@ DIAG_PLAN = ["bert_b8_perleaf_noqkv", "bert_b8_perleaf_qkv",
              "profile_bert", "profile_bert_b32", "profile_resnet",
              "resnet_nhwc_b256_perleaf", "resnet_nhwc_b128_s2d",
              "bert_b32_remat", "bert_b64_remat", "bert_b8_bf16mv"]
+# Round-4 triage (VERDICT r3 task 5): ordered by information value per
+# chip-minute so the first ~15 min of any tunnel window settles the big
+# questions — b8-vs-b32 (the 121.8k discrepancy), the ResNet levers
+# (largest perf hole), and the flash train crossover — before the tail.
+R4_PLAN = ["verify",                      # refresh stamped artifact
+           "bert_b8_perleaf_noqkv",       # the round-2 121.8k config
+           "bert_b8_perleaf_qkv",
+           "resnet_nhwc_b128_perleaf",
+           "resnet_nhwc_b128_s2d",
+           "flash_train",
+           "bert_b8_bf16mv",
+           "bert_b16_perleaf_noqkv",
+           "bert_b32_perleaf_noqkv",
+           "resnet_nhwc_b256_perleaf",
+           "bert_b32_remat",
+           "bert_b64_remat",
+           "flash",
+           "profile_bert", "profile_bert_b32", "profile_resnet"]
 
 
 def log(msg: str) -> None:
@@ -193,6 +211,8 @@ def resolve_plan(names: list) -> list:
             out.extend(DEFAULT_PLAN)
         elif n == "diag":
             out.extend(DIAG_PLAN)
+        elif n == "r4":
+            out.extend(R4_PLAN)
         else:
             out.append(n)
     return out
