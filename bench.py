@@ -168,11 +168,13 @@ def maybe_steps_per_loop(step, stacked, dt_single: float, iters: int,
     spl = int(spl_env) if spl_env else default_spl
     if spl <= 1:
         return dt_single
-    args, labels = stacked(spl)
+    out = stacked(spl)
+    args, labels = out[0], out[1]
+    kwargs = out[2] if len(out) > 2 else {}
     try:
         dt_multi = warmup_and_time(
             lambda: {"loss": step.run_steps(
-                *args, labels=labels)["loss"][-1]},
+                *args, labels=labels, **kwargs)["loss"][-1]},
             iters // spl + 1, settle_s=float(spl)) / spl
     except Exception as e:  # noqa: BLE001
         if not looks_oom(e):
@@ -196,14 +198,51 @@ def bench_bert(on_accel: bool) -> None:
     batch_env = os.environ.get("PT_BENCH_BERT_BATCH")
     seq = 512 if on_accel else 128
 
+    # Masked-LM head restriction (reference parity: the reference's
+    # BERT gathers mask_pos before the vocab projection — see
+    # BertForPretraining.forward). PT_BENCH_MASKED_LM pins; otherwise
+    # the measured capture pair FOR THAT BATCH decides (b8 and b32 have
+    # their own A/B stages; other batches fall back to the b32 pair);
+    # default full-positions until a chip A/B lands.
+    masked_env = os.environ.get("PT_BENCH_MASKED_LM")
+    n_masked = max(8, int(seq * 0.15) // 8 * 8)  # 15% rounded to 8
+
+    def masked_for(b) -> bool:
+        if masked_env is not None:
+            return masked_env.strip().lower() in ("1", "true", "yes",
+                                                  "on")
+        if not on_accel:
+            return False
+        m_on = capture_value(f"bert_b{b}_maskedlm")
+        m_off = capture_value(f"bert_b{b}_perleaf_noqkv")
+        if m_on is None or m_off is None:
+            m_on = capture_value("bert_b32_maskedlm")
+            m_off = capture_value("bert_b32_perleaf_noqkv")
+        on = m_on is not None and m_off is not None and m_on > m_off
+        if on:
+            log(f"masked-LM head for b{b} from captures "
+                f"({m_on:.0f} vs {m_off:.0f} tok/s)")
+        return on
+
     rng = np.random.default_rng(0)
 
     def make_data(b):
-        return (rng.integers(0, config.vocab_size, (b, seq))
-                .astype(np.int32),
-                rng.integers(0, config.vocab_size, (b, seq))
-                .astype(np.int64),
-                rng.integers(0, 2, (b,)).astype(np.int64))
+        ids = rng.integers(0, config.vocab_size, (b, seq)) \
+            .astype(np.int32)
+        nsp = rng.integers(0, 2, (b,)).astype(np.int64)
+        if masked_for(b):
+            pos = np.sort(rng.permuted(
+                np.broadcast_to(np.arange(seq), (b, seq)), axis=1)
+                [:, :n_masked], axis=1).astype(np.int32)
+            mlm = rng.integers(0, config.vocab_size,
+                               (b, n_masked)).astype(np.int64)
+            return ids, pos, mlm, nsp
+        mlm = rng.integers(0, config.vocab_size, (b, seq)) \
+            .astype(np.int64)
+        return ids, None, mlm, nsp
+
+    def step_kwargs(pos):
+        return {} if pos is None else {"masked_positions": pos}
 
     def build(fused: bool):
         pt.seed(0)
@@ -276,13 +315,28 @@ def bench_bert(on_accel: bool) -> None:
             n_params_box[0] = sum(
                 int(np.prod(p.shape)) for p in model.parameters())
 
-    def result_for(tokens_per_sec: float) -> dict:
-        achieved = tokens_per_sec * 6 * n_params_box[0] / 1e12
+    def effective_params(masked: bool) -> float:
+        """FLOP-carrying parameter count for the 6*N*T estimate. In
+        masked mode the MLM head path (tied vocab matrix + transform +
+        bias) only processes n_masked of seq positions, so crediting
+        full 6*N*T would overstate achieved TFLOPs by the skipped
+        vocab-projection share — scale that slice by the masked
+        fraction instead."""
+        n = float(n_params_box[0])
+        if not masked:
+            return n
+        h, v = config.hidden_size, config.vocab_size
+        head = h * v + h * h + v  # tied decoder + transform + bias
+        return n - head * (1.0 - n_masked / seq)
+
+    def result_for(tokens_per_sec: float, masked: bool) -> dict:
+        achieved = tokens_per_sec * 6 * effective_params(masked) / 1e12
         return {
             "metric": "BERT-base pretrain tokens/sec/chip",
             "value": round(tokens_per_sec, 1),
             "unit": "tokens/sec",
             "vs_baseline": round(achieved / (0.8 * 197.0), 4),
+            "masked_lm": masked,
         }
 
     best = None
@@ -292,20 +346,22 @@ def bench_bert(on_accel: bool) -> None:
         for i, (batch, fused) in enumerate(candidates):
             if batch not in data_cache:
                 data_cache[batch] = make_data(batch)
-            ids, mlm, nsp = data_cache[batch]
+            ids, pos, mlm, nsp = data_cache[batch]
             model = step = None
             try:
                 model, step = build(fused)
                 note_params(model)
                 dt_c = warmup_and_time(
-                    lambda: step(ids, labels=(mlm, nsp)),
+                    lambda: step(ids, labels=(mlm, nsp),
+                                 **step_kwargs(pos)),
                     8 if on_accel else 2)
                 log(f"batch={batch} fused_state={fused}: "
                     f"{dt_c * 1e3:.2f} ms/step "
                     f"({batch * seq / dt_c / 1e3:.1f}k tok/s)")
                 if best is None or dt_c / batch < best[0] / best[2]:
                     best = (dt_c, fused, batch)
-                    emit_partial(result_for(batch * seq / dt_c))
+                    emit_partial(result_for(batch * seq / dt_c,
+                                            pos is not None))
             except Exception as e:  # noqa: BLE001
                 if not looks_oom(e):
                     raise
@@ -330,29 +386,34 @@ def bench_bert(on_accel: bool) -> None:
         _, fused, batch = best
     else:
         batch, fused = candidates[0]
-    ids, mlm, nsp = make_data(batch)
-    log(f"timing with batch={batch} fused_state={fused} (winner "
-        f"rebuild; compile cache makes this cheap)")
+    ids, pos, mlm, nsp = make_data(batch)
+    log(f"timing with batch={batch} fused_state={fused} "
+        f"masked_lm={pos is not None} (winner rebuild; compile cache "
+        f"makes this cheap)")
     model, step = build(fused)
     note_params(model)
 
-    dt = warmup_and_time(lambda: step(ids, labels=(mlm, nsp)),
+    dt = warmup_and_time(lambda: step(ids, labels=(mlm, nsp),
+                                      **step_kwargs(pos)),
                          30 if on_accel else 3)
-    emit_partial(result_for(batch * seq / dt))
+    emit_partial(result_for(batch * seq / dt, pos is not None))
     if budget_left() > 120:
         dt = maybe_steps_per_loop(
             step,
             lambda K: ((np.stack([ids] * K),),
-                       (np.stack([mlm] * K), np.stack([nsp] * K))),
+                       (np.stack([mlm] * K), np.stack([nsp] * K)),
+                       step_kwargs(None if pos is None else
+                                   np.stack([pos] * K))),
             dt, 30 if on_accel else 3, 8 if on_accel else 2)
     else:
         log(f"budget_left {budget_left():.0f}s: skipping "
             f"steps_per_loop re-timing (measured ~1.0x in r3)")
     tokens_per_sec = batch * seq / dt
-    achieved_tflops = tokens_per_sec * 6 * n_params_box[0] / 1e12
+    achieved_tflops = tokens_per_sec * 6 * \
+        effective_params(pos is not None) / 1e12
     log(f"{tokens_per_sec:.0f} tok/s = {achieved_tflops:.1f} TFLOPs "
         f"({achieved_tflops / 197.0 * 100:.1f}% v5e MFU)")
-    emit(result_for(tokens_per_sec))
+    emit(result_for(tokens_per_sec, pos is not None))
 
 
 def bench_resnet(on_accel: bool) -> None:

@@ -128,9 +128,22 @@ class BertForPretraining(nn.Layer):
         self.bert = BertModel(config)
         self.cls = BertPretrainingHeads(config)
 
-    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_positions=None):
+        """``masked_positions`` [B, P] (per-row indices into the time
+        axis) restricts the MLM head to the masked tokens, as the
+        reference's BERT does (ref: python/paddle/fluid/tests/unittests/
+        dygraph_to_static/bert_dygraph_model.py:327-335 gathers mask_pos
+        from the flattened encoder output before the MLM transform) —
+        the vocab-size projection is ~20% of step FLOPs at seq 512 and
+        only ~15% of positions are masked. mlm_logits is then [B, P, V]
+        and the MLM labels must be gathered the same way."""
         seq_out, pooled = self.bert(input_ids, token_type_ids,
                                     attention_mask)
+        if masked_positions is not None:
+            seq_out = jnp.take_along_axis(
+                seq_out,
+                masked_positions[:, :, None].astype(jnp.int32), axis=1)
         return self.cls(seq_out, pooled,
                         self.bert.embeddings.word_embeddings.weight)
 

@@ -95,6 +95,19 @@ STAGES = {
                             "FLAGS_fused_qkv_projection": "0",
                             "FLAGS_optimizer_moment_dtype": "bfloat16"},
                        900),
+    # masked-LM head restriction (reference-parity mask_pos gather):
+    # A/B against bert_b32_perleaf_noqkv / bert_b8_perleaf_noqkv — the
+    # vocab projection over all 512 positions is ~20% of step FLOPs
+    "bert_b32_maskedlm": ([], {**_SKIP, **_SPL1,
+                               "PT_BENCH_BERT_BATCH": "32",
+                               "PT_BENCH_FUSED": "0",
+                               "FLAGS_fused_qkv_projection": "0",
+                               "PT_BENCH_MASKED_LM": "1"}, 900),
+    "bert_b8_maskedlm": ([], {**_SKIP, **_SPL1,
+                              "PT_BENCH_BERT_BATCH": "8",
+                              "PT_BENCH_FUSED": "0",
+                              "FLAGS_fused_qkv_projection": "0",
+                              "PT_BENCH_MASKED_LM": "1"}, 900),
     "profile_bert": (["bert", "8"], {}, 900, "tools/profile_step.py"),
     "profile_bert_b32": (["bert", "32"], {}, 900,
                          "tools/profile_step.py"),
@@ -122,10 +135,12 @@ R4_PLAN = ["verify",                      # refresh stamped artifact
            "bert_b8_perleaf_qkv",
            "resnet_nhwc_b128_perleaf",
            "resnet_nhwc_b128_s2d",
+           "bert_b32_perleaf_noqkv",
+           "bert_b32_maskedlm",           # ~20% FLOP cut if it holds
            "flash_train",
            "bert_b8_bf16mv",
+           "bert_b8_maskedlm",
            "bert_b16_perleaf_noqkv",
-           "bert_b32_perleaf_noqkv",
            "resnet_nhwc_b256_perleaf",
            "bert_b32_remat",
            "bert_b64_remat",
