@@ -179,6 +179,15 @@ define_flag("flash_attention_min_seq", 4096,
             "Key-sequence length at or above which attention routes to the "
             "Pallas flash kernel (below it XLA's fused attention is faster "
             "on v5e; the flash kernel is always O(T) memory).")
+define_flag("flash_block_q", 0,
+            "Flash kernel query-tile size (rows of the online-softmax "
+            "block). 0 = the kernel module's built-in BLOCK_Q (256). "
+            "Sweep lever for the flash_train capture stages; clamped "
+            "to the sequence length.")
+define_flag("flash_block_k", 0,
+            "Flash kernel key-tile size (columns scanned per "
+            "fori_loop iteration). 0 = built-in BLOCK_K (256); sweep "
+            "lever, clamped like flash_block_q.")
 define_flag("transformer_remat", False,
             "Rematerialize each TransformerEncoder layer in the "
             "backward (jax.checkpoint): ~1/3 more FLOPs for O(layers) "

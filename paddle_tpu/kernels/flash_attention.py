@@ -34,6 +34,24 @@ BLOCK_K = 256
 _NEG_INF = -1e30
 
 
+def _block_sizes(tq: int, tk: int):
+    """Kernel tile sizes, tunable per chip session via the
+    flash_block_q/k flags (FLAGS_flash_block_q=... env works too) so a
+    capture stage can sweep tiles without code edits. Flag value 0 (the
+    default) means "use the module constants" — tests monkeypatch
+    BLOCK_Q/BLOCK_K to force multi-block/tail paths and must keep
+    working. Clamped to the sequence lengths."""
+    bq, bk = 0, 0
+    try:
+        from ..flags import get_flags
+        f = get_flags(["flash_block_q", "flash_block_k"])
+        bq, bk = int(f["flash_block_q"]), int(f["flash_block_k"])
+    except Exception:  # noqa: BLE001 — kernels stay importable alone
+        pass
+    bq, bk = bq or BLOCK_Q, bk or BLOCK_K
+    return min(bq, tq), min(bk, tk)
+
+
 def _fmix32(x):
     """murmur3 finalizer: full-avalanche 32-bit mix (uint32 in/out)."""
     x = x ^ (x >> jnp.uint32(16))
@@ -150,8 +168,7 @@ def _flash_forward(q, k, v, seed, scale: float, causal: bool,
                    kv_bias=None):
     b, h, tq, d = q.shape
     tk = k.shape[2]
-    bq = min(BLOCK_Q, tq)
-    bk = min(BLOCK_K, tk)
+    bq, bk = _block_sizes(tq, tk)
     # pad sequences to block multiples: pl.ds on a short tail CLAMPS the
     # start index (shifting rows under the validity mask), so the buffers
     # must physically cover every block; the k_pos < seq_k mask in the
@@ -386,8 +403,7 @@ def _flash_backward(q, k, v, seed, out, lse, g, scale: float,
                     interpret: bool = False, dlse=None, kv_bias=None):
     b, h, tq, d = q.shape
     tk = k.shape[2]
-    bq = min(BLOCK_Q, tq)
-    bk = min(BLOCK_K, tk)
+    bq, bk = _block_sizes(tq, tk)
     tq_p = pl.cdiv(tq, bq) * bq
     tk_p = pl.cdiv(tk, bk) * bk
 

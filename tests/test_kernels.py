@@ -596,3 +596,28 @@ def test_train_step_through_flash_path(monkeypatch):
     # same model/data/optimizer; only attention impl + dropout bits
     # differ — trajectories must agree to dropout-noise tolerance
     np.testing.assert_allclose(fl, base, rtol=0.1)
+
+
+def test_flash_block_size_flags_parity():
+    """flash_block_q/k tiles are a pure performance lever: any tile
+    choice (including non-divisible sequence tails) computes the same
+    attention as the XLA reference."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.kernels.flash_attention import flash_attention
+    from paddle_tpu.ops.attention import scaled_dot_product_attention
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(0, 1, (1, 2, 300, 64)), jnp.float32)
+    ref = np.asarray(scaled_dot_product_attention(q, q, q))
+    saved = pt.get_flags(["flash_block_q", "flash_block_k"])
+    try:
+        for bq, bk in [(64, 128), (128, 64), (32, 32)]:
+            pt.set_flags({"flash_block_q": bq, "flash_block_k": bk})
+            got = flash_attention(q, q, q, interpret=True)
+            np.testing.assert_allclose(np.asarray(got), ref,
+                                       rtol=2e-5, atol=2e-5)
+    finally:
+        pt.set_flags(saved)

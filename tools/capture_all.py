@@ -60,6 +60,14 @@ STAGES = {
                           "PT_BENCH_FUSED": "1"}, 1200),
     "flash": (["flash"], _SKIP, 1800),
     "flash_train": (["flash_train"], _SKIP, 1800),
+    # tile-size sweep for the flash kernel (only worth chip time if the
+    # default-tile flash_train stage loses to XLA)
+    "flash_train_t128": (["flash_train"],
+                         {**_SKIP, "FLAGS_flash_block_q": "128",
+                          "FLAGS_flash_block_k": "128"}, 900),
+    "flash_train_t512": (["flash_train"],
+                         {**_SKIP, "FLAGS_flash_block_q": "512",
+                          "FLAGS_flash_block_k": "512"}, 900),
     # round-3 regression hunt: fused_state measured -26% (b32), so the
     # remaining suspects for the 121.8k -> 97.1k/b32 gap are fused QKV
     # and per-chip batch. b8_perleaf_noqkv IS the round-2 config.
@@ -96,17 +104,11 @@ STAGES = {
                             "FLAGS_optimizer_moment_dtype": "bfloat16"},
                        900),
     # masked-LM head restriction (reference-parity mask_pos gather):
-    # A/B against bert_b32_perleaf_noqkv / bert_b8_perleaf_noqkv — the
-    # vocab projection over all 512 positions is ~20% of step FLOPs
-    "bert_b32_maskedlm": ([], {**_SKIP, **_SPL1,
-                               "PT_BENCH_BERT_BATCH": "32",
-                               "PT_BENCH_FUSED": "0",
-                               "FLAGS_fused_qkv_projection": "0",
+    # A/B against bert_b{32,8}_perleaf_noqkv — SAME baseline env via
+    # _bert so the comparison stays single-variable
+    "bert_b32_maskedlm": ([], {**_bert(32, "0", "0")[1],
                                "PT_BENCH_MASKED_LM": "1"}, 900),
-    "bert_b8_maskedlm": ([], {**_SKIP, **_SPL1,
-                              "PT_BENCH_BERT_BATCH": "8",
-                              "PT_BENCH_FUSED": "0",
-                              "FLAGS_fused_qkv_projection": "0",
+    "bert_b8_maskedlm": ([], {**_bert(8, "0", "0")[1],
                               "PT_BENCH_MASKED_LM": "1"}, 900),
     "profile_bert": (["bert", "8"], {}, 900, "tools/profile_step.py"),
     "profile_bert_b32": (["bert", "32"], {}, 900,
