@@ -727,6 +727,51 @@ def bench_flash_train(on_accel: bool) -> None:
     })
 
 
+_chip_lock_handle = [None]  # keeps the flock alive for the process
+
+
+def acquire_chip_lock(name: str = "bench") -> None:
+    """One chip user at a time. The background capture watcher and the
+    driver's end-of-round bench are separate processes; both funnel
+    through this flock so a capture stage mid-timing can't corrupt the
+    driver's numbers (or vice versa). Waits up to PT_BENCH_LOCK_WAIT_S
+    (default 900; capped by the remaining soft budget — capture stages
+    budget 780-2880s, so a long holder can still overlap a waiter that
+    gave up, but the common diag stages fit) then proceeds anyway:
+    contention beats producing nothing."""
+    import fcntl
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".chip_lock")
+    f = open(path, "w")
+    # wait at most PT_BENCH_LOCK_WAIT_S, but never past the stage's own
+    # soft budget (minus a margin to still measure something): a
+    # contended stage that waits its whole budget away dies mid-warmup
+    wait_s = float(os.environ.get("PT_BENCH_LOCK_WAIT_S", "900"))
+    if budget_left() != float("inf"):
+        wait_s = max(30.0, min(wait_s, budget_left() - 60.0))
+    deadline = time.time() + wait_s
+    waited = False
+    while True:
+        try:
+            fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            if waited:
+                log(f"chip lock acquired ({name})")
+            _chip_lock_handle[0] = f
+            return
+        except OSError:
+            if time.time() > deadline:
+                log("chip lock still held after wait; proceeding "
+                    "anyway (risking contention, not silence)")
+                _chip_lock_handle[0] = f
+                return
+            if not waited:
+                log(f"chip lock held by another bench/capture process; "
+                    f"waiting ({name})...")
+                waited = True
+            time.sleep(5)
+
+
 def _probe_backend(attempts: int = 3, timeout_s: int = 60) -> bool:
     """Fail FAST (with retries) if the accelerator tunnel is hung or
     down, instead of hanging until the driver's timeout (round 1's
@@ -764,6 +809,11 @@ def _probe_backend(attempts: int = 3, timeout_s: int = 60) -> bool:
 
 
 def main() -> None:
+    # anchor the soft deadline FIRST: capture_all's hard kill counts
+    # from spawn, so lock-wait time must come out of the same budget
+    _deadline[0] = time.perf_counter() + float(
+        os.environ.get("PT_BENCH_BUDGET_S", "1200"))
+    acquire_chip_lock()
     if not _probe_backend():
         log("accelerator backend unreachable after retries; aborting "
             "fast so the driver can rerun (no fabricated numbers)")
@@ -796,8 +846,6 @@ def main() -> None:
         })
         sys.exit(0 if res["ok"] else 1)
 
-    _deadline[0] = time.perf_counter() + float(
-        os.environ.get("PT_BENCH_BUDGET_S", "1200"))
     try:
         # a stale best-so-far from a previous run must not be
         # attributable to this one — the stdout lines are per-run, the

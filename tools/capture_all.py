@@ -147,6 +147,7 @@ R4_PLAN = ["verify",                      # refresh stamped artifact
            "bert_b32_remat",
            "bert_b64_remat",
            "flash",
+           "flash_train_t128", "flash_train_t512",
            "profile_bert", "profile_bert_b32", "profile_resnet"]
 
 

@@ -137,7 +137,8 @@ def main() -> None:
     # same probe + rc=3 fast-abort protocol as bench.py, so the watcher
     # can tell a tunnel outage from a real failed attempt
     sys.path.insert(0, ROOT)
-    from bench import _probe_backend
+    from bench import _probe_backend, acquire_chip_lock
+    acquire_chip_lock("profile")
     if not _probe_backend():
         print("[profile] backend unreachable; aborting (rc=3)",
               file=sys.stderr)
