@@ -140,6 +140,14 @@ def capture_value(stage: str, any_device: bool = False):
     return val
 
 
+def capture_pair(on_stage: str, off_stage: str):
+    """Both stages' measured values, or None unless BOTH exist (a pin
+    decision needs the full pair). One helper so every capture A/B
+    shares the same None handling."""
+    a, b_ = capture_value(on_stage), capture_value(off_stage)
+    return None if a is None or b_ is None else (a, b_)
+
+
 def reorder_measured(opts: list, meas: dict) -> list:
     """Sort only the MEASURED entries of ``opts`` by value (desc),
     leaving unmeasured entries at their original positions — a partial
@@ -213,15 +221,13 @@ def bench_bert(on_accel: bool) -> None:
                                                   "on")
         if not on_accel:
             return False
-        m_on = capture_value(f"bert_b{b}_maskedlm")
-        m_off = capture_value(f"bert_b{b}_perleaf_noqkv")
-        if m_on is None or m_off is None:
-            m_on = capture_value("bert_b32_maskedlm")
-            m_off = capture_value("bert_b32_perleaf_noqkv")
-        on = m_on is not None and m_off is not None and m_on > m_off
+        pair = capture_pair(f"bert_b{b}_maskedlm",
+                            f"bert_b{b}_perleaf_noqkv") or \
+            capture_pair("bert_b32_maskedlm", "bert_b32_perleaf_noqkv")
+        on = pair is not None and pair[0] > pair[1]
         if on:
             log(f"masked-LM head for b{b} from captures "
-                f"({m_on:.0f} vs {m_off:.0f} tok/s)")
+                f"({pair[0]:.0f} vs {pair[1]:.0f} tok/s)")
         return on
 
     rng = np.random.default_rng(0)
@@ -279,12 +285,30 @@ def bench_bert(on_accel: bool) -> None:
         # diag-campaign artifacts reorder the sweep among MEASURED
         # batches only (selection still re-measures; this only decides
         # what the 300s cap protects — unmeasured proven configs keep
-        # their built-in position)
+        # their built-in position). When EVERY batch is measured, also
+        # cut to the top two: re-sweeping known losers spends the
+        # driver's short window re-proving captures.
         meas = {b_: capture_value(f"bert_b{b_}_perleaf_noqkv")
                 for b_ in batch_opts}
         if any(v is not None for v in meas.values()):
             batch_opts = reorder_measured(batch_opts, meas)
             log(f"measured batch order from captures: {meas}")
+            if all(v is not None for v in meas.values()) \
+                    and len(batch_opts) > 2:
+                log(f"all batches measured; sweeping top-2 only "
+                    f"{batch_opts[:2]}")
+                batch_opts = batch_opts[:2]
+    if on_accel and not (pin and pin.strip()) and len(fused_opts) > 1:
+        # state-layout cut from the r3 capture pair (perleaf 97.1k vs
+        # fused 77.1k at b32) — but ONLY when per-leaf wins: cutting to
+        # per-leaf never drops a proven config (round 2's best was
+        # per-leaf), while cutting to fused on b32 evidence alone would
+        # remove (8, per-leaf) from the sweep
+        pair = capture_pair("bert_fused_b32", "bert_perleaf_b32")
+        if pair is not None and pair[1] >= pair[0]:
+            fused_opts = [False]
+            log(f"fused_state=False from captures (perleaf "
+                f"{pair[1]:.0f} vs fused {pair[0]:.0f} tok/s)")
     # measured flag choices (sound A/Bs: same batch, same other flags).
     # TPU only — the artifacts are chip measurements. transformer_remat
     # is deliberately NOT auto-pinned: a remat win at b32 says nothing
@@ -292,19 +316,19 @@ def bench_bert(on_accel: bool) -> None:
     # the no-remat configs from the sweep (tools/recommend.py surfaces
     # it for a manual default flip instead).
     if on_accel and os.environ.get("FLAGS_fused_qkv_projection") is None:
-        q_on = capture_value("bert_b8_perleaf_qkv")
-        q_off = capture_value("bert_b8_perleaf_noqkv")
-        if q_on is not None and q_off is not None:
-            pt.set_flags({"fused_qkv_projection": bool(q_on >= q_off)})
-            log(f"fused_qkv_projection={q_on >= q_off} from captures "
-                f"(qkv {q_on:.0f} vs noqkv {q_off:.0f} tok/s)")
+        pair = capture_pair("bert_b8_perleaf_qkv",
+                            "bert_b8_perleaf_noqkv")
+        if pair is not None:
+            pt.set_flags({"fused_qkv_projection": pair[0] >= pair[1]})
+            log(f"fused_qkv_projection={pair[0] >= pair[1]} from "
+                f"captures (qkv {pair[0]:.0f} vs noqkv {pair[1]:.0f} "
+                f"tok/s)")
     if on_accel and os.environ.get("FLAGS_optimizer_moment_dtype") is None:
-        mv = capture_value("bert_b8_bf16mv")
-        q_off = capture_value("bert_b8_perleaf_noqkv")
-        if mv is not None and q_off is not None and mv > q_off:
+        pair = capture_pair("bert_b8_bf16mv", "bert_b8_perleaf_noqkv")
+        if pair is not None and pair[0] > pair[1]:
             pt.set_flags({"optimizer_moment_dtype": "bfloat16"})
             log(f"optimizer_moment_dtype=bfloat16 from captures "
-                f"({mv:.0f} vs {q_off:.0f} tok/s)")
+                f"({pair[0]:.0f} vs {pair[1]:.0f} tok/s)")
     candidates = [(b_, f_) for b_ in batch_opts for f_ in fused_opts]
     log(f"BERT-base pretrain, seq={seq} candidates {candidates}")
 
@@ -462,6 +486,25 @@ def bench_resnet(on_accel: bool) -> None:
         (["NHWC", "NCHW"] if on_accel else ["NCHW"])
     fuseds = [pin_fused.strip() in ("1", "true", "yes", "on")] \
         if pin_fused else ([False, True] if on_accel else [False])
+    if on_accel and not pin_layout and len(layouts) > 1:
+        # the r3 capture pair settled the layout (NHWC 1829 vs NCHW
+        # 1689 img/s at b128) — don't re-prove it in the short window
+        pair = capture_pair("resnet_nhwc_b128", "resnet_nchw_b128")
+        if pair is not None:
+            layouts = ["NHWC" if pair[0] >= pair[1] else "NCHW"]
+            log(f"layout={layouts[0]} from captures "
+                f"(nhwc {pair[0]:.0f} vs nchw {pair[1]:.0f} img/s)")
+    if on_accel and not pin_fused and len(fuseds) > 1 \
+            and layouts == ["NHWC"]:
+        # clean same-flags pair only (resnet_nhwc_b128 autotunes
+        # steps-per-loop, so it is NOT comparable to the _SPL1 perleaf
+        # stage); pair is NHWC evidence, hence the layout gate
+        pair = capture_pair("resnet_nhwc_b128_fused",
+                            "resnet_nhwc_b128_perleaf")
+        if pair is not None:
+            fuseds = [pair[0] > pair[1]]
+            log(f"fused_state={fuseds[0]} from captures "
+                f"(fused {pair[0]:.0f} vs perleaf {pair[1]:.0f} img/s)")
     batches = [int(batch_env)] if batch_env else \
         ([64, 128, 256] if on_accel else [4])
     if on_accel and not batch_env:
@@ -474,12 +517,12 @@ def bench_resnet(on_accel: bool) -> None:
         "resnet_space_to_depth_stem"]
     if on_accel and \
             os.environ.get("FLAGS_resnet_space_to_depth_stem") is None:
-        s2d_v = capture_value("resnet_nhwc_b128_s2d")
-        plain = capture_value("resnet_nhwc_b128_perleaf")
-        if s2d_v is not None and plain is not None:
-            s2d_pin = bool(s2d_v > plain)
+        pair = capture_pair("resnet_nhwc_b128_s2d",
+                            "resnet_nhwc_b128_perleaf")
+        if pair is not None:
+            s2d_pin = bool(pair[0] > pair[1])
             log(f"s2d stem={s2d_pin} from captures "
-                f"({s2d_v:.0f} vs {plain:.0f} img/s)")
+                f"({pair[0]:.0f} vs {pair[1]:.0f} img/s)")
     candidates = [(b_, df, fu, s2d_pin and df == "NHWC")
                   for b_ in batches for df in layouts for fu in fuseds]
     # keep the sweep bounded: batch dim rides the first layout/fused

@@ -79,6 +79,13 @@ STAGES = {
         ["resnet50"], {**_SKIP, **_SPL1, "PT_BENCH_RESNET_BATCH": "128",
                        "PT_BENCH_LAYOUT": "NHWC",
                        "PT_BENCH_FUSED": "0"}, 900),
+    # clean fused-state A/B partner for _perleaf (same _SPL1 pinning —
+    # the older resnet_nhwc_b128 stage autotunes steps-per-loop and is
+    # not comparable like-for-like)
+    "resnet_nhwc_b128_fused": (
+        ["resnet50"], {**_SKIP, **_SPL1, "PT_BENCH_RESNET_BATCH": "128",
+                       "PT_BENCH_LAYOUT": "NHWC",
+                       "PT_BENCH_FUSED": "1"}, 900),
     "resnet_nhwc_b256_perleaf": (
         ["resnet50"], {**_SKIP, **_SPL1, "PT_BENCH_RESNET_BATCH": "256",
                        "PT_BENCH_LAYOUT": "NHWC",
@@ -143,6 +150,7 @@ R4_PLAN = ["verify",                      # refresh stamped artifact
            "bert_b8_bf16mv",
            "bert_b8_maskedlm",
            "bert_b16_perleaf_noqkv",
+           "resnet_nhwc_b128_fused",
            "resnet_nhwc_b256_perleaf",
            "bert_b32_remat",
            "bert_b64_remat",

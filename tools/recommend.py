@@ -96,13 +96,21 @@ def main() -> None:
         rows.append(("ResNet batch 256 vs 128 (img/s)",
                      f"b256={r256:.0f} vs b128={r128:.0f}",
                      "bench batches order"))
+    # masked-LM head restriction (reference mask_pos parity)
+    for b in (8, 32):
+        compare(f"masked-LM head (b{b})",
+                f"bert_b{b}_maskedlm", f"bert_b{b}_perleaf_noqkv",
+                "masked", "full",
+                "bench masked_for auto-pin uses this pair directly")
     # flash crossover: report the stage's speedup metrics
-    for st in ("flash", "flash_train"):
+    for st in ("flash", "flash_train", "flash_train_t128",
+               "flash_train_t512"):
         v = load(st)
         if v is not None:
             rows.append((f"{st} speedup at top seq", f"{v}x",
-                         "flash_attention_min_seq from the per-seq "
-                         "stderr table in the capture artifact"))
+                         "flash_attention_min_seq (and flash_block_q/k "
+                         "for the tile stages) from the per-seq stderr "
+                         "table in the capture artifact"))
         else:
             rows.append((f"{st}", "PENDING", ""))
 
