@@ -161,10 +161,12 @@ class _ComposedTrainStep(ShardedTrainStep):
         st = self.scaler.init()
         return {"amp": (st, jax.tree.map(lambda _: P(), st))}
 
-    def _loss_and_buffers(self, params, buffers, args, labels, key):
+    def _loss_and_buffers(self, params, buffers, args, labels, key,
+                          kwargs=None):
         import contextlib
 
         from ...core import random as _random
+        kwargs = kwargs or {}
 
         def run(p, *xs):
             ctx = contextlib.nullcontext()
@@ -173,7 +175,8 @@ class _ComposedTrainStep(ShardedTrainStep):
                 ctx = auto_cast(enable=True, dtype=self.amp_dtype)
             with ctx, _random.rng_scope(default=key, dropout=key):
                 out, new_buffers = functional_call(
-                    self.model, p, buffers, *xs, capture_buffers=True)
+                    self.model, p, buffers, *xs, capture_buffers=True,
+                    **kwargs)
             return self.loss_fn(out, *labels), (new_buffers, out)
 
         if self.remat_policy is not None:
@@ -185,6 +188,7 @@ class _ComposedTrainStep(ShardedTrainStep):
         buffers = state["buffers"]
         rng, step_key = jax.random.split(state["rng"])
         args, labels = batch["args"], batch["labels"]
+        kwargs = batch.get("kwargs", {})
 
         if self.grad_accum_steps > 1:
             # micro-batch scan (ref: gradient_merge_optimizer.py)
@@ -194,11 +198,23 @@ class _ComposedTrainStep(ShardedTrainStep):
                 g_acc, loss_acc, bufs = carry
                 m_args = tuple(_micro_slice(a, i, k) for a in args)
                 m_labels = tuple(_micro_slice(l, i, k) for l in labels)
+                # kwargs are where non-batch tensors ride (broadcast
+                # masks, replicated tables): micro-slice only leaves
+                # that share the args' batch-leading dim, pass the
+                # rest whole to every micro-step
+                bsz = args[0].shape[0] if args and \
+                    hasattr(args[0], "shape") else None
+                m_kwargs = {
+                    n: _micro_slice(v, i, k)
+                    if (bsz is not None and hasattr(v, "shape")
+                        and getattr(v, "ndim", 0) >= 1
+                        and v.shape[0] == bsz) else v
+                    for n, v in kwargs.items()}
 
                 def lf(p):
                     loss, aux = self._loss_and_buffers(
                         p, bufs, m_args, m_labels,
-                        jax.random.fold_in(step_key, i))
+                        jax.random.fold_in(step_key, i), m_kwargs)
                     if self.scaler is not None:
                         loss = self.scaler.scale(loss, state["amp"])
                     return loss, aux
@@ -217,7 +233,8 @@ class _ComposedTrainStep(ShardedTrainStep):
         else:
             def lf(p):
                 loss, aux = self._loss_and_buffers(p, buffers, args,
-                                                   labels, step_key)
+                                                   labels, step_key,
+                                                   kwargs)
                 if self.scaler is not None:
                     loss = self.scaler.scale(loss, state["amp"])
                 return loss, aux

@@ -112,6 +112,7 @@ class LocalSGDStep:
                 with _random.rng_scope(default=step_key, dropout=step_key):
                     out, new_buffers = functional_call(
                         self.model, p, buffers, *batch["args"],
+                        **batch.get("kwargs", {}),
                         capture_buffers=True)
                 return self.loss_fn(out, *batch["labels"]), new_buffers
 
@@ -156,9 +157,12 @@ class LocalSGDStep:
                           out_specs=self.state_specs, **smap),
             donate_argnums=(0,))
 
-    def __call__(self, *args, labels=()):
+    def __call__(self, *args, labels=(), **kwargs):
         from .spmd import host_lr_of
-        batch = {"args": args, "labels": as_label_tuple(labels)}
+        # model-forward kwargs ride like args (batch-leading leaves,
+        # sharded over dp with the rest of the batch tree)
+        batch = {"args": args, "labels": as_label_tuple(labels),
+                 "kwargs": kwargs}
         lr = host_lr_of(self.optimizer) if self._host_lr_active else 0.0
         with self.mesh:
             self.state, metrics = self._local(self.state, batch,

@@ -265,7 +265,7 @@ class ShardedTrainStep:
             with _random.rng_scope(default=step_key, dropout=step_key):
                 out, new_buffers = functional_call(
                     self.model, p, buffers, *batch["args"],
-                    capture_buffers=True)
+                    capture_buffers=True, **batch.get("kwargs", {}))
                 loss = self.loss_fn(out, *batch["labels"])
             return loss, (new_buffers, out)
 
@@ -286,9 +286,13 @@ class ShardedTrainStep:
         return tuple(jax.device_put(jnp.asarray(a), self.batch_sharding)
                      for a in arrays)
 
-    def __call__(self, *args, labels=()):
+    def __call__(self, *args, labels=(), **kwargs):
+        # model-forward kwargs ride the batch like args (same contract
+        # as TrainStep — e.g. BERT's masked_positions); their leaves
+        # shard per batch_spec when shardable, else replicate
         batch = inject_host_lr(
-            {"args": args, "labels": as_label_tuple(labels)},
+            {"args": args, "labels": as_label_tuple(labels),
+             "kwargs": kwargs},
             self.optimizer)
         batch = self._place_batch(batch)
         with self.mesh:
