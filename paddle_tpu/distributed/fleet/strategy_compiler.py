@@ -200,10 +200,16 @@ class _ComposedTrainStep(ShardedTrainStep):
                 m_labels = tuple(_micro_slice(l, i, k) for l in labels)
                 # kwargs are where non-batch tensors ride (broadcast
                 # masks, replicated tables): micro-slice only leaves
-                # that share the args' batch-leading dim, pass the
-                # rest whole to every micro-step
-                bsz = args[0].shape[0] if args and \
-                    hasattr(args[0], "shape") else None
+                # that share the batch-leading dim (taken from the
+                # first arg, else the first label — kwargs-only models
+                # still slice consistently), pass the rest whole to
+                # every micro-step. Convention: a kwarg whose leading
+                # dim EQUALS the batch size is treated as per-sample
+                # data — a replicated table that coincides must be
+                # reshaped (e.g. [1, N, ...]) by the caller.
+                lead = args[0] if args else \
+                    (labels[0] if labels else None)
+                bsz = lead.shape[0] if hasattr(lead, "shape") else None
                 m_kwargs = {
                     n: _micro_slice(v, i, k)
                     if (bsz is not None and hasattr(v, "shape")

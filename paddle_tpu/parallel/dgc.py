@@ -75,6 +75,7 @@ class DGCTrainStep:
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.mesh = mesh
+        self._dp_size = mesh.shape[dp_axis]
         self.sparsity = float(sparsity)
         self.rampup_steps = int(rampup_steps)
         self.axis = dp_axis
@@ -108,7 +109,7 @@ class DGCTrainStep:
         from .spmd import host_lr_of
         self._host_lr_active = host_lr_of(optimizer) is not None
 
-        def step(state, batch, lr):
+        def step(state, batch, rep_kwargs, lr):
             params = state["params"]
             buffers = state["buffers"]
             rng, step_key = jax.random.split(state["rng"])
@@ -117,7 +118,7 @@ class DGCTrainStep:
                 with _random.rng_scope(default=step_key, dropout=step_key):
                     out, new_buffers = functional_call(
                         self.model, p, buffers, *batch["args"],
-                        **batch.get("kwargs", {}),
+                        **batch.get("kwargs", {}), **rep_kwargs,
                         capture_buffers=True)
                 return self.loss_fn(out, *batch["labels"]), new_buffers
 
@@ -149,20 +150,23 @@ class DGCTrainStep:
         # rank-0 leaf can't satisfy the batch's P(dp_axis) shard_map spec
         self._jitted = jax.jit(
             jax.shard_map(step, mesh=mesh,
-                          in_specs=(self.state_specs, P(dp_axis), P()),
+                          in_specs=(self.state_specs, P(dp_axis), P(),
+                                    P()),
                           out_specs=(self.state_specs, P()),
                           check_vma=False),
             donate_argnums=(0,))
 
     def __call__(self, *args, labels=(), **kwargs):
         from .spmd import host_lr_of
-        # model-forward kwargs ride like args (batch-leading leaves,
-        # sharded over dp with the rest of the batch tree)
+        from .spmd import split_kwargs_by_shardable as _split_kwargs
+        # same kwargs split as LocalSGDStep (see _split_kwargs)
+        sh_kwargs, rep_kwargs = _split_kwargs(kwargs, self._dp_size)
         batch = {"args": args, "labels": as_label_tuple(labels),
-                 "kwargs": kwargs}
+                 "kwargs": sh_kwargs}
         lr = host_lr_of(self.optimizer) if self._host_lr_active else 0.0
         with self.mesh:
             self.state, metrics = self._jitted(self.state, batch,
+                                               rep_kwargs,
                                                jnp.float32(lr))
         return metrics
 

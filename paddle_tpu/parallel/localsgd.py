@@ -46,6 +46,7 @@ class LocalSGDStep:
         self._calls = 0
         n = mesh.shape[dp_axis]
         self.n_replicas = n
+        self._dp_size = n
 
         params = model.param_dict()
         buffers = model.buffer_dict()
@@ -91,7 +92,7 @@ class LocalSGDStep:
         from .spmd import host_lr_of
         self._host_lr_active = host_lr_of(optimizer) is not None
 
-        def local_step(state, batch, lr):
+        def local_step(state, batch, rep_kwargs, lr):
             # inside shard_map: leading replica axis is size 1 locally
             def unstack(tree):
                 return jax.tree.map(
@@ -112,7 +113,7 @@ class LocalSGDStep:
                 with _random.rng_scope(default=step_key, dropout=step_key):
                     out, new_buffers = functional_call(
                         self.model, p, buffers, *batch["args"],
-                        **batch.get("kwargs", {}),
+                        **batch.get("kwargs", {}), **rep_kwargs,
                         capture_buffers=True)
                 return self.loss_fn(out, *batch["labels"]), new_buffers
 
@@ -149,7 +150,8 @@ class LocalSGDStep:
         # rank-0 leaf can't satisfy the batch's P(dp_axis) shard_map spec
         self._local = jax.jit(
             jax.shard_map(local_step,
-                          in_specs=(self.state_specs, P(dp_axis), P()),
+                          in_specs=(self.state_specs, P(dp_axis), P(),
+                                    P()),
                           out_specs=(self.state_specs, P()), **smap),
             donate_argnums=(0,))
         self._sync = jax.jit(
@@ -159,13 +161,18 @@ class LocalSGDStep:
 
     def __call__(self, *args, labels=(), **kwargs):
         from .spmd import host_lr_of
-        # model-forward kwargs ride like args (batch-leading leaves,
-        # sharded over dp with the rest of the batch tree)
+        from .spmd import split_kwargs_by_shardable as _split_kwargs
+        # model-forward kwargs: dp-shardable leaves (leading dim
+        # divisible by the dp size) ride the batch tree; the rest
+        # (broadcast masks, tables, scalars) go replicated — the same
+        # split ShardedTrainStep._place_batch makes
+        sh_kwargs, rep_kwargs = _split_kwargs(kwargs, self._dp_size)
         batch = {"args": args, "labels": as_label_tuple(labels),
-                 "kwargs": kwargs}
+                 "kwargs": sh_kwargs}
         lr = host_lr_of(self.optimizer) if self._host_lr_active else 0.0
         with self.mesh:
             self.state, metrics = self._local(self.state, batch,
+                                              rep_kwargs,
                                               jnp.float32(lr))
             self._calls += 1
             if self._calls % self.k_steps == 0:

@@ -65,6 +65,27 @@ def inject_host_lr(batch: Dict[str, Any], optimizer) -> Dict[str, Any]:
     return batch
 
 
+def split_kwargs_by_shardable(kwargs: Dict[str, Any], dp_size: int):
+    """Partition model-forward kwargs into (dp-shardable, replicated):
+    a leaf whose leading dim divides by the dp size rides the sharded
+    batch tree, everything else (broadcast masks, tables, scalars) is
+    replicated — the shard_map analogue of ShardedTrainStep's
+    _place_batch per-leaf placement."""
+    sh, rep = {}, {}
+    for n, v in kwargs.items():
+        nd = getattr(v, "ndim", None)
+        shp = getattr(v, "shape", None)
+        if nd is None and hasattr(v, "__len__"):
+            import numpy as _np
+            v = _np.asarray(v)
+            nd, shp = v.ndim, v.shape
+        if nd and shp and shp[0] % dp_size == 0:
+            sh[n] = v
+        else:
+            rep[n] = v
+    return sh, rep
+
+
 def _global_put(value, sharding: NamedSharding):
     """device_put that also works on a multi-process mesh.
 

@@ -202,8 +202,10 @@ def test_sharded_step_forwards_model_kwargs():
 
 
 def test_all_compiled_steps_forward_kwargs():
-    """LocalSGD/DGC steps take the same model-kwargs contract, and a
-    NON-batch-leading kwarg (broadcast mask) survives grad accumulation
+    """LocalSGD/DGC steps take the same model-kwargs contract
+    (dp-shardable leaves ride the P(dp) batch tree, non-batch leaves —
+    broadcast masks, scalars — go replicated via a separate shard_map
+    argument), and a NON-batch-leading kwarg survives grad accumulation
     unsliced in the composed step."""
     import numpy as np
 
@@ -243,6 +245,36 @@ def test_all_compiled_steps_forward_kwargs():
                          masked_positions=pos)["loss"])
               for _ in range(4)]
         assert ls[-1] < ls[0], (cls.__name__, ls)
+
+    class MaskedFc(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = pt.nn.Linear(8, 4)
+
+        def forward(self, x, mask=None, scale=None):
+            out = self.fc(x)
+            if mask is not None:
+                out = out * mask
+            if scale is not None:
+                out = out * scale
+            return out
+
+    fx = rng.normal(0, 1, (16, 8)).astype(np.float32)
+    fy = rng.integers(0, 4, (16,)).astype(np.int64)
+    fmask = np.ones((1, 4), np.float32)  # dim0=1: must replicate
+    for cls, kw in [(LocalSGDStep, dict(k_steps=2)),
+                    (DGCTrainStep, dict())]:
+        pt.seed(0)
+        step = cls(MaskedFc(),
+                   pt.optimizer.Momentum(learning_rate=0.05,
+                                         momentum=0.9),
+                   lambda o, t_: pt.nn.functional.cross_entropy(o, t_),
+                   mesh=mesh, **kw)
+        f0 = float(step(fx, labels=(fy,), mask=fmask,
+                        scale=np.float32(1.0))["loss"])
+        f1 = float(step(fx, labels=(fy,), mask=fmask,
+                        scale=np.float32(1.0))["loss"])
+        assert f1 < f0, (cls.__name__, f0, f1)
 
     class MaskNet(pt.nn.Layer):
         def __init__(self):
