@@ -207,9 +207,8 @@ class _ComposedTrainStep(ShardedTrainStep):
                 # dim EQUALS the batch size is treated as per-sample
                 # data — a replicated table that coincides must be
                 # reshaped (e.g. [1, N, ...]) by the caller.
-                lead = args[0] if args else \
-                    (labels[0] if labels else None)
-                bsz = lead.shape[0] if hasattr(lead, "shape") else None
+                from ...parallel.spmd import leading_batch_size
+                bsz = leading_batch_size(args, labels)
                 m_kwargs = {
                     n: _micro_slice(v, i, k)
                     if (bsz is not None and hasattr(v, "shape")

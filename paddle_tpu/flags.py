@@ -179,6 +179,12 @@ define_flag("flash_attention_min_seq", 4096,
             "Key-sequence length at or above which attention routes to the "
             "Pallas flash kernel (below it XLA's fused attention is faster "
             "on v5e; the flash kernel is always O(T) memory).")
+define_flag("flash_attention_min_seq_train", 0,
+            "Training-mode flash crossover (0 = use "
+            "flash_attention_min_seq). Separate because the XLA "
+            "attention backward materializes the [T, T] probs in fp32, "
+            "so flash typically wins earlier in training than in eval; "
+            "set from the bench.py flash_train capture table.")
 define_flag("flash_block_q", 0,
             "Flash kernel query-tile size (rows of the online-softmax "
             "block). 0 = the kernel module's built-in BLOCK_Q (256). "

@@ -92,8 +92,14 @@ def maybe_flash_attention(q, k, v, mask=None, scale: Optional[float] = None,
     # additive key bias; broadcastable or richer mask shapes fall back
     # to the XLA path. Conversion happens only on the routed branch.
     mask_ok = mask is None or _is_key_padding_mask(mask, q, k)
+    min_seq = GLOBAL_FLAGS.get("flash_attention_min_seq")
+    if training:
+        # the train crossover is its own measured number (XLA's
+        # backward re-materializes [T, T] probs in fp32); 0 = shared
+        min_seq = GLOBAL_FLAGS.get("flash_attention_min_seq_train") \
+            or min_seq
     if (pallas_enabled() and mask_ok and q.ndim == 4 and d_ok
-            and k.shape[2] >= GLOBAL_FLAGS.get("flash_attention_min_seq")):
+            and k.shape[2] >= min_seq):
         from .flash_attention import flash_attention
         kv_bias = None if mask is None else _mask_to_kv_bias(mask)
         if dropout_p > 0.0 and training:

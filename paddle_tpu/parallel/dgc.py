@@ -75,7 +75,6 @@ class DGCTrainStep:
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.mesh = mesh
-        self._dp_size = mesh.shape[dp_axis]
         self.sparsity = float(sparsity)
         self.rampup_steps = int(rampup_steps)
         self.axis = dp_axis
@@ -158,9 +157,11 @@ class DGCTrainStep:
 
     def __call__(self, *args, labels=(), **kwargs):
         from .spmd import host_lr_of
-        from .spmd import split_kwargs_by_shardable as _split_kwargs
+        from .spmd import (leading_batch_size,
+                           split_kwargs_by_shardable)
         # same kwargs split as LocalSGDStep (see _split_kwargs)
-        sh_kwargs, rep_kwargs = _split_kwargs(kwargs, self._dp_size)
+        sh_kwargs, rep_kwargs = split_kwargs_by_shardable(
+            kwargs, leading_batch_size(args, labels))
         batch = {"args": args, "labels": as_label_tuple(labels),
                  "kwargs": sh_kwargs}
         lr = host_lr_of(self.optimizer) if self._host_lr_active else 0.0

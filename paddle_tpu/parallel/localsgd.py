@@ -46,7 +46,6 @@ class LocalSGDStep:
         self._calls = 0
         n = mesh.shape[dp_axis]
         self.n_replicas = n
-        self._dp_size = n
 
         params = model.param_dict()
         buffers = model.buffer_dict()
@@ -161,12 +160,14 @@ class LocalSGDStep:
 
     def __call__(self, *args, labels=(), **kwargs):
         from .spmd import host_lr_of
-        from .spmd import split_kwargs_by_shardable as _split_kwargs
+        from .spmd import (leading_batch_size,
+                           split_kwargs_by_shardable)
         # model-forward kwargs: dp-shardable leaves (leading dim
         # divisible by the dp size) ride the batch tree; the rest
         # (broadcast masks, tables, scalars) go replicated — the same
         # split ShardedTrainStep._place_batch makes
-        sh_kwargs, rep_kwargs = _split_kwargs(kwargs, self._dp_size)
+        sh_kwargs, rep_kwargs = split_kwargs_by_shardable(
+            kwargs, leading_batch_size(args, labels))
         batch = {"args": args, "labels": as_label_tuple(labels),
                  "kwargs": sh_kwargs}
         lr = host_lr_of(self.optimizer) if self._host_lr_active else 0.0

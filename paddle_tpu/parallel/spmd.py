@@ -65,12 +65,14 @@ def inject_host_lr(batch: Dict[str, Any], optimizer) -> Dict[str, Any]:
     return batch
 
 
-def split_kwargs_by_shardable(kwargs: Dict[str, Any], dp_size: int):
+def split_kwargs_by_shardable(kwargs: Dict[str, Any],
+                              batch_size: Optional[int]):
     """Partition model-forward kwargs into (dp-shardable, replicated):
-    a leaf whose leading dim divides by the dp size rides the sharded
-    batch tree, everything else (broadcast masks, tables, scalars) is
-    replicated — the shard_map analogue of ShardedTrainStep's
-    _place_batch per-leaf placement."""
+    a leaf whose leading dim EQUALS the batch size is per-sample data
+    and rides the sharded batch tree; everything else (broadcast
+    masks, tables, scalars) is replicated — the shard_map analogue of
+    ShardedTrainStep's _place_batch placement, using the same
+    leading-dim convention the grad-accum micro-slicer documents."""
     sh, rep = {}, {}
     for n, v in kwargs.items():
         nd = getattr(v, "ndim", None)
@@ -79,11 +81,20 @@ def split_kwargs_by_shardable(kwargs: Dict[str, Any], dp_size: int):
             import numpy as _np
             v = _np.asarray(v)
             nd, shp = v.ndim, v.shape
-        if nd and shp and shp[0] % dp_size == 0:
+        if batch_size is not None and nd and shp                 and shp[0] == batch_size:
             sh[n] = v
         else:
             rep[n] = v
     return sh, rep
+
+
+def leading_batch_size(args, labels) -> Optional[int]:
+    """Batch size from the first arg (else first label) with a rank
+    guard — the one convention every step class shares."""
+    lead = args[0] if args else (labels[0] if labels else None)
+    if getattr(lead, "ndim", 0) >= 1:
+        return lead.shape[0]
+    return None
 
 
 def _global_put(value, sharding: NamedSharding):

@@ -621,3 +621,57 @@ def test_flash_block_size_flags_parity():
                                        rtol=2e-5, atol=2e-5)
     finally:
         pt.set_flags(saved)
+
+
+def test_flash_train_eval_split_crossover(monkeypatch):
+    """flash_attention_min_seq_train routes TRAINING attention to flash
+    independently of the eval threshold (the XLA backward's fp32 [T,T]
+    probs make the train crossover lower); 0 falls back to the shared
+    flag. d=128 so the head-dim gate passes in BOTH modes — otherwise
+    the eval assertions would hold vacuously."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu import kernels
+    from paddle_tpu.kernels import flash_attention as fa_mod
+    from paddle_tpu.kernels import maybe_flash_attention
+
+    q = jnp.asarray(
+        np.random.default_rng(0).normal(0, 1, (1, 2, 64, 128)),
+        jnp.float32)
+    calls = []
+    orig = fa_mod.flash_attention
+
+    def spy(*a, **k):
+        calls.append(1)
+        k.pop("interpret", None)
+        return orig(*a, interpret=True, **k)
+
+    monkeypatch.setattr(kernels, "_on_tpu", lambda: True)
+    monkeypatch.setattr(fa_mod, "flash_attention", spy)
+    saved = pt.get_flags(["flash_attention_min_seq",
+                          "flash_attention_min_seq_train"])
+    try:
+        # eval threshold passes at d=128 (sanity: gate is live)
+        pt.set_flags({"flash_attention_min_seq": 64,
+                      "flash_attention_min_seq_train": 0})
+        maybe_flash_attention(q, q, q, training=False)
+        assert calls, "eval gate not live at d=128 — test is vacuous"
+        calls.clear()
+        # split: train threshold met, eval threshold not
+        pt.set_flags({"flash_attention_min_seq": 4096,
+                      "flash_attention_min_seq_train": 64})
+        maybe_flash_attention(q, q, q, training=True)
+        assert calls, "training did not route to flash at its threshold"
+        calls.clear()
+        maybe_flash_attention(q, q, q, training=False)
+        assert not calls, "eval wrongly took the train threshold"
+        # 0-sentinel: training falls back to the SHARED threshold
+        # (4096 > 64 -> must NOT route)
+        pt.set_flags({"flash_attention_min_seq": 4096,
+                      "flash_attention_min_seq_train": 0})
+        maybe_flash_attention(q, q, q, training=True)
+        assert not calls, "train 0-sentinel ignored the shared threshold"
+    finally:
+        pt.set_flags(saved)
