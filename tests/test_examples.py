@@ -78,3 +78,16 @@ def test_long_context():
     import long_context
     err = long_context.main(seq=256, verbose=False, interpret=True)
     assert err < 2e-4
+
+
+def test_bert_pretraining():
+    import bert_pretraining
+    r = bert_pretraining.main(steps=6, verbose=False)
+    assert r["last_loss"] < r["first_loss"]
+
+
+def test_bert_pretraining_sharded():
+    import bert_pretraining
+    r = bert_pretraining.main(steps=4, batch=8, sharded=True,
+                              verbose=False)
+    assert r["last_loss"] < r["first_loss"]
