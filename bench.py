@@ -816,40 +816,12 @@ def acquire_chip_lock(name: str = "bench") -> None:
 
 
 def _probe_backend(attempts: int = 3, timeout_s: int = 60) -> bool:
-    """Fail FAST (with retries) if the accelerator tunnel is hung or
-    down, instead of hanging until the driver's timeout (round 1's
-    rc=124 failure mode). Probes in a subprocess so a wedged PJRT init
-    can't freeze this process. Worst case ≤3×60s + 2×10s ≈ 3.3 min
-    (VERDICT r2 weak 1: the old 3×300s burned 15 min of driver budget
-    just to learn the tunnel was down)."""
-    import subprocess
-
-    for i in range(attempts):
-        try:
-            # honor an explicit JAX_PLATFORMS: the ambient sitecustomize
-            # re-pins jax_platforms to "axon,cpu" at interpreter start,
-            # so the env var alone is overridden and a CPU smoke run
-            # would dial the (possibly down) tunnel anyway
-            r = subprocess.run(
-                [sys.executable, "-c",
-                 "import os, jax\n"
-                 "if os.environ.get('JAX_PLATFORMS'):\n"
-                 "    jax.config.update('jax_platforms',"
-                 " os.environ['JAX_PLATFORMS'])\n"
-                 "print(jax.default_backend())"],
-                capture_output=True, timeout=timeout_s, text=True)
-            if r.returncode == 0:
-                backend = r.stdout.strip().splitlines()[-1]
-                log(f"backend probe {i}: {backend}")
-                return True
-            log(f"backend probe {i}: rc={r.returncode} "
-                f"{r.stderr.strip().splitlines()[-1][:200] if r.stderr else ''}")
-        except subprocess.TimeoutExpired:
-            log(f"backend probe {i}: hung >{timeout_s}s (tunnel down?)")
-        if i + 1 < attempts:
-            time.sleep(10)
-    return False
-
+    """Fail FAST if the accelerator tunnel is hung or down (round 1's
+    rc=124 failure mode). Delegates to the single shared probe in
+    paddle_tpu.verify — one implementation, one place for fixes —
+    logging through this module's [bench] prefix."""
+    from paddle_tpu.verify import _probe_backend as probe
+    return probe(attempts, timeout_s, log_fn=log)
 
 def main() -> None:
     # anchor the soft deadline FIRST: capture_all's hard kill counts

@@ -230,29 +230,42 @@ def train_parity_10steps() -> dict:
             "losses": [round(v, 6) for v in losses_fw]}
 
 
-def _probe_backend(attempts: int = 3, timeout_s: int = 60) -> bool:
+def _probe_backend(attempts: int = 3, timeout_s: int = 60,
+                   log_fn=None) -> bool:
     """Fail FAST (with retries) when the accelerator tunnel is hung —
     a wedged PJRT init would otherwise block run_verification forever
     and no artifact would be written, the exact outcome this module
     exists to prevent. Probes in a subprocess so this process never
-    touches the backend until it's known good."""
+    touches the backend until it's known good. The ONE probe
+    implementation — bench.py delegates here so probe fixes land once.
+    """
     import subprocess
+
+    log = log_fn or _log
 
     for i in range(attempts):
         try:
+            # honor an explicit JAX_PLATFORMS (same fix as bench.py's
+            # probe): the ambient sitecustomize re-pins jax_platforms
+            # to "axon,cpu" at interpreter start, so a CPU verification
+            # run would otherwise dial the (possibly down) tunnel
             r = subprocess.run(
                 [sys.executable, "-c",
-                 "import jax; print(jax.default_backend())"],
+                 "import os, jax\n"
+                 "if os.environ.get('JAX_PLATFORMS'):\n"
+                 "    jax.config.update('jax_platforms',"
+                 " os.environ['JAX_PLATFORMS'])\n"
+                 "print(jax.default_backend())"],
                 capture_output=True, timeout=timeout_s, text=True)
             if r.returncode == 0:
-                _log(f"backend probe {i}: "
+                log(f"backend probe {i}: "
                      f"{r.stdout.strip().splitlines()[-1]}")
                 return True
             tail = r.stderr.strip().splitlines()[-1][:200] if r.stderr \
                 else ""
-            _log(f"backend probe {i}: rc={r.returncode} {tail}")
+            log(f"backend probe {i}: rc={r.returncode} {tail}")
         except subprocess.TimeoutExpired:
-            _log(f"backend probe {i}: hung >{timeout_s}s (tunnel down?)")
+            log(f"backend probe {i}: hung >{timeout_s}s (tunnel down?)")
         if i + 1 < attempts:
             time.sleep(10)
     return False
@@ -306,7 +319,32 @@ def run_verification(artifact_path: str | None = None) -> dict:
             _log(f"wrote {artifact_path} (backend unreachable)")
         return result
 
+    import os
+
     import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # sitecustomize-override guard (same as the probe): if the
+        # backend is ALREADY committed to something else, the config
+        # update silently no-ops — detect the mismatch and bail with an
+        # artifact instead of letting the checks dial a down tunnel
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        want = os.environ["JAX_PLATFORMS"].split(",")[0]
+        if jax.default_backend() != want:
+            result = {
+                "backend": jax.default_backend(),
+                "on_accel": False, "kernels_ok": False,
+                "kernel_failures": [
+                    f"requested JAX_PLATFORMS={want} but the backend "
+                    f"was already committed to {jax.default_backend()} "
+                    "in this process; run verification in a fresh "
+                    "process"],
+                "train_parity": {"ok": False}, "ok": False,
+            }
+            with open(artifact_path, "w") as f:
+                json.dump(result, f, indent=1)
+            _log(f"wrote {artifact_path} (backend mismatch)")
+            return result
 
     backend = jax.default_backend()
     on_accel = any(d.platform in ("tpu", "axon") for d in jax.devices())

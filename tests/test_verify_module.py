@@ -1,0 +1,66 @@
+"""paddle_tpu.verify must work on CPU too — a regression here would
+otherwise only surface during a (rare, short) real-chip window."""
+
+import json
+import os
+
+
+def test_train_parity_cpu():
+    from paddle_tpu.verify import train_parity_10steps
+
+    res = train_parity_10steps()
+    assert res["ok"], res
+    assert res["max_rel_err"] < 1e-4
+    assert len(res["losses"]) == 10
+    assert res["losses"][-1] < res["losses"][0]
+
+
+def test_kernels_source_hash_stable_and_sensitive(tmp_path,
+                                                  monkeypatch):
+    from paddle_tpu import verify
+
+    h1 = verify.kernels_source_hash()
+    assert h1 == verify.kernels_source_hash()  # deterministic
+    assert len(h1) == 16
+    # sensitive to kernel-source bytes: hash a copied tree with one
+    # byte changed
+    import shutil
+    kdir = os.path.join(os.path.dirname(verify.__file__), "kernels")
+    fake = tmp_path / "kernels"
+    shutil.copytree(kdir, fake, ignore=shutil.ignore_patterns(
+        "__pycache__"))
+    with open(fake / "flash_attention.py", "a") as f:
+        f.write("\n# x\n")
+    real_dirname = os.path.dirname
+
+    def fake_dirname(p):
+        # redirect the module-dir lookup to the tampered copy
+        if os.path.abspath(p) == os.path.abspath(verify.__file__):
+            return str(tmp_path)
+        return real_dirname(p)
+
+    monkeypatch.setattr(verify.os.path if hasattr(verify, "os")
+                        else os.path, "dirname", fake_dirname)
+    try:
+        h2 = verify.kernels_source_hash()
+    finally:
+        monkeypatch.undo()
+    assert h2 != h1
+
+
+def test_run_verification_writes_canonical_artifact(tmp_path,
+                                                    monkeypatch):
+    from paddle_tpu.verify import default_artifact_path, \
+        run_verification
+
+    assert default_artifact_path().endswith("/VERIFY_TPU.json")
+    out = str(tmp_path / "v.json")
+    # the probe subprocess honors JAX_PLATFORMS (the in-process config
+    # pin from conftest doesn't reach subprocesses): run it the way a
+    # CPU operator would — JAX_PLATFORMS=cpu python -m ...
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    res = run_verification(artifact_path=out)
+    with open(out) as f:
+        d = json.load(f)
+    assert d["ok"] == res["ok"]
+    assert "kernel_hash" in d and "device" in d
