@@ -307,17 +307,24 @@ def run_verification(artifact_path: str | None = None) -> dict:
     (ok=False, backend="unreachable") instead of hanging."""
     if artifact_path is None:
         artifact_path = default_artifact_path()
-    if not _probe_backend():
-        result = {"backend": "unreachable", "on_accel": False,
-                  "kernels_ok": False,
-                  "kernel_failures": ["backend unreachable (tunnel "
-                                      "down?): probes timed out"],
+
+    def fail_result(backend: str, reason: str, why: str) -> dict:
+        """ok=False artifact for a run that never reached the checks —
+        one shape for every bail path."""
+        result = {"backend": backend, "on_accel": False,
+                  "kernels_ok": False, "kernel_failures": [reason],
                   "train_parity": {"ok": False}, "ok": False}
         if artifact_path:
             with open(artifact_path, "w") as f:
                 json.dump(result, f, indent=1)
-            _log(f"wrote {artifact_path} (backend unreachable)")
+            _log(f"wrote {artifact_path} ({why})")
         return result
+
+    if not _probe_backend():
+        return fail_result(
+            "unreachable",
+            "backend unreachable (tunnel down?): probes timed out",
+            "backend unreachable")
 
     import os
 
@@ -331,20 +338,12 @@ def run_verification(artifact_path: str | None = None) -> dict:
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
         want = os.environ["JAX_PLATFORMS"].split(",")[0]
         if jax.default_backend() != want:
-            result = {
-                "backend": jax.default_backend(),
-                "on_accel": False, "kernels_ok": False,
-                "kernel_failures": [
-                    f"requested JAX_PLATFORMS={want} but the backend "
-                    f"was already committed to {jax.default_backend()} "
-                    "in this process; run verification in a fresh "
-                    "process"],
-                "train_parity": {"ok": False}, "ok": False,
-            }
-            with open(artifact_path, "w") as f:
-                json.dump(result, f, indent=1)
-            _log(f"wrote {artifact_path} (backend mismatch)")
-            return result
+            return fail_result(
+                jax.default_backend(),
+                f"requested JAX_PLATFORMS={want} but the backend was "
+                f"already committed to {jax.default_backend()} in this "
+                "process; run verification in a fresh process",
+                "backend mismatch")
 
     backend = jax.default_backend()
     on_accel = any(d.platform in ("tpu", "axon") for d in jax.devices())

@@ -1,0 +1,19 @@
+"""Fixture: a fake bench stage for tools tests — prints partial and
+final JSON lines like bench.py, honoring PT_FAKE_* controls."""
+import json
+import os
+import sys
+
+mode = os.environ.get("PT_FAKE_MODE", "ok")
+print(json.dumps({"metric": "fake", "value": 1.0, "unit": "x",
+                  "vs_baseline": 0.1, "partial": True}), flush=True)
+if mode == "hang":
+    import time
+    time.sleep(3600)
+if mode == "rc3":
+    print("[fake] aborting like a probe failure", file=sys.stderr)
+    sys.exit(3)
+print(json.dumps({"metric": "fake", "value": 2.0, "unit": "x",
+                  "vs_baseline": 0.2,
+                  "budget": os.environ.get("PT_BENCH_BUDGET_S")}),
+      flush=True)

@@ -1,0 +1,77 @@
+"""tools/capture_all.py plumbing — the machinery the driver-artifact
+story depends on: env merge + budget passing, last-JSON-line parsing,
+timeout partial preservation, stage_ok semantics."""
+
+import json
+import os
+import sys
+
+import pytest
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+
+@pytest.fixture
+def capture_all():
+    import capture_all as mod
+    saved = dict(mod.STAGES)
+    yield mod
+    mod.STAGES.clear()
+    mod.STAGES.update(saved)
+
+
+def _cleanup(name):
+    p = os.path.join(ROOT, f"CAPTURE_{name}.json")
+    if os.path.exists(p):
+        os.unlink(p)
+
+
+def test_run_stage_ok_parses_last_line_and_passes_budget(capture_all):
+    capture_all.STAGES["selftest_ok"] = (
+        [], {"PT_FAKE_MODE": "ok"}, 300, "tests/fixtures/fake_stage.py")
+    try:
+        out = capture_all.run_stage("selftest_ok")
+        assert out["ok"] and out["rc"] == 0
+        # LAST JSON line wins (the final result supersedes partials)
+        assert out["parsed"]["value"] == 2.0
+        # the stage's real deadline reached the subprocess
+        assert out["parsed"]["budget"] == str(max(60, 300 - 120))
+        with open(os.path.join(ROOT, "CAPTURE_selftest_ok.json")) as f:
+            assert json.load(f)["parsed"]["value"] == 2.0
+    finally:
+        _cleanup("selftest_ok")
+
+
+def test_run_stage_timeout_keeps_partial(capture_all):
+    capture_all.STAGES["selftest_hang"] = (
+        [], {"PT_FAKE_MODE": "hang"}, 3,
+        "tests/fixtures/fake_stage.py")
+    try:
+        out = capture_all.run_stage("selftest_hang")
+        assert out["timed_out"]
+        # the pre-hang partial line survived the kill
+        assert out["parsed"] is not None
+        assert out["parsed"]["value"] == 1.0
+        assert out["ok"]  # a timed-out stage with a number is usable
+    finally:
+        _cleanup("selftest_hang")
+
+
+def test_run_stage_rc3_probe_abort_not_ok(capture_all):
+    capture_all.STAGES["selftest_rc3"] = (
+        [], {"PT_FAKE_MODE": "rc3"}, 300,
+        "tests/fixtures/fake_stage.py")
+    try:
+        out = capture_all.run_stage("selftest_rc3")
+        assert out["rc"] == 3 and not out["ok"]
+    finally:
+        _cleanup("selftest_rc3")
+
+
+def test_resolve_plan_aliases(capture_all):
+    r4 = capture_all.resolve_plan(["r4"])
+    assert r4[0] == "verify"
+    assert "bert_b8_perleaf_noqkv" in r4[:3]
+    assert all(s in capture_all.STAGES for s in r4)
+    assert capture_all.resolve_plan(["flash"]) == ["flash"]

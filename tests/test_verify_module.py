@@ -39,12 +39,9 @@ def test_kernels_source_hash_stable_and_sensitive(tmp_path,
             return str(tmp_path)
         return real_dirname(p)
 
-    monkeypatch.setattr(verify.os.path if hasattr(verify, "os")
-                        else os.path, "dirname", fake_dirname)
-    try:
-        h2 = verify.kernels_source_hash()
-    finally:
-        monkeypatch.undo()
+    monkeypatch.setattr(os.path, "dirname", fake_dirname)
+    h2 = verify.kernels_source_hash()
+    monkeypatch.undo()
     assert h2 != h1
 
 
