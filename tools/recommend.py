@@ -25,6 +25,10 @@ def load(stage):
     return capture_value(stage, any_device=True)
 
 
+def load_field(stage, field):
+    return capture_value(stage, any_device=True, field=field)
+
+
 def tok(stage):
     return load(stage)  # tokens/sec (higher better)
 
@@ -33,8 +37,9 @@ def main() -> None:
     rows = []
 
     def compare(name, a_stage, b_stage, a_label, b_label,
-                implies_fmt):
-        a, b_ = tok(a_stage), tok(b_stage)
+                implies_fmt, field="value"):
+        a = load_field(a_stage, field)
+        b_ = load_field(b_stage, field)
         if a is None or b_ is None:
             missing = [s for s, v in ((a_stage, a), (b_stage, b_))
                        if v is None]
@@ -42,8 +47,10 @@ def main() -> None:
             return None
         win, lose = (a_label, b_label) if a >= b_ else (b_label, a_label)
         ratio = max(a, b_) / max(min(a, b_), 1e-9)
+        fmt = ".0f" if field == "value" else ".3f"
         rows.append((name, f"{win} wins {ratio:.2f}x "
-                     f"({a_label}={a:.0f} vs {b_label}={b_:.0f})",
+                     f"({a_label}={a:{fmt}} vs {b_label}={b_:{fmt}}"
+                     f"{'' if field == 'value' else ' ' + field})",
                      implies_fmt.format(win=win)))
         return win
 
@@ -96,12 +103,15 @@ def main() -> None:
         rows.append(("ResNet batch 256 vs 128 (img/s)",
                      f"b256={r256:.0f} vs b128={r128:.0f}",
                      "bench batches order"))
-    # masked-LM head restriction (reference mask_pos parity)
+    # masked-LM head restriction (reference mask_pos parity) — judged
+    # by vs_baseline: masked mode's honest FLOP accounting means
+    # higher tokens/sec does not imply a higher judged number
     for b in (8, 32):
         compare(f"masked-LM head (b{b})",
                 f"bert_b{b}_maskedlm", f"bert_b{b}_perleaf_noqkv",
                 "masked", "full",
-                "bench masked_for auto-pin uses this pair directly")
+                "bench masked_for auto-pin uses this pair",
+                field="vs_baseline")
     # flash crossover: report the stage's speedup metrics
     for st in ("flash", "flash_train", "flash_train_t128",
                "flash_train_t512"):
