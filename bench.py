@@ -865,9 +865,8 @@ def main() -> None:
     if os.environ.get("JAX_PLATFORMS"):
         # see _probe_backend: sitecustomize overrides the env var
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    jax.config.update("jax_compilation_cache_dir",
-                      "/root/repo/.jax_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    from paddle_tpu.sysconfig import enable_compile_cache
+    enable_compile_cache()
 
     on_accel = any(d.platform in ("tpu", "axon") for d in jax.devices())
     log(f"backend={jax.default_backend()} devices={jax.devices()}")

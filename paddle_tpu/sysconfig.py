@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["get_include", "get_lib"]
+__all__ = ["get_include", "get_lib", "enable_compile_cache"]
 
 
 def get_include() -> str:
@@ -38,3 +38,27 @@ def get_lib() -> str:
     native.build()
     return os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "native")
+
+
+def enable_compile_cache(cache_dir: str = None,
+                         min_compile_secs: float = 0.5) -> None:
+    """Enable JAX's persistent compilation cache (repo-root
+    ``.jax_cache/`` by default). The ONE implementation — bench.py,
+    verify, conftest and perf_lab all call this, so the path and the
+    min-compile threshold can't drift between entry points. Safe to
+    call repeatedly; failures are swallowed (the cache is an
+    optimization, never a correctness dependency)."""
+    import os
+
+    import jax
+
+    if cache_dir is None:
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_compile_secs)
+    except Exception:  # noqa: BLE001
+        pass

@@ -330,18 +330,10 @@ def run_verification(artifact_path: str | None = None) -> dict:
 
     import jax
 
-    try:
-        # same persistent compile cache bench.py main() uses — the
-        # driver calls __graft_entry__.verify() directly, and warm
-        # kernels cut the chip-window cost of a verify stage
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.path.join(os.path.dirname(default_artifact_path()),
-                         ".jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                          0.5)
-    except Exception:  # noqa: BLE001 — cache is an optimization only
-        pass
+    # warm kernels cut the chip-window cost of a verify stage (the
+    # driver calls __graft_entry__.verify() directly, not via bench)
+    from .sysconfig import enable_compile_cache
+    enable_compile_cache()
 
     if os.environ.get("JAX_PLATFORMS"):
         # sitecustomize-override guard (same as the probe): if the

@@ -31,9 +31,9 @@ jax.config.update("jax_default_matmul_precision", "highest")
 
 # Persistent compilation cache: CPU-XLA conv compiles are slow (~20s for
 # LeNet); cache them across pytest runs.
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+from paddle_tpu.sysconfig import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
 
 
 @pytest.fixture

@@ -221,9 +221,8 @@ def main():
         raise SystemExit("accelerator backend unreachable (tunnel "
                          "wedged?); aborting fast")
     import jax
-    import os
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(_repo_root(), ".jax_cache"))
+    from paddle_tpu.sysconfig import enable_compile_cache
+    enable_compile_cache()
     log(f"backend={jax.default_backend()} devices={jax.devices()}")
     if which in ("leafcount", "all"):
         exp_leafcount()
