@@ -48,8 +48,6 @@ def enable_compile_cache(cache_dir: str = None,
     min-compile threshold can't drift between entry points. Safe to
     call repeatedly; failures are swallowed (the cache is an
     optimization, never a correctness dependency)."""
-    import os
-
     import jax
 
     if cache_dir is None:
