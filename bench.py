@@ -513,9 +513,15 @@ def bench_resnet(on_accel: bool) -> None:
     fuseds = [pin_fused.strip() in ("1", "true", "yes", "on")] \
         if pin_fused else ([False, True] if on_accel else [False])
     if on_accel and not pin_layout and len(layouts) > 1:
-        # the r3 capture pair settled the layout (NHWC 1829 vs NCHW
-        # 1689 img/s at b128) — don't re-prove it in the short window
-        pair = capture_pair("resnet_nhwc_b128", "resnet_nchw_b128")
+        # prefer the clean _SPL1 like-for-like pair (VERDICT r4 task 6:
+        # the r3 unpinned pair said NHWC 1829 vs NCHW 1689 img/s, but
+        # the dead NCHW stage's partial timing contradicted it in the
+        # same window — the layout question is only settled by the
+        # matched pair); fall back to the old unpinned pair until the
+        # clean one lands
+        pair = capture_pair("resnet_nhwc_b128_perleaf",
+                            "resnet_nchw_b128_perleaf") or \
+            capture_pair("resnet_nhwc_b128", "resnet_nchw_b128")
         if pair is not None:
             layouts = ["NHWC" if pair[0] >= pair[1] else "NCHW"]
             log(f"layout={layouts[0]} from captures "

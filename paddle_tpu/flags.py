@@ -138,11 +138,14 @@ define_flag("matmul_precision", "default",
             "highest. bf16 MXU passes use 'default'.")
 define_flag("use_pallas_kernels", True,
             "Route hot ops (attention, layer_norm, adam) through Pallas "
-            "kernels when on TPU (master switch; per-kernel flags below).")
+            "kernels when on TPU (master switch; per-kernel flags "
+            "below). [structural] The switch itself only enables "
+            "routing; each routed kernel carries its own evidence "
+            "class on its own flag.")
 define_flag("optimizer_fused_state", False,
             "Pack optimizer state (m/v/master) into flat fp32 vectors: "
             "one elementwise update over 3 buffers instead of 3 buffers "
-            "PER parameter (~600 for BERT-base). MEASURED A REGRESSION "
+            "PER parameter (~600 for BERT-base). [measured] A REGRESSION "
             "on real v5e (round 3): BERT-base b32xs512 97.1k tok/s "
             "per-leaf vs 77.1k fused (per-leaf +26%) — the in-graph pack/unpack "
             "slices cost more than the dispatch copies they save, and "
@@ -153,14 +156,17 @@ define_flag("optimizer_fused_state", False,
             "optimizers, incubate multi_tensor_apply.)")
 define_flag("optimizer_moment_dtype", "float32",
             "Storage dtype for Adam-family first/second moments "
-            "(float32 | bfloat16). bfloat16 halves optimizer-state HBM "
+            "(float32 | bfloat16). [assumed — conservative] fp32 is "
+            "the safe default; the bf16 win is a hypothesis whose "
+            "bert_b8_bf16mv capture stage is queued. bfloat16 halves "
+            "optimizer-state HBM "
             "traffic (~1.3 GB/step on BERT-base); update math still "
             "runs in fp32 and the fp32 master weights are unaffected, "
             "so the only loss is ~0.4% relative rounding on stored "
             "m/v. Read at optimizer init. (ref capability: "
             "multi_precision / master-weight family.)")
 define_flag("use_pallas_adam", False,
-            "Use the Pallas fused-adam kernel. Off by default: measured on "
+            "Use the Pallas fused-adam kernel. [measured] Off: on "
             "v5e the flatten/unflatten layout copies it forces on 2-D "
             "params cost more than the fusion saves (XLA fuses the "
             "elementwise adam chain itself; 34.4 vs 39.6 ms/step on "
@@ -168,23 +174,40 @@ define_flag("use_pallas_adam", False,
             "a 1-D flat buffer.")
 define_flag("use_pallas_layer_norm", True,
             "Use the Pallas layer_norm kernel (subject to the master "
-            "switch).")
-define_flag("fused_qkv_projection", True,
+            "switch). [assumed] Correctness is chip-verified "
+            "(VERIFY_TPU.json) but no A/B against XLA's fused LN has "
+            "ever been captured; kept on because the kernel is "
+            "correctness-proven and the XLA fallback is one flag away.")
+define_flag("fused_qkv_projection", False,
             "Compute self-attention q/k/v as one [d, 3d] matmul via "
             "trace-time weight concat (checkpoint layout unchanged). "
-            "A/B lever: round-2 chip measurement said -3% for the "
-            "separate-projections era; round-3 HLO shows fewer "
-            "dots/transposes — toggle per chip session.")
-define_flag("flash_attention_min_seq", 4096,
-            "Key-sequence length at or above which attention routes to the "
-            "Pallas flash kernel (below it XLA's fused attention is faster "
-            "on v5e; the flash kernel is always O(T) memory).")
-define_flag("flash_attention_min_seq_train", 0,
-            "Training-mode flash crossover (0 = use "
-            "flash_attention_min_seq). Separate because the XLA "
-            "attention backward materializes the [T, T] probs in fp32, "
-            "so flash typically wins earlier in training than in eval; "
-            "set from the bench.py flash_train capture table.")
+            "[measured] The only chip measurement (round 2) said -3%; "
+            "default follows it. The round-3 HLO count (fewer dots/"
+            "transposes) argued for on, but HLO structure has "
+            "mispredicted the chip twice (docs/performance.md), so the "
+            "default stays with the last measurement until the "
+            "bert_b8_perleaf_{qkv,noqkv} capture pair remeasures it.")
+define_flag("flash_attention_min_seq", 8192,
+            "Key-sequence length at or above which attention routes to "
+            "the Pallas flash kernel. [structural] The default is "
+            "MEMORY-motivated, not a speed claim: at 8k+ the XLA "
+            "path's [T, T] fp32 score tensors are HBM-scale by plain "
+            "arithmetic (B1 H12 T16k fp32 ≈ 12.9 GB on a 16 GB v5e), "
+            "so the O(T) kernel is routed for capacity. The old 4096 "
+            "SPEED crossover is retired — four rounds of tunnel "
+            "outages never measured it; set this lower only from a "
+            "measured bench.py flash_train table. Ring/Ulysses long-"
+            "context paths use the kernel directly, not via this gate.")
+define_flag("flash_attention_min_seq_train", 4096,
+            "Training-mode flash gate (0 = use "
+            "flash_attention_min_seq). [structural] Separate and LOWER "
+            "than the eval gate because the XLA attention backward "
+            "re-materializes the [B, H, T, T] probs in fp32: at BERT "
+            "geometry B8 H12 T4096 that is ~6.4 GB on a 16 GB v5e — "
+            "HBM-scale by arithmetic well below the eval gate. Like "
+            "the eval gate this is a memory bound, not a speed claim; "
+            "the speed crossover is unmeasured — set from the "
+            "bench.py flash_train capture table when it lands.")
 define_flag("flash_block_q", 0,
             "Flash kernel query-tile size (rows of the online-softmax "
             "block). 0 = the kernel module's built-in BLOCK_Q (256). "
@@ -196,7 +219,9 @@ define_flag("flash_block_k", 0,
             "lever, clamped like flash_block_q.")
 define_flag("transformer_remat", False,
             "Rematerialize each TransformerEncoder layer in the "
-            "backward (jax.checkpoint): ~1/3 more FLOPs for O(layers) "
+            "backward (jax.checkpoint). [assumed — conservative] Off "
+            "until the bert_b{32,64}_remat stages measure it: "
+            "~1/3 more FLOPs for O(layers) "
             "less activation HBM. A/B lever for large-batch training "
             "where XLA otherwise spills. (ref capability: "
             "recompute/checkpointing strategy, fleet "
@@ -205,13 +230,14 @@ define_flag("resnet_space_to_depth_stem", False,
             "Rewrite the ResNet 7x7/s2 stem conv as an exact 4x4/s1 "
             "conv over space-to-depth-folded 12-channel input (the "
             "MLPerf TPU trick: 3 input channels waste MXU lanes). NHWC "
-            "only; checkpoints unchanged. A/B candidate pending chip "
-            "measurement.")
+            "only; checkpoints unchanged. [assumed — conservative] Off "
+            "pending the resnet_nhwc_b128_s2d chip A/B.")
 define_flag("use_fast_rng", True,
             "On TPU, use the hardware RngBitGenerator PRNG ('rbg') for "
-            "jax.random keys instead of threefry. Dropout-heavy training "
-            "is ~1.5x faster; streams are still splittable/foldable but "
-            "not bit-identical to threefry.")
+            "jax.random keys instead of threefry. [assumed] The ~1.5x "
+            "dropout-heavy speedup is the public TPU-known result, not "
+            "a measurement from this repo; streams are still "
+            "splittable/foldable but not bit-identical to threefry.")
 define_flag("profile_dir", "",
             "If set, write xplane profiler traces under this directory.")
 define_flag("log_level", 0, "Framework VLOG level (0 = off).")

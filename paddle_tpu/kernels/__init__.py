@@ -69,13 +69,17 @@ def maybe_flash_attention(q, k, v, mask=None, scale: Optional[float] = None,
                           training: bool = False):
     """q/k/v: [B, H, T, D].
 
-    Routing measured on v5e: XLA's attention wins below the
-    flash_attention_min_seq crossover; the flash kernel wins beyond it
-    and, more importantly, keeps memory O(T) instead of materializing
-    the [T, T] scores, so long context doesn't OOM. Attention dropout
-    runs INSIDE the kernel (counter-based mask, same bits in the
-    recompute backward), so training models like BERT (head dim 64,
-    attn dropout 0.1) stay on the flash path at long sequence.
+    Routing: attention goes to the Pallas flash kernel only at
+    key-sequence lengths >= flash_attention_min_seq. The default gate
+    (8192) is memory-motivated — beyond it XLA's [T, T] scores are
+    HBM-scale by arithmetic — while the old 4096 SPEED crossover is
+    retired as never-measured; a measured flash_train table may set
+    the flag lower. Paths where O(T) memory is the whole point
+    (ring/Ulysses long context) route to the kernel directly, not
+    through this gate. Attention dropout runs INSIDE the kernel
+    (counter-based mask, same bits in the recompute backward), so
+    training models like BERT (head dim 64, attn dropout 0.1) stay
+    on the flash path when routed.
     """
     from ..ops.attention import scaled_dot_product_attention as ref_impl
     import jax.numpy as jnp

@@ -38,6 +38,15 @@ def _shard_map(fn, mesh, in_specs, out_specs):
 _NEG_INF = -1e30
 
 
+def _default_use_flash(head_dim: int) -> bool:
+    """One gate for both long-context paths (ring + Ulysses): the flash
+    kernel is the default local attention whenever Pallas can lower it
+    (TPU + lane-aligned head dim). O(T) memory is the point of these
+    paths, so the gate deliberately ignores flash_attention_min_seq."""
+    from ..kernels import pallas_enabled
+    return pallas_enabled() and head_dim % 8 == 0
+
+
 def _ring_attention_local(q, k, v, axis: str, causal: bool,
                           scale: Optional[float]):
     """Runs inside shard_map. q/k/v: [B, H, Tl, D] local shards."""
@@ -184,8 +193,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
     TPU when the pallas master switch is on. ``interpret`` runs the
     kernel under the Pallas interpreter (CPU tests)."""
     if use_flash is None:
-        from ..kernels import pallas_enabled
-        use_flash = pallas_enabled() and q.shape[-1] % 8 == 0
+        use_flash = _default_use_flash(q.shape[-1])
     spec = P(None, None, axis, None)
 
     def fn(q_, k_, v_):
@@ -199,7 +207,8 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
 
 
 def _ulysses_local(q, k, v, axis: str, causal: bool,
-                   scale: Optional[float]):
+                   scale: Optional[float], use_flash: bool,
+                   interpret: bool):
     """Inside shard_map: seq-sharded [B, H, Tl, D] → a2a to head-sharded
     [B, H/n, T, D] → local flash attention → a2a back."""
     n = lax.axis_size(axis)
@@ -216,24 +225,44 @@ def _ulysses_local(q, k, v, axis: str, causal: bool,
     qh = seq_to_head(q)
     kh = seq_to_head(k)
     vh = seq_to_head(v)
-    from ..kernels import maybe_flash_attention
-    out = maybe_flash_attention(qh, kh, vh, causal=causal, scale=scale)
+    # Route to the flash kernel DIRECTLY (same gate as ring_attention),
+    # not via maybe_flash_attention's min-seq gate: the gathered
+    # sequence here is the full T, so O(T) memory is the point of this
+    # path regardless of the measured speed crossover.
+    if use_flash:
+        from ..kernels.flash_attention import flash_attention
+        out = flash_attention(qh, kh, vh, causal=causal, scale=scale,
+                              interpret=interpret)
+    else:
+        from ..ops.attention import scaled_dot_product_attention
+        out = scaled_dot_product_attention(qh, kh, vh, causal=causal,
+                                           scale=scale)
     return head_to_seq(out)
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
                       causal: bool = False,
-                      scale: Optional[float] = None):
-    """Ulysses sequence parallelism; needs num_heads % mesh[axis] == 0."""
+                      scale: Optional[float] = None,
+                      use_flash: Optional[bool] = None,
+                      interpret: bool = False):
+    """Ulysses sequence parallelism; needs num_heads % mesh[axis] == 0.
+
+    ``use_flash``/``interpret`` mirror ring_attention: flash is the
+    default local attention whenever Pallas can lower it, and
+    ``interpret`` runs the kernel under the Pallas interpreter so the
+    flash branch is testable off-TPU."""
     n = mesh.shape[axis]
     if q.shape[1] % n != 0:
         raise ValueError(
             f"num_heads={q.shape[1]} not divisible by sp={n}; "
             "use ring_attention")
+    if use_flash is None:
+        use_flash = _default_use_flash(q.shape[-1])
     spec = P(None, None, axis, None)
 
     def fn(q_, k_, v_):
-        return _ulysses_local(q_, k_, v_, axis, causal, scale)
+        return _ulysses_local(q_, k_, v_, axis, causal, scale,
+                              use_flash, interpret)
 
     return _shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                       out_specs=spec)(q, k, v)

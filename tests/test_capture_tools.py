@@ -75,3 +75,11 @@ def test_resolve_plan_aliases(capture_all):
     assert "bert_b8_perleaf_noqkv" in r4[:3]
     assert all(s in capture_all.STAGES for s in r4)
     assert capture_all.resolve_plan(["flash"]) == ["flash"]
+    # round-5 triage: ResNet rollup first (VERDICT r4 task 1), the
+    # clean NCHW layout partner in the top stages (task 6), and every
+    # hand-typed name must resolve — a typo would otherwise only
+    # surface during a scarce tunnel window
+    r5 = capture_all.resolve_plan(["r5"])
+    assert r5[0] == "profile_resnet"
+    assert "resnet_nchw_b128_perleaf" in r5[:5]
+    assert all(s in capture_all.STAGES for s in r5)
