@@ -54,7 +54,7 @@ class TPUPlace(Place):
     device_type = "tpu"
 
     def jax_device(self):
-        for platform in ("tpu", "axon"):
+        for platform in ACCEL_PLATFORMS:
             try:
                 devs = jax.devices(platform)
                 if devs:
@@ -69,9 +69,28 @@ CUDAPlace = TPUPlace
 XPUPlace = TPUPlace
 
 
+# THE canonical accelerator-platform list. On this runtime the chip
+# registers as 'tpu' or (tunneled) 'axon'; every accel check in the
+# package, bench, and tools imports this tuple — a new platform name
+# is added HERE, once.
+ACCEL_PLATFORMS = ("tpu", "axon")
+
+
 @functools.lru_cache(maxsize=None)
 def _accelerator_available() -> bool:
-    return any(d.platform in ("tpu", "axon") for d in jax.devices())
+    return any(d.platform in ACCEL_PLATFORMS for d in jax.devices())
+
+
+def accelerator_available() -> bool:
+    """THE public accelerator predicate (initializes the backend; use
+    accelerator_configured() where a wedged tunnel must not block).
+    Every in-package/bench/tool accel check calls this so platform
+    semantics live in one place. False (not an exception) when backend
+    init fails."""
+    try:
+        return _accelerator_available()
+    except Exception:  # noqa: BLE001
+        return False
 
 
 def accelerator_configured() -> bool:
@@ -95,7 +114,7 @@ def accelerator_configured() -> bool:
     except Exception:  # noqa: BLE001
         pass
     return any(p in plats.lower()
-               for p in ("tpu", "axon", "cuda", "gpu"))
+               for p in ACCEL_PLATFORMS + ("cuda", "gpu"))
 
 
 def is_compiled_with_tpu() -> bool:
@@ -118,7 +137,7 @@ def set_device(device: Union[str, Place]) -> Place:
     else:
         name, _, idx = device.partition(":")
         idx = int(idx) if idx else 0
-        if name in ("tpu", "gpu", "cuda", "xpu", "axon"):
+        if name in ACCEL_PLATFORMS + ("gpu", "cuda", "xpu"):
             _current_place = TPUPlace(idx)
         elif name == "cpu":
             _current_place = CPUPlace(idx)

@@ -46,7 +46,8 @@ def _configure_fast_rng_once() -> None:
                 backend = jax.default_backend()
             except Exception:
                 return  # backend unavailable — retry on next key creation
-            if backend in ("tpu", "axon"):
+            from .place import ACCEL_PLATFORMS
+            if backend in ACCEL_PLATFORMS:
                 jax.config.update("jax_default_prng_impl", "rbg")
         _fast_rng_configured = True
 

@@ -196,8 +196,11 @@ define_flag("flash_attention_min_seq", 8192,
             "so the O(T) kernel is routed for capacity. The old 4096 "
             "SPEED crossover is retired — four rounds of tunnel "
             "outages never measured it; set this lower only from a "
-            "measured bench.py flash_train table. Ring/Ulysses long-"
-            "context paths use the kernel directly, not via this gate.")
+            "measured bench.py flash/flash_train table. Narrow head "
+            "dims (d%8) keep a separate fixed 8192 eval floor "
+            "(kernels._NARROW_HEAD_EVAL_MIN_SEQ) this flag does not "
+            "move. Ring/Ulysses long-context paths use the kernel "
+            "directly, not via this gate.")
 define_flag("flash_attention_min_seq_train", 4096,
             "Training-mode flash gate (0 = use "
             "flash_attention_min_seq). [structural] Separate and LOWER "

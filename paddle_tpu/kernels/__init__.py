@@ -20,15 +20,24 @@ from ..flags import GLOBAL_FLAGS
 
 
 def _on_tpu() -> bool:
+    from ..core.place import ACCEL_PLATFORMS
     try:
         platform = jax.default_backend()
     except Exception:
         return False
-    return platform in ("tpu", "axon")
+    return platform in ACCEL_PLATFORMS
 
 
 def pallas_enabled() -> bool:
     return GLOBAL_FLAGS.get("use_pallas_kernels") and _on_tpu()
+
+
+# Memory bound for routing NARROW head dims (d%8, not d%128) to flash
+# in EVAL mode: at 8k+ the [T, T] fwd scores alone are HBM-scale. A
+# fixed constant, not the flash_attention_min_seq flag — that flag may
+# be lowered from a measured d=128 table, which is no evidence about
+# narrow-head eval.
+_NARROW_HEAD_EVAL_MIN_SEQ = 8192
 
 
 def maybe_layer_norm(x, weight, bias, epsilon: float, begin_norm_axis: int):
@@ -85,12 +94,16 @@ def maybe_flash_attention(q, k, v, mask=None, scale: Optional[float] = None,
     import jax.numpy as jnp
 
     d = q.shape[-1]
-    # d%128 keeps MXU lanes full (measured routing). Narrower head dims
-    # (BERT's 64) route only where flash's O(T) memory is the point:
-    # training (the XLA backward materializes [T,T] probs in fp32) or
-    # eval at lengths where the fwd scores alone are HBM-scale.
-    d_ok = d % 128 == 0 or (d % 8 == 0
-                            and (training or k.shape[2] >= 8192))
+    # d%128 keeps MXU lanes full. Narrower head dims (BERT's 64) route
+    # only where flash's O(T) memory is the point: training (the XLA
+    # backward materializes [T,T] probs in fp32) or eval at lengths
+    # where the fwd scores alone are HBM-scale. The eval floor below is
+    # deliberately NOT the flash_attention_min_seq flag: lowering that
+    # flag from a measured d=128 `flash` table says nothing about
+    # narrow-head eval (no capture stage measures it), so the memory
+    # bound stays fixed.
+    d_ok = d % 128 == 0 or (d % 8 == 0 and (
+        training or k.shape[2] >= _NARROW_HEAD_EVAL_MIN_SEQ))
     # key-padding masks [B, 1, 1, Tk] (the exact shape BertModel/
     # variable-length batches produce) run INSIDE the kernel as an
     # additive key bias; broadcastable or richer mask shapes fall back

@@ -167,7 +167,8 @@ class LocalSGDStep:
         # (broadcast masks, tables, scalars) go replicated — the same
         # split ShardedTrainStep._place_batch makes
         sh_kwargs, rep_kwargs = split_kwargs_by_shardable(
-            kwargs, leading_batch_size(args, labels))
+            kwargs, leading_batch_size(args, labels),
+            note=self.mesh.shape[self.axis] > 1)
         batch = {"args": args, "labels": as_label_tuple(labels),
                  "kwargs": sh_kwargs}
         lr = host_lr_of(self.optimizer) if self._host_lr_active else 0.0

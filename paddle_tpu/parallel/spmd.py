@@ -65,14 +65,54 @@ def inject_host_lr(batch: Dict[str, Any], optimizer) -> Dict[str, Any]:
     return batch
 
 
+_shardable_warned: set = set()
+_note_counts: Dict[str, int] = {}
+_MAX_NOTES_PER_NAME = 2
+
+
+def _note_auto_shard(name: str, shape, rule: str) -> None:
+    """One-time-per-(name, shape) visibility for the silent convention
+    that classifies a model-forward KWARG as per-sample data — keyed on
+    the shape too so a later model whose same-named kwarg is a
+    different (possibly coincident) tensor still gets noticed, but
+    capped per name so a variable-length kwarg (a new shape per
+    sequence bucket) cannot spam the log or grow the set unboundedly.
+    The classification cannot be inspected, only assumed — a replicated
+    table/mask whose dims merely coincide would be sharded wrong with
+    no diagnostic — so the first time each kwarg name is classified,
+    say so. Emitted through logging (printed by logging's last-resort
+    handler even unconfigured) rather than warnings.warn, so correct
+    per-sample kwargs — the common case — don't explode under
+    warnings-as-errors test setups."""
+    key = (name, tuple(shape))
+    if key in _shardable_warned \
+            or _note_counts.get(name, 0) >= _MAX_NOTES_PER_NAME:
+        return
+    _shardable_warned.add(key)
+    _note_counts[name] = _note_counts.get(name, 0) + 1
+    import logging
+    logging.getLogger("paddle_tpu.parallel").warning(
+        "model-forward kwarg '%s' (shape %s) auto-classified as "
+        "per-sample data (%s); it will be batch-sharded/micro-sliced. "
+        "If it is actually replicated (a table/mask whose dims "
+        "coincide), give it a non-batch leading dim, e.g. reshape to "
+        "[1, ...].", name, tuple(shape), rule)
+
+
 def split_kwargs_by_shardable(kwargs: Dict[str, Any],
-                              batch_size: Optional[int]):
+                              batch_size: Optional[int],
+                              note: bool = True):
     """Partition model-forward kwargs into (dp-shardable, replicated):
     a leaf whose leading dim EQUALS the batch size is per-sample data
     and rides the sharded batch tree; everything else (broadcast
     masks, tables, scalars) is replicated — the shard_map analogue of
     ShardedTrainStep's _place_batch placement, using the same
-    leading-dim convention the grad-accum micro-slicer documents."""
+    leading-dim convention the grad-accum micro-slicer documents.
+    Every auto-classification is surfaced once per kwarg name
+    (_note_auto_shard) so a coincidental match is visible; callers on
+    a trivial (size-1) mesh pass note=False — sharding is a no-op
+    there, so the notice would be misleading noise (same gate as
+    _place_batch's _batch_spec_nontrivial)."""
     sh, rep = {}, {}
     for n, v in kwargs.items():
         nd = getattr(v, "ndim", None)
@@ -81,7 +121,11 @@ def split_kwargs_by_shardable(kwargs: Dict[str, Any],
             import numpy as _np
             v = _np.asarray(v)
             nd, shp = v.ndim, v.shape
-        if batch_size is not None and nd and shp                 and shp[0] == batch_size:
+        if (batch_size is not None and nd and shp
+                and shp[0] == batch_size):
+            if note:
+                _note_auto_shard(n, shp, "leading dim equals the "
+                                         f"batch size {batch_size}")
             sh[n] = v
         else:
             rep[n] = v
@@ -249,6 +293,9 @@ class ShardedTrainStep:
             out_shardings=(state_shardings, None),
             donate_argnums=(0,))
         self._replicated_sharding = NamedSharding(mesh, P())
+        # invariant for the life of the step object (mesh + batch_spec
+        # are fixed here); used on the per-step path by _place_batch
+        self._note_kwargs = self._batch_spec_nontrivial()
 
     def _leaf_shardable(self, x) -> bool:
         spec = tuple(self.batch_spec)
@@ -267,9 +314,32 @@ class ShardedTrainStep:
                 return False
         return True
 
+    def _batch_spec_nontrivial(self) -> bool:
+        """True when the batch sharding actually splits something: on a
+        mesh whose batch-spec axes all have size 1, _leaf_shardable is
+        vacuously True for every leaf and 'sharding' is a no-op, so the
+        coincidence notice would be pure noise there."""
+        sizes = self.mesh.shape
+        for entry in tuple(self.batch_spec):
+            if entry is None:
+                continue
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            if int(np.prod([sizes[a] for a in axes])) > 1:
+                return True
+        return False
+
     def _place_batch(self, batch):
-        def put(x):
-            dst = (self.batch_sharding if self._leaf_shardable(x)
+        note = self._note_kwargs
+
+        def put(x, kwarg_name=None):
+            shardable = self._leaf_shardable(x)
+            if shardable and kwarg_name is not None and note:
+                # args/labels are per-sample by contract; a KWARG that
+                # happens to satisfy the divisibility rule is the
+                # silent-coincidence hazard — surface it once
+                _note_auto_shard(kwarg_name, getattr(x, "shape", ()),
+                                 "dims divisible by the batch spec")
+            dst = (self.batch_sharding if shardable
                    else self._replicated_sharding)
             if not dst.is_fully_addressable and not isinstance(x, jax.Array):
                 # A host array here would be each process's LOCAL batch
@@ -281,6 +351,15 @@ class ShardedTrainStep:
                     "local_data(sharding, local_batch, global_shape)); "
                     f"got {type(x).__name__} for sharding {dst}")
             return _global_put(jnp.asarray(x), dst)
+
+        kwargs = batch.get("kwargs") if isinstance(batch, dict) else None
+        if kwargs:
+            placed = jax.tree.map(
+                put, {k: v for k, v in batch.items() if k != "kwargs"})
+            placed["kwargs"] = {
+                n: jax.tree.map(lambda x, n=n: put(x, kwarg_name=n), v)
+                for n, v in kwargs.items()}
+            return placed
         return jax.tree.map(put, batch)
 
     def extra_state(self):

@@ -351,7 +351,8 @@ def run_verification(artifact_path: str | None = None) -> dict:
                 "backend mismatch")
 
     backend = jax.default_backend()
-    on_accel = any(d.platform in ("tpu", "axon") for d in jax.devices())
+    from .core.place import accelerator_available
+    on_accel = accelerator_available()
     _log(f"backend={backend} on_accel={on_accel}")
     t0 = time.time()
     kernel_failures = validate_kernels_on_tpu() if on_accel else \

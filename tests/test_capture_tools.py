@@ -83,3 +83,63 @@ def test_resolve_plan_aliases(capture_all):
     assert r5[0] == "profile_resnet"
     assert "resnet_nchw_b128_perleaf" in r5[:5]
     assert all(s in capture_all.STAGES for s in r5)
+
+
+@pytest.fixture
+def bench_mod():
+    sys.path.insert(0, os.path.abspath(ROOT))
+    import bench
+    return bench
+
+
+def test_emit_partial_cpu_goes_to_separate_path(bench_mod, monkeypatch,
+                                                tmp_path):
+    """A non-accelerator best-so-far must never occupy
+    BENCH_partial.json (VERDICT r4 task 7: a resident CPU datum in the
+    TPU-facing artifact invites a wrong read in a hurried window)."""
+    accel = tmp_path / "BENCH_partial.json"
+    cpu = tmp_path / "BENCH_partial_cpu.json"
+    monkeypatch.setattr(bench_mod, "_PARTIAL_PATH", str(accel))
+    monkeypatch.setattr(bench_mod, "_PARTIAL_CPU_PATH", str(cpu))
+    # pin the backend probe: the suite usually runs on CPU, but this
+    # file may also run on the v5e host during a tunnel window
+    monkeypatch.setattr(bench_mod, "_on_accel_backend", lambda: False)
+    bench_mod.emit_partial({"metric": "m", "value": 1.0, "unit": "u",
+                            "vs_baseline": 0.0})
+    assert not accel.exists()
+    with open(cpu) as f:
+        d = json.load(f)
+    assert d["partial"] is True and d["value"] == 1.0
+    # accelerator backends keep the primary path
+    monkeypatch.setattr(bench_mod, "_on_accel_backend", lambda: True)
+    bench_mod.emit_partial({"metric": "m", "value": 2.0, "unit": "u",
+                            "vs_baseline": 0.0})
+    with open(accel) as f:
+        assert json.load(f)["value"] == 2.0
+
+
+def test_capture_value_logs_partial_provenance(bench_mod, capsys):
+    """Pins decided from a timed-out stage's preserved best-so-far must
+    carry that provenance in the log (ADVICE r4)."""
+    stage = "selftest_provenance"
+    path = os.path.join(os.path.abspath(ROOT), f"CAPTURE_{stage}.json")
+    with open(path, "w") as f:
+        json.dump({"ok": True,
+                   "parsed": {"value": 41.5, "vs_baseline": 0.2,
+                              "partial": True}}, f)
+    try:
+        bench_mod._capture_cache.clear()
+        bench_mod._partial_logged.discard(stage)
+        v = bench_mod.capture_value(stage, any_device=True)
+        assert v == 41.5
+        assert "PARTIAL artifact" in capsys.readouterr().err
+        # once per stage: further fields of the same artifact (the
+        # recommend.py pattern) must not re-log the caveat
+        bench_mod.capture_value(stage, any_device=True,
+                                field="vs_baseline")
+        assert "PARTIAL" not in capsys.readouterr().err
+        assert bench_mod.capture_value(stage, any_device=True) == 41.5
+    finally:
+        os.unlink(path)
+        bench_mod._capture_cache.clear()
+        bench_mod._partial_logged.discard(stage)

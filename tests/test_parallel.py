@@ -296,3 +296,40 @@ def test_all_compiled_steps_forward_kwargs():
     l0 = float(cstep(x, labels=(y,), mask=mask)["loss"])
     l1 = float(cstep(x, labels=(y,), mask=mask)["loss"])
     assert l1 < l0
+
+
+def test_split_kwargs_notes_auto_shardable(caplog):
+    """The leading-dim==batch convention silently shards a replicated
+    table that coincidentally matches — every auto-classification is
+    surfaced once per kwarg name so the coincidence is visible
+    (ADVICE r4). Via logging, not warnings.warn: correct per-sample
+    kwargs are the common case and must not explode under
+    warnings-as-errors pytest setups."""
+    import logging as _logging
+
+    from paddle_tpu.parallel.spmd import (_note_counts,
+                                          _shardable_warned,
+                                          split_kwargs_by_shardable)
+
+    _shardable_warned.discard(("selftest_coincident", (4, 3)))
+    _note_counts.pop("selftest_coincident", None)
+    kw = {"selftest_coincident": np.ones((4, 3), np.float32),
+          "bcast": np.ones((1, 3), np.float32)}
+    with caplog.at_level(_logging.WARNING, logger="paddle_tpu.parallel"):
+        sh, rep = split_kwargs_by_shardable(kw, 4)
+    assert set(sh) == {"selftest_coincident"} and set(rep) == {"bcast"}
+    assert any("selftest_coincident" in r.getMessage()
+               for r in caplog.records)
+    # one-time per name: a second call stays quiet
+    caplog.clear()
+    with caplog.at_level(_logging.WARNING, logger="paddle_tpu.parallel"):
+        sh2, _ = split_kwargs_by_shardable(kw, 4)
+    assert set(sh2) == {"selftest_coincident"} and not caplog.records
+    # per-name cap: a variable-length kwarg (new shape per bucket) must
+    # not spam the log — after the cap, further shapes stay quiet
+    caplog.clear()
+    with caplog.at_level(_logging.WARNING, logger="paddle_tpu.parallel"):
+        for t in (5, 6, 7):
+            split_kwargs_by_shardable(
+                {"selftest_coincident": np.ones((4, t), np.float32)}, 4)
+    assert len(caplog.records) <= 1

@@ -194,7 +194,8 @@ R5_PLAN = ["profile_resnet",
            "bert_b64_remat",
            "flash",
            "flash_train_t128", "flash_train_t512",
-           "profile_bert_b32", "profile_bert"]
+           "profile_bert_b32", "profile_bert",
+           "bert", "resnet"]
 
 
 def log(msg: str) -> None:

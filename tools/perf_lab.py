@@ -75,7 +75,8 @@ def exp_fused():
     os.environ["PT_BENCH_FUSED"] = ""
     sys.path.insert(0, _repo_root())
     import bench
-    on_accel = any(d.platform in ("tpu", "axon") for d in jax.devices())
+    from paddle_tpu.core.place import accelerator_available
+    on_accel = accelerator_available()
     if not on_accel:
         log("no accelerator: running the tiny CPU shape (numbers only "
             "meaningful on a real chip)")

@@ -143,8 +143,8 @@ def main() -> None:
         print("[profile] backend unreachable; aborting (rc=3)",
               file=sys.stderr)
         sys.exit(3)
-    import jax
-    if not any(d.platform in ("tpu", "axon") for d in jax.devices()):
+    from paddle_tpu.core.place import accelerator_available
+    if not accelerator_available():
         print("[profile] no accelerator device (CPU fallback would "
               "record a host-only trace); aborting", file=sys.stderr)
         sys.exit(3)

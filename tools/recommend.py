@@ -36,6 +36,13 @@ def tok(stage):
 def main() -> None:
     rows = []
 
+    def partial_tag(*stages):
+        """' [PARTIAL: x,y]' when any stage's artifact is a timed-out
+        best-so-far — EVERY row carries the provenance caveat, not
+        just the flash ones."""
+        p = [s for s in stages if load_field(s, "partial")]
+        return f" [PARTIAL artifact: {', '.join(p)}]" if p else ""
+
     def compare(name, a_stage, b_stage, a_label, b_label,
                 implies_fmt, field="value"):
         a = load_field(a_stage, field)
@@ -50,7 +57,8 @@ def main() -> None:
         fmt = ".0f" if field == "value" else ".3f"
         rows.append((name, f"{win} wins {ratio:.2f}x "
                      f"({a_label}={a:{fmt}} vs {b_label}={b_:{fmt}}"
-                     f"{'' if field == 'value' else ' ' + field})",
+                     f"{'' if field == 'value' else ' ' + field})"
+                     f"{partial_tag(a_stage, b_stage)}",
                      implies_fmt.format(win=win)))
         return win
 
@@ -64,7 +72,9 @@ def main() -> None:
     if all(v is not None for v in vals.values()):
         order = sorted(vals, key=lambda b: -vals[b])
         rows.append(("BERT batch order (per-leaf, noqkv)",
-                     " > ".join(f"b{b}={vals[b]:.0f}" for b in order),
+                     " > ".join(f"b{b}={vals[b]:.0f}" for b in order)
+                     + partial_tag(*(f"bert_b{b}_perleaf_noqkv"
+                                     for b in order)),
                      f"bench batch_opts = {order}"))
     else:
         rows.append(("BERT batch order",
@@ -76,19 +86,25 @@ def main() -> None:
     if b32 is not None and r32 is not None:
         rows.append(("transformer_remat (b32)",
                      f"{'remat' if r32 > b32 else 'no-remat'} wins "
-                     f"({r32:.0f} vs {b32:.0f})",
+                     f"({r32:.0f} vs {b32:.0f})"
+                     + partial_tag("bert_b32_remat",
+                                   "bert_b32_perleaf_noqkv"),
                      f"flags.transformer_remat default = {r32 > b32}"))
     r64 = tok("bert_b64_remat")
     if r64 is not None:
         rows.append(("remat-enabled b64",
-                     f"{r64:.0f} tok/s", "larger-batch headroom check"))
+                     f"{r64:.0f} tok/s"
+                     + partial_tag("bert_b64_remat"),
+                     "larger-batch headroom check"))
     # bf16 moments
     b8 = tok("bert_b8_perleaf_noqkv")
     mv = tok("bert_b8_bf16mv")
     if b8 is not None and mv is not None:
         rows.append(("optimizer_moment_dtype bf16 (b8)",
                      f"{'bf16' if mv > b8 else 'fp32'} wins "
-                     f"({mv:.0f} vs {b8:.0f})",
+                     f"({mv:.0f} vs {b8:.0f})"
+                     + partial_tag("bert_b8_bf16mv",
+                                   "bert_b8_perleaf_noqkv"),
                      "flags.optimizer_moment_dtype default = "
                      f"{'bfloat16' if mv > b8 else 'float32'}"))
     # resnet
@@ -101,7 +117,9 @@ def main() -> None:
     r128 = tok("resnet_nhwc_b128_perleaf")
     if r256 is not None and r128 is not None:
         rows.append(("ResNet batch 256 vs 128 (img/s)",
-                     f"b256={r256:.0f} vs b128={r128:.0f}",
+                     f"b256={r256:.0f} vs b128={r128:.0f}"
+                     + partial_tag("resnet_nhwc_b256_perleaf",
+                                   "resnet_nhwc_b128_perleaf"),
                      "bench batches order"))
     # masked-LM head restriction (reference mask_pos parity) — judged
     # by vs_baseline: masked mode's honest FLOP accounting means
@@ -112,15 +130,28 @@ def main() -> None:
                 "masked", "full",
                 "bench masked_for auto-pin uses this pair",
                 field="vs_baseline")
-    # flash crossover: report the stage's speedup metrics
+    # flash crossover: report the stage's speedup AT THE SEQ THE
+    # ARTIFACT ACTUALLY RECORDS — a timed-out stage's last line is the
+    # speedup at whatever seq last completed, not the top of the sweep,
+    # so the metric/seq come from the parsed line instead of being
+    # assumed
     for st in ("flash", "flash_train", "flash_train_t128",
                "flash_train_t512"):
         v = load(st)
         if v is not None:
-            rows.append((f"{st} speedup at top seq", f"{v}x",
-                         "flash_attention_min_seq (and flash_block_q/k "
-                         "for the tile stages) from the per-seq stderr "
-                         "table in the capture artifact"))
+            seq = load_field(st, "seq")
+            metric = load_field(st, "metric") or st
+            partial = load_field(st, "partial")
+            # older artifacts embed the seq only in the metric string;
+            # don't print it twice when both carry it
+            at = f" @seq{seq}" if (seq is not None
+                                   and f"@seq{seq}" not in metric) else ""
+            note = " [PARTIAL artifact]" if partial else ""
+            rows.append((f"{st} speedup", f"{v}x{at}{note} ({metric})",
+                         "flash_attention_min_seq/_train (and "
+                         "flash_block_q/k for the tile stages) from "
+                         "the per-seq stderr table in the capture "
+                         "artifact"))
         else:
             rows.append((f"{st}", "PENDING", ""))
 

@@ -29,6 +29,13 @@ LOG = os.path.join(ROOT, "tools", "tunnel_watch.log")
 sys.path.insert(0, os.path.join(ROOT, "tools"))
 from capture_all import DEFAULT_PLAN, STAGES, resolve_plan  # noqa: E402
 
+# Deliberately NOT imported from paddle_tpu.core.place (the canonical
+# copy): the watcher's whole design is that jax/PJRT/framework code
+# runs only inside hard-timeout subprocesses, so a broken framework
+# import can never wedge the watcher itself. Keep in sync with
+# paddle_tpu.core.place.ACCEL_PLATFORMS.
+ACCEL_PLATFORMS = ("tpu", "axon")
+
 # a stage that fails deterministically (e.g. a pinned batch that OOMs)
 # must not burn its full chip-time budget forever — give up after this
 # many campaign attempts that included it
@@ -106,7 +113,7 @@ def main() -> None:
                 f"given up: {sorted(set(wanted) - set(done))}); exiting")
             sys.exit(0 if len(done) == len(wanted) else 1)
         backend = probe()
-        if backend in ("tpu", "axon"):
+        if backend in ACCEL_PLATFORMS:
             log(f"probe {n}: backend={backend} — tunnel UP; "
                 f"capturing {todo}")
             r = subprocess.run(
