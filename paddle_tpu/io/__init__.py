@@ -35,6 +35,15 @@ def _flatten(state) -> Dict[str, np.ndarray]:
     leaves_with_path = jax.tree_util.tree_flatten_with_path(state)[0]
     for path, leaf in leaves_with_path:
         key = "/".join(_path_str(p) for p in path)
+        if getattr(leaf, "is_deleted", None) and leaf.is_deleted():
+            # the layer's arrays were donated to a jitted train step;
+            # without this the user sees jax's bare "Array has been
+            # deleted" with no hint at the fix
+            raise ValueError(
+                f"cannot save {key!r}: its buffer was donated to a "
+                "train step (in-place HBM update). Call the step's "
+                ".sync_to_model() first to write the trained values "
+                "back into the layer, then save.")
         flat[key] = np.asarray(leaf)
     return flat
 
@@ -145,7 +154,18 @@ class AsyncCheckpointer:
 
     def save(self, state: Any, step: int) -> None:
         self.wait()
-        # materialize on host before handing to the thread
+        # materialize on host before handing to the thread; _flatten's
+        # donated-buffer guard (with its sync_to_model() hint) runs too
+        # late for this path, so check here before np.asarray can raise
+        # jax's bare "Array has been deleted"
+        for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+            if getattr(leaf, "is_deleted", None) and leaf.is_deleted():
+                key = "/".join(_path_str(p) for p in path)
+                raise ValueError(
+                    f"cannot checkpoint {key!r}: its buffer was donated "
+                    "to a train step (in-place HBM update). Call the "
+                    "step's .sync_to_model() first, or checkpoint "
+                    "step.state directly.")
         host_state = jax.tree.map(np.asarray, state)
 
         def work():

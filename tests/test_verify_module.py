@@ -61,3 +61,16 @@ def test_run_verification_writes_canonical_artifact(tmp_path,
         d = json.load(f)
     assert d["ok"] == res["ok"]
     assert "kernel_hash" in d and "device" in d
+
+
+def test_platform_commit_alias():
+    # the axon tunnel plugin commits a backend named "tpu"; requesting
+    # JAX_PLATFORMS=axon must not be reported as a mismatch (round-5
+    # chip-window regression: verify bailed while bench ran fine)
+    from paddle_tpu.verify import _platform_commit_ok
+
+    assert _platform_commit_ok("tpu", "tpu")
+    assert _platform_commit_ok("axon", "tpu")
+    assert _platform_commit_ok("axon", "axon")
+    assert not _platform_commit_ok("axon", "cpu")
+    assert not _platform_commit_ok("cpu", "tpu")

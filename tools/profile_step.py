@@ -81,56 +81,68 @@ def aggregate(outdir: str) -> None:
     with gzip.open(traces[-1], "rt") as f:
         data = json.load(f)
     events = data.get("traceEvents", [])
-    # keep only TPU-side complete events (device op lanes), not host
+    # The device process exposes three lanes (Steps / XLA Modules /
+    # XLA Ops); the first two are aggregates of the third, so summing
+    # every device event double-counts the whole step (the round-4
+    # rollup did exactly that and mis-ranked BN reductions over conv).
+    # Keep ONLY the "XLA Ops" lane and trust its hlo_category metadata
+    # over name-substring guessing (fusion names hide the conv inside).
     pid_names = {e.get("pid"): e.get("args", {}).get("name", "")
                  for e in events if e.get("ph") == "M"
                  and e.get("name") == "process_name"}
     device_pids = {p for p, n in pid_names.items()
                    if "TPU" in n or "tpu" in n or "/device" in n.lower()
                    or "XLA" in n}
+    op_tids = {(e.get("pid"), e.get("tid"))
+               for e in events if e.get("ph") == "M"
+               and e.get("name") == "thread_name"
+               and e.get("args", {}).get("name") == "XLA Ops"}
+    if not op_tids:
+        # without lane metadata the filter below would silently revert
+        # to summing Steps + Modules + Ops (the double-count this
+        # rewrite removed) — refuse to print authoritative-looking
+        # numbers instead
+        print("trace has no 'XLA Ops' thread_name metadata; cannot "
+              "aggregate reliably (profiler version mismatch?)",
+              file=sys.stderr)
+        sys.exit(2)
     durs: dict = defaultdict(float)
     counts: dict = defaultdict(int)
+    cats: dict = defaultdict(float)
     total = 0.0
     for e in events:
         if e.get("ph") != "X":
             continue
         if device_pids and e.get("pid") not in device_pids:
             continue
+        if (e.get("pid"), e.get("tid")) not in op_tids:
+            continue
         name = e.get("name", "?")
         d = float(e.get("dur", 0.0))
         durs[name] += d
         counts[name] += 1
+        cats[e.get("args", {}).get("hlo_category", "?")] += d
         total += d
-
-    def category(name: str) -> str:
-        n = name.lower()
-        if "conv" in n:
-            return "conv"
-        if "dot" in n or "matmul" in n:
-            return "matmul/fusion"
-        if "copy" in n:
-            return "copy"
-        if "transpose" in n:
-            return "transpose"
-        if any(k in n for k in ("fused", "fusion", "loop", "add",
-                                "mul", "sub", "div", "select")):
-            return "elementwise/fusion"
-        if any(k in n for k in ("reduce", "scatter", "gather",
-                                "dynamic", "slice", "iota", "rng",
-                                "convert", "broadcast")):
-            return "data-movement/reduce"
-        return "other"
-
-    cats: dict = defaultdict(float)
-    for name, d in durs.items():
-        cats[category(name)] += d
-    print(f"\n== device op time rollup (total {total / 1e3:.2f} ms over "
-          f"trace) ==")
+    # per-step divisor: one event per step on the "XLA Modules" lane
+    mod_tids = {(e.get("pid"), e.get("tid"))
+                for e in events if e.get("ph") == "M"
+                and e.get("name") == "thread_name"
+                and e.get("args", {}).get("name") == "XLA Modules"}
+    steps = sum(1 for e in events if e.get("ph") == "X"
+                and (e.get("pid"), e.get("tid")) in mod_tids)
+    if not steps:
+        print("warning: no 'XLA Modules' step events; reporting "
+              "whole-trace totals as one step", file=sys.stderr)
+        steps = 1
+    print(f"\n== device op time rollup (total {total / 1e3:.2f} ms, "
+          f"{steps} steps, {total / steps / 1e3:.2f} ms/step) ==")
     for c, d in sorted(cats.items(), key=lambda kv: -kv[1]):
-        print(f"  {c:24s} {d / 1e3:9.2f} ms  {d / total * 100:5.1f}%")
+        print(f"  {c:24s} {d / steps / 1e3:9.3f} ms/step "
+              f"{d / total * 100:5.1f}%")
     print("\n== top 30 ops by total duration ==")
     for name, d in sorted(durs.items(), key=lambda kv: -kv[1])[:30]:
-        print(f"  {d / 1e3:9.2f} ms  x{counts[name]:<5d} {name[:100]}")
+        print(f"  {d / steps / 1e3:9.3f} ms/step x{counts[name]:<5d}"
+              f" {name[:100]}")
 
 
 def main() -> None:
