@@ -235,6 +235,15 @@ define_flag("resnet_space_to_depth_stem", False,
             "MLPerf TPU trick: 3 input channels waste MXU lanes). NHWC "
             "only; checkpoints unchanged. [assumed — conservative] Off "
             "pending the resnet_nhwc_b128_s2d chip A/B.")
+define_flag("batch_norm_single_pass", False,
+            "Compute training-mode BatchNorm statistics as "
+            "E[x^2]-E[x]^2 with fp32 accumulation (sibling reductions "
+            "XLA fuses into ONE read of the activation) instead of "
+            "jnp.mean followed by the data-dependent jnp.var pass. "
+            "[assumed — conservative] Off pending the "
+            "resnet_bn1pass chip A/B; the r5 profile puts ResNet loop "
+            "fusions (BN stats + residual adds) at 10.7 ms of the "
+            "53 ms step.")
 define_flag("use_fast_rng", True,
             "On TPU, use the hardware RngBitGenerator PRNG ('rbg') for "
             "jax.random keys instead of threefry. [assumed] The ~1.5x "

@@ -375,8 +375,23 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         axes = tuple(range(x.ndim - 1))
         shape = (1,) * (x.ndim - 1) + (-1,)
     if training:
-        mean = jnp.mean(x, axis=axes)
-        var = jnp.var(x, axis=axes)
+        if GLOBAL_FLAGS.get("batch_norm_single_pass"):
+            # E[x^2]-E[x]^2 with fp32 accumulation: the two reductions
+            # read the same operand so XLA's multi-output fusion makes
+            # them ONE pass over the activation, where mean-then-var is
+            # two data-dependent passes (r5 ResNet profile: BN-stat
+            # loop fusions are ~1/5 of the step). Cancellation is
+            # bounded by fp32 accumulation + the clamp; BN inputs are
+            # ~unit-scale so the classic failure mode doesn't apply.
+            xf = x.astype(jnp.float32)
+            mean32 = jnp.mean(xf, axis=axes)
+            mean_sq = jnp.mean(jnp.square(xf), axis=axes)
+            var32 = jnp.maximum(mean_sq - jnp.square(mean32), 0.0)
+            mean = mean32.astype(x.dtype)
+            var = var32.astype(x.dtype)
+        else:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
         n = x.size // x.shape[1 if data_format.startswith("NC") else -1]
         unbiased = var * n / builtins.max(n - 1, 1)
         new_mean = momentum * running_mean + (1 - momentum) * mean

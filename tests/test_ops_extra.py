@@ -621,3 +621,43 @@ def test_attention_bthd_matches_bhtd():
         np.testing.assert_allclose(np.asarray(jnp.moveaxis(ref, 1, 2)),
                                    np.asarray(got), rtol=1e-5,
                                    atol=1e-6)
+
+
+def test_batch_norm_single_pass_parity():
+    """FLAGS_batch_norm_single_pass must match the two-pass stats (it
+    only changes how XLA schedules the reductions) — fwd outputs,
+    running stats, and grads."""
+    import numpy as np
+
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.ops.nn_functional import batch_norm
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(3.0, 2.0, (4, 8, 6, 6)).astype(np.float32)
+    w = rng.normal(1.0, 0.1, (8,)).astype(np.float32)
+    b = rng.normal(0.0, 0.1, (8,)).astype(np.float32)
+    rm = np.zeros(8, np.float32)
+    rv = np.ones(8, np.float32)
+
+    def run(single):
+        pt.set_flags({"batch_norm_single_pass": single})
+        try:
+            out, nm, nv = batch_norm(x, rm, rv, w, b, training=True)
+
+            def loss(xx):
+                o, _, _ = batch_norm(xx, rm, rv, w, b, training=True)
+                return (o ** 2).mean()
+
+            g = jax.grad(loss)(x)
+            return np.asarray(out), np.asarray(nm), np.asarray(nv), \
+                np.asarray(g)
+        finally:
+            pt.set_flags({"batch_norm_single_pass": False})
+
+    o1, m1, v1, g1 = run(False)
+    o2, m2, v2, g2 = run(True)
+    np.testing.assert_allclose(o1, o2, rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(m1, m2, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(v1, v2, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(g1, g2, rtol=2e-4, atol=2e-5)

@@ -103,6 +103,15 @@ STAGES = {
         ["resnet50"], {**_SKIP, **_SPL1, "PT_BENCH_RESNET_BATCH": "128",
                        "PT_BENCH_LAYOUT": "NHWC", "PT_BENCH_FUSED": "0",
                        "FLAGS_resnet_space_to_depth_stem": "1"}, 900),
+    # BN-stat single-pass A/B partner for resnet_nhwc_b128_perleaf
+    # (same pinning; only the flag differs)
+    "resnet_bn1pass": (
+        ["resnet50"], {**_SKIP, **_SPL1, "PT_BENCH_RESNET_BATCH": "128",
+                       "PT_BENCH_LAYOUT": "NHWC", "PT_BENCH_FUSED": "0",
+                       "FLAGS_batch_norm_single_pass": "1"}, 900),
+    # low end of the BERT batch ladder (r5 measured b8 121.1k > b16
+    # 106.4k > b32 100.6k — monotonic toward small batch, so probe b4)
+    "bert_b4_perleaf_noqkv": _bert(4, "0", "0"),
     "bert_b32_remat": ([], {**_SKIP, **_SPL1,
                             "PT_BENCH_BERT_BATCH": "32",
                             "PT_BENCH_FUSED": "0",
@@ -126,6 +135,10 @@ STAGES = {
                                "PT_BENCH_MASKED_LM": "1"}, 900),
     "bert_b8_maskedlm": ([], {**_bert(8, "0", "0")[1],
                               "PT_BENCH_MASKED_LM": "1"}, 900),
+    # framework-free raw-JAX RN50 comparator: same chip, no paddle_tpu
+    # on the model path — separates "our overhead" from "XLA's conv
+    # ceiling" for the stuck ~2260 img/s
+    "rn50_floor": (["128"], {}, 900, "tools/rn50_floor.py"),
     "profile_bert": (["bert", "8"], {}, 900, "tools/profile_step.py"),
     "profile_bert_b32": (["bert", "32"], {}, 900,
                          "tools/profile_step.py"),
