@@ -710,8 +710,8 @@ def bench_flash_attention(on_accel: bool) -> None:
                 return run(fn)
             except Exception as e:  # noqa: BLE001
                 if looks_oom(e):
-                    log(f"seq {t}: {name} OOM (scores are O(T^2)); "
-                        f"recording None")
+                    log(f"seq {t}: {name} OOM; recording None "
+                        f"[{f'{type(e).__name__}: {e}'[:200]}]")
                     return None
                 raise
 
@@ -800,7 +800,8 @@ def bench_flash_train(on_accel: bool) -> None:
                 return run(loss)
             except Exception as e:  # noqa: BLE001
                 if looks_oom(e):
-                    log(f"seq {t}: {name} OOM; recording None")
+                    log(f"seq {t}: {name} OOM; recording None "
+                        f"[{f'{type(e).__name__}: {e}'[:200]}]")
                     return None
                 raise
 

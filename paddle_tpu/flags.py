@@ -188,29 +188,28 @@ define_flag("fused_qkv_projection", False,
             "default stays with the last measurement until the "
             "bert_b8_perleaf_{qkv,noqkv} capture pair remeasures it.")
 define_flag("flash_attention_min_seq", 8192,
-            "Key-sequence length at or above which attention routes to "
-            "the Pallas flash kernel. [structural] The default is "
-            "MEMORY-motivated, not a speed claim: at 8k+ the XLA "
-            "path's [T, T] fp32 score tensors are HBM-scale by plain "
-            "arithmetic (B1 H12 T16k fp32 ≈ 12.9 GB on a 16 GB v5e), "
-            "so the O(T) kernel is routed for capacity. The old 4096 "
-            "SPEED crossover is retired — four rounds of tunnel "
-            "outages never measured it; set this lower only from a "
-            "measured bench.py flash/flash_train table. Narrow head "
-            "dims (d%8) keep a separate fixed 8192 eval floor "
+            "Key-sequence length at or above which EVAL attention "
+            "routes to the Pallas flash kernel. [measured+structural] "
+            "r5 chip sweep (d128 fwd): flash/XLA = 0.86/0.93/1.01/1.00 "
+            "at seq 1k/2k/4k/8k — speed parity from 4k, no win below, "
+            "so the eval gate stays at the MEMORY bound (the XLA "
+            "path's [T, T] fp32 scores are HBM-scale at 8k+: B1 H12 "
+            "T16k fp32 ≈ 12.9 GB on a 16 GB v5e). Narrow head dims "
+            "(d%8) keep a separate fixed 8192 eval floor "
             "(kernels._NARROW_HEAD_EVAL_MIN_SEQ) this flag does not "
             "move. Ring/Ulysses long-context paths use the kernel "
             "directly, not via this gate.")
-define_flag("flash_attention_min_seq_train", 4096,
+define_flag("flash_attention_min_seq_train", 1024,
             "Training-mode flash gate (0 = use "
-            "flash_attention_min_seq). [structural] Separate and LOWER "
-            "than the eval gate because the XLA attention backward "
-            "re-materializes the [B, H, T, T] probs in fp32: at BERT "
-            "geometry B8 H12 T4096 that is ~6.4 GB on a 16 GB v5e — "
-            "HBM-scale by arithmetic well below the eval gate. Like "
-            "the eval gate this is a memory bound, not a speed claim; "
-            "the speed crossover is unmeasured — set from the "
-            "bench.py flash_train capture table when it lands.")
+            "flash_attention_min_seq). [measured] r5 chip sweep (d64 "
+            "fwd+bwd with dropout, 512 tiles): flash beats XLA "
+            "1.18x/1.58x/2.08x at seq 1k/2k/4k — every measured seq "
+            ">= 1024 wins, so the gate sits at the measured crossover. "
+            "The standalone seq-512 point (8.7x) is dispatch-overhead "
+            "dominated on both sides; the in-model bert_b8_flash512 "
+            "capture decides whether 512 joins. The memory argument "
+            "(XLA backward re-materializes [B, H, T, T] fp32 probs, "
+            "~6.4 GB at B8 T4096) independently caps the XLA path.")
 define_flag("flash_block_q", 0,
             "Flash kernel query-tile size (rows of the online-softmax "
             "block). 0 = the kernel module's built-in BLOCK_Q (256). "
@@ -218,7 +217,8 @@ define_flag("flash_block_q", 0,
             "to the sequence length.")
 define_flag("flash_block_k", 0,
             "Flash kernel key-tile size (columns scanned per "
-            "fori_loop iteration). 0 = built-in BLOCK_K (256); sweep "
+            "fori_loop iteration). 0 = built-in BLOCK_K (512, measured "
+            "r5); sweep "
             "lever, clamped like flash_block_q.")
 define_flag("transformer_remat", False,
             "Rematerialize each TransformerEncoder layer in the "

@@ -29,8 +29,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-BLOCK_Q = 256
-BLOCK_K = 256
+# 512 tiles measured fastest on chip (r5 d64 train sweep, v5e:
+# 512-tile 1.18x/1.58x/2.08x vs XLA at seq 1k/2k/4k, dominating
+# 256-tile 1.08x/1.36x/1.65x; 128-tile loses to XLA beyond 512).
+BLOCK_Q = 512
+BLOCK_K = 512
 _NEG_INF = -1e30
 
 

@@ -112,6 +112,14 @@ STAGES = {
     # low end of the BERT batch ladder (r5 measured b8 121.1k > b16
     # 106.4k > b32 100.6k — monotonic toward small batch, so probe b4)
     "bert_b4_perleaf_noqkv": _bert(4, "0", "0"),
+    # in-model flash routing at BERT's own seq 512: the standalone r5
+    # sweep says flash wins at every seq incl. 512 (8.68x), but both
+    # standalone numbers at T512 are dispatch-overhead-dominated — only
+    # an in-model step A/B against bert_b8_perleaf_noqkv settles the
+    # train gate
+    "bert_b8_flash512": ([], {**_bert(8, "0", "0")[1],
+                              "FLAGS_flash_attention_min_seq_train":
+                              "512"}, 900),
     "bert_b32_remat": ([], {**_SKIP, **_SPL1,
                             "PT_BENCH_BERT_BATCH": "32",
                             "PT_BENCH_FUSED": "0",
