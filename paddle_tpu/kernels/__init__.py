@@ -52,12 +52,12 @@ def maybe_layer_norm(x, weight, bias, epsilon: float, begin_norm_axis: int):
     return ref_impl(x, weight, bias, epsilon, begin_norm_axis)
 
 
-def _is_key_padding_mask(mask, q, k) -> bool:
+def _is_key_padding_mask(mask, batch: int, tk: int) -> bool:
     """True for exactly-shaped [B, 1, 1, Tk] masks (no broadcasting)."""
     return (getattr(mask, "ndim", 0) == 4
-            and mask.shape[0] == q.shape[0]
+            and mask.shape[0] == batch
             and mask.shape[1] == 1 and mask.shape[2] == 1
-            and mask.shape[3] == k.shape[2])
+            and mask.shape[3] == tk)
 
 
 def _mask_to_kv_bias(mask):
@@ -75,24 +75,32 @@ def _mask_to_kv_bias(mask):
 
 def maybe_flash_attention(q, k, v, mask=None, scale: Optional[float] = None,
                           causal: bool = False, dropout_p: float = 0.0,
-                          training: bool = False):
-    """q/k/v: [B, H, T, D].
+                          training: bool = False, layout: str = "bhtd"):
+    """q/k/v: [B, H, T, D] (``layout="bhtd"``, default) or
+    [B, T, H, D] (``layout="bthd"`` — the projections' natural layout;
+    the flash kernel gathers heads inside its block DMA, so the routed
+    path runs ZERO physical transposes, measured ~2.2 ms/step of
+    transpose_jvp in the r5 BERT b8 profile. The output layout matches
+    the input layout; the XLA fallback transposes to/from BHTD
+    internally, costing exactly what the caller-side split used to).
 
     Routing: attention goes to the Pallas flash kernel only at
-    key-sequence lengths >= flash_attention_min_seq. The default gate
-    (8192) is memory-motivated — beyond it XLA's [T, T] scores are
-    HBM-scale by arithmetic — while the old 4096 SPEED crossover is
-    retired as never-measured; a measured flash_train table may set
-    the flag lower. Paths where O(T) memory is the whole point
-    (ring/Ulysses long context) route to the kernel directly, not
-    through this gate. Attention dropout runs INSIDE the kernel
-    (counter-based mask, same bits in the recompute backward), so
-    training models like BERT (head dim 64, attn dropout 0.1) stay
-    on the flash path when routed.
+    key-sequence lengths >= the mode's gate: flash_attention_min_seq
+    (eval; memory-motivated — beyond it XLA's [T, T] scores are
+    HBM-scale by arithmetic) or flash_attention_min_seq_train
+    (measured: the r5 in-model bert_b8_flash512 A/B won at seq 512).
+    Paths where O(T) memory is the whole point (ring/Ulysses long
+    context) route to the kernel directly, not through this gate.
+    Attention dropout runs INSIDE the kernel (counter-based mask, same
+    bits in the recompute backward), so training models like BERT
+    (head dim 64, attn dropout 0.1) stay on the flash path when
+    routed.
     """
     from ..ops.attention import scaled_dot_product_attention as ref_impl
     import jax.numpy as jnp
 
+    bthd = layout == "bthd"
+    t_axis = 1 if bthd else 2
     d = q.shape[-1]
     # d%128 keeps MXU lanes full. Narrower head dims (BERT's 64) route
     # only where flash's O(T) memory is the point: training (the XLA
@@ -102,13 +110,14 @@ def maybe_flash_attention(q, k, v, mask=None, scale: Optional[float] = None,
     # flag from a measured d=128 `flash` table says nothing about
     # narrow-head eval (no capture stage measures it), so the memory
     # bound stays fixed.
+    tk = k.shape[t_axis]
     d_ok = d % 128 == 0 or (d % 8 == 0 and (
-        training or k.shape[2] >= _NARROW_HEAD_EVAL_MIN_SEQ))
+        training or tk >= _NARROW_HEAD_EVAL_MIN_SEQ))
     # key-padding masks [B, 1, 1, Tk] (the exact shape BertModel/
     # variable-length batches produce) run INSIDE the kernel as an
     # additive key bias; broadcastable or richer mask shapes fall back
     # to the XLA path. Conversion happens only on the routed branch.
-    mask_ok = mask is None or _is_key_padding_mask(mask, q, k)
+    mask_ok = mask is None or _is_key_padding_mask(mask, q.shape[0], tk)
     min_seq = GLOBAL_FLAGS.get("flash_attention_min_seq")
     if training:
         # the train crossover is its own measured number (XLA's
@@ -116,8 +125,16 @@ def maybe_flash_attention(q, k, v, mask=None, scale: Optional[float] = None,
         min_seq = GLOBAL_FLAGS.get("flash_attention_min_seq_train") \
             or min_seq
     if (pallas_enabled() and mask_ok and q.ndim == 4 and d_ok
-            and k.shape[2] >= min_seq):
-        from .flash_attention import flash_attention
+            and tk >= min_seq):
+        from .flash_attention import bthd_supported, flash_attention
+        if bthd and not bthd_supported(d, q.shape[2]):
+            # geometry the BTHD block tiling can't express (e.g. d=32,
+            # odd head count): still flash, via the transpose layout
+            out = maybe_flash_attention(
+                jnp.moveaxis(q, 2, 1), jnp.moveaxis(k, 2, 1),
+                jnp.moveaxis(v, 2, 1), mask=mask, scale=scale,
+                causal=causal, dropout_p=dropout_p, training=training)
+            return jnp.moveaxis(out, 1, 2)
         kv_bias = None if mask is None else _mask_to_kv_bias(mask)
         if dropout_p > 0.0 and training:
             from ..core import random as _random
@@ -127,8 +144,16 @@ def maybe_flash_attention(q, k, v, mask=None, scale: Optional[float] = None,
             return flash_attention(q, k, v, seed=seed, causal=causal,
                                    scale=scale,
                                    dropout_p=float(dropout_p),
-                                   kv_bias=kv_bias)
+                                   kv_bias=kv_bias, bthd=bthd)
         return flash_attention(q, k, v, causal=causal, scale=scale,
-                               kv_bias=kv_bias)
+                               kv_bias=kv_bias, bthd=bthd)
+    if bthd:
+        # XLA fallback wants [B, H, T, D]; the transpose pair here
+        # costs what the caller-side head split used to cost
+        out = ref_impl(jnp.moveaxis(q, 2, 1), jnp.moveaxis(k, 2, 1),
+                       jnp.moveaxis(v, 2, 1), mask=mask, scale=scale,
+                       causal=causal, dropout_p=dropout_p,
+                       training=training)
+        return jnp.moveaxis(out, 1, 2)
     return ref_impl(q, k, v, mask=mask, scale=scale, causal=causal,
                     dropout_p=dropout_p, training=training)

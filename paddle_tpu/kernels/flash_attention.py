@@ -55,6 +55,27 @@ def _block_sizes(tq: int, tk: int):
     return min(bq, tq), min(bk, tk)
 
 
+def _heads_per_block(d: int, h: int) -> int:
+    """How many heads share one program in the [B, T, H, D] layout.
+    Mosaic requires the minor block dim be a multiple of 128 (or the
+    whole array dim), so a d=64 head slab must ride as a head PAIR
+    (128 lanes); d%128 heads ride alone. Callers gate unsupported
+    combinations to the transpose path before reaching the kernel."""
+    if d % 128 == 0:
+        return 1
+    if (2 * d) % 128 == 0 and h % 2 == 0:
+        return 2
+    raise ValueError(
+        f"flash_attention bthd layout needs d%128==0 or (d%64==0 and "
+        f"even heads); got d={d}, h={h} — route via the BHTD layout")
+
+
+def bthd_supported(d: int, h: int) -> bool:
+    """Whether the transpose-free [B, T, H, D] layout can ride the
+    kernel for this geometry (see _heads_per_block)."""
+    return d % 128 == 0 or ((2 * d) % 128 == 0 and h % 2 == 0)
+
+
 # Both grid dims of every flash kernel — (batch*heads, block index) —
 # are independent: each program writes an exclusive output block and
 # the sequential scan lives INSIDE the kernel (fori_loop). Telling
@@ -91,12 +112,29 @@ def _dropout_keep(seed, g, q_pos, k_pos, dropout_p: float):
     return bits >= threshold
 
 
+def _head_id(g, half: int, hpb: int, n_heads: int):
+    """Global (batch*n_heads + head) counter for the dropout hash. With
+    hpb == 1 this is exactly the grid index g (bitwise-identical masks
+    to the historical single-head layout); with head pairs it
+    reconstructs the same per-head counter from (pair, half)."""
+    if hpb == 1:
+        return g
+    hg = n_heads // hpb
+    return (g // hg) * n_heads + (g % hg) * hpb + half
+
+
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, seed_ref, bias_ref, o_ref,
                       lse_ref, *, scale: float, causal: bool,
                       block_k: int, seq_k: int, seq_q: int,
-                      dropout_p: float, has_bias: bool):
-    q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
-    block_q = q.shape[0]
+                      dropout_p: float, has_bias: bool, d_head: int,
+                      hpb: int, n_heads: int):
+    # refs carry hpb heads side-by-side in the minor dim ([BQ, hpb*D]):
+    # hpb == 1 is the classic one-head-per-program layout; hpb == 2
+    # packs head PAIRS so the [B, T, H, D] layout's d=64 slabs form a
+    # 128-lane block (Mosaic's minor-dim tiling floor). Each half is an
+    # independent attention problem sharing the same K-scan.
+    q2 = q_ref[0].astype(jnp.float32) * scale        # [BQ, hpb*D]
+    block_q = q2.shape[0]
     g = pl.program_id(0)
     i_q = pl.program_id(1)
 
@@ -106,15 +144,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, seed_ref, bias_ref, o_ref,
     causal_offset = seq_k - seq_q
 
     def body(j, carry):
-        acc, m_prev, l_prev = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)        # [BQ, BK]
-        if has_bias:
-            # [1, BK] additive key bias (this batch row) broadcasts
-            s = s + bias_ref[0, :, pl.ds(j * block_k, block_k)]
+        accs, ms, ls = carry
+        k2 = k_ref[0, pl.ds(j * block_k, block_k), :] \
+            .astype(jnp.float32)
+        v2 = v_ref[0, pl.ds(j * block_k, block_k), :] \
+            .astype(jnp.float32)
         k_pos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         valid = k_pos < seq_k                          # tail-block mask
@@ -123,40 +157,64 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, seed_ref, bias_ref, o_ref,
         if causal:
             valid = jnp.logical_and(valid,
                                     q_pos + causal_offset >= k_pos)
-        s = jnp.where(valid, s, _NEG_INF)
-        m_cur = jnp.max(s, axis=-1, keepdims=True)     # [BQ, 1]
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        # l accumulates the full softmax denominator (undropped p);
-        # dropout zeroes entries only in the numerator accumulator
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        if dropout_p > 0.0:
-            keep = _dropout_keep(seed_ref[0, 0], g, q_pos, k_pos,
-                                 dropout_p)
-            p = jnp.where(keep, p, 0.0)
-        acc = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return acc, m_new, l_new
+        bias = bias_ref[0, :, pl.ds(j * block_k, block_k)] \
+            if has_bias else None
+        new = ([], [], [])
+        for half in range(hpb):
+            sl = slice(half * d_head, (half + 1) * d_head)
+            s = jax.lax.dot_general(
+                q2[:, sl], k2[:, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)    # [BQ, BK]
+            if has_bias:
+                # [1, BK] additive key bias (this batch row) broadcasts
+                s = s + bias
+            s = jnp.where(valid, s, _NEG_INF)
+            m_cur = jnp.max(s, axis=-1, keepdims=True)  # [BQ, 1]
+            m_new = jnp.maximum(ms[half], m_cur)
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(ms[half] - m_new)
+            # l accumulates the full softmax denominator (undropped p);
+            # dropout zeroes entries only in the numerator accumulator
+            l_new = ls[half] * alpha + jnp.sum(p, axis=-1,
+                                               keepdims=True)
+            if dropout_p > 0.0:
+                keep = _dropout_keep(
+                    seed_ref[0, 0], _head_id(g, half, hpb, n_heads),
+                    q_pos, k_pos, dropout_p)
+                p = jnp.where(keep, p, 0.0)
+            acc = accs[half] * alpha + jax.lax.dot_general(
+                p, v2[:, sl], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            new[0].append(acc)
+            new[1].append(m_new)
+            new[2].append(l_new)
+        return tuple(new[0]), tuple(new[1]), tuple(new[2])
 
-    d = q.shape[-1]
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = tuple(jnp.zeros((block_q, d_head), jnp.float32)
+                 for _ in range(hpb))
+    m0 = tuple(jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+               for _ in range(hpb))
+    l0 = tuple(jnp.zeros((block_q, 1), jnp.float32)
+               for _ in range(hpb))
     if causal:
         # only scan K blocks that intersect this Q block's visible range
         max_k = (i_q + 1) * block_q - 1 + causal_offset
         upper = jnp.clip(max_k // block_k + 1, 1, num_k)
     else:
         upper = num_k
-    acc, m_fin, l_fin = jax.lax.fori_loop(0, upper, body, (acc0, m0, l0))
-    safe_l = jnp.maximum(l_fin, 1e-30)
-    out = acc / safe_l
-    if dropout_p > 0.0:
-        out = out / (1.0 - dropout_p)
-    o_ref[0] = out.astype(o_ref.dtype)
-    lse_ref[0] = m_fin + jnp.log(safe_l)  # [BQ, 1]
+    accs, m_fin, l_fin = jax.lax.fori_loop(0, upper, body,
+                                           (acc0, m0, l0))
+    outs, lses = [], []
+    for half in range(hpb):
+        safe_l = jnp.maximum(l_fin[half], 1e-30)
+        out = accs[half] / safe_l
+        if dropout_p > 0.0:
+            out = out / (1.0 - dropout_p)
+        outs.append(out)
+        lses.append(m_fin[half] + jnp.log(safe_l))
+    o_ref[0] = jnp.concatenate(outs, axis=1).astype(o_ref.dtype) \
+        if hpb > 1 else outs[0].astype(o_ref.dtype)
+    lse_ref[0] = jnp.concatenate(lses, axis=1) if hpb > 1 else lses[0]
 
 
 def _seed_arr(seed):
@@ -178,9 +236,22 @@ def _bias_arr(kv_bias, b, tk, tk_p):
 
 def _flash_forward(q, k, v, seed, scale: float, causal: bool,
                    dropout_p: float, interpret: bool = False,
-                   kv_bias=None):
-    b, h, tq, d = q.shape
-    tk = k.shape[2]
+                   kv_bias=None, bthd: bool = False):
+    """``bthd=False``: q/k/v are [B, H, T, D] (classic layout).
+    ``bthd=True``: q/k/v are [B, T, H, D] — the layout attention
+    projections produce naturally. The kernels are IDENTICAL in both
+    modes: in bthd mode the arrays are viewed as [B, T, H*D] (a free
+    reshape) and each program's BlockSpec index map selects its head's
+    d-wide column slab, so the strided head gather happens inside the
+    block DMA instead of as a physical [B,T,H,D]→[B,H,T,D] transpose —
+    which the r5 BERT profile measured at ~2.2 ms/step of
+    transpose_jvp ops plus their forward twins."""
+    if bthd:
+        b, tq, h, d = q.shape
+        tk = k.shape[1]
+    else:
+        b, h, tq, d = q.shape
+        tk = k.shape[2]
     bq, bk = _block_sizes(tq, tk)
     # pad sequences to block multiples: pl.ds on a short tail CLAMPS the
     # start index (shifting rows under the validity mask), so the buffers
@@ -189,63 +260,91 @@ def _flash_forward(q, k, v, seed, scale: float, causal: bool,
     # the output below.
     tq_p = pl.cdiv(tq, bq) * bq
     tk_p = pl.cdiv(tk, bk) * bk
-    qr = q.reshape(b * h, tq, d)
-    kr = k.reshape(b * h, tk, d)
-    vr = v.reshape(b * h, tk, d)
-    if tq_p != tq:
-        qr = jnp.pad(qr, ((0, 0), (0, tq_p - tq), (0, 0)))
-    if tk_p != tk:
-        kr = jnp.pad(kr, ((0, 0), (0, tk_p - tk), (0, 0)))
-        vr = jnp.pad(vr, ((0, 0), (0, tk_p - tk), (0, 0)))
-    grid = (b * h, tq_p // bq)
+    hpb = _heads_per_block(d, h) if bthd else 1
+    hg = h // hpb                    # head-groups per batch element
+    if bthd:
+        qr = q.reshape(b, tq, h * d)
+        kr = k.reshape(b, tk, h * d)
+        vr = v.reshape(b, tk, h * d)
+        if tq_p != tq:
+            qr = jnp.pad(qr, ((0, 0), (0, tq_p - tq), (0, 0)))
+        if tk_p != tk:
+            kr = jnp.pad(kr, ((0, 0), (0, tk_p - tk), (0, 0)))
+            vr = jnp.pad(vr, ((0, 0), (0, tk_p - tk), (0, 0)))
+        # program g handles (batch g//hg, head-group g%hg): block index
+        # g%hg on the H*D dim × block width hpb*d = this group's slab
+        q_spec = pl.BlockSpec((1, bq, hpb * d),
+                              lambda g, i: (g // hg, i, g % hg),
+                              memory_space=pltpu.VMEM)
+        kv_spec = pl.BlockSpec((1, tk_p, hpb * d),
+                               lambda g, i: (g // hg, 0, g % hg),
+                               memory_space=pltpu.VMEM)
+        out_struct = jax.ShapeDtypeStruct((b, tq_p, h * d), q.dtype)
+    else:
+        qr = q.reshape(b * h, tq, d)
+        kr = k.reshape(b * h, tk, d)
+        vr = v.reshape(b * h, tk, d)
+        if tq_p != tq:
+            qr = jnp.pad(qr, ((0, 0), (0, tq_p - tq), (0, 0)))
+        if tk_p != tk:
+            kr = jnp.pad(kr, ((0, 0), (0, tk_p - tk), (0, 0)))
+            vr = jnp.pad(vr, ((0, 0), (0, tk_p - tk), (0, 0)))
+        q_spec = pl.BlockSpec((1, bq, d), lambda g, i: (g, i, 0),
+                              memory_space=pltpu.VMEM)
+        kv_spec = pl.BlockSpec((1, tk_p, d), lambda g, i: (g, 0, 0),
+                               memory_space=pltpu.VMEM)
+        out_struct = jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype)
+    grid = (b * hg, tq_p // bq)
     has_bias = kv_bias is not None
-    # bias rows are per batch element: block index g // h (h static)
-    bias_map = (lambda g, i: (g // h, 0, 0)) if has_bias else \
+    # bias rows are per batch element: block index g // hg (hg static)
+    bias_map = (lambda g, i: (g // hg, 0, 0)) if has_bias else \
         (lambda g, i: (0, 0, 0))
     kernel = functools.partial(_flash_fwd_kernel, scale=scale,
                                causal=causal, block_k=bk, seq_k=tk,
                                seq_q=tq, dropout_p=dropout_p,
-                               has_bias=has_bias)
+                               has_bias=has_bias, d_head=d, hpb=hpb,
+                               n_heads=h)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda g, i: (g, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tk_p, d), lambda g, i: (g, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tk_p, d), lambda g, i: (g, 0, 0),
-                         memory_space=pltpu.VMEM),
+            q_spec,
+            kv_spec,
+            kv_spec,
             pl.BlockSpec((1, 1), lambda g, i: (0, 0),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, tk_p), bias_map,
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda g, i: (g, i, 0),
-                         memory_space=pltpu.VMEM),
-            # lse as [bh, tq, 1]: a trailing unit dim (equal to the array
-            # dim) satisfies Mosaic's (8,128) block tiling rule, which a
+            q_spec,
+            # lse as [b*hg, tq, hpb]: a trailing dim equal to the array
+            # dim satisfies Mosaic's (8,128) block tiling rule, which a
             # 2-D (1, bq) block does not
-            pl.BlockSpec((1, bq, 1), lambda g, i: (g, i, 0),
+            pl.BlockSpec((1, bq, hpb), lambda g, i: (g, i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, tq_p, 1), jnp.float32),
+            out_struct,
+            jax.ShapeDtypeStruct((b * hg, tq_p, hpb), jnp.float32),
         ],
         interpret=interpret,
         compiler_params=_GRID_PARALLEL,
     )(qr, kr, vr, _seed_arr(seed), _bias_arr(kv_bias, b, tk, tk_p))
-    return (out[:, :tq].reshape(b, h, tq, d),
-            lse[:, :tq, 0].reshape(b, h, tq))
+    # lse -> [B, H, Tq]: head = group*hpb + half, so the trailing half
+    # dim interleaves back via a (tiny, h*tq fp32) transpose
+    lse_pub = lse[:, :tq, :].reshape(b, hg, tq, hpb)
+    lse_pub = jnp.moveaxis(lse_pub, 3, 2).reshape(b, h, tq)
+    if bthd:
+        return out[:, :tq].reshape(b, tq, h, d), lse_pub
+    return out[:, :tq].reshape(b, h, tq, d), lse_pub
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 9))
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
                     interpret: bool = False, dropout_p: float = 0.0,
-                    seed=None, kv_bias=None):
+                    seed=None, kv_bias=None, bthd: bool = False):
     """Fused attention:
     dropout(softmax(QK^T * scale + kv_bias [+ causal mask])) V.
 
@@ -260,6 +359,11 @@ def flash_attention(q, k, v, causal: bool = False,
     ``kv_bias``: [B, Tk] additive key bias (0 keep / large-negative
     masked) — the key-padding mask of variable-length batches. Treated
     as non-trainable: its cotangent is zero.
+
+    ``bthd``: q/k/v (and the output + cotangents) are [B, T, H, D] —
+    the projections' natural layout — instead of [B, H, T, D]. Same
+    kernels; the head gather rides the block DMA, eliminating the
+    physical transposes around attention (see _flash_forward).
     """
     if dropout_p > 0.0 and seed is None:
         raise ValueError("flash_attention: dropout_p > 0 requires a "
@@ -267,43 +371,42 @@ def flash_attention(q, k, v, causal: bool = False,
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     out, _ = _flash_forward(q, k, v, seed, scale, causal, dropout_p,
-                            interpret, kv_bias)
+                            interpret, kv_bias, bthd)
     return out
 
 
-def _fwd(q, k, v, causal, scale, interpret, dropout_p, seed, kv_bias):
+def _fwd(q, k, v, causal, scale, interpret, dropout_p, seed, kv_bias,
+         bthd):
     if dropout_p > 0.0 and seed is None:
         raise ValueError("flash_attention: dropout_p > 0 requires a "
                          "seed (vary it per step)")
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     out, lse = _flash_forward(q, k, v, seed, scale, causal, dropout_p,
-                              interpret, kv_bias)
+                              interpret, kv_bias, bthd)
     return out, (q, k, v, seed, kv_bias, out, lse, scale)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    seed_ref, bias_ref, dq_ref, *, scale: float,
                    causal: bool, block_k: int, seq_k: int, seq_q: int,
-                   dropout_p: float, has_bias: bool):
-    q = q_ref[0].astype(jnp.float32)                   # [BQ, D]
-    do = do_ref[0].astype(jnp.float32)                 # [BQ, D]
-    lse = lse_ref[0]                                   # [BQ, 1] f32
-    delta = delta_ref[0]                               # [BQ, 1] f32
-    block_q = q.shape[0]
+                   dropout_p: float, has_bias: bool, d_head: int,
+                   hpb: int, n_heads: int):
+    q2 = q_ref[0].astype(jnp.float32)                  # [BQ, hpb*D]
+    do2 = do_ref[0].astype(jnp.float32)                # [BQ, hpb*D]
+    lse2 = lse_ref[0]                                  # [BQ, hpb] f32
+    delta2 = delta_ref[0]                              # [BQ, hpb] f32
+    block_q = q2.shape[0]
     g = pl.program_id(0)
     i_q = pl.program_id(1)
     num_k = pl.cdiv(seq_k, block_k)
     causal_offset = seq_k - seq_q
 
-    def body(j, dq_acc):
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [BQ, BK]
-        if has_bias:
-            s = s + bias_ref[0, :, pl.ds(j * block_k, block_k)]
+    def body(j, dq_accs):
+        k2 = k_ref[0, pl.ds(j * block_k, block_k), :] \
+            .astype(jnp.float32)
+        v2 = v_ref[0, pl.ds(j * block_k, block_k), :] \
+            .astype(jnp.float32)
         k_pos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         valid = k_pos < seq_k
@@ -312,43 +415,57 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             valid = jnp.logical_and(valid,
                                     q_pos + causal_offset >= k_pos)
-        s = jnp.where(valid, s, _NEG_INF)
-        p = jnp.exp(s - lse)                            # probs, 0 at -inf
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [BQ, BK]
-        if dropout_p > 0.0:
-            # same mask as the forward: dP = keep * dp / (1-p_drop);
-            # delta already equals rowsum(P_dropped * dp) via dO.O
-            keep = _dropout_keep(seed_ref[0, 0], g, q_pos, k_pos,
-                                 dropout_p)
-            dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
-        dsc = p * (dp - delta) * scale
-        return dq_acc + jax.lax.dot_general(
-            dsc, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        bias = bias_ref[0, :, pl.ds(j * block_k, block_k)] \
+            if has_bias else None
+        out = []
+        for half in range(hpb):
+            sl = slice(half * d_head, (half + 1) * d_head)
+            s = jax.lax.dot_general(
+                q2[:, sl], k2[:, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [BQ, BK]
+            if has_bias:
+                s = s + bias
+            s = jnp.where(valid, s, _NEG_INF)
+            p = jnp.exp(s - lse2[:, half:half + 1])  # probs, 0 at -inf
+            dp = jax.lax.dot_general(
+                do2[:, sl], v2[:, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)          # [BQ, BK]
+            if dropout_p > 0.0:
+                # same mask as the forward: dP = keep * dp/(1-p_drop);
+                # delta already equals rowsum(P_dropped * dp) via dO.O
+                keep = _dropout_keep(
+                    seed_ref[0, 0], _head_id(g, half, hpb, n_heads),
+                    q_pos, k_pos, dropout_p)
+                dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
+            dsc = p * (dp - delta2[:, half:half + 1]) * scale
+            out.append(dq_accs[half] + jax.lax.dot_general(
+                dsc, k2[:, sl], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
+        return tuple(out)
 
     if causal:
         max_k = (i_q + 1) * block_q - 1 + causal_offset
         upper = jnp.clip(max_k // block_k + 1, 1, num_k)
     else:
         upper = num_k
-    d = q.shape[-1]
-    dq = jax.lax.fori_loop(0, upper, body,
-                           jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    dq0 = tuple(jnp.zeros((block_q, d_head), jnp.float32)
+                for _ in range(hpb))
+    dqs = jax.lax.fori_loop(0, upper, body, dq0)
+    dq_ref[0] = (jnp.concatenate(dqs, axis=1) if hpb > 1 else dqs[0]) \
+        .astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     seed_ref, bias_ref, dk_ref, dv_ref, *, scale: float,
                     causal: bool, block_q: int, seq_k: int, seq_q: int,
-                    dropout_p: float, has_bias: bool):
+                    dropout_p: float, has_bias: bool, d_head: int,
+                    hpb: int, n_heads: int):
     # Padded-q correctness: dO and delta are zero-padded, so a padded
     # query row contributes p^T@dO = 0 to dv and p*(0-0) = 0 to dk —
     # no explicit q-validity mask is needed.
-    k = k_ref[0].astype(jnp.float32)                   # [BK, D]
-    v = v_ref[0].astype(jnp.float32)                   # [BK, D]
-    block_k = k.shape[0]
+    k2 = k_ref[0].astype(jnp.float32)                  # [BK, hpb*D]
+    v2 = v_ref[0].astype(jnp.float32)                  # [BK, hpb*D]
+    block_k = k2.shape[0]
     g = pl.program_id(0)
     j_k = pl.program_id(1)
     seq_q_pad = q_ref.shape[1]
@@ -356,18 +473,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     causal_offset = seq_k - seq_q
 
     def body(i, carry):
-        dk_acc, dv_acc = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * block_q, block_q), :]   # [BQ, 1]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q), :]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale     # [BQ, BK]
-        if has_bias:
-            # this kernel's k block is fixed, so the BlockSpec already
-            # delivered exactly the [1, BK] bias slice for j_k
-            s = s + bias_ref[0]
+        dk_accs, dv_accs = carry
+        q2 = q_ref[0, pl.ds(i * block_q, block_q), :] \
+            .astype(jnp.float32)
+        do2 = do_ref[0, pl.ds(i * block_q, block_q), :] \
+            .astype(jnp.float32)
+        lse2 = lse_ref[0, pl.ds(i * block_q, block_q), :]  # [BQ, hpb]
+        delta2 = delta_ref[0, pl.ds(i * block_q, block_q), :]
         k_pos = j_k * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         valid = k_pos < seq_k
@@ -376,27 +488,38 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             valid = jnp.logical_and(valid,
                                     q_pos + causal_offset >= k_pos)
-        s = jnp.where(valid, s, _NEG_INF)
-        p = jnp.exp(s - lse)                                # [BQ, BK]
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)             # [BQ, BK]
-        if dropout_p > 0.0:
-            keep = _dropout_keep(seed_ref[0, 0], g, q_pos, k_pos,
-                                 dropout_p)
-            inv = 1.0 - dropout_p
-            p_v = jnp.where(keep, p / inv, 0.0)   # dropped+scaled probs
-            dp = jnp.where(keep, dp / inv, 0.0)
-        else:
-            p_v = p
-        dv_acc = dv_acc + jax.lax.dot_general(
-            p_v, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)             # [BK, D]
-        dsc = p * (dp - delta) * scale
-        dk_acc = dk_acc + jax.lax.dot_general(
-            dsc, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)             # [BK, D]
-        return dk_acc, dv_acc
+        new_dk, new_dv = [], []
+        for half in range(hpb):
+            sl = slice(half * d_head, (half + 1) * d_head)
+            s = jax.lax.dot_general(
+                q2[:, sl], k2[:, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [BQ, BK]
+            if has_bias:
+                # this kernel's k block is fixed, so the BlockSpec
+                # already delivered exactly the [1, BK] slice for j_k
+                s = s + bias_ref[0]
+            s = jnp.where(valid, s, _NEG_INF)
+            p = jnp.exp(s - lse2[:, half:half + 1])         # [BQ, BK]
+            dp = jax.lax.dot_general(
+                do2[:, sl], v2[:, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)         # [BQ, BK]
+            if dropout_p > 0.0:
+                keep = _dropout_keep(
+                    seed_ref[0, 0], _head_id(g, half, hpb, n_heads),
+                    q_pos, k_pos, dropout_p)
+                inv = 1.0 - dropout_p
+                p_v = jnp.where(keep, p / inv, 0.0)  # dropped+scaled
+                dp = jnp.where(keep, dp / inv, 0.0)
+            else:
+                p_v = p
+            new_dv.append(dv_accs[half] + jax.lax.dot_general(
+                p_v, do2[:, sl], (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))        # [BK, D]
+            dsc = p * (dp - delta2[:, half:half + 1]) * scale
+            new_dk.append(dk_accs[half] + jax.lax.dot_general(
+                dsc, q2[:, sl], (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))        # [BK, D]
+        return tuple(new_dk), tuple(new_dv)
 
     if causal:
         # first q block whose last visible key reaches this k block:
@@ -405,70 +528,128 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          0, num_q)
     else:
         lower = 0
-    d = k.shape[-1]
-    zeros = jnp.zeros((block_k, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(lower, num_q, body, (zeros, zeros))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    zeros = tuple(jnp.zeros((block_k, d_head), jnp.float32)
+                  for _ in range(hpb))
+    dks, dvs = jax.lax.fori_loop(lower, num_q, body, (zeros, zeros))
+    dk_ref[0] = (jnp.concatenate(dks, axis=1) if hpb > 1 else dks[0]) \
+        .astype(dk_ref.dtype)
+    dv_ref[0] = (jnp.concatenate(dvs, axis=1) if hpb > 1 else dvs[0]) \
+        .astype(dv_ref.dtype)
 
 
 def _flash_backward(q, k, v, seed, out, lse, g, scale: float,
                     causal: bool, dropout_p: float,
-                    interpret: bool = False, dlse=None, kv_bias=None):
-    b, h, tq, d = q.shape
-    tk = k.shape[2]
+                    interpret: bool = False, dlse=None, kv_bias=None,
+                    bthd: bool = False):
+    if bthd:
+        b, tq, h, d = q.shape
+        tk = k.shape[1]
+    else:
+        b, h, tq, d = q.shape
+        tk = k.shape[2]
     bq, bk = _block_sizes(tq, tk)
     tq_p = pl.cdiv(tq, bq) * bq
     tk_p = pl.cdiv(tk, bk) * bk
 
-    def flat(x, t, tp):
-        x = x.reshape(b * h, t, -1)
-        return jnp.pad(x, ((0, 0), (0, tp - t), (0, 0))) \
-            if tp != t else x
+    hpb = _heads_per_block(d, h) if bthd else 1
+    hg = h // hpb
+    if bthd:
+        # [B, T, H, D] -> [B, T, H*D] view; head-group slabs are
+        # selected by the BlockSpec index maps (see _flash_forward)
+        def flat(x, t, tp):
+            x = x.reshape(b, t, -1)
+            return jnp.pad(x, ((0, 0), (0, tp - t), (0, 0))) \
+                if tp != t else x
+
+        def seq_spec(blk, imap):
+            return pl.BlockSpec((1, blk, hpb * d), imap,
+                                memory_space=pltpu.VMEM)
+
+        q_map = lambda g_, i: (g_ // hg, i, g_ % hg)      # noqa: E731
+        kv_map = lambda g_, i: (g_ // hg, 0, g_ % hg)     # noqa: E731
+        kblk_map = lambda g_, j: (g_ // hg, j, g_ % hg)   # noqa: E731
+        qfull_map = lambda g_, j: (g_ // hg, 0, g_ % hg)  # noqa: E731
+        dq_struct = jax.ShapeDtypeStruct((b, tq_p, h * d), q.dtype)
+        dk_struct = jax.ShapeDtypeStruct((b, tk_p, h * d), k.dtype)
+        dv_struct = jax.ShapeDtypeStruct((b, tk_p, h * d), v.dtype)
+        # delta/lse ride as [b*hg, tq, hpb] (head = group*hpb + half):
+        # [b, tq, h] -> that layout is a tiny fp32 transpose
+        # (b*h*tq elements), not activation-scale traffic
+        delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1)                          # [b, tq, h]
+        delta = jnp.moveaxis(delta.reshape(b, tq, hg, hpb), 2, 1) \
+            .reshape(b * hg, tq, hpb)
+    else:
+        def flat(x, t, tp):
+            x = x.reshape(b * h, t, -1)
+            return jnp.pad(x, ((0, 0), (0, tp - t), (0, 0))) \
+                if tp != t else x
+
+        def seq_spec(blk, imap):
+            return pl.BlockSpec((1, blk, d), imap,
+                                memory_space=pltpu.VMEM)
+
+        q_map = lambda g_, i: (g_, i, 0)                # noqa: E731
+        kv_map = lambda g_, i: (g_, 0, 0)               # noqa: E731
+        kblk_map = lambda g_, j: (g_, j, 0)             # noqa: E731
+        qfull_map = lambda g_, j: (g_, 0, 0)            # noqa: E731
+        dq_struct = jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype)
+        dk_struct = jax.ShapeDtypeStruct((b * h, tk_p, d), k.dtype)
+        dv_struct = jax.ShapeDtypeStruct((b * h, tk_p, d), v.dtype)
+        delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1).reshape(b * h, tq, 1)
 
     qr, dor = flat(q, tq, tq_p), flat(g, tq, tq_p)
     kr, vr = flat(k, tk, tk_p), flat(v, tk, tk_p)
-    # delta = rowsum(dO * O): one elementwise+reduce in XLA, [bh, tq, 1].
+
+    def to_rows(x):
+        """[B, H, Tq]-shaped values -> the kernels' row layout
+        (b*hg, tq, hpb) with head = group*hpb + half (unpadded)."""
+        x = x.reshape(b, hg, hpb, tq)
+        return jnp.moveaxis(x, 2, 3).reshape(b * hg, tq, hpb)
+
+    def pad_rows(x):
+        return jnp.pad(x, ((0, 0), (0, tq_p - tq), (0, 0))) \
+            if tq_p != tq else x
+
+    # delta = rowsum(dO * O): one elementwise+reduce in XLA.
     # An lse cotangent folds in here: ds = p*(dP - (delta - dlse))*scale
     # (d lse_i/ds_ij = p_ij), so no kernel change is needed.
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1).reshape(b * h, tq, 1)
     if dlse is not None:
-        delta = delta - dlse.astype(jnp.float32).reshape(b * h, tq, 1)
-    delta = flat(delta, tq, tq_p)
-    lse_r = flat(lse.reshape(b, h, tq, 1).astype(jnp.float32), tq, tq_p)
+        delta = delta - to_rows(dlse.astype(jnp.float32))
+    delta = pad_rows(delta)
+    lse_r = pad_rows(to_rows(lse.astype(jnp.float32)))
 
     seed_a = _seed_arr(seed)
     has_bias = kv_bias is not None
     bias_a = _bias_arr(kv_bias, b, tk, tk_p)
-    bias_map = (lambda g_, i: (g_ // h, 0, 0)) if has_bias else \
+    bias_map = (lambda g_, i: (g_ // hg, 0, 0)) if has_bias else \
         (lambda g_, i: (0, 0, 0))
+    row_spec = pl.BlockSpec((1, bq, hpb), lambda g_, i: (g_, i, 0),
+                            memory_space=pltpu.VMEM)
+    rowfull_spec = pl.BlockSpec((1, tq_p, hpb),
+                                lambda g_, j: (g_, 0, 0),
+                                memory_space=pltpu.VMEM)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_k=bk, seq_k=tk, seq_q=tq,
-                          dropout_p=dropout_p, has_bias=has_bias),
-        grid=(b * h, tq_p // bq),
+                          dropout_p=dropout_p, has_bias=has_bias,
+                          d_head=d, hpb=hpb, n_heads=h),
+        grid=(b * hg, tq_p // bq),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda g_, i: (g_, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tk_p, d), lambda g_, i: (g_, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tk_p, d), lambda g_, i: (g_, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, d), lambda g_, i: (g_, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, 1), lambda g_, i: (g_, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, 1), lambda g_, i: (g_, i, 0),
-                         memory_space=pltpu.VMEM),
+            seq_spec(bq, q_map),
+            seq_spec(tk_p, kv_map),
+            seq_spec(tk_p, kv_map),
+            seq_spec(bq, q_map),
+            row_spec,
+            row_spec,
             pl.BlockSpec((1, 1), lambda g_, i: (0, 0),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, tk_p), bias_map,
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda g_, i: (g_, i, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
+        out_specs=seq_spec(bq, q_map),
+        out_shape=dq_struct,
         interpret=interpret,
         compiler_params=_GRID_PARALLEL,
     )(qr, kr, vr, dor, lse_r, delta, seed_a, bias_a)
@@ -476,56 +657,50 @@ def _flash_backward(q, k, v, seed, out, lse, g, scale: float,
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=bq, seq_k=tk, seq_q=tq,
-                          dropout_p=dropout_p, has_bias=has_bias),
-        grid=(b * h, tk_p // bk),
+                          dropout_p=dropout_p, has_bias=has_bias,
+                          d_head=d, hpb=hpb, n_heads=h),
+        grid=(b * hg, tk_p // bk),
         in_specs=[
-            pl.BlockSpec((1, tq_p, d), lambda g_, j: (g_, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda g_, j: (g_, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda g_, j: (g_, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tq_p, d), lambda g_, j: (g_, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tq_p, 1), lambda g_, j: (g_, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tq_p, 1), lambda g_, j: (g_, 0, 0),
-                         memory_space=pltpu.VMEM),
+            seq_spec(tq_p, qfull_map),
+            seq_spec(bk, kblk_map),
+            seq_spec(bk, kblk_map),
+            seq_spec(tq_p, qfull_map),
+            rowfull_spec,
+            rowfull_spec,
             pl.BlockSpec((1, 1), lambda g_, j: (0, 0),
                          memory_space=pltpu.SMEM),
             # this kernel's k block is fixed per program: deliver only
             # the bk-wide bias slice instead of the whole padded row
             pl.BlockSpec((1, 1, bk),
-                         (lambda g_, j: (g_ // h, 0, j)) if has_bias
+                         (lambda g_, j: (g_ // hg, 0, j)) if has_bias
                          else (lambda g_, j: (0, 0, 0)),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda g_, j: (g_, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda g_, j: (g_, j, 0),
-                         memory_space=pltpu.VMEM),
+            seq_spec(bk, kblk_map),
+            seq_spec(bk, kblk_map),
         ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b * h, tk_p, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, tk_p, d), v.dtype),
-        ],
+        out_shape=[dk_struct, dv_struct],
         interpret=interpret,
         compiler_params=_GRID_PARALLEL,
     )(qr, kr, vr, dor, lse_r, delta, seed_a, bias_a)
 
+    if bthd:
+        return (dq[:, :tq].reshape(b, tq, h, d),
+                dk[:, :tk].reshape(b, tk, h, d),
+                dv[:, :tk].reshape(b, tk, h, d))
     return (dq[:, :tq].reshape(b, h, tq, d),
             dk[:, :tk].reshape(b, h, tk, d),
             dv[:, :tk].reshape(b, h, tk, d))
 
 
-def _bwd(causal, scale_arg, interpret, dropout_p, res, g):
+def _bwd(causal, scale_arg, interpret, dropout_p, bthd, res, g):
     import numpy as np
 
     q, k, v, seed, kv_bias, out, lse, scale = res
     dq, dk, dv = _flash_backward(q, k, v, seed, out, lse, g, scale,
                                  causal, dropout_p, interpret,
-                                 kv_bias=kv_bias)
+                                 kv_bias=kv_bias, bthd=bthd)
     # seed is integer-valued: its cotangent is the symbolic-zero float0
     dseed = None if seed is None else \
         np.zeros(jnp.shape(jnp.asarray(seed)), jax.dtypes.float0)

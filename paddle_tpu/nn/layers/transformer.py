@@ -67,12 +67,16 @@ class MultiHeadAttention(Layer):
 
     def forward(self, query, key=None, value=None, attn_mask=None,
                 causal: bool = False):
-        # Layout note: a transpose-free [B, T, H, D] variant exists
-        # (ops.attention.attention_bthd) but measured structurally
-        # WORSE on compiled HLO (tools/perf_lab.py hlostats: 136->144
-        # transposes on bert4L — XLA re-transposes inside dot_general
-        # anyway), so the BHTD split stays until a real-chip A/B says
-        # otherwise.
+        # Layout note: the main path hands the projections to attention
+        # in their NATIVE [B, T, H, D] layout (layout="bthd") — the
+        # flash kernel gathers heads inside its block DMA, so the
+        # routed path runs zero physical head transposes (the r5 BERT
+        # b8 profile measured ~2.2 ms/step of transpose_jvp around
+        # attention). The XLA fallback transposes to BHTD internally,
+        # costing exactly what the old caller-side split did. An
+        # earlier transpose-free attempt (ops.attention.attention_bthd)
+        # targeted the XLA composition, where dot_general re-transposes
+        # anyway — that objection does not apply to the Pallas path.
         from ...flags import GLOBAL_FLAGS
         fusable = (GLOBAL_FLAGS.get("fused_qkv_projection")
                    and key is None and value is None
@@ -85,31 +89,45 @@ class MultiHeadAttention(Layer):
         value = key if value is None else value
         if fusable:
             qp, kp, vp = self._qkv_self(query)
-            q, k, v = self._split(qp), self._split(kp), self._split(vp)
         else:
-            q = self._split(self.q_proj(query))
-            k = self._split(self.k_proj(key))
-            v = self._split(self.v_proj(value))
+            qp = self.q_proj(query)
+            kp = self.k_proj(key)
+            vp = self.v_proj(value)
         if self.need_weights:
             # the reference returns (out, attention weights); weights
             # require materializing the [B, H, Tq, Tk] probs, so this
             # path stays on the XLA composition by construction
             from ...ops.attention import scaled_dot_product_attention
             out, weights = scaled_dot_product_attention(
-                q, k, v, mask=attn_mask, causal=causal,
+                self._split(qp), self._split(kp), self._split(vp),
+                mask=attn_mask, causal=causal,
                 dropout_p=self.dropout, training=self.training,
                 return_weights=True)
-        else:
-            from ...kernels import maybe_flash_attention
-            out = maybe_flash_attention(
-                q, k, v, mask=attn_mask, causal=causal,
-                dropout_p=self.dropout, training=self.training)
-        b, h, t, d = out.shape
-        out = jnp.moveaxis(out, 1, 2).reshape(b, t, h * d)
-        out = self.out_proj(out)
-        if self.need_weights:
+            b, h, t, d = out.shape
+            out = jnp.moveaxis(out, 1, 2).reshape(b, t, h * d)
+            out = self.out_proj(out)
             return out, weights
-        return out
+        from ...kernels import maybe_flash_attention
+        if not GLOBAL_FLAGS.get("attention_bthd_layout"):
+            # transpose layout (the measured A/B partner + escape hatch)
+            out = maybe_flash_attention(
+                self._split(qp), self._split(kp), self._split(vp),
+                mask=attn_mask, causal=causal, dropout_p=self.dropout,
+                training=self.training)
+            b, h, t, d = out.shape
+            return self.out_proj(
+                jnp.moveaxis(out, 1, 2).reshape(b, t, h * d))
+
+        def heads(x):
+            b_, t_, _ = x.shape
+            return x.reshape(b_, t_, self.num_heads, self.head_dim)
+
+        out = maybe_flash_attention(
+            heads(qp), heads(kp), heads(vp), mask=attn_mask,
+            causal=causal, dropout_p=self.dropout,
+            training=self.training, layout="bthd")
+        b, t, h, d = out.shape
+        return self.out_proj(out.reshape(b, t, h * d))
 
 
 class TransformerEncoderLayer(Layer):

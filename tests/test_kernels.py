@@ -520,20 +520,18 @@ def test_mask_to_kv_bias_helpers():
     qualify (broadcastable shapes fall back to the XLA path)."""
     from paddle_tpu.kernels import _is_key_padding_mask, _mask_to_kv_bias
 
-    q = jnp.zeros((2, 2, 8, 4))
-    k = jnp.zeros((2, 2, 16, 4))
     m_bool = jnp.asarray(np.array(
         [[True] * 10 + [False] * 6, [True] * 16])[:, None, None, :])
-    assert _is_key_padding_mask(m_bool, q, k)
+    assert _is_key_padding_mask(m_bool, batch=2, tk=16)
     bias = np.asarray(_mask_to_kv_bias(m_bool))
     assert (bias[0, :10] == 0).all()
     assert (bias[0, 10:] < -1e29).all()
     assert (bias[1] == 0).all()
     m_add = jnp.zeros((2, 1, 1, 16), jnp.float32) - 5.0
     np.testing.assert_allclose(np.asarray(_mask_to_kv_bias(m_add)), -5.0)
-    assert not _is_key_padding_mask(jnp.zeros((1, 1, 1, 16)), q, k)
-    assert not _is_key_padding_mask(jnp.zeros((2, 1, 1, 8)), q, k)
-    assert not _is_key_padding_mask(jnp.zeros((2, 1, 8, 16)), q, k)
+    assert not _is_key_padding_mask(jnp.zeros((1, 1, 1, 16)), 2, 16)
+    assert not _is_key_padding_mask(jnp.zeros((2, 1, 1, 8)), 2, 16)
+    assert not _is_key_padding_mask(jnp.zeros((2, 1, 8, 16)), 2, 16)
 
 
 
@@ -675,3 +673,81 @@ def test_flash_train_eval_split_crossover(monkeypatch):
         assert not calls, "train 0-sentinel ignored the shared threshold"
     finally:
         pt.set_flags(saved)
+
+
+def test_flash_bthd_layout_parity(rng):
+    """bthd=True takes [B, T, H, D] (the projections' native layout) and
+    must match the [B, H, T, D] path bitwise: same kernels, the head
+    gather just moves into the BlockSpec index maps. Covers forward and
+    all three input grads, with causal + dropout + key bias + a
+    non-block-multiple sequence (padding path)."""
+    from paddle_tpu.kernels.flash_attention import flash_attention
+
+    b, h, t, d = 2, 4, 96, 64
+    q = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    bias = (jnp.where(jnp.arange(t)[None, :] < t - 7, 0.0, -1e30)
+            .astype(jnp.float32) * jnp.ones((b, 1)))
+    qT, kT, vT = (jnp.moveaxis(x, 1, 2) for x in (q, k, v))
+
+    o_ref = flash_attention(q, k, v, interpret=True, kv_bias=bias)
+    o_bthd = flash_attention(qT, kT, vT, interpret=True, kv_bias=bias,
+                             bthd=True)
+    np.testing.assert_array_equal(np.asarray(o_ref),
+                                  np.asarray(jnp.moveaxis(o_bthd, 1, 2)))
+
+    seed = jnp.asarray(5, jnp.int32)
+
+    def loss(q_, k_, v_, bthd):
+        out = flash_attention(q_, k_, v_, True, None, True, 0.1, seed,
+                              bias, bthd)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    g_ref = jax.grad(lambda a, b_, c: loss(a, b_, c, False),
+                     argnums=(0, 1, 2))(q, k, v)
+    g_bthd = jax.grad(lambda a, b_, c: loss(a, b_, c, True),
+                      argnums=(0, 1, 2))(qT, kT, vT)
+    for gr, gt in zip(g_ref, g_bthd):
+        np.testing.assert_array_equal(np.asarray(gr),
+                                      np.asarray(jnp.moveaxis(gt, 1, 2)))
+
+
+def test_mha_bthd_routing_equivalence(monkeypatch):
+    """MultiHeadAttention feeds attention in BTHD layout; when flash
+    routes (train gate met) the module output must match the XLA
+    composition run on the same inputs — layout plumbing must not
+    change the math."""
+    import paddle_tpu as pt
+    from paddle_tpu import kernels
+    from paddle_tpu.kernels import flash_attention as fa_mod
+    from paddle_tpu.nn.layers.transformer import MultiHeadAttention
+
+    pt.seed(0)
+    # head dim 128 (256/2): the d%128 route is live in eval mode
+    mha = MultiHeadAttention(256, 2, dropout=0.0)
+    mha.eval()
+    x = jnp.asarray(np.random.default_rng(1).normal(0, 1, (2, 32, 256)),
+                    jnp.float32)
+    ref = np.asarray(mha(x))
+
+    monkeypatch.setattr(kernels, "_on_tpu", lambda: True)
+    orig = fa_mod.flash_attention
+    calls = []
+
+    def spy(*a, **kw):
+        calls.append(kw.get("bthd", False))
+        kw.pop("interpret", None)
+        return orig(*a, interpret=True, **kw)
+
+    monkeypatch.setattr(kernels, "flash_attention", None, raising=False)
+    monkeypatch.setattr(fa_mod, "flash_attention", spy)
+    saved = pt.get_flags(["flash_attention_min_seq"])
+    try:
+        pt.set_flags({"flash_attention_min_seq": 16})
+        got = np.asarray(mha(x))
+    finally:
+        pt.set_flags(saved)
+    assert calls and calls[0] is True, \
+        "MHA did not route the BTHD layout to flash"
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)

@@ -156,14 +156,17 @@ STAGES = {
     # train gate
     "bert_b8_flash512": ([], {**_bert(8, "0", "0")[1],
                               "FLAGS_flash_attention_min_seq_train":
-                              "512"}, 900),
+                              "512",
+                              "FLAGS_attention_bthd_layout": "0"}, 900),
     # BTHD-native flash layout (zero physical head transposes; the
-    # kernel gathers heads in its block DMA): same env as
-    # bert_b8_flash512, separate artifact so the transpose-layout
-    # number survives as the A/B partner
+    # kernel gathers heads in its block DMA): the layout flag is the
+    # ONLY difference vs bert_b8_flash512, so the A/B stays pinnable
+    # on any code version
     "bert_b8_flash_bthd": ([], {**_bert(8, "0", "0")[1],
                                 "FLAGS_flash_attention_min_seq_train":
-                                "512"}, 900),
+                                "512",
+                                "FLAGS_attention_bthd_layout": "1"},
+                           900),
     # dispatch-copy amortization at the NEW best config (flash512):
     # the only prior steps-per-loop A/B (0.95x) was at fused_b32 —
     # per-leaf b8 has far more dispatch buffers, so re-measure there
@@ -179,6 +182,19 @@ STAGES = {
     "bert_b4_flash512": ([], {**_bert(4, "0", "0")[1],
                               "FLAGS_flash_attention_min_seq_train":
                               "512"}, 900),
+    # Pallas-vs-XLA LayerNorm at the best config (use_pallas_layer_norm
+    # has been default-on [assumed] since round 2 with zero chip
+    # evidence; the r5 HLO metadata probe shows the per-layer backward
+    # pallas_call fusions at ~0.2 ms each). A/B partner:
+    # bert_b8_flash512_spl8 — identical env, only the LN route differs.
+    "bert_b8_spl8_xlaln": ([], {**_SKIP,
+                                "PT_BENCH_BERT_BATCH": "8",
+                                "PT_BENCH_FUSED": "0",
+                                "FLAGS_fused_qkv_projection": "0",
+                                "FLAGS_flash_attention_min_seq_train":
+                                "512",
+                                "FLAGS_use_pallas_layer_norm": "0",
+                                "PT_BENCH_STEPS_PER_LOOP": "8"}, 900),
     "bert_b32_remat": ([], {**_SKIP, **_SPL1,
                             "FLAGS_flash_attention_min_seq_train": "1024",
                             "PT_BENCH_BERT_BATCH": "32",
@@ -209,11 +225,25 @@ STAGES = {
     # on the model path — separates "our overhead" from "XLA's conv
     # ceiling" for the stuck ~2260 img/s
     "rn50_floor": (["128"], {}, 900, "tools/rn50_floor.py"),
-    "profile_bert": (["bert", "8"], {}, 900, "tools/profile_step.py"),
-    "profile_bert_b32": (["bert", "32"], {}, 900,
+    # Profile stages pin the config they historically profiled (same
+    # no-silent-config-change rule as the bench stages): profile_bert
+    # is the XLA-attention transpose-layout baseline whose rollup
+    # steered rounds 2-5; profile_bert_flash is the current default
+    # config (flash512 + BTHD). profile_resnet is the two-pass-BN
+    # baseline; profile_resnet_bn1pass the measured winner.
+    "profile_bert": (["bert", "8"],
+                     {"FLAGS_flash_attention_min_seq_train": "1024",
+                      "FLAGS_attention_bthd_layout": "0"},
+                     900, "tools/profile_step.py"),
+    "profile_bert_flash": (["bert", "8"], {}, 900,
+                           "tools/profile_step.py"),
+    "profile_bert_b32": (["bert", "32"],
+                         {"FLAGS_flash_attention_min_seq_train": "1024",
+                          "FLAGS_attention_bthd_layout": "0"}, 900,
                          "tools/profile_step.py"),
     "profile_resnet": (["resnet", "128"],
-                       {"PT_PROF_LAYOUT": "NHWC"}, 900,
+                       {"PT_PROF_LAYOUT": "NHWC",
+                        "FLAGS_batch_norm_single_pass": "0"}, 900,
                        "tools/profile_step.py"),
     # unpinned autotunes (the driver's default bench path)
     "bert": ([], {}, 3000),
