@@ -260,10 +260,15 @@ def bench_bert(on_accel: bool) -> None:
             return False
         # compare the JUDGED number: masked mode's honest FLOP
         # accounting means higher tokens/sec does NOT imply higher
-        # vs_baseline (it skips credited work)
-        pair = capture_pair(f"bert_b{b}_maskedlm",
-                            f"bert_b{b}_perleaf_noqkv",
+        # vs_baseline (it skips credited work). Flash-config pairs
+        # (current defaults) take precedence over the XLA-attention-era
+        # pairs when captured.
+        pair = capture_pair(f"bert_b{b}_flash_maskedlm",
+                            f"bert_b{b}_flash",
                             field="vs_baseline") or \
+            capture_pair(f"bert_b{b}_maskedlm",
+                         f"bert_b{b}_perleaf_noqkv",
+                         field="vs_baseline") or \
             capture_pair("bert_b32_maskedlm", "bert_b32_perleaf_noqkv",
                          field="vs_baseline")
         on = pair is not None and pair[0] > pair[1]
@@ -334,6 +339,22 @@ def bench_bert(on_accel: bool) -> None:
         # full-mode tokens/sec could drop the batch whose masked
         # config wins vs_baseline.
         def batch_vs(b_):
+            # flash-config artifacts (current defaults) outrank the
+            # XLA-attention-era ones when both exist — the ladder
+            # reshaped under flash (b16 139.7k > b8 129.3k, r5). b8's
+            # flash-era stages predate the bert_b*_flash naming, so
+            # its historical names join the flash-era lookup.
+            flash_names = [f"bert_b{b_}_flash",
+                           f"bert_b{b_}_flash_maskedlm"]
+            if b_ == 8:
+                flash_names += ["bert_b8_flash512_spl8",
+                                "bert_b8_flash_bthd",
+                                "bert_b8_flash512"]
+            vals = [capture_value(n, field="vs_baseline")
+                    for n in flash_names]
+            vals = [v for v in vals if v is not None]
+            if vals:
+                return max(vals)
             vals = [capture_value(f"bert_b{b_}_perleaf_noqkv",
                                   field="vs_baseline"),
                     capture_value(f"bert_b{b_}_maskedlm",
@@ -656,7 +677,7 @@ def bench_resnet(on_accel: bool) -> None:
         dt = maybe_steps_per_loop(
             step, lambda K: ((jnp.stack([x] * K),),
                              (np.stack([y] * K),)),
-            dt, 20 if on_accel else 3, 4 if on_accel else 2)
+            dt, 20 if on_accel else 3, 8 if on_accel else 2)
     else:
         log(f"budget_left {budget_left():.0f}s: skipping "
             f"steps_per_loop re-timing")

@@ -61,18 +61,18 @@ def _heads_per_block(d: int, h: int) -> int:
     whole array dim), so a d=64 head slab must ride as a head PAIR
     (128 lanes); d%128 heads ride alone. Callers gate unsupported
     combinations to the transpose path before reaching the kernel."""
-    if d % 128 == 0:
-        return 1
-    if (2 * d) % 128 == 0 and h % 2 == 0:
-        return 2
-    raise ValueError(
-        f"flash_attention bthd layout needs d%128==0 or (d%64==0 and "
-        f"even heads); got d={d}, h={h} — route via the BHTD layout")
+    if not bthd_supported(d, h):
+        raise ValueError(
+            f"flash_attention bthd layout needs d%128==0 or (d%64==0 "
+            f"and even heads); got d={d}, h={h} — route via the BHTD "
+            "layout")
+    return 1 if d % 128 == 0 else 2
 
 
 def bthd_supported(d: int, h: int) -> bool:
     """Whether the transpose-free [B, T, H, D] layout can ride the
-    kernel for this geometry (see _heads_per_block)."""
+    kernel for this geometry — the single home of the tiling rule
+    (_heads_per_block gates on it)."""
     return d % 128 == 0 or ((2 * d) % 128 == 0 and h % 2 == 0)
 
 
@@ -262,15 +262,16 @@ def _flash_forward(q, k, v, seed, scale: float, causal: bool,
     tk_p = pl.cdiv(tk, bk) * bk
     hpb = _heads_per_block(d, h) if bthd else 1
     hg = h // hpb                    # head-groups per batch element
+    lead = b if bthd else b * h      # flat leading dim of the arrays
+
+    def flat(x, t, tp):
+        x = x.reshape(lead, t, -1)
+        return jnp.pad(x, ((0, 0), (0, tp - t), (0, 0))) \
+            if tp != t else x
+
+    qr = flat(q, tq, tq_p)
+    kr, vr = flat(k, tk, tk_p), flat(v, tk, tk_p)
     if bthd:
-        qr = q.reshape(b, tq, h * d)
-        kr = k.reshape(b, tk, h * d)
-        vr = v.reshape(b, tk, h * d)
-        if tq_p != tq:
-            qr = jnp.pad(qr, ((0, 0), (0, tq_p - tq), (0, 0)))
-        if tk_p != tk:
-            kr = jnp.pad(kr, ((0, 0), (0, tk_p - tk), (0, 0)))
-            vr = jnp.pad(vr, ((0, 0), (0, tk_p - tk), (0, 0)))
         # program g handles (batch g//hg, head-group g%hg): block index
         # g%hg on the H*D dim × block width hpb*d = this group's slab
         q_spec = pl.BlockSpec((1, bq, hpb * d),
@@ -281,14 +282,6 @@ def _flash_forward(q, k, v, seed, scale: float, causal: bool,
                                memory_space=pltpu.VMEM)
         out_struct = jax.ShapeDtypeStruct((b, tq_p, h * d), q.dtype)
     else:
-        qr = q.reshape(b * h, tq, d)
-        kr = k.reshape(b * h, tk, d)
-        vr = v.reshape(b * h, tk, d)
-        if tq_p != tq:
-            qr = jnp.pad(qr, ((0, 0), (0, tq_p - tq), (0, 0)))
-        if tk_p != tk:
-            kr = jnp.pad(kr, ((0, 0), (0, tk_p - tk), (0, 0)))
-            vr = jnp.pad(vr, ((0, 0), (0, tk_p - tk), (0, 0)))
         q_spec = pl.BlockSpec((1, bq, d), lambda g, i: (g, i, 0),
                               memory_space=pltpu.VMEM)
         kv_spec = pl.BlockSpec((1, tk_p, d), lambda g, i: (g, 0, 0),

@@ -132,6 +132,41 @@ STAGES = {
         ["resnet50"], {**_SKIP, **_SPL1, "PT_BENCH_RESNET_BATCH": "128",
                        "PT_BENCH_LAYOUT": "NHWC", "PT_BENCH_FUSED": "0",
                        "FLAGS_batch_norm_single_pass": "1"}, 900),
+    # dispatch-gap reclaim: the bn1pass profile shows 48.2 ms device
+    # vs 52.1 ms wall — the SPL1 pinning of the lever ladder never
+    # amortized the ~4 ms dispatch gap; a K=8 lax.scan dispatches once
+    # per 8 optimizer steps and should reclaim most of it (measured:
+    # 2582.6 vs 2455.9 img/s, +5.2%)
+    "resnet_bn1pass_spl8": (
+        ["resnet50"], {**_SKIP, "PT_BENCH_RESNET_BATCH": "128",
+                       "PT_BENCH_LAYOUT": "NHWC", "PT_BENCH_FUSED": "0",
+                       "FLAGS_batch_norm_single_pass": "1",
+                       "PT_BENCH_STEPS_PER_LOOP": "8"}, 900),
+    # flash batch ladder: under XLA attention the ladder peaked at b8
+    # (the backward's [B,H,T,T] fp32 probs scale with batch); flash
+    # removes that wall and the unpinned r5 sweep found b16 at 139.7k
+    # (0.5856) — measure the ladder's new top. Default flags (flash
+    # 512, BTHD, Pallas LN) + auto spl retiming.
+    "bert_b16_flash": ([], {**_SKIP, "PT_BENCH_BERT_BATCH": "16",
+                            "PT_BENCH_FUSED": "0"}, 900),
+    "bert_b32_flash": ([], {**_SKIP, "PT_BENCH_BERT_BATCH": "32",
+                            "PT_BENCH_FUSED": "0"}, 900),
+    "bert_b64_flash": ([], {**_SKIP, "PT_BENCH_BERT_BATCH": "64",
+                            "PT_BENCH_FUSED": "0"}, 900),
+    "bert_b16_flash_maskedlm": ([], {**_SKIP,
+                                     "PT_BENCH_BERT_BATCH": "16",
+                                     "PT_BENCH_FUSED": "0",
+                                     "PT_BENCH_MASKED_LM": "1"}, 900),
+    # steps-per-loop ladder top: does K=32 add anything over K=8's
+    # +1.4% at the BERT headline config
+    "bert_b8_flash512_spl32": ([], {**_SKIP,
+                                    "PT_BENCH_BERT_BATCH": "8",
+                                    "PT_BENCH_FUSED": "0",
+                                    "FLAGS_fused_qkv_projection": "0",
+                                    "FLAGS_flash_attention_min_seq_train":
+                                    "512",
+                                    "PT_BENCH_STEPS_PER_LOOP": "32"},
+                               900),
     # stack the two stem/stat levers on top of the bn1pass win (+8.5%
     # measured): s2d alone was +0.8% (noise) — see if it adds anything
     # once BN stats no longer dominate the loop fusions
