@@ -327,7 +327,9 @@ def bench_bert(on_accel: bool) -> None:
     if batch_env:
         batch_opts = [int(batch_env)]
     else:
-        batch_opts = [8, 32, 16] if on_accel else [2]
+        # b16 first: the r5 flash ladder peaks there (139.3k tok/s);
+        # the capture-driven reorder below refines from artifacts
+        batch_opts = [16, 8, 32] if on_accel else [2]
     if on_accel and not batch_env:
         # diag-campaign artifacts reorder the sweep among MEASURED
         # batches only (selection still re-measures; this only decides
@@ -348,6 +350,7 @@ def bench_bert(on_accel: bool) -> None:
                            f"bert_b{b_}_flash_maskedlm"]
             if b_ == 8:
                 flash_names += ["bert_b8_flash512_spl8",
+                                "bert_b8_flash512_spl32",
                                 "bert_b8_flash_bthd",
                                 "bert_b8_flash512"]
             vals = [capture_value(n, field="vs_baseline")

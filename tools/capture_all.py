@@ -157,6 +157,13 @@ STAGES = {
                                      "PT_BENCH_BERT_BATCH": "16",
                                      "PT_BENCH_FUSED": "0",
                                      "PT_BENCH_MASKED_LM": "1"}, 900),
+    # ladder midpoint: b16 139.3k > b32 136.1k — the peak may sit
+    # between
+    "bert_b24_flash": ([], {**_SKIP, "PT_BENCH_BERT_BATCH": "24",
+                            "PT_BENCH_FUSED": "0"}, 900),
+    # where do the remaining ~53% of peak go at the new headline config
+    "profile_bert_b16_flash": (["bert", "16"], {}, 900,
+                               "tools/profile_step.py"),
     # steps-per-loop ladder top: does K=32 add anything over K=8's
     # +1.4% at the BERT headline config
     "bert_b8_flash512_spl32": ([], {**_SKIP,
@@ -405,8 +412,11 @@ def run_stage(name: str) -> dict:
            "rc": rc, "timed_out": timed_out, "parsed": parsed,
            "elapsed_s": round(time.time() - t0, 1),
            "env": env,
-           "stdout_tail": (stdout or "").splitlines()[-45:],
-           "stderr_tail": (stderr or "").splitlines()[-25:]}
+           # 90 lines keeps a full profiler rollup (categories + top-30
+           # table) — 45 cut the category header off every profile
+           # artifact this round
+           "stdout_tail": (stdout or "").splitlines()[-90:],
+           "stderr_tail": (stderr or "").splitlines()[-40:]}
     result_path = os.path.join(ROOT, f"CAPTURE_{name}.json")
     with open(result_path, "w") as f:
         json.dump(out, f, indent=1)
