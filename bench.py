@@ -327,8 +327,9 @@ def bench_bert(on_accel: bool) -> None:
     if batch_env:
         batch_opts = [int(batch_env)]
     else:
-        # b16 first: the r5 flash ladder peaks there (139.3k tok/s);
-        # the capture-driven reorder below refines from artifacts
+        # b16 first: the r5 flash ladder peaks there (147.8k tok/s
+        # with the fused single-block backward); the capture-driven
+        # reorder below refines from artifacts
         batch_opts = [16, 8, 32] if on_accel else [2]
     if on_accel and not batch_env:
         # diag-campaign artifacts reorder the sweep among MEASURED

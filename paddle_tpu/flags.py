@@ -238,6 +238,15 @@ define_flag("transformer_remat", False,
             "where XLA otherwise spills. (ref capability: "
             "recompute/checkpointing strategy, fleet "
             "DistributedStrategy.recompute.)")
+define_flag("resnet_block_remat", False,
+            "Rematerialize each residual block in the backward "
+            "(jax.checkpoint per block, BN stats threaded explicitly "
+            "through the boundary). [assumed — conservative] Off "
+            "pending the resnet_remat chip A/B: the r5 profile says "
+            "the step is HBM-bound with conv fusions at HBM peak, so "
+            "recompute FLOPs are cheap relative to the activation "
+            "round-trips they remove — the opposite regime from BERT, "
+            "where remat measured -29%.")
 define_flag("resnet_space_to_depth_stem", False,
             "Rewrite the ResNet 7x7/s2 stem conv as an exact 4x4/s1 "
             "conv over space-to-depth-folded 12-channel input (the "

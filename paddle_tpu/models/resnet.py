@@ -169,6 +169,36 @@ class ResNet(nn.Layer):
                 else block(self.inplanes, planes, data_format=df))
         return nn.Sequential(*layers)
 
+    def _run_stage(self, seq, x):
+        """One layerN stage; with resnet_block_remat on (training), each
+        residual block rematerializes in the backward — the step is
+        HBM-bound (r5 profile: conv fusions at HBM peak), so recompute
+        FLOPs ride idle MXU cycles while the intermediate activations
+        never round-trip HBM. BN running stats are threaded EXPLICITLY
+        through the jax.checkpoint boundary (the side-channel buffer
+        capture would leak inner-trace values)."""
+        if not (self.training and GLOBAL_FLAGS.get("resnet_block_remat")):
+            return seq(x)
+        import jax
+
+        from ..nn.layer import functional_call
+        for blk in seq._sub_layers.values():
+            params = blk.param_dict(trainable_only=False)
+            buffers = blk.buffer_dict()
+
+            def fn(p, bufs, xx, _blk=blk):
+                return functional_call(_blk, p, bufs, xx,
+                                       capture_buffers=True)
+
+            x, new_bufs = jax.checkpoint(fn)(params, buffers, x)
+            # nested bind restored the pre-block buffers on exit; push
+            # the updated values back so the OUTER capture sees them
+            slots = blk._named_buffer_slots()
+            for n, v in new_bufs.items():
+                sub, bname = slots[n]
+                sub._buffers[bname] = v
+        return x
+
     def forward(self, x):
         # per-model override beats the global flag (lets a bench A/B
         # candidates without mutating process state)
@@ -180,10 +210,10 @@ class ResNet(nn.Layer):
         else:
             x = self.conv1(x)
         x = self.maxpool(self.relu(self.bn1(x)))
-        x = self.layer1(x)
-        x = self.layer2(x)
-        x = self.layer3(x)
-        x = self.layer4(x)
+        x = self._run_stage(self.layer1, x)
+        x = self._run_stage(self.layer2, x)
+        x = self._run_stage(self.layer3, x)
+        x = self._run_stage(self.layer4, x)
         x = self.flatten(self.avgpool(x))
         return self.fc(x)
 

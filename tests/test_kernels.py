@@ -751,3 +751,37 @@ def test_mha_bthd_routing_equivalence(monkeypatch):
     assert calls and calls[0] is True, \
         "MHA did not route the BTHD layout to flash"
     np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_fused_single_block_backward_matches_scanning(rng):
+    """The fused single-block backward (default tiles, T <= tile) must
+    produce the same gradients as the scanning two-kernel path (forced
+    small tiles) under causal + dropout + key bias — the exact branch
+    combination the production BERT config runs. Locks the fused
+    kernel's inline mask/dropout/bias math to the scanning kernels'."""
+    from paddle_tpu.kernels import flash_attention as fa
+
+    b, h, t, d = 2, 2, 96, 64
+    q = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, h, t, d)), jnp.float32)
+    bias = (jnp.where(jnp.arange(t)[None, :] < t - 5, 0.0, -1e30)
+            .astype(jnp.float32) * jnp.ones((b, 1)))
+    seed = jnp.asarray(11, jnp.int32)
+
+    def grads(q_, k_, v_):
+        return jax.grad(
+            lambda a, b_, c: jnp.sum(fa.flash_attention(
+                a, b_, c, True, None, True, 0.1, seed, bias) ** 2),
+            argnums=(0, 1, 2))(q_, k_, v_)
+
+    g_fused = grads(q, k, v)          # default 512 tiles -> fused path
+    orig_q, orig_k = fa.BLOCK_Q, fa.BLOCK_K
+    fa.BLOCK_Q, fa.BLOCK_K = 32, 32   # multi-block -> scanning path
+    try:
+        g_scan = grads(q, k, v)
+    finally:
+        fa.BLOCK_Q, fa.BLOCK_K = orig_q, orig_k
+    for gf, gs, name in zip(g_fused, g_scan, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gs),
+                                   rtol=2e-4, atol=2e-4, err_msg=name)

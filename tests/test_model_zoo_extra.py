@@ -557,3 +557,49 @@ def test_resnet_space_to_depth_stem_exact():
     finally:
         pt.set_flags({"resnet_space_to_depth_stem": False})
     assert out_odd.shape == (1, 10)
+
+
+def test_resnet_block_remat_parity():
+    """resnet_block_remat must be a pure scheduling change: losses,
+    gradients (via identical post-step losses), and BN running stats
+    match the no-remat step exactly. BN buffers cross the
+    jax.checkpoint boundary explicitly (the side-channel capture would
+    leak inner-trace values), so buffer parity is the load-bearing
+    assertion."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.models.resnet import BasicBlock, ResNet
+    from paddle_tpu.static import TrainStep
+
+    def run(remat: bool):
+        pt.set_flags({"resnet_block_remat": remat})
+        pt.seed(0)
+        m = ResNet(BasicBlock, [1, 1, 1, 1], num_classes=4,
+                   data_format="NHWC")
+        o = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+        step = TrainStep(m, o, pt.nn.CrossEntropyLoss())
+        rng = np.random.default_rng(0)
+        x = rng.normal(0, 1, (2, 16, 16, 3)).astype(np.float32)
+        y = rng.integers(0, 4, (2,)).astype(np.int64)
+        losses = [float(np.ravel(np.asarray(
+            step(x, labels=(y,))["loss"]))[0]) for _ in range(2)]
+        bufs = {k: np.asarray(v)
+                for k, v in step.state["buffers"].items()}
+        return losses, bufs
+
+    saved = pt.get_flags(["resnet_block_remat"])
+    try:
+        l_ref, b_ref = run(False)
+        l_rm, b_rm = run(True)
+    finally:
+        pt.set_flags(saved)
+    np.testing.assert_allclose(l_ref, l_rm, rtol=1e-5, atol=1e-6)
+    assert set(b_ref) == set(b_rm)
+    updated = 0
+    for k in b_ref:
+        np.testing.assert_allclose(b_ref[k], b_rm[k], rtol=1e-5,
+                                   atol=1e-6, err_msg=k)
+        if "_mean" in k and np.abs(b_ref[k]).sum() > 0:
+            updated += 1
+    assert updated, "BN means never updated — remat dropped buffers"

@@ -174,6 +174,15 @@ STAGES = {
                                     "512",
                                     "PT_BENCH_STEPS_PER_LOOP": "32"},
                                900),
+    # block remat on the HBM-bound step: recompute FLOPs ride idle MXU
+    # while intermediate activations skip the HBM round-trip — A/B
+    # partner is resnet_bn1pass_spl8 (identical env, only the flag)
+    "resnet_remat": (
+        ["resnet50"], {**_SKIP, "PT_BENCH_RESNET_BATCH": "128",
+                       "PT_BENCH_LAYOUT": "NHWC", "PT_BENCH_FUSED": "0",
+                       "FLAGS_batch_norm_single_pass": "1",
+                       "FLAGS_resnet_block_remat": "1",
+                       "PT_BENCH_STEPS_PER_LOOP": "8"}, 900),
     # stack the two stem/stat levers on top of the bn1pass win (+8.5%
     # measured): s2d alone was +0.8% (noise) — see if it adds anything
     # once BN stats no longer dominate the loop fusions
