@@ -95,11 +95,15 @@ def emit_partial(result: dict) -> None:
                 TypeError):
             pass
         old = entries.get(res["metric"])
-        if old is not None and old.get("device") == res.get("device") \
+        if isinstance(old, dict) \
+                and old.get("device") == res.get("device") \
                 and (old.get("vs_baseline") or 0) \
                 >= (res.get("vs_baseline") or 0):
+            import calendar
             try:
-                age = time.time() - time.mktime(time.strptime(
+                # "when" is stamped with gmtime: parse it back as UTC
+                # (mktime would shift the window by the host's offset)
+                age = time.time() - calendar.timegm(time.strptime(
                     old.get("when", ""), "%Y-%m-%dT%H:%M:%SZ"))
             except (ValueError, TypeError):
                 age = float("inf")
@@ -209,6 +213,37 @@ def capture_value(stage: str, any_device: bool = False,
         pass
     _capture_cache[key] = val
     return val
+
+
+def bert_batch_stages(b: int) -> list:
+    """Flash-era capture stages whose artifacts can carry batch ``b``'s
+    judged number (b8's flash-era stages predate the bert_b*_flash
+    naming, so its historical names join the lookup). One list so
+    bench's sweep ordering and tools/recommend.py report the SAME
+    evidence set."""
+    names = [f"bert_b{b}_flash", f"bert_b{b}_flash_maskedlm"]
+    if b == 8:
+        names += ["bert_b8_flash512_spl8", "bert_b8_flash512_spl32",
+                  "bert_b8_flash_bthd", "bert_b8_flash512"]
+    return names
+
+
+def bert_batch_judged(b: int, any_device: bool = False):
+    """Best judged (vs_baseline) capture for per-chip batch ``b``.
+    Flash-config artifacts (current defaults) outrank the
+    XLA-attention-era ones when both exist — the ladder reshaped under
+    flash (b16 above b8, r5)."""
+    vals = [capture_value(n, any_device=any_device, field="vs_baseline")
+            for n in bert_batch_stages(b)]
+    vals = [v for v in vals if v is not None]
+    if vals:
+        return max(vals)
+    vals = [capture_value(f"bert_b{b}_perleaf_noqkv",
+                          any_device=any_device, field="vs_baseline"),
+            capture_value(f"bert_b{b}_maskedlm",
+                          any_device=any_device, field="vs_baseline")]
+    vals = [v for v in vals if v is not None]
+    return max(vals) if vals else None
 
 
 def capture_pair(on_stage: str, off_stage: str, field: str = "value"):
@@ -376,32 +411,7 @@ def bench_bert(on_accel: bool) -> None:
         # JUDGED number across BOTH head modes per batch — cutting by
         # full-mode tokens/sec could drop the batch whose masked
         # config wins vs_baseline.
-        def batch_vs(b_):
-            # flash-config artifacts (current defaults) outrank the
-            # XLA-attention-era ones when both exist — the ladder
-            # reshaped under flash (b16 139.7k > b8 129.3k, r5). b8's
-            # flash-era stages predate the bert_b*_flash naming, so
-            # its historical names join the flash-era lookup.
-            flash_names = [f"bert_b{b_}_flash",
-                           f"bert_b{b_}_flash_maskedlm"]
-            if b_ == 8:
-                flash_names += ["bert_b8_flash512_spl8",
-                                "bert_b8_flash512_spl32",
-                                "bert_b8_flash_bthd",
-                                "bert_b8_flash512"]
-            vals = [capture_value(n, field="vs_baseline")
-                    for n in flash_names]
-            vals = [v for v in vals if v is not None]
-            if vals:
-                return max(vals)
-            vals = [capture_value(f"bert_b{b_}_perleaf_noqkv",
-                                  field="vs_baseline"),
-                    capture_value(f"bert_b{b_}_maskedlm",
-                                  field="vs_baseline")]
-            vals = [v for v in vals if v is not None]
-            return max(vals) if vals else None
-
-        meas = {b_: batch_vs(b_) for b_ in batch_opts}
+        meas = {b_: bert_batch_judged(b_) for b_ in batch_opts}
         if any(v is not None for v in meas.values()):
             batch_opts = reorder_measured(batch_opts, meas)
             log(f"measured batch order from captures: {meas}")

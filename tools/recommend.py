@@ -62,20 +62,46 @@ def main() -> None:
                      implies_fmt.format(win=win)))
         return win
 
+    # ---- flash-era ladder (current defaults): computed with bench's
+    # OWN evidence reader (bert_batch_judged / bert_batch_stages) so
+    # this row cannot diverge from the ordering bench actually applies
+    from bench import bert_batch_judged, bert_batch_stages
+    fvals = {b: bert_batch_judged(b, any_device=True)
+             for b in (8, 16, 24, 32, 64)}
+    meas = {b: v for b, v in fvals.items() if v is not None}
+    if meas:
+        order = sorted(meas, key=lambda b: -meas[b])
+        rows.append(("BERT batch order (FLASH era, judged)",
+                     " > ".join(f"b{b}={meas[b]:.4f}" for b in order)
+                     + partial_tag(*(s for b in order
+                                     for s in bert_batch_stages(b))),
+                     f"bench batch_opts lead = {order[:2]}"))
+    compare("flash in-model @seq512 (b8)",
+            "bert_b8_flash512", "bert_b8_perleaf_noqkv",
+            "flash", "xla_attn",
+            "flags.flash_attention_min_seq_train = 512 iff flash wins")
+    compare("BTHD layout (b8 flash)",
+            "bert_b8_flash_bthd", "bert_b8_flash512",
+            "bthd", "transpose",
+            "flags.attention_bthd_layout default = {win}")
+    compare("Pallas vs XLA LayerNorm (b8 spl8)",
+            "bert_b8_flash512_spl8", "bert_b8_spl8_xlaln",
+            "pallas_ln", "xla_ln",
+            "flags.use_pallas_layer_norm default = {win}")
     # fused QKV at b8 (round-2 chip said -3%, round-3 HLO said better)
     compare("fused QKV projection (b8)",
             "bert_b8_perleaf_qkv", "bert_b8_perleaf_noqkv",
             "qkv_on", "qkv_off",
             "flags.fused_qkv_projection default = {win}")
-    # batch scaling, per-leaf
+    # batch scaling, per-leaf (XLA-attention era, historical)
     vals = {b: tok(f"bert_b{b}_perleaf_noqkv") for b in (8, 16, 32)}
     if all(v is not None for v in vals.values()):
         order = sorted(vals, key=lambda b: -vals[b])
-        rows.append(("BERT batch order (per-leaf, noqkv)",
+        rows.append(("BERT batch order (XLA-attn era)",
                      " > ".join(f"b{b}={vals[b]:.0f}" for b in order)
                      + partial_tag(*(f"bert_b{b}_perleaf_noqkv"
                                      for b in order)),
-                     f"bench batch_opts = {order}"))
+                     "historical; flash-era order governs"))
     else:
         rows.append(("BERT batch order",
                      f"PENDING ({ {b: v for b, v in vals.items()} })",
@@ -108,6 +134,24 @@ def main() -> None:
                      "flags.optimizer_moment_dtype default = "
                      f"{'bfloat16' if mv > b8 else 'float32'}"))
     # resnet
+    compare("ResNet BN single-pass (b128)",
+            "resnet_bn1pass", "resnet_nhwc_b128_perleaf",
+            "bn1pass", "two-pass",
+            "flags.batch_norm_single_pass default = {win}")
+    compare("ResNet steps-per-loop 8 (bn1pass)",
+            "resnet_bn1pass_spl8", "resnet_bn1pass",
+            "spl8", "spl1",
+            "bench resnet default_spl = 8 iff spl8 wins")
+    compare("ResNet block remat (bn1pass+spl8)",
+            "resnet_remat", "resnet_bn1pass_spl8",
+            "remat", "no-remat",
+            "flags.resnet_block_remat default = {win}")
+    floor = tok("rn50_floor")
+    if floor is not None:
+        rows.append(("raw-JAX RN50 floor probe",
+                     f"{floor:.0f} img/s"
+                     + partial_tag("rn50_floor"),
+                     "framework-overhead bound (single dispatch)"))
     compare("ResNet s2d stem (b128 NHWC)",
             "resnet_nhwc_b128_s2d", "resnet_nhwc_b128_perleaf",
             "s2d", "plain",
