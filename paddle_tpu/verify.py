@@ -133,6 +133,64 @@ def validate_kernels_on_tpu() -> list:
     except Exception as e:  # noqa: BLE001
         failures.append(f"flash_attention_d64_dropout: {e}")
 
+    # BTHD layout (paired d=64 heads ride one 128-lane block) must match
+    # the classic layout in compiled mode — DISTINCT q/k/v tensors and
+    # per-input grads, so a dq/dk/dv routing swap cannot cancel out
+    try:
+        from paddle_tpu.kernels.flash_attention import flash_attention
+        q = jnp.asarray(rng.normal(0, 1, (1, 4, 256, 64)), jnp.float32)
+        k = jnp.asarray(rng.normal(0, 1, (1, 4, 256, 64)), jnp.float32)
+        v = jnp.asarray(rng.normal(0, 1, (1, 4, 256, 64)), jnp.float32)
+        qT, kT, vT = (jnp.moveaxis(x, 1, 2) for x in (q, k, v))
+
+        def f_cls(q_, k_, v_):
+            return jnp.sum(flash_attention(q_, k_, v_) ** 2)
+
+        def f_bthd(q_, k_, v_):
+            return jnp.sum(flash_attention(
+                q_, k_, v_, False, None, False, 0.0, None, None, True)
+                ** 2)
+
+        vc, gc = jax.value_and_grad(f_cls, argnums=(0, 1, 2))(q, k, v)
+        vb, gb = jax.value_and_grad(f_bthd,
+                                    argnums=(0, 1, 2))(qT, kT, vT)
+        np.testing.assert_allclose(float(vc), float(vb), rtol=1e-6)
+        for a, c in zip(gc, gb):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(jnp.moveaxis(c, 1, 2)),
+                rtol=1e-5, atol=1e-6)
+        _log("kernel-validate flash bthd layout: OK")
+    except Exception as e:  # noqa: BLE001
+        failures.append(f"flash_bthd_layout: {e}")
+
+    # multi-block (scanning) backward at T > one tile: the single-block
+    # fused kernel covers the checks above, so the long-context scan
+    # path needs its own compiled grad check — distinct inputs + causal
+    try:
+        from paddle_tpu.kernels.flash_attention import flash_attention
+        from paddle_tpu.ops.attention import scaled_dot_product_attention
+        q = jnp.asarray(rng.normal(0, 1, (1, 2, 1024, 64)), jnp.float32)
+        k = jnp.asarray(rng.normal(0, 1, (1, 2, 1024, 64)), jnp.float32)
+        v = jnp.asarray(rng.normal(0, 1, (1, 2, 1024, 64)), jnp.float32)
+
+        def m_pallas(q_, k_, v_):
+            return jnp.sum(flash_attention(q_, k_, v_, True) ** 2)
+
+        def m_ref(q_, k_, v_):
+            return jnp.sum(scaled_dot_product_attention(
+                q_, k_, v_, causal=True) ** 2)
+
+        vp, gp = jax.value_and_grad(m_pallas,
+                                    argnums=(0, 1, 2))(q, k, v)
+        vr, gr = jax.value_and_grad(m_ref, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(float(vp), float(vr), rtol=2e-3)
+        for a, c in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                       rtol=5e-3, atol=5e-3)
+        _log("kernel-validate flash multi-block bwd: OK")
+    except Exception as e:  # noqa: BLE001
+        failures.append(f"flash_multiblock_bwd: {e}")
+
     # fused adam vs elementwise composition
     try:
         from paddle_tpu.kernels.fused_adam import fused_adam_flat
