@@ -656,6 +656,15 @@ def bench_resnet(on_accel: bool) -> None:
             s2d_pin = bool(pair[0] > pair[1])
             log(f"s2d stem={s2d_pin} from captures "
                 f"({pair[0]:.0f} vs {pair[1]:.0f} img/s)")
+    if on_accel and os.environ.get("FLAGS_resnet_block_remat") is None:
+        # block remat on the HBM-bound step (same pinning as its A/B
+        # partner: bn1pass + spl8) — measured winner governs
+        pair = capture_pair("resnet_remat", "resnet_bn1pass_spl8")
+        if pair is not None:
+            pt.set_flags({"resnet_block_remat": pair[0] > pair[1]})
+            log(f"resnet_block_remat={pair[0] > pair[1]} from captures "
+                f"(remat {pair[0]:.0f} vs no-remat {pair[1]:.0f} "
+                f"img/s)")
     candidates = [(b_, df, fu, s2d_pin and df == "NHWC")
                   for b_ in batches for df in layouts for fu in fuseds]
     # keep the sweep bounded: batch dim rides the first layout/fused
