@@ -78,6 +78,11 @@ class ClipGradByGlobalNorm(ClipGradBase):
         sq = [jnp.sum(jnp.square(_values(g))) for g in _leaves(grads)
               if g is not None]
         global_norm = jnp.sqrt(jnp.sum(jnp.stack(sq)))
+        # grad-norm gauge: inserts a debug callback into the traced
+        # program only when metrics are on AT TRACE TIME (off = zero
+        # compiled overhead; flipping the flag later needs a retrace)
+        from .observability import observe_traced
+        observe_traced("grad_global_norm", global_norm)
         scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
         return _map(lambda g: _scale(g, scale), grads)
 

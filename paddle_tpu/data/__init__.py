@@ -17,10 +17,13 @@ jax.device_put (the BufferedReader.ReadAsync role).
 from __future__ import annotations
 
 import itertools
+import time
 from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
 
 import jax
 import numpy as np
+
+from ..observability import metrics as _obs_metrics
 
 
 class Dataset:
@@ -233,6 +236,31 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in indices])
 
     def __iter__(self):
+        if not _obs_metrics.enabled():
+            yield from self._iter_raw()
+            return
+        # production-visibility path: count batches and measure how
+        # long the consumer waited on the pipeline for each one
+        batches = _obs_metrics.counter(
+            "data_batches_total", "batches produced by DataLoader")
+        wait_h = _obs_metrics.histogram(
+            "data_batch_wait_seconds",
+            "time the training loop waited on the data pipeline")
+        it = self._iter_raw()
+        try:
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    b = next(it)
+                except StopIteration:
+                    return
+                wait_h.observe(time.perf_counter() - t0)
+                batches.inc()
+                yield b
+        finally:
+            it.close()
+
+    def _iter_raw(self):
         if self.num_workers <= 0:
             yield from self._iter_batches()
             return

@@ -283,3 +283,33 @@ define_flag("io_threadpool_size", 4,
 define_flag("fuse_parameter_groups_size", 32 * 1024 * 1024,
             "Gradient coalescing bucket size in bytes for DP fusion "
             "(ref: FLAGS_fuse_parameter_groups_size).")
+
+
+def _enable_metrics_changed(value) -> None:
+    # keep the observability module's cached fast-path bool in sync
+    # (lazy import: observability imports this module)
+    from .observability import metrics as _obs_metrics
+    _obs_metrics.set_enabled(bool(value))
+
+
+define_flag("enable_metrics", False,
+            "Master switch for the observability subsystem: metrics "
+            "registry writes, host span tracing, and per-call jit "
+            "cache-hit accounting. Off = near-free early return on "
+            "every instrumented hot path (trace-time-only accounting "
+            "like recompile counts stays on — it costs nothing per "
+            "step). (ref capability: monitor.h stats + "
+            "Enable/DisableProfiler.)",
+            on_change=_enable_metrics_changed)
+define_flag("trace_dir", "",
+            "If set, observability.export_all()/Model.fit write the "
+            "host chrome-trace (host_trace.json) and metrics snapshot "
+            "(metrics.json) under this directory at train end; "
+            "tools/trace_report.py reads it. (ref: chrome-trace "
+            "profiler output path, profiler.h:208.)")
+define_flag("recompile_warn_threshold", 8,
+            "Warn (once per function) when one jit entry point has "
+            "been traced for at least this many distinct input "
+            "signatures — a recompilation storm usually means "
+            "unpadded/unbucketed input shapes. 0 disables the "
+            "warning.")

@@ -16,6 +16,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import io as io_mod
+from . import observability as _obs
+from .flags import GLOBAL_FLAGS
 from .metric import Metric
 from .nn.layer import Layer
 from .optimizer import Optimizer
@@ -260,9 +262,39 @@ class Model:
                 totals: Dict[str, jnp.ndarray] = {}
                 count = 0
                 logs: Dict[str, float] = {}
+                obs_on = _obs.enabled()
+                if obs_on:
+                    step_hist = _obs.histogram(
+                        "hapi_step_time_seconds",
+                        "fit() per-step wall time (dispatch, not sync)")
+                    tput_g = _obs.gauge(
+                        "hapi_throughput_items_per_sec",
+                        "items/s of the latest fit() step")
+                    loss_g = _obs.gauge(
+                        "hapi_loss",
+                        "latest training loss (held as a device array; "
+                        "synced only at snapshot time)")
+                    mem_g = _obs.gauge(
+                        "device_mem_bytes_in_use",
+                        "per-device allocator bytes_in_use watermark")
                 for i, batch in enumerate(train_loader):
                     *inputs, label = batch
+                    if obs_on:
+                        t0 = time.perf_counter()
                     metrics = step(*inputs, labels=(label,))
+                    if obs_on:
+                        # host-side accounting only: the loss gauge keeps
+                        # the device array (no sync), memory stats query
+                        # the allocator, never the stream
+                        dt = time.perf_counter() - t0
+                        step_hist.observe(dt)
+                        items = int(np.shape(label)[0]) \
+                            if np.ndim(label) else 1
+                        tput_g.set(items / dt if dt > 0 else 0.0)
+                        loss_g.set(metrics.get("loss"))
+                        for dev, b in _obs.device_memory_stats(
+                                include_unavailable=True).items():
+                            mem_g.set_max(b, device=dev)
                     for k, v in metrics.items():
                         # running device-side sum: O(1) buffers, still one
                         # async dispatch per step (no host sync)
@@ -283,6 +315,10 @@ class Model:
                     break
             for cb in callbacks:
                 cb.on_train_end()
+            if _obs.enabled() and GLOBAL_FLAGS.get("trace_dir"):
+                # host chrome-trace + metrics snapshot for
+                # tools/trace_report.py
+                _obs.export_all()
         finally:
             self._fitting = False
             # Must run even on an interrupted fit: the jitted step donated

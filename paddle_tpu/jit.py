@@ -31,6 +31,7 @@ import numpy as np
 
 from . import io as io_mod
 from .nn.layer import Layer, functional_call
+from .observability import instrumented_jit
 
 __all__ = ["to_static", "declarative", "not_to_static", "StaticFunction",
            "TracedLayer",
@@ -77,8 +78,10 @@ class StaticFunction:
     """
 
     def __init__(self, fn: Callable, input_spec=None,
-                 convert_cf: bool = True) -> None:
+                 convert_cf: bool = True,
+                 name: Optional[str] = None) -> None:
         self._fn = fn
+        self._name = name
         self._input_spec = input_spec
         self.conversion_note = None
         run = fn
@@ -91,7 +94,12 @@ class StaticFunction:
             except Exception as e:  # noqa: BLE001
                 run, self.conversion_note = fn, f"conversion failed: {e}"
         self._converted = run
-        self._jitted = jax.jit(run)
+        # jit through the recompile tracker: every retrace of this
+        # function is counted (and storm-warned) per display name
+        if self._name is None:
+            self._name = "to_static:" + getattr(
+                fn, "__qualname__", getattr(fn, "__name__", "fn"))
+        self._jitted = instrumented_jit(run, self._name)
         self.__wrapped__ = fn
 
     def __call__(self, *args, **kwargs):
@@ -145,7 +153,8 @@ def to_static(function=None, input_spec=None):
             def call(*args, **kwargs):
                 return layer(*args, **kwargs)
 
-            sf = StaticFunction(call, input_spec, convert_cf=False)
+            sf = StaticFunction(call, input_spec, convert_cf=False,
+                                name=f"to_static:{type(layer).__name__}")
             sf.conversion_note = note
             sf.layer = layer
 

@@ -1,0 +1,129 @@
+"""Framework-wide telemetry.
+
+TPU-native rebuild of the reference's three-part observability stack
+(/root/reference/paddle/fluid/platform/: profiler.h RecordEvent spans +
+chrome-trace output, device_tracer.cc CUPTI timelines, monitor.h stat
+registry) as one subsystem:
+
+- :mod:`metrics`   — typed counters/gauges/histograms with labeled
+  series, Prometheus text exposition + JSON snapshot (absorbs the old
+  ``profiler.StatRegistry``).
+- :mod:`tracer`    — nestable, thread-aware host spans exported as
+  Chrome ``traceEvents`` JSON (Perfetto/TensorBoard-loadable), each
+  span forwarded to ``jax.profiler.TraceAnnotation`` so host and XLA
+  timelines line up.
+- :mod:`recompile` — jit cache hit/trace accounting, per-function
+  compile latency, triggering shapes, recompile-storm warnings.
+- :mod:`trace_agg` — chrome/perfetto trace parsing + the
+  reference-style aggregated summary tables (shared by
+  tools/profile_step.py and tools/trace_report.py).
+
+Everything instrument-shaped is gated on ``FLAGS_enable_metrics``: off
+(the default) is a near-free early return on every hot path; the old
+explicit user APIs (``profiler.RecordEvent``/``stat_add``) stay
+always-on because calling them is its own opt-in.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Dict, Optional
+
+from . import metrics, recompile, trace_agg, tracer
+from .metrics import (counter, enabled, gauge, histogram, registry,
+                      set_enabled)
+from .recompile import instrumented_jit
+from .recompile import tracker as recompile_tracker
+from .tracer import export_chrome_trace, span
+from .tracer import tracer as get_tracer
+
+__all__ = ["metrics", "tracer", "recompile", "trace_agg",
+           "counter", "gauge", "histogram", "registry", "enabled",
+           "set_enabled", "span", "export_chrome_trace", "get_tracer",
+           "instrumented_jit", "recompile_tracker",
+           "observe_traced", "device_memory_stats", "export_all",
+           "reset_all"]
+
+_mem_warned = False
+
+
+def device_memory_stats(include_unavailable: bool = False
+                        ) -> Dict[str, int]:
+    """Per-device ``bytes_in_use`` (allocator-stats analogue of the
+    reference's memory/stats + gpu_info mem flags).
+
+    Backends without allocator stats (CPU returns None) are skipped, or
+    reported as 0 with ``include_unavailable=True`` (so dashboards keep
+    the series). A backend that *errors* is surfaced with a one-time
+    warning instead of being silently swallowed.
+    """
+    global _mem_warned
+    import jax
+    out: Dict[str, int] = {}
+    for d in jax.local_devices():
+        try:
+            ms = d.memory_stats()
+        except (RuntimeError, NotImplementedError, AttributeError) as e:
+            if not _mem_warned:
+                _mem_warned = True
+                warnings.warn(
+                    f"device_memory_stats: {d} raised "
+                    f"{type(e).__name__}: {e} — memory series will be "
+                    "missing for this backend (warning shown once)",
+                    RuntimeWarning)
+            if include_unavailable:
+                out[str(d)] = 0
+            continue
+        if ms:
+            out[str(d)] = int(ms.get("bytes_in_use", 0))
+        elif include_unavailable:
+            out[str(d)] = 0
+    return out
+
+
+def observe_traced(name: str, value: Any, kind: str = "gauge") -> None:
+    """Record a TRACED scalar into a host metric.
+
+    For values that only exist inside a jitted computation (e.g. the
+    global grad norm computed by the clip). Inserts a
+    ``jax.debug.callback`` into the traced program — only when
+    FLAGS_enable_metrics is on at trace time, so the compiled program
+    carries zero callback overhead when metrics are off. Flipping the
+    flag after compilation does not retrace: the callback presence is
+    baked in at trace time (documented in docs/observability.md).
+    """
+    if not metrics.enabled():
+        return
+    import jax
+    if kind == "counter":
+        inst = metrics.counter(name)
+        jax.debug.callback(lambda v: inst.inc(float(v)), value)
+    else:
+        inst = metrics.gauge(name)
+        jax.debug.callback(lambda v: inst.set(float(v)), value)
+
+
+def export_all(path: Optional[str] = None) -> Dict[str, str]:
+    """Write the host chrome trace + metrics/recompile JSON snapshots
+    under ``path`` (default FLAGS_trace_dir); returns written paths."""
+    import json
+    import os
+    if path is None:
+        from ..flags import GLOBAL_FLAGS
+        path = GLOBAL_FLAGS.get("trace_dir") or "/tmp/pt_trace"
+    os.makedirs(path, exist_ok=True)
+    out = {"trace": get_tracer().export(path)}
+    snap = {"metrics": registry().snapshot(),
+            "recompile": recompile_tracker().snapshot()}
+    mpath = os.path.join(path, "metrics.json")
+    with open(mpath, "w") as f:
+        json.dump(snap, f, indent=1, sort_keys=True, default=str)
+    out["metrics"] = mpath
+    return out
+
+
+def reset_all() -> None:
+    """Clear metrics, spans, and recompile records (tests/new runs)."""
+    registry().reset()
+    get_tracer().reset()
+    recompile_tracker().reset()

@@ -1,0 +1,332 @@
+"""Typed metrics registry: counters, gauges, bucketed histograms.
+
+TPU-native successor of the reference's monitor.h stat registry
+(/root/reference/paddle/fluid/platform/monitor.h:33 StatRegistry,
+STAT_ADD :129), extended the way production jobs need it: labeled
+series, histograms for latency distributions, a Prometheus-style text
+exposition plus a JSON snapshot, and a global on/off switch
+(FLAGS_enable_metrics) whose off state is a near-free early return.
+
+Instruments created with ``always=True`` record regardless of the flag —
+that is the compat contract for the old ``profiler.StatRegistry`` /
+``RecordEvent`` user-facing API (an explicit user call is its own
+opt-in); framework-internal hooks use the default gated instruments.
+
+Gauges may store device arrays (e.g. the live loss): values are kept as
+handed in and only ``float()``-ed at snapshot/exposition time, so
+setting a gauge in a hot loop never forces a host sync.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "registry", "counter", "gauge", "histogram",
+           "enabled", "set_enabled", "DEFAULT_BUCKETS"]
+
+# Module-level enabled cache: read on every instrument write, so it must
+# be one attribute load — FLAGS_enable_metrics keeps it in sync via its
+# on_change hook (flags.py) and the import-time read below.
+_ENABLED = False
+
+
+def enabled() -> bool:
+    """Whether gated instruments record (FLAGS_enable_metrics)."""
+    return _ENABLED
+
+
+def set_enabled(value: bool) -> None:
+    global _ENABLED
+    _ENABLED = bool(value)
+
+
+def _label_key(labels: Dict[str, Any]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _fmt_labels(key: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _as_float(v: Any) -> float:
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return float("nan")
+
+
+class _Instrument:
+    """Shared base: name/help/lock + the enabled gate."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, lock: threading.Lock,
+                 always: bool = False) -> None:
+        self.name = name
+        self.help = help
+        self._lock = lock
+        self._always = always
+
+    def _on(self) -> bool:
+        return self._always or _ENABLED
+
+
+class Counter(_Instrument):
+    """Monotonic counter with optional labels (ref: STAT_ADD)."""
+
+    kind = "counter"
+
+    def __init__(self, *args, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        self._series: Dict[Tuple, float] = {}
+
+    def inc(self, value: float = 1, **labels) -> None:
+        if not self._on():
+            return
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0) + value
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._series.get(_label_key(labels), 0)
+
+    # compat for the old StatRegistry.set() (monitor.h allowed it);
+    # not part of the counter contract proper.
+    def set_total(self, value: float, **labels) -> None:
+        if not self._on():
+            return
+        with self._lock:
+            self._series[_label_key(labels)] = value
+
+    def _snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            items = list(self._series.items())
+        return [{"labels": dict(k), "value": v} for k, v in items]
+
+
+class Gauge(_Instrument):
+    """Last-value instrument; values may be lazy (device arrays)."""
+
+    kind = "gauge"
+
+    def __init__(self, *args, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        self._series: Dict[Tuple, Any] = {}
+
+    def set(self, value: Any, **labels) -> None:
+        if not self._on():
+            return
+        with self._lock:
+            self._series[_label_key(labels)] = value
+
+    def set_max(self, value: Any, **labels) -> None:
+        """Watermark semantics: keep the running maximum."""
+        if not self._on():
+            return
+        key = _label_key(labels)
+        v = _as_float(value)
+        with self._lock:
+            old = self._series.get(key)
+            if old is None or _as_float(old) < v:
+                self._series[key] = v
+
+    def add(self, delta: float, **labels) -> None:
+        if not self._on():
+            return
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = _as_float(self._series.get(key, 0)) + delta
+
+    def value(self, **labels) -> Any:
+        with self._lock:
+            return self._series.get(_label_key(labels))
+
+    def _snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            items = list(self._series.items())
+        return [{"labels": dict(k), "value": _as_float(v)}
+                for k, v in items]
+
+
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Histogram(_Instrument):
+    """Cumulative-bucket histogram (Prometheus semantics)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str, lock: threading.Lock,
+                 always: bool = False,
+                 buckets: Optional[Sequence[float]] = None) -> None:
+        super().__init__(name, help, lock, always)
+        self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS))
+        self._series: Dict[Tuple, Dict[str, Any]] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        if not self._on():
+            return
+        v = _as_float(value)
+        key = _label_key(labels)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = {"counts": [0] * len(self.buckets), "sum": 0.0,
+                     "count": 0}
+                self._series[key] = s
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    s["counts"][i] += 1
+            s["sum"] += v
+            s["count"] += 1
+
+    def count(self, **labels) -> int:
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            return s["count"] if s else 0
+
+    def sum(self, **labels) -> float:
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            return s["sum"] if s else 0.0
+
+    def _snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            items = [(k, dict(s, counts=list(s["counts"])))
+                     for k, s in self._series.items()]
+        out = []
+        for k, s in items:
+            # observe() increments every bucket with le >= v, so counts
+            # are already cumulative (Prometheus bucket semantics)
+            buckets = {str(b): c
+                       for b, c in zip(self.buckets, s["counts"])}
+            buckets["+Inf"] = s["count"]
+            out.append({"labels": dict(k), "count": s["count"],
+                        "sum": s["sum"], "buckets": buckets})
+        return out
+
+
+class MetricsRegistry:
+    """Thread-safe named instrument registry.
+
+    ``counter``/``gauge``/``histogram`` are idempotent: the first call
+    creates the instrument, later calls return it (a mismatched kind
+    raises — one name, one type).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Instrument] = {}
+
+    def _get_or_make(self, cls, name: str, help: str, always: bool,
+                     **kwargs) -> _Instrument:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, threading.Lock(), always, **kwargs)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric '{name}' already registered as {m.kind}")
+            return m
+
+    def counter(self, name: str, help: str = "",
+                always: bool = False) -> Counter:
+        return self._get_or_make(Counter, name, help, always)
+
+    def gauge(self, name: str, help: str = "",
+              always: bool = False) -> Gauge:
+        return self._get_or_make(Gauge, name, help, always)
+
+    def histogram(self, name: str, help: str = "", always: bool = False,
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        return self._get_or_make(Histogram, name, help, always,
+                                 buckets=buckets)
+
+    def get(self, name: str) -> Optional[_Instrument]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def reset(self) -> None:
+        """Drop every instrument (tests / fresh runs)."""
+        with self._lock:
+            self._metrics.clear()
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able view: {name: {type, help, series|histogram data}}."""
+        with self._lock:
+            metrics = list(self._metrics.items())
+        return {name: {"type": m.kind, "help": m.help,
+                       "series": m._snapshot()}
+                for name, m in metrics}
+
+    def snapshot_json(self, indent: int = 1) -> str:
+        return json.dumps(self.snapshot(), indent=indent, sort_keys=True)
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format."""
+        with self._lock:
+            metrics = list(self._metrics.items())
+        lines: List[str] = []
+        for name, m in metrics:
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            lines.append(f"# TYPE {name} {m.kind}")
+            for s in m._snapshot():
+                key = _label_key(s["labels"])
+                if m.kind == "histogram":
+                    for le, c in s["buckets"].items():
+                        le_label = 'le="%s"' % le
+                        lines.append(
+                            f"{name}_bucket"
+                            f"{_fmt_labels(key, le_label)} {c}")
+                    lines.append(f"{name}_sum{_fmt_labels(key)} "
+                                 f"{s['sum']}")
+                    lines.append(f"{name}_count{_fmt_labels(key)} "
+                                 f"{s['count']}")
+                else:
+                    lines.append(
+                        f"{name}{_fmt_labels(key)} {s['value']}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def counter(name: str, help: str = "", always: bool = False) -> Counter:
+    return _REGISTRY.counter(name, help, always)
+
+
+def gauge(name: str, help: str = "", always: bool = False) -> Gauge:
+    return _REGISTRY.gauge(name, help, always)
+
+
+def histogram(name: str, help: str = "", always: bool = False,
+              buckets: Optional[Sequence[float]] = None) -> Histogram:
+    return _REGISTRY.histogram(name, help, always, buckets=buckets)
+
+
+# Pick up an env-set FLAGS_enable_metrics (define_flag parses env
+# overrides without firing on_change; later set_flags calls keep this in
+# sync through the hook in flags.py).
+try:  # pragma: no cover - trivial wiring
+    from ..flags import GLOBAL_FLAGS as _GF
+    _ENABLED = bool(_GF.get("enable_metrics"))
+except Exception:  # flag not defined yet (direct submodule import)
+    pass

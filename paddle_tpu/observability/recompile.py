@@ -1,0 +1,219 @@
+"""Recompilation tracker for jit entry points.
+
+jax retraces (and XLA recompiles) a jitted function for every new
+abstract input signature; in the reference that cost shows up as
+ProgramDesc re-construction + pass re-runs, here it is the dominant
+silent perf cliff (ROADMAP: "as fast as the hardware allows" dies to a
+shape-churning input pipeline). This module wraps the framework's jit
+boundaries (jit.StaticFunction, static.TrainStep/EvalStep) to
+
+- count traces vs. cache hits per function,
+- record per-trace compile latency (wall time of the dispatch call that
+  traced) and the triggering abstract input signature, and
+- warn ONCE per function on a recompilation storm: ≥
+  FLAGS_recompile_warn_threshold distinct signatures.
+
+Mechanics: ``FunctionRecord.mark_trace(fn)`` wraps the to-be-jitted
+function so its body — which only executes while jax is tracing —
+notes the trace; ``wrap_call`` wraps the jitted callable to time
+dispatches and classify each call as hit or trace via a thread-local
+handoff (tracing runs synchronously on the calling thread). Trace
+notes are always on (they cost only at compile time); per-call
+hit/latency accounting is gated on FLAGS_enable_metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from typing import Any, Callable, Dict, List, Optional
+
+from . import metrics as _metrics
+
+__all__ = ["RecompileTracker", "FunctionRecord", "tracker",
+           "instrumented_jit"]
+
+
+def _abstract_signature(args, kwargs) -> str:
+    """Stable string of every leaf's (shape, dtype) — leaves are
+    tracers at trace time, concrete arrays on eager fallback."""
+    import jax
+
+    def leaf_sig(x):
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is None:
+            return repr(type(x).__name__)
+        return f"{getattr(dtype, 'name', dtype)}{list(shape)}"
+
+    leaves = jax.tree.leaves((args, kwargs))
+    return "(" + ",".join(leaf_sig(x) for x in leaves) + ")"
+
+
+class FunctionRecord:
+    """Per-function trace/call accounting."""
+
+    def __init__(self, name: str, lock: threading.Lock) -> None:
+        self.name = name
+        self._lock = lock
+        self._tls = threading.local()
+        self.traces = 0
+        self.calls = 0
+        self.hits = 0
+        self.signatures: List[str] = []
+        self.compile_times_s: List[float] = []
+        self._warned = False
+
+    # -- trace side --------------------------------------------------------
+
+    def note_trace(self, args, kwargs) -> None:
+        sig = _abstract_signature(args, kwargs)
+        threshold = None
+        with self._lock:
+            self.traces += 1
+            if sig not in self.signatures:
+                self.signatures.append(sig)
+            n_sigs = len(self.signatures)
+            if not self._warned:
+                threshold = self._threshold()
+                if threshold and n_sigs >= threshold:
+                    self._warned = True
+                else:
+                    threshold = None
+        self._tls.traced = True
+        _metrics.counter(
+            "jit_traces_total",
+            "jit traces (recompilations) per function", always=True
+        ).inc(fn=self.name)
+        if threshold:
+            warnings.warn(
+                f"recompilation storm: '{self.name}' has been traced "
+                f"for {n_sigs} distinct input signatures (threshold "
+                f"{threshold}); latest {sig[:200]} — pad or bucket "
+                f"input shapes (FLAGS_recompile_warn_threshold)",
+                RuntimeWarning, stacklevel=3)
+
+    @staticmethod
+    def _threshold() -> int:
+        try:
+            from ..flags import GLOBAL_FLAGS
+            return int(GLOBAL_FLAGS.get("recompile_warn_threshold"))
+        except Exception:
+            return 0
+
+    def mark_trace(self, fn: Callable) -> Callable:
+        """Wrap ``fn`` (pre-jit) so tracing it is observed."""
+        def traced(*args, **kwargs):
+            self.note_trace(args, kwargs)
+            return fn(*args, **kwargs)
+        traced.__name__ = getattr(fn, "__name__", "fn")
+        traced.__qualname__ = getattr(fn, "__qualname__", traced.__name__)
+        traced.__wrapped__ = fn
+        return traced
+
+    # -- call side ---------------------------------------------------------
+
+    def on_call(self, dt_s: float) -> None:
+        traced = getattr(self._tls, "traced", False)
+        self._tls.traced = False
+        with self._lock:
+            self.calls += 1
+            if traced:
+                self.compile_times_s.append(dt_s)
+            else:
+                self.hits += 1
+        if traced:
+            _metrics.histogram(
+                "jit_compile_seconds",
+                "wall time of dispatch calls that traced",
+                buckets=(0.01, 0.05, 0.1, 0.5, 1, 5, 15, 60, 300)
+            ).observe(dt_s, fn=self.name)
+        else:
+            _metrics.counter("jit_cache_hits_total",
+                             "jit dispatches served from cache"
+                             ).inc(fn=self.name)
+
+    def wrap_call(self, jitted: Callable) -> "_InstrumentedJit":
+        return _InstrumentedJit(jitted, self)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"name": self.name, "traces": self.traces,
+                    "calls": self.calls, "hits": self.hits,
+                    "signatures": list(self.signatures),
+                    "compile_times_s": list(self.compile_times_s)}
+
+
+class _InstrumentedJit:
+    """Callable wrapper that times dispatches; every other attribute
+    (``lower``, ``clear_cache``, ...) passes through to the jitted fn."""
+
+    def __init__(self, jitted: Callable, record: FunctionRecord) -> None:
+        object.__setattr__(self, "_jitted", jitted)
+        object.__setattr__(self, "_record", record)
+
+    def __call__(self, *args, **kwargs):
+        rec: FunctionRecord = self._record
+        if not _metrics.enabled():
+            # still consume a pending trace marker so a later enabled
+            # call is not misclassified as a compile
+            rec._tls.traced = False
+            return self._jitted(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = self._jitted(*args, **kwargs)
+        rec.on_call(time.perf_counter() - t0)
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self._jitted, item)
+
+
+class RecompileTracker:
+    """Registry of FunctionRecords, keyed by display name."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._fns: Dict[str, FunctionRecord] = {}
+
+    def function(self, name: str) -> FunctionRecord:
+        with self._lock:
+            rec = self._fns.get(name)
+            if rec is None:
+                rec = FunctionRecord(name, threading.Lock())
+                self._fns[name] = rec
+            return rec
+
+    def get(self, name: str) -> Optional[FunctionRecord]:
+        with self._lock:
+            return self._fns.get(name)
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            fns = list(self._fns.values())
+        return {r.name: r.stats() for r in fns}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._fns.clear()
+
+
+_TRACKER = RecompileTracker()
+
+
+def tracker() -> RecompileTracker:
+    return _TRACKER
+
+
+def instrumented_jit(fn: Callable, name: Optional[str] = None,
+                     **jit_kwargs) -> _InstrumentedJit:
+    """``jax.jit`` with recompile tracking: drop-in at jit boundaries.
+
+    Returns a callable; ``.lower()`` etc. still work (attribute
+    passthrough).
+    """
+    import jax
+    name = name or getattr(fn, "__qualname__",
+                           getattr(fn, "__name__", repr(fn)))
+    rec = _TRACKER.function(name)
+    return rec.wrap_call(jax.jit(rec.mark_trace(fn), **jit_kwargs))

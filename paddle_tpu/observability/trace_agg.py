@@ -1,0 +1,164 @@
+"""Chrome/perfetto ``traceEvents`` aggregation.
+
+One home for the trace-parsing logic that tools/profile_step.py grew
+round-4 and tools/trace_report.py needs too: load a trace (plain
+``.json`` or gzipped ``*.trace.json.gz``), roll up XLA device op
+self-times from the "XLA Ops" lane, and aggregate host spans into the
+reference-style calls/total/avg/max summary table
+(/root/reference/paddle/fluid/platform/profiler.cc PrintProfiler).
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Sequence
+
+__all__ = ["load_trace_events", "find_xla_traces", "xla_op_rollup",
+           "span_summary", "format_span_table", "format_xla_rollup",
+           "TraceFormatError"]
+
+
+class TraceFormatError(ValueError):
+    """Trace lacks the metadata needed for a reliable aggregation."""
+
+
+def load_trace_events(path: str) -> List[Dict[str, Any]]:
+    """Load ``traceEvents`` from a chrome-trace JSON file (gzipped or
+    not; dict-with-traceEvents or bare event list)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        data = json.load(f)
+    if isinstance(data, list):
+        return data
+    return data.get("traceEvents", [])
+
+
+def find_xla_traces(root: str) -> List[str]:
+    """XLA profiler outputs ``**/*.trace.json.gz`` under its log dir."""
+    return sorted(glob.glob(os.path.join(root, "**", "*.trace.json.gz"),
+                            recursive=True))
+
+
+def xla_op_rollup(events: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregate device op self-times from an XLA profiler trace.
+
+    The device process exposes three lanes (Steps / XLA Modules /
+    XLA Ops); the first two are aggregates of the third, so summing
+    every device event double-counts the whole step (the round-4
+    rollup did exactly that and mis-ranked BN reductions over conv).
+    Keep ONLY the "XLA Ops" lane and trust its hlo_category metadata
+    over name-substring guessing (fusion names hide the conv inside).
+
+    Returns {"ops": {name: {"dur_us", "count"}}, "categories":
+    {cat: dur_us}, "total_us", "steps"}; raises TraceFormatError when
+    the lane metadata is missing (aggregating without it would silently
+    revert to the double-count).
+    """
+    pid_names = {e.get("pid"): e.get("args", {}).get("name", "")
+                 for e in events if e.get("ph") == "M"
+                 and e.get("name") == "process_name"}
+    device_pids = {p for p, n in pid_names.items()
+                   if "TPU" in n or "tpu" in n or "/device" in n.lower()
+                   or "XLA" in n}
+    op_tids = {(e.get("pid"), e.get("tid"))
+               for e in events if e.get("ph") == "M"
+               and e.get("name") == "thread_name"
+               and e.get("args", {}).get("name") == "XLA Ops"}
+    if not op_tids:
+        raise TraceFormatError(
+            "trace has no 'XLA Ops' thread_name metadata; cannot "
+            "aggregate reliably (profiler version mismatch?)")
+    durs: Dict[str, float] = defaultdict(float)
+    counts: Dict[str, int] = defaultdict(int)
+    cats: Dict[str, float] = defaultdict(float)
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        if device_pids and e.get("pid") not in device_pids:
+            continue
+        if (e.get("pid"), e.get("tid")) not in op_tids:
+            continue
+        name = e.get("name", "?")
+        d = float(e.get("dur", 0.0))
+        durs[name] += d
+        counts[name] += 1
+        cats[e.get("args", {}).get("hlo_category", "?")] += d
+        total += d
+    # per-step divisor: one event per step on the "XLA Modules" lane
+    mod_tids = {(e.get("pid"), e.get("tid"))
+                for e in events if e.get("ph") == "M"
+                and e.get("name") == "thread_name"
+                and e.get("args", {}).get("name") == "XLA Modules"}
+    steps = sum(1 for e in events if e.get("ph") == "X"
+                and (e.get("pid"), e.get("tid")) in mod_tids)
+    return {"ops": {n: {"dur_us": d, "count": counts[n]}
+                    for n, d in durs.items()},
+            "categories": dict(cats), "total_us": total, "steps": steps}
+
+
+def span_summary(events: Sequence[Dict[str, Any]],
+                 prefix: str = "") -> Dict[str, Dict[str, float]]:
+    """Per-name calls/total/avg/max over complete ("X") events, in µs.
+
+    ``prefix`` tags names (e.g. "xla::") so host and device tables can
+    merge without collisions.
+    """
+    agg: Dict[str, Dict[str, float]] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        name = prefix + e.get("name", "?")
+        a = agg.setdefault(name, {"calls": 0, "total_us": 0.0,
+                                  "max_us": 0.0})
+        d = float(e.get("dur", 0.0))
+        a["calls"] += 1
+        a["total_us"] += d
+        a["max_us"] = max(a["max_us"], d)
+    for a in agg.values():
+        a["avg_us"] = a["total_us"] / max(a["calls"], 1)
+    return agg
+
+
+def format_span_table(summary: Dict[str, Dict[str, float]],
+                      top: Optional[int] = None,
+                      title: str = "span summary") -> str:
+    """Reference-style aggregated table, sorted by total time."""
+    rows = sorted(summary.items(), key=lambda kv: -kv[1]["total_us"])
+    if top is not None:
+        rows = rows[:top]
+    lines = [f"== {title} ({len(summary)} spans"
+             + (f", top {len(rows)}" if top is not None else "") + ") ==",
+             f"{'name':<48} {'calls':>7} {'total_ms':>10} "
+             f"{'avg_ms':>9} {'max_ms':>9}"]
+    for name, a in rows:
+        lines.append(f"{name[:48]:<48} {a['calls']:>7d} "
+                     f"{a['total_us'] / 1e3:>10.3f} "
+                     f"{a['avg_us'] / 1e3:>9.3f} "
+                     f"{a['max_us'] / 1e3:>9.3f}")
+    return "\n".join(lines)
+
+
+def format_xla_rollup(rollup: Dict[str, Any], top: int = 30) -> str:
+    """The profile_step.py category + top-ops printout, as a string."""
+    total = rollup["total_us"]
+    steps = rollup["steps"] or 1
+    lines = [f"== device op time rollup (total {total / 1e3:.2f} ms, "
+             f"{rollup['steps']} steps, "
+             f"{total / steps / 1e3:.2f} ms/step) =="]
+    for c, d in sorted(rollup["categories"].items(),
+                       key=lambda kv: -kv[1]):
+        pct = d / total * 100 if total else 0.0
+        lines.append(f"  {c:24s} {d / steps / 1e3:9.3f} ms/step "
+                     f"{pct:5.1f}%")
+    lines.append("")
+    lines.append(f"== top {top} ops by total duration ==")
+    for name, op in sorted(rollup["ops"].items(),
+                           key=lambda kv: -kv[1]["dur_us"])[:top]:
+        lines.append(f"  {op['dur_us'] / steps / 1e3:9.3f} ms/step "
+                     f"x{op['count']:<5d} {name[:100]}")
+    return "\n".join(lines)

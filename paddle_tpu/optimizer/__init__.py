@@ -433,6 +433,10 @@ class Optimizer:
             values, gdict, self._eager_state)
         for i, p in params.items():
             p.value = new_values[i]
+        from ..observability import metrics as _obs_metrics
+        if _obs_metrics.enabled():
+            _obs_metrics.counter("optimizer_steps_total",
+                                 "optimizer update steps applied").inc()
 
     def clear_grad(self) -> None:
         pass  # grads are values, not state, in the functional design

@@ -20,7 +20,29 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..observability import metrics as _obs_metrics
+
 AxisName = Union[str, Sequence[str]]
+
+
+def _account(op: str, x) -> None:
+    """Count collective launches + payload bytes. Runs in host Python:
+    inside shard_map/pjit that is ONCE per trace (compiled steady state
+    pays nothing), eagerly it is per call — both gated on
+    FLAGS_enable_metrics."""
+    if not _obs_metrics.enabled():
+        return
+    _obs_metrics.counter("collective_calls_total",
+                         "collective ops (per trace when jitted)"
+                         ).inc(op=op)
+    try:
+        nbytes = sum(int(l.size) * int(l.dtype.itemsize)
+                     for l in jax.tree.leaves(x))
+    except (AttributeError, TypeError):
+        nbytes = 0
+    _obs_metrics.counter("collective_bytes_total",
+                         "payload bytes handed to collectives "
+                         "(per trace when jitted)").inc(nbytes, op=op)
 
 # ring_id → axis-name registry (ref: NCCLCommContext keyed by ring_id,
 # collective_helper.h:62)
@@ -61,9 +83,17 @@ def _axis(group: Optional[Union[CommGroup, AxisName]]) -> AxisName:
     return group
 
 
+def _axis_size(axis: AxisName) -> int:
+    # lax.axis_size is recent; on older jax the psum-of-static-1 idiom
+    # gives the same bound-axis size (and raises NameError unbound)
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    return lax.psum(1, axis)
+
+
 def _in_traced_collective(axis: AxisName) -> bool:
     try:
-        lax.axis_size(axis)
+        _axis_size(axis)
         return True
     except (NameError, KeyError, Exception):
         return False
@@ -74,6 +104,7 @@ def all_reduce(x, op: str = "sum", group=None):
     axis = _axis(group)
     if not _in_traced_collective(axis):
         return x
+    _account("all_reduce", x)
     if op == "sum":
         return lax.psum(x, axis)
     if op == "mean":
@@ -92,6 +123,7 @@ def all_gather(x, axis: int = 0, group=None):
     a = _axis(group)
     if not _in_traced_collective(a):
         return x
+    _account("all_gather", x)
     return lax.all_gather(x, a, axis=axis, tiled=True)
 
 
@@ -100,6 +132,7 @@ def reduce_scatter(x, axis: int = 0, group=None):
     a = _axis(group)
     if not _in_traced_collective(a):
         return x
+    _account("reduce_scatter", x)
     return lax.psum_scatter(x, a, scatter_dimension=axis, tiled=True)
 
 
@@ -108,7 +141,8 @@ def broadcast(x, src: int = 0, group=None):
     a = _axis(group)
     if not _in_traced_collective(a):
         return x
-    n = lax.axis_size(a)
+    _account("broadcast", x)
+    n = _axis_size(a)
     return lax.all_gather(x, a)[src] if n > 1 else x
 
 def reduce(x, dst: int = 0, op: str = "sum", group=None):
@@ -122,7 +156,8 @@ def scatter(x, src: int = 0, group=None):
     a = _axis(group)
     if not _in_traced_collective(a):
         return x
-    n = lax.axis_size(a)
+    _account("scatter", x)
+    n = _axis_size(a)
     idx = lax.axis_index(a)
     full = lax.all_gather(x, a)[src]
     size = full.shape[0] // n
@@ -134,6 +169,7 @@ def all_to_all(x, split_axis: int = 0, concat_axis: int = 0, group=None):
     a = _axis(group)
     if not _in_traced_collective(a):
         return x
+    _account("all_to_all", x)
     return lax.all_to_all(x, a, split_axis=split_axis,
                           concat_axis=concat_axis, tiled=True)
 
@@ -143,6 +179,7 @@ def ppermute(x, perm, group=None):
     a = _axis(group)
     if not _in_traced_collective(a):
         return x
+    _account("ppermute", x)
     return lax.ppermute(x, a, perm)
 
 
@@ -173,5 +210,5 @@ def rank(group=None):
 def world_size(group=None) -> int:
     a = _axis(group)
     if _in_traced_collective(a):
-        return lax.axis_size(a)
+        return _axis_size(a)
     return 1

@@ -287,8 +287,10 @@ class ShardedTrainStep:
         # off data parallelism for the whole batch. The reference's
         # ParallelExecutor simply rejects such feeds (it splits by device
         # count).
-        self._jitted = jax.jit(
-            self._step,
+        from ..observability import instrumented_jit
+        self._span_name = f"ShardedTrainStep({type(model).__name__})"
+        self._jitted = instrumented_jit(
+            self._step, self._span_name,
             in_shardings=(state_shardings, None),
             out_shardings=(state_shardings, None),
             donate_argnums=(0,))
@@ -406,8 +408,16 @@ class ShardedTrainStep:
              "kwargs": kwargs},
             self.optimizer)
         batch = self._place_batch(batch)
-        with self.mesh:
-            self.state, metrics = self._jitted(self.state, batch)
+        from ..observability import metrics as _obs_metrics
+        if _obs_metrics.enabled():
+            from ..observability import span as _obs_span
+            with _obs_span(self._span_name), self.mesh:
+                self.state, metrics = self._jitted(self.state, batch)
+            _obs_metrics.counter("optimizer_steps_total",
+                                 "optimizer update steps applied").inc()
+        else:
+            with self.mesh:
+                self.state, metrics = self._jitted(self.state, batch)
         return metrics
 
     @property

@@ -1,39 +1,38 @@
-"""Profiling & observability.
+"""Profiling compat shim over :mod:`paddle_tpu.observability`.
 
-TPU-native redesign of the reference's three-part tracing stack
-(/root/reference/paddle/fluid/platform/profiler.h:126 RecordEvent spans,
-profiler.h:208 Enable/DisableProfiler + chrome-trace output;
-device_tracer.cc:61 CUPTI device timelines; monitor.h:33 global stat
-registry). Mapping:
+The real implementation lives in ``paddle_tpu/observability/`` (metrics
+registry, span tracer, recompile tracker, trace aggregation); this
+module keeps the original surface working:
 
-- CUPTI device tracing → **jax.profiler / XPlane**: start_profiler writes
-  TensorBoard-loadable traces with real TPU kernel timelines.
-- RecordEvent host spans → :class:`RecordEvent` (times host code AND
-  forwards to jax.profiler.TraceAnnotation so spans land in the xplane).
-- monitor.h STAT registry → :class:`StatRegistry` (monotonic counters).
-- FLAGS_benchmark per-op sync → ``benchmark_sync()`` helper that
-  block_until_ready()s a pytree (operator.cc:1022 analogue).
+- ``start_profiler``/``stop_profiler``/``profiler`` — jax xplane device
+  capture (ref: Enable/DisableProfiler, profiler.h:208).
+- ``RecordEvent`` — host span + TraceAnnotation (ref: profiler.h:126);
+  records regardless of FLAGS_enable_metrics (an explicit call is its
+  own opt-in), now also landing in the exported chrome trace.
+- ``stats``/``stat_add``/``StatRegistry`` — absorbed by the metrics
+  registry (ref: monitor.h:33); names share the registry namespace.
+- ``event_summary``/``get_host_events`` — served from the span tracer.
+- ``benchmark_sync``, ``device_memory_stats``, ``StepTimer`` — as
+  before, with the silent-failure fixes.
 """
 
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
-from collections import defaultdict
 from typing import Any, Dict, List, Optional
 
 import jax
 
 from .flags import GLOBAL_FLAGS
+from . import observability as _obs
+from .observability import device_memory_stats  # noqa: F401  (public)
 
 
 class _ProfilerState:
     def __init__(self) -> None:
         self.active = False
         self.log_dir: Optional[str] = None
-        self.events: List[Dict[str, Any]] = []
-        self.lock = threading.Lock()
 
 
 _state = _ProfilerState()
@@ -71,69 +70,56 @@ class RecordEvent:
 
     def __init__(self, name: str) -> None:
         self.name = name
-        self._trace_ctx = None
-        self._t0 = 0.0
+        self._cm = None
 
     def __enter__(self) -> "RecordEvent":
-        self._t0 = time.perf_counter()
-        self._trace_ctx = jax.profiler.TraceAnnotation(self.name)
-        self._trace_ctx.__enter__()
+        self._cm = _obs.span(self.name, force=True)
+        self._cm.__enter__()
         return self
 
     def __exit__(self, *exc) -> None:
-        self._trace_ctx.__exit__(*exc)
-        dt = time.perf_counter() - self._t0
-        with _state.lock:
-            _state.events.append({"name": self.name, "dur_s": dt,
-                                  "ts": self._t0})
+        self._cm.__exit__(*exc)
 
 
 def get_host_events() -> List[Dict[str, Any]]:
-    with _state.lock:
-        return list(_state.events)
+    """Old event format: name / dur_s / ts (seconds)."""
+    return [{"name": e["name"], "dur_s": e["dur"] / 1e6,
+             "ts": e["ts"] / 1e6}
+            for e in _obs.get_tracer().events() if e.get("ph") == "X"]
 
 
 def reset_host_events() -> None:
-    with _state.lock:
-        _state.events.clear()
+    _obs.get_tracer().reset()
 
 
 def event_summary() -> Dict[str, Dict[str, float]]:
     """Aggregated table like the reference's profiler summary printer."""
-    agg: Dict[str, Dict[str, float]] = defaultdict(
-        lambda: {"calls": 0, "total_s": 0.0, "max_s": 0.0})
-    for e in get_host_events():
-        a = agg[e["name"]]
-        a["calls"] += 1
-        a["total_s"] += e["dur_s"]
-        a["max_s"] = max(a["max_s"], e["dur_s"])
-    for a in agg.values():
-        a["avg_s"] = a["total_s"] / max(a["calls"], 1)
-    return dict(agg)
+    return _obs.get_tracer().summary()
 
 
 class StatRegistry:
-    """(ref: monitor.h:33 StatRegistry, STAT_ADD :129)."""
+    """(ref: monitor.h:33) — a view over the observability metrics
+    registry; add/get/set keep their old int semantics and the counters
+    they create are always-on (explicit user API)."""
 
     def __init__(self) -> None:
-        self._stats: Dict[str, int] = defaultdict(int)
-        self._lock = threading.Lock()
+        self._names: Dict[str, bool] = {}
+
+    def _c(self, name: str):
+        self._names[name] = True
+        return _obs.counter(name, always=True)
 
     def add(self, name: str, value: int = 1) -> None:
-        with self._lock:
-            self._stats[name] += value
+        self._c(name).inc(value)
 
     def get(self, name: str) -> int:
-        with self._lock:
-            return self._stats[name]
+        return int(self._c(name).value())
 
     def set(self, name: str, value: int) -> None:
-        with self._lock:
-            self._stats[name] = value
+        self._c(name).set_total(value)
 
     def snapshot(self) -> Dict[str, int]:
-        with self._lock:
-            return dict(self._stats)
+        return {n: self.get(n) for n in self._names}
 
 
 stats = StatRegistry()
@@ -147,19 +133,6 @@ def benchmark_sync(tree) -> Any:
     """Block on device work for accurate timing
     (ref: FLAGS_benchmark sync, operator.cc:1022)."""
     return jax.block_until_ready(tree)
-
-
-def device_memory_stats() -> Dict[str, int]:
-    """Allocator stats analogue (ref: memory/stats + gpu_info mem flags)."""
-    out: Dict[str, int] = {}
-    for d in jax.local_devices():
-        try:
-            ms = d.memory_stats()
-            if ms:
-                out[str(d)] = int(ms.get("bytes_in_use", 0))
-        except Exception:
-            pass
-    return out
 
 
 class StepTimer:
@@ -176,14 +149,22 @@ class StepTimer:
         self._t0 = time.perf_counter()
 
     def stop(self, result=None) -> float:
+        if self._t0 is None:
+            # stop() without start() used to silently time against
+            # "now" and record a ~0 sample that skewed throughput
+            return 0.0
         if GLOBAL_FLAGS.get("benchmark") and result is not None:
             benchmark_sync(result)
-        dt = time.perf_counter() - (self._t0 or time.perf_counter())
+        dt = time.perf_counter() - self._t0
+        self._t0 = None
         self.times.append(dt)
         return dt
 
     def throughput(self, skip_first: int = 1) -> float:
-        ts = self.times[skip_first:] or self.times
+        # drop warmup samples, but never fall back to re-using the
+        # skipped (compile-inflated) sample when it is the only one —
+        # that reported a number dominated by compile time
+        ts = self.times[skip_first:]
         if not ts:
             return 0.0
         return self.items_per_step * len(ts) / sum(ts)

@@ -18,7 +18,10 @@ import itertools
 import queue as queue_mod
 import random as _random_mod
 import threading
+import time
 from typing import Callable
+
+from .observability import metrics as _obs_metrics
 
 __all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
            "buffered", "firstn", "xmap_readers", "batch"]
@@ -142,9 +145,20 @@ def buffered(reader: Callable, size: int) -> Callable:
                         continue
         t = threading.Thread(target=produce, daemon=True)
         t.start()
+        # wait-time accounting: enabled-state snapshotted per iteration
+        # start, so the hot loop pays one None check when metrics are off
+        wait_h = _obs_metrics.histogram(
+            "reader_buffer_wait_seconds",
+            "consumer wait on the buffered() prefetch queue") \
+            if _obs_metrics.enabled() else None
         try:
             while True:
-                item = q.get()
+                if wait_h is not None:
+                    t0 = time.perf_counter()
+                    item = q.get()
+                    wait_h.observe(time.perf_counter() - t0)
+                else:
+                    item = q.get()
                 if item is _End:
                     break
                 yield item
@@ -274,13 +288,20 @@ def batch(reader: Callable, batch_size: int,
         raise ValueError(f"batch_size must be positive, got {batch_size}")
 
     def creator():
+        counter = _obs_metrics.counter(
+            "reader_batches_total", "batches produced by reader.batch") \
+            if _obs_metrics.enabled() else None
         buf = []
         for sample in reader():
             buf.append(sample)
             if len(buf) == batch_size:
+                if counter is not None:
+                    counter.inc()
                 yield buf
                 buf = []
         if buf and not drop_last:
+            if counter is not None:
+                counter.inc()
             yield buf
 
     return creator

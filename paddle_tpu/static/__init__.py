@@ -36,6 +36,7 @@ from ..errors import NotFoundError
 from ..flags import GLOBAL_FLAGS
 from ..nn.layer import Layer, functional_call
 from ..optimizer import Optimizer
+from .. import observability as _obs
 
 
 class Scope:
@@ -352,8 +353,14 @@ class TrainStep:
             "opt": optimizer.init(params),
             "rng": _random.make_key(seed),
         }
-        self._jitted = jax.jit(self._step, donate_argnums=(0,))
-        self._jitted_multi = jax.jit(self._multi, donate_argnums=(0,))
+        # jit through the recompile tracker: a shape-churning input
+        # pipeline shows up as jit_traces_total{fn=...} growth + a
+        # storm warning instead of a silent 100x slowdown
+        self._span_name = f"TrainStep({type(model).__name__})"
+        self._jitted = _obs.instrumented_jit(
+            self._step, self._span_name, donate_argnums=(0,))
+        self._jitted_multi = _obs.instrumented_jit(
+            self._multi, self._span_name + ".multi", donate_argnums=(0,))
 
     def _step(self, state, batch):
         params = state["params"]
@@ -401,7 +408,13 @@ class TrainStep:
 
     def __call__(self, *args, labels=(), **kwargs):
         batch = self._make_batch(args, labels, kwargs)
-        self.state, metrics = self._jitted(self.state, batch)
+        if _obs.enabled():
+            with _obs.span(self._span_name):
+                self.state, metrics = self._jitted(self.state, batch)
+            _obs.counter("optimizer_steps_total",
+                         "optimizer update steps applied").inc()
+        else:
+            self.state, metrics = self._jitted(self.state, batch)
         return metrics
 
     def run_steps(self, *args, labels=(), **kwargs):
@@ -416,7 +429,17 @@ class TrainStep:
                  "kwargs": kwargs}
         lr = host_lr_of(self.optimizer)
         lr = None if lr is None else jnp.float32(lr)
-        self.state, metrics = self._jitted_multi(self.state, batch, lr)
+        if _obs.enabled():
+            with _obs.span(self._span_name + ".multi"):
+                self.state, metrics = self._jitted_multi(self.state,
+                                                         batch, lr)
+            k = next((int(a.shape[0]) for a in jax.tree.leaves(batch)
+                      if getattr(a, "ndim", 0)), 1)
+            _obs.counter("optimizer_steps_total",
+                         "optimizer update steps applied").inc(k)
+        else:
+            self.state, metrics = self._jitted_multi(self.state, batch,
+                                                     lr)
         return metrics
 
     def compiled_hlo(self, *args, labels=(), **kwargs) -> str:
@@ -465,7 +488,8 @@ class EvalStep:
                  metric_fns: Optional[Dict[str, Callable]] = None) -> None:
         self.model = model
         self.metric_fns = metric_fns or {}
-        self._jitted = jax.jit(self._step)
+        self._span_name = f"EvalStep({type(model).__name__})"
+        self._jitted = _obs.instrumented_jit(self._step, self._span_name)
 
     def _step(self, params, buffers, batch):
         was_training = self.model.training
@@ -481,8 +505,11 @@ class EvalStep:
         return out, metrics
 
     def __call__(self, params, buffers, *args, labels=()):
-        return self._jitted(params, buffers,
-                            {"args": args, "labels": as_label_tuple(labels)})
+        batch = {"args": args, "labels": as_label_tuple(labels)}
+        if _obs.enabled():
+            with _obs.span(self._span_name):
+                return self._jitted(params, buffers, batch)
+        return self._jitted(params, buffers, batch)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,503 @@
+"""Observability subsystem tests: metrics registry (threads, labels,
+exposition), span tracer (nesting, chrome-trace schema), recompile
+tracker (hit/miss, storm warning), hot-path instrumentation smoke
+(hapi.Model.fit with FLAGS_enable_metrics=1), the profiler compat shim,
+and the tools/trace_report.py CLI self-test.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu import profiler
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def metrics_on():
+    pt.set_flags({"enable_metrics": True})
+    try:
+        yield
+    finally:
+        pt.set_flags({"enable_metrics": False, "trace_dir": ""})
+        obs.reset_all()
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_basics(metrics_on):
+    c = obs.counter("t_requests_total", "help text")
+    c.inc()
+    c.inc(2, route="train")
+    assert c.value() == 1
+    assert c.value(route="train") == 2
+    # idempotent registration returns the same instrument
+    assert obs.counter("t_requests_total") is c
+    with pytest.raises(TypeError):
+        obs.gauge("t_requests_total")
+
+    g = obs.gauge("t_gauge")
+    g.set(3.5)
+    g.set_max(2.0)          # watermark keeps 3.5
+    assert g.value() == 3.5
+    g.set_max(9.0)
+    assert g.value() == 9.0
+
+    h = obs.histogram("t_lat_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    assert h.count() == 4
+    snap = obs.registry().snapshot()
+    hs = snap["t_lat_seconds"]["series"][0]
+    assert hs["buckets"]["0.1"] == 1
+    assert hs["buckets"]["1.0"] == 2
+    assert hs["buckets"]["10.0"] == 3
+    assert hs["buckets"]["+Inf"] == 4
+    assert hs["sum"] == pytest.approx(55.55)
+    assert snap["t_requests_total"]["type"] == "counter"
+
+
+def test_disabled_is_noop_and_always_overrides():
+    # flag is off (default): gated instruments drop writes
+    assert not obs.enabled()
+    c = obs.counter("t_gated_total")
+    c.inc(5)
+    assert c.value() == 0
+    a = obs.counter("t_always_total", always=True)
+    a.inc(5)
+    assert a.value() == 5
+    h = obs.histogram("t_gated_seconds")
+    h.observe(1.0)
+    assert h.count() == 0
+    obs.reset_all()
+
+
+def test_flag_toggles_enabled_cache():
+    assert not obs.enabled()
+    pt.set_flags({"enable_metrics": True})
+    assert obs.enabled()
+    pt.set_flags({"enable_metrics": False})
+    assert not obs.enabled()
+
+
+def test_metrics_under_threads(metrics_on):
+    c = obs.counter("t_mt_total")
+    h = obs.histogram("t_mt_seconds")
+
+    def work():
+        for _ in range(500):
+            c.inc()
+            h.observe(0.01, worker="w")
+
+    ts = [threading.Thread(target=work) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert c.value() == 4000
+    assert h.count(worker="w") == 4000
+    assert h.sum(worker="w") == pytest.approx(40.0)
+
+
+def test_prometheus_text_exposition(metrics_on):
+    obs.counter("t_pc_total", "a counter").inc(3, op="x")
+    obs.histogram("t_ph_seconds", buckets=(1.0,)).observe(0.5)
+    text = obs.registry().prometheus_text()
+    assert "# TYPE t_pc_total counter" in text
+    assert 't_pc_total{op="x"} 3' in text
+    assert 't_ph_seconds_bucket{le="1.0"} 1' in text
+    assert 't_ph_seconds_count 1' in text
+
+
+def test_gauge_holds_device_array_without_sync(metrics_on):
+    g = obs.gauge("t_dev_gauge")
+    g.set(jnp.float32(2.5))  # stored as-is; float()ed only at snapshot
+    snap = obs.registry().snapshot()
+    assert snap["t_dev_gauge"]["series"][0]["value"] == 2.5
+
+
+# ---------------------------------------------------------------------------
+# span tracer + chrome trace schema
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_and_chrome_schema(metrics_on, tmp_path):
+    tr = obs.get_tracer()
+    tr.reset()
+    with tr.span("outer"):
+        with tr.span("inner"):
+            pass
+    with tr.span("outer"):
+        pass
+    summary = tr.summary()
+    assert summary["outer"]["calls"] == 2
+    assert summary["inner"]["calls"] == 1
+
+    path = tr.export(str(tmp_path))
+    with open(path) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    xs = [e for e in events if e["ph"] == "X"]
+    ms = [e for e in events if e["ph"] == "M"]
+    assert {e["name"] for e in xs} == {"outer", "inner"}
+    for e in xs:
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert e["dur"] >= 0 and e["ts"] >= 0
+    assert any(e["name"] == "process_name" for e in ms)
+    assert any(e["name"] == "thread_name" for e in ms)
+    # nesting: inner fully contained in its outer span
+    inner = next(e for e in xs if e["name"] == "inner")
+    outer = max((e for e in xs if e["name"] == "outer"),
+                key=lambda e: e["dur"])
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+
+
+def test_span_disabled_records_nothing():
+    assert not obs.enabled()
+    tr = obs.get_tracer()
+    tr.reset()
+    with tr.span("gated"):
+        pass
+    assert tr.events() == []
+    with tr.span("forced", force=True):
+        pass
+    assert [e["name"] for e in tr.events()] == ["forced"]
+    tr.reset()
+
+
+def test_span_threads_get_distinct_tids(metrics_on):
+    tr = obs.get_tracer()
+    tr.reset()
+    # hold all threads alive inside their span: thread idents are
+    # reused once a thread exits, which would alias tids
+    gate = threading.Barrier(3)
+
+    def work():
+        with tr.span("threaded"):
+            gate.wait(timeout=10)
+
+    ts = [threading.Thread(target=work) for _ in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    tids = {e["tid"] for e in tr.events()}
+    assert len(tids) == 3
+
+
+# ---------------------------------------------------------------------------
+# recompile tracker
+# ---------------------------------------------------------------------------
+
+def test_recompile_tracker_hits_and_traces(metrics_on):
+    @pt.jit.to_static
+    def f(x):
+        return x * 2 + 1
+
+    f(jnp.ones((3,)))
+    f(jnp.ones((3,)))          # cache hit
+    f(jnp.ones((4,)))          # new shape -> retrace
+    # records are keyed by qualname ("to_static:<qualname>.f")
+    name = next(n for n in obs.recompile_tracker().snapshot()
+                if n.startswith("to_static:") and n.endswith(".f"))
+    st = obs.recompile_tracker().get(name).stats()
+    assert st["traces"] == 2
+    assert st["hits"] == 1
+    assert st["calls"] == 3
+    assert len(st["signatures"]) == 2
+    assert "float32[3]" in st["signatures"][0]
+    assert len(st["compile_times_s"]) == 2
+    assert obs.counter("jit_traces_total").value(fn=name) == 2
+    assert obs.counter("jit_cache_hits_total").value(fn=name) == 1
+
+
+def test_recompile_storm_warning(metrics_on):
+    pt.set_flags({"recompile_warn_threshold": 2})
+    try:
+        @pt.jit.to_static
+        def g(x):
+            return x + 1
+
+        g(jnp.ones((2,)))
+        with pytest.warns(RuntimeWarning, match="recompilation storm"):
+            g(jnp.ones((5,)))
+        # warned once only
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("error", RuntimeWarning)
+            g(jnp.ones((7,)))
+    finally:
+        pt.set_flags({"recompile_warn_threshold": 8})
+
+
+def test_instrumented_jit_preserves_lower(metrics_on):
+    f = obs.instrumented_jit(lambda x: x + 1, "t_lower")
+    hlo = f.lower(jnp.ones((2,))).compile().as_text()
+    assert hlo  # attribute passthrough works
+
+
+# ---------------------------------------------------------------------------
+# profiler compat shim
+# ---------------------------------------------------------------------------
+
+def test_profiler_compat_record_event_and_summary():
+    profiler.reset_host_events()
+    with profiler.RecordEvent("compat_span"):
+        pass
+    events = profiler.get_host_events()
+    assert events and events[0]["name"] == "compat_span"
+    assert "dur_s" in events[0] and "ts" in events[0]
+    summary = profiler.event_summary()
+    assert summary["compat_span"]["calls"] == 1
+    assert set(summary["compat_span"]) >= {"calls", "total_s", "avg_s",
+                                           "max_s"}
+    profiler.reset_host_events()
+
+
+def test_profiler_compat_stats():
+    profiler.stat_add("t_compat_stat", 3)
+    profiler.stat_add("t_compat_stat")
+    assert profiler.stats.get("t_compat_stat") == 4
+    profiler.stats.set("t_compat_stat", 10)
+    assert profiler.stats.get("t_compat_stat") == 10
+    assert profiler.stats.snapshot()["t_compat_stat"] == 10
+
+
+def test_steptimer_stop_without_start_returns_zero():
+    t = profiler.StepTimer(items_per_step=8)
+    assert t.stop() == 0.0
+    assert t.times == []          # the bogus sample is not recorded
+
+
+def test_steptimer_throughput_single_sample_not_double_counted():
+    t = profiler.StepTimer(items_per_step=8)
+    t.times = [10.0]              # only the warmup/compile sample
+    assert t.throughput(skip_first=1) == 0.0
+    t.times = [10.0, 1.0, 1.0]
+    assert t.throughput(skip_first=1) == pytest.approx(8.0)
+
+
+def test_device_memory_stats():
+    out = obs.device_memory_stats()
+    assert isinstance(out, dict)
+    out_all = obs.device_memory_stats(include_unavailable=True)
+    assert len(out_all) >= 1     # CPU devices report 0 rather than vanish
+    assert all(isinstance(v, int) for v in out_all.values())
+
+
+# ---------------------------------------------------------------------------
+# trace aggregation (shared with tools/)
+# ---------------------------------------------------------------------------
+
+def _fake_xla_events():
+    return [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 2,
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 3,
+         "args": {"name": "XLA Modules"}},
+        {"ph": "X", "name": "fusion.1", "pid": 1, "tid": 2, "ts": 0,
+         "dur": 100.0, "args": {"hlo_category": "convolution"}},
+        {"ph": "X", "name": "fusion.1", "pid": 1, "tid": 2, "ts": 200,
+         "dur": 100.0, "args": {"hlo_category": "convolution"}},
+        {"ph": "X", "name": "copy.2", "pid": 1, "tid": 2, "ts": 300,
+         "dur": 50.0, "args": {"hlo_category": "copy"}},
+        {"ph": "X", "name": "module", "pid": 1, "tid": 3, "ts": 0,
+         "dur": 400.0},
+        {"ph": "X", "name": "module", "pid": 1, "tid": 3, "ts": 400,
+         "dur": 400.0},
+    ]
+
+
+def test_xla_op_rollup():
+    from paddle_tpu.observability import trace_agg
+    rollup = trace_agg.xla_op_rollup(_fake_xla_events())
+    assert rollup["ops"]["fusion.1"] == {"dur_us": 200.0, "count": 2}
+    assert rollup["categories"] == {"convolution": 200.0, "copy": 50.0}
+    assert rollup["total_us"] == 250.0
+    assert rollup["steps"] == 2
+    text = trace_agg.format_xla_rollup(rollup, top=5)
+    assert "convolution" in text and "ms/step" in text
+
+
+def test_xla_op_rollup_refuses_without_lane_metadata():
+    from paddle_tpu.observability import trace_agg
+    events = [e for e in _fake_xla_events()
+              if e.get("args", {}).get("name") != "XLA Ops"]
+    with pytest.raises(trace_agg.TraceFormatError):
+        trace_agg.xla_op_rollup(events)
+
+
+def test_span_summary_and_table():
+    from paddle_tpu.observability import trace_agg
+    events = [
+        {"ph": "X", "name": "step", "ts": 0, "dur": 10.0},
+        {"ph": "X", "name": "step", "ts": 20, "dur": 30.0},
+        {"ph": "M", "name": "process_name"},
+    ]
+    s = trace_agg.span_summary(events)
+    assert s["step"] == {"calls": 2, "total_us": 40.0, "max_us": 30.0,
+                         "avg_us": 20.0}
+    table = trace_agg.format_span_table(s, top=10)
+    assert "step" in table and "calls" in table
+
+
+# ---------------------------------------------------------------------------
+# instrumented hot paths
+# ---------------------------------------------------------------------------
+
+class _MLP(pt.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = pt.nn.Linear(8, 16)
+        self.fc2 = pt.nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(pt.nn.functional.relu(self.fc1(x)))
+
+
+def _loader(n=96, batch=32):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    y = rng.integers(0, 4, n).astype(np.int64)
+    return pt.data.DataLoader(pt.data.TensorDataset(x, y),
+                              batch_size=batch)
+
+
+def test_fit_smoke_populates_metrics(metrics_on, tmp_path):
+    """Tier-1-safe CPU smoke: one fit with FLAGS_enable_metrics=1 must
+    populate step-time, throughput, recompile and device-memory series
+    (the ISSUE acceptance criteria)."""
+    from paddle_tpu.clip import ClipGradByGlobalNorm
+    pt.set_flags({"trace_dir": str(tmp_path)})
+    m = pt.hapi.Model(_MLP())
+    m.prepare(optimizer=pt.optimizer.Adam(
+                  learning_rate=1e-2,
+                  grad_clip=ClipGradByGlobalNorm(1.0)),
+              loss=pt.nn.CrossEntropyLoss())
+    m.fit(_loader(), epochs=1, verbose=0)
+
+    snap = obs.registry().snapshot()
+    # step-time histogram: one sample per step (96/32 = 3 steps)
+    assert snap["hapi_step_time_seconds"]["series"][0]["count"] == 3
+    assert snap["hapi_throughput_items_per_sec"]["series"][0]["value"] > 0
+    assert snap["hapi_loss"]["series"][0]["value"] > 0
+    assert any(s["labels"].get("device")
+               for s in snap["device_mem_bytes_in_use"]["series"])
+    assert snap["optimizer_steps_total"]["series"][0]["value"] == 3
+    # recompile series: the train step traced exactly once
+    traces = {s["labels"]["fn"]: s["value"]
+              for s in snap["jit_traces_total"]["series"]}
+    assert traces.get("TrainStep(_MLP)") == 1
+    hits = {s["labels"]["fn"]: s["value"]
+            for s in snap["jit_cache_hits_total"]["series"]}
+    assert hits.get("TrainStep(_MLP)") == 2
+    # grad-norm gauge (clipping on -> debug callback recorded a value)
+    assert snap["grad_global_norm"]["series"][0]["value"] > 0
+    # data pipeline instrumentation
+    assert snap["data_batches_total"]["series"][0]["value"] == 3
+    # trace_dir export happened at train end
+    assert os.path.exists(tmp_path / "host_trace.json")
+    assert os.path.exists(tmp_path / "metrics.json")
+    with open(tmp_path / "metrics.json") as f:
+        dumped = json.load(f)
+    assert "hapi_step_time_seconds" in dumped["metrics"]
+    assert "TrainStep(_MLP)" in dumped["recompile"]
+
+
+def test_trace_report_on_fit_output(metrics_on, tmp_path, capsys):
+    """ISSUE acceptance: trace_report on a 3-step CPU fit run prints a
+    non-empty per-span summary table."""
+    pt.set_flags({"trace_dir": str(tmp_path)})
+    m = pt.hapi.Model(_MLP())
+    m.prepare(optimizer=pt.optimizer.SGD(learning_rate=1e-2),
+              loss=pt.nn.CrossEntropyLoss())
+    m.fit(_loader(), epochs=1, verbose=0)
+
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import trace_report
+        rc = trace_report.report(str(tmp_path))
+    finally:
+        sys.path.pop(0)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "TrainStep(_MLP)" in out
+    assert "merged span summary" in out
+    assert "hapi_step_time_seconds" in out
+
+
+def test_fit_disabled_adds_no_metrics():
+    assert not obs.enabled()
+    obs.reset_all()
+    m = pt.hapi.Model(_MLP())
+    m.prepare(optimizer=pt.optimizer.SGD(learning_rate=1e-2),
+              loss=pt.nn.CrossEntropyLoss())
+    m.fit(_loader(n=32), epochs=1, verbose=0)
+    snap = obs.registry().snapshot()
+    assert "hapi_step_time_seconds" not in snap
+    assert obs.get_tracer().events() == []
+    obs.reset_all()
+
+
+def test_dataloader_and_reader_instrumentation(metrics_on):
+    list(_loader(n=64, batch=16))
+    assert obs.counter("data_batches_total").value() == 4
+    assert obs.histogram("data_batch_wait_seconds").count() == 4
+
+    r = pt.reader.batch(lambda: iter(range(10)), 3)
+    n = sum(1 for _ in r())
+    assert n == 4
+    assert obs.counter("reader_batches_total").value() == 4
+    buf = pt.reader.buffered(lambda: iter(range(5)), 2)
+    assert list(buf()) == [0, 1, 2, 3, 4]
+    assert obs.histogram("reader_buffer_wait_seconds").count() > 0
+
+
+def test_collective_accounting(metrics_on):
+    from paddle_tpu.parallel import collective
+    n = jax.local_device_count()
+    f = jax.pmap(lambda x: collective.all_reduce(x, group="dp"),
+                 axis_name="dp")
+    out = f(jnp.ones((n, 4), jnp.float32))
+    assert out.shape == (n, 4)
+    # accounted once per TRACE, not per execution
+    assert obs.counter("collective_calls_total").value(
+        op="all_reduce") == 1
+    assert obs.counter("collective_bytes_total").value(
+        op="all_reduce") == 16  # per-shard payload: 4 x float32
+
+
+def test_eager_optimizer_step_counter(metrics_on):
+    lin = pt.nn.Linear(4, 2)
+    opt = pt.optimizer.SGD(learning_rate=0.1,
+                           parameters=lin.parameters())
+    grads = [jnp.ones_like(p.value) for p in lin.parameters()
+             if p.trainable]
+    opt.step(grads)
+    assert obs.counter("optimizer_steps_total").value() == 1
+
+
+def test_trace_report_self_test_subprocess():
+    """CI hook: the CLI must pass its self-test without a TPU."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "trace_report.py"),
+         "--self-test"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert "self-test OK" in proc.stdout

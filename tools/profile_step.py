@@ -9,12 +9,8 @@ the same aggregation the round-2 README profile used.
 
 from __future__ import annotations
 
-import glob
-import gzip
-import json
 import os
 import sys
-from collections import defaultdict
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -71,78 +67,33 @@ def capture(model: str, batch: int) -> str:
 
 
 def aggregate(outdir: str) -> None:
-    traces = sorted(glob.glob(os.path.join(
-        outdir, "**", "*.trace.json.gz"), recursive=True))
+    # parsing/rollup shared with tools/trace_report.py:
+    # paddle_tpu.observability.trace_agg (keeps the round-4 lesson in
+    # one place: only the "XLA Ops" lane, hlo_category over name
+    # guessing)
+    from paddle_tpu.observability import trace_agg
+
+    traces = trace_agg.find_xla_traces(outdir)
     if not traces:
         # a profiler stage with no trace produced no data — exit nonzero
         # so capture_all records it not-ok and the watcher retries
         print(f"no trace.json.gz under {outdir}", file=sys.stderr)
         sys.exit(2)
-    with gzip.open(traces[-1], "rt") as f:
-        data = json.load(f)
-    events = data.get("traceEvents", [])
-    # The device process exposes three lanes (Steps / XLA Modules /
-    # XLA Ops); the first two are aggregates of the third, so summing
-    # every device event double-counts the whole step (the round-4
-    # rollup did exactly that and mis-ranked BN reductions over conv).
-    # Keep ONLY the "XLA Ops" lane and trust its hlo_category metadata
-    # over name-substring guessing (fusion names hide the conv inside).
-    pid_names = {e.get("pid"): e.get("args", {}).get("name", "")
-                 for e in events if e.get("ph") == "M"
-                 and e.get("name") == "process_name"}
-    device_pids = {p for p, n in pid_names.items()
-                   if "TPU" in n or "tpu" in n or "/device" in n.lower()
-                   or "XLA" in n}
-    op_tids = {(e.get("pid"), e.get("tid"))
-               for e in events if e.get("ph") == "M"
-               and e.get("name") == "thread_name"
-               and e.get("args", {}).get("name") == "XLA Ops"}
-    if not op_tids:
-        # without lane metadata the filter below would silently revert
-        # to summing Steps + Modules + Ops (the double-count this
-        # rewrite removed) — refuse to print authoritative-looking
-        # numbers instead
-        print("trace has no 'XLA Ops' thread_name metadata; cannot "
-              "aggregate reliably (profiler version mismatch?)",
-              file=sys.stderr)
+    events = trace_agg.load_trace_events(traces[-1])
+    try:
+        rollup = trace_agg.xla_op_rollup(events)
+    except trace_agg.TraceFormatError as e:
+        # without lane metadata the aggregation would silently revert
+        # to summing Steps + Modules + Ops (the double-count the
+        # round-4 rewrite removed) — refuse to print
+        # authoritative-looking numbers instead
+        print(str(e), file=sys.stderr)
         sys.exit(2)
-    durs: dict = defaultdict(float)
-    counts: dict = defaultdict(int)
-    cats: dict = defaultdict(float)
-    total = 0.0
-    for e in events:
-        if e.get("ph") != "X":
-            continue
-        if device_pids and e.get("pid") not in device_pids:
-            continue
-        if (e.get("pid"), e.get("tid")) not in op_tids:
-            continue
-        name = e.get("name", "?")
-        d = float(e.get("dur", 0.0))
-        durs[name] += d
-        counts[name] += 1
-        cats[e.get("args", {}).get("hlo_category", "?")] += d
-        total += d
-    # per-step divisor: one event per step on the "XLA Modules" lane
-    mod_tids = {(e.get("pid"), e.get("tid"))
-                for e in events if e.get("ph") == "M"
-                and e.get("name") == "thread_name"
-                and e.get("args", {}).get("name") == "XLA Modules"}
-    steps = sum(1 for e in events if e.get("ph") == "X"
-                and (e.get("pid"), e.get("tid")) in mod_tids)
-    if not steps:
+    if not rollup["steps"]:
         print("warning: no 'XLA Modules' step events; reporting "
               "whole-trace totals as one step", file=sys.stderr)
-        steps = 1
-    print(f"\n== device op time rollup (total {total / 1e3:.2f} ms, "
-          f"{steps} steps, {total / steps / 1e3:.2f} ms/step) ==")
-    for c, d in sorted(cats.items(), key=lambda kv: -kv[1]):
-        print(f"  {c:24s} {d / steps / 1e3:9.3f} ms/step "
-              f"{d / total * 100:5.1f}%")
-    print("\n== top 30 ops by total duration ==")
-    for name, d in sorted(durs.items(), key=lambda kv: -kv[1])[:30]:
-        print(f"  {d / steps / 1e3:9.3f} ms/step x{counts[name]:<5d}"
-              f" {name[:100]}")
+    print()
+    print(trace_agg.format_xla_rollup(rollup, top=30))
 
 
 def main() -> None:
