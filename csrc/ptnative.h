@@ -174,6 +174,11 @@ int64_t pt_srv_next(int64_t h, int timeout_ms, uint64_t* req_id,
 int pt_srv_reply(int64_t h, uint64_t req_id, int64_t status,
                  const uint8_t* data, int64_t len);
 int64_t pt_srv_pending(int64_t h);
+// "key=value\n" server stats (queue depth, inflight, accepted/replied
+// totals, uptime, plus monitor-registry "serving.*" lines) — the local
+// view of the STATS control request. Returns bytes written (or needed
+// when cap is too small), -1 on a bad handle.
+int64_t pt_srv_stats(int64_t h, char* buf, int64_t cap);
 
 // ---------------- monitor ----------------
 void pt_mon_add(const char* name, int64_t v);

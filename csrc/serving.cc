@@ -17,6 +17,13 @@
 //   server -> client:  u64 tag | i64 status | u32 len | payload
 // A connection may pipeline many tagged requests; replies carry the tag
 // and may arrive out of order (the Python batcher decides scheduling).
+//
+// Control frames use magic 'PTSC' with the same header layout; the
+// payload starts with a u32 opcode. Opcode 1 (STATS) is answered
+// inline by the reader thread — it never enters the request queue, so
+// health probes work even when the queue is saturated. The reply body
+// is "key=value\n" text: server counters plus every monitor-registry
+// stat with the "serving." prefix (docs/serving_protocol.md).
 
 #include "ptnative.h"
 
@@ -27,19 +34,24 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
+#include <cstdio>
 #include <cstring>
 #include <deque>
 #include <map>
 #include <memory>
 #include <mutex>
+#include <sstream>
 #include <string>
 #include <thread>
 #include <vector>
 
 namespace {
 
-constexpr uint32_t kMagic = 0x56535450;  // "PTSV"
+constexpr uint32_t kMagic = 0x56535450;     // "PTSV"
+constexpr uint32_t kMagicCtl = 0x43535450;  // "PTSC" control frame
+constexpr uint32_t kCtlStats = 1;
 // Hard cap on a single request payload: a corrupt/malicious length must
 // fail the request, not drive an unchecked allocation (same rule as the
 // PS dispatch validation).
@@ -171,6 +183,7 @@ class Server {
         inflight_.emplace(oversized_id, oversized);
         queue_.pop_front();
         space_cv_.notify_one();
+        oversized_total_.fetch_add(1);
       }
       // Error-reply outside mu_ (Reply re-takes it).
       static const char kMsg[] = "request exceeds server max_payload";
@@ -191,16 +204,29 @@ class Server {
       inf = it->second;
       inflight_.erase(it);
     }
-    if (!inf.conn->alive.load()) return -3;
+    if (!inf.conn->alive.load()) {
+      reply_dropped_total_.fetch_add(1);
+      pt_mon_add("serving.reply_dropped_total", 1);
+      return -3;
+    }
     uint8_t hdr[8 + 8 + 4];
     std::memcpy(hdr, &inf.tag, 8);
     std::memcpy(hdr + 8, &status, 8);
     uint32_t l = static_cast<uint32_t>(len);
     std::memcpy(hdr + 16, &l, 4);
+    // Count BEFORE writing: a client that has received its reply and
+    // immediately probes STATS must see it counted (the inverse race —
+    // counting a reply whose write then fails — is corrected by the
+    // dropped counter below).
+    replied_total_.fetch_add(1);
+    pt_mon_add("serving.replied_total", 1);
+    if (status != 0) pt_mon_add("serving.error_replies_total", 1);
     std::lock_guard<std::mutex> wl(inf.conn->write_mu);
     if (!WriteFull(inf.conn->fd, hdr, sizeof(hdr)) ||
         (len > 0 && !WriteFull(inf.conn->fd, data, len))) {
       inf.conn->alive.store(false);
+      reply_dropped_total_.fetch_add(1);
+      pt_mon_add("serving.reply_dropped_total", 1);
       return -3;
     }
     return 0;
@@ -209,6 +235,53 @@ class Server {
   int64_t Pending() {
     std::lock_guard<std::mutex> lk(mu_);
     return static_cast<int64_t>(queue_.size());
+  }
+
+  // "key=value\n" stats: server internals plus monitor-registry lines
+  // scoped to "serving." (the Python batcher publishes there via
+  // pt_mon_add, so batch-size buckets ride the same reply).
+  std::string StatsText() {
+    size_t qd, inflight, alive = 0;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      qd = queue_.size();
+      inflight = inflight_.size();
+      for (auto& c : conns_)
+        if (c->alive.load()) alive++;
+    }
+    auto up = std::chrono::duration_cast<std::chrono::milliseconds>(
+                  std::chrono::steady_clock::now() - start_)
+                  .count();
+    std::string out;
+    char line[128];
+    auto add = [&](const char* k, long long v) {
+      std::snprintf(line, sizeof(line), "%s=%lld\n", k, v);
+      out += line;
+    };
+    add("proto_version", 1);
+    add("uptime_ms", static_cast<long long>(up));
+    add("queue_depth", static_cast<long long>(qd));
+    add("queue_cap", queue_cap_);
+    add("inflight", static_cast<long long>(inflight));
+    add("accepted_total", static_cast<long long>(accepted_total_.load()));
+    add("replied_total", static_cast<long long>(replied_total_.load()));
+    add("reply_dropped_total",
+        static_cast<long long>(reply_dropped_total_.load()));
+    add("oversized_total", static_cast<long long>(oversized_total_.load()));
+    add("connections_active", static_cast<long long>(alive));
+    add("connections_total", static_cast<long long>(conns_total_.load()));
+    add("stats_requests_total",
+        static_cast<long long>(stats_requests_total_.load()));
+    int64_t need = pt_mon_dump(nullptr, 0);
+    if (need > 0) {
+      std::string mon(static_cast<size_t>(need), '\0');
+      pt_mon_dump(&mon[0], need);
+      std::istringstream ss(mon);
+      std::string l;
+      while (std::getline(ss, l))
+        if (l.rfind("serving.", 0) == 0) out += l + "\n";
+    }
+    return out;
   }
 
  private:
@@ -226,6 +299,8 @@ class Server {
       }
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      conns_total_.fetch_add(1);
+      pt_mon_add("serving.connections_total", 1);
       auto conn = std::make_shared<Conn>(fd);
       auto done = std::make_shared<std::atomic<bool>>(false);
       {
@@ -274,9 +349,35 @@ class Server {
       std::memcpy(&magic, hdr, 4);
       std::memcpy(&tag, hdr + 4, 8);
       std::memcpy(&len, hdr + 12, 4);
-      if (magic != kMagic || len > kMaxPayload) break;  // corrupt stream
+      if ((magic != kMagic && magic != kMagicCtl) || len > kMaxPayload)
+        break;  // corrupt stream
       std::string payload(len, '\0');
       if (len > 0 && !ReadFull(conn->fd, payload.data(), len)) break;
+      if (magic == kMagicCtl) {
+        // Control request: answered inline by this reader thread (never
+        // queued), so stats stay reachable under full-queue backpressure.
+        uint32_t opcode = 0;
+        if (payload.size() >= 4) std::memcpy(&opcode, payload.data(), 4);
+        std::string body;
+        int64_t status = 0;
+        if (opcode == kCtlStats) {
+          stats_requests_total_.fetch_add(1);
+          body = StatsText();
+        } else {
+          status = -4;
+          body = "unknown control opcode";
+        }
+        uint8_t rhdr[8 + 8 + 4];
+        std::memcpy(rhdr, &tag, 8);
+        std::memcpy(rhdr + 8, &status, 8);
+        uint32_t l = static_cast<uint32_t>(body.size());
+        std::memcpy(rhdr + 16, &l, 4);
+        std::lock_guard<std::mutex> wl(conn->write_mu);
+        if (!WriteFull(conn->fd, rhdr, sizeof(rhdr)) ||
+            (l > 0 && !WriteFull(conn->fd, body.data(), l)))
+          break;
+        continue;
+      }
       std::unique_lock<std::mutex> lk(mu_);
       // Backpressure: block the reading side when the queue is full, so
       // a flood degrades to TCP flow control instead of unbounded memory.
@@ -286,6 +387,8 @@ class Server {
       });
       if (stopping_.load()) break;
       queue_.push_back(Request{next_id_++, tag, conn, std::move(payload)});
+      accepted_total_.fetch_add(1);
+      pt_mon_add("serving.accepted_total", 1);
       cv_.notify_one();
     }
     conn->alive.store(false);
@@ -298,6 +401,14 @@ class Server {
   int port_ = 0;
   int queue_cap_;
   std::atomic<bool> stopping_{false};
+  std::atomic<uint64_t> accepted_total_{0};
+  std::atomic<uint64_t> replied_total_{0};
+  std::atomic<uint64_t> reply_dropped_total_{0};
+  std::atomic<uint64_t> oversized_total_{0};
+  std::atomic<uint64_t> conns_total_{0};
+  std::atomic<uint64_t> stats_requests_total_{0};
+  std::chrono::steady_clock::time_point start_ =
+      std::chrono::steady_clock::now();
   std::thread accept_thread_;
   std::vector<std::pair<std::thread, std::shared_ptr<std::atomic<bool>>>>
       conn_threads_;
@@ -371,6 +482,15 @@ int pt_srv_reply(int64_t h, uint64_t req_id, int64_t status,
 int64_t pt_srv_pending(int64_t h) {
   auto s = Get(h);
   return s ? s->Pending() : -1;
+}
+
+int64_t pt_srv_stats(int64_t h, char* buf, int64_t cap) {
+  auto s = Get(h);
+  if (!s) return -1;
+  std::string text = s->StatsText();
+  int64_t need = static_cast<int64_t>(text.size());
+  if (buf && cap >= need) std::memcpy(buf, text.data(), need);
+  return need;
 }
 
 }  // extern "C"

@@ -14,11 +14,17 @@
  * paddle_tpu.inference.encode_tensors/decode_tensors; for raw use the
  * payload is opaque. Compile a demo binary with -DPTSC_DEMO_MAIN.
  *
+ * Control frames (magic 'PTSC', same header layout, payload = u32
+ * opcode) query the server out-of-band; opcode 1 (STATS) returns
+ * "key=value\n" text with queue/served/uptime counters
+ * (docs/serving_protocol.md "STATS control frames").
+ *
  * API (all return 0 on success, negative on error):
  *   ptsc_connect(host, port)                 -> fd (>=0) or -errno
  *   ptsc_request(fd, payload, len, &tag)     -> sends one frame
  *   ptsc_wait_reply(fd, tag, buf, cap, &status, &out_len)
  *   ptsc_infer(fd, payload, len, buf, cap, &status, &out_len)
+ *   ptsc_stats(fd, buf, cap, &status, &out_len)
  *   ptsc_close(fd)
  */
 
@@ -32,7 +38,9 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
-#define PTSC_MAGIC 0x56535450u /* 'PTSV' */
+#define PTSC_MAGIC 0x56535450u     /* 'PTSV' */
+#define PTSC_MAGIC_CTL 0x43535450u /* 'PTSC' control frame */
+#define PTSC_OP_STATS 1u
 
 #define PTSC_ERR_CONNECT -1
 #define PTSC_ERR_IO -2
@@ -199,13 +207,28 @@ int ptsc_infer(int fd, const void *payload, uint32_t len, void *buf,
   return ptsc_wait_reply(fd, tag, buf, cap, status, out_len);
 }
 
+/* STATS control round trip: reply payload is "key=value\n" text. */
+int ptsc_stats(int fd, void *buf, uint32_t cap, int64_t *status,
+               uint32_t *out_len) {
+  unsigned char hdr[20];
+  uint64_t tag = PTSC_NEXT_TAG();
+  int rc;
+  ptsc_put_u32(hdr, PTSC_MAGIC_CTL);
+  ptsc_put_u64(hdr + 4, tag);
+  ptsc_put_u32(hdr + 12, 4);
+  ptsc_put_u32(hdr + 16, PTSC_OP_STATS);
+  if ((rc = ptsc_write_all(fd, hdr, sizeof(hdr))) != 0) return rc;
+  return ptsc_wait_reply(fd, tag, buf, cap, status, out_len);
+}
+
 int ptsc_close(int fd) { return close(fd); }
 
 #ifdef PTSC_DEMO_MAIN
 #include <stdlib.h>
 /* Demo/test binary: send argv[3] (default "ping") as one request,
- * print "status=<s> len=<n>" then the payload bytes to stdout.
- * Usage: ptsc_demo <host> <port> [payload-string] */
+ * print "status=<s> len=<n>" then the payload bytes to stdout. With
+ * payload "--stats" issue a STATS control request instead.
+ * Usage: ptsc_demo <host> <port> [payload-string | --stats] */
 int main(int argc, char **argv) {
   static char reply[1 << 22];
   const char *msg;
@@ -213,7 +236,7 @@ int main(int argc, char **argv) {
   int64_t status = -999;
   int fd, rc;
   if (argc < 3) {
-    fprintf(stderr, "usage: %s host port [payload]\n", argv[0]);
+    fprintf(stderr, "usage: %s host port [payload|--stats]\n", argv[0]);
     return 2;
   }
   msg = argc > 3 ? argv[3] : "ping";
@@ -222,10 +245,13 @@ int main(int argc, char **argv) {
     fprintf(stderr, "connect failed: %d\n", fd);
     return 1;
   }
-  rc = ptsc_infer(fd, msg, (uint32_t)strlen(msg), reply, sizeof(reply),
-                  &status, &out_len);
+  if (strcmp(msg, "--stats") == 0)
+    rc = ptsc_stats(fd, reply, sizeof(reply), &status, &out_len);
+  else
+    rc = ptsc_infer(fd, msg, (uint32_t)strlen(msg), reply, sizeof(reply),
+                    &status, &out_len);
   if (rc != 0) {
-    fprintf(stderr, "infer failed: %d\n", rc);
+    fprintf(stderr, "request failed: %d\n", rc);
     return 1;
   }
   printf("status=%lld len=%u\n", (long long)status, out_len);

@@ -41,10 +41,17 @@ def main(verbose: bool = True):
         with inference.Server(pred, max_batch=8, wait_ms=10) as srv:
             with inference.Client(port=srv.port) as cli:
                 served = cli.infer([x])[0]
+                # STATS control frame: live queue/served counters
+                # (docs/serving_protocol.md)
+                stats = cli.stats()
         np.testing.assert_allclose(served, want, rtol=1e-5, atol=1e-5)
+        assert stats["replied_total"] >= 1, stats
     if verbose:
         print("inference_serving: export -> predictor -> native server "
-              "round trip OK (C clients: csrc/serving_client.c)")
+              "round trip OK (C clients: csrc/serving_client.c); "
+              f"server stats: accepted={stats['accepted_total']} "
+              f"replied={stats['replied_total']} "
+              f"uptime_ms={stats['uptime_ms']}")
     return {"ok": True}
 
 

@@ -301,6 +301,37 @@ define_flag("enable_metrics", False,
             "step). (ref capability: monitor.h stats + "
             "Enable/DisableProfiler.)",
             on_change=_enable_metrics_changed)
+define_flag("metrics_port", 0,
+            "TCP port for the live observability HTTP exporter "
+            "(observability/server.py). 0 (default) = no server; -1 = "
+            "ephemeral port (tests). When set (and "
+            "FLAGS_enable_metrics is on), hapi.Model.fit and "
+            "inference.Server start a daemon-threaded stdlib HTTP "
+            "server exposing /metrics (Prometheus text), /healthz "
+            "(device liveness + train heartbeat), /varz (full JSON "
+            "snapshot incl. program cards) and /trace?ms=N (on-demand "
+            "chrome-trace window). (ref capability: monitor/stat "
+            "export surface.)")
+define_flag("program_analytics", True,
+            "Harvest compiled-program analytics (XLA cost_analysis + "
+            "memory_analysis) into per-function program cards on every "
+            "jit trace while FLAGS_enable_metrics is on. The harvest "
+            "runs lowered.compile() a second time per traced signature "
+            "— a trace-time-only cost, zero steady-state overhead — "
+            "and feeds the achieved-FLOPs gauge on /metrics. Off skips "
+            "harvesting entirely.")
+define_flag("anomaly_spike_factor", 10.0,
+            "Anomaly sentinel spike threshold: a watched series (loss, "
+            "grad norm) whose value exceeds this factor times its "
+            "running EWMA (after a short warmup) is counted in "
+            "anomalies_total and logged to events.jsonl under "
+            "FLAGS_trace_dir. NaN/Inf are always flagged. 0 disables "
+            "spike detection (NaN/Inf detection stays on).")
+define_flag("health_heartbeat_timeout_s", 300.0,
+            "The /healthz endpoint reports unhealthy (HTTP 503) when a "
+            "training heartbeat exists but is older than this many "
+            "seconds — a wedged fit() loop reads unhealthy while the "
+            "process is still up. 0 disables the staleness check.")
 define_flag("trace_dir", "",
             "If set, observability.export_all()/Model.fit write the "
             "host chrome-trace (host_trace.json) and metrics snapshot "

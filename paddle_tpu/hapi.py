@@ -241,6 +241,8 @@ class Model:
                        "host_driven", False):
                 callbacks.append(LRSchedulerCallback(self._optimizer))
         history: Dict[str, List[float]] = {}
+        # live observability plane: flag-gated, idempotent, daemon thread
+        _obs.server.maybe_start()
         if self._train_step is not None:
             # weights may have been set_value'd/loaded since the last fit
             self._train_step.reset_from_model()
@@ -276,7 +278,21 @@ class Model:
                         "synced only at snapshot time)")
                     mem_g = _obs.gauge(
                         "device_mem_bytes_in_use",
-                        "per-device allocator bytes_in_use watermark")
+                        "per-device allocator true-peak watermark "
+                        "(peak_bytes_in_use where the backend reports "
+                        "it, else the bytes_in_use high-water mark)")
+                    headroom_g = _obs.gauge(
+                        "memory_headroom_bytes",
+                        "per-device bytes_limit - bytes_in_use (absent "
+                        "on backends without an allocator limit)")
+                    hb_g = _obs.gauge(
+                        _obs.server.HEARTBEAT_GAUGE,
+                        "unix time of the latest completed fit() step "
+                        "dispatch; /healthz flags staleness")
+                    flops_g = _obs.gauge(
+                        "achieved_flops_per_sec",
+                        "XLA cost-model FLOPs of the compiled train "
+                        "step divided by measured step wall time")
                 for i, batch in enumerate(train_loader):
                     *inputs, label = batch
                     if obs_on:
@@ -292,9 +308,21 @@ class Model:
                             if np.ndim(label) else 1
                         tput_g.set(items / dt if dt > 0 else 0.0)
                         loss_g.set(metrics.get("loss"))
-                        for dev, b in _obs.device_memory_stats(
-                                include_unavailable=True).items():
-                            mem_g.set_max(b, device=dev)
+                        hb_g.set(time.time())
+                        for dev, ms in _obs.device_memory_stats(
+                                include_unavailable=True,
+                                full=True).items():
+                            mem_g.set_max(
+                                ms["peak_bytes_in_use"]
+                                or ms["bytes_in_use"], device=dev)
+                            if ms["bytes_limit"]:
+                                headroom_g.set(
+                                    ms["bytes_limit"]
+                                    - ms["bytes_in_use"], device=dev)
+                        flops = _obs.xprof.flops_of(
+                            getattr(step, "_span_name", ""))
+                        if flops and dt > 0:
+                            flops_g.set(flops / dt)
                     for k, v in metrics.items():
                         # running device-side sum: O(1) buffers, still one
                         # async dispatch per step (no host sync)

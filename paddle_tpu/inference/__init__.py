@@ -354,9 +354,14 @@ class Server:
     efficiency on TPU).
     """
 
+    # batch-size buckets published to the native stat registry (and the
+    # STATS reply): cumulative "le" semantics like the Python histogram
+    _BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
     def __init__(self, predictor: Predictor, port: int = 0,
                  max_batch: int = 32, wait_ms: int = 2,
-                 queue_cap: int = 512, max_payload: int = 64 << 20):
+                 queue_cap: int = 512, max_payload: int = 64 << 20,
+                 stats_interval_s: float = 1.0):
         from ..native import ServingTransport
 
         self.predictor = predictor
@@ -369,7 +374,56 @@ class Server:
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self.n_batches = 0
         self.n_requests = 0
+        self.n_errors = 0
         self._thread.start()
+        # live observability: flag-gated HTTP exporter + a bridge thread
+        # that scrapes the native transport's stats into the metrics
+        # registry so server internals ride the same /metrics page
+        from ..observability import server as _obs_server
+        _obs_server.maybe_start()
+        self._stats_interval_s = max(0.05, float(stats_interval_s))
+        self._bridge = threading.Thread(target=self._bridge_loop,
+                                        daemon=True)
+        self._bridge.start()
+
+    def _bridge_loop(self) -> None:
+        while not self._stop.wait(self._stats_interval_s):
+            self.scrape_stats()
+        self.scrape_stats()  # final snapshot so totals survive stop()
+
+    def scrape_stats(self) -> Dict[str, int]:
+        """One bridge pass: pull the native transport stats into the
+        metrics registry (gauges for levels, set_total for the native
+        monotonic counters). Returns the raw stats dict."""
+        from .. import observability as obs
+        try:
+            stats = self.transport.stats()
+        except Exception:  # noqa: BLE001 — transport may be stopping
+            return {}
+        if not stats or not obs.enabled():
+            return stats
+        gauges = {"queue_depth": "serving_queue_depth",
+                  "inflight": "serving_inflight",
+                  "connections_active": "serving_connections_active",
+                  "queue_cap": "serving_queue_cap"}
+        counters = {"accepted_total": "serving_accepted_total",
+                    "replied_total": "serving_replied_total",
+                    "reply_dropped_total": "serving_reply_dropped_total",
+                    "oversized_total": "serving_oversized_total",
+                    "connections_total": "serving_connections_total"}
+        for key, name in gauges.items():
+            if key in stats:
+                obs.gauge(name, f"native serving transport {key}"
+                          ).set(float(stats[key]))
+        for key, name in counters.items():
+            if key in stats:
+                obs.counter(name, f"native serving transport {key}"
+                            ).set_total(float(stats[key]))
+        if "uptime_ms" in stats:
+            obs.gauge("serving_uptime_seconds",
+                      "native serving transport uptime"
+                      ).set(stats["uptime_ms"] / 1e3)
+        return stats
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -421,6 +475,7 @@ class Server:
                           for i in range(len(batch_members[0][1]))]
                 outs = self.predictor.run(joined)
                 self.n_batches += 1
+                self._note_batch(len(batch_members), sum(rows))
                 off = 0
                 for (rid, _), r in zip(batch_members, rows):
                     part = [o[off:off + r] for o in outs]
@@ -428,12 +483,51 @@ class Server:
                     off += r
                     self.n_requests += 1
             except Exception as e:  # noqa: BLE001
+                self.n_errors += len(batch_members)
+                self._note_error(len(batch_members))
                 for rid, _ in batch_members:
                     self.transport.reply(rid, str(e).encode(), status=-1)
+
+    def _note_batch(self, n_members: int, n_rows: int) -> None:
+        """Batch accounting on both planes: the native stat registry
+        (always on — it backs the STATS wire reply for C clients) and
+        the gated Python metrics registry (the /metrics page)."""
+        try:
+            from ..native import stat_add
+            stat_add("serving.batches_total")
+            stat_add("serving.batch_rows_total", n_rows)
+            for b in self._BATCH_BUCKETS:
+                if n_rows <= b:
+                    stat_add(f"serving.batch_size_le_{b}")
+            stat_add("serving.batch_size_le_inf")
+        except Exception:  # noqa: BLE001 — never fail a batch on stats
+            pass
+        from .. import observability as obs
+        if obs.enabled():
+            obs.histogram("serving_batch_size",
+                          "rows per merged serving batch",
+                          buckets=[float(b) for b in self._BATCH_BUCKETS]
+                          ).observe(float(n_rows))
+            obs.counter("serving_requests_total",
+                        "requests answered by the dynamic batcher"
+                        ).inc(n_members)
+
+    def _note_error(self, n_members: int) -> None:
+        try:
+            from ..native import stat_add
+            stat_add("serving.batch_errors_total")
+        except Exception:  # noqa: BLE001
+            pass
+        from .. import observability as obs
+        if obs.enabled():
+            obs.counter("serving_errors_total",
+                        "requests answered with an error status"
+                        ).inc(n_members)
 
     def stop(self) -> None:
         self._stop.set()
         self._thread.join(timeout=5)
+        self._bridge.join(timeout=5)
         self.transport.stop()
 
     def __enter__(self):
@@ -447,7 +541,9 @@ class Client:
     """Socket client of the native serving protocol (tests and the
     reference's demo_ci role). Thread-safe; supports pipelining."""
 
-    _MAGIC = 0x56535450
+    _MAGIC = 0x56535450       # 'PTSV' tensor request
+    _MAGIC_CTL = 0x43535450   # 'PTSC' control frame
+    _OP_STATS = 1
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  timeout_s: float = 30.0):
@@ -466,6 +562,29 @@ class Client:
         if status != 0:
             raise RuntimeError(f"server error: {payload.decode()!r}")
         return decode_tensors(payload)
+
+    def stats(self) -> Dict[str, int]:
+        """STATS control round trip: queue depth, in-flight count,
+        accepted/served/error totals, batch-size buckets, uptime —
+        parsed from the server's "key=value" reply
+        (docs/serving_protocol.md, STATS control frames)."""
+        with self._wlock:
+            self._tag += 1
+            tag = self._tag
+            hdr = struct.pack("<IQI", self._MAGIC_CTL, tag, 4)
+            self._sock.sendall(hdr + struct.pack("<I", self._OP_STATS))
+        status, payload = self._recv(tag)
+        if status != 0:
+            raise RuntimeError(f"stats error: {payload.decode()!r}")
+        out: Dict[str, int] = {}
+        for line in payload.decode().splitlines():
+            if "=" in line:
+                k, v = line.rsplit("=", 1)
+                try:
+                    out[k] = int(v)
+                except ValueError:
+                    pass
+        return out
 
     def _send(self, arrays) -> int:
         payload = encode_tensors(arrays)

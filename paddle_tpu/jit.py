@@ -26,6 +26,7 @@ import os
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
+import jax.export  # noqa: F401 — jax.export is not eagerly imported by jax
 import jax.numpy as jnp
 import numpy as np
 

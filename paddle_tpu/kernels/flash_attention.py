@@ -82,7 +82,8 @@ def bthd_supported(d: int, h: int) -> bool:
 # Mosaic so lets it pipeline/parallelize grid iterations instead of the
 # conservative sequential default. Pure scheduling hint: numerics are
 # identical (interpret-mode tests + the compiled verify stage cover it).
-_GRID_PARALLEL = pltpu.CompilerParams(
+_GRID_PARALLEL = getattr(pltpu, "CompilerParams",
+                         getattr(pltpu, "TPUCompilerParams", None))(
     dimension_semantics=("parallel", "parallel"))
 
 

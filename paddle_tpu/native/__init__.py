@@ -171,6 +171,8 @@ def _load():
             "pt_srv_reply": ([c.c_int64, c.c_uint64, c.c_int64,
                               c.POINTER(c.c_uint8), c.c_int64], c.c_int),
             "pt_srv_pending": ([c.c_int64], c.c_int64),
+            "pt_srv_stats": ([c.c_int64, c.c_char_p, c.c_int64],
+                             c.c_int64),
             "pt_mon_add": ([c.c_char_p, c.c_int64], None),
             "pt_mon_get": ([c.c_char_p], c.c_int64),
             "pt_mon_reset": ([c.c_char_p], None),
@@ -190,6 +192,13 @@ def available() -> bool:
         return True
     except Exception:
         return False
+
+
+def loaded() -> bool:
+    """Whether the library is already loaded — unlike ``available()``
+    this never triggers a build (observability bridges use it so a
+    metrics scrape can't stall on g++)."""
+    return _lib is not None
 
 
 # ---------------------------------------------------------------- control plane
@@ -727,6 +736,23 @@ class ServingTransport:
 
     def pending(self) -> int:
         return _load().pt_srv_pending(self._h)
+
+    def stats(self) -> Dict[str, int]:
+        """Server stats (queue depth, inflight, accepted/replied totals,
+        uptime, serving.* monitor lines) parsed from pt_srv_stats —
+        the local, no-TCP view of the STATS control request."""
+        lib = _load()
+        need = lib.pt_srv_stats(self._h, None, 0)
+        if need <= 0:
+            return {}
+        buf = ctypes.create_string_buffer(need)
+        lib.pt_srv_stats(self._h, buf, need)
+        out: Dict[str, int] = {}
+        for line in buf.raw[:need].decode().splitlines():
+            if "=" in line:
+                k, v = line.rsplit("=", 1)
+                out[k] = int(v)
+        return out
 
     def stop(self) -> None:
         if self._h > 0:

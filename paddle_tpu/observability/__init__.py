@@ -29,37 +29,53 @@ from __future__ import annotations
 import warnings
 from typing import Any, Dict, Optional
 
-from . import metrics, recompile, trace_agg, tracer
+from . import anomaly, metrics, recompile, server, trace_agg, tracer, xprof
+from .anomaly import sentinel as anomaly_sentinel
 from .metrics import (counter, enabled, gauge, histogram, registry,
                       set_enabled)
 from .recompile import instrumented_jit
 from .recompile import tracker as recompile_tracker
 from .tracer import export_chrome_trace, span
 from .tracer import tracer as get_tracer
+from .xprof import cards as program_cards
 
-__all__ = ["metrics", "tracer", "recompile", "trace_agg",
+__all__ = ["metrics", "tracer", "recompile", "trace_agg", "xprof",
+           "anomaly", "server",
            "counter", "gauge", "histogram", "registry", "enabled",
            "set_enabled", "span", "export_chrome_trace", "get_tracer",
-           "instrumented_jit", "recompile_tracker",
+           "instrumented_jit", "recompile_tracker", "program_cards",
+           "anomaly_sentinel", "native_stats",
            "observe_traced", "device_memory_stats", "export_all",
            "reset_all"]
 
 _mem_warned = False
 
+# bytes_in_use plus the extra allocator fields ``full=True`` reports
+_FULL_MEM_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
 
-def device_memory_stats(include_unavailable: bool = False
-                        ) -> Dict[str, int]:
-    """Per-device ``bytes_in_use`` (allocator-stats analogue of the
-    reference's memory/stats + gpu_info mem flags).
+
+def device_memory_stats(include_unavailable: bool = False,
+                        full: bool = False) -> Dict[str, Any]:
+    """Per-device allocator stats (analogue of the reference's
+    memory/stats + gpu_info mem flags).
+
+    Default: ``{device: bytes_in_use}``. With ``full=True`` each device
+    maps to ``{bytes_in_use, peak_bytes_in_use, bytes_limit}`` (fields
+    the backend does not report are 0) — the true high-watermark and
+    headroom the fit() memory gauges need.
 
     Backends without allocator stats (CPU returns None) are skipped, or
-    reported as 0 with ``include_unavailable=True`` (so dashboards keep
-    the series). A backend that *errors* is surfaced with a one-time
-    warning instead of being silently swallowed.
+    reported as 0/zeros with ``include_unavailable=True`` (so dashboards
+    keep the series). A backend that *errors* is surfaced with a
+    one-time warning instead of being silently swallowed.
     """
     global _mem_warned
     import jax
-    out: Dict[str, int] = {}
+
+    def empty():
+        return {k: 0 for k in _FULL_MEM_KEYS} if full else 0
+
+    out: Dict[str, Any] = {}
     for d in jax.local_devices():
         try:
             ms = d.memory_stats()
@@ -72,13 +88,31 @@ def device_memory_stats(include_unavailable: bool = False
                     "missing for this backend (warning shown once)",
                     RuntimeWarning)
             if include_unavailable:
-                out[str(d)] = 0
+                out[str(d)] = empty()
             continue
         if ms:
-            out[str(d)] = int(ms.get("bytes_in_use", 0))
+            if full:
+                out[str(d)] = {k: int(ms.get(k, 0))
+                               for k in _FULL_MEM_KEYS}
+            else:
+                out[str(d)] = int(ms.get("bytes_in_use", 0))
         elif include_unavailable:
-            out[str(d)] = 0
+            out[str(d)] = empty()
     return out
+
+
+def native_stats() -> Dict[str, int]:
+    """Snapshot of the native stat registry (csrc/monitor.cc) — the
+    bridge that makes ``pt_mon_add`` counters from data_feed.cc /
+    ps_service.cc / serving.cc readable from Python. Returns {} when
+    the native library has not been loaded (never triggers a build)."""
+    try:
+        from .. import native as _native
+        if not _native.loaded():
+            return {}
+        return _native.stat_dump()
+    except Exception:  # noqa: BLE001 — telemetry must not raise
+        return {}
 
 
 def observe_traced(name: str, value: Any, kind: str = "gauge") -> None:
@@ -104,8 +138,11 @@ def observe_traced(name: str, value: Any, kind: str = "gauge") -> None:
 
 
 def export_all(path: Optional[str] = None) -> Dict[str, str]:
-    """Write the host chrome trace + metrics/recompile JSON snapshots
-    under ``path`` (default FLAGS_trace_dir); returns written paths."""
+    """Write the host chrome trace + snapshots under ``path`` (default
+    FLAGS_trace_dir); returns written paths. Emits both the JSON
+    snapshot (``metrics.json``: metrics + recompile + program cards +
+    native stats) and the Prometheus text exposition (``metrics.prom``)
+    so offline runs and scraped runs produce the same artifact."""
     import json
     import os
     if path is None:
@@ -114,16 +151,26 @@ def export_all(path: Optional[str] = None) -> Dict[str, str]:
     os.makedirs(path, exist_ok=True)
     out = {"trace": get_tracer().export(path)}
     snap = {"metrics": registry().snapshot(),
-            "recompile": recompile_tracker().snapshot()}
+            "recompile": recompile_tracker().snapshot(),
+            "programs": program_cards().snapshot(),
+            "native_stats": native_stats()}
     mpath = os.path.join(path, "metrics.json")
     with open(mpath, "w") as f:
         json.dump(snap, f, indent=1, sort_keys=True, default=str)
     out["metrics"] = mpath
+    from .server import metrics_text
+    ppath = os.path.join(path, "metrics.prom")
+    with open(ppath, "w") as f:
+        f.write(metrics_text())
+    out["prometheus"] = ppath
     return out
 
 
 def reset_all() -> None:
-    """Clear metrics, spans, and recompile records (tests/new runs)."""
+    """Clear metrics, spans, recompile records, program cards, and
+    anomaly state (tests/new runs)."""
     registry().reset()
     get_tracer().reset()
     recompile_tracker().reset()
+    program_cards().reset()
+    anomaly_sentinel().reset()

@@ -1,0 +1,136 @@
+"""Anomaly sentinel: trace-time NaN/Inf and spike detection.
+
+The reference's FLAGS_check_nan_inf scans fetched outputs on the host
+after every step — a full sync per step. The TPU-native sentinel rides
+the ``observe_traced`` mechanism instead: ``probe()`` called inside a
+to-be-jitted function inserts a ``jax.debug.callback`` **at trace
+time** (only while FLAGS_enable_metrics is on), so the compiled program
+streams each watched scalar (loss, grad global norm) to the host
+asynchronously — no blocking sync, zero overhead when metrics are off,
+and the callback presence is baked in at trace time like
+``observe_traced`` documents.
+
+Host side, each watched series keeps an EWMA; a sample is an anomaly
+when it is non-finite, or exceeds ``FLAGS_anomaly_spike_factor`` times
+the EWMA after a short warmup. Anomalies increment ``anomalies_total
+{kind=,series=}`` and append one JSON record per event to
+``events.jsonl`` under FLAGS_trace_dir (structured, tail-able — the
+audit analogue of the reference's nan-inf printouts).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from . import metrics as _metrics
+
+__all__ = ["AnomalySentinel", "sentinel", "probe"]
+
+_WARMUP_SAMPLES = 5
+_EWMA_ALPHA = 0.1
+
+
+class AnomalySentinel:
+    """Per-series EWMA watcher with a JSONL event log."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._series: Dict[str, Dict[str, float]] = {}
+
+    # -- traced entry point ------------------------------------------------
+
+    def probe(self, series: str, value: Any) -> None:
+        """Watch a TRACED scalar. Call inside a jitted function; inserts
+        the host callback only when metrics are enabled at trace time
+        (flipping the flag later does not retrace)."""
+        if not _metrics.enabled():
+            return
+        # register the counter at trace time so the series' TYPE line is
+        # on /metrics from the first scrape, not only after an incident
+        _metrics.counter(
+            "anomalies_total",
+            "NaN/Inf and spike events seen by the anomaly sentinel")
+        import jax
+        jax.debug.callback(
+            lambda v, _s=series: self.observe(_s, float(v)), value)
+
+    # -- host side ---------------------------------------------------------
+
+    def observe(self, series: str, value: float) -> Optional[str]:
+        """Feed one host-side sample; returns the anomaly kind recorded
+        ("nan" | "spike") or None. Usable directly for host-driven
+        series (tests, custom loops)."""
+        kind = None
+        ewma = None
+        with self._lock:
+            st = self._series.setdefault(series, {"ewma": 0.0, "n": 0})
+            if not math.isfinite(value):
+                kind = "nan"
+            else:
+                ewma = st["ewma"]
+                factor = self._spike_factor()
+                if (factor > 0 and st["n"] >= _WARMUP_SAMPLES
+                        and abs(value) > factor * max(abs(ewma), 1e-12)):
+                    kind = "spike"
+                st["ewma"] = (value if st["n"] == 0 else
+                              (1 - _EWMA_ALPHA) * ewma
+                              + _EWMA_ALPHA * value)
+                st["n"] += 1
+        if kind is not None:
+            self._record(kind, series, value, ewma)
+        return kind
+
+    @staticmethod
+    def _spike_factor() -> float:
+        try:
+            from ..flags import GLOBAL_FLAGS
+            return float(GLOBAL_FLAGS.get("anomaly_spike_factor"))
+        except Exception:
+            return 0.0
+
+    def _record(self, kind: str, series: str, value: float,
+                ewma: Optional[float]) -> None:
+        _metrics.counter(
+            "anomalies_total",
+            "NaN/Inf and spike events seen by the anomaly sentinel"
+        ).inc(kind=kind, series=series)
+        try:
+            from ..flags import GLOBAL_FLAGS
+            trace_dir = GLOBAL_FLAGS.get("trace_dir")
+        except Exception:
+            trace_dir = ""
+        if not trace_dir:
+            return
+        rec = {"ts_unix": time.time(), "kind": kind, "series": series,
+               "value": value if math.isfinite(value) else str(value)}
+        if ewma is not None:
+            rec["ewma"] = ewma
+        try:
+            os.makedirs(trace_dir, exist_ok=True)
+            with self._lock:
+                with open(os.path.join(trace_dir, "events.jsonl"),
+                          "a") as f:
+                    f.write(json.dumps(rec) + "\n")
+        except OSError:
+            pass  # a full disk must not take down the training loop
+
+    def reset(self) -> None:
+        with self._lock:
+            self._series.clear()
+
+
+_SENTINEL = AnomalySentinel()
+
+
+def sentinel() -> AnomalySentinel:
+    return _SENTINEL
+
+
+def probe(series: str, value: Any) -> None:
+    """Module-level shortcut (traced contexts)."""
+    _SENTINEL.probe(series, value)

@@ -30,6 +30,7 @@ import warnings
 from typing import Any, Callable, Dict, List, Optional
 
 from . import metrics as _metrics
+from . import xprof as _xprof
 
 __all__ = ["RecompileTracker", "FunctionRecord", "tracker",
            "instrumented_jit"]
@@ -68,7 +69,31 @@ class FunctionRecord:
     # -- trace side --------------------------------------------------------
 
     def note_trace(self, args, kwargs) -> None:
+        if getattr(self._tls, "suppress", False):
+            # an xprof harvest re-traces through .lower(); that trace is
+            # bookkeeping, not user-visible recompilation
+            return
         sig = _abstract_signature(args, kwargs)
+        if _xprof.enabled():
+            # Capture the abstract signature as ShapeDtypeStructs while
+            # the tracers are live: after a donated-argnum dispatch the
+            # concrete args are deleted, so this is the only safe point
+            # to keep a lowerable description for the program-card
+            # harvest.
+            import jax
+
+            def to_sds(x):
+                shape = getattr(x, "shape", None)
+                dtype = getattr(x, "dtype", None)
+                if shape is None or dtype is None:
+                    return x
+                return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+            try:
+                self._tls.pending_avals = (
+                    jax.tree.map(to_sds, (args, kwargs)), sig)
+            except Exception:  # noqa: BLE001 — analytics never break a trace
+                self._tls.pending_avals = None
         threshold = None
         with self._lock:
             self.traces += 1
@@ -114,7 +139,15 @@ class FunctionRecord:
 
     # -- call side ---------------------------------------------------------
 
-    def on_call(self, dt_s: float) -> None:
+    def take_pending_avals(self):
+        """Pop the (avals, signature) captured by the latest trace on
+        this thread (None when analytics were off at trace time)."""
+        pending = getattr(self._tls, "pending_avals", None)
+        self._tls.pending_avals = None
+        return pending
+
+    def on_call(self, dt_s: float) -> bool:
+        """Classify the finished dispatch; returns True when it traced."""
         traced = getattr(self._tls, "traced", False)
         self._tls.traced = False
         with self._lock:
@@ -133,6 +166,7 @@ class FunctionRecord:
             _metrics.counter("jit_cache_hits_total",
                              "jit dispatches served from cache"
                              ).inc(fn=self.name)
+        return traced
 
     def wrap_call(self, jitted: Callable) -> "_InstrumentedJit":
         return _InstrumentedJit(jitted, self)
@@ -159,11 +193,30 @@ class _InstrumentedJit:
             # still consume a pending trace marker so a later enabled
             # call is not misclassified as a compile
             rec._tls.traced = False
+            rec._tls.pending_avals = None
             return self._jitted(*args, **kwargs)
         t0 = time.perf_counter()
         out = self._jitted(*args, **kwargs)
-        rec.on_call(time.perf_counter() - t0)
+        traced = rec.on_call(time.perf_counter() - t0)
+        if traced:
+            self._maybe_harvest(rec)
         return out
+
+    def _maybe_harvest(self, rec: "FunctionRecord") -> None:
+        """Program-card harvest for the trace that just completed. Runs
+        lower().compile() over the captured ShapeDtypeStructs (no data,
+        donation-safe); the re-trace it causes is suppressed from the
+        recompile stats."""
+        pending = rec.take_pending_avals()
+        if pending is None or not _xprof.enabled():
+            return
+        (avals_args, avals_kwargs), sig = pending
+        rec._tls.suppress = True
+        try:
+            _xprof.harvest(rec.name, self._jitted, avals_args,
+                           avals_kwargs, sig)
+        finally:
+            rec._tls.suppress = False
 
     def __getattr__(self, item):
         return getattr(self._jitted, item)

@@ -1,0 +1,328 @@
+"""Live observability plane: stdlib HTTP exporter for a running process.
+
+The PR-1 telemetry core is in-process only; this module makes a *live*
+paddle_tpu process observable from outside — the pull-based runtime
+health/metrics surface a production serving fleet needs (ROADMAP north
+star), in the spirit of the reference's monitor/profiler export
+surfaces but shaped for Prometheus-era scraping. Pure stdlib
+(``http.server`` on a daemon thread), started automatically by
+``hapi.Model.fit`` and ``inference.Server`` when ``FLAGS_metrics_port``
+is set (and metrics are enabled), or explicitly via :func:`start`.
+
+Endpoints:
+
+- ``/metrics``  — Prometheus text exposition of the metrics registry,
+  plus the native stat registry (``pt_mon_dump``) bridged as
+  ``pt_native_stat{name=...}`` series.
+- ``/healthz``  — device liveness (``jax.local_devices()``) + training
+  heartbeat staleness: a wedged fit() loop reads unhealthy (HTTP 503)
+  once the last-step heartbeat is older than
+  ``FLAGS_health_heartbeat_timeout_s``.
+- ``/varz``     — full JSON snapshot: metrics, recompile records,
+  compiled-program cards (xprof), per-device memory, native stats.
+- ``/trace?ms=N`` — on-demand chrome-trace capture window: returns the
+  host spans recorded during the next N milliseconds as a
+  ``traceEvents`` JSON (Perfetto-loadable).
+
+The server binds all interfaces (a scrape endpoint); everything it
+serves is read-only telemetry.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from . import metrics as _metrics
+from . import recompile as _recompile
+from . import tracer as _tracer
+from . import xprof as _xprof
+
+__all__ = ["ObservabilityServer", "start", "stop", "get",
+           "maybe_start", "HEARTBEAT_GAUGE"]
+
+# Gauge name hapi.fit sets each step; /healthz judges staleness by it.
+HEARTBEAT_GAUGE = "train_heartbeat_timestamp_seconds"
+
+_TRACE_WINDOW_MAX_MS = 60_000
+
+
+def _native_stats() -> Dict[str, int]:
+    """Native stat registry snapshot — only when the library is already
+    loaded (never trigger a g++ build from a scrape)."""
+    try:
+        from .. import native as _native
+        if not _native.loaded():
+            return {}
+        return _native.stat_dump()
+    except Exception:  # noqa: BLE001 — telemetry must not raise
+        return {}
+
+
+def _device_health() -> Dict[str, Any]:
+    try:
+        import jax
+        devs = jax.local_devices()
+        return {"ok": len(devs) > 0,
+                "device_count": len(devs),
+                "devices": [str(d) for d in devs]}
+    except Exception as e:  # noqa: BLE001
+        return {"ok": False, "device_count": 0,
+                "error": f"{type(e).__name__}: {e}"}
+
+
+def _heartbeat_age_s() -> Optional[float]:
+    g = _metrics.registry().get(HEARTBEAT_GAUGE)
+    if g is None:
+        return None
+    v = g.value()
+    if v is None:
+        return None
+    try:
+        return max(0.0, time.time() - float(v))
+    except (TypeError, ValueError):
+        return None
+
+
+def _healthz() -> Dict[str, Any]:
+    out = _device_health()
+    age = _heartbeat_age_s()
+    out["heartbeat_age_s"] = age
+    try:
+        from ..flags import GLOBAL_FLAGS
+        timeout = float(GLOBAL_FLAGS.get("health_heartbeat_timeout_s"))
+    except Exception:
+        timeout = 0.0
+    out["heartbeat_timeout_s"] = timeout
+    if age is not None and timeout > 0 and age > timeout:
+        out["ok"] = False
+        out["wedged"] = True
+    out["status"] = "ok" if out["ok"] else "unhealthy"
+    return out
+
+
+def _varz() -> Dict[str, Any]:
+    from . import device_memory_stats
+    return {
+        "unix_time": time.time(),
+        "metrics": _metrics.registry().snapshot(),
+        "recompile": _recompile.tracker().snapshot(),
+        "programs": _xprof.cards().snapshot(),
+        "device_memory": device_memory_stats(include_unavailable=True,
+                                             full=True),
+        "native_stats": _native_stats(),
+        "health": _healthz(),
+    }
+
+
+def metrics_text() -> str:
+    """Prometheus page body: registry exposition + bridged native
+    stats (shared by the HTTP handler and export_all's metrics.prom)."""
+    text = _metrics.registry().prometheus_text()
+    native = _native_stats()
+    if native:
+        lines = ["# HELP pt_native_stat native stat registry "
+                 "(csrc/monitor.cc) bridged via pt_mon_dump",
+                 "# TYPE pt_native_stat counter"]
+        for k in sorted(native):
+            lines.append(f'pt_native_stat{{name="{k}"}} {native[k]}')
+        text += "\n".join(lines) + "\n"
+    return text
+
+
+def _trace_window(ms: int) -> Dict[str, Any]:
+    """Record host spans for ``ms`` milliseconds and return them as a
+    chrome trace. Spans only appear while FLAGS_enable_metrics is on
+    (the endpoint reports what it captured either way)."""
+    ms = max(1, min(int(ms), _TRACE_WINDOW_MAX_MS))
+    tr = _tracer.tracer()
+    before = len(tr.events())
+    time.sleep(ms / 1e3)
+    window = tr.events()[before:]
+    full = tr.chrome_trace()
+    meta = [e for e in full["traceEvents"] if e.get("ph") == "M"]
+    return {"traceEvents": meta + window,
+            "displayTimeUnit": "ms",
+            "metadata": {"window_ms": ms, "events_in_window": len(window),
+                         "metrics_enabled": _metrics.enabled()}}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "paddle_tpu_obs/1"
+
+    def log_message(self, fmt, *args):  # silence per-request stderr spam
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj: Any) -> None:
+        body = json.dumps(obj, indent=1, sort_keys=True,
+                          default=str).encode()
+        self._send(code, body, "application/json")
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        try:
+            url = urlparse(self.path)
+            if url.path == "/metrics":
+                self._send(200, metrics_text().encode(),
+                           "text/plain; version=0.0.4")
+            elif url.path == "/healthz":
+                h = _healthz()
+                self._send_json(200 if h["ok"] else 503, h)
+            elif url.path == "/varz":
+                self._send_json(200, _varz())
+            elif url.path == "/trace":
+                q = parse_qs(url.query)
+                ms = int(q.get("ms", ["500"])[0])
+                self._send_json(200, _trace_window(ms))
+            elif url.path == "/":
+                self._send(200,
+                           b"paddle_tpu observability: /metrics /healthz "
+                           b"/varz /trace?ms=N\n", "text/plain")
+            else:
+                self._send(404, b"not found\n", "text/plain")
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # noqa: BLE001 — keep the exporter alive
+            try:
+                self._send_json(500,
+                                {"error": f"{type(e).__name__}: {e}"})
+            except Exception:
+                pass
+
+
+class ObservabilityServer:
+    """Daemon-threaded HTTP exporter; ``port`` 0/-1 = ephemeral."""
+
+    def __init__(self, port: int = 0) -> None:
+        self._httpd = ThreadingHTTPServer(("", max(0, int(port))),
+                                          _Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="pt-observability-http")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+_lock = threading.Lock()
+_server: Optional[ObservabilityServer] = None
+
+
+def start(port: int = 0) -> ObservabilityServer:
+    """Start (or return) the process-wide exporter. Idempotent: a
+    second call returns the running server regardless of port."""
+    global _server
+    with _lock:
+        if _server is None:
+            _server = ObservabilityServer(port)
+            _metrics.gauge(
+                "observability_server_port",
+                "TCP port of the live observability HTTP exporter",
+                always=True).set(float(_server.port))
+        return _server
+
+
+def get() -> Optional[ObservabilityServer]:
+    return _server
+
+
+def stop() -> None:
+    global _server
+    with _lock:
+        if _server is not None:
+            _server.stop()
+            _server = None
+
+
+def maybe_start() -> Optional[ObservabilityServer]:
+    """Flag-driven start: FLAGS_metrics_port != 0 and metrics enabled.
+    Called from hapi.Model.fit and inference.Server."""
+    if not _metrics.enabled():
+        return _server
+    try:
+        from ..flags import GLOBAL_FLAGS
+        port = int(GLOBAL_FLAGS.get("metrics_port"))
+    except Exception:
+        return _server
+    if port == 0:
+        return _server
+    return start(port)
+
+
+# ----------------------------------------------------------------- CLI
+
+def self_test() -> int:
+    """No-accelerator CI check: boot on an ephemeral port, populate one
+    of every endpoint's inputs, GET them all, assert, exit 0."""
+    import urllib.request
+
+    _metrics.set_enabled(True)
+    srv = ObservabilityServer(0)
+    try:
+        _metrics.counter("selftest_http_total", always=True).inc(3)
+        _metrics.gauge(HEARTBEAT_GAUGE, always=True).set(time.time())
+        with _tracer.tracer().span("selftest/http", force=True):
+            time.sleep(0.001)
+
+        def fetch(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}{path}",
+                    timeout=10) as r:
+                return r.status, r.read().decode()
+
+        code, text = fetch("/metrics")
+        assert code == 200 and "selftest_http_total 3" in text, text
+        code, text = fetch("/healthz")
+        assert code == 200 and json.loads(text)["status"] == "ok", text
+        code, text = fetch("/varz")
+        varz = json.loads(text)
+        assert code == 200 and "selftest_http_total" in varz["metrics"]
+        assert "programs" in varz and "device_memory" in varz
+        code, text = fetch("/trace?ms=20")
+        trace = json.loads(text)
+        assert code == 200 and "traceEvents" in trace, text
+    finally:
+        srv.stop()
+        _metrics.set_enabled(False)
+    print("self-test OK")
+    return 0
+
+
+def main() -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="paddle_tpu live observability HTTP exporter")
+    ap.add_argument("--port", type=int, default=0,
+                    help="port to serve on (0 = ephemeral)")
+    ap.add_argument("--self-test", action="store_true")
+    args = ap.parse_args()
+    if args.self_test:
+        return self_test()
+    srv = start(args.port)
+    print(f"serving /metrics /healthz /varz /trace on :{srv.port}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from ._shard_map import shard_map as _shard_map
+from .collective import _axis_size
 
 from ..core import random as _random
 from ..nn.layer import Layer, functional_call
@@ -46,7 +48,7 @@ def dgc_allreduce(local_grad: jnp.ndarray, residual: jnp.ndarray,
     local_grad: this replica's gradient; residual: error feedback carried
     from previous steps. Returns (dense mean gradient, new residual).
     """
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     acc = local_grad + residual
     size = acc.size
     k = max(1, int(size * (1.0 - sparsity)))
@@ -148,7 +150,7 @@ class DGCTrainStep:
         # host-driven LR rides as its own replicated scalar argument — a
         # rank-0 leaf can't satisfy the batch's P(dp_axis) shard_map spec
         self._jitted = jax.jit(
-            jax.shard_map(step, mesh=mesh,
+            _shard_map(step, mesh=mesh,
                           in_specs=(self.state_specs, P(dp_axis), P(),
                                     P()),
                           out_specs=(self.state_specs, P()),

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from ._shard_map import shard_map as _shard_map
 
 from ..core import random as _random
 from ..nn.layer import Layer, functional_call
@@ -148,13 +149,13 @@ class LocalSGDStep:
         # host-driven LR rides as its own replicated scalar argument — a
         # rank-0 leaf can't satisfy the batch's P(dp_axis) shard_map spec
         self._local = jax.jit(
-            jax.shard_map(local_step,
+            _shard_map(local_step,
                           in_specs=(self.state_specs, P(dp_axis), P(),
                                     P()),
                           out_specs=(self.state_specs, P()), **smap),
             donate_argnums=(0,))
         self._sync = jax.jit(
-            jax.shard_map(sync, in_specs=(self.state_specs,),
+            _shard_map(sync, in_specs=(self.state_specs,),
                           out_specs=self.state_specs, **smap),
             donate_argnums=(0,))
 

@@ -29,11 +29,15 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .collective import _axis_size
+from ._shard_map import shard_map as _compat_shard_map
+
+
 def _shard_map(fn, mesh, in_specs, out_specs):
     # check_vma=False: carries mix replicated inits with ppermute-varying
     # values, which strict VMA checking rejects
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=False)
+    return _compat_shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
 
 _NEG_INF = -1e30
 
@@ -50,7 +54,7 @@ def _default_use_flash(head_dim: int) -> bool:
 def _ring_attention_local(q, k, v, axis: str, causal: bool,
                           scale: Optional[float]):
     """Runs inside shard_map. q/k/v: [B, H, Tl, D] local shards."""
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     idx = lax.axis_index(axis)
     t_local = q.shape[2]
     d = q.shape[-1]
@@ -119,7 +123,7 @@ def _ring_attention_local_flash(q, k, v, axis: str, causal: bool,
     """
     from ..kernels.flash_attention import (_NEG_INF,
                                            flash_attention_with_lse)
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     idx = lax.axis_index(axis)
     b, h, t_local, d = q.shape
     if scale is None:
@@ -211,7 +215,7 @@ def _ulysses_local(q, k, v, axis: str, causal: bool,
                    interpret: bool):
     """Inside shard_map: seq-sharded [B, H, Tl, D] → a2a to head-sharded
     [B, H/n, T, D] → local flash attention → a2a back."""
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
 
     def seq_to_head(x):
         # split heads across ranks, gather full sequence

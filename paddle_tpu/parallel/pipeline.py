@@ -27,6 +27,7 @@ from ..core import random as _random
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from ._shard_map import shard_map as _shard_map
 
 from ..nn.layer import Layer, functional_call
 
@@ -92,7 +93,7 @@ def gpipe(stage_fn: Callable, stacked_params, x, num_microbatches: int,
         return lax.psum(outputs, axis)
 
     param_specs = jax.tree.map(lambda _: P(axis), stacked_params)
-    out = jax.shard_map(
+    out = _shard_map(
         spmd_fn, mesh=mesh,
         in_specs=(param_specs, P()),
         out_specs=P(),

@@ -377,6 +377,15 @@ class TrainStep:
 
         (loss, (new_buffers, out)), grads = jax.value_and_grad(
             loss_of, has_aux=True)(params)
+        if _obs.enabled():
+            # anomaly sentinel: async host callbacks baked in at trace
+            # time (observe_traced semantics) — NaN/Inf + spike watch on
+            # the loss and the gradient global norm, no per-step sync
+            _obs.anomaly.probe("loss", loss)
+            gnorm = jnp.sqrt(sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree.leaves(grads)) + 0.0)
+            _obs.anomaly.probe("grad_norm", gnorm)
         new_params, new_opt = self.optimizer.apply_gradients(
             params, grads, state["opt"], lr_override=batch.get("lr"))
         metrics = {"loss": loss}

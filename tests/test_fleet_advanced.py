@@ -130,7 +130,8 @@ class TestDGC:
                 out, new_r = dgc_allreduce(g_const, r, "dp", sparsity=0.9)
                 return out, new_r
 
-            out, new_res = jax.shard_map(
+            from paddle_tpu.parallel._shard_map import shard_map
+            out, new_res = shard_map(
                 inner, mesh=mesh, in_specs=(P(),), out_specs=(P(), P()),
                 check_vma=False)(res)
             return new_res, out

@@ -276,6 +276,137 @@ def test_server_connection_churn_does_not_leak_fds(artifact):
         assert nfds() <= base + 4, (base, nfds())
 
 
+def test_python_client_stats_round_trip(artifact):
+    """STATS control opcode through the Python client: queue/served
+    totals, batch-size buckets and uptime parsed from the key=value
+    reply (docs/serving_protocol.md)."""
+    d, x, want = artifact
+    pred = create_predictor(Config(d))
+    with Server(pred, max_batch=8, wait_ms=5) as srv:
+        with Client(port=srv.port) as cli:
+            cli.infer([x[:2]])
+            cli.infer([x[:1]])
+            stats = cli.stats()
+    assert stats["proto_version"] == 1
+    assert stats["accepted_total"] >= 2
+    assert stats["replied_total"] >= 2
+    assert stats["stats_requests_total"] >= 1
+    assert stats["uptime_ms"] >= 0
+    for key in ("queue_depth", "queue_cap", "inflight",
+                "connections_active"):
+        assert key in stats
+    # the Python batcher publishes batch accounting into the native
+    # registry; the wire reply carries it under the serving. prefix
+    assert stats.get("serving.batches_total", 0) >= 2
+    assert stats.get("serving.batch_size_le_inf", 0) >= 2
+
+
+def test_stats_channel_works_under_full_queue(artifact):
+    """Control frames are answered inline by the reader thread, so a
+    STATS probe must succeed even with nothing draining the queue."""
+    from paddle_tpu.native import ServingTransport
+    transport = ServingTransport(port=0, queue_cap=4)
+    try:
+        with Client(port=transport.port) as cli:
+            stats = cli.stats()
+            assert stats["queue_depth"] == 0
+            # park two requests in the queue (nobody dequeues them)
+            cli._send([np.zeros((1, 2), np.float32)])
+            cli._send([np.zeros((1, 2), np.float32)])
+            import time as _t
+            deadline = _t.time() + 5
+            while _t.time() < deadline:
+                stats = cli.stats()
+                if stats["queue_depth"] == 2:
+                    break
+                _t.sleep(0.01)
+            assert stats["queue_depth"] == 2, stats
+            assert stats["accepted_total"] == 2
+    finally:
+        transport.stop()
+
+
+def test_server_stats_bridge_into_metrics(artifact):
+    """The bridge thread scrapes pt_srv_stats into the metrics registry
+    so serving internals land on the same /metrics page."""
+    import paddle_tpu as pt
+    from paddle_tpu import observability as obs
+    d, x, want = artifact
+    pt.set_flags({"enable_metrics": True})
+    try:
+        pred = create_predictor(Config(d))
+        with Server(pred, max_batch=8, wait_ms=5,
+                    stats_interval_s=0.05) as srv:
+            with Client(port=srv.port) as cli:
+                cli.infer([x[:3]])
+            raw = srv.scrape_stats()        # deterministic bridge pass
+            assert raw["accepted_total"] >= 1
+        snap = obs.registry().snapshot()
+        assert "serving_queue_depth" in snap
+        assert snap["serving_accepted_total"]["series"][0]["value"] >= 1
+        assert snap["serving_replied_total"]["series"][0]["value"] >= 1
+        # the Python batcher's own histogram
+        assert snap["serving_batch_size"]["series"][0]["count"] >= 1
+        assert snap["serving_requests_total"]["series"][0]["value"] >= 1
+        text = obs.registry().prometheus_text()
+        assert "serving_queue_depth" in text
+        assert "serving_batch_size_bucket" in text
+    finally:
+        pt.set_flags({"enable_metrics": False})
+        obs.reset_all()
+
+
+def test_c_client_stats_round_trip(tmp_path):
+    """STATS opcode through the shipped C client (--stats mode of the
+    demo binary): the reply must carry the transport counters."""
+    import subprocess
+
+    from paddle_tpu.native import ServingTransport
+
+    src = os.path.join(os.path.dirname(__file__), "..", "csrc",
+                       "serving_client.c")
+    exe = str(tmp_path / "ptsc_stats_demo")
+    subprocess.run(["cc", "-O2", "-DPTSC_DEMO_MAIN", "-o", exe, src],
+                   check=True, capture_output=True)
+    transport = ServingTransport(port=0, queue_cap=8)
+    try:
+        out = subprocess.run(
+            [exe, "127.0.0.1", str(transport.port), "--stats"],
+            capture_output=True, timeout=30)
+        assert out.returncode == 0, out.stderr.decode()
+        text = out.stdout.decode()
+        assert text.startswith("status=0 "), text
+        body = dict(line.split("=", 1)
+                    for line in text.splitlines()[1:] if "=" in line)
+        assert body["proto_version"] == "1"
+        assert body["queue_depth"] == "0"
+        assert int(body["stats_requests_total"]) >= 1
+        assert int(body["connections_total"]) >= 1
+    finally:
+        transport.stop()
+
+
+def test_unknown_control_opcode_rejected(artifact):
+    """An unrecognized control opcode gets status -4, and the
+    connection stays usable."""
+    import struct
+    d, x, want = artifact
+    pred = create_predictor(Config(d))
+    with Server(pred, wait_ms=1) as srv:
+        with Client(port=srv.port) as cli:
+            with cli._wlock:
+                cli._tag += 1
+                tag = cli._tag
+                cli._sock.sendall(
+                    struct.pack("<IQI", Client._MAGIC_CTL, tag, 4)
+                    + struct.pack("<I", 999))
+            status, payload = cli._recv(tag)
+            assert status == -4
+            assert b"unknown control opcode" in payload
+            out = cli.infer([x[:1]])[0]     # stream not poisoned
+            assert out.shape == (1, 3)
+
+
 def test_c_client_round_trip(tmp_path):
     """The shipped C client (csrc/serving_client.c — the analogue of
     the reference's capi/c_api.cc and go/paddle/predictor.go clients)

@@ -501,3 +501,346 @@ def test_trace_report_self_test_subprocess():
         capture_output=True, text=True, env=env, timeout=300)
     assert proc.returncode == 0, proc.stderr + proc.stdout
     assert "self-test OK" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# live HTTP exporter (/metrics /healthz /varz /trace)
+# ---------------------------------------------------------------------------
+
+def _get(port, path, timeout=10):
+    import urllib.error
+    import urllib.request
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+@pytest.fixture
+def http_server(metrics_on):
+    """Exporter on an ephemeral port; torn down with flags reset."""
+    srv = obs.server.start(0)
+    try:
+        yield srv
+    finally:
+        obs.server.stop()
+
+
+def test_http_endpoints_during_fit(metrics_on, tmp_path):
+    """ISSUE acceptance: with FLAGS_enable_metrics=1 and
+    FLAGS_metrics_port set, GET /metrics DURING a CPU fit returns
+    Prometheus text with the step-time histogram, recompile counters
+    and the anomaly counter; /varz carries a program card with
+    non-empty analyses (or an explicit unavailable marker)."""
+    pt.set_flags({"metrics_port": -1, "trace_dir": str(tmp_path)})
+    pages = {}
+
+    class Probe(pt.hapi.Callback):
+        def on_batch_end(self, step, logs=None):
+            if step == 1 and not pages:
+                port = obs.server.get().port
+                pages["metrics"] = _get(port, "/metrics")
+
+    try:
+        m = pt.hapi.Model(_MLP())
+        m.prepare(optimizer=pt.optimizer.SGD(learning_rate=1e-2),
+                  loss=pt.nn.CrossEntropyLoss())
+        m.fit(_loader(), epochs=1, verbose=0, callbacks=[Probe()])
+
+        code, text = pages["metrics"]
+        assert code == 200
+        assert "hapi_step_time_seconds_bucket" in text
+        assert "jit_traces_total" in text
+        assert "anomalies_total" in text          # registered at trace time
+        assert "train_heartbeat_timestamp_seconds" in text
+        assert "# TYPE hapi_step_time_seconds histogram" in text
+
+        port = obs.server.get().port
+        code, text = _get(port, "/varz")
+        assert code == 200
+        varz = json.loads(text)
+        cards = varz["programs"]
+        name = next(n for n in cards if n.startswith("TrainStep"))
+        card = list(cards[name].values())[0]
+        assert (card.get("cost_analysis") or card.get("memory_analysis")
+                or card.get("unavailable"))
+        assert "device_memory" in varz and "recompile" in varz
+        # the achieved-FLOPs gauge derived from the card (CPU has a
+        # cost model, so it must be present and positive here)
+        g = obs.gauge("achieved_flops_per_sec")
+        assert g.value() and g.value() > 0
+    finally:
+        pt.set_flags({"metrics_port": 0})
+        obs.server.stop()
+
+
+def test_healthz_ok_and_wedged(http_server):
+    code, text = _get(http_server.port, "/healthz")
+    assert code == 200 and json.loads(text)["status"] == "ok"
+    # a stale heartbeat must flip the endpoint to 503 (wedged loop)
+    obs.gauge(obs.server.HEARTBEAT_GAUGE).set(
+        __import__("time").time() - 10_000)
+    code, text = _get(http_server.port, "/healthz")
+    body = json.loads(text)
+    assert code == 503 and body["wedged"] is True, body
+
+
+def test_trace_window_endpoint(http_server):
+    import threading as _t
+    stop = _t.Event()
+
+    def spin():
+        while not stop.is_set():
+            with obs.span("windowed"):
+                pass
+
+    th = _t.Thread(target=spin, daemon=True)
+    th.start()
+    try:
+        code, text = _get(http_server.port, "/trace?ms=100")
+    finally:
+        stop.set()
+        th.join(timeout=5)
+    assert code == 200
+    trace = json.loads(text)
+    assert trace["metadata"]["window_ms"] == 100
+    assert any(e.get("name") == "windowed"
+               for e in trace["traceEvents"])
+
+
+def test_http_server_unknown_path_404(http_server):
+    code, _ = _get(http_server.port, "/nope")
+    assert code == 404
+
+
+# ---------------------------------------------------------------------------
+# program cards (xprof)
+# ---------------------------------------------------------------------------
+
+def test_program_card_harvested_on_trace(metrics_on):
+    @pt.jit.to_static
+    def f(x):
+        return x * 2 + 1
+
+    f(jnp.ones((3,)))
+    f(jnp.ones((3,)))          # cache hit: no second card
+    snap = obs.program_cards().snapshot()
+    name = next(n for n in snap if n.endswith(".f"))
+    cards = snap[name]
+    assert len(cards) == 1
+    card = list(cards.values())[0]
+    assert card["signature"] == "(float32[3])"
+    # CPU backend has a cost model: flops present and sane
+    assert card.get("flops", 0) > 0 or card.get("unavailable")
+    # the harvest's own re-trace must not pollute recompile stats
+    st = obs.recompile_tracker().get(name).stats()
+    assert st["traces"] == 1 and st["hits"] == 1
+
+
+def test_program_card_empty_analysis_marked_unavailable(metrics_on,
+                                                        monkeypatch):
+    """Backends that return empty analyses get an explicit marker, not
+    an error (the graceful-fallback path of the ISSUE acceptance)."""
+    from paddle_tpu.observability import xprof
+    monkeypatch.setattr(xprof, "_cost_dict", lambda c: {})
+    monkeypatch.setattr(xprof, "_memory_dict", lambda c: {})
+    import jax
+    jitted = jax.jit(lambda x: x + 1)
+    card = xprof.harvest("t_unavail", jitted,
+                         (jax.ShapeDtypeStruct((2,), jnp.float32),),
+                         {}, "(float32[2])")
+    assert card["unavailable"] == "backend returned empty analyses"
+    assert obs.program_cards().get("t_unavail")
+
+
+def test_program_card_lower_failure_is_contained(metrics_on):
+    from paddle_tpu.observability import xprof
+
+    class Boom:
+        def lower(self, *a, **k):
+            raise RuntimeError("no lowering here")
+
+    card = xprof.harvest("t_boom", Boom(), (), {}, "()")
+    assert "lower/compile failed" in card["unavailable"]
+
+
+def test_flops_of_missing_returns_none():
+    from paddle_tpu.observability import xprof
+    assert xprof.flops_of("never_registered") is None
+
+
+def test_analytics_flag_gates_harvest(metrics_on):
+    pt.set_flags({"program_analytics": False})
+    try:
+        @pt.jit.to_static
+        def g2(x):
+            return x - 1
+
+        g2(jnp.ones((4,)))
+        assert obs.program_cards().snapshot() == {}
+    finally:
+        pt.set_flags({"program_analytics": True})
+
+
+# ---------------------------------------------------------------------------
+# anomaly sentinel
+# ---------------------------------------------------------------------------
+
+def test_anomaly_sentinel_nan_and_spike(metrics_on, tmp_path):
+    pt.set_flags({"trace_dir": str(tmp_path)})
+    s = obs.anomaly_sentinel()
+    assert s.observe("t_loss", float("nan")) == "nan"
+    for _ in range(8):                      # warmup around ~1.0
+        assert s.observe("t_loss", 1.0) is None
+    assert s.observe("t_loss", 1e6) == "spike"
+    c = obs.counter("anomalies_total")
+    assert c.value(kind="nan", series="t_loss") == 1
+    assert c.value(kind="spike", series="t_loss") == 1
+    lines = [json.loads(l) for l in
+             open(tmp_path / "events.jsonl").read().splitlines()]
+    assert [e["kind"] for e in lines] == ["nan", "spike"]
+    assert lines[1]["series"] == "t_loss" and "ewma" in lines[1]
+
+
+def test_anomaly_probe_inside_jitted_fn(metrics_on):
+    import jax
+
+    @jax.jit
+    def f(x):
+        obs.anomaly.probe("t_traced", x.sum())
+        return x * 0 / 0                    # NaN output, probed input ok
+
+    f(jnp.ones((3,)))
+    jax.effects_barrier()
+    # the probed value (3.0) is finite -> no anomaly, but the callback
+    # ran (series registered in the sentinel)
+    assert obs.counter("anomalies_total").value(
+        kind="nan", series="t_traced") == 0
+
+    @jax.jit
+    def g(x):
+        obs.anomaly.probe("t_traced_nan", x[0] / x[1])
+        return x
+
+    g(jnp.array([1.0, 0.0]))
+    jax.effects_barrier()
+    assert obs.counter("anomalies_total").value(
+        kind="nan", series="t_traced_nan") == 1
+
+
+def test_fit_nan_loss_counts_anomaly(metrics_on, tmp_path):
+    """A training run whose loss goes NaN must surface in
+    anomalies_total via the TrainStep probes."""
+    pt.set_flags({"trace_dir": str(tmp_path)})
+    import jax
+
+    def nan_loss(out, label):
+        return jnp.mean(out) * jnp.float32(float("nan"))
+
+    m = pt.hapi.Model(_MLP())
+    m.prepare(optimizer=pt.optimizer.SGD(learning_rate=1e-2),
+              loss=nan_loss)
+    m.fit(_loader(n=32), epochs=1, verbose=0)
+    jax.effects_barrier()
+    assert obs.counter("anomalies_total").value(
+        kind="nan", series="loss") >= 1
+    events = open(tmp_path / "events.jsonl").read()
+    assert '"series": "loss"' in events
+
+
+def test_anomaly_disabled_inserts_no_callback():
+    assert not obs.enabled()
+    import jax
+
+    @jax.jit
+    def f(x):
+        obs.anomaly.probe("t_gated_series", x.sum())
+        return x
+
+    f(jnp.ones((2,)))
+    jax.effects_barrier()
+    snap = obs.registry().snapshot()
+    series = snap.get("anomalies_total", {}).get("series", [])
+    assert not any(s["labels"].get("series") == "t_gated_series"
+                   for s in series)
+    obs.reset_all()
+
+
+# ---------------------------------------------------------------------------
+# satellite: device memory / export_all / native bridge
+# ---------------------------------------------------------------------------
+
+def test_device_memory_stats_full():
+    out = obs.device_memory_stats(include_unavailable=True, full=True)
+    assert len(out) >= 1
+    for stats in out.values():
+        assert set(stats) == {"bytes_in_use", "peak_bytes_in_use",
+                              "bytes_limit"}
+        assert all(isinstance(v, int) for v in stats.values())
+
+
+def test_export_all_writes_prometheus_artifact(metrics_on, tmp_path):
+    obs.counter("t_export_total").inc(2)
+    out = obs.export_all(str(tmp_path))
+    assert os.path.exists(out["prometheus"])
+    prom = open(out["prometheus"]).read()
+    assert "t_export_total 2" in prom
+    assert "# TYPE t_export_total counter" in prom
+    snap = json.load(open(out["metrics"]))
+    assert set(snap) >= {"metrics", "recompile", "programs",
+                         "native_stats"}
+
+
+def test_native_stats_bridge(metrics_on):
+    native = pytest.importorskip("paddle_tpu.native")
+    if not native.available():
+        pytest.skip("native library unavailable")
+    native.stat_add("t_bridge_stat", 7)
+    stats = obs.native_stats()
+    assert stats.get("t_bridge_stat") == 7
+    text = obs.server.metrics_text()
+    assert 'pt_native_stat{name="t_bridge_stat"} 7' in text
+    native.stat_reset("t_bridge_stat")
+
+
+# ---------------------------------------------------------------------------
+# CI tooling: flags-doc check + exporter self-test
+# ---------------------------------------------------------------------------
+
+def test_check_flags_doc_passes():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "check_flags_doc.py")],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert "OK" in proc.stdout
+
+
+def test_check_flags_doc_catches_undocumented(tmp_path):
+    """The checker must actually fail on an undocumented flag."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import check_flags_doc as cfd
+        flags_py = tmp_path / "flags.py"
+        flags_py.write_text(
+            'define_flag("totally_new_flag", 1, "has help")\n'
+            'define_flag("no_help_flag", 2, "")\n')
+        flags = cfd.collect_flags(str(flags_py))
+    finally:
+        sys.path.pop(0)
+    assert ("totally_new_flag", True) in flags
+    assert ("no_help_flag", False) in flags
+    docs = cfd.docs_text()
+    assert "FLAGS_totally_new_flag" not in docs
+
+
+def test_exporter_self_test_subprocess():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.observability.server",
+         "--self-test"],
+        capture_output=True, text=True, env=env, timeout=300, cwd=ROOT)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert "self-test OK" in proc.stdout

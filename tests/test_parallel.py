@@ -38,7 +38,8 @@ def test_collectives_inside_shard_map():
         return s, g
 
     x = jnp.arange(8.0).reshape(8, 1)
-    s, g = jax.shard_map(fn, mesh=mesh, in_specs=P("dp"),
+    from paddle_tpu.parallel._shard_map import shard_map
+    s, g = shard_map(fn, mesh=mesh, in_specs=P("dp"),
                          out_specs=(P("dp"), P("dp")),
                          check_vma=False)(x)
     # every shard's sum equals total

@@ -73,6 +73,26 @@ def _print_metrics_snapshot(trace_dir: str) -> None:
             print(f"  {name:<48} traces={r['traces']} "
                   f"hits={r['hits']} shapes={n_sig} "
                   f"compile_s={comp:.3f}")
+    programs = snap.get("programs", {})
+    if programs:
+        print("\n== compiled-program cards ==")
+        for name in sorted(programs):
+            for sig, card in programs[name].items():
+                if card.get("unavailable"):
+                    print(f"  {name:<40} {sig[:40]:<42} "
+                          f"unavailable: {card['unavailable']}")
+                    continue
+                flops = card.get("flops", 0.0)
+                peak = card.get("peak_bytes_estimate", 0)
+                print(f"  {name:<40} {sig[:40]:<42} "
+                      f"flops={flops:.4g} "
+                      f"bytes={card.get('bytes_accessed', 0):.4g} "
+                      f"peak_mem={peak / 1e6:.3f}MB")
+    native = snap.get("native_stats", {})
+    if native:
+        print("\n== native stats (pt_mon) ==")
+        for k in sorted(native):
+            print(f"  {k:<52} {native[k]}")
 
 
 def report(trace_dir: str, xla: str = "", top: int = 30) -> int:
