@@ -45,7 +45,9 @@ class PipelineConfig:
 
 @dataclass
 class DGCConfig:
-    rampup_begin_step: int = 0
+    # dense warm-up steps before compression kicks in (paper §3.3
+    # warm-up training; DGCTrainStep's own default)
+    rampup_begin_step: int = 3
     sparsity: float = 0.999
 
 

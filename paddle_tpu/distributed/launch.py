@@ -110,19 +110,43 @@ def launch_elastic(cmd: Sequence[str], nproc: int,
     utils.py:252 terminate_local_procs; DistributedStrategy.elastic is
     a stub, distributed_strategy.proto:105). Restart counter rides in
     PT_ELASTIC_ATTEMPT; each attempt gets a fresh control plane.
+
+    Goodput accounting: the launcher counts restarts
+    (``elastic_restarts_total``) and hands each relaunched gang the
+    cumulative teardown-to-respawn dead time via ``PT_RESTART_IDLE_S``
+    — the child's goodput ledger seeds its ``restart_idle`` bucket
+    from it (plus its own import-to-resume time, anchored by
+    PT_ELASTIC_ATTEMPT > 0), so /goodput on a restarted worker shows
+    what the crash actually cost.
     """
+    from ..observability import flight as _flight
+    from ..observability import metrics as _metrics
+
     code = 0
+    idle_s = 0.0
     for attempt in range(max_restarts + 1):
         env = dict(env_extra or {})
         env["PT_ELASTIC_ATTEMPT"] = str(attempt)
+        env["PT_RESTART_IDLE_S"] = f"{idle_s:.3f}"
         code = launch_procs(cmd, nproc, env_extra=env,
                             poll_interval=poll_interval)
         if code == 0:
             return 0
+        t_dead = time.time()
+        _metrics.counter(
+            "elastic_restarts_total",
+            "gang restarts performed by launch_elastic after a worker "
+            "failure", always=True).inc()
+        _flight.record("elastic_restart", force=True, attempt=attempt,
+                       exit_code=code)
         if attempt < max_restarts:
             print(f"[launch] job failed rc={code}; gang restart "
                   f"{attempt + 1}/{max_restarts}", file=sys.stderr,
                   flush=True)
+        # respawn is immediate, so the measured gap is small — but the
+        # mechanism is what matters: schedulers that add backoff (or a
+        # slow control-plane re-bootstrap) surface here automatically
+        idle_s += time.time() - t_dead
     return code
 
 

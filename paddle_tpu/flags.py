@@ -303,15 +303,19 @@ define_flag("enable_metrics", False,
             on_change=_enable_metrics_changed)
 define_flag("metrics_port", 0,
             "TCP port for the live observability HTTP exporter "
-            "(observability/server.py). 0 (default) = no server; -1 = "
-            "ephemeral port (tests). When set (and "
-            "FLAGS_enable_metrics is on), hapi.Model.fit and "
-            "inference.Server start a daemon-threaded stdlib HTTP "
-            "server exposing /metrics (Prometheus text), /healthz "
-            "(device liveness + train heartbeat), /varz (full JSON "
-            "snapshot incl. program cards) and /trace?ms=N (on-demand "
-            "chrome-trace window). (ref capability: monitor/stat "
-            "export surface.)")
+            "(observability/server.py). 0 (default) = bind an "
+            "EPHEMERAL port — the chosen port is published via the "
+            "observability_server_port gauge and one log line, so "
+            "parallel runs never collide; a negative value disables "
+            "the exporter. When FLAGS_enable_metrics is on, "
+            "hapi.Model.fit and inference.Server start (idempotently "
+            "share) a daemon-threaded stdlib HTTP server exposing "
+            "/metrics (Prometheus text), /healthz (device liveness + "
+            "train heartbeat), /varz (full JSON snapshot incl. "
+            "program cards), /trace?ms=N (on-demand chrome-trace "
+            "window), /goodput (wall-time ledger) and /flight (event "
+            "ring buffer). (ref capability: monitor/stat export "
+            "surface.)")
 define_flag("program_analytics", True,
             "Harvest compiled-program analytics (XLA cost_analysis + "
             "memory_analysis) into per-function program cards on every "
@@ -327,6 +331,30 @@ define_flag("anomaly_spike_factor", 10.0,
             "anomalies_total and logged to events.jsonl under "
             "FLAGS_trace_dir. NaN/Inf are always flagged. 0 disables "
             "spike detection (NaN/Inf detection stays on).")
+define_flag("straggler_factor", 0.0,
+            "Multi-host straggler threshold: during a sharded fit, "
+            "per-host step wall times are all_gather-exchanged every "
+            "few steps (async, via jax.debug.callback — never a host "
+            "sync) and a host whose step time exceeds this factor "
+            "times the fleet median increments "
+            "straggler_events_total{host=} and logs a flight-recorder "
+            "event. 0 (default) disables the exchange entirely; 1.5 "
+            "is a reasonable production starting point.")
+
+
+def _flight_buffer_changed(value) -> None:
+    from .observability import flight as _obs_flight
+    _obs_flight.recorder().resize(int(value))
+
+
+define_flag("flight_buffer_events", 512,
+            "Capacity of the crash flight recorder's in-process ring "
+            "buffer (observability/flight.py): the last N structured "
+            "events (step markers, recompiles, anomalies, ledger "
+            "transitions, stragglers) kept for the /flight endpoint "
+            "and dumped to flight_<ts>.jsonl under FLAGS_trace_dir on "
+            "SIGTERM/uncaught exception/exit.",
+            on_change=_flight_buffer_changed)
 define_flag("health_heartbeat_timeout_s", 300.0,
             "The /healthz endpoint reports unhealthy (HTTP 503) when a "
             "training heartbeat exists but is older than this many "

@@ -243,6 +243,12 @@ class Model:
         history: Dict[str, List[float]] = {}
         # live observability plane: flag-gated, idempotent, daemon thread
         _obs.server.maybe_start()
+        ledger = _obs.goodput_ledger()
+        if _obs.enabled():
+            # goodput ledger + crash flight recorder cover the whole fit
+            ledger.start()
+            _obs.flight.install()
+            _obs.flight.record("fit_begin", epochs=epochs)
         if self._train_step is not None:
             # weights may have been set_value'd/loaded since the last fit
             self._train_step.reset_from_model()
@@ -251,6 +257,14 @@ class Model:
             for cb in callbacks:
                 cb.on_train_begin()
             step = self._get_train_step()
+            straggler = None
+            if _obs.enabled():
+                mesh = getattr(step, "mesh", None)
+                axis = getattr(step, "axis", "dp")
+                if mesh is not None and axis in dict(mesh.shape) \
+                        and mesh.shape[axis] > 1:
+                    straggler = _obs.goodput.StragglerDetector(mesh, axis)
+            global_step = 0
             for epoch in range(epochs):
                 for cb in callbacks:
                     cb.on_epoch_begin(epoch)
@@ -293,9 +307,24 @@ class Model:
                         "achieved_flops_per_sec",
                         "XLA cost-model FLOPs of the compiled train "
                         "step divided by measured step wall time")
-                for i, batch in enumerate(train_loader):
+                batches = iter(train_loader)
+                i = -1
+                while True:
+                    if obs_on:
+                        # goodput ledger: blocking on the pipeline is
+                        # data_wait badput, split out from the step
+                        t_wait = time.perf_counter()
+                    try:
+                        batch = next(batches)
+                    except StopIteration:
+                        break
+                    if obs_on:
+                        ledger.attribute("data_wait",
+                                         time.perf_counter() - t_wait)
+                    i += 1
                     *inputs, label = batch
                     if obs_on:
+                        compile_before = _obs.goodput.compile_seconds_total()
                         t0 = time.perf_counter()
                     metrics = step(*inputs, labels=(label,))
                     if obs_on:
@@ -303,6 +332,18 @@ class Model:
                         # the device array (no sync), memory stats query
                         # the allocator, never the stream
                         dt = time.perf_counter() - t0
+                        # a dispatch that traced spent its wall time in
+                        # XLA, not the model: charge it to jit_compile
+                        compile_dt = min(dt, max(
+                            0.0,
+                            _obs.goodput.compile_seconds_total()
+                            - compile_before))
+                        ledger.attribute("jit_compile", compile_dt)
+                        ledger.attribute("step_compute", dt - compile_dt)
+                        _obs.flight.record("step", epoch=epoch, step=i)
+                        if straggler is not None:
+                            straggler.observe(global_step, dt)
+                        global_step += 1
                         step_hist.observe(dt)
                         items = int(np.shape(label)[0]) \
                             if np.ndim(label) else 1
@@ -333,7 +374,10 @@ class Model:
                 logs = {k: float(v) / max(count, 1)
                         for k, v in totals.items()}
                 if eval_loader is not None:
-                    logs.update(self.evaluate(eval_loader, verbose=0))
+                    with ledger.measure("eval"):
+                        logs.update(self.evaluate(eval_loader, verbose=0))
+                if obs_on:
+                    ledger.publish()
                 for k, v in logs.items():
                     history.setdefault(k, []).append(v)
                 for cb in callbacks:
@@ -343,12 +387,18 @@ class Model:
                     break
             for cb in callbacks:
                 cb.on_train_end()
-            if _obs.enabled() and GLOBAL_FLAGS.get("trace_dir"):
-                # host chrome-trace + metrics snapshot for
-                # tools/trace_report.py
-                _obs.export_all()
+            if _obs.enabled():
+                _obs.flight.record("fit_end", steps_run=global_step)
+                ledger.stop()
+                ledger.publish()
+                if GLOBAL_FLAGS.get("trace_dir"):
+                    # host chrome-trace + metrics/goodput snapshot for
+                    # tools/trace_report.py and tools/goodput_report.py
+                    _obs.export_all()
         finally:
             self._fitting = False
+            if ledger.running():  # interrupted fit: close the books
+                ledger.stop()
             # Must run even on an interrupted fit: the jitted step donated
             # (deleted) the network's own arrays into the training state, so
             # skipping the sync-back would leave the eager model holding
@@ -451,13 +501,14 @@ class Model:
         # and syncing would clobber user weight mutations.
         if self._fitting and self._train_step is not None:
             self._train_step.sync_to_model()
-        if not training:
-            # jit.save itself forces eval mode for the export trace and
-            # restores the layer's mode afterwards
-            from . import jit as jit_mod
-            jit_mod.save(self.network, path, input_spec=input_spec)
-            return
-        io_mod.save(self.network.state_dict(), path + ".pdparams")
+        with _obs.goodput_ledger().measure("checkpoint"):
+            if not training:
+                # jit.save itself forces eval mode for the export trace
+                # and restores the layer's mode afterwards
+                from . import jit as jit_mod
+                jit_mod.save(self.network, path, input_spec=input_spec)
+                return
+            io_mod.save(self.network.state_dict(), path + ".pdparams")
 
     def load(self, path: str) -> None:
         state = io_mod.load(path + ".pdparams")

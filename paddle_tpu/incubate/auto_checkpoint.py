@@ -25,6 +25,7 @@ import jax
 import numpy as np
 
 from .. import io as io_mod
+from ..observability import flight as _flight
 
 __all__ = ["TrainEpochRange", "train_epoch_range"]
 
@@ -61,6 +62,7 @@ class TrainEpochRange:
         if latest is not None:
             self._restored_state = self._ckpt.restore()
             self._start_epoch = latest
+            _flight.record("checkpoint_restore", name=name, epoch=latest)
         self.restored = self._restored_state is not None
 
     def register(self, key: str, getter: Callable[[], Any],
@@ -85,6 +87,8 @@ class TrainEpochRange:
                     epoch + 1 == self.max_epoch:
                 state = {k: g() for k, g in self._getters.items()}
                 self._ckpt.save(state, step=epoch + 1)
+                _flight.record("checkpoint_save", name=self.name,
+                               epoch=epoch + 1)
         self._ckpt.wait()
 
     def __iter__(self) -> Iterator[int]:

@@ -17,6 +17,7 @@ fully synchronous. Orbax itself is supported as an opt-in backend.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import shutil
@@ -25,6 +26,17 @@ from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
+
+
+def _ckpt_measure():
+    """Goodput-ledger context for the HOST-BLOCKING parts of a save
+    (the async writer thread overlaps training and is not charged).
+    A no-op context unless a fit is running with metrics on."""
+    try:
+        from ..observability import goodput as _goodput
+        return _goodput.ledger().measure("checkpoint")
+    except Exception:  # telemetry must never break a save
+        return contextlib.nullcontext()
 
 _SENTINEL_KEY = "__paddle_tpu_ckpt__"
 _VERSION = 1
@@ -153,20 +165,23 @@ class AsyncCheckpointer:
         os.makedirs(directory, exist_ok=True)
 
     def save(self, state: Any, step: int) -> None:
-        self.wait()
-        # materialize on host before handing to the thread; _flatten's
-        # donated-buffer guard (with its sync_to_model() hint) runs too
-        # late for this path, so check here before np.asarray can raise
-        # jax's bare "Array has been deleted"
-        for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
-            if getattr(leaf, "is_deleted", None) and leaf.is_deleted():
-                key = "/".join(_path_str(p) for p in path)
-                raise ValueError(
-                    f"cannot checkpoint {key!r}: its buffer was donated "
-                    "to a train step (in-place HBM update). Call the "
-                    "step's .sync_to_model() first, or checkpoint "
-                    "step.state directly.")
-        host_state = jax.tree.map(np.asarray, state)
+        with _ckpt_measure():
+            self.wait()
+            # materialize on host before handing to the thread;
+            # _flatten's donated-buffer guard (with its sync_to_model()
+            # hint) runs too late for this path, so check here before
+            # np.asarray can raise jax's bare "Array has been deleted"
+            for path, leaf in \
+                    jax.tree_util.tree_flatten_with_path(state)[0]:
+                if getattr(leaf, "is_deleted", None) \
+                        and leaf.is_deleted():
+                    key = "/".join(_path_str(p) for p in path)
+                    raise ValueError(
+                        f"cannot checkpoint {key!r}: its buffer was "
+                        "donated to a train step (in-place HBM "
+                        "update). Call the step's .sync_to_model() "
+                        "first, or checkpoint step.state directly.")
+            host_state = jax.tree.map(np.asarray, state)
 
         def work():
             path = os.path.join(self.directory, f"ckpt-{step}")
@@ -177,7 +192,9 @@ class AsyncCheckpointer:
         self._thread.start()
 
     def wait(self) -> None:
-        if self._thread is not None:
+        if self._thread is None:
+            return
+        with _ckpt_measure():
             self._thread.join()
             self._thread = None
 

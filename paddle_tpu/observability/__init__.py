@@ -29,8 +29,11 @@ from __future__ import annotations
 import warnings
 from typing import Any, Dict, Optional
 
-from . import anomaly, metrics, recompile, server, trace_agg, tracer, xprof
+from . import (anomaly, flight, goodput, metrics, recompile, rotation,
+               server, trace_agg, tracer, xprof)
 from .anomaly import sentinel as anomaly_sentinel
+from .flight import recorder as flight_recorder
+from .goodput import ledger as goodput_ledger
 from .metrics import (counter, enabled, gauge, histogram, registry,
                       set_enabled)
 from .recompile import instrumented_jit
@@ -40,11 +43,12 @@ from .tracer import tracer as get_tracer
 from .xprof import cards as program_cards
 
 __all__ = ["metrics", "tracer", "recompile", "trace_agg", "xprof",
-           "anomaly", "server",
+           "anomaly", "server", "goodput", "flight", "rotation",
            "counter", "gauge", "histogram", "registry", "enabled",
            "set_enabled", "span", "export_chrome_trace", "get_tracer",
            "instrumented_jit", "recompile_tracker", "program_cards",
-           "anomaly_sentinel", "native_stats",
+           "anomaly_sentinel", "native_stats", "goodput_ledger",
+           "flight_recorder",
            "observe_traced", "device_memory_stats", "export_all",
            "reset_all"]
 
@@ -150,9 +154,11 @@ def export_all(path: Optional[str] = None) -> Dict[str, str]:
         path = GLOBAL_FLAGS.get("trace_dir") or "/tmp/pt_trace"
     os.makedirs(path, exist_ok=True)
     out = {"trace": get_tracer().export(path)}
+    goodput_ledger().publish()
     snap = {"metrics": registry().snapshot(),
             "recompile": recompile_tracker().snapshot(),
             "programs": program_cards().snapshot(),
+            "goodput": goodput_ledger().snapshot(),
             "native_stats": native_stats()}
     mpath = os.path.join(path, "metrics.json")
     with open(mpath, "w") as f:
@@ -167,10 +173,12 @@ def export_all(path: Optional[str] = None) -> Dict[str, str]:
 
 
 def reset_all() -> None:
-    """Clear metrics, spans, recompile records, program cards, and
-    anomaly state (tests/new runs)."""
+    """Clear metrics, spans, recompile records, program cards, anomaly
+    state, the goodput ledger, and the flight buffer (tests/new runs)."""
     registry().reset()
     get_tracer().reset()
     recompile_tracker().reset()
     program_cards().reset()
     anomaly_sentinel().reset()
+    goodput_ledger().reset()
+    flight_recorder().reset()

@@ -13,21 +13,25 @@ and the callback presence is baked in at trace time like
 Host side, each watched series keeps an EWMA; a sample is an anomaly
 when it is non-finite, or exceeds ``FLAGS_anomaly_spike_factor`` times
 the EWMA after a short warmup. Anomalies increment ``anomalies_total
-{kind=,series=}`` and append one JSON record per event to
-``events.jsonl`` under FLAGS_trace_dir (structured, tail-able — the
-audit analogue of the reference's nan-inf printouts).
+{kind=,series=}``, enter the crash flight recorder, and append one
+JSON record per event to ``events.jsonl`` under FLAGS_trace_dir
+(structured, tail-able — the audit analogue of the reference's nan-inf
+printouts). The file rolls to ``events.jsonl.1`` at 16 MB and only the
+two newest generations are kept (rotation.append_jsonl), so a
+weeks-long run of a spiky job cannot fill the disk.
 """
 
 from __future__ import annotations
 
-import json
 import math
 import os
 import threading
 import time
 from typing import Any, Dict, Optional
 
+from . import flight as _flight
 from . import metrics as _metrics
+from . import rotation as _rotation
 
 __all__ = ["AnomalySentinel", "sentinel", "probe"]
 
@@ -99,6 +103,9 @@ class AnomalySentinel:
             "anomalies_total",
             "NaN/Inf and spike events seen by the anomaly sentinel"
         ).inc(kind=kind, series=series)
+        safe_value = value if math.isfinite(value) else str(value)
+        _flight.record("anomaly", anomaly=kind, series=series,
+                       value=safe_value)
         try:
             from ..flags import GLOBAL_FLAGS
             trace_dir = GLOBAL_FLAGS.get("trace_dir")
@@ -107,17 +114,12 @@ class AnomalySentinel:
         if not trace_dir:
             return
         rec = {"ts_unix": time.time(), "kind": kind, "series": series,
-               "value": value if math.isfinite(value) else str(value)}
+               "value": safe_value}
         if ewma is not None:
             rec["ewma"] = ewma
-        try:
-            os.makedirs(trace_dir, exist_ok=True)
-            with self._lock:
-                with open(os.path.join(trace_dir, "events.jsonl"),
-                          "a") as f:
-                    f.write(json.dumps(rec) + "\n")
-        except OSError:
-            pass  # a full disk must not take down the training loop
+        with self._lock:
+            _rotation.append_jsonl(os.path.join(trace_dir,
+                                                "events.jsonl"), [rec])
 
     def reset(self) -> None:
         with self._lock:

@@ -1,0 +1,347 @@
+"""Goodput ledger and multi-host straggler detection.
+
+TPU fleet practice reports *goodput* — the fraction of wall-clock that
+actually advanced the model — as the headline efficiency number
+(PAPERS.md: the Gemma-on-Cloud-TPU comparison leads with utilization /
+throughput accounting; EQuARX attacks collective latency because it is
+pure badput). PR 1–2 exposed raw telemetry; this module turns it into
+that accounting:
+
+- :class:`GoodputLedger` classifies every second of ``Model.fit`` wall
+  time into **exclusive** buckets::
+
+      step_compute   the train step itself (the goodput)
+      jit_compile    dispatches that traced (from the recompile tracker)
+      data_wait      blocking on DataLoader/reader for the next batch
+      eval           in-fit evaluation passes
+      checkpoint     Model.save / io.AsyncCheckpointer / auto_checkpoint
+      restart_idle   elastic relaunch dead time (launch.py hands it to
+                     the restarted process via PT_RESTART_IDLE_S)
+      other          wall time no instrument claimed (the residual, so
+                     buckets always sum to wall time)
+
+  Nested measurements use self-time semantics (a checkpoint saved
+  inside an eval pass is charged to ``checkpoint`` only), which is what
+  makes the buckets exclusive. Published as ``goodput_ratio``,
+  ``goodput_wall_seconds``, ``goodput_seconds_total`` and per-bucket
+  ``badput_seconds_total{bucket=…}`` on the metrics registry, served
+  live at ``/goodput``, exported into ``metrics.json`` for
+  ``tools/goodput_report.py``.
+
+- :class:`StragglerDetector` exchanges per-host step wall times over
+  the dp axis (``all_gather`` through the version-portable
+  ``parallel/_shard_map`` shim) and flags hosts slower than
+  ``FLAGS_straggler_factor`` × the fleet median. The gathered times
+  leave the device program through ``jax.debug.callback`` — the
+  exchange is one more async dispatch, never a host sync — and flagged
+  hosts emit ``straggler_events_total{host=…}`` plus a flight-recorder
+  event. On a single-host mesh the fleet is its emulated dp shards, so
+  the same path is testable on the 8-CPU mesh.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+from . import flight as _flight
+from . import metrics as _metrics
+from . import recompile as _recompile
+
+__all__ = ["BUCKETS", "GOODPUT_BUCKET", "GoodputLedger", "ledger",
+           "StragglerDetector", "flag_stragglers"]
+
+GOODPUT_BUCKET = "step_compute"
+BUCKETS = (GOODPUT_BUCKET, "jit_compile", "data_wait", "eval",
+           "checkpoint", "restart_idle", "other")
+
+# process-start anchor: a relaunched elastic worker charges the time
+# from interpreter start to its first ledger.start() as restart_idle
+_IMPORT_T0 = time.perf_counter()
+
+
+class GoodputLedger:
+    """Exclusive wall-time accounting for a training process."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._seconds: Dict[str, float] = {b: 0.0 for b in BUCKETS}
+        self._t0: Optional[float] = None
+        self._prior_wall = 0.0
+        self._seeded_restart = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Open the wall clock (idempotent while running). On the first
+        start of a relaunched elastic worker, seeds ``restart_idle``
+        with the launcher's hand-off plus this process's own start-up
+        time."""
+        with self._lock:
+            if self._t0 is None:
+                self._t0 = time.perf_counter()
+            if not self._seeded_restart:
+                self._seeded_restart = True
+                idle = 0.0
+                try:
+                    idle += float(os.environ.get("PT_RESTART_IDLE_S", 0))
+                except ValueError:
+                    pass
+                try:
+                    if int(os.environ.get("PT_ELASTIC_ATTEMPT", 0)) > 0:
+                        # relaunch: everything before fit resumed is
+                        # restart dead time (imports, checkpoint find)
+                        idle += time.perf_counter() - _IMPORT_T0
+                except ValueError:
+                    pass
+                if idle > 0:
+                    self._seconds["restart_idle"] += idle
+                    self._prior_wall += idle
+                    _flight.record("ledger", bucket="restart_idle",
+                                   seconds=round(idle, 6))
+
+    def stop(self) -> None:
+        """Close the wall clock; the unattributed residual up to now is
+        folded into ``other`` so a later ``start()`` keeps the books
+        exclusive across multiple fits."""
+        with self._lock:
+            if self._t0 is None:
+                return
+            wall = self._prior_wall + (time.perf_counter() - self._t0)
+            self._t0 = None
+            self._prior_wall = wall
+            accounted = sum(self._seconds.values())
+            if wall > accounted:
+                self._seconds["other"] += wall - accounted
+
+    def running(self) -> bool:
+        return self._t0 is not None
+
+    def wall_seconds(self) -> float:
+        with self._lock:
+            live = (time.perf_counter() - self._t0) \
+                if self._t0 is not None else 0.0
+            return self._prior_wall + live
+
+    # -- attribution -------------------------------------------------------
+
+    def attribute(self, bucket: str, seconds: float) -> None:
+        """Charge ``seconds`` to ``bucket`` (direct, non-nesting path —
+        the fit loop's per-step data_wait/compile/compute splits)."""
+        if seconds <= 0:
+            return
+        with self._lock:
+            self._seconds[bucket] = self._seconds.get(bucket, 0.0) \
+                + seconds
+
+    @contextmanager
+    def measure(self, bucket: str, flight_event: bool = True):
+        """Charge the block's SELF time to ``bucket``: time spent in a
+        nested ``measure`` goes to the inner bucket only (exclusivity).
+        No-op unless the ledger is running and metrics are on."""
+        if not (self.running() and _metrics.enabled()):
+            yield
+            return
+        stack: List[Dict[str, float]] = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        frame = {"child": 0.0}
+        stack.append(frame)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            stack.pop()
+            self.attribute(bucket, max(0.0, dt - frame["child"]))
+            if stack:
+                stack[-1]["child"] += dt
+            if flight_event:
+                _flight.record("ledger", bucket=bucket,
+                               seconds=round(dt, 6))
+
+    # -- views -------------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able ledger: per-bucket seconds (with the live residual
+        shown as ``other``), ratios that sum to 1, and the goodput
+        headline."""
+        wall = self.wall_seconds()
+        with self._lock:
+            buckets = dict(self._seconds)
+        accounted = sum(buckets.values())
+        if wall > accounted:
+            buckets["other"] += wall - accounted
+        else:
+            # measured time can exceed the wall clock only by timer
+            # jitter; pin wall to the accounted sum so ratios stay valid
+            wall = accounted
+        ratios = {b: (s / wall if wall > 0 else 0.0)
+                  for b, s in buckets.items()}
+        return {"wall_seconds": wall,
+                "buckets": buckets,
+                "ratios": ratios,
+                "goodput_seconds": buckets[GOODPUT_BUCKET],
+                "goodput_ratio": ratios[GOODPUT_BUCKET],
+                "running": self.running()}
+
+    def publish(self) -> None:
+        """Write the snapshot onto the metrics registry (scraped pages
+        and metrics.prom; /goodput and metrics.json read the ledger
+        directly)."""
+        if not _metrics.enabled():
+            return
+        snap = self.snapshot()
+        _metrics.gauge(
+            "goodput_ratio",
+            "fraction of fit() wall time spent in the train step "
+            "itself").set(snap["goodput_ratio"])
+        _metrics.gauge(
+            "goodput_wall_seconds",
+            "wall seconds covered by the goodput ledger"
+        ).set(snap["wall_seconds"])
+        good = _metrics.counter(
+            "goodput_seconds_total",
+            "ledger seconds in the goodput bucket (step_compute)")
+        good.set_total(snap["buckets"][GOODPUT_BUCKET])
+        bad = _metrics.counter(
+            "badput_seconds_total",
+            "ledger seconds per non-goodput bucket "
+            "(jit_compile | data_wait | eval | checkpoint | "
+            "restart_idle | other)")
+        for b, s in snap["buckets"].items():
+            if b != GOODPUT_BUCKET:
+                bad.set_total(s, bucket=b)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._seconds = {b: 0.0 for b in BUCKETS}
+            self._t0 = None
+            self._prior_wall = 0.0
+            self._seeded_restart = False
+
+
+def compile_seconds_total() -> float:
+    """Total jit-compile wall seconds seen by the recompile tracker —
+    the fit loop diffs this around each step dispatch to split the
+    step's wall time into jit_compile vs step_compute."""
+    total = 0.0
+    for rec in _recompile.tracker().snapshot().values():
+        total += sum(rec.get("compile_times_s", ()))
+    return total
+
+
+_LEDGER = GoodputLedger()
+
+
+def ledger() -> GoodputLedger:
+    return _LEDGER
+
+
+# ---------------------------------------------------------------------------
+# straggler detection
+# ---------------------------------------------------------------------------
+
+def _straggler_factor() -> float:
+    try:
+        from ..flags import GLOBAL_FLAGS
+        return float(GLOBAL_FLAGS.get("straggler_factor"))
+    except Exception:
+        return 0.0
+
+
+def flag_stragglers(times, factor: float) -> List[int]:
+    """Pure policy: indices whose time exceeds ``factor`` × median.
+    ``times`` is any sequence of per-host step seconds."""
+    import numpy as np
+    t = np.asarray(times, dtype=np.float64).reshape(-1)
+    if t.size < 2 or factor <= 0:
+        return []
+    med = float(np.median(t))
+    if med <= 0:
+        return []
+    return [int(i) for i in np.nonzero(t > factor * med)[0]]
+
+
+class StragglerDetector:
+    """Per-host step-time exchange + flagging over a mesh axis.
+
+    ``observe(step_idx, dt)`` feeds the local step wall time; every
+    ``interval`` steps it dispatches the exchange program (all_gather of
+    each host's latest time over ``axis``) whose ``jax.debug.callback``
+    hands the fleet vector back to :meth:`on_fleet` asynchronously.
+    The callback fires once per local shard — ``on_fleet`` dedups by
+    step index so a flagged host is counted once per exchange.
+    """
+
+    def __init__(self, mesh, axis: str = "dp", interval: int = 16) -> None:
+        self.mesh = mesh
+        self.axis = axis
+        self.interval = max(1, int(interval))
+        self._n = int(mesh.shape[axis]) if mesh is not None else 1
+        self._exchange = None
+        self._lock = threading.Lock()
+        self._last_processed = -1
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel._shard_map import shard_map as _shard_map
+
+        def ex(t, step_idx):
+            times = lax.all_gather(t.reshape(()), self.axis)
+            jax.debug.callback(self.on_fleet, times, step_idx)
+            return jnp.sum(times)
+
+        return jax.jit(_shard_map(
+            ex, mesh=self.mesh, in_specs=(P(self.axis), P()),
+            out_specs=P(), check_vma=False))
+
+    def observe(self, step_idx: int, dt_s: float) -> None:
+        """Feed one local step time; dispatches an exchange every
+        ``interval`` steps (async — the result arrives via callback)."""
+        if self._n < 2 or _straggler_factor() <= 0:
+            return
+        if (step_idx + 1) % self.interval:
+            return
+        import jax.numpy as jnp
+        if self._exchange is None:
+            self._exchange = self._build()
+        # every host fills its own slot(s) of the sharded vector with
+        # its local time; the gather then carries one entry per shard
+        arr = jnp.full((self._n,), float(dt_s), jnp.float32)
+        with self.mesh:
+            self._exchange(arr, jnp.int32(step_idx))
+
+    def on_fleet(self, times, step_idx) -> None:
+        """Host-side: flag stragglers in one fleet vector. Public so
+        tests (and host-driven loops) can drive it directly."""
+        step = int(step_idx)
+        with self._lock:
+            if step <= self._last_processed:
+                return  # duplicate callback from another local shard
+            self._last_processed = step
+        import numpy as np
+        t = np.asarray(times, dtype=np.float64).reshape(-1)
+        factor = _straggler_factor()
+        flagged = flag_stragglers(t, factor)
+        if not flagged:
+            return
+        med = float(np.median(t))
+        c = _metrics.counter(
+            "straggler_events_total",
+            "hosts whose step time exceeded FLAGS_straggler_factor x "
+            "the fleet median")
+        for host in flagged:
+            c.inc(host=host)
+            _flight.record("straggler", host=host, step=step,
+                           step_seconds=round(float(t[host]), 6),
+                           fleet_median_seconds=round(med, 6),
+                           factor=factor)

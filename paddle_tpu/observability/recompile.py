@@ -111,6 +111,11 @@ class FunctionRecord:
             "jit_traces_total",
             "jit traces (recompilations) per function", always=True
         ).inc(fn=self.name)
+        # flight recorder: recompiles are prime crash/efficiency
+        # forensics (a storm right before OOM tells the whole story)
+        from . import flight as _flight
+        _flight.record("recompile", fn=self.name, signature=sig[:200],
+                       distinct_signatures=n_sigs)
         if threshold:
             warnings.warn(
                 f"recompilation storm: '{self.name}' has been traced "

@@ -23,6 +23,17 @@ Endpoints:
 - ``/trace?ms=N`` — on-demand chrome-trace capture window: returns the
   host spans recorded during the next N milliseconds as a
   ``traceEvents`` JSON (Perfetto-loadable).
+- ``/goodput``  — the wall-time ledger (per-bucket seconds/ratios and
+  the goodput headline, observability/goodput.py).
+- ``/flight``   — the crash flight recorder's live event ring
+  (observability/flight.py).
+
+Port selection (``FLAGS_metrics_port``): a positive value binds that
+port; **0 (the default) binds an ephemeral port** — the chosen port is
+published through the ``observability_server_port`` gauge and one log
+line, so parallel test runs and co-scheduled jobs never collide; a
+negative value disables the exporter. ``start()`` is idempotent: once
+one server is bound, later calls from fit/Server share it.
 
 The server binds all interfaces (a scrape endpoint); everything it
 serves is read-only telemetry.
@@ -31,16 +42,21 @@ serves is read-only telemetry.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
+from . import flight as _flight
+from . import goodput as _goodput
 from . import metrics as _metrics
 from . import recompile as _recompile
 from . import tracer as _tracer
 from . import xprof as _xprof
+
+_log = logging.getLogger("paddle_tpu.observability")
 
 __all__ = ["ObservabilityServer", "start", "stop", "get",
            "maybe_start", "HEARTBEAT_GAUGE"]
@@ -184,10 +200,17 @@ class _Handler(BaseHTTPRequestHandler):
                 q = parse_qs(url.query)
                 ms = int(q.get("ms", ["500"])[0])
                 self._send_json(200, _trace_window(ms))
+            elif url.path == "/goodput":
+                self._send_json(200, _goodput.ledger().snapshot())
+            elif url.path == "/flight":
+                rec = _flight.recorder()
+                self._send_json(200, {"capacity": rec.capacity,
+                                      "events": rec.events()})
             elif url.path == "/":
                 self._send(200,
                            b"paddle_tpu observability: /metrics /healthz "
-                           b"/varz /trace?ms=N\n", "text/plain")
+                           b"/varz /trace?ms=N /goodput /flight\n",
+                           "text/plain")
             else:
                 self._send(404, b"not found\n", "text/plain")
         except BrokenPipeError:
@@ -201,7 +224,7 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class ObservabilityServer:
-    """Daemon-threaded HTTP exporter; ``port`` 0/-1 = ephemeral."""
+    """Daemon-threaded HTTP exporter; ``port`` <= 0 binds ephemeral."""
 
     def __init__(self, port: int = 0) -> None:
         self._httpd = ThreadingHTTPServer(("", max(0, int(port))),
@@ -224,8 +247,10 @@ _server: Optional[ObservabilityServer] = None
 
 
 def start(port: int = 0) -> ObservabilityServer:
-    """Start (or return) the process-wide exporter. Idempotent: a
-    second call returns the running server regardless of port."""
+    """Start (or return) the process-wide exporter; ``port`` 0 binds
+    an ephemeral port. Idempotent: a second call returns the running
+    server regardless of port (a differing explicit request is
+    logged, not honoured — one process, one exporter)."""
     global _server
     with _lock:
         if _server is None:
@@ -234,6 +259,12 @@ def start(port: int = 0) -> ObservabilityServer:
                 "observability_server_port",
                 "TCP port of the live observability HTTP exporter",
                 always=True).set(float(_server.port))
+            _log.info("observability exporter serving /metrics /healthz "
+                      "/varz /trace /goodput /flight on :%d",
+                      _server.port)
+        elif port > 0 and port != _server.port:
+            _log.info("observability exporter already bound on :%d; "
+                      "ignoring request for :%d", _server.port, port)
         return _server
 
 
@@ -250,8 +281,9 @@ def stop() -> None:
 
 
 def maybe_start() -> Optional[ObservabilityServer]:
-    """Flag-driven start: FLAGS_metrics_port != 0 and metrics enabled.
-    Called from hapi.Model.fit and inference.Server."""
+    """Flag-driven start, called from hapi.Model.fit and
+    inference.Server: metrics enabled and FLAGS_metrics_port >= 0
+    (0 = ephemeral bind, negative = exporter off)."""
     if not _metrics.enabled():
         return _server
     try:
@@ -259,7 +291,7 @@ def maybe_start() -> Optional[ObservabilityServer]:
         port = int(GLOBAL_FLAGS.get("metrics_port"))
     except Exception:
         return _server
-    if port == 0:
+    if port < 0:
         return _server
     return start(port)
 
@@ -296,6 +328,15 @@ def self_test() -> int:
         code, text = fetch("/trace?ms=20")
         trace = json.loads(text)
         assert code == 200 and "traceEvents" in trace, text
+        _flight.record("selftest_event", step=1)
+        code, text = fetch("/flight")
+        fl = json.loads(text)
+        assert code == 200 and any(
+            e["kind"] == "selftest_event" for e in fl["events"]), text
+        code, text = fetch("/goodput")
+        gp = json.loads(text)
+        assert code == 200 and "goodput_ratio" in gp \
+            and set(gp["buckets"]) >= set(_goodput.BUCKETS), text
     finally:
         srv.stop()
         _metrics.set_enabled(False)

@@ -12,6 +12,24 @@ the dp axis inside shard_map — k is small so the gather is cheap — then a
 dense scatter-add rebuild. XLA cannot do this transformation itself
 because it changes numerics; everything else (the dense path) stays with
 the automatic pjit collectives.
+
+Numerics follow the DGC paper (and the reference's DGCMomentumOptimizer,
+which replaces the plain momentum update rather than stacking on top of
+it):
+
+- **momentum correction** — each replica accumulates a local velocity
+  ``u = m*u + g`` and sparsifies ``residual + u``, so the exchanged
+  entries carry momentum-accumulated mass instead of raw gradients
+  (momentum applied *after* sparsification amplifies the bursty top-k
+  arrivals and destabilises early training);
+- **momentum factor masking** — entries that were transmitted are
+  zeroed in the velocity too, so stale momentum can't re-send them;
+- the outer optimizer's own momentum is neutralised at trace time
+  (``_with_zeroed_attr``) because the velocity already carries it —
+  double-applying m would square the effective momentum;
+- **dense warm-up** — the first ``rampup_steps`` steps exchange the
+  full velocity densely (exactly momentum SGD), the paper's warm-up
+  that lets early large gradients through before sparsity bites.
 """
 
 from __future__ import annotations
@@ -61,17 +79,54 @@ def dgc_allreduce(local_grad: jnp.ndarray, residual: jnp.ndarray,
     return (dense / n).reshape(acc.shape), new_residual
 
 
+def dgc_momentum_exchange(grad: jnp.ndarray, velocity: jnp.ndarray,
+                          residual: jnp.ndarray, use_dgc, axis: str,
+                          sparsity: float, momentum: float):
+    """One leaf of the momentum-corrected DGC step (paper §3.2).
+
+    Per-replica: accumulate velocity ``u = m*u + g``, add the error
+    residual, top-k sparsify, exchange the sparse entries, and apply
+    momentum factor masking (transmitted entries leave both residual
+    and velocity). ``use_dgc`` is a traced bool — False (warm-up)
+    delivers ``pmean(residual + u)`` densely and carries the velocity
+    forward untouched, which is exactly momentum SGD.
+
+    Returns (delivered dense update, new velocity, new residual).
+    """
+    n = _axis_size(axis)
+    u = momentum * velocity + grad
+    acc = residual + u
+    size = acc.size
+    k = max(1, int(size * (1.0 - sparsity)))
+    vals, idx, sparse_residual = topk_sparsify(acc, k)
+    sparse_velocity = u.reshape(-1).at[idx].set(0.0).reshape(u.shape)
+    all_vals = lax.all_gather(vals, axis)
+    all_idx = lax.all_gather(idx, axis)
+    sparse_update = jnp.zeros((size,), acc.dtype) \
+        .at[all_idx.reshape(-1)].add(all_vals.reshape(-1)) \
+        .reshape(acc.shape) / n
+    dense_update = lax.pmean(acc, axis)
+    update = jnp.where(use_dgc, sparse_update, dense_update)
+    new_velocity = jnp.where(use_dgc, sparse_velocity, u)
+    new_residual = jnp.where(use_dgc, sparse_residual,
+                             jnp.zeros_like(residual))
+    return update, new_velocity, new_residual
+
+
 class DGCTrainStep:
     """Data-parallel train step whose grad allreduce is DGC-compressed.
 
     Per-replica grads are computed under shard_map (no automatic psum),
-    compressed, exchanged sparsely, and fed to the optimizer identically
-    on every replica (params stay replicated).
+    momentum-corrected, compressed, exchanged sparsely, and fed to the
+    optimizer identically on every replica (params stay replicated).
+    The optimizer's own momentum is zeroed at trace time — the DGC
+    velocity subsumes it (reference: DGCMomentumOptimizer *replaces*
+    Momentum rather than wrapping it).
     """
 
     def __init__(self, model: Layer, optimizer: Optimizer,
                  loss_fn: Callable, mesh: Mesh, sparsity: float = 0.99,
-                 rampup_steps: int = 0, seed: int = 0,
+                 rampup_steps: int = 3, seed: int = 0,
                  dp_axis: str = "dp") -> None:
         self.model = model
         self.optimizer = optimizer
@@ -80,6 +135,10 @@ class DGCTrainStep:
         self.sparsity = float(sparsity)
         self.rampup_steps = int(rampup_steps)
         self.axis = dp_axis
+        # momentum correction coefficient: lifted from the optimizer
+        # (Momentum/LarsMomentum); optimizers without a momentum attr
+        # (Adam, SGD) run with m=0 — velocity degenerates to the grad
+        self.momentum = float(getattr(optimizer, "momentum", 0.0))
 
         params = model.param_dict()
         buffers = model.buffer_dict()
@@ -89,6 +148,7 @@ class DGCTrainStep:
             "buffers": buffers,
             "opt": opt_state,
             "residual": jax.tree.map(jnp.zeros_like, params),
+            "velocity": jax.tree.map(jnp.zeros_like, params),
             "rng": _random.make_key(seed),
             "step_count": jnp.zeros((), jnp.int32),
         }
@@ -99,6 +159,7 @@ class DGCTrainStep:
         self.state_specs = {
             "params": rep(params), "buffers": rep(buffers),
             "opt": rep(opt_state), "residual": rep(params),
+            "velocity": rep(params),
             "rng": P(), "step_count": P(),
         }
         shardings = jax.tree.map(lambda s: NamedSharding(mesh, s),
@@ -126,24 +187,41 @@ class DGCTrainStep:
             (loss, new_buffers), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params)
 
-            # compress+exchange per tensor; rampup runs dense (ref:
-            # DGCMomentumOptimizer rampup_begin_step)
+            # momentum-corrected compress+exchange, leaf-wise over the
+            # grads PYTREE — positional and kwargs-fed batches produce
+            # the same tree, so velocity/residual always pair with the
+            # right leaf regardless of how the batch arrived; rampup
+            # runs dense (ref: DGCMomentumOptimizer rampup_begin_step)
             use_dgc = state["step_count"] >= self.rampup_steps
-            new_grads, new_res = {}, {}
-            for name in grads:
-                g = grads[name]
-                r = state["residual"][name]
-                cg, cr = dgc_allreduce(g, r, dp_axis, self.sparsity)
-                dg = lax.pmean(g, dp_axis)
-                new_grads[name] = jnp.where(use_dgc, cg, dg)
-                new_res[name] = jnp.where(use_dgc, cr,
-                                          jnp.zeros_like(r))
-            new_params, new_opt = self.optimizer.apply_gradients(
-                params, new_grads, state["opt"],
-                lr_override=lr if self._host_lr_active else None)
+            exchanged = jax.tree.map(
+                lambda g, u, r: dgc_momentum_exchange(
+                    g, u, r, use_dgc, dp_axis, self.sparsity,
+                    self.momentum),
+                grads, state["velocity"], state["residual"])
+            is_triple = lambda x: isinstance(x, tuple)  # noqa: E731
+            new_grads = jax.tree.map(lambda t: t[0], exchanged,
+                                     is_leaf=is_triple)
+            new_vel = jax.tree.map(lambda t: t[1], exchanged,
+                                   is_leaf=is_triple)
+            new_res = jax.tree.map(lambda t: t[2], exchanged,
+                                   is_leaf=is_triple)
+
+            def apply():
+                return self.optimizer.apply_gradients(
+                    params, new_grads, state["opt"],
+                    lr_override=lr if self._host_lr_active else None)
+
+            if self.momentum:
+                # trace-time momentum bypass: the exchanged update
+                # already carries the velocity accumulation
+                new_params, new_opt = self.optimizer._with_zeroed_attr(
+                    "momentum", apply)
+            else:
+                new_params, new_opt = apply()
             loss = lax.pmean(loss, dp_axis)
             return ({"params": new_params, "buffers": new_buffers,
-                     "opt": new_opt, "residual": new_res, "rng": rng,
+                     "opt": new_opt, "residual": new_res,
+                     "velocity": new_vel, "rng": rng,
                      "step_count": state["step_count"] + 1},
                     {"loss": loss})
 

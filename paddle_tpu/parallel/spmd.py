@@ -215,6 +215,7 @@ class ShardedTrainStep:
         self.loss_fn = loss_fn
         self.mesh = mesh
         self.batch_spec = batch_spec
+        self.axis = dp_axis  # straggler detector keys the dp exchange
         self.extra_metrics = extra_metrics or {}
 
         params = model.param_dict()

@@ -530,11 +530,12 @@ def http_server(metrics_on):
 
 def test_http_endpoints_during_fit(metrics_on, tmp_path):
     """ISSUE acceptance: with FLAGS_enable_metrics=1 and
-    FLAGS_metrics_port set, GET /metrics DURING a CPU fit returns
-    Prometheus text with the step-time histogram, recompile counters
-    and the anomaly counter; /varz carries a program card with
-    non-empty analyses (or an explicit unavailable marker)."""
-    pt.set_flags({"metrics_port": -1, "trace_dir": str(tmp_path)})
+    FLAGS_metrics_port=0 (ephemeral bind — the parallel-test-safe
+    default), GET /metrics DURING a CPU fit returns Prometheus text
+    with the step-time histogram, recompile counters and the anomaly
+    counter; /varz carries a program card with non-empty analyses (or
+    an explicit unavailable marker)."""
+    pt.set_flags({"metrics_port": 0, "trace_dir": str(tmp_path)})
     pages = {}
 
     class Probe(pt.hapi.Callback):
@@ -809,10 +810,13 @@ def test_native_stats_bridge(metrics_on):
 # CI tooling: flags-doc check + exporter self-test
 # ---------------------------------------------------------------------------
 
-def test_check_flags_doc_passes():
+@pytest.mark.parametrize("checker", ["check_flags_doc.py",
+                                     "check_metrics_doc.py"])
+def test_check_flags_doc_passes(checker):
+    """One gate for both doc contracts: every flag AND every literal
+    metric name registered in code must be documented."""
     proc = subprocess.run(
-        [sys.executable, os.path.join(ROOT, "tools",
-                                      "check_flags_doc.py")],
+        [sys.executable, os.path.join(ROOT, "tools", checker)],
         capture_output=True, text=True, timeout=60)
     assert proc.returncode == 0, proc.stderr + proc.stdout
     assert "OK" in proc.stdout
@@ -844,3 +848,346 @@ def test_exporter_self_test_subprocess():
         capture_output=True, text=True, env=env, timeout=300, cwd=ROOT)
     assert proc.returncode == 0, proc.stderr + proc.stdout
     assert "self-test OK" in proc.stdout
+
+
+def test_check_metrics_doc_catches_undocumented(tmp_path):
+    """The metrics checker must actually fail on an unlisted name."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import check_metrics_doc as cmd
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(
+            'from obs import counter, gauge\n'
+            'counter("totally_new_metric_total", "help").inc()\n'
+            'gauge("selftest_ignored").set(1)\n'
+            'name = "dyn"; counter(name)\n')
+        found = cmd.collect_metrics(str(pkg))
+    finally:
+        sys.path.pop(0)
+    assert set(found) == {"totally_new_metric_total"}
+    assert "totally_new_metric_total" not in open(cmd.DOC).read()
+
+
+# ---------------------------------------------------------------------------
+# goodput ledger
+# ---------------------------------------------------------------------------
+
+def test_goodput_ledger_exclusive_buckets(metrics_on):
+    import time as _time
+    led = obs.goodput.GoodputLedger()
+    led.start()
+    led.attribute("data_wait", 0.05)
+    with led.measure("eval"):
+        _time.sleep(0.02)
+        with led.measure("checkpoint"):      # nested: self-time only
+            _time.sleep(0.02)
+    led.attribute("step_compute", 0.1)
+    led.stop()
+    snap = led.snapshot()
+    # exclusivity: the eval bucket holds only its SELF time
+    assert 0.015 <= snap["buckets"]["eval"] <= 0.035, snap["buckets"]
+    assert 0.015 <= snap["buckets"]["checkpoint"] <= 0.035
+    # completeness: buckets (incl. the residual) sum to wall exactly
+    assert sum(snap["buckets"].values()) == \
+        pytest.approx(snap["wall_seconds"], rel=1e-6)
+    assert sum(snap["ratios"].values()) == pytest.approx(1.0, abs=1e-6)
+    assert snap["goodput_ratio"] == pytest.approx(
+        0.1 / snap["wall_seconds"], rel=1e-6)
+    # a second start/stop keeps accumulating without double-counting
+    led.start()
+    led.attribute("step_compute", 0.05)
+    led.stop()
+    snap2 = led.snapshot()
+    assert snap2["buckets"]["step_compute"] == pytest.approx(0.15)
+    assert sum(snap2["buckets"].values()) == \
+        pytest.approx(snap2["wall_seconds"], rel=1e-6)
+
+
+def test_goodput_ledger_publishes_registry_series(metrics_on):
+    led = obs.goodput.GoodputLedger()
+    led.start()
+    led.attribute("step_compute", 0.2)
+    led.attribute("jit_compile", 0.1)
+    led.stop()
+    led.publish()
+    assert obs.counter("goodput_seconds_total").value() == \
+        pytest.approx(0.2)
+    bad = obs.counter("badput_seconds_total")
+    assert bad.value(bucket="jit_compile") == pytest.approx(0.1)
+    assert 0 < obs.gauge("goodput_ratio").value() < 1
+
+
+def test_goodput_ledger_seeds_restart_idle(metrics_on, monkeypatch):
+    monkeypatch.setenv("PT_RESTART_IDLE_S", "2.5")
+    monkeypatch.setenv("PT_ELASTIC_ATTEMPT", "1")
+    led = obs.goodput.GoodputLedger()
+    led.start()
+    led.stop()
+    snap = led.snapshot()
+    # launcher hand-off plus this process's own import-to-start time
+    assert snap["buckets"]["restart_idle"] >= 2.5
+    # seed applied once, not per start()
+    led.start()
+    led.stop()
+    assert led.snapshot()["buckets"]["restart_idle"] == \
+        snap["buckets"]["restart_idle"]
+
+
+def test_fit_populates_goodput_and_flight(metrics_on, tmp_path):
+    """A CPU fit must leave a coherent ledger: compile split out of
+    step time, data_wait measured, buckets exclusive, metrics.json
+    carrying the goodput section, and the flight ring holding the
+    step markers."""
+    pt.set_flags({"trace_dir": str(tmp_path)})
+    m = pt.hapi.Model(_MLP())
+    m.prepare(optimizer=pt.optimizer.SGD(learning_rate=1e-2),
+              loss=pt.nn.CrossEntropyLoss())
+    m.fit(_loader(), eval_loader=_loader(n=32), epochs=1, verbose=0)
+
+    with open(tmp_path / "metrics.json") as f:
+        snap = json.load(f)
+    gp = snap["goodput"]
+    assert gp["wall_seconds"] > 0
+    assert gp["buckets"]["step_compute"] > 0
+    assert gp["buckets"]["jit_compile"] > 0   # first dispatch traced
+    assert gp["buckets"]["eval"] > 0
+    assert sum(gp["buckets"].values()) == \
+        pytest.approx(gp["wall_seconds"], rel=0.02)
+    assert gp["goodput_ratio"] == pytest.approx(
+        gp["buckets"]["step_compute"] / gp["wall_seconds"], rel=1e-6)
+    # registry series mirror the ledger
+    bad = {s["labels"]["bucket"]: s["value"]
+           for s in snap["metrics"]["badput_seconds_total"]["series"]}
+    assert bad["jit_compile"] == pytest.approx(
+        gp["buckets"]["jit_compile"], rel=1e-6)
+    assert "step_compute" not in bad          # goodput is not badput
+    # flight ring: lifecycle + one marker per step (3 steps)
+    kinds = [e["kind"] for e in obs.flight_recorder().events()]
+    assert kinds.count("step") == 3
+    assert "fit_begin" in kinds and "fit_end" in kinds
+    assert "recompile" in kinds               # the TrainStep trace
+
+
+# ---------------------------------------------------------------------------
+# straggler detection
+# ---------------------------------------------------------------------------
+
+def test_flag_stragglers_policy():
+    from paddle_tpu.observability.goodput import flag_stragglers
+    assert flag_stragglers([1.0, 1.0, 1.0, 5.0], 2.0) == [3]
+    assert flag_stragglers([1.0, 1.0, 1.0, 1.4], 1.5) == []
+    assert flag_stragglers([1.0], 2.0) == []          # fleet of one
+    assert flag_stragglers([1.0, 9.0], 0.0) == []     # disabled
+    assert flag_stragglers([0.0, 0.0], 2.0) == []     # degenerate
+
+
+def test_straggler_detector_exchange_and_dedup(metrics_on):
+    from paddle_tpu.parallel import data_parallel_mesh
+    pt.set_flags({"straggler_factor": 1.5})
+    try:
+        det = obs.goodput.StragglerDetector(data_parallel_mesh(), "dp",
+                                            interval=2)
+        det.observe(0, 0.1)          # off-interval: no dispatch
+        assert det._exchange is None
+        det.observe(1, 0.1)          # exchange (all shards equal)
+        jax.effects_barrier()
+        assert det._last_processed == 1
+        assert obs.counter("straggler_events_total").value(host=0) == 0
+        # one slow host in a synthetic fleet vector: flagged ONCE even
+        # when the per-shard callback replays it
+        fleet = np.array([0.1] * 7 + [0.9])
+        det.on_fleet(fleet, 3)
+        det.on_fleet(fleet, 3)       # duplicate shard callback
+        assert obs.counter("straggler_events_total").value(host=7) == 1
+        ev = [e for e in obs.flight_recorder().events()
+              if e["kind"] == "straggler"]
+        assert len(ev) == 1 and ev[0]["host"] == 7
+        assert ev[0]["fleet_median_seconds"] == pytest.approx(0.1)
+    finally:
+        pt.set_flags({"straggler_factor": 0.0})
+
+
+def test_straggler_disabled_by_default(metrics_on):
+    from paddle_tpu.parallel import data_parallel_mesh
+    det = obs.goodput.StragglerDetector(data_parallel_mesh(), "dp",
+                                        interval=1)
+    det.observe(0, 0.5)              # factor 0.0: no exchange built
+    assert det._exchange is None
+
+
+# ---------------------------------------------------------------------------
+# flight recorder + rotation
+# ---------------------------------------------------------------------------
+
+def test_flight_ring_capacity_and_gating(metrics_on):
+    rec = obs.flight.FlightRecorder(capacity=16)
+    for i in range(40):
+        rec.record("step", step=i)
+    evs = rec.events()
+    assert len(evs) == 16
+    assert evs[-1]["step"] == 39 and evs[0]["step"] == 24  # newest kept
+    pt.set_flags({"enable_metrics": False})
+    rec.record("dropped")
+    assert len(rec.events()) == 16   # gated off
+    rec.record("forced", force=True)
+    assert rec.events()[-1]["kind"] == "forced"
+    pt.set_flags({"enable_metrics": True})
+
+
+def test_flight_buffer_flag_resizes_ring(metrics_on):
+    rec = obs.flight_recorder()
+    rec.reset()
+    for i in range(20):
+        rec.record("step", step=i)
+    pt.set_flags({"flight_buffer_events": 8})
+    try:
+        assert rec.capacity == 8
+        assert [e["step"] for e in rec.events()] == list(range(12, 20))
+    finally:
+        pt.set_flags({"flight_buffer_events": 512})
+
+
+def test_flight_dump_format_and_rotation(metrics_on, tmp_path):
+    rec = obs.flight.FlightRecorder(capacity=64)
+    for i in range(10):
+        rec.record("step", step=i)
+    paths = [rec.dump(f"manual:{i}", str(tmp_path)) for i in range(3)]
+    assert all(paths)
+    lines = [json.loads(l) for l in open(paths[-1])]
+    assert lines[0]["kind"] == "flight_header"
+    assert lines[0]["reason"] == "manual:2"
+    assert [e["step"] for e in lines[1:-1]] == list(range(10))
+    assert lines[-1]["kind"] == "final_metrics"
+    assert "metrics" in lines[-1] and "goodput" in lines[-1]
+    # repeated dumps keep only the newest two files
+    flights = [f for f in os.listdir(tmp_path)
+               if f.startswith("flight_")]
+    assert len(flights) <= 2
+    assert os.path.basename(paths[-1]) in flights
+
+
+def test_flight_dump_without_trace_dir_is_noop(metrics_on):
+    rec = obs.flight.FlightRecorder(capacity=8)
+    rec.record("x")
+    assert rec.dump("nowhere") == ""     # FLAGS_trace_dir unset
+
+
+def test_rotation_append_jsonl_rolls_over(tmp_path):
+    from paddle_tpu.observability import rotation
+    path = str(tmp_path / "ev.jsonl")
+    rec = {"kind": "x", "pad": "p" * 80}
+    for _ in range(30):
+        rotation.append_jsonl(path, [rec], max_bytes=1000, keep=2)
+    assert os.path.exists(path) and os.path.exists(path + ".1")
+    assert not os.path.exists(path + ".2")          # keep=2 only
+    assert os.path.getsize(path) <= 1000 + 200      # fresh generation
+    # every surviving line is intact JSON
+    for p in (path, path + ".1"):
+        for line in open(p):
+            assert json.loads(line)["kind"] == "x"
+
+
+def test_anomaly_events_rotate_and_enter_flight(metrics_on, tmp_path,
+                                                monkeypatch):
+    from paddle_tpu.observability import rotation
+    pt.set_flags({"trace_dir": str(tmp_path)})
+    monkeypatch.setattr(rotation, "DEFAULT_MAX_BYTES", 500)
+    s = obs.anomaly_sentinel()
+    for _ in range(20):
+        s.observe("t_rot", float("nan"))
+    assert os.path.exists(tmp_path / "events.jsonl")
+    assert os.path.exists(tmp_path / "events.jsonl.1")
+    fl = [e for e in obs.flight_recorder().events()
+          if e["kind"] == "anomaly"]
+    assert fl and fl[-1]["series"] == "t_rot" \
+        and fl[-1]["anomaly"] == "nan"
+
+
+# ---------------------------------------------------------------------------
+# /goodput + /flight endpoints, port semantics
+# ---------------------------------------------------------------------------
+
+def test_goodput_and_flight_endpoints(http_server):
+    led = obs.goodput_ledger()
+    led.start()
+    led.attribute("step_compute", 0.3)
+    led.attribute("data_wait", 0.1)
+    obs.flight.record("probe_event", step=4)
+    code, text = _get(http_server.port, "/goodput")
+    assert code == 200
+    gp = json.loads(text)
+    assert gp["buckets"]["step_compute"] == pytest.approx(0.3)
+    assert set(gp["buckets"]) == set(obs.goodput.BUCKETS)
+    assert sum(gp["ratios"].values()) == pytest.approx(1.0, abs=1e-6)
+    code, text = _get(http_server.port, "/flight")
+    fl = json.loads(text)
+    assert code == 200 and fl["capacity"] >= 8
+    assert any(e["kind"] == "probe_event" for e in fl["events"])
+    led.stop()
+
+
+def test_metrics_port_semantics(metrics_on):
+    # negative: exporter disabled
+    obs.server.stop()
+    pt.set_flags({"metrics_port": -1})
+    try:
+        assert obs.server.maybe_start() is None
+        # 0 (default): ephemeral bind, port published on the gauge
+        pt.set_flags({"metrics_port": 0})
+        srv = obs.server.maybe_start()
+        assert srv is not None and srv.port > 0
+        assert obs.gauge("observability_server_port").value() == srv.port
+        # idempotent across fit/Server start sites, even with a
+        # different explicit port requested
+        assert obs.server.start(srv.port + 1) is srv
+        assert obs.server.maybe_start() is srv
+    finally:
+        pt.set_flags({"metrics_port": 0})
+        obs.server.stop()
+
+
+# ---------------------------------------------------------------------------
+# trace_report merged host+XLA path
+# ---------------------------------------------------------------------------
+
+def test_trace_report_merges_host_and_xla(metrics_on, tmp_path, capsys):
+    """The merged path: host spans from export_all + an XLA capture in
+    the same directory must land in ONE table (xla:: prefix) with the
+    device-category rollup printed."""
+    import gzip
+    tr = obs.get_tracer()
+    tr.reset()
+    with tr.span("host/step", force=True):
+        pass
+    obs.export_all(str(tmp_path))
+    with gzip.open(tmp_path / "t.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": _fake_xla_events()}, f)
+
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import trace_report
+        rc = trace_report.report(str(tmp_path))
+    finally:
+        sys.path.pop(0)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "host/step" in out
+    assert "xla::fusion.1" in out
+    assert "convolution" in out          # category rollup
+    assert "merged span summary" in out
+
+
+def test_goodput_report_self_test_subprocess():
+    """ISSUE acceptance: the goodput CLI self-test passes on CPU —
+    short fit, exclusive ledger summing to wall time, and a simulated
+    SIGTERM leaving a parseable flight_*.jsonl with >= 50 events."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "goodput_report.py"),
+         "--self-test"],
+        capture_output=True, text=True, env=env, timeout=540)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert "self-test OK" in proc.stdout
+    assert "goodput_ratio" in proc.stdout

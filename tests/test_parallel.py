@@ -299,6 +299,49 @@ def test_all_compiled_steps_forward_kwargs():
     assert l1 < l0
 
 
+def test_dgc_kwargs_match_positional_leaf_routing():
+    """Regression: DGC's momentum correction routes every grad leaf
+    through the same (velocity, residual) pairing whether the batch
+    tensor arrived positionally or as a model-forward kwarg — the two
+    spellings must produce bit-identical loss trajectories, and the
+    correction state must actually engage past the dense warm-up."""
+    from paddle_tpu.parallel.dgc import DGCTrainStep
+
+    class GatedFc(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = pt.nn.Linear(8, 4)
+
+        def forward(self, x, gate=None):
+            out = self.fc(x)
+            return out if gate is None else out * gate
+
+    rng = np.random.default_rng(7)
+    x = rng.normal(0, 1, (16, 8)).astype(np.float32)
+    y = rng.integers(0, 4, (16,)).astype(np.int64)
+    gate = rng.uniform(0.5, 1.5, (16, 4)).astype(np.float32)
+
+    def build():
+        pt.seed(5)
+        return DGCTrainStep(
+            GatedFc(), pt.optimizer.Momentum(learning_rate=0.05,
+                                             momentum=0.9),
+            lambda o, t_: pt.nn.functional.cross_entropy(o, t_),
+            mesh=data_parallel_mesh(), sparsity=0.9, rampup_steps=1)
+
+    pos, kw = build(), build()
+    for i in range(5):
+        lp = float(pos(x, gate, labels=(y,))["loss"])
+        lk = float(kw(x, labels=(y,), gate=gate)["loss"])
+        assert lp == lk, (i, lp, lk)
+    # past warm-up the momentum-correction state is live: velocity and
+    # residual carry mass on every parameter leaf
+    for name, v in kw.state["velocity"].items():
+        assert float(jnp.sum(jnp.abs(v))) > 0, name
+    assert float(sum(jnp.sum(jnp.abs(r))
+                     for r in kw.state["residual"].values())) > 0
+
+
 def test_split_kwargs_notes_auto_shardable(caplog):
     """The leading-dim==batch convention silently shards a replicated
     table that coincidentally matches — every auto-classification is

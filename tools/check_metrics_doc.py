@@ -1,0 +1,97 @@
+"""CI check: every metric name registered in code must be documented.
+
+Mirror of ``check_flags_doc.py`` for the metrics registry: walks every
+``counter(...)`` / ``gauge(...)`` / ``histogram(...)`` call under
+``paddle_tpu/`` by AST (no framework import — milliseconds, no jax) and
+fails when a literal metric name does not appear in
+``docs/observability.md`` — the canonical metric index scrapers and
+dashboards are built from. Dynamically-named instruments (the
+user-facing ``obs.counter(my_name)`` API) have non-constant first
+arguments and are out of scope by construction; names starting with
+``selftest_`` (CLI self-test fixtures) are ignored.
+
+Usage: python tools/check_metrics_doc.py   (exit 0 ok, 1 violations)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_DIR = os.path.join(ROOT, "paddle_tpu")
+DOC = os.path.join(ROOT, "docs", "observability.md")
+
+_FACTORIES = {"counter", "gauge", "histogram"}
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def collect_metrics(pkg_dir: str = PKG_DIR):
+    """{name: [file:line, ...]} for every literal-named instrument."""
+    out = {}
+    for dirpath, _, files in os.walk(pkg_dir):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            try:
+                tree = ast.parse(open(path).read(), filename=path)
+            except SyntaxError as e:  # pragma: no cover
+                print(f"check_metrics_doc: cannot parse {path}: {e}",
+                      file=sys.stderr)
+                return None
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call)
+                        and _call_name(node) in _FACTORIES
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    continue
+                name = node.args[0].value
+                if not name or name.startswith("selftest_"):
+                    continue
+                rel = os.path.relpath(path, ROOT)
+                out.setdefault(name, []).append(
+                    f"{rel}:{node.lineno}")
+    return out
+
+
+def main() -> int:
+    metrics = collect_metrics()
+    if metrics is None:
+        return 1
+    if not metrics:
+        print("check_metrics_doc: no instrument registrations found "
+              f"under {PKG_DIR} — parser broken?", file=sys.stderr)
+        return 1
+    try:
+        doc = open(DOC).read()
+    except OSError as e:
+        print(f"check_metrics_doc: cannot read {DOC}: {e}",
+              file=sys.stderr)
+        return 1
+    missing = {n: sites for n, sites in metrics.items() if n not in doc}
+    for name in sorted(missing):
+        print(f"{name}: registered at {', '.join(missing[name])} but "
+              "not mentioned in docs/observability.md",
+              file=sys.stderr)
+    if missing:
+        print(f"check_metrics_doc: {len(missing)} undocumented of "
+              f"{len(metrics)} metric names", file=sys.stderr)
+        return 1
+    print(f"check_metrics_doc: OK ({len(metrics)} metric names "
+          "documented)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

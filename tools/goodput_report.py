@@ -1,0 +1,220 @@
+"""Render the goodput ledger from an exported metrics.json.
+
+Usage:
+    python tools/goodput_report.py [TRACE_DIR | metrics.json]
+                                   [--self-test]
+
+TRACE_DIR (default: FLAGS_trace_dir or /tmp/pt_trace) is what
+``paddle_tpu.observability.export_all()`` / ``hapi.Model.fit`` with
+FLAGS_trace_dir wrote; its ``metrics.json`` carries a ``goodput``
+section (the ledger snapshot: exclusive per-bucket wall seconds) plus
+the registry series (``badput_seconds_total{bucket=…}``,
+``straggler_events_total{host=…}``). This CLI prints the operator view:
+a per-bucket table, the goodput headline, and any straggler/anomaly
+counts — "what fraction of wall-clock trained the model, and where did
+the rest go".
+
+``--self-test`` is the no-TPU CI hook: it runs a short CPU fit with
+metrics on, asserts the ledger's invariants (buckets exclusive and
+summing to wall time within 2%), then re-runs a fit in a SUBPROCESS
+that SIGTERMs itself mid-flight and asserts the crash flight recorder
+left a parseable ``flight_*.jsonl`` with at least 50 events.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+_BUCKET_ORDER = ("step_compute", "jit_compile", "data_wait", "eval",
+                 "checkpoint", "restart_idle", "other")
+
+
+def _counter_series(metrics: dict, name: str) -> dict:
+    out = {}
+    for s in metrics.get(name, {}).get("series", []):
+        labels = s.get("labels", {})
+        key = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+        out[key] = s.get("value", 0)
+    return out
+
+
+def render(snap: dict) -> str:
+    """Format one exported snapshot (the metrics.json dict)."""
+    goodput = snap.get("goodput")
+    lines = []
+    if not goodput:
+        return ("no goodput section in metrics.json — run the fit with "
+                "FLAGS_enable_metrics=1 (ledger accounting rides the "
+                "metrics switch)")
+    wall = goodput.get("wall_seconds", 0.0)
+    buckets = goodput.get("buckets", {})
+    ratios = goodput.get("ratios", {})
+    lines.append("== goodput ledger ==")
+    lines.append(f"{'bucket':<16} {'seconds':>12} {'share':>8}")
+    for b in _BUCKET_ORDER:
+        if b in buckets:
+            lines.append(f"{b:<16} {buckets[b]:>12.3f} "
+                         f"{100 * ratios.get(b, 0):>7.1f}%")
+    for b in sorted(set(buckets) - set(_BUCKET_ORDER)):
+        lines.append(f"{b:<16} {buckets[b]:>12.3f} "
+                     f"{100 * ratios.get(b, 0):>7.1f}%")
+    lines.append(f"{'wall':<16} {wall:>12.3f} {100.0:>7.1f}%")
+    lines.append(f"goodput_ratio    {goodput.get('goodput_ratio', 0):.4f}")
+
+    metrics = snap.get("metrics", {})
+    stragglers = _counter_series(metrics, "straggler_events_total")
+    if stragglers:
+        lines.append("\n== straggler events ==")
+        for host, n in sorted(stragglers.items()):
+            lines.append(f"  {host:<20} {int(n)}")
+    anomalies = _counter_series(metrics, "anomalies_total")
+    if anomalies:
+        lines.append("\n== anomalies ==")
+        for key, n in sorted(anomalies.items()):
+            lines.append(f"  {key:<32} {int(n)}")
+    restarts = _counter_series(metrics, "elastic_restarts_total")
+    if restarts:
+        lines.append("\n== elastic restarts ==")
+        for key, n in restarts.items():
+            lines.append(f"  {key or 'total':<20} {int(n)}")
+    return "\n".join(lines)
+
+
+def report(path: str) -> int:
+    mpath = path
+    if os.path.isdir(path):
+        mpath = os.path.join(path, "metrics.json")
+    if not os.path.exists(mpath):
+        print(f"no metrics.json at {mpath} — run with "
+              "FLAGS_enable_metrics=1 and FLAGS_trace_dir set",
+              file=sys.stderr)
+        return 1
+    with open(mpath) as f:
+        snap = json.load(f)
+    print(render(snap))
+    return 0
+
+
+# ------------------------------------------------------------------ CI
+
+def _run_fit(trace_dir: str, steps: int = 64):
+    """Tiny CPU fit that exercises every ledger bucket: train steps,
+    an eval pass, and a checkpoint save."""
+    import numpy as np
+
+    import paddle_tpu as pt
+
+    pt.set_flags({"enable_metrics": True, "trace_dir": trace_dir})
+
+    class MLP(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = pt.nn.Linear(8, 16)
+            self.fc2 = pt.nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.fc2(pt.nn.functional.relu(self.fc1(x)))
+
+    rng = np.random.default_rng(0)
+    n = steps * 4
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    y = rng.integers(0, 4, n).astype(np.int64)
+    loader = pt.data.DataLoader(pt.data.TensorDataset(x, y),
+                                batch_size=4)
+    m = pt.hapi.Model(MLP())
+    m.prepare(optimizer=pt.optimizer.SGD(learning_rate=1e-2),
+              loss=pt.nn.CrossEntropyLoss())
+    # the mid-fit ModelCheckpoint callback exercises the ledger's
+    # checkpoint bucket (a save outside fit is not fit wall time)
+    ckpt = pt.hapi.ModelCheckpoint(
+        m, os.path.join(trace_dir, "selftest_ckpt"), save_freq=1)
+    m.fit(loader, eval_loader=loader, epochs=1, verbose=0,
+          callbacks=[ckpt])
+    return m
+
+
+def _sigterm_child(trace_dir: str) -> int:
+    """Run a short fit, then deliver SIGTERM to ourselves — the flight
+    recorder's handler must dump before the default action kills us."""
+    _run_fit(trace_dir, steps=64)
+    os.kill(os.getpid(), signal.SIGTERM)
+    return 7  # unreachable: the re-raised SIGTERM terminates us
+
+
+def self_test() -> int:
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        _run_fit(d)
+        from paddle_tpu import observability as obs
+        obs.export_all(d)
+        rc = report(d)
+        if rc != 0:
+            return rc
+        with open(os.path.join(d, "metrics.json")) as f:
+            snap = json.load(f)
+        gp = snap["goodput"]
+        wall, buckets = gp["wall_seconds"], gp["buckets"]
+        # exclusivity: buckets are disjoint classifications of wall
+        # time, so they must sum back to it (±2%) and each be sane
+        total = sum(buckets.values())
+        assert wall > 0 and abs(total - wall) <= 0.02 * wall, \
+            (wall, buckets)
+        assert all(v >= 0 for v in buckets.values()), buckets
+        assert abs(sum(gp["ratios"].values()) - 1.0) <= 0.02
+        assert buckets["step_compute"] > 0 and buckets["eval"] > 0
+        assert buckets["checkpoint"] > 0 and buckets["jit_compile"] > 0
+        assert gp["goodput_ratio"] == \
+            buckets["step_compute"] / max(wall, 1e-12)
+
+    # crash path: a separate interpreter SIGTERMs itself mid-run
+    with tempfile.TemporaryDirectory() as d:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--sigterm-child", d],
+            capture_output=True, text=True, env=env, timeout=480)
+        assert proc.returncode != 0, "child survived its own SIGTERM"
+        flights = [f for f in os.listdir(d) if f.startswith("flight_")]
+        assert flights, (proc.stdout, proc.stderr)
+        with open(os.path.join(d, sorted(flights)[-1])) as f:
+            lines = [json.loads(line) for line in f]
+        assert lines[0]["kind"] == "flight_header"
+        assert lines[0]["reason"].startswith("signal:")
+        assert lines[-1]["kind"] == "final_metrics"
+        events = lines[1:-1]
+        assert len(events) >= 50, len(events)
+        kinds = {e["kind"] for e in events}
+        assert "step" in kinds and "signal" in kinds, kinds
+    print("\nself-test OK")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("path", nargs="?", default="")
+    ap.add_argument("--self-test", action="store_true")
+    ap.add_argument("--sigterm-child", metavar="DIR", default="",
+                    help=argparse.SUPPRESS)  # internal: self-test crash half
+    args = ap.parse_args()
+    if args.sigterm_child:
+        return _sigterm_child(args.sigterm_child)
+    if args.self_test:
+        return self_test()
+    path = args.path
+    if not path:
+        from paddle_tpu.flags import GLOBAL_FLAGS
+        path = GLOBAL_FLAGS.get("trace_dir") or "/tmp/pt_trace"
+    return report(path)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
