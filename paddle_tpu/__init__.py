@@ -34,7 +34,8 @@ from .ops import sparse
 from .tensor import Tensor, to_tensor
 
 from . import amp, data, datasets, distribution, hapi, inference, io, \
-    jit, layers, metric, nets, nn, observability, optimizer, reader
+    jit, layers, metric, nets, nn, observability, optimizer, preemption, \
+    reader, testing
 from . import utils, vision  # noqa: F401
 from . import parallel
 from . import static

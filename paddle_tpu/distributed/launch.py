@@ -18,14 +18,29 @@ tears the job down when any child dies). Differences by design:
 from __future__ import annotations
 
 import os
+import random
 import signal
 import subprocess
 import sys
 import time
+from collections import deque
 from typing import Dict, List, Optional, Sequence
 
 __all__ = ["launch_procs", "launch_elastic", "terminate_local_procs",
-           "get_cluster_env", "spawn"]
+           "get_cluster_env", "classify_exit", "spawn"]
+
+
+def classify_exit(code: int) -> str:
+    """Exit-code triage for the restart policy: ``clean`` (0),
+    ``preempt`` (died by SIGTERM — the scheduler's preemption signal,
+    re-raised by preemption.guard after the graceful checkpoint), or
+    ``crash`` (anything else). Accepts both Popen's negative-signal
+    convention and the shell's 128+N."""
+    if code == 0:
+        return "clean"
+    if code == -int(signal.SIGTERM) or code == 128 + int(signal.SIGTERM):
+        return "preempt"
+    return "crash"
 
 
 def get_cluster_env(rank: int, world: int, cp_endpoint: str) \
@@ -98,11 +113,17 @@ def launch_procs(cmd: Sequence[str], nproc: int,
 def launch_elastic(cmd: Sequence[str], nproc: int,
                    max_restarts: int = 3,
                    env_extra: Optional[Dict[str, str]] = None,
-                   poll_interval: float = 0.5) -> int:
+                   poll_interval: float = 0.5,
+                   backoff_s: float = 0.0,
+                   backoff_max_s: float = 30.0,
+                   restart_budget: int = 0,
+                   restart_window_s: float = 60.0,
+                   start_control_plane: bool = True) -> int:
     """Gang-restart orchestration: when any worker dies, the whole job
     is torn down (launch_procs's watch loop) and relaunched, up to
     ``max_restarts`` times. Training scripts resume from their last
-    checkpoint via incubate.TrainEpochRange / io.AsyncCheckpointer.
+    checkpoint via incubate.TrainEpochRange / io.AsyncCheckpointer /
+    hapi.Model.fit(ckpt_dir=).
 
     This is the half the reference never implemented — its watch loop
     only detects child exit and tears down
@@ -111,43 +132,85 @@ def launch_elastic(cmd: Sequence[str], nproc: int,
     a stub, distributed_strategy.proto:105). Restart counter rides in
     PT_ELASTIC_ATTEMPT; each attempt gets a fresh control plane.
 
+    Restart policy (docs/fault_tolerance.md): exits are classified by
+    :func:`classify_exit`. A *preemption* (SIGTERM death — the worker
+    already checkpointed via preemption.guard) respawns immediately and
+    never burns the failure budget. A *crash* backs off exponentially
+    from ``backoff_s`` (doubling per consecutive crash, capped at
+    ``backoff_max_s``, +0-25% jitter so gangs don't thunder) and is
+    charged against the failure budget: more than ``restart_budget``
+    crashes inside the sliding ``restart_window_s`` window aborts the
+    job immediately (``elastic_budget_exhausted_total``) — a
+    deterministic crash-loop fails fast instead of burning
+    ``max_restarts`` on one bad step. ``restart_budget=0`` disables the
+    budget; ``backoff_s=0`` disables backoff.
+
     Goodput accounting: the launcher counts restarts
-    (``elastic_restarts_total``) and hands each relaunched gang the
-    cumulative teardown-to-respawn dead time via ``PT_RESTART_IDLE_S``
-    — the child's goodput ledger seeds its ``restart_idle`` bucket
-    from it (plus its own import-to-resume time, anchored by
-    PT_ELASTIC_ATTEMPT > 0), so /goodput on a restarted worker shows
-    what the crash actually cost.
+    (``elastic_restarts_total``, labeled by exit kind) and hands each
+    relaunched gang the cumulative teardown-to-respawn dead time
+    (backoff included) via ``PT_RESTART_IDLE_S`` — the child's goodput
+    ledger seeds its ``restart_idle`` bucket from it (plus its own
+    import-to-resume time, anchored by PT_ELASTIC_ATTEMPT > 0), so
+    /goodput on a restarted worker shows what the crash actually cost.
     """
     from ..observability import flight as _flight
     from ..observability import metrics as _metrics
 
-    code = 0
+    attempt = 0
     idle_s = 0.0
-    for attempt in range(max_restarts + 1):
+    consecutive_crashes = 0
+    crash_times: deque = deque()
+    while True:
         env = dict(env_extra or {})
         env["PT_ELASTIC_ATTEMPT"] = str(attempt)
         env["PT_RESTART_IDLE_S"] = f"{idle_s:.3f}"
         code = launch_procs(cmd, nproc, env_extra=env,
-                            poll_interval=poll_interval)
+                            poll_interval=poll_interval,
+                            start_control_plane=start_control_plane)
         if code == 0:
             return 0
         t_dead = time.time()
+        kind = classify_exit(code)
         _metrics.counter(
             "elastic_restarts_total",
             "gang restarts performed by launch_elastic after a worker "
-            "failure", always=True).inc()
+            "failure (kind: preempt | crash)", always=True).inc(kind=kind)
         _flight.record("elastic_restart", force=True, attempt=attempt,
-                       exit_code=code)
-        if attempt < max_restarts:
-            print(f"[launch] job failed rc={code}; gang restart "
-                  f"{attempt + 1}/{max_restarts}", file=sys.stderr,
-                  flush=True)
-        # respawn is immediate, so the measured gap is small — but the
-        # mechanism is what matters: schedulers that add backoff (or a
-        # slow control-plane re-bootstrap) surface here automatically
+                       exit_code=code, exit_kind=kind)
+        if attempt >= max_restarts:
+            return code
+        if kind == "crash":
+            now = time.time()
+            crash_times.append(now)
+            while crash_times and now - crash_times[0] > restart_window_s:
+                crash_times.popleft()
+            if restart_budget > 0 and len(crash_times) > restart_budget:
+                _metrics.counter(
+                    "elastic_budget_exhausted_total",
+                    "jobs aborted by launch_elastic's sliding-window "
+                    "failure budget (crash-loop fail-fast)",
+                    always=True).inc()
+                _flight.record("elastic_budget_exhausted", force=True,
+                               crashes=len(crash_times),
+                               window_s=restart_window_s)
+                print(f"[launch] {len(crash_times)} crashes within "
+                      f"{restart_window_s:.0f}s exceed the restart "
+                      f"budget ({restart_budget}); giving up rc={code}",
+                      file=sys.stderr, flush=True)
+                return code
+            consecutive_crashes += 1
+            if backoff_s > 0:
+                delay = min(backoff_max_s,
+                            backoff_s * 2 ** (consecutive_crashes - 1))
+                delay *= 1.0 + random.uniform(0.0, 0.25)
+                time.sleep(delay)
+        else:  # preemption: the worker already checkpointed — respawn
+            consecutive_crashes = 0
+        print(f"[launch] job {'preempted' if kind == 'preempt' else 'failed'}"
+              f" rc={code}; gang restart {attempt + 1}/{max_restarts}",
+              file=sys.stderr, flush=True)
         idle_s += time.time() - t_dead
-    return code
+        attempt += 1
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -160,13 +223,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--elastic", type=int, default=0, metavar="R",
                         help="gang-restart the job up to R times on "
                              "worker failure (resume via checkpoints)")
+    parser.add_argument("--backoff", type=float, default=0.0,
+                        metavar="S",
+                        help="initial crash-restart backoff in seconds "
+                             "(doubles per consecutive crash, capped, "
+                             "jittered; 0 = immediate respawn)")
+    parser.add_argument("--restart-budget", type=int, default=0,
+                        metavar="R",
+                        help="abort when more than R crash-restarts "
+                             "fall inside the sliding window "
+                             "(0 = no budget)")
+    parser.add_argument("--restart-window", type=float, default=60.0,
+                        metavar="S",
+                        help="sliding window (seconds) for "
+                             "--restart-budget")
     parser.add_argument("script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     cmd = [sys.executable, args.script] + list(args.script_args)
     if args.elastic > 0:
         return launch_elastic(cmd, args.nproc,
-                              max_restarts=args.elastic)
+                              max_restarts=args.elastic,
+                              backoff_s=args.backoff,
+                              restart_budget=args.restart_budget,
+                              restart_window_s=args.restart_window)
     return launch_procs(cmd, args.nproc)
 
 
@@ -224,9 +304,18 @@ def spawn(func, args=(), nprocs: int = 1, join: bool = True,
                     f"spawn: workers still running after {timeout}s")
             time.sleep(0.1)
     finally:
+        # terminate AND join: terminate() alone leaves zombies (the
+        # exit status is never reaped) — mirror terminate_local_procs'
+        # bounded grace period, escalating to SIGKILL
         for p in procs:
             if p.is_alive():
                 p.terminate()
+        grace_deadline = time.time() + 5.0
+        for p in procs:
+            p.join(max(0.0, grace_deadline - time.time()))
+            if p.is_alive():
+                p.kill()
+                p.join(1.0)
         if server is not None:
             server.stop()
 

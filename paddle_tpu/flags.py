@@ -366,6 +366,43 @@ define_flag("trace_dir", "",
             "(metrics.json) under this directory at train end; "
             "tools/trace_report.py reads it. (ref: chrome-trace "
             "profiler output path, profiler.h:208.)")
+define_flag("checkpoint_verify", True,
+            "Verify checkpoint integrity on load: require the COMMIT "
+            "marker and check each leaf's recorded CRC32 before "
+            "deserializing (io.load / AsyncCheckpointer.restore). Off "
+            "skips the CRC pass (size and existence checks stay on — "
+            "they are free). Corrupt or uncommitted checkpoints are "
+            "skipped by restore with a fallback to the newest intact "
+            "one, counted in checkpoint_corrupt_total.")
+define_flag("serving_queue_deadline_ms", 0,
+            "Inference server load shedding: a queued request older "
+            "than this many milliseconds when the batcher picks it up "
+            "is answered with an error instead of being served "
+            "(counted in requests_shed_total and the native "
+            "serving.shed_total stat). 0 (default) disables shedding. "
+            "Age is measured from when the server first dequeues the "
+            "request off the native transport.")
+
+
+def _fault_spec_changed(value) -> None:
+    # (re)arm the chaos-injection registry; lazy import mirrors
+    # _enable_metrics_changed (testing.faults imports this module)
+    from .testing import faults as _faults
+    _faults.configure(value or None)
+
+
+define_flag("fault_spec", "",
+            "Deterministic chaos-injection spec "
+            "(paddle_tpu.testing.faults; grammar in "
+            "docs/fault_tolerance.md). Comma-separated entries "
+            "'point[:key=value]...', e.g. "
+            "'ckpt_write:p=1:at=2,sigterm:step=7,loader:exc=OSError'. "
+            "Injection points: ckpt_write (checkpoint writer, per "
+            "leaf), loader (fit data fetch), train_step (before each "
+            "dispatch), sigterm (self-delivers SIGTERM). Empty "
+            "(default) disarms every point — the hit() hook is a "
+            "near-free early return. Used by tools/chaos_drill.py.",
+            on_change=_fault_spec_changed)
 define_flag("recompile_warn_threshold", 8,
             "Warn (once per function) when one jit entry point has "
             "been traced for at least this many distinct input "

@@ -17,7 +17,9 @@ import numpy as np
 
 from . import io as io_mod
 from . import observability as _obs
+from . import preemption as _preempt
 from .flags import GLOBAL_FLAGS
+from .testing import faults as _faults
 from .metric import Metric
 from .nn.layer import Layer
 from .optimizer import Optimizer
@@ -129,6 +131,21 @@ class LRSchedulerCallback(Callback):
                 sched.step(float(val))
 
 
+_CKPT_KEYS = ("params", "buffers", "opt")
+
+
+def _ckpt_state_of(step) -> Optional[Dict]:
+    """The checkpointable slice of a train step's state: params,
+    buffers, optimizer slots. The RNG key is deliberately excluded —
+    key arrays are backend-specific (FLAGS_use_fast_rng) and a resumed
+    run restarting its dropout stream is harmless."""
+    state = getattr(step, "state", None)
+    if not isinstance(state, dict) \
+            or not all(k in state for k in _CKPT_KEYS):
+        return None
+    return {k: state[k] for k in _CKPT_KEYS}
+
+
 def _as_metric_list(metrics) -> List[Metric]:
     if metrics is None:
         return []
@@ -230,8 +247,21 @@ class Model:
 
     def fit(self, train_loader, eval_loader=None, epochs: int = 1,
             callbacks: Optional[List[Callback]] = None,
-            verbose: int = 1, log_freq: int = 10) -> Dict[str, List[float]]:
-        """Train; returns per-epoch history {metric: [v_epoch0, ...]}."""
+            verbose: int = 1, log_freq: int = 10,
+            ckpt_dir: Optional[str] = None, save_steps: int = 0,
+            ckpt_max_to_keep: int = 3) -> Dict[str, List[float]]:
+        """Train; returns per-epoch history {metric: [v_epoch0, ...]}.
+
+        With ``ckpt_dir=`` fit becomes fault-tolerant at STEP
+        granularity (docs/fault_tolerance.md): an ``io.AsyncCheckpointer``
+        saves params/buffers/optimizer state every ``save_steps`` steps
+        (plus once at the end), and a fresh fit over the same directory
+        auto-resumes — the newest intact checkpoint is restored and the
+        data stream fast-forwarded past the completed steps. SIGTERM
+        (scheduler preemption) is caught by a preemption guard: the
+        in-flight step finishes, a final synchronous checkpoint is
+        forced at the preempted step, and the signal is re-raised so
+        the process still dies with the SIGTERM wait status."""
         callbacks = list(callbacks or [])
         if verbose:
             callbacks.append(ProgBarLogger(log_freq, verbose))
@@ -252,11 +282,33 @@ class Model:
         if self._train_step is not None:
             # weights may have been set_value'd/loaded since the last fit
             self._train_step.reset_from_model()
+        # graceful preemption: SIGTERM only sets a flag here; the loop
+        # finishes the current step, checkpoints, then re-raises
+        guard = _preempt.guard()
+        guard.__enter__()
+        preempted = False
         self._fitting = True
         try:
             for cb in callbacks:
                 cb.on_train_begin()
             step = self._get_train_step()
+            ckptr = None
+            resume_step = 0
+            if ckpt_dir:
+                target = _ckpt_state_of(step)
+                if target is None:
+                    raise ValueError(
+                        "fit(ckpt_dir=...) needs a train step exposing "
+                        "state{params, buffers, opt} (got "
+                        f"{type(step).__name__})")
+                ckptr = io_mod.AsyncCheckpointer(
+                    ckpt_dir, max_to_keep=ckpt_max_to_keep)
+                restored, at = ckptr.restore_latest(target=target)
+                if restored is not None:
+                    step.state.update(restored)
+                    resume_step = int(at or 0)
+                    _obs.flight.record("fit_resume", force=True,
+                                       step=resume_step)
             straggler = None
             if _obs.enabled():
                 mesh = getattr(step, "mesh", None)
@@ -310,6 +362,8 @@ class Model:
                 batches = iter(train_loader)
                 i = -1
                 while True:
+                    if _faults.active() and global_step >= resume_step:
+                        _faults.hit("loader", step=global_step)
                     if obs_on:
                         # goodput ledger: blocking on the pipeline is
                         # data_wait badput, split out from the step
@@ -323,6 +377,15 @@ class Model:
                                          time.perf_counter() - t_wait)
                     i += 1
                     *inputs, label = batch
+                    if global_step < resume_step:
+                        # auto-resume fast-forward: replay the data
+                        # stream past the restored step without running
+                        # compute, metrics, or callbacks
+                        global_step += 1
+                        continue
+                    if _faults.active():
+                        _faults.hit("train_step", step=global_step)
+                        _faults.hit("sigterm", step=global_step)
                     if obs_on:
                         compile_before = _obs.goodput.compile_seconds_total()
                         t0 = time.perf_counter()
@@ -343,7 +406,6 @@ class Model:
                         _obs.flight.record("step", epoch=epoch, step=i)
                         if straggler is not None:
                             straggler.observe(global_step, dt)
-                        global_step += 1
                         step_hist.observe(dt)
                         items = int(np.shape(label)[0]) \
                             if np.ndim(label) else 1
@@ -371,6 +433,20 @@ class Model:
                     count += 1
                     for cb in callbacks:
                         cb.on_batch_end(i, metrics)
+                    global_step += 1
+                    if ckptr is not None and save_steps > 0 \
+                            and global_step % save_steps == 0:
+                        ckptr.save(_ckpt_state_of(step),
+                                   step=global_step)
+                        _obs.flight.record("checkpoint_save",
+                                           step=global_step)
+                    if guard.preempted:
+                        # finish-the-step done; leave both loops and
+                        # take the final-checkpoint path below
+                        preempted = True
+                        break
+                if preempted:
+                    break
                 logs = {k: float(v) / max(count, 1)
                         for k, v in totals.items()}
                 if eval_loader is not None:
@@ -385,8 +461,32 @@ class Model:
                 if any(getattr(cb, "stop_training", False)
                        for cb in callbacks):
                     break
+            if preempted:
+                _obs.flight.record("preempted", force=True,
+                                   step=global_step)
+                if ckptr is not None:
+                    # final SYNCHRONOUS checkpoint — the point of the
+                    # graceful path: resume from the step the
+                    # preemption landed on, not the last save interval
+                    try:
+                        ckptr.save(_ckpt_state_of(step),
+                                   step=global_step)
+                        ckptr.wait()
+                        _obs.flight.record("preempt_checkpoint",
+                                           force=True, step=global_step)
+                    except Exception as e:  # noqa: BLE001
+                        _obs.flight.record("preempt_checkpoint_failed",
+                                           force=True, step=global_step,
+                                           error=str(e)[:300])
+                guard.reraise()  # dies with SIGTERM wait status
             for cb in callbacks:
                 cb.on_train_end()
+            if ckptr is not None:
+                # make the end state durable before fit returns; skip
+                # the save when the cadence just wrote this exact step
+                if save_steps <= 0 or global_step % save_steps != 0:
+                    ckptr.save(_ckpt_state_of(step), step=global_step)
+                ckptr.wait()
             if _obs.enabled():
                 _obs.flight.record("fit_end", steps_run=global_step)
                 ledger.stop()
@@ -396,6 +496,7 @@ class Model:
                     # tools/trace_report.py and tools/goodput_report.py
                     _obs.export_all()
         finally:
+            guard.__exit__(None, None, None)
             self._fitting = False
             if ledger.running():  # interrupted fit: close the books
                 ledger.stop()

@@ -25,6 +25,7 @@ import jax
 import numpy as np
 
 from .. import io as io_mod
+from .. import preemption as _preempt
 from ..observability import flight as _flight
 
 __all__ = ["TrainEpochRange", "train_epoch_range"]
@@ -58,11 +59,13 @@ class TrainEpochRange:
         self._setters: Dict[str, Callable[[Any], None]] = {}
         self._start_epoch = 0
         self._restored_state: Optional[Dict[str, Any]] = None
-        latest = self._ckpt.latest_step()
-        if latest is not None:
-            self._restored_state = self._ckpt.restore()
-            self._start_epoch = latest
-            _flight.record("checkpoint_restore", name=name, epoch=latest)
+        # restore_latest skips corrupt/uncommitted checkpoints and
+        # falls back to the newest intact one — _start_epoch must track
+        # the checkpoint actually restored, not the newest on disk
+        self._restored_state, at = self._ckpt.restore_latest()
+        if self._restored_state is not None:
+            self._start_epoch = int(at)
+            _flight.record("checkpoint_restore", name=name, epoch=at)
         self.restored = self._restored_state is not None
 
     def register(self, key: str, getter: Callable[[], Any],
@@ -79,17 +82,34 @@ class TrainEpochRange:
                 if sub:
                     setter(sub)
 
+    def _save(self, step: int) -> None:
+        state = {k: g() for k, g in self._getters.items()}
+        self._ckpt.save(state, step=step)
+        _flight.record("checkpoint_save", name=self.name, epoch=step)
+
     def get(self) -> Iterator[int]:
-        """The epoch iterator (ref: TrainEpochRange.get :265)."""
-        for epoch in range(self._start_epoch, self.max_epoch):
-            yield epoch
-            if (epoch + 1) % self.save_interval == 0 or \
-                    epoch + 1 == self.max_epoch:
-                state = {k: g() for k, g in self._getters.items()}
-                self._ckpt.save(state, step=epoch + 1)
-                _flight.record("checkpoint_save", name=self.name,
-                               epoch=epoch + 1)
-        self._ckpt.wait()
+        """The epoch iterator (ref: TrainEpochRange.get :265).
+
+        SIGTERM (scheduler preemption) is handled gracefully: the
+        in-flight epoch finishes, an off-interval checkpoint is forced
+        and flushed, and the signal is re-raised (preemption.guard) —
+        the restarted job resumes from the preempted epoch."""
+        with _preempt.guard() as guard:
+            for epoch in range(self._start_epoch, self.max_epoch):
+                yield epoch
+                saved = False
+                if (epoch + 1) % self.save_interval == 0 or \
+                        epoch + 1 == self.max_epoch:
+                    self._save(epoch + 1)
+                    saved = True
+                if guard.preempted:
+                    if not saved:
+                        self._save(epoch + 1)
+                    self._ckpt.wait()
+                    _flight.record("preempt_checkpoint", force=True,
+                                   name=self.name, epoch=epoch + 1)
+                    guard.reraise()
+            self._ckpt.wait()
 
     def __iter__(self) -> Iterator[int]:
         return self.get()

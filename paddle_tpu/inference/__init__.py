@@ -27,6 +27,7 @@ lives in csrc/serving.cc; :class:`Server` here is the compute half.
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import socket
@@ -361,12 +362,17 @@ class Server:
     def __init__(self, predictor: Predictor, port: int = 0,
                  max_batch: int = 32, wait_ms: int = 2,
                  queue_cap: int = 512, max_payload: int = 64 << 20,
-                 stats_interval_s: float = 1.0):
+                 stats_interval_s: float = 1.0,
+                 queue_deadline_ms: Optional[int] = None):
         from ..native import ServingTransport
 
         self.predictor = predictor
         self.max_batch = max_batch
         self.wait_ms = wait_ms
+        # load shedding: requests older than this when the batcher
+        # picks them up are error-replied, not served (None → the
+        # FLAGS_serving_queue_deadline_ms flag; 0 disables)
+        self.queue_deadline_ms = queue_deadline_ms
         self.transport = ServingTransport(port=port, queue_cap=queue_cap,
                                           max_payload=max_payload)
         self.port = self.transport.port
@@ -375,6 +381,11 @@ class Server:
         self.n_batches = 0
         self.n_requests = 0
         self.n_errors = 0
+        self.n_shed = 0
+        # arrival-stamped staging queue: requests are drained off the
+        # native transport eagerly so their queue age is measurable
+        # (the native queue carries no enqueue timestamps)
+        self._rq: collections.deque = collections.deque()
         self._thread.start()
         # live observability: flag-gated HTTP exporter + a bridge thread
         # that scrapes the native transport's stats into the metrics
@@ -425,18 +436,78 @@ class Server:
                       ).set(stats["uptime_ms"] / 1e3)
         return stats
 
+    def _queue_deadline_s(self) -> float:
+        v = self.queue_deadline_ms
+        if v is None:
+            try:
+                from ..flags import GLOBAL_FLAGS
+                v = GLOBAL_FLAGS.get("serving_queue_deadline_ms")
+            except Exception:  # noqa: BLE001
+                v = 0
+        return max(0, int(v or 0)) / 1e3
+
+    def _drain_transport(self) -> None:
+        while True:
+            r = self.transport.next_request(timeout_ms=0)
+            if r is None:
+                return
+            self._rq.append((time.perf_counter(), r[0], r[1]))
+
+    def _next_request(self, timeout_ms: int):
+        """The batcher's Next() path: stamped staging queue first, then
+        the native transport. Requests whose queue age exceeds the
+        deadline are shed here — counted, never silently dropped."""
+        self._drain_transport()
+        if not self._rq:
+            r = self.transport.next_request(timeout_ms=timeout_ms)
+            if r is None:
+                return None
+            self._rq.append((time.perf_counter(), r[0], r[1]))
+        ddl = self._queue_deadline_s()
+        while self._rq:
+            ts, rid, payload = self._rq.popleft()
+            age = time.perf_counter() - ts
+            if ddl > 0 and age > ddl:
+                self._shed(rid, age, ddl)
+                continue
+            return rid, payload
+        return None
+
+    def _shed(self, rid: int, age_s: float, deadline_s: float) -> None:
+        self.n_shed += 1
+        try:
+            self.transport.reply(
+                rid,
+                f"request shed: queued {age_s * 1e3:.0f}ms > queue "
+                f"deadline {deadline_s * 1e3:.0f}ms".encode(),
+                status=-1)
+        except Exception:  # noqa: BLE001 — client may already be gone
+            pass
+        try:
+            from ..native import stat_add
+            stat_add("serving.shed_total")
+        except Exception:  # noqa: BLE001
+            pass
+        from .. import observability as obs
+        if obs.enabled():
+            obs.counter("requests_shed_total",
+                        "requests answered with an error because they "
+                        "sat in the serving queue longer than the "
+                        "queue deadline").inc()
+
     def _loop(self) -> None:
         while not self._stop.is_set():
-            first = self.transport.next_request(timeout_ms=100)
+            first = self._next_request(timeout_ms=100)
             if first is None:
                 continue
             group = [first]
             deadline = time.perf_counter() + self.wait_ms / 1e3
             while len(group) < self.max_batch:
                 left = deadline - time.perf_counter()
-                if left <= 0 and self.transport.pending() == 0:
+                if left <= 0 and self.transport.pending() == 0 \
+                        and not self._rq:
                     break
-                nxt = self.transport.next_request(
+                nxt = self._next_request(
                     timeout_ms=max(1, int(left * 1e3)))
                 if nxt is None:
                     break
@@ -539,100 +610,272 @@ class Server:
 
 class Client:
     """Socket client of the native serving protocol (tests and the
-    reference's demo_ci role). Thread-safe; supports pipelining."""
+    reference's demo_ci role). Thread-safe; supports pipelining.
+
+    Resilience (docs/fault_tolerance.md):
+
+    - **Per-call deadlines** — ``deadline_s`` (constructor default or
+      per ``infer``/``stats`` call) bounds the whole round trip;
+      expiry raises ``TimeoutError``. A deadline that fires mid-frame
+      poisons the connection (the stream position is lost), which the
+      next call repairs by reconnecting.
+    - **Bounded reconnect with backoff** — a ``ConnectionError`` while
+      *sending* triggers up to ``max_reconnects`` reconnect attempts
+      (exponential backoff from ``reconnect_backoff_s``) and a resend:
+      nothing reached the server, so the retry is safe for any call.
+    - **Idempotent STATS retry** — ``stats()`` additionally retries the
+      whole round trip when the connection dies while *waiting*: a
+      stats read has no side effects. ``infer()`` deliberately does
+      not (the server may have executed the request); it reconnects
+      the transport for subsequent calls and raises.
+    """
 
     _MAGIC = 0x56535450       # 'PTSV' tensor request
     _MAGIC_CTL = 0x43535450   # 'PTSC' control frame
     _OP_STATS = 1
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 timeout_s: float = 30.0):
-        self._sock = socket.create_connection((host, port),
-                                              timeout=timeout_s)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                 timeout_s: float = 30.0,
+                 deadline_s: Optional[float] = None,
+                 max_reconnects: int = 2,
+                 reconnect_backoff_s: float = 0.05):
+        self._host = host
+        self._port = port
+        self._timeout_s = timeout_s
+        self._deadline_s = deadline_s
+        self._max_reconnects = int(max_reconnects)
+        self._reconnect_backoff_s = float(reconnect_backoff_s)
         self._wlock = threading.Lock()
         self._rlock = threading.Lock()
+        self._conn_lock = threading.Lock()
         self._tag = 0
         self._replies: Dict[int, Tuple[int, bytes]] = {}
         self._rcond = threading.Condition()
+        self._sock: Optional[socket.socket] = None
+        self._gen = 0
+        self._connect()
 
-    def infer(self, arrays: Sequence[np.ndarray]) -> List[np.ndarray]:
-        tag = self._send(arrays)
-        status, payload = self._recv(tag)
-        if status != 0:
-            raise RuntimeError(f"server error: {payload.decode()!r}")
-        return decode_tensors(payload)
+    # -- connection management -------------------------------------------
 
-    def stats(self) -> Dict[str, int]:
+    def _connect(self) -> None:
+        sock = socket.create_connection((self._host, self._port),
+                                        timeout=self._timeout_s)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with self._rcond:
+            self._sock = sock
+            self._gen += 1
+            # tags from the old connection can never be answered
+            self._replies.clear()
+            self._rcond.notify_all()
+
+    def _poison(self, gen: int) -> None:
+        """Mark connection ``gen`` dead: waiters raise instead of
+        hanging; the next call reconnects."""
+        with self._rcond:
+            if self._gen != gen:
+                return  # already superseded
+            sock, self._sock = self._sock, None
+            self._rcond.notify_all()
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _reconnect_with_backoff(self, attempts: int, gen: int,
+                                deadline: Optional[float]) -> int:
+        """One bounded retry step; returns the new attempt count or
+        raises the terminal error."""
+        if attempts >= self._max_reconnects:
+            raise ConnectionError(
+                f"server unreachable after {attempts} reconnect "
+                f"attempts ({self._host}:{self._port})")
+        delay = self._reconnect_backoff_s * (2 ** attempts)
+        if deadline is not None:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise TimeoutError("deadline exceeded while reconnecting")
+            delay = min(delay, left)
+        time.sleep(delay)
+        with self._conn_lock:
+            with self._rcond:
+                stale = self._sock is None or self._gen == gen
+            if stale:
+                try:
+                    self._connect()
+                except OSError as e:
+                    self._poison(self._gen)
+                    if attempts + 1 >= self._max_reconnects:
+                        raise ConnectionError(
+                            f"reconnect to {self._host}:{self._port} "
+                            f"failed: {e}") from e
+        return attempts + 1
+
+    def _deadline_of(self, deadline_s: Optional[float]
+                     ) -> Optional[float]:
+        eff = deadline_s if deadline_s is not None else self._deadline_s
+        return None if eff is None else time.monotonic() + float(eff)
+
+    # -- public API -------------------------------------------------------
+
+    def infer(self, arrays: Sequence[np.ndarray],
+              deadline_s: Optional[float] = None) -> List[np.ndarray]:
+        deadline = self._deadline_of(deadline_s)
+        attempts = 0
+        while True:
+            with self._rcond:
+                gen = self._gen
+            try:
+                tag = self._send(arrays)
+            except (ConnectionError, OSError) as e:
+                # nothing reached the server: reconnect and resend
+                self._poison(gen)
+                if isinstance(e, socket.timeout):
+                    raise TimeoutError(f"send timed out: {e}") from e
+                attempts = self._reconnect_with_backoff(
+                    attempts, gen, deadline)
+                continue
+            try:
+                status, payload = self._recv(tag, gen, deadline)
+            except ConnectionError:
+                # the request may have executed server-side — repair
+                # the transport for later calls, but surface the error
+                try:
+                    self._reconnect_with_backoff(
+                        max(0, self._max_reconnects - 1), gen, deadline)
+                except (ConnectionError, TimeoutError):
+                    pass
+                raise
+            if status != 0:
+                raise RuntimeError(f"server error: {payload.decode()!r}")
+            return decode_tensors(payload)
+
+    def stats(self, deadline_s: Optional[float] = None) -> Dict[str, int]:
         """STATS control round trip: queue depth, in-flight count,
         accepted/served/error totals, batch-size buckets, uptime —
         parsed from the server's "key=value" reply
-        (docs/serving_protocol.md, STATS control frames)."""
-        with self._wlock:
-            self._tag += 1
-            tag = self._tag
-            hdr = struct.pack("<IQI", self._MAGIC_CTL, tag, 4)
-            self._sock.sendall(hdr + struct.pack("<I", self._OP_STATS))
-        status, payload = self._recv(tag)
-        if status != 0:
-            raise RuntimeError(f"stats error: {payload.decode()!r}")
-        out: Dict[str, int] = {}
-        for line in payload.decode().splitlines():
-            if "=" in line:
-                k, v = line.rsplit("=", 1)
-                try:
-                    out[k] = int(v)
-                except ValueError:
-                    pass
-        return out
+        (docs/serving_protocol.md, STATS control frames). Idempotent:
+        retried across reconnects."""
+        deadline = self._deadline_of(deadline_s)
+        attempts = 0
+        while True:
+            with self._rcond:
+                gen = self._gen
+            try:
+                tag = self._send_frame(
+                    self._MAGIC_CTL, struct.pack("<I", self._OP_STATS))
+                status, payload = self._recv(tag, gen, deadline)
+            except (ConnectionError, OSError) as e:
+                self._poison(gen)
+                if isinstance(e, socket.timeout):
+                    raise TimeoutError(f"stats timed out: {e}") from e
+                attempts = self._reconnect_with_backoff(
+                    attempts, gen, deadline)
+                continue
+            if status != 0:
+                raise RuntimeError(f"stats error: {payload.decode()!r}")
+            out: Dict[str, int] = {}
+            for line in payload.decode().splitlines():
+                if "=" in line:
+                    k, v = line.rsplit("=", 1)
+                    try:
+                        out[k] = int(v)
+                    except ValueError:
+                        pass
+            return out
 
-    def _send(self, arrays) -> int:
-        payload = encode_tensors(arrays)
+    # -- wire -------------------------------------------------------------
+
+    def _send(self, arrays: Sequence[np.ndarray]) -> int:
+        """Encode + send one tensor request; returns its tag."""
+        return self._send_frame(self._MAGIC, encode_tensors(arrays))
+
+    def _send_frame(self, magic: int, payload: bytes) -> int:
         with self._wlock:
+            with self._rcond:
+                sock = self._sock
+            if sock is None:
+                raise ConnectionError("not connected")
             self._tag += 1
             tag = self._tag
-            hdr = struct.pack("<IQI", self._MAGIC, tag, len(payload))
-            self._sock.sendall(hdr + payload)
+            hdr = struct.pack("<IQI", magic, tag, len(payload))
+            sock.sendall(hdr + payload)
         return tag
 
-    def _recv(self, want_tag: int) -> Tuple[int, bytes]:
+    def _recv(self, want_tag: int, gen: Optional[int] = None,
+              deadline: Optional[float] = None) -> Tuple[int, bytes]:
         # One thread at a time owns the socket read side (_rlock) and
         # parks frames for the others; non-owners wait on the condition.
+        if gen is None:
+            with self._rcond:
+                gen = self._gen
         while True:
             with self._rcond:
                 if want_tag in self._replies:
                     return self._replies.pop(want_tag)
+                if self._gen != gen or self._sock is None:
+                    raise ConnectionError("connection lost")
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    "deadline exceeded waiting for server reply")
             if not self._rlock.acquire(blocking=False):
                 with self._rcond:
                     if want_tag in self._replies:
                         return self._replies.pop(want_tag)
+                    if self._gen != gen or self._sock is None:
+                        raise ConnectionError("connection lost")
                     self._rcond.wait(timeout=0.05)
                 continue
             try:
                 with self._rcond:
                     if want_tag in self._replies:
                         return self._replies.pop(want_tag)
-                hdr = self._read_exact(8 + 8 + 4)
-                tag, status, n = struct.unpack("<QqI", hdr)
-                payload = self._read_exact(n) if n else b""
+                    if self._gen != gen or self._sock is None:
+                        raise ConnectionError("connection lost")
+                    sock = self._sock
+                try:
+                    if deadline is not None:
+                        sock.settimeout(max(
+                            0.001, min(self._timeout_s,
+                                       deadline - time.monotonic())))
+                    else:
+                        sock.settimeout(self._timeout_s)
+                    hdr = self._read_exact(sock, 8 + 8 + 4)
+                    tag, status, n = struct.unpack("<QqI", hdr)
+                    payload = self._read_exact(sock, n) if n else b""
+                except socket.timeout as e:
+                    # mid-frame timeout: the stream position is lost —
+                    # poison so other waiters don't read garbage
+                    self._poison(gen)
+                    raise TimeoutError(
+                        "deadline exceeded waiting for server reply"
+                    ) from e
+                except (ConnectionError, OSError) as e:
+                    self._poison(gen)
+                    raise ConnectionError(str(e)) from e
                 with self._rcond:
                     self._replies[tag] = (status, payload)
                     self._rcond.notify_all()
             finally:
                 self._rlock.release()
 
-    def _read_exact(self, n: int) -> bytes:
+    @staticmethod
+    def _read_exact(sock: socket.socket, n: int) -> bytes:
         buf = b""
         while len(buf) < n:
-            chunk = self._sock.recv(n - len(buf))
+            chunk = sock.recv(n - len(buf))
             if not chunk:
                 raise ConnectionError("server closed connection")
             buf += chunk
         return buf
 
     def close(self) -> None:
+        with self._rcond:
+            sock, self._sock = self._sock, None
+            self._rcond.notify_all()
         try:
-            self._sock.close()
+            if sock is not None:
+                sock.close()
         except Exception:
             pass
 

@@ -6,26 +6,44 @@ save_inference_model :52-57; C++ framework/save_load_util.cc tensor file
 format; dygraph/checkpoint.py state-dict save). Format here is a directory:
 
   checkpoint/
-    manifest.json        — names, shapes, dtypes, tree structure, step
+    manifest.json        — names, shapes, dtypes, per-leaf crc32+nbytes,
+                           tree structure, step
     data/<name>.npy      — one npy per leaf (host-sharded in multi-host)
+    COMMIT               — terminal marker, written LAST; carries the
+                           manifest's own crc32
 
 This keeps the reference's "inspectable per-variable files" property while
 being pytree-native. Async save (orbax-style) runs serialization on a
 background thread so the train loop isn't blocked — the reference's save is
 fully synchronous. Orbax itself is supported as an opt-in backend.
+
+Integrity (docs/fault_tolerance.md): a directory without COMMIT is an
+unfinished save and is never restored; :func:`load` re-checks each
+leaf's size and CRC32 before deserializing (opt-out:
+FLAGS_checkpoint_verify); :func:`verify` validates a directory without
+materializing any array; ``AsyncCheckpointer.restore`` falls back to
+the newest *intact* checkpoint, counting skips in
+``checkpoint_corrupt_total``.
 """
 
 from __future__ import annotations
 
 import contextlib
+import io as _pyio
 import json
 import os
 import shutil
 import threading
-from typing import Any, Dict, Optional
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
+
+try:  # chaos-injection hook (paddle_tpu.testing.faults, FLAGS_fault_spec)
+    from ..testing import faults as _faults
+except ImportError:  # pragma: no cover - partial installs
+    _faults = None
 
 
 def _ckpt_measure():
@@ -39,7 +57,50 @@ def _ckpt_measure():
         return contextlib.nullcontext()
 
 _SENTINEL_KEY = "__paddle_tpu_ckpt__"
-_VERSION = 1
+_VERSION = 2                    # v2 adds per-leaf crc32/nbytes + COMMIT
+_SUPPORTED_VERSIONS = (1, 2)    # v1 (pre-integrity) stays loadable
+_COMMIT_NAME = "COMMIT"
+
+
+def _verify_default() -> bool:
+    try:
+        from ..flags import GLOBAL_FLAGS
+        return bool(GLOBAL_FLAGS.get("checkpoint_verify"))
+    except Exception:  # flag registry unavailable (direct import)
+        return True
+
+
+def _note_corrupt(path: str, error: Any,
+                  step: Optional[int] = None) -> None:
+    """Count + flight-record a checkpoint skipped as corrupt or
+    uncommitted. Telemetry must never break a restore."""
+    try:
+        from ..observability import flight as _flight
+        from ..observability import metrics as _metrics
+        _metrics.counter(
+            "checkpoint_corrupt_total",
+            "checkpoints skipped at restore time because they were "
+            "corrupt or uncommitted (restore fell back to the newest "
+            "intact one)", always=True).inc()
+        _flight.record("checkpoint_corrupt", force=True, path=str(path),
+                       step=step, error=str(error)[:300])
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _note_save_failure(step: Optional[int], error: BaseException) -> None:
+    try:
+        from ..observability import flight as _flight
+        from ..observability import metrics as _metrics
+        _metrics.counter(
+            "checkpoint_failures_total",
+            "checkpoint saves that raised (background writer failures "
+            "are re-raised at the next save()/wait())",
+            always=True).inc()
+        _flight.record("checkpoint_write_failed", force=True, step=step,
+                       error=str(error)[:300])
+    except Exception:  # noqa: BLE001
+        pass
 
 
 def _flatten(state) -> Dict[str, np.ndarray]:
@@ -86,13 +147,7 @@ def save(state: Any, path: str, step: Optional[int] = None,
     os.makedirs(os.path.join(tmp, "data"), exist_ok=True)
     treedef = jax.tree.structure(state)
     flat = _flatten(state)
-    manifest = {
-        _SENTINEL_KEY: _VERSION,
-        "step": step,
-        "treedef": str(treedef),
-        "leaves": {k: {"shape": list(v.shape), "dtype": str(v.dtype)}
-                   for k, v in flat.items()},
-    }
+    leaves: Dict[str, Dict[str, Any]] = {}
     for k, v in flat.items():
         fname = k.replace("/", "__") + ".npy"
         arr = np.asarray(v)
@@ -103,9 +158,34 @@ def save(state: Any, path: str, step: Optional[int] = None,
         if (arr.dtype.kind in "Vf"
                 and str(arr.dtype) not in _BUILTIN_DTYPES):
             arr = arr.view(np.dtype(f"u{arr.dtype.itemsize}"))
-        np.save(os.path.join(tmp, "data", fname), arr)
-    with open(os.path.join(tmp, "manifest.json"), "w") as f:
-        json.dump(manifest, f, indent=1)
+        if _faults is not None:
+            _faults.hit("ckpt_write", step=step)
+        # serialize to memory first so the recorded CRC covers exactly
+        # the bytes that land on disk (one write, no read-back pass)
+        buf = _pyio.BytesIO()
+        np.save(buf, arr)
+        raw = buf.getvalue()
+        with open(os.path.join(tmp, "data", fname), "wb") as f:
+            f.write(raw)
+        leaves[k] = {"shape": list(v.shape), "dtype": str(v.dtype),
+                     "crc32": zlib.crc32(raw), "nbytes": len(raw)}
+    manifest = {
+        _SENTINEL_KEY: _VERSION,
+        "step": step,
+        "treedef": str(treedef),
+        "leaves": leaves,
+    }
+    mbytes = json.dumps(manifest, indent=1).encode()
+    with open(os.path.join(tmp, "manifest.json"), "wb") as f:
+        f.write(mbytes)
+    # COMMIT is written LAST: a directory without it is an unfinished
+    # save. The atomic os.replace below already guarantees that on
+    # POSIX; the marker extends the guarantee to filesystems without
+    # atomic rename (object-store mounts) and to readers that see the
+    # tmp dir mid-write.
+    with open(os.path.join(tmp, _COMMIT_NAME), "w") as f:
+        json.dump({"manifest_crc32": zlib.crc32(mbytes), "step": step,
+                   "n_leaves": len(leaves)}, f)
     if os.path.exists(path):
         if not overwrite:
             raise FileExistsError(path)
@@ -113,20 +193,83 @@ def save(state: Any, path: str, step: Optional[int] = None,
     os.replace(tmp, path)
 
 
-def load(path: str, target: Optional[Any] = None) -> Any:
+def _read_manifest(path: str) -> Dict[str, Any]:
+    try:
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+    except json.JSONDecodeError as e:
+        raise ValueError(
+            f"checkpoint {path!r}: manifest.json is corrupt ({e}) — "
+            f"run paddle_tpu.io.verify({path!r}) for a full report")
+    if manifest.get(_SENTINEL_KEY) not in _SUPPORTED_VERSIONS:
+        raise ValueError(f"{path} is not a paddle_tpu checkpoint")
+    return manifest
+
+
+def is_committed(path: str) -> bool:
+    """Cheap intact check: manifest parses and, for v2+ checkpoints,
+    the terminal COMMIT marker exists. No data files are touched."""
     path = os.path.normpath(path)
+    try:
+        manifest = _read_manifest(path)
+    except (OSError, ValueError):
+        return False
+    if manifest.get(_SENTINEL_KEY, 0) >= 2:
+        return os.path.exists(os.path.join(path, _COMMIT_NAME))
+    return True
+
+
+def load(path: str, target: Optional[Any] = None,
+         verify_integrity: Optional[bool] = None) -> Any:
     """Load a checkpoint. With ``target`` (a pytree of the same structure),
     leaves are restored into that structure; otherwise returns a flat
-    name→array dict."""
-    with open(os.path.join(path, "manifest.json")) as f:
-        manifest = json.load(f)
-    if manifest.get(_SENTINEL_KEY) != _VERSION:
-        raise ValueError(f"{path} is not a paddle_tpu checkpoint")
+    name→array dict.
+
+    ``verify_integrity`` (default: FLAGS_checkpoint_verify) re-checks
+    the COMMIT marker and each leaf's recorded CRC32 before
+    deserializing; missing or size-mismatched leaf files always raise
+    a descriptive ``ValueError`` (they cost nothing to detect)."""
+    path = os.path.normpath(path)
+    if verify_integrity is None:
+        verify_integrity = _verify_default()
+    manifest = _read_manifest(path)
+    version = manifest.get(_SENTINEL_KEY, 0)
+    if verify_integrity and version >= 2 \
+            and not os.path.exists(os.path.join(path, _COMMIT_NAME)):
+        raise ValueError(
+            f"checkpoint {path!r}: missing its COMMIT marker — the "
+            "save never completed; restore from an older checkpoint "
+            f"(run paddle_tpu.io.verify({path!r}) for a full report)")
     flat = {}
     for k, meta in manifest["leaves"].items():
         fname = k.replace("/", "__") + ".npy"
-        arr = np.load(os.path.join(path, "data", fname))
-        want = meta.get("dtype") if isinstance(meta, dict) else None
+        fpath = os.path.join(path, "data", fname)
+        meta_d = meta if isinstance(meta, dict) else {}
+        if not os.path.exists(fpath):
+            raise ValueError(
+                f"checkpoint {path!r}: leaf {k!r} is missing its data "
+                f"file ({fname}) — run paddle_tpu.io.verify({path!r}) "
+                "for a full report")
+        nbytes = meta_d.get("nbytes")
+        if nbytes is not None and os.path.getsize(fpath) != nbytes:
+            raise ValueError(
+                f"checkpoint {path!r}: leaf {k!r} is "
+                f"{os.path.getsize(fpath)} bytes on disk but the "
+                f"manifest records {nbytes} — truncated or corrupt; "
+                f"run paddle_tpu.io.verify({path!r}) for a full report")
+        crc = meta_d.get("crc32")
+        if verify_integrity and crc is not None:
+            with open(fpath, "rb") as f:
+                raw = f.read()
+            if zlib.crc32(raw) != crc:
+                raise ValueError(
+                    f"checkpoint {path!r}: leaf {k!r} fails its CRC32 "
+                    "check — corrupt data file; run "
+                    f"paddle_tpu.io.verify({path!r}) for a full report")
+            arr = np.load(_pyio.BytesIO(raw))
+        else:
+            arr = np.load(fpath)
+        want = meta_d.get("dtype")
         if want and str(arr.dtype) != want:
             if want not in _BUILTIN_DTYPES:
                 import ml_dtypes
@@ -154,6 +297,67 @@ def load_step(path: str) -> Optional[int]:
         return json.load(f).get("step")
 
 
+def verify(path: str) -> List[str]:
+    """Validate a checkpoint directory WITHOUT deserializing arrays.
+
+    Checks: manifest parses and carries the sentinel; v2+ directories
+    have the COMMIT marker and the manifest matches the CRC recorded in
+    it; every leaf's data file exists with the recorded size and CRC32
+    (bytes are read for the CRC, never parsed into arrays). Returns a
+    list of problem strings — empty means intact.
+    """
+    path = os.path.normpath(path)
+    problems: List[str] = []
+    try:
+        manifest = _read_manifest(path)
+    except FileNotFoundError:
+        return [f"{path}: manifest.json missing"]
+    except OSError as e:
+        return [f"{path}: manifest.json unreadable ({e})"]
+    except ValueError as e:
+        return [str(e)]
+    version = manifest.get(_SENTINEL_KEY, 0)
+    if version >= 2:
+        commit_path = os.path.join(path, _COMMIT_NAME)
+        if not os.path.exists(commit_path):
+            problems.append(
+                f"{path}: COMMIT marker missing (unfinished save)")
+        else:
+            try:
+                with open(commit_path) as f:
+                    commit = json.load(f)
+                with open(os.path.join(path, "manifest.json"),
+                          "rb") as f:
+                    mcrc = zlib.crc32(f.read())
+                want = commit.get("manifest_crc32")
+                if want is not None and want != mcrc:
+                    problems.append(
+                        f"{path}: manifest.json does not match the CRC "
+                        "recorded in COMMIT")
+            except (OSError, json.JSONDecodeError) as e:
+                problems.append(f"{path}: COMMIT unreadable ({e})")
+    for k, meta in manifest.get("leaves", {}).items():
+        fname = k.replace("/", "__") + ".npy"
+        fpath = os.path.join(path, "data", fname)
+        meta_d = meta if isinstance(meta, dict) else {}
+        if not os.path.exists(fpath):
+            problems.append(f"leaf {k!r}: data file missing ({fname})")
+            continue
+        nbytes = meta_d.get("nbytes")
+        if nbytes is not None and os.path.getsize(fpath) != nbytes:
+            problems.append(
+                f"leaf {k!r}: {os.path.getsize(fpath)} bytes on disk, "
+                f"manifest records {nbytes}")
+            continue
+        crc = meta_d.get("crc32")
+        if crc is not None:
+            with open(fpath, "rb") as f:
+                have = zlib.crc32(f.read())
+            if have != crc:
+                problems.append(f"leaf {k!r}: CRC32 mismatch")
+    return problems
+
+
 class AsyncCheckpointer:
     """Non-blocking save (ref capability: auto_checkpoint.py:71 —
     periodic job checkpointing; here additionally async)."""
@@ -162,7 +366,18 @@ class AsyncCheckpointer:
         self.directory = directory
         self.max_to_keep = max_to_keep
         self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
         os.makedirs(directory, exist_ok=True)
+
+    def _raise_pending(self) -> None:
+        """Surface a background-writer failure (satellite fix: an
+        exception in the daemon writer thread used to vanish)."""
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError(
+                f"background checkpoint save failed in {self.directory}:"
+                f" {err!r} (re-raised at the next save()/wait())"
+            ) from err
 
     def save(self, state: Any, step: int) -> None:
         with _ckpt_measure():
@@ -185,24 +400,31 @@ class AsyncCheckpointer:
 
         def work():
             path = os.path.join(self.directory, f"ckpt-{step}")
-            save(host_state, path, step=step)
-            self._gc()
+            try:
+                save(host_state, path, step=step)
+                self._gc()
+            except BaseException as e:  # noqa: BLE001 — captured, not lost
+                self._error = e
+                _note_save_failure(step, e)
 
         self._thread = threading.Thread(target=work, daemon=True)
         self._thread.start()
 
     def wait(self) -> None:
         if self._thread is None:
+            self._raise_pending()
             return
         with _ckpt_measure():
             self._thread.join()
             self._thread = None
+        self._raise_pending()
 
     def _complete_steps(self) -> Dict[int, str]:
         """Only ckpt-<digits> entries count: a hard crash mid-save can
         strand ckpt-N.tmp staging dirs, which must never be parsed as
         checkpoints (they'd crash every elastic restart) or restored
         from (they're incomplete)."""
+        writing = self._thread is not None and self._thread.is_alive()
         out: Dict[int, str] = {}
         for d in os.listdir(self.directory):
             if not d.startswith("ckpt-"):
@@ -210,8 +432,10 @@ class AsyncCheckpointer:
             suffix = d.split("-", 1)[1]
             if suffix.isdigit():
                 out[int(suffix)] = d
-            else:
-                # stale staging leftover from a crashed save
+            elif not writing:
+                # stale staging leftover from a crashed save — but only
+                # reap when no background save is in flight, or we would
+                # delete the live .tmp dir out from under the writer
                 shutil.rmtree(os.path.join(self.directory, d),
                               ignore_errors=True)
         return out
@@ -222,15 +446,51 @@ class AsyncCheckpointer:
             shutil.rmtree(os.path.join(self.directory, steps[s]),
                           ignore_errors=True)
 
-    def latest_step(self) -> Optional[int]:
-        steps = self._complete_steps()
-        return max(steps) if steps else None
+    def intact_steps(self) -> List[int]:
+        """Steps whose directories pass the cheap commit check
+        (manifest parses + COMMIT marker for v2 saves), ascending."""
+        return [s for s in sorted(self._complete_steps())
+                if is_committed(os.path.join(self.directory,
+                                             f"ckpt-{s}"))]
 
-    def restore(self, target: Any = None, step: Optional[int] = None):
+    def latest_step(self) -> Optional[int]:
+        steps = self.intact_steps()
+        return steps[-1] if steps else None
+
+    def verify(self, step: Optional[int] = None) -> List[str]:
+        """Full integrity report for one checkpoint (default: newest
+        committed) without loading arrays; see :func:`verify`."""
         step = step if step is not None else self.latest_step()
         if step is None:
-            return None
-        return load(os.path.join(self.directory, f"ckpt-{step}"), target)
+            return [f"{self.directory}: no committed checkpoints"]
+        return verify(os.path.join(self.directory, f"ckpt-{step}"))
+
+    def restore_latest(self, target: Any = None
+                       ) -> Tuple[Optional[Any], Optional[int]]:
+        """Restore the newest INTACT checkpoint, skipping corrupt or
+        uncommitted ones (each skip increments
+        ``checkpoint_corrupt_total`` and records a flight event).
+        Returns ``(state, step)`` or ``(None, None)`` when nothing
+        intact exists."""
+        for s in reversed(sorted(self._complete_steps())):
+            path = os.path.join(self.directory, f"ckpt-{s}")
+            try:
+                if not is_committed(path):
+                    raise ValueError(
+                        f"checkpoint {path!r}: missing COMMIT marker "
+                        "(unfinished save)")
+                return load(path, target), s
+            except (OSError, ValueError) as e:
+                _note_corrupt(path, e, step=s)
+                continue
+        return None, None
+
+    def restore(self, target: Any = None, step: Optional[int] = None):
+        if step is not None:
+            return load(os.path.join(self.directory, f"ckpt-{step}"),
+                        target)
+        state, _ = self.restore_latest(target)
+        return state
 
 
 # reference-parity entry points -------------------------------------------

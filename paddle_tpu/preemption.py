@@ -1,0 +1,135 @@
+"""Graceful preemption handling (SIGTERM).
+
+On every TPU scheduler — GCE preemptible/spot VMs, GKE eviction, batch
+schedulers — the preemption warning is a SIGTERM with a short grace
+window. Before this module the framework's only SIGTERM behavior was
+the flight recorder's dump-and-die: correct forensics, but all work
+since the last checkpoint was thrown away.
+
+:class:`PreemptionGuard` turns SIGTERM into a cooperative request:
+the handler only sets a flag (and counts ``preemptions_total`` + a
+flight event); the wrapped training loop (``hapi.Model.fit``,
+``incubate.TrainEpochRange``) checks the flag at step/epoch
+boundaries, finishes the in-flight step, forces a final *synchronous*
+checkpoint, and then calls :meth:`PreemptionGuard.reraise` — which
+restores the previous handler chain and re-delivers the signal so the
+process still dies with the scheduler-visible SIGTERM wait status
+(``distributed.launch_elastic`` classifies that exit as a preemption,
+not a crash). A preempted worker therefore resumes from the step it
+died at, not the last epoch. See docs/fault_tolerance.md.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Optional, Tuple
+
+__all__ = ["PreemptedError", "PreemptionGuard", "guard"]
+
+
+class PreemptedError(RuntimeError):
+    """Raised by :meth:`PreemptionGuard.reraise` when re-delivering the
+    signal did not terminate the process (a chained handler swallowed
+    it) — unwinds the stack so outer loops can run their own final
+    saves and re-raise in turn."""
+
+
+def _note_preempted(signum: int) -> None:
+    try:
+        from .observability import flight as _flight
+        from .observability import metrics as _metrics
+        _metrics.counter(
+            "preemptions_total",
+            "SIGTERM preemption notices caught by a preemption guard "
+            "(graceful: finish step, checkpoint, re-raise)",
+            always=True).inc()
+        _flight.record("preemption_notice", force=True,
+                       signum=int(signum))
+    except Exception:  # noqa: BLE001 — telemetry never blocks the flag
+        pass
+
+
+class PreemptionGuard:
+    """Context manager that converts SIGTERM into a checked flag.
+
+    Usage::
+
+        with preemption.guard() as g:
+            for step in steps:
+                run(step)
+                if g.preempted:
+                    checkpoint_now()
+                    g.reraise()   # dies with SIGTERM wait status
+
+    Installing a handler is only possible from the main thread; in any
+    other thread the guard is inert (``preempted`` stays False) so
+    library code can use it unconditionally.
+    """
+
+    def __init__(self, signals: Tuple[int, ...] = (signal.SIGTERM,)
+                 ) -> None:
+        self._signals = tuple(signals)
+        self._prev: dict = {}
+        self._installed = False
+        self._flag = threading.Event()
+        self.signum: Optional[int] = None
+
+    @property
+    def preempted(self) -> bool:
+        return self._flag.is_set()
+
+    @property
+    def active(self) -> bool:
+        """Whether handlers are actually installed (main thread)."""
+        return self._installed
+
+    def _handler(self, signum, frame) -> None:
+        self.signum = int(signum)
+        if not self._flag.is_set():
+            self._flag.set()
+            _note_preempted(signum)
+
+    def __enter__(self) -> "PreemptionGuard":
+        try:
+            for sig in self._signals:
+                self._prev[sig] = signal.signal(sig, self._handler)
+            self._installed = True
+        except (ValueError, OSError):  # not the main thread: stay inert
+            self._restore()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._restore()
+        return False
+
+    def _restore(self) -> None:
+        for sig, prev in list(self._prev.items()):
+            try:
+                signal.signal(sig, prev if prev is not None
+                              else signal.SIG_DFL)
+            except (ValueError, OSError):
+                pass
+        self._prev.clear()
+        self._installed = False
+
+    def reraise(self) -> None:
+        """Restore the previous handler chain and re-deliver the
+        signal — the graceful detour is over; the process must still
+        die with the correct wait status. The flight recorder (if
+        installed underneath) dumps and re-delivers in turn. If every
+        chained handler swallows the signal, raises
+        :class:`PreemptedError` so the stack still unwinds."""
+        signum = self.signum or self._signals[0]
+        self._restore()
+        os.kill(os.getpid(), signum)
+        # Reached only if a chained Python handler caught the
+        # re-delivery (e.g. an outer guard): unwind via exception.
+        raise PreemptedError(f"preempted by signal {signum}")
+
+
+def guard(signals: Tuple[int, ...] = (signal.SIGTERM,)
+          ) -> PreemptionGuard:
+    """Factory spelling used by the training loops."""
+    return PreemptionGuard(signals)

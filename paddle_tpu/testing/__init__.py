@@ -1,0 +1,12 @@
+"""Testing / chaos-engineering surface.
+
+:mod:`faults` is the deterministic fault-injection registry behind
+``FLAGS_fault_spec`` — the production code carries the injection points
+(checkpoint writer, data loader boundary, train step), this package
+carries the trigger logic, and ``tools/chaos_drill.py`` drives both to
+prove the fault-tolerance layer end to end (docs/fault_tolerance.md).
+"""
+
+from . import faults  # noqa: F401
+
+__all__ = ["faults"]

@@ -1,0 +1,255 @@
+"""Deterministic fault injection for chaos drills.
+
+Production fault-tolerance code is only trustworthy if the failure path
+runs — this module makes failures reproducible. ``FLAGS_fault_spec``
+arms a registry of fault entries; the framework's injection points
+(checkpoint writer, data-loader boundary, train step) call
+:func:`hit`, which is a near-free no-op while the registry is empty.
+
+Spec grammar (comma-separated entries, colon-separated fields)::
+
+    point[:key=value]...
+
+    ckpt_write:p=1:at=2          # 2nd checkpoint leaf write raises
+    sigterm:step=7               # SIGTERM self when train step == 7
+    loader:exc=OSError           # data fetch raises OSError
+    train_step:step=3:exc=RuntimeError
+    ckpt_write:step=8:kill=9     # SIGKILL mid-save of checkpoint 8
+
+Trigger keys (an entry fires when ALL of its conditions hold):
+
+- ``at=N``    — the Nth invocation of this point (1-based, per process)
+- ``step=N``  — the caller-supplied ``step`` context equals N
+- ``p=X``     — probability per call, seeded RNG (``seed=``) so a given
+  spec replays identically; ``p=1`` fires always
+- no condition keys → fires on every call
+
+Action keys (first present wins):
+
+- ``exc=Name`` — raise that builtin exception (default RuntimeError)
+- ``kill=SIG`` — ``os.kill(self, SIG)`` (number or name, e.g. ``9``,
+  ``KILL``, ``SIGTERM``)
+- ``exit=N``   — ``os._exit(N)`` (no cleanup, like a hard crash)
+- none         — the ``sigterm`` point self-delivers SIGTERM; every
+  other point raises RuntimeError
+
+Every fired fault increments ``faults_injected_total{point=}`` and
+records a forced flight-recorder event before acting, so a drill can
+assert the injection actually happened. See docs/fault_tolerance.md.
+"""
+
+from __future__ import annotations
+
+import builtins
+import os
+import random
+import signal
+import threading
+from dataclasses import dataclass
+from typing import List, Optional
+
+__all__ = ["FaultSpec", "parse_spec", "format_spec", "configure",
+           "active", "hit"]
+
+
+@dataclass
+class FaultSpec:
+    point: str
+    p: Optional[float] = None
+    at: Optional[int] = None
+    step: Optional[int] = None
+    exc: Optional[str] = None
+    kill: Optional[int] = None
+    exit: Optional[int] = None
+    seed: int = 0
+
+
+_INT_KEYS = ("at", "step", "exit", "seed")
+
+
+def _parse_signal(text: str) -> int:
+    text = text.strip()
+    if text.lstrip("-").isdigit():
+        return int(text)
+    name = text.upper()
+    if not name.startswith("SIG"):
+        name = "SIG" + name
+    sig = getattr(signal, name, None)
+    if sig is None:
+        raise ValueError(f"fault spec: unknown signal {text!r}")
+    return int(sig)
+
+
+def parse_spec(text: Optional[str]) -> List[FaultSpec]:
+    """Parse a ``FLAGS_fault_spec`` string into :class:`FaultSpec` list.
+
+    Raises ``ValueError`` on malformed entries — a typo'd chaos spec
+    must fail loudly at arm time, not silently never fire.
+    """
+    specs: List[FaultSpec] = []
+    for entry in (text or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        fields = entry.split(":")
+        point = fields[0].strip()
+        if not point or "=" in point:
+            raise ValueError(
+                f"fault spec entry {entry!r}: first field must be the "
+                "injection point name")
+        kwargs = {}
+        for f in fields[1:]:
+            if "=" not in f:
+                raise ValueError(
+                    f"fault spec entry {entry!r}: field {f!r} is not "
+                    "key=value")
+            k, v = f.split("=", 1)
+            k, v = k.strip(), v.strip()
+            if k == "p":
+                kwargs["p"] = float(v)
+            elif k in _INT_KEYS:
+                kwargs[k] = int(v)
+            elif k == "kill":
+                kwargs["kill"] = _parse_signal(v)
+            elif k == "exc":
+                kwargs["exc"] = v
+            else:
+                raise ValueError(
+                    f"fault spec entry {entry!r}: unknown key {k!r} "
+                    f"(known: p, at, step, exc, kill, exit, seed)")
+        specs.append(FaultSpec(point, **kwargs))
+    return specs
+
+
+def format_spec(specs: List[FaultSpec]) -> str:
+    """Inverse of :func:`parse_spec` (round-trips)."""
+    parts = []
+    for s in specs:
+        fields = [s.point]
+        if s.p is not None:
+            fields.append(f"p={s.p:g}")
+        if s.at is not None:
+            fields.append(f"at={s.at}")
+        if s.step is not None:
+            fields.append(f"step={s.step}")
+        if s.exc is not None:
+            fields.append(f"exc={s.exc}")
+        if s.kill is not None:
+            fields.append(f"kill={s.kill}")
+        if s.exit is not None:
+            fields.append(f"exit={s.exit}")
+        if s.seed:
+            fields.append(f"seed={s.seed}")
+        parts.append(":".join(fields))
+    return ",".join(parts)
+
+
+def _exc_class(name: str):
+    cls = getattr(builtins, name, None)
+    if isinstance(cls, type) and issubclass(cls, BaseException):
+        return cls
+    return RuntimeError
+
+
+class _Armed:
+    def __init__(self, spec: FaultSpec) -> None:
+        self.spec = spec
+        self.calls = 0
+        self.rng = random.Random(spec.seed)
+
+
+class FaultRegistry:
+    """Armed spec entries + per-entry invocation counters."""
+
+    def __init__(self, specs: List[FaultSpec]) -> None:
+        self._armed = [_Armed(s) for s in specs]
+        self._lock = threading.Lock()
+
+    def hit(self, point: str, step: Optional[int] = None) -> None:
+        fire: Optional[FaultSpec] = None
+        with self._lock:
+            for a in self._armed:
+                s = a.spec
+                if s.point != point:
+                    continue
+                a.calls += 1
+                if s.at is not None and a.calls != s.at:
+                    continue
+                if s.step is not None and (step is None
+                                           or int(step) != s.step):
+                    continue
+                if s.p is not None and s.p < 1.0 \
+                        and a.rng.random() >= s.p:
+                    continue
+                fire = s
+                break
+        if fire is not None:
+            self._fire(point, fire, step)
+
+    def _fire(self, point: str, s: FaultSpec,
+              step: Optional[int]) -> None:
+        _note(point, s, step)
+        where = f"fault injected at {point!r}" + (
+            f" (step {step})" if step is not None else "")
+        if s.exc is not None:
+            raise _exc_class(s.exc)(where)
+        if s.kill is not None:
+            os.kill(os.getpid(), s.kill)
+            return
+        if s.exit is not None:
+            os._exit(s.exit)
+        if point == "sigterm":
+            os.kill(os.getpid(), signal.SIGTERM)
+            return
+        raise RuntimeError(where)
+
+
+def _note(point: str, s: FaultSpec, step: Optional[int]) -> None:
+    # telemetry first (the action may not return), but never let
+    # telemetry itself break the injection
+    try:
+        from ..observability import flight as _flight
+        from ..observability import metrics as _metrics
+        _metrics.counter(
+            "faults_injected_total",
+            "faults fired by the chaos injection registry "
+            "(paddle_tpu.testing.faults, FLAGS_fault_spec)",
+            always=True).inc(point=point)
+        _flight.record("fault_injected", force=True, point=point,
+                       step=step, spec=format_spec([s]))
+    except Exception:  # noqa: BLE001
+        pass
+
+
+_REGISTRY: Optional[FaultRegistry] = None
+
+
+def configure(spec: Optional[str]) -> None:
+    """(Re)arm the registry from a spec string; ``None``/"" disarms.
+    Wired to FLAGS_fault_spec's on_change hook."""
+    global _REGISTRY
+    specs = parse_spec(spec) if spec else []
+    _REGISTRY = FaultRegistry(specs) if specs else None
+
+
+def active() -> bool:
+    return _REGISTRY is not None
+
+
+def hit(point: str, step: Optional[int] = None) -> None:
+    """Injection-point hook: no-op unless a spec armed this point."""
+    r = _REGISTRY
+    if r is None:
+        return
+    r.hit(point, step=step)
+
+
+# Arm from an env-set FLAGS_fault_spec at import (the subprocess-drill
+# path: the drill exports FLAGS_fault_spec before the trainer starts).
+try:  # pragma: no cover - trivial wiring
+    from ..flags import GLOBAL_FLAGS as _GF
+    _spec = _GF.get("fault_spec")
+    if _spec:
+        configure(_spec)
+except Exception:  # flag not defined yet (direct submodule import)
+    pass

@@ -1,0 +1,556 @@
+"""End-to-end fault tolerance (ISSUE 4, docs/fault_tolerance.md).
+
+Checkpoint integrity (CRC32 + COMMIT marker + verify()), corrupt-skip
+restore fallback, background-writer failure surfacing, graceful
+preemption, step-granular fit auto-save/auto-resume, restart budgets,
+serving retry, and the chaos-spec grammar + drill harness.
+"""
+
+import json
+import os
+import shutil
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import io as io_mod
+from paddle_tpu import observability as obs
+from paddle_tpu import preemption
+from paddle_tpu.testing import faults
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults_and_telemetry():
+    faults.configure(None)
+    obs.flight_recorder().reset()
+    yield
+    faults.configure(None)
+
+
+# ---------------------------------------------------------------------------
+# chaos spec grammar
+# ---------------------------------------------------------------------------
+
+def test_parse_spec_round_trip():
+    text = ("ckpt_write:p=1:at=2,sigterm:step=7,loader:exc=OSError,"
+            "train_step:step=3:exc=RuntimeError:seed=5,"
+            "ckpt_write:step=8:kill=9,loader:exit=3")
+    specs = faults.parse_spec(text)
+    assert [s.point for s in specs] == ["ckpt_write", "sigterm",
+                                        "loader", "train_step",
+                                        "ckpt_write", "loader"]
+    assert specs[0].p == 1.0 and specs[0].at == 2
+    assert specs[1].step == 7
+    assert specs[2].exc == "OSError"
+    assert specs[3].seed == 5
+    assert specs[4].kill == 9
+    assert specs[5].exit == 3
+    # round trip: format(parse(x)) reparses to the same specs
+    assert faults.parse_spec(faults.format_spec(specs)) == specs
+
+
+def test_parse_spec_signal_names_and_errors():
+    assert faults.parse_spec("x:kill=TERM")[0].kill == int(signal.SIGTERM)
+    assert faults.parse_spec("x:kill=SIGKILL")[0].kill == int(signal.SIGKILL)
+    assert faults.parse_spec("") == []
+    with pytest.raises(ValueError, match="key=value"):
+        faults.parse_spec("ckpt_write:banana")
+    with pytest.raises(ValueError, match="unknown key"):
+        faults.parse_spec("ckpt_write:frobnicate=1")
+    with pytest.raises(ValueError, match="unknown signal"):
+        faults.parse_spec("x:kill=SIGBANANA")
+
+
+def test_fault_registry_at_step_and_exc():
+    faults.configure("pt_test_point:at=2:exc=OSError")
+    faults.hit("pt_test_point")           # 1st call: armed but silent
+    with pytest.raises(OSError, match="fault injected"):
+        faults.hit("pt_test_point")       # 2nd call fires
+    faults.hit("pt_test_point")           # 3rd call: at=2 passed
+    faults.configure("pt_step_point:step=5")
+    faults.hit("pt_step_point", step=4)
+    with pytest.raises(RuntimeError):
+        faults.hit("pt_step_point", step=5)
+    # counter + flight event recorded (always-on, no metrics flag)
+    c = obs.metrics.counter("faults_injected_total", always=True)
+    assert c.value(point="pt_step_point") >= 1
+    kinds = [e["kind"] for e in obs.flight_recorder().events()]
+    assert "fault_injected" in kinds
+    faults.configure(None)
+    faults.hit("pt_test_point")           # disarmed: no-op
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity
+# ---------------------------------------------------------------------------
+
+def _save_one(tmp_path, name="c1", step=3):
+    path = str(tmp_path / name)
+    io_mod.save({"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+                 "b": np.ones(3)}, path, step=step)
+    return path
+
+
+def test_save_writes_integrity_format(tmp_path):
+    path = _save_one(tmp_path)
+    manifest = json.load(open(os.path.join(path, "manifest.json")))
+    assert manifest["__paddle_tpu_ckpt__"] == 2
+    for meta in manifest["leaves"].values():
+        assert meta["nbytes"] > 0 and "crc32" in meta
+    commit = json.load(open(os.path.join(path, "COMMIT")))
+    with open(os.path.join(path, "manifest.json"), "rb") as f:
+        assert commit["manifest_crc32"] == zlib.crc32(f.read())
+    assert io_mod.verify(path) == []
+    assert io_mod.is_committed(path)
+
+
+def test_load_missing_leaf_names_checkpoint_and_leaf(tmp_path):
+    path = _save_one(tmp_path)
+    os.remove(os.path.join(path, "data", "w.npy"))
+    with pytest.raises(ValueError) as ei:
+        io_mod.load(path)
+    msg = str(ei.value)
+    assert path in msg and "'w'" in msg and "verify" in msg
+
+
+def test_load_size_mismatch_detected_even_unverified(tmp_path):
+    path = _save_one(tmp_path)
+    fpath = os.path.join(path, "data", "w.npy")
+    with open(fpath, "ab") as f:
+        f.write(b"xx")  # grow the file: manifest nbytes now wrong
+    with pytest.raises(ValueError, match="bytes on disk"):
+        io_mod.load(path, verify_integrity=False)
+
+
+def test_load_crc_corruption_and_opt_out(tmp_path):
+    path = _save_one(tmp_path)
+    fpath = os.path.join(path, "data", "w.npy")
+    raw = open(fpath, "rb").read()
+    with open(fpath, "wb") as f:  # same size, flipped last byte
+        f.write(raw[:-1] + bytes([raw[-1] ^ 0xFF]))
+    with pytest.raises(ValueError, match="CRC32"):
+        io_mod.load(path)
+    # explicit opt-out skips the CRC pass (size still matches)
+    flat = io_mod.load(path, verify_integrity=False)
+    assert flat["w"].shape == (2, 3)
+    # the flag spells the same opt-out
+    pt.set_flags({"checkpoint_verify": False})
+    try:
+        io_mod.load(path)
+    finally:
+        pt.set_flags({"checkpoint_verify": True})
+    with pytest.raises(ValueError, match="CRC32"):
+        io_mod.load(path)
+    assert any("CRC32" in p for p in io_mod.verify(path))
+
+
+def test_uncommitted_checkpoint_skipped_with_fallback(tmp_path):
+    ck = io_mod.AsyncCheckpointer(str(tmp_path / "ck"))
+    ck.save({"w": np.ones(3)}, step=1)
+    ck.wait()
+    ck.save({"w": np.ones(3) * 2}, step=2)
+    ck.wait()
+    os.remove(str(tmp_path / "ck" / "ckpt-2" / "COMMIT"))
+    assert ck.latest_step() == 1
+    before = obs.metrics.counter("checkpoint_corrupt_total",
+                                 always=True).value()
+    state, step = ck.restore_latest()
+    assert step == 1
+    np.testing.assert_array_equal(state["w"], np.ones(3))
+    assert obs.metrics.counter("checkpoint_corrupt_total",
+                               always=True).value() == before + 1
+    assert any(e["kind"] == "checkpoint_corrupt"
+               for e in obs.flight_recorder().events())
+
+
+def test_corrupt_leaf_restore_falls_back_one_step(tmp_path):
+    ck = io_mod.AsyncCheckpointer(str(tmp_path / "ck"))
+    for s in (2, 4):
+        ck.save({"w": np.full(3, float(s))}, step=s)
+        ck.wait()
+    leaf = str(tmp_path / "ck" / "ckpt-4" / "data" / "w.npy")
+    raw = open(leaf, "rb").read()
+    with open(leaf, "wb") as f:
+        f.write(raw[:-1] + bytes([raw[-1] ^ 0x55]))
+    state, step = ck.restore_latest()
+    assert step == 2
+    np.testing.assert_array_equal(state["w"], np.full(3, 2.0))
+    assert ck.verify(4)  # full report names the problem
+
+
+def test_async_writer_failure_surfaces_at_next_wait(tmp_path):
+    faults.configure("ckpt_write:at=1:exc=OSError")
+    ck = io_mod.AsyncCheckpointer(str(tmp_path / "ck"))
+    before = obs.metrics.counter("checkpoint_failures_total",
+                                 always=True).value()
+    ck.save({"w": np.ones(2)}, step=1)
+    with pytest.raises(RuntimeError, match="background checkpoint"):
+        ck.wait()
+    assert obs.metrics.counter("checkpoint_failures_total",
+                               always=True).value() == before + 1
+    # the error is consumed: the next save works
+    faults.configure(None)
+    ck.save({"w": np.ones(2)}, step=2)
+    ck.wait()
+    assert ck.latest_step() == 2
+
+
+def test_v1_checkpoint_still_loads(tmp_path):
+    """Legacy (pre-integrity) checkpoints have no COMMIT/crc fields and
+    must keep loading — is_committed treats v1 as committed."""
+    path = _save_one(tmp_path)
+    manifest = json.load(open(os.path.join(path, "manifest.json")))
+    manifest["__paddle_tpu_ckpt__"] = 1
+    for meta in manifest["leaves"].values():
+        meta.pop("crc32"), meta.pop("nbytes")
+    with open(os.path.join(path, "manifest.json"), "w") as f:
+        json.dump(manifest, f)
+    os.remove(os.path.join(path, "COMMIT"))
+    assert io_mod.is_committed(path)
+    flat = io_mod.load(path)
+    assert flat["w"].shape == (2, 3)
+    assert io_mod.verify(path) == []
+
+
+# ---------------------------------------------------------------------------
+# preemption guard
+# ---------------------------------------------------------------------------
+
+def test_preemption_guard_catches_sigterm_without_dying():
+    with preemption.guard() as g:
+        assert g.active and not g.preempted
+        os.kill(os.getpid(), signal.SIGTERM)
+        # CPython runs the handler at the next bytecode boundary
+        deadline = time.time() + 2
+        while not g.preempted and time.time() < deadline:
+            time.sleep(0.01)
+        assert g.preempted
+        assert g.signum == int(signal.SIGTERM)
+    assert signal.getsignal(signal.SIGTERM) != g._handler
+    assert obs.metrics.counter("preemptions_total",
+                               always=True).value() >= 1
+    assert any(e["kind"] == "preemption_notice"
+               for e in obs.flight_recorder().events())
+
+
+def test_preemption_guard_inert_off_main_thread():
+    out = {}
+
+    def worker():
+        with preemption.guard() as g:
+            out["active"] = g.active
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join(10)
+    assert out["active"] is False
+
+
+# ---------------------------------------------------------------------------
+# Model.fit checkpointing
+# ---------------------------------------------------------------------------
+
+def _make_model():
+    pt.seed(0)
+    net = pt.nn.Linear(4, 2)
+    return pt.hapi.Model(
+        net, loss=lambda o, y: pt.nn.functional.cross_entropy(o, y),
+        optimizer=pt.optimizer.SGD(learning_rate=0.1))
+
+
+def _batches(n, rng=None):
+    rng = rng or np.random.default_rng(0)
+    return [(rng.normal(size=(8, 4)).astype(np.float32),
+             rng.integers(0, 2, (8,)).astype(np.int64))
+            for _ in range(n)]
+
+
+def test_fit_auto_save_and_step_granular_resume(tmp_path):
+    d = str(tmp_path / "ck")
+    batches = _batches(6)
+    _make_model().fit(batches[:4], epochs=1, verbose=0, ckpt_dir=d,
+                      save_steps=2)
+    ck = io_mod.AsyncCheckpointer(d)
+    assert ck.latest_step() == 4
+    assert ck.verify() == []
+    ran = []
+
+    class CB(pt.hapi.Callback):
+        def on_batch_end(self, step, logs=None):
+            ran.append(step)
+
+    _make_model().fit(batches, epochs=1, verbose=0, ckpt_dir=d,
+                      save_steps=2, callbacks=[CB()])
+    # fast-forward skipped steps 0-3 (no compute, no callbacks)
+    assert ran == [4, 5]
+    assert ck.latest_step() == 6
+
+
+def test_fit_resume_matches_uninterrupted_run(tmp_path):
+    """Interrupted-at-step-3 + resume must land on the same weights as
+    one uninterrupted run (modulo the restarted dropout stream — the
+    Linear model has none)."""
+    batches = _batches(6)
+    m_full = _make_model()
+    m_full.fit(batches, epochs=1, verbose=0)
+    want = {k: np.asarray(v)
+            for k, v in m_full.network.state_dict().items()}
+
+    d = str(tmp_path / "ck")
+    _make_model().fit(batches[:3], epochs=1, verbose=0, ckpt_dir=d,
+                      save_steps=1)
+    m2 = _make_model()
+    m2.fit(batches, epochs=1, verbose=0, ckpt_dir=d, save_steps=1)
+    got = {k: np.asarray(v)
+           for k, v in m2.network.state_dict().items()}
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=1e-5,
+                                   atol=1e-6, err_msg=k)
+
+
+def test_fit_loader_fault_injection_surfaces(tmp_path):
+    faults.configure("loader:step=1:exc=OSError")
+    with pytest.raises(OSError, match="fault injected"):
+        _make_model().fit(_batches(4), epochs=1, verbose=0)
+
+
+# ---------------------------------------------------------------------------
+# restart policy
+# ---------------------------------------------------------------------------
+
+def test_classify_exit():
+    from paddle_tpu.distributed.launch import classify_exit
+    assert classify_exit(0) == "clean"
+    assert classify_exit(-int(signal.SIGTERM)) == "preempt"
+    assert classify_exit(128 + int(signal.SIGTERM)) == "preempt"
+    assert classify_exit(1) == "crash"
+    assert classify_exit(-9) == "crash"
+
+
+def test_restart_budget_fails_fast(tmp_path):
+    """A deterministic crash-loop must stop via the sliding-window
+    budget, not burn max_restarts."""
+    from paddle_tpu.distributed.launch import launch_elastic
+    script = tmp_path / "crash.py"
+    log = tmp_path / "attempts.log"
+    script.write_text(
+        "import os, sys\n"
+        f"open({str(log)!r}, 'a').write("
+        "os.environ.get('PT_ELASTIC_ATTEMPT', '?') + '\\n')\n"
+        "sys.exit(3)\n")
+    t0 = time.time()
+    rc = launch_elastic([sys.executable, str(script)], nproc=1,
+                        max_restarts=10, backoff_s=0.01,
+                        restart_budget=2, restart_window_s=60.0,
+                        start_control_plane=False)
+    assert rc == 3
+    attempts = [l.strip() for l in open(log) if l.strip()]
+    assert attempts == ["0", "1", "2"]
+    assert time.time() - t0 < 30
+    assert obs.metrics.counter("elastic_budget_exhausted_total",
+                               always=True).value() >= 1
+
+
+def test_preemption_restart_does_not_burn_budget(tmp_path):
+    from paddle_tpu.distributed.launch import launch_elastic
+    script = tmp_path / "pre.py"
+    log = tmp_path / "attempts.log"
+    script.write_text(
+        "import os, signal, sys\n"
+        "a = int(os.environ.get('PT_ELASTIC_ATTEMPT', '0'))\n"
+        f"open({str(log)!r}, 'a').write(str(a) + '\\n')\n"
+        "if a < 2:\n"
+        "    signal.signal(signal.SIGTERM, signal.SIG_DFL)\n"
+        "    os.kill(os.getpid(), signal.SIGTERM)\n"
+        "sys.exit(0)\n")
+    rc = launch_elastic([sys.executable, str(script)], nproc=1,
+                        max_restarts=5, restart_budget=1,
+                        restart_window_s=60.0,
+                        start_control_plane=False)
+    assert rc == 0  # two preemptions did not trip the budget of 1
+    assert [l.strip() for l in open(log)] == ["0", "1", "2"]
+
+
+def _spawn_sleeper():
+    time.sleep(60)
+
+
+def test_spawn_reaps_workers_on_timeout():
+    """Satellite fix: spawn's teardown must JOIN terminated workers,
+    not leave zombies behind."""
+    import multiprocessing
+    from paddle_tpu.distributed.launch import spawn
+    with pytest.raises(TimeoutError):
+        spawn(_spawn_sleeper, nprocs=2, timeout=1.0)
+    assert not multiprocessing.active_children()
+
+
+# ---------------------------------------------------------------------------
+# serving retry: flapping server / deadlines / shedding
+# ---------------------------------------------------------------------------
+
+class _FakeServer:
+    """Minimal protocol server: optionally drops the first N
+    connections on their first read, then answers STATS frames."""
+
+    def __init__(self, flap_first=0, reply=True):
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        self.flap_left = flap_first
+        self.reply = reply
+        self.connections = 0
+        self._stop = False
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while not self._stop:
+            try:
+                c, _ = self.sock.accept()
+            except OSError:
+                return
+            self.connections += 1
+            if self.flap_left > 0:
+                self.flap_left -= 1
+                c.close()
+                continue
+            threading.Thread(target=self._serve, args=(c,),
+                             daemon=True).start()
+
+    def _serve(self, c):
+        try:
+            while True:
+                hdr = b""
+                while len(hdr) < 16:
+                    chunk = c.recv(16 - len(hdr))
+                    if not chunk:
+                        return
+                    hdr += chunk
+                magic, tag, n = struct.unpack("<IQI", hdr)
+                payload = b""
+                while len(payload) < n:
+                    payload += c.recv(n - len(payload))
+                if not self.reply:
+                    continue
+                body = b"queue_depth=0\nproto_version=1\n"
+                c.sendall(struct.pack("<QqI", tag, 0, len(body)) + body)
+        except OSError:
+            pass
+
+    def close(self):
+        self._stop = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def test_client_stats_retries_across_flapping_connection():
+    from paddle_tpu.inference import Client
+    srv = _FakeServer(flap_first=1)
+    try:
+        cli = Client(port=srv.port, timeout_s=5.0,
+                     max_reconnects=3, reconnect_backoff_s=0.01)
+        stats = cli.stats()
+        assert stats["queue_depth"] == 0
+        assert srv.connections >= 2  # reconnected after the flap
+        cli.close()
+    finally:
+        srv.close()
+
+
+def test_client_reconnect_is_bounded():
+    from paddle_tpu.inference import Client
+    srv = _FakeServer(flap_first=100)
+    try:
+        cli = Client(port=srv.port, timeout_s=5.0,
+                     max_reconnects=2, reconnect_backoff_s=0.01)
+        with pytest.raises((ConnectionError, TimeoutError)):
+            cli.stats(deadline_s=5.0)
+        cli.close()
+    finally:
+        srv.close()
+
+
+def test_client_deadline_raises_timeout():
+    from paddle_tpu.inference import Client
+    srv = _FakeServer(reply=False)  # accepts, never replies
+    try:
+        cli = Client(port=srv.port, timeout_s=10.0)
+        t0 = time.time()
+        with pytest.raises(TimeoutError):
+            cli.infer([np.zeros((1, 2), np.float32)], deadline_s=0.3)
+        assert time.time() - t0 < 5
+        cli.close()
+    finally:
+        srv.close()
+
+
+class _SlowPredictor:
+    config = None
+
+    def run(self, joined):
+        time.sleep(0.25)
+        return [joined[0]]
+
+
+def test_server_sheds_requests_past_queue_deadline():
+    from paddle_tpu import native
+    from paddle_tpu.inference import Client, Server
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    srv = Server(_SlowPredictor(), max_batch=1, wait_ms=1,
+                 queue_deadline_ms=80)
+    try:
+        errs, oks = [], []
+
+        def call(i):
+            try:
+                with Client(port=srv.port, timeout_s=15.0) as c:
+                    c.infer([np.zeros((1, 2), np.float32)])
+                    oks.append(i)
+            except RuntimeError as e:
+                errs.append(str(e))
+
+        ts = [threading.Thread(target=call, args=(i,))
+              for i in range(5)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        assert srv.n_shed > 0
+        assert any("shed" in e for e in errs)
+        assert oks  # shedding is partial, not a blackout
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos drill harness (ISSUE acceptance: wired into tier-1)
+# ---------------------------------------------------------------------------
+
+def test_chaos_drill_self_test_subprocess():
+    """The full drill suite — kill -9 mid-save, corrupted leaf, SIGTERM
+    mid-fit, crash-loop budget — must pass end to end on CPU."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("FLAGS_fault_spec", None)
+    env.pop("FLAGS_enable_metrics", None)
+    env.pop("FLAGS_trace_dir", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "chaos_drill.py"),
+         "--self-test"],
+        capture_output=True, text=True, env=env, timeout=540, cwd=ROOT)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert "self-test OK" in proc.stdout

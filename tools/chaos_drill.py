@@ -1,0 +1,302 @@
+#!/usr/bin/env python
+"""Chaos drills: prove the fault-tolerance layer end to end.
+
+Each drill runs real training subprocesses with deterministic fault
+injection (FLAGS_fault_spec, paddle_tpu.testing.faults) and asserts
+the recovery contract from docs/fault_tolerance.md:
+
+  kill_mid_save    — SIGKILL lands mid checkpoint write; the strand is
+                     never visible as a checkpoint and a restart
+                     resumes from the newest INTACT one.
+  corrupt_leaf     — a leaf's bytes are flipped on disk; restore
+                     detects the CRC mismatch, falls back one step,
+                     and records checkpoint_corrupt_total + a flight
+                     event. A stripped COMMIT marker falls back again.
+  sigterm_mid_fit  — graceful preemption: SIGTERM during Model.fit
+                     finishes the step, forces a final checkpoint,
+                     dies with the SIGTERM wait status, and the
+                     restart resumes at the preempted step.
+  crash_loop       — a deterministic per-step crash under
+                     launch_elastic terminates via the sliding-window
+                     restart budget instead of exhausting max_restarts.
+
+Usage:
+  python tools/chaos_drill.py --self-test        # all drills (CPU)
+  python tools/chaos_drill.py --drill kill_mid_save
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:  # runnable from any cwd
+    sys.path.insert(0, ROOT)
+
+# Per-step auto-checkpointing trainer driven entirely by env flags;
+# writes {"resumed": <step>, "attempt": N} to its output path before
+# training so the driver can assert the resume point.
+_TRAINER = textwrap.dedent("""
+    import json, os, sys
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as pt
+    from paddle_tpu import io
+    from paddle_tpu.sysconfig import enable_compile_cache
+
+    enable_compile_cache()
+    ckdir, outpath = sys.argv[1], sys.argv[2]
+    n_steps = int(sys.argv[3]) if len(sys.argv) > 3 else 12
+
+    rng = np.random.default_rng(0)
+    batches = [(rng.normal(size=(8, 4)).astype(np.float32),
+                rng.integers(0, 2, (8,)).astype(np.int64))
+               for _ in range(n_steps)]
+    pt.seed(0)
+    net = pt.nn.Linear(4, 2)
+    model = pt.hapi.Model(
+        net, loss=lambda o, y: pt.nn.functional.cross_entropy(o, y),
+        optimizer=pt.optimizer.SGD(learning_rate=0.1))
+    resumed = io.AsyncCheckpointer(ckdir).latest_step() or 0
+    with open(outpath, "w") as f:
+        json.dump({"resumed": resumed,
+                   "attempt": int(os.environ.get("PT_ELASTIC_ATTEMPT",
+                                                 "0"))}, f)
+    model.fit(batches, epochs=1, verbose=0, ckpt_dir=ckdir,
+              save_steps=2)
+    with open(outpath, "w") as f:
+        json.dump({"resumed": resumed, "done": True,
+                   "attempt": int(os.environ.get("PT_ELASTIC_ATTEMPT",
+                                                 "0"))}, f)
+""")
+
+
+class DrillFailure(AssertionError):
+    pass
+
+
+def _check(cond, msg):
+    if not cond:
+        raise DrillFailure(msg)
+
+
+def _env(tmp, fault_spec=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["FLAGS_enable_metrics"] = "1"
+    env["FLAGS_metrics_port"] = "-1"        # no HTTP exporter in drills
+    env["FLAGS_trace_dir"] = os.path.join(tmp, "trace")
+    if fault_spec:
+        env["FLAGS_fault_spec"] = fault_spec
+    else:
+        env.pop("FLAGS_fault_spec", None)
+    return env
+
+
+def _run_trainer(tmp, ckdir, fault_spec=None, steps=12, timeout=240):
+    script = os.path.join(tmp, "trainer.py")
+    if not os.path.exists(script):
+        with open(script, "w") as f:
+            f.write(_TRAINER)
+    out = os.path.join(tmp, "result.json")
+    if os.path.exists(out):
+        os.remove(out)
+    proc = subprocess.run(
+        [sys.executable, script, ckdir, out, str(steps)],
+        env=_env(tmp, fault_spec), capture_output=True, text=True,
+        timeout=timeout)
+    result = json.load(open(out)) if os.path.exists(out) else {}
+    return proc, result
+
+
+def _intact_checkpoints(ckdir):
+    from paddle_tpu import io
+    ck = io.AsyncCheckpointer(ckdir)
+    return {s: io.verify(os.path.join(ckdir, f"ckpt-{s}"))
+            for s in ck.intact_steps()}
+
+
+# --------------------------------------------------------------- drills
+
+def drill_kill_mid_save(tmp):
+    """SIGKILL fired by the checkpoint writer mid-save of step 8."""
+    ck = os.path.join(tmp, "ck_kill")
+    p1, _ = _run_trainer(tmp, ck, fault_spec="ckpt_write:step=8:kill=9")
+    _check(p1.returncode == -signal.SIGKILL,
+           f"expected SIGKILL death, rc={p1.returncode}\n{p1.stderr}")
+    from paddle_tpu import io
+    latest = io.AsyncCheckpointer(ck).latest_step()
+    _check(latest == 6, f"newest intact checkpoint should be 6, "
+           f"got {latest} ({sorted(os.listdir(ck))})")
+    p2, res = _run_trainer(tmp, ck)
+    _check(p2.returncode == 0, f"restart failed rc={p2.returncode}\n"
+           f"{p2.stderr}")
+    _check(res.get("resumed") == 6 and res.get("done"),
+           f"restart should resume from 6 and finish, got {res}")
+    reports = _intact_checkpoints(ck)
+    _check(reports and all(not v for v in reports.values()),
+           f"post-restart checkpoints not intact: {reports}")
+    _check(not glob.glob(os.path.join(ck, "*.tmp")),
+           "stale .tmp staging dir survived the restart")
+    return f"killed mid ckpt-8 write, resumed from 6, finished clean"
+
+
+def drill_corrupt_leaf(tmp):
+    """Bit-flip the newest checkpoint; restore falls back one step."""
+    ck = os.path.join(tmp, "ck_corrupt")
+    p1, _ = _run_trainer(tmp, ck)
+    _check(p1.returncode == 0, f"clean run failed\n{p1.stderr}")
+    from paddle_tpu import io
+    from paddle_tpu.observability import flight, metrics
+    ckptr = io.AsyncCheckpointer(ck)
+    steps = ckptr.intact_steps()
+    _check(len(steps) >= 2, f"need >=2 checkpoints, got {steps}")
+    newest, fallback = steps[-1], steps[-2]
+    leaf = sorted(glob.glob(os.path.join(ck, f"ckpt-{newest}",
+                                         "data", "*.npy")))[0]
+    raw = open(leaf, "rb").read()
+    with open(leaf, "wb") as f:       # same size, different bytes
+        f.write(raw[:-1] + bytes([raw[-1] ^ 0xFF]))
+    _check(io.verify(os.path.join(ck, f"ckpt-{newest}")),
+           "verify() missed the corrupted leaf")
+    before = metrics.counter("checkpoint_corrupt_total",
+                              always=True).value()
+    state, got = ckptr.restore_latest()
+    _check(got == fallback and state is not None,
+           f"restore should fall back to {fallback}, got {got}")
+    _check(metrics.counter("checkpoint_corrupt_total",
+                           always=True).value()
+           == before + 1, "checkpoint_corrupt_total did not increment")
+    events = [e for e in flight.recorder().events()
+              if e.get("kind") == "checkpoint_corrupt"]
+    _check(events, "no checkpoint_corrupt flight event recorded")
+    # a stripped COMMIT marker must also be skipped
+    os.remove(os.path.join(ck, f"ckpt-{fallback}", "COMMIT"))
+    _, got2 = ckptr.restore_latest()
+    _check(got2 is not None and got2 < fallback,
+           f"uncommitted fallback not skipped, got {got2}")
+    return (f"corrupt ckpt-{newest} fell back to {fallback}; "
+            f"stripped COMMIT fell back to {got2}; counter+event ok")
+
+
+def drill_sigterm_mid_fit(tmp):
+    """Scheduler preemption at train step 7, resume where it died."""
+    ck = os.path.join(tmp, "ck_term")
+    p1, _ = _run_trainer(tmp, ck, fault_spec="sigterm:step=7")
+    _check(p1.returncode in (-signal.SIGTERM, 128 + signal.SIGTERM),
+           f"expected SIGTERM wait status, rc={p1.returncode}\n"
+           f"{p1.stderr}")
+    from paddle_tpu import io
+    latest = io.AsyncCheckpointer(ck).latest_step()
+    _check(latest == 8, f"preemption checkpoint should land at 8 "
+           f"(step 7 finished), got {latest}")
+    dumps = glob.glob(os.path.join(tmp, "trace", "flight_*.jsonl"))
+    _check(dumps, "no flight dump written on preemption")
+    dump_text = "".join(open(d).read() for d in dumps)
+    _check("preemption_notice" in dump_text,
+           "flight dump lacks the preemption_notice event")
+    _check("preempt_checkpoint" in dump_text,
+           "flight dump lacks the preempt_checkpoint event")
+    p2, res = _run_trainer(tmp, ck)
+    _check(p2.returncode == 0 and res.get("resumed") == 8
+           and res.get("done"),
+           f"restart should resume from 8 and finish, got "
+           f"rc={p2.returncode} {res}")
+    return "preempted after step 7, checkpointed at 8, resumed at 8"
+
+
+def drill_crash_loop(tmp):
+    """Deterministic crash at step 3; the restart budget fails fast."""
+    from paddle_tpu.distributed.launch import launch_elastic
+    ck = os.path.join(tmp, "ck_loop")
+    script = os.path.join(tmp, "trainer.py")
+    if not os.path.exists(script):
+        with open(script, "w") as f:
+            f.write(_TRAINER)
+    out = os.path.join(tmp, "loop_result.json")
+    log = os.path.join(tmp, "loop_attempts.log")
+    env = _env(tmp, fault_spec="train_step:step=3:exc=RuntimeError")
+    t0 = time.time()
+    rc = launch_elastic(
+        [sys.executable, script, ck, out, "12"], nproc=1,
+        max_restarts=8, env_extra=env, backoff_s=0.05,
+        backoff_max_s=0.2, restart_budget=2, restart_window_s=60.0)
+    elapsed = time.time() - t0
+    _check(rc != 0, "crash loop unexpectedly converged")
+    attempts = json.load(open(out)).get("attempt")
+    _check(attempts == 2,
+           f"budget of 2 should stop after attempts 0,1,2 — last "
+           f"attempt was {attempts}")
+    from paddle_tpu.observability import metrics
+    _check(metrics.counter("elastic_budget_exhausted_total",
+                           always=True).value()
+           >= 1, "budget-exhausted counter not incremented")
+    return (f"crash-loop stopped by budget after 3 attempts "
+            f"({elapsed:.1f}s), not max_restarts=8")
+
+
+DRILLS = {
+    "kill_mid_save": drill_kill_mid_save,
+    "corrupt_leaf": drill_corrupt_leaf,
+    "sigterm_mid_fit": drill_sigterm_mid_fit,
+    "crash_loop": drill_crash_loop,
+}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--self-test", action="store_true",
+                        help="run every drill on CPU and report")
+    parser.add_argument("--drill", choices=sorted(DRILLS),
+                        help="run one drill")
+    parser.add_argument("--keep", action="store_true",
+                        help="keep the scratch directory")
+    args = parser.parse_args(argv)
+    if not args.self_test and not args.drill:
+        parser.error("pass --self-test or --drill NAME")
+
+    # the driver half imports paddle_tpu itself — force CPU first
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    names = [args.drill] if args.drill else sorted(DRILLS)
+    tmp = tempfile.mkdtemp(prefix="chaos_drill_")
+    failures = 0
+    try:
+        for name in names:
+            t0 = time.time()
+            try:
+                summary = DRILLS[name](tmp)
+                print(f"[chaos] {name}: OK ({time.time() - t0:.1f}s) — "
+                      f"{summary}")
+            except DrillFailure as e:
+                failures += 1
+                print(f"[chaos] {name}: FAIL — {e}", file=sys.stderr)
+    finally:
+        if args.keep:
+            print(f"[chaos] scratch kept at {tmp}")
+        else:
+            shutil.rmtree(tmp, ignore_errors=True)
+    if failures:
+        print(f"chaos drill: {failures} of {len(names)} drills FAILED",
+              file=sys.stderr)
+        return 1
+    print(f"chaos drill self-test OK ({len(names)} drills)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
