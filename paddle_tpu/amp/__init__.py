@@ -83,6 +83,30 @@ def low_precision_policy(x, op_name: str = "matmul"):
     return x
 
 
+def all_finite(tree) -> jax.Array:
+    """Scalar bool: every floating leaf of ``tree`` is finite — the
+    check half of the reference's amp_check_finite_and_scale op,
+    usable standalone (the bf16/fp32 skip-step guard). Integer leaves
+    (sparse RowSlices rows, step counters) are ignored."""
+    checks = []
+    for g in jax.tree.leaves(tree):
+        dt = getattr(g, "dtype", None)
+        if dt is not None and jnp.issubdtype(dt, jnp.inexact):
+            checks.append(jnp.all(jnp.isfinite(g)))
+    if not checks:
+        return jnp.asarray(True)
+    return jnp.all(jnp.stack(checks))
+
+
+def select_update(found_inf, updated, current):
+    """Per-leaf ``where(found_inf, current, updated)`` over two
+    same-structure pytrees: the skip-step half of the reference's AMP
+    stack, compiled into the train step — no host sync, the whole
+    update is discarded in-graph when the step saw non-finite grads."""
+    return jax.tree.map(
+        lambda u, c: jnp.where(found_inf, c, u), updated, current)
+
+
 class GradScaler:
     """Dynamic loss scaling (ref: loss_scaler.py:27 AmpScaler;
     update rule: update_loss_scaling op — incr every
@@ -128,9 +152,11 @@ class GradScaler:
         if not self.enable:
             return grads, jnp.zeros((), bool)
         inv = 1.0 / state["scale"]
-        unscaled = jax.tree.map(lambda g: g * inv.astype(g.dtype), grads)
-        finite = [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(unscaled)]
-        found_inf = ~jnp.all(jnp.stack(finite))
+        unscaled = jax.tree.map(
+            lambda g: g * inv.astype(g.dtype)
+            if jnp.issubdtype(getattr(g, "dtype", jnp.int32),
+                              jnp.inexact) else g, grads)
+        found_inf = ~all_finite(unscaled)
         return unscaled, found_inf
 
     def update(self, state, found_inf):

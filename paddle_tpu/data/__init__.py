@@ -287,6 +287,44 @@ class DataLoader:
             raise TypeError("IterableDataset has no length")
         return len(self.batch_sampler)
 
+    def iter_from(self, batch_offset: int):
+        """One epoch's batches starting at ``batch_offset``, skipping
+        the earlier ones WITHOUT fetching or collating them — the
+        checkpoint-resume fast path (docs/fault_tolerance.md
+        "Numerical faults & exact resume"). The batch sampler is still
+        consumed for the skipped positions, so a seeded shuffle yields
+        exactly the batches the uninterrupted epoch would have
+        produced from ``batch_offset`` on. Iterable datasets have no
+        indexable sampler and fall back to consuming raw samples."""
+        batch_offset = max(0, int(batch_offset))
+        if batch_offset == 0:
+            yield from self
+            return
+        if self.batch_sampler is None:
+            # iterable path: samples must be drawn to advance the
+            # stream; only collation is skipped
+            for j, batch in enumerate(self._iter_batches()):
+                if j >= batch_offset:
+                    yield batch
+            return
+        indices = list(self.batch_sampler)[batch_offset:]
+        if not indices:
+            return
+        if self.num_workers > 0:
+            from .worker import MultiprocessIter
+            it = MultiprocessIter(
+                self.dataset, self.collate_fn, indices,
+                self.num_workers, prefetch_factor=self.prefetch_factor,
+                mp_start_method=self.mp_start_method)
+            try:
+                yield from it
+            finally:
+                it.shutdown()
+            return
+        for batch_indices in indices:
+            yield self.collate_fn([self.dataset[i]
+                                   for i in batch_indices])
+
 
 class DeviceLoader:
     """Async host→device prefetch (ref: buffered_reader.h:46 ReadAsync).

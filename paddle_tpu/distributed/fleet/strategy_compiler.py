@@ -247,6 +247,8 @@ class _ComposedTrainStep(ShardedTrainStep):
             (loss, (new_buffers, _)), grads = jax.value_and_grad(
                 lf, has_aux=True)(params)
 
+        from ...amp import all_finite, select_update
+        from ...static import probe_nonfinite
         extra = {}
         if self.scaler is not None:
             # unscale + finite check; on inf/nan skip the update and let
@@ -255,22 +257,27 @@ class _ComposedTrainStep(ShardedTrainStep):
             grads, found_inf = self.scaler.unscale(grads, state["amp"])
             upd_params, upd_opt = self.optimizer.apply_gradients(
                 params, grads, state["opt"], lr_override=batch.get("lr"))
-            new_params = jax.tree.map(
-                lambda u, p: jnp.where(found_inf, p, u), upd_params,
-                params)
-            new_opt = jax.tree.map(
-                lambda u, o: jnp.where(found_inf, o, u), upd_opt,
-                state["opt"])
+            new_params = select_update(found_inf, upd_params, params)
+            new_opt = select_update(found_inf, upd_opt, state["opt"])
             # a skipped step must not commit anything from the overflowed
             # forward — including BN running stats
-            new_buffers = jax.tree.map(
-                lambda u, o: jnp.where(found_inf, o, u), new_buffers,
-                buffers)
+            new_buffers = select_update(found_inf, new_buffers, buffers)
             extra["amp"] = self.scaler.update(state["amp"], found_inf)
             loss = loss / state["amp"]["scale"].astype(loss.dtype)
+            probe_nonfinite(found_inf)
         else:
             new_params, new_opt = self.optimizer.apply_gradients(
                 params, grads, state["opt"], lr_override=batch.get("lr"))
+            if self._skip_guard:
+                # bf16/fp32 runs get the skip-step guard alone
+                found_inf = ~all_finite(grads)
+                new_params = select_update(found_inf, new_params,
+                                           params)
+                new_opt = select_update(found_inf, new_opt,
+                                        state["opt"])
+                new_buffers = select_update(found_inf, new_buffers,
+                                            buffers)
+                probe_nonfinite(found_inf)
 
         return ({**state, "params": new_params, "buffers": new_buffers,
                  "opt": new_opt, "rng": rng, **extra}, {"loss": loss})

@@ -403,6 +403,36 @@ define_flag("fault_spec", "",
             "(default) disarms every point — the hit() hook is a "
             "near-free early return. Used by tools/chaos_drill.py.",
             on_change=_fault_spec_changed)
+define_flag("skip_nonfinite_steps", True,
+            "Compile a finiteness guard into TrainStep/ShardedTrainStep:"
+            " when any gradient leaf is NaN/Inf the whole "
+            "optimizer/buffer update is discarded in-graph (lax select,"
+            " no host sync) and the step is counted in "
+            "nonfinite_steps_total instead of poisoning the weights — "
+            "the reference's amp_check_finite_and_scale semantics, "
+            "applied to every precision (fp16 runs additionally get "
+            "GradScaler backoff). Costs one fused isfinite reduction "
+            "per gradient leaf. Read at train-step construction.")
+define_flag("rollback_budget", 2,
+            "Divergence-watchdog rollback budget for one "
+            "hapi.Model.fit(ckpt_dir=...) run: when the watchdog trips "
+            "(a NaN/spike streak on the loss, FLAGS_divergence_streak),"
+            " fit restores the newest intact checkpoint and replays — "
+            "at most this many times; the next trip after the budget "
+            "is exhausted raises. 0 disables rollback (the watchdog "
+            "still counts anomalies). Rollback needs "
+            "FLAGS_enable_metrics (the loss probes feed the watchdog).")
+define_flag("rollback_lr_factor", 1.0,
+            "Learning-rate multiplier applied on divergence-rollback "
+            "re-entry (e.g. 0.5 halves the LR after each rollback) — "
+            "compiled in as a runtime scalar, so the first rollback "
+            "retraces the step once. 1.0 leaves the LR untouched.")
+define_flag("divergence_streak", 5,
+            "Consecutive anomalous loss samples (NaN/Inf or EWMA spike "
+            "per FLAGS_anomaly_spike_factor) before the divergence "
+            "watchdog declares the run diverged and fit rolls back to "
+            "the newest intact checkpoint. A clean sample resets the "
+            "streak.")
 define_flag("recompile_warn_threshold", 8,
             "Warn (once per function) when one jit entry point has "
             "been traced for at least this many distinct input "

@@ -12,6 +12,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -135,15 +136,53 @@ _CKPT_KEYS = ("params", "buffers", "opt")
 
 
 def _ckpt_state_of(step) -> Optional[Dict]:
-    """The checkpointable slice of a train step's state: params,
-    buffers, optimizer slots. The RNG key is deliberately excluded —
-    key arrays are backend-specific (FLAGS_use_fast_rng) and a resumed
-    run restarting its dropout stream is harmless."""
+    """The checkpointable slice of a train step's state: the FULL
+    training state — params, buffers, optimizer slots, the RNG key
+    stream, and (under fp16 AMP) the GradScaler state. This is the
+    checkpoint-v3 exact-resume contract: a SIGKILLed-then-resumed run
+    continues the uninterrupted trajectory bit-for-bit (PRNG keys
+    round-trip via io's prng_key leaves). A v2 checkpoint without the
+    extra leaves still restores — the step keeps its fresh rng/scaler,
+    which is the old approximate-resume behavior."""
     state = getattr(step, "state", None)
     if not isinstance(state, dict) \
             or not all(k in state for k in _CKPT_KEYS):
         return None
-    return {k: state[k] for k in _CKPT_KEYS}
+    return dict(state)
+
+
+def _fit_host_state(global_step: int, epoch: int,
+                    batch_in_epoch: int) -> Dict:
+    """Manifest host_state section for fit checkpoints: where in the
+    data stream the save landed, so a resume (or a human reading the
+    manifest) can re-enter exactly there."""
+    return {"global_step": int(global_step), "epoch": int(epoch),
+            "batch_in_epoch": int(batch_in_epoch)}
+
+
+def _parse_amp(amp):
+    """``fit(amp=...)`` → ``(amp_dtype, GradScaler | None)``.
+
+    fp16 gets the dynamic loss scaler (scale-up/scale-down +
+    skip-on-inf compiled into the step); bf16 — the TPU-native low
+    precision, same exponent range as fp32 — needs no scaling and gets
+    the skip-step guard alone. A GradScaler instance implies fp16."""
+    from . import amp as amp_mod
+    if amp is None or amp is False:
+        return None, None
+    if isinstance(amp, amp_mod.GradScaler):
+        return "float16", amp
+    if amp is True:
+        amp = "bfloat16"
+    from .core.dtype import convert_dtype
+    dtype = str(convert_dtype(amp))
+    if dtype == "float16":
+        return dtype, amp_mod.GradScaler()
+    if dtype == "bfloat16":
+        return dtype, None
+    raise ValueError(
+        "fit(amp=...) expects 'float16'/'bfloat16' (or a GradScaler "
+        f"instance), got {amp!r}")
 
 
 def _as_metric_list(metrics) -> List[Metric]:
@@ -169,6 +208,8 @@ class Model:
         self._fitting = False
         self._mesh = None
         self._mesh_kwargs: Dict = {}
+        self._amp_dtype = None
+        self._scaler = None
 
     def prepare(self, optimizer: Optional[Optimizer] = None,
                 loss: Optional[Callable] = None,
@@ -221,10 +262,13 @@ class Model:
                 from .parallel import ShardedTrainStep
                 self._train_step = ShardedTrainStep(
                     self.network, self._optimizer, loss_call, self._mesh,
-                    extra_metrics=extra, **self._mesh_kwargs)
+                    extra_metrics=extra, amp_dtype=self._amp_dtype,
+                    scaler=self._scaler, **self._mesh_kwargs)
             else:
-                self._train_step = TrainStep(self.network, self._optimizer,
-                                             loss_call, extra_metrics=extra)
+                self._train_step = TrainStep(
+                    self.network, self._optimizer, loss_call,
+                    extra_metrics=extra, amp_dtype=self._amp_dtype,
+                    scaler=self._scaler)
         return self._train_step
 
     def train_batch(self, inputs, labels) -> Dict[str, float]:
@@ -249,20 +293,52 @@ class Model:
             callbacks: Optional[List[Callback]] = None,
             verbose: int = 1, log_freq: int = 10,
             ckpt_dir: Optional[str] = None, save_steps: int = 0,
-            ckpt_max_to_keep: int = 3) -> Dict[str, List[float]]:
+            ckpt_max_to_keep: int = 3,
+            amp=None) -> Dict[str, List[float]]:
         """Train; returns per-epoch history {metric: [v_epoch0, ...]}.
 
         With ``ckpt_dir=`` fit becomes fault-tolerant at STEP
         granularity (docs/fault_tolerance.md): an ``io.AsyncCheckpointer``
-        saves params/buffers/optimizer state every ``save_steps`` steps
-        (plus once at the end), and a fresh fit over the same directory
-        auto-resumes — the newest intact checkpoint is restored and the
-        data stream fast-forwarded past the completed steps. SIGTERM
-        (scheduler preemption) is caught by a preemption guard: the
-        in-flight step finishes, a final synchronous checkpoint is
-        forced at the preempted step, and the signal is re-raised so
-        the process still dies with the SIGTERM wait status."""
+        saves the FULL training state (params/buffers/optimizer plus
+        the RNG stream and GradScaler state — checkpoint v3) every
+        ``save_steps`` steps (plus once at the end), and a fresh fit
+        over the same directory auto-resumes bit-exactly: the newest
+        intact checkpoint is restored and the data stream re-entered at
+        the saved offset (``DataLoader.iter_from``; loaders without a
+        sampler are fast-forwarded by replay). SIGTERM (scheduler
+        preemption) is caught by a preemption guard: the in-flight step
+        finishes, a final synchronous checkpoint is forced at the
+        preempted step, and the signal is re-raised so the process
+        still dies with the SIGTERM wait status.
+
+        ``amp='float16'`` compiles dynamic loss scaling
+        (``amp.GradScaler``: scale-up after clean steps, back-off +
+        skip on overflow) into the train step; ``amp='bfloat16'`` runs
+        the forward under bf16 autocast with the skip-step guard alone.
+        Non-finite gradients never poison the weights either way — the
+        update is discarded in-graph and counted in
+        ``nonfinite_steps_total`` (FLAGS_skip_nonfinite_steps).
+
+        Divergence rollback: while metrics are on and ``ckpt_dir`` is
+        set, a watchdog fed by the anomaly sentinel's loss probes rolls
+        fit back to the newest intact checkpoint after
+        FLAGS_divergence_streak consecutive NaN/spike loss samples — at
+        most FLAGS_rollback_budget times, optionally rescaling the LR
+        by FLAGS_rollback_lr_factor on each re-entry."""
         callbacks = list(callbacks or [])
+        if amp is not None:
+            from . import amp as amp_mod
+            amp_dtype, scaler = _parse_amp(amp)
+            changed = (amp_dtype != self._amp_dtype
+                       or (scaler is None) != (self._scaler is None)
+                       or (isinstance(amp, amp_mod.GradScaler)
+                           and scaler is not self._scaler))
+            if changed:
+                # the compiled step bakes the AMP policy in — rebuild
+                # (weights live in the network between fits; optimizer
+                # slots restart unless a checkpoint restores them)
+                self._amp_dtype, self._scaler = amp_dtype, scaler
+                self._train_step = None
         if verbose:
             callbacks.append(ProgBarLogger(log_freq, verbose))
         if self._optimizer is not None and not any(
@@ -287,6 +363,7 @@ class Model:
         guard = _preempt.guard()
         guard.__enter__()
         preempted = False
+        watchdog = None
         self._fitting = True
         try:
             for cb in callbacks:
@@ -316,8 +393,22 @@ class Model:
                 if mesh is not None and axis in dict(mesh.shape) \
                         and mesh.shape[axis] > 1:
                     straggler = _obs.goodput.StragglerDetector(mesh, axis)
+            watchdog = None
+            if ckptr is not None and _obs.enabled() \
+                    and int(GLOBAL_FLAGS.get("rollback_budget")) > 0:
+                # divergence rollback: fed by the loss probes the
+                # anomaly sentinel already streams out of the compiled
+                # step — no extra sync, no extra probes
+                watchdog = _obs.anomaly.DivergenceWatchdog().attach(
+                    _obs.anomaly.sentinel())
+            rollbacks = 0
             global_step = 0
-            for epoch in range(epochs):
+            epoch = 0
+            i = -1
+            # while (not for): a divergence rollback rewinds `epoch`
+            # and replays from the restored step
+            while epoch < epochs:
+                rollback = False
                 for cb in callbacks:
                     cb.on_epoch_begin(epoch)
                 # HOT LOOP: no host sync per step. Metrics stay device
@@ -359,8 +450,31 @@ class Model:
                         "achieved_flops_per_sec",
                         "XLA cost-model FLOPs of the compiled train "
                         "step divided by measured step wall time")
+                    scale_g = _obs.gauge(
+                        "amp_loss_scale",
+                        "current GradScaler dynamic loss scale "
+                        "(fp16 AMP; held as a device array, synced "
+                        "only at snapshot time)") \
+                        if "scaler" in getattr(step, "state", {}) \
+                        else None
                 batches = iter(train_loader)
                 i = -1
+                skip = resume_step - global_step
+                if skip > 0 and hasattr(train_loader, "iter_from"):
+                    # checkpointable sampler offset: re-enter the data
+                    # stream at the saved batch index without fetching
+                    # or collating the skipped batches (the loader
+                    # still consumes its sampler, so a seeded shuffle
+                    # replays the identical order)
+                    try:
+                        n_epoch = len(train_loader)
+                    except TypeError:
+                        n_epoch = None
+                    if n_epoch:
+                        take = min(skip, n_epoch)
+                        batches = train_loader.iter_from(take)
+                        global_step += take
+                        i = take - 1
                 while True:
                     if _faults.active() and global_step >= resume_step:
                         _faults.hit("loader", step=global_step)
@@ -384,6 +498,7 @@ class Model:
                         global_step += 1
                         continue
                     if _faults.active():
+                        _faults.set_step_context(global_step)
                         _faults.hit("train_step", step=global_step)
                         _faults.hit("sigterm", step=global_step)
                     if obs_on:
@@ -411,6 +526,8 @@ class Model:
                             if np.ndim(label) else 1
                         tput_g.set(items / dt if dt > 0 else 0.0)
                         loss_g.set(metrics.get("loss"))
+                        if scale_g is not None:
+                            scale_g.set(step.state["scaler"]["scale"])
                         hb_g.set(time.time())
                         for dev, ms in _obs.device_memory_stats(
                                 include_unavailable=True,
@@ -437,7 +554,9 @@ class Model:
                     if ckptr is not None and save_steps > 0 \
                             and global_step % save_steps == 0:
                         ckptr.save(_ckpt_state_of(step),
-                                   step=global_step)
+                                   step=global_step,
+                                   host_state=_fit_host_state(
+                                       global_step, epoch, i))
                         _obs.flight.record("checkpoint_save",
                                            step=global_step)
                     if guard.preempted:
@@ -445,8 +564,55 @@ class Model:
                         # take the final-checkpoint path below
                         preempted = True
                         break
+                    if watchdog is not None and watchdog.tripped():
+                        rollback = True
+                        break
                 if preempted:
                     break
+                if rollback:
+                    budget = int(GLOBAL_FLAGS.get("rollback_budget"))
+                    rollbacks += 1
+                    _obs.counter(
+                        "rollbacks_total",
+                        "divergence-watchdog checkpoint rollbacks "
+                        "performed by Model.fit", always=True).inc()
+                    _obs.flight.record("fit_rollback", force=True,
+                                       at_step=global_step,
+                                       n=rollbacks)
+                    if rollbacks > budget:
+                        raise FloatingPointError(
+                            f"training diverged again after {budget} "
+                            "rollback(s) — FLAGS_rollback_budget "
+                            "exhausted; newest intact checkpoint is "
+                            f"step {ckptr.latest_step()}")
+                    # drain in-flight probe callbacks so stale
+                    # pre-rollback anomalies cannot re-trip the fresh
+                    # watchdog state
+                    jax.effects_barrier()
+                    restored, at = ckptr.restore_latest(
+                        target=_ckpt_state_of(step))
+                    if restored is None:
+                        raise FloatingPointError(
+                            "training diverged and no intact "
+                            "checkpoint exists to roll back to "
+                            f"(ckpt_dir={ckpt_dir!r})")
+                    step.state.update(restored)
+                    resume_step = int(at or 0)
+                    global_step = 0
+                    factor = float(
+                        GLOBAL_FLAGS.get("rollback_lr_factor"))
+                    if factor != 1.0 and hasattr(step, "lr_scale"):
+                        # picked up as a runtime scalar by the step
+                        # (one retrace on first rescale)
+                        step.lr_scale = step.lr_scale * factor
+                    _obs.anomaly.sentinel().reset()
+                    watchdog.reset()
+                    _obs.flight.record(
+                        "fit_rollback_resume", force=True,
+                        resume_step=resume_step,
+                        lr_scale=getattr(step, "lr_scale", 1.0))
+                    epoch = 0
+                    continue
                 logs = {k: float(v) / max(count, 1)
                         for k, v in totals.items()}
                 if eval_loader is not None:
@@ -461,6 +627,7 @@ class Model:
                 if any(getattr(cb, "stop_training", False)
                        for cb in callbacks):
                     break
+                epoch += 1
             if preempted:
                 _obs.flight.record("preempted", force=True,
                                    step=global_step)
@@ -470,7 +637,9 @@ class Model:
                     # preemption landed on, not the last save interval
                     try:
                         ckptr.save(_ckpt_state_of(step),
-                                   step=global_step)
+                                   step=global_step,
+                                   host_state=_fit_host_state(
+                                       global_step, epoch, i))
                         ckptr.wait()
                         _obs.flight.record("preempt_checkpoint",
                                            force=True, step=global_step)
@@ -485,7 +654,9 @@ class Model:
                 # make the end state durable before fit returns; skip
                 # the save when the cadence just wrote this exact step
                 if save_steps <= 0 or global_step % save_steps != 0:
-                    ckptr.save(_ckpt_state_of(step), step=global_step)
+                    ckptr.save(_ckpt_state_of(step), step=global_step,
+                               host_state=_fit_host_state(
+                                   global_step, epoch, i))
                 ckptr.wait()
             if _obs.enabled():
                 _obs.flight.record("fit_end", steps_run=global_step)
@@ -498,6 +669,10 @@ class Model:
         finally:
             guard.__exit__(None, None, None)
             self._fitting = False
+            if watchdog is not None:
+                watchdog.detach(_obs.anomaly.sentinel())
+            if _faults.active():
+                _faults.set_step_context(None)
             if ledger.running():  # interrupted fit: close the books
                 ledger.stop()
             # Must run even on an interrupted fit: the jitted step donated

@@ -57,9 +57,42 @@ def _ckpt_measure():
         return contextlib.nullcontext()
 
 _SENTINEL_KEY = "__paddle_tpu_ckpt__"
-_VERSION = 2                    # v2 adds per-leaf crc32/nbytes + COMMIT
-_SUPPORTED_VERSIONS = (1, 2)    # v1 (pre-integrity) stays loadable
+_VERSION = 3                    # v3 adds host_state + PRNG-key leaves
+_SUPPORTED_VERSIONS = (1, 2, 3)  # v1 (pre-integrity) / v2 stay loadable
 _COMMIT_NAME = "COMMIT"
+_KEY_DTYPE_PREFIX = "prng_key:"  # manifest dtype marker for key arrays
+
+
+class _KeyLeaf:
+    """Host-side stand-in for a jax PRNG key array: the raw counter
+    bits plus the impl name, so a key survives the host materialization
+    + background-writer round trip and restores bit-exactly (exact
+    resume needs the dropout stream, not just the weights)."""
+
+    __slots__ = ("data", "impl")
+
+    def __init__(self, data, impl: str) -> None:
+        self.data = np.asarray(data)
+        self.impl = str(impl)
+
+
+def _is_key_array(x) -> bool:
+    try:
+        import jax.numpy as jnp
+        return jnp.issubdtype(x.dtype, jax.dtypes.prng_key)
+    except (AttributeError, TypeError):
+        return False
+
+
+def host_leaf(x):
+    """Materialize one state leaf on host (np.asarray), keeping PRNG
+    key arrays restorable via :class:`_KeyLeaf`."""
+    if isinstance(x, _KeyLeaf):
+        return x
+    if _is_key_array(x):
+        return _KeyLeaf(np.asarray(jax.random.key_data(x)),
+                        jax.random.key_impl(x))
+    return np.asarray(x)
 
 
 def _verify_default() -> bool:
@@ -117,7 +150,7 @@ def _flatten(state) -> Dict[str, np.ndarray]:
                 "train step (in-place HBM update). Call the step's "
                 ".sync_to_model() first to write the trained values "
                 "back into the layer, then save.")
-        flat[key] = np.asarray(leaf)
+        flat[key] = host_leaf(leaf)
     return flat
 
 
@@ -136,8 +169,17 @@ _BUILTIN_DTYPES = {
 
 
 def save(state: Any, path: str, step: Optional[int] = None,
-         overwrite: bool = True) -> None:
-    """Save a pytree (state dict, TrainStep.state, ...) to ``path``."""
+         overwrite: bool = True,
+         host_state: Optional[Dict[str, Any]] = None) -> None:
+    """Save a pytree (state dict, TrainStep.state, ...) to ``path``.
+
+    ``host_state`` (v3) is a JSON-serializable dict of host-side
+    training position — data-loader batch offset, epoch, global step —
+    stored in the manifest next to the array leaves, so a resume can
+    re-enter the data stream exactly where the save left it
+    (docs/fault_tolerance.md "Numerical faults & exact resume").
+    PRNG key arrays are first-class leaves: their counter bits and impl
+    name round-trip bit-exactly."""
     # a trailing separator would stage the tmp dir INSIDE the target,
     # which the overwrite rmtree then destroys mid-save
     path = os.path.normpath(path)
@@ -150,7 +192,14 @@ def save(state: Any, path: str, step: Optional[int] = None,
     leaves: Dict[str, Dict[str, Any]] = {}
     for k, v in flat.items():
         fname = k.replace("/", "__") + ".npy"
-        arr = np.asarray(v)
+        if isinstance(v, _KeyLeaf):
+            # PRNG keys: the raw counter bits on disk, the impl in the
+            # dtype string — load() wraps them back into a key array
+            arr = v.data
+            dtype_str = _KEY_DTYPE_PREFIX + v.impl
+        else:
+            arr = np.asarray(v)
+            dtype_str = str(v.dtype)
         # numpy serializes ml_dtypes extension floats (bfloat16,
         # float8_*) as raw void records and np.load hands back 'V2'
         # garbage — store those as uintN bits and restore via the
@@ -167,7 +216,7 @@ def save(state: Any, path: str, step: Optional[int] = None,
         raw = buf.getvalue()
         with open(os.path.join(tmp, "data", fname), "wb") as f:
             f.write(raw)
-        leaves[k] = {"shape": list(v.shape), "dtype": str(v.dtype),
+        leaves[k] = {"shape": list(arr.shape), "dtype": dtype_str,
                      "crc32": zlib.crc32(raw), "nbytes": len(raw)}
     manifest = {
         _SENTINEL_KEY: _VERSION,
@@ -175,6 +224,8 @@ def save(state: Any, path: str, step: Optional[int] = None,
         "treedef": str(treedef),
         "leaves": leaves,
     }
+    if host_state is not None:
+        manifest["host_state"] = host_state
     mbytes = json.dumps(manifest, indent=1).encode()
     with open(os.path.join(tmp, "manifest.json"), "wb") as f:
         f.write(mbytes)
@@ -270,7 +321,11 @@ def load(path: str, target: Optional[Any] = None,
         else:
             arr = np.load(fpath)
         want = meta_d.get("dtype")
-        if want and str(arr.dtype) != want:
+        if want and want.startswith(_KEY_DTYPE_PREFIX):
+            arr = jax.random.wrap_key_data(
+                jax.numpy.asarray(arr),
+                impl=want[len(_KEY_DTYPE_PREFIX):])
+        elif want and str(arr.dtype) != want:
             if want not in _BUILTIN_DTYPES:
                 import ml_dtypes
                 arr = arr.view(getattr(ml_dtypes, want))
@@ -295,6 +350,15 @@ def load(path: str, target: Optional[Any] = None,
 def load_step(path: str) -> Optional[int]:
     with open(os.path.join(path, "manifest.json")) as f:
         return json.load(f).get("step")
+
+
+def load_host_state(path: str) -> Optional[Dict[str, Any]]:
+    """The manifest's ``host_state`` section (v3), or None for
+    pre-v3 checkpoints / saves without one. Reads only the manifest —
+    no array data is touched."""
+    with open(os.path.join(os.path.normpath(path),
+                           "manifest.json")) as f:
+        return json.load(f).get("host_state")
 
 
 def verify(path: str) -> List[str]:
@@ -379,7 +443,8 @@ class AsyncCheckpointer:
                 f" {err!r} (re-raised at the next save()/wait())"
             ) from err
 
-    def save(self, state: Any, step: int) -> None:
+    def save(self, state: Any, step: int,
+             host_state: Optional[Dict[str, Any]] = None) -> None:
         with _ckpt_measure():
             self.wait()
             # materialize on host before handing to the thread;
@@ -396,12 +461,12 @@ class AsyncCheckpointer:
                         "donated to a train step (in-place HBM "
                         "update). Call the step's .sync_to_model() "
                         "first, or checkpoint step.state directly.")
-            host_state = jax.tree.map(np.asarray, state)
+            host_tree = jax.tree.map(host_leaf, state)
 
         def work():
             path = os.path.join(self.directory, f"ckpt-{step}")
             try:
-                save(host_state, path, step=step)
+                save(host_tree, path, step=step, host_state=host_state)
                 self._gc()
             except BaseException as e:  # noqa: BLE001 — captured, not lost
                 self._error = e
@@ -456,6 +521,16 @@ class AsyncCheckpointer:
     def latest_step(self) -> Optional[int]:
         steps = self.intact_steps()
         return steps[-1] if steps else None
+
+    def host_state(self, step: Optional[int] = None
+                   ) -> Optional[Dict[str, Any]]:
+        """host_state section of one checkpoint (default: newest
+        committed); None when absent (pre-v3) or nothing committed."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None
+        return load_host_state(os.path.join(self.directory,
+                                            f"ckpt-{step}"))
 
     def verify(self, step: Optional[int] = None) -> List[str]:
         """Full integrity report for one checkpoint (default: newest

@@ -33,7 +33,8 @@ from . import flight as _flight
 from . import metrics as _metrics
 from . import rotation as _rotation
 
-__all__ = ["AnomalySentinel", "sentinel", "probe"]
+__all__ = ["AnomalySentinel", "sentinel", "probe",
+           "DivergenceWatchdog"]
 
 _WARMUP_SAMPLES = 5
 _EWMA_ALPHA = 0.1
@@ -45,6 +46,23 @@ class AnomalySentinel:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._series: Dict[str, Dict[str, float]] = {}
+        self._listeners: list = []
+
+    # -- listeners ---------------------------------------------------------
+
+    def add_listener(self, fn) -> None:
+        """Register ``fn(series, value, kind)`` called on EVERY
+        observed sample (kind None for clean ones) — the divergence
+        watchdog's feed. Listener exceptions are swallowed: a broken
+        consumer must not poison the probe stream."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
 
     # -- traced entry point ------------------------------------------------
 
@@ -87,6 +105,13 @@ class AnomalySentinel:
                 st["n"] += 1
         if kind is not None:
             self._record(kind, series, value, ewma)
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(series, value, kind)
+            except Exception:  # noqa: BLE001 — see add_listener
+                pass
         return kind
 
     @staticmethod
@@ -124,6 +149,59 @@ class AnomalySentinel:
     def reset(self) -> None:
         with self._lock:
             self._series.clear()
+
+
+class DivergenceWatchdog:
+    """Trips when a watched series produces ``streak`` CONSECUTIVE
+    anomalous samples (NaN/Inf, or an EWMA spike per
+    FLAGS_anomaly_spike_factor) — the divergence detector behind
+    ``hapi.Model.fit``'s checkpoint rollback. Feeds off the sentinel's
+    listener stream, so it sees exactly what the in-graph probes see
+    (async, never a host sync). A clean sample resets the streak."""
+
+    def __init__(self, series=("loss",),
+                 streak: Optional[int] = None) -> None:
+        self.series = set(series)
+        self._need = int(streak) if streak else self._streak_flag()
+        self._lock = threading.Lock()
+        self._streak = 0
+        self._tripped = False
+
+    @staticmethod
+    def _streak_flag() -> int:
+        try:
+            from ..flags import GLOBAL_FLAGS
+            return max(1, int(GLOBAL_FLAGS.get("divergence_streak")))
+        except Exception:
+            return 5
+
+    def sample(self, series: str, value: float,
+               kind: Optional[str]) -> None:
+        """Sentinel-listener entry point."""
+        if series not in self.series:
+            return
+        with self._lock:
+            if kind is None:
+                self._streak = 0
+            else:
+                self._streak += 1
+                if self._streak >= self._need:
+                    self._tripped = True
+
+    def attach(self, sent: "AnomalySentinel") -> "DivergenceWatchdog":
+        sent.add_listener(self.sample)
+        return self
+
+    def detach(self, sent: "AnomalySentinel") -> None:
+        sent.remove_listener(self.sample)
+
+    def tripped(self) -> bool:
+        return self._tripped
+
+    def reset(self) -> None:
+        with self._lock:
+            self._streak = 0
+            self._tripped = False
 
 
 _SENTINEL = AnomalySentinel()

@@ -207,16 +207,28 @@ class ShardedTrainStep:
                  param_rule: Optional[Callable] = None,
                  seed: int = 0,
                  extra_metrics: Optional[Dict[str, Callable]] = None,
-                 zero_stage: int = 0, dp_axis: str = "dp") -> None:
+                 zero_stage: int = 0, dp_axis: str = "dp",
+                 amp_dtype=None, scaler=None) -> None:
         self.model = model
         self.optimizer = optimizer
-        from ..static import _wire_param_meta
+        from ..static import _wire_param_meta, _skip_guard_default
         _wire_param_meta(model, optimizer)
         self.loss_fn = loss_fn
         self.mesh = mesh
         self.batch_spec = batch_spec
         self.axis = dp_axis  # straggler detector keys the dp exchange
         self.extra_metrics = extra_metrics or {}
+        # AMP / skip-step guard (same contract as TrainStep). getattr
+        # defaults keep subclasses that set these before super().__init__
+        # (_ComposedTrainStep) authoritative.
+        if scaler is not None and not scaler.enable:
+            scaler = None
+        self.scaler = scaler if scaler is not None \
+            else getattr(self, "scaler", None)
+        self.amp_dtype = amp_dtype if amp_dtype is not None \
+            else getattr(self, "amp_dtype", None)
+        self._skip_guard = _skip_guard_default()
+        self.lr_scale = 1.0
 
         params = model.param_dict()
         buffers = model.buffer_dict()
@@ -367,33 +379,77 @@ class ShardedTrainStep:
 
     def extra_state(self):
         """Subclass hook: {name: (initial_value, PartitionSpec tree)}
-        merged into the carried state before compilation."""
-        return {}
+        merged into the carried state before compilation. The base
+        class registers the GradScaler state here (replicated)."""
+        if getattr(self, "scaler", None) is None:
+            return {}
+        st = self.scaler.init()
+        return {"scaler": (st, jax.tree.map(lambda _: P(), st))}
 
     def _step(self, state, batch):
+        import contextlib
+
+        from .. import amp as _amp
+        from ..static import apply_fault_mults, probe_nonfinite
         params = state["params"]
         buffers = state["buffers"]
         rng, step_key = jax.random.split(state["rng"])
+        scaler = self.scaler if "scaler" in state else None
 
         def loss_of(p):
-            with _random.rng_scope(default=step_key, dropout=step_key):
+            ctx = _amp.auto_cast(enable=True, dtype=self.amp_dtype) \
+                if self.amp_dtype is not None \
+                else contextlib.nullcontext()
+            with ctx, _random.rng_scope(default=step_key,
+                                        dropout=step_key):
                 out, new_buffers = functional_call(
                     self.model, p, buffers, *batch["args"],
                     capture_buffers=True, **batch.get("kwargs", {}))
                 loss = self.loss_fn(out, *batch["labels"])
+            if scaler is not None:
+                loss = scaler.scale(loss, state["scaler"])
             return loss, (new_buffers, out)
 
         (loss, (new_buffers, out)), grads = jax.value_and_grad(
             loss_of, has_aux=True)(params)
+        loss, grads = apply_fault_mults(loss, grads, batch)
+        found_inf = None
+        if scaler is not None:
+            grads, found_inf = scaler.unscale(grads, state["scaler"])
+            loss = loss / state["scaler"]["scale"].astype(loss.dtype)
+        elif self._skip_guard:
+            found_inf = ~_amp.all_finite(grads)
+        lr = batch.get("lr")
+        if "lr_scale" in batch:
+            from ..optimizer.lr import resolve_lr
+            base = lr if lr is not None else resolve_lr(
+                self.optimizer.learning_rate, state["opt"]["step"] + 1)
+            lr = base * batch["lr_scale"]
         new_params, new_opt = self.optimizer.apply_gradients(
-            params, grads, state["opt"], lr_override=batch.get("lr"))
+            params, grads, state["opt"], lr_override=lr)
+        if found_inf is not None:
+            # skip-step guard: discard the whole update in-graph on
+            # non-finite grads (no host sync; XLA keeps the select
+            # local per shard)
+            new_params = _amp.select_update(found_inf, new_params,
+                                            params)
+            new_opt = _amp.select_update(found_inf, new_opt,
+                                         state["opt"])
+            new_buffers = _amp.select_update(found_inf, new_buffers,
+                                             buffers)
+            probe_nonfinite(found_inf)
         metrics = {"loss": loss}
         for name, fn in self.extra_metrics.items():
             metrics[name] = fn(out, *batch["labels"])
-        # **state first: subclass-registered extra state (extra_state())
-        # passes through untouched
-        return ({**state, "params": new_params, "buffers": new_buffers,
-                 "opt": new_opt, "rng": rng}, metrics)
+        new_state = {**state, "params": new_params,
+                     "buffers": new_buffers, "opt": new_opt,
+                     "rng": rng}
+        if scaler is not None:
+            new_state["scaler"] = scaler.update(state["scaler"],
+                                                found_inf)
+        # **state first above: subclass-registered extra state
+        # (extra_state()) passes through untouched
+        return (new_state, metrics)
 
     def shard_batch(self, *arrays):
         """Place host arrays onto the mesh with the batch sharding."""
@@ -408,6 +464,10 @@ class ShardedTrainStep:
             {"args": args, "labels": as_label_tuple(labels),
              "kwargs": kwargs},
             self.optimizer)
+        from ..static import inject_fault_mults
+        inject_fault_mults(batch)
+        if self.lr_scale != 1.0:
+            batch["lr_scale"] = jnp.float32(self.lr_scale)
         batch = self._place_batch(batch)
         from ..observability import metrics as _obs_metrics
         if _obs_metrics.enabled():

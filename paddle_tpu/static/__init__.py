@@ -310,6 +310,73 @@ def _check_nan_inf(tree, what: str) -> None:
 
 
 
+def _note_nonfinite_host(fired: bool) -> None:
+    if not fired:
+        return
+    try:
+        from ..observability import flight as _flight
+        _obs.counter(
+            "nonfinite_steps_total",
+            "train steps whose gradients contained NaN/Inf — the "
+            "optimizer/scaler/buffer update was skipped in-graph "
+            "(skip-step guard, FLAGS_skip_nonfinite_steps)").inc()
+        _flight.record("nonfinite_step", force=True)
+    except Exception:  # telemetry must never break the stream
+        pass
+
+
+def probe_nonfinite(found_inf) -> None:
+    """Stream the skip-step guard's verdict to the host (traced
+    context): async jax.debug.callback like anomaly.probe — baked in
+    at trace time only while metrics are on, never a host sync."""
+    if not _obs.enabled():
+        return
+    # register at trace time so the TYPE line is on /metrics before
+    # the first incident
+    _obs.counter(
+        "nonfinite_steps_total",
+        "train steps whose gradients contained NaN/Inf — the "
+        "optimizer/scaler/buffer update was skipped in-graph "
+        "(skip-step guard, FLAGS_skip_nonfinite_steps)")
+    jax.debug.callback(lambda v: _note_nonfinite_host(bool(v)),
+                       found_inf)
+
+
+def _skip_guard_default() -> bool:
+    try:
+        return bool(GLOBAL_FLAGS.get("skip_nonfinite_steps"))
+    except KeyError:  # pragma: no cover - partial installs
+        return True
+
+
+def inject_fault_mults(batch) -> None:
+    """Thread in-graph value faults (testing.faults: nonfinite_grad /
+    loss_spike) into a step's batch as scalar multipliers. Keys are
+    added on EVERY call while such a spec is armed (value 1.0 when not
+    firing), so the compiled signature stays stable — one trace, not
+    one per flip."""
+    from ..testing import faults as _faults
+    if not (_faults.active() and _faults.value_points_armed()):
+        return
+    batch["grad_mult"] = jnp.float32(
+        _faults.value_mult("nonfinite_grad"))
+    batch["loss_mult"] = jnp.float32(_faults.value_mult("loss_spike"))
+
+
+def apply_fault_mults(loss, grads, batch):
+    """Traced half of the value-fault injection: multiply the loss /
+    every inexact grad leaf by the armed multipliers (1.0 = inert)."""
+    if "loss_mult" in batch:
+        loss = loss * batch["loss_mult"].astype(loss.dtype)
+    if "grad_mult" in batch:
+        mult = batch["grad_mult"]
+        grads = jax.tree.map(
+            lambda g: g * mult.astype(g.dtype)
+            if jnp.issubdtype(getattr(g, "dtype", jnp.int32),
+                              jnp.inexact) else g, grads)
+    return loss, grads
+
+
 def _wire_param_meta(model, optimizer) -> None:
     """Hand per-parameter ParamAttr metadata (need_clip, regularizer)
     to the optimizer, keyed like param_dict — reference semantics:
@@ -339,12 +406,26 @@ class TrainStep:
 
     def __init__(self, model: Layer, optimizer: Optimizer,
                  loss_fn: Callable, extra_metrics: Optional[Dict[str,
-                 Callable]] = None, seed: int = 0) -> None:
+                 Callable]] = None, seed: int = 0,
+                 amp_dtype=None, scaler=None) -> None:
         self.model = model
         self.optimizer = optimizer
         _wire_param_meta(model, optimizer)
         self.loss_fn = loss_fn
         self.extra_metrics = extra_metrics or {}
+        # AMP: amp_dtype runs the forward under auto_cast; a GradScaler
+        # (fp16) compiles dynamic loss scaling + skip-on-inf into the
+        # step (ref: amp_check_finite_and_scale + update_loss_scaling)
+        self.amp_dtype = amp_dtype
+        if scaler is not None and not scaler.enable:
+            scaler = None
+        self.scaler = scaler
+        # finiteness guard for every precision (bf16/fp32 runs get the
+        # skip alone, without scaling); flag read at construction
+        self._skip_guard = _skip_guard_default()
+        # host-LR rescale applied on divergence-rollback re-entry
+        # (FLAGS_rollback_lr_factor); changing it retraces once
+        self.lr_scale = 1.0
         params = model.param_dict()
         buffers = model.buffer_dict()
         self.state = {
@@ -353,6 +434,8 @@ class TrainStep:
             "opt": optimizer.init(params),
             "rng": _random.make_key(seed),
         }
+        if self.scaler is not None:
+            self.state["scaler"] = self.scaler.init()
         # jit through the recompile tracker: a shape-churning input
         # pipeline shows up as jit_traces_total{fn=...} growth + a
         # storm warning instead of a silent 100x slowdown
@@ -363,20 +446,39 @@ class TrainStep:
             self._multi, self._span_name + ".multi", donate_argnums=(0,))
 
     def _step(self, state, batch):
+        import contextlib
+
+        from .. import amp as _amp
         params = state["params"]
         buffers = state["buffers"]
         rng, step_key = jax.random.split(state["rng"])
+        scaler = self.scaler if "scaler" in state else None
 
         def loss_of(p):
-            with _random.rng_scope(default=step_key, dropout=step_key):
+            ctx = _amp.auto_cast(enable=True, dtype=self.amp_dtype) \
+                if self.amp_dtype is not None \
+                else contextlib.nullcontext()
+            with ctx, _random.rng_scope(default=step_key,
+                                        dropout=step_key):
                 out, new_buffers = functional_call(
                     self.model, p, buffers, *batch["args"],
                     capture_buffers=True, **batch.get("kwargs", {}))
                 loss = self.loss_fn(out, *batch["labels"])
+            if scaler is not None:
+                loss = scaler.scale(loss, state["scaler"])
             return loss, (new_buffers, out)
 
         (loss, (new_buffers, out)), grads = jax.value_and_grad(
             loss_of, has_aux=True)(params)
+        loss, grads = apply_fault_mults(loss, grads, batch)
+        # finiteness: the scaler's unscale fuses the check; bare runs
+        # get the check alone (skip-step guard)
+        found_inf = None
+        if scaler is not None:
+            grads, found_inf = scaler.unscale(grads, state["scaler"])
+            loss = loss / state["scaler"]["scale"].astype(loss.dtype)
+        elif self._skip_guard:
+            found_inf = ~_amp.all_finite(grads)
         if _obs.enabled():
             # anomaly sentinel: async host callbacks baked in at trace
             # time (observe_traced semantics) — NaN/Inf + spike watch on
@@ -384,15 +486,42 @@ class TrainStep:
             _obs.anomaly.probe("loss", loss)
             gnorm = jnp.sqrt(sum(
                 jnp.sum(jnp.square(g.astype(jnp.float32)))
-                for g in jax.tree.leaves(grads)) + 0.0)
+                for g in jax.tree.leaves(grads)
+                if jnp.issubdtype(getattr(g, "dtype", jnp.int32),
+                                  jnp.inexact)) + 0.0)
             _obs.anomaly.probe("grad_norm", gnorm)
+        lr = batch.get("lr")
+        if "lr_scale" in batch:
+            # rollback LR rescale: reproduce the LR apply_gradients
+            # would have used and multiply — works for floats,
+            # in-graph schedulers (traced over the step counter) and
+            # host-driven schedulers (batch["lr"]) alike
+            from ..optimizer.lr import resolve_lr
+            base = lr if lr is not None else resolve_lr(
+                self.optimizer.learning_rate, state["opt"]["step"] + 1)
+            lr = base * batch["lr_scale"]
         new_params, new_opt = self.optimizer.apply_gradients(
-            params, grads, state["opt"], lr_override=batch.get("lr"))
+            params, grads, state["opt"], lr_override=lr)
+        if found_inf is not None:
+            # skip-step: discard the whole update in-graph — params,
+            # optimizer slots (incl. the step counter, matching the
+            # reference's update_loss_scaling) and buffer stats
+            new_params = _amp.select_update(found_inf, new_params,
+                                            params)
+            new_opt = _amp.select_update(found_inf, new_opt,
+                                         state["opt"])
+            new_buffers = _amp.select_update(found_inf, new_buffers,
+                                             buffers)
+            probe_nonfinite(found_inf)
         metrics = {"loss": loss}
         for name, fn in self.extra_metrics.items():
             metrics[name] = fn(out, *batch["labels"])
-        return ({"params": new_params, "buffers": new_buffers,
-                 "opt": new_opt, "rng": rng}, metrics)
+        new_state = {"params": new_params, "buffers": new_buffers,
+                     "opt": new_opt, "rng": rng}
+        if scaler is not None:
+            new_state["scaler"] = scaler.update(state["scaler"],
+                                                found_inf)
+        return (new_state, metrics)
 
     def _multi(self, state, batches, lr):
         # iterations-per-loop: K optimizer steps inside ONE compiled
@@ -411,9 +540,13 @@ class TrainStep:
 
     def _make_batch(self, args, labels, kwargs):
         from ..parallel.spmd import inject_host_lr
-        return inject_host_lr(
+        batch = inject_host_lr(
             {"args": args, "labels": as_label_tuple(labels),
              "kwargs": kwargs}, self.optimizer)
+        inject_fault_mults(batch)
+        if self.lr_scale != 1.0:
+            batch["lr_scale"] = jnp.float32(self.lr_scale)
+        return batch
 
     def __call__(self, *args, labels=(), **kwargs):
         batch = self._make_batch(args, labels, kwargs)

@@ -33,6 +33,16 @@ Action keys (first present wins):
 - none         — the ``sigterm`` point self-delivers SIGTERM; every
   other point raises RuntimeError
 
+**Value faults** (numerical chaos, no exception): the points
+``nonfinite_grad`` and ``loss_spike`` do not act at the call site —
+they return a multiplier that the train step compiles into its graph
+(gradients × NaN, loss × spike factor), exercising the skip-step
+guard and the divergence watchdog. ``mul=X`` overrides the default
+multiplier (NaN for nonfinite_grad, 1e6 for loss_spike). The trigger
+keys (``at=``/``step=``/``p=``) work unchanged; ``step=`` matches the
+trainer's global step (set via :func:`set_step_context` by the fit
+loop).
+
 Every fired fault increments ``faults_injected_total{point=}`` and
 records a forced flight-recorder event before acting, so a drill can
 assert the injection actually happened. See docs/fault_tolerance.md.
@@ -49,7 +59,14 @@ from dataclasses import dataclass
 from typing import List, Optional
 
 __all__ = ["FaultSpec", "parse_spec", "format_spec", "configure",
-           "active", "hit"]
+           "active", "hit", "value_mult", "value_points_armed",
+           "set_step_context", "VALUE_POINTS"]
+
+# in-graph value-fault points: they never raise/kill; the train step
+# consumes their multiplier (grads x NaN / loss x spike factor)
+VALUE_POINTS = ("nonfinite_grad", "loss_spike")
+_VALUE_DEFAULT_MUL = {"nonfinite_grad": float("nan"),
+                      "loss_spike": 1e6}
 
 
 @dataclass
@@ -61,6 +78,7 @@ class FaultSpec:
     exc: Optional[str] = None
     kill: Optional[int] = None
     exit: Optional[int] = None
+    mul: Optional[float] = None
     seed: int = 0
 
 
@@ -107,6 +125,8 @@ def parse_spec(text: Optional[str]) -> List[FaultSpec]:
             k, v = k.strip(), v.strip()
             if k == "p":
                 kwargs["p"] = float(v)
+            elif k == "mul":
+                kwargs["mul"] = float(v)
             elif k in _INT_KEYS:
                 kwargs[k] = int(v)
             elif k == "kill":
@@ -116,7 +136,7 @@ def parse_spec(text: Optional[str]) -> List[FaultSpec]:
             else:
                 raise ValueError(
                     f"fault spec entry {entry!r}: unknown key {k!r} "
-                    f"(known: p, at, step, exc, kill, exit, seed)")
+                    f"(known: p, at, step, exc, kill, exit, mul, seed)")
         specs.append(FaultSpec(point, **kwargs))
     return specs
 
@@ -138,6 +158,8 @@ def format_spec(specs: List[FaultSpec]) -> str:
             fields.append(f"kill={s.kill}")
         if s.exit is not None:
             fields.append(f"exit={s.exit}")
+        if s.mul is not None:
+            fields.append(f"mul={s.mul:g}")
         if s.seed:
             fields.append(f"seed={s.seed}")
         parts.append(":".join(fields))
@@ -165,7 +187,14 @@ class FaultRegistry:
         self._armed = [_Armed(s) for s in specs]
         self._lock = threading.Lock()
 
-    def hit(self, point: str, step: Optional[int] = None) -> None:
+    def _match(self, point: str, step: Optional[int]
+               ) -> Optional[FaultSpec]:
+        """Condition check shared by action and value faults. EVERY
+        entry armed on this point advances its call counter on every
+        call — even after an earlier entry already fired — so a run of
+        entries `p:at=4,p:at=5,p:at=6` fires on three CONSECUTIVE
+        calls (the shape a divergence-streak drill needs). The first
+        firing entry wins."""
         fire: Optional[FaultSpec] = None
         with self._lock:
             for a in self._armed:
@@ -173,6 +202,8 @@ class FaultRegistry:
                 if s.point != point:
                     continue
                 a.calls += 1
+                if fire is not None:
+                    continue
                 if s.at is not None and a.calls != s.at:
                     continue
                 if s.step is not None and (step is None
@@ -182,9 +213,29 @@ class FaultRegistry:
                         and a.rng.random() >= s.p:
                     continue
                 fire = s
-                break
+        return fire
+
+    def points(self) -> set:
+        with self._lock:
+            return {a.spec.point for a in self._armed}
+
+    def hit(self, point: str, step: Optional[int] = None) -> None:
+        fire = self._match(point, step)
         if fire is not None:
             self._fire(point, fire, step)
+
+    def value_mult(self, point: str,
+                   step: Optional[int] = None) -> float:
+        """Multiplier for an in-graph value fault: 1.0 when nothing
+        fires, else the entry's ``mul`` (or the point's default).
+        Telemetry fires like hit(), but no exception/signal."""
+        s = self._match(point, step)
+        if s is None:
+            return 1.0
+        _note(point, s, step)
+        mul = s.mul if s.mul is not None \
+            else _VALUE_DEFAULT_MUL.get(point, float("nan"))
+        return float(mul)
 
     def _fire(self, point: str, s: FaultSpec,
               step: Optional[int]) -> None:
@@ -242,6 +293,39 @@ def hit(point: str, step: Optional[int] = None) -> None:
     if r is None:
         return
     r.hit(point, step=step)
+
+
+# global-step context for value faults: the fit loop publishes its
+# step counter here so spec `step=` triggers match the trainer's
+# notion of a step even from inside TrainStep (which has no counter)
+_step_context: Optional[int] = None
+
+
+def set_step_context(step: Optional[int]) -> None:
+    global _step_context
+    _step_context = step
+
+
+def value_points_armed() -> bool:
+    """True when the armed spec contains any in-graph value-fault
+    entry (nonfinite_grad / loss_spike) — train steps consult this
+    once per call to decide whether to thread fault multipliers
+    through the compiled batch."""
+    r = _REGISTRY
+    if r is None:
+        return False
+    return bool(r.points() & set(VALUE_POINTS))
+
+
+def value_mult(point: str, step: Optional[int] = None) -> float:
+    """Current multiplier for a value-fault point (1.0 = inert).
+    ``step`` defaults to the fit loop's published step context."""
+    r = _REGISTRY
+    if r is None:
+        return 1.0
+    if step is None:
+        step = _step_context
+    return r.value_mult(point, step=step)
 
 
 # Arm from an env-set FLAGS_fault_spec at import (the subprocess-drill

@@ -66,9 +66,22 @@ def main() -> None:
     gy = jax.make_array_from_process_local_data(batch_sh, y_local, y.shape)
 
     losses = []
-    for _ in range(6):
-        m = step(gx, labels=gy)
-        losses.append(float(m["loss"]))
+    try:
+        for _ in range(6):
+            m = step(gx, labels=gy)
+            losses.append(float(m["loss"]))
+    except Exception as e:  # noqa: BLE001 — env-capability probe
+        # The pinned CPU jaxlib cannot execute computations spanning
+        # multiple processes ("Multiprocess computations aren't
+        # implemented on the CPU backend") — an environment limit, not
+        # a framework bug. Exit 77 (the automake SKIP convention) so
+        # the driving test can skip with a reason instead of failing.
+        if "Multiprocess computations aren't implemented" in str(e):
+            print(f"[dist_trainer rank {rank}] backend limit: {e}",
+                  file=sys.stderr)
+            cp.close()
+            sys.exit(77)
+        raise
 
     cp.barrier("done", world)
     if rank == 0:

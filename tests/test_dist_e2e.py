@@ -44,6 +44,13 @@ def test_two_process_training_matches_single_process(tmp_path):
 
     code = launch_procs([sys.executable, _TRAINER, out], nproc=2,
                         env_extra=env)
+    if code == 77:
+        # dist_trainer.py probes the backend and exits 77 (the SKIP
+        # convention) when the CPU client cannot execute multiprocess
+        # computations — a jaxlib build limit, not a framework bug.
+        pytest.skip("CPU backend cannot execute multiprocess "
+                    "computations (pinned jaxlib build limit); "
+                    "dist e2e needs real multi-host devices")
     assert code == 0, f"distributed job failed rc={code}"
     with open(out) as f:
         dist_losses = json.load(f)

@@ -105,7 +105,7 @@ def _save_one(tmp_path, name="c1", step=3):
 def test_save_writes_integrity_format(tmp_path):
     path = _save_one(tmp_path)
     manifest = json.load(open(os.path.join(path, "manifest.json")))
-    assert manifest["__paddle_tpu_ckpt__"] == 2
+    assert manifest["__paddle_tpu_ckpt__"] == 3
     for meta in manifest["leaves"].values():
         assert meta["nbytes"] > 0 and "crc32" in meta
     commit = json.load(open(os.path.join(path, "COMMIT")))
@@ -543,7 +543,8 @@ def test_server_sheds_requests_past_queue_deadline():
 
 def test_chaos_drill_self_test_subprocess():
     """The full drill suite — kill -9 mid-save, corrupted leaf, SIGTERM
-    mid-fit, crash-loop budget — must pass end to end on CPU."""
+    mid-fit, crash-loop budget, nonfinite-grad skip, bitwise-exact
+    SIGKILL resume — must pass end to end on CPU."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("FLAGS_fault_spec", None)
     env.pop("FLAGS_enable_metrics", None)

@@ -156,17 +156,25 @@ class TestVisionModelZoo:
     """MobileNetV1/V2 + VGG parity (ref: hapi/vision/models/)."""
 
     def _train_smoke(self, model, img=32, classes=4):
+        # Adam, not Momentum(0.05, 0.9): a freshly-initialized deep-BN
+        # net has exponentially-growing early-layer gradients (global
+        # grad norm ~2.5e3 here), so raw high-LR momentum on one
+        # repeated batch oscillates chaotically — some seeds landed the
+        # 5th step above the 1st and failed the smoke spuriously. The
+        # smoke's claim is "the zoo model trains", which Adam shows
+        # robustly (loss -> ~0 in 8 steps for every seed tried).
         import paddle_tpu as pt
         from paddle_tpu.static import TrainStep
         pt.seed(0)
-        step = TrainStep(model, pt.optimizer.Momentum(0.05, 0.9),
+        step = TrainStep(model, pt.optimizer.Adam(learning_rate=3e-3),
                          lambda o, y: pt.nn.functional.cross_entropy(o, y))
         rng = np.random.default_rng(0)
         x = rng.normal(0, 1, (4, 3, img, img)).astype(np.float32)
         y = rng.integers(0, classes, (4,)).astype(np.int64)
         l0 = float(step(x, labels=y)["loss"])
-        for _ in range(4):
+        for _ in range(7):
             m = step(x, labels=y)
+        assert np.isfinite(float(m["loss"]))
         assert float(m["loss"]) < l0
 
     def test_mobilenet_v1_shapes_and_training(self):

@@ -19,6 +19,15 @@ the recovery contract from docs/fault_tolerance.md:
   crash_loop       — a deterministic per-step crash under
                      launch_elastic terminates via the sliding-window
                      restart budget instead of exhausting max_restarts.
+  nonfinite_skip   — injected non-finite gradients (value fault
+                     nonfinite_grad) are skipped in-graph by the
+                     skip-step guard: fit completes, weights stay
+                     finite, nonfinite_steps_total counts the skips.
+  exact_resume     — SIGKILL mid-epoch, resume from the newest intact
+                     v3 checkpoint (RNG stream + data offset +
+                     GradScaler state restored): final weights are
+                     BITWISE-identical to an uninterrupted control run
+                     (delegates to tools/replay_check.py).
 
 Usage:
   python tools/chaos_drill.py --self-test        # all drills (CPU)
@@ -247,11 +256,88 @@ def drill_crash_loop(tmp):
             f"({elapsed:.1f}s), not max_restarts=8")
 
 
+# Skip-guard trainer: reports the nonfinite counter + weight health
+# so the driver can assert the skips actually happened in-graph.
+_NONFINITE_TRAINER = textwrap.dedent("""
+    import json, sys
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as pt
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.sysconfig import enable_compile_cache
+
+    enable_compile_cache()
+    outpath = sys.argv[1]
+    rng = np.random.default_rng(0)
+    batches = [(rng.normal(size=(8, 4)).astype(np.float32),
+                rng.integers(0, 2, (8,)).astype(np.int64))
+               for _ in range(10)]
+    pt.seed(0)
+    net = pt.nn.Linear(4, 2)
+    model = pt.hapi.Model(
+        net, loss=lambda o, y: pt.nn.functional.cross_entropy(o, y),
+        optimizer=pt.optimizer.SGD(learning_rate=0.1))
+    hist = model.fit(batches, epochs=1, verbose=0)
+    jax.effects_barrier()   # drain the async nonfinite-step callbacks
+    w = {k: np.asarray(v) for k, v in net.state_dict().items()}
+    with open(outpath, "w") as f:
+        json.dump({
+            "done": True,
+            "nonfinite_steps": metrics.counter(
+                "nonfinite_steps_total", always=True).value(),
+            "weights_finite": bool(all(np.isfinite(a).all()
+                                       for a in w.values())),
+            "loss_finite": bool(np.isfinite(hist["loss"][-1])),
+        }, f)
+""")
+
+
+def drill_nonfinite_skip(tmp):
+    """Two injected NaN-gradient steps must be skipped in-graph."""
+    script = os.path.join(tmp, "nonfinite_trainer.py")
+    with open(script, "w") as f:
+        f.write(_NONFINITE_TRAINER)
+    out = os.path.join(tmp, "nonfinite_result.json")
+    proc = subprocess.run(
+        [sys.executable, script, out],
+        env=_env(tmp, fault_spec="nonfinite_grad:step=3,"
+                                 "nonfinite_grad:step=6"),
+        capture_output=True, text=True, timeout=240)
+    _check(proc.returncode == 0,
+           f"skip-guard trainer died rc={proc.returncode}\n"
+           f"{proc.stderr}")
+    res = json.load(open(out))
+    _check(res.get("done"), f"trainer did not finish: {res}")
+    _check(res.get("nonfinite_steps", 0) >= 2,
+           f"nonfinite_steps_total should be >= 2, got "
+           f"{res.get('nonfinite_steps')}")
+    _check(res.get("weights_finite"),
+           "weights went non-finite despite the skip guard")
+    _check(res.get("loss_finite"), "epoch loss went non-finite")
+    return (f"{res['nonfinite_steps']} nonfinite-grad steps skipped "
+            "in-graph, weights finite, fit completed")
+
+
+def drill_exact_resume(tmp):
+    """SIGKILL mid-epoch + v3 resume == uninterrupted run, bitwise."""
+    try:
+        from tools import replay_check
+    except ImportError:  # run from inside tools/
+        import replay_check
+    try:
+        return replay_check.run_check(tmp)
+    except replay_check.CheckFailure as e:
+        raise DrillFailure(str(e)) from e
+
+
 DRILLS = {
     "kill_mid_save": drill_kill_mid_save,
     "corrupt_leaf": drill_corrupt_leaf,
     "sigterm_mid_fit": drill_sigterm_mid_fit,
     "crash_loop": drill_crash_loop,
+    "nonfinite_skip": drill_nonfinite_skip,
+    "exact_resume": drill_exact_resume,
 }
 
 

@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""Bitwise-exact resume prover (checkpoint v3).
+
+A fault-tolerant trainer is only trustworthy if "resume" means *the
+same run*, not *a similar run*. This tool proves it end to end with
+real subprocesses:
+
+1. **control** — train to completion, uninterrupted; dump the final
+   weights.
+2. **victim**  — identical trainer, SIGKILLed mid-epoch by a
+   deterministic fault (`train_step:step=7:kill=9`).
+3. **resume**  — rerun the victim over the same checkpoint directory;
+   it restores the newest intact v3 checkpoint (params, optimizer
+   slots, the RNG key stream, GradScaler state) and re-enters the data
+   stream at the saved offset.
+4. assert the resumed run's final weights are **bitwise identical** to
+   the control's.
+
+The trainer deliberately uses a Dropout layer (so the restored RNG
+stream is load-bearing), fp16 AMP with a dynamic GradScaler (so the
+restored scaler state is load-bearing), and a DataLoader (so the
+sampler-offset resume path `DataLoader.iter_from` is exercised, not
+the replay fallback).
+
+Usage:
+  python tools/replay_check.py --self-test
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:  # runnable from any cwd
+    sys.path.insert(0, ROOT)
+
+# Trainer: dropout + fp16 GradScaler + DataLoader, auto-checkpointing
+# every 2 steps; dumps final weights (raw arrays) + the resume point.
+_TRAINER = textwrap.dedent("""
+    import json, os, sys
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as pt
+    from paddle_tpu import io
+    from paddle_tpu.sysconfig import enable_compile_cache
+
+    enable_compile_cache()
+    ckdir, outpath, final_npz = sys.argv[1], sys.argv[2], sys.argv[3]
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(96, 4)).astype(np.float32)
+    y = rng.integers(0, 2, (96,)).astype(np.int64)
+    loader = pt.data.DataLoader(pt.data.TensorDataset(x, y),
+                                batch_size=8)   # 12 steps/epoch
+    pt.seed(0)
+    net = pt.nn.Sequential(pt.nn.Linear(4, 16), pt.nn.ReLU(),
+                           pt.nn.Dropout(0.5), pt.nn.Linear(16, 2))
+    model = pt.hapi.Model(
+        net, loss=lambda o, yy: pt.nn.functional.cross_entropy(o, yy),
+        optimizer=pt.optimizer.SGD(learning_rate=0.1))
+    resumed = io.AsyncCheckpointer(ckdir).latest_step() or 0
+    with open(outpath, "w") as f:
+        json.dump({"resumed": resumed}, f)
+    model.fit(loader, epochs=1, verbose=0, ckpt_dir=ckdir,
+              save_steps=2, amp="float16")
+    np.savez(final_npz, **{k: np.asarray(v)
+                           for k, v in net.state_dict().items()})
+    with open(outpath, "w") as f:
+        json.dump({"resumed": resumed, "done": True}, f)
+""")
+
+
+class CheckFailure(AssertionError):
+    pass
+
+
+def _check(cond, msg):
+    if not cond:
+        raise CheckFailure(msg)
+
+
+def _env(tmp, fault_spec=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["FLAGS_enable_metrics"] = "1"
+    env["FLAGS_metrics_port"] = "-1"
+    env["FLAGS_trace_dir"] = os.path.join(tmp, "trace")
+    if fault_spec:
+        env["FLAGS_fault_spec"] = fault_spec
+    else:
+        env.pop("FLAGS_fault_spec", None)
+    return env
+
+
+def _run_trainer(tmp, ckdir, tag, fault_spec=None, timeout=240):
+    script = os.path.join(tmp, "replay_trainer.py")
+    if not os.path.exists(script):
+        with open(script, "w") as f:
+            f.write(_TRAINER)
+    out = os.path.join(tmp, f"result_{tag}.json")
+    npz = os.path.join(tmp, f"final_{tag}.npz")
+    proc = subprocess.run(
+        [sys.executable, script, ckdir, out, npz],
+        env=_env(tmp, fault_spec), capture_output=True, text=True,
+        timeout=timeout)
+    result = json.load(open(out)) if os.path.exists(out) else {}
+    return proc, result, npz
+
+
+def run_check(tmp: str) -> str:
+    """The full control / SIGKILL / resume / bitwise-compare cycle.
+    Raises :class:`CheckFailure` with a diagnostic on any breach."""
+    # 1. control: one uninterrupted run
+    ck_a = os.path.join(tmp, "replay_ck_control")
+    p, res, npz_a = _run_trainer(tmp, ck_a, "control")
+    _check(p.returncode == 0 and res.get("done"),
+           f"control run failed rc={p.returncode}\n{p.stderr}")
+
+    # 2. victim: SIGKILL lands mid-epoch at train step 7
+    ck_b = os.path.join(tmp, "replay_ck_victim")
+    p, res, _ = _run_trainer(tmp, ck_b, "victim",
+                             fault_spec="train_step:step=7:kill=9")
+    _check(p.returncode == -signal.SIGKILL,
+           f"expected SIGKILL death, rc={p.returncode}\n{p.stderr}")
+
+    # 3. resume over the same directory from the newest intact v3 ckpt
+    from paddle_tpu import io
+    latest = io.AsyncCheckpointer(ck_b).latest_step()
+    _check(latest and 0 < latest < 12,
+           f"expected a mid-epoch checkpoint, got {latest}")
+    host = io.AsyncCheckpointer(ck_b).host_state()
+    _check(host and host.get("global_step") == latest,
+           f"v3 host_state missing/stale: {host}")
+    p, res, npz_b = _run_trainer(tmp, ck_b, "resume")
+    _check(p.returncode == 0 and res.get("done"),
+           f"resume run failed rc={p.returncode}\n{p.stderr}")
+    _check(res.get("resumed") == latest,
+           f"resume started at {res.get('resumed')}, wanted {latest}")
+
+    # 4. bitwise comparison of the final weights
+    a, b = np.load(npz_a), np.load(npz_b)
+    _check(sorted(a.files) == sorted(b.files),
+           f"weight sets differ: {a.files} vs {b.files}")
+    diffs = [k for k in a.files
+             if a[k].tobytes() != b[k].tobytes()]
+    if diffs:
+        worst = max(float(np.abs(a[k].astype(np.float64)
+                                 - b[k].astype(np.float64)).max())
+                    for k in diffs)
+        raise CheckFailure(
+            "resumed weights are NOT bitwise-identical to the "
+            f"control run: {diffs} (max abs diff {worst:.3e})")
+    return (f"SIGKILL at step 7, resumed from intact ckpt-{latest} "
+            f"(host_state offset {host.get('batch_in_epoch')}), "
+            f"{len(a.files)} weight arrays bitwise-equal to control")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--self-test", action="store_true",
+                        help="run the full check on CPU and report")
+    parser.add_argument("--keep", action="store_true",
+                        help="keep the scratch directory")
+    args = parser.parse_args(argv)
+    if not args.self_test:
+        parser.error("pass --self-test")
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    tmp = tempfile.mkdtemp(prefix="replay_check_")
+    try:
+        summary = run_check(tmp)
+        print(f"[replay] exact_resume: OK — {summary}")
+    except CheckFailure as e:
+        print(f"[replay] exact_resume: FAIL — {e}", file=sys.stderr)
+        return 1
+    finally:
+        if args.keep:
+            print(f"[replay] scratch kept at {tmp}")
+        else:
+            shutil.rmtree(tmp, ignore_errors=True)
+    print("replay check self-test OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
