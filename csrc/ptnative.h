@@ -170,6 +170,13 @@ void pt_srv_stop(int64_t h);
 // cap too small (request stays queued), 0 if stopping and drained.
 int64_t pt_srv_next(int64_t h, int timeout_ms, uint64_t* req_id,
                     uint8_t* buf, int64_t cap);
+// Trace-aware dequeue: pt_srv_next plus the request's client-assigned
+// trace id (0 = untraced 'PTSV' frame) and its reader-thread ingress
+// stamp in unix microseconds (the first of the per-request span
+// timestamps served at /requests).
+int64_t pt_srv_next_ex(int64_t h, int timeout_ms, uint64_t* req_id,
+                       uint64_t* trace_id, uint64_t* ingress_us,
+                       uint8_t* buf, int64_t cap);
 // Reply to a dequeued request. 0 ok, -1 unknown id, -3 client gone.
 int pt_srv_reply(int64_t h, uint64_t req_id, int64_t status,
                  const uint8_t* data, int64_t len);

@@ -24,6 +24,15 @@
 // health probes work even when the queue is saturated. The reply body
 // is "key=value\n" text: server counters plus every monitor-registry
 // stat with the "serving." prefix (docs/serving_protocol.md).
+//
+// Traced requests use magic 'PTSR' with the same header layout; the
+// payload starts with a u64 client-assigned trace id, then the normal
+// tensor payload. The reply framing is unchanged (the trace id rides
+// the server's request-span records, not the wire reply). Every
+// request — traced or not — is stamped with its ingress time (unix
+// microseconds) when the reader thread parses the frame; Python reads
+// both through pt_srv_next_ex and builds the per-request span records
+// served at /requests (docs/serving_protocol.md, "Request tracing").
 
 #include "ptnative.h"
 
@@ -49,8 +58,9 @@
 
 namespace {
 
-constexpr uint32_t kMagic = 0x56535450;     // "PTSV"
-constexpr uint32_t kMagicCtl = 0x43535450;  // "PTSC" control frame
+constexpr uint32_t kMagic = 0x56535450;      // "PTSV"
+constexpr uint32_t kMagicCtl = 0x43535450;   // "PTSC" control frame
+constexpr uint32_t kMagicTrace = 0x52535450; // "PTSR" traced request
 constexpr uint32_t kCtlStats = 1;
 // Hard cap on a single request payload: a corrupt/malicious length must
 // fail the request, not drive an unchecked allocation (same rule as the
@@ -93,9 +103,18 @@ struct Conn {
 struct Request {
   uint64_t id;  // server-assigned, returned to Python
   uint64_t tag;  // client-assigned, echoed in the reply
+  uint64_t trace_id;    // client-assigned ('PTSR' frames); 0 = untraced
+  uint64_t ingress_us;  // unix microseconds when the frame was parsed
   std::shared_ptr<Conn> conn;
   std::string payload;
 };
+
+uint64_t NowUs() {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::microseconds>(
+          std::chrono::system_clock::now().time_since_epoch())
+          .count());
+}
 
 class Server {
  public:
@@ -153,8 +172,12 @@ class Server {
   // timeout, 0 if the server is stopping and the queue is drained. A
   // request larger than cap is popped and answered with an error frame
   // (status -2) so it can never wedge the queue head; the scan then
-  // continues to the next request.
-  int64_t Next(int timeout_ms, uint64_t* req_id, uint8_t* buf, int64_t cap) {
+  // continues to the next request. trace_id/ingress_us are optional
+  // out-params (pt_srv_next_ex) carrying the request's client trace id
+  // (0 = untraced 'PTSV' frame) and its reader-thread arrival stamp.
+  int64_t Next(int timeout_ms, uint64_t* req_id, uint8_t* buf, int64_t cap,
+               uint64_t* trace_id = nullptr,
+               uint64_t* ingress_us = nullptr) {
     auto deadline = std::chrono::steady_clock::now() +
                     std::chrono::milliseconds(timeout_ms);
     for (;;) {
@@ -172,6 +195,8 @@ class Server {
         int64_t n = static_cast<int64_t>(r.payload.size());
         if (n <= cap) {
           *req_id = r.id;
+          if (trace_id) *trace_id = r.trace_id;
+          if (ingress_us) *ingress_us = r.ingress_us;
           std::memcpy(buf, r.payload.data(), r.payload.size());
           inflight_.emplace(r.id, InFlight{r.tag, r.conn});
           queue_.pop_front();
@@ -272,6 +297,7 @@ class Server {
     add("connections_total", static_cast<long long>(conns_total_.load()));
     add("stats_requests_total",
         static_cast<long long>(stats_requests_total_.load()));
+    add("traced_total", static_cast<long long>(traced_total_.load()));
     int64_t need = pt_mon_dump(nullptr, 0);
     if (need > 0) {
       std::string mon(static_cast<size_t>(need), '\0');
@@ -349,10 +375,38 @@ class Server {
       std::memcpy(&magic, hdr, 4);
       std::memcpy(&tag, hdr + 4, 8);
       std::memcpy(&len, hdr + 12, 4);
-      if ((magic != kMagic && magic != kMagicCtl) || len > kMaxPayload)
+      if ((magic != kMagic && magic != kMagicCtl &&
+           magic != kMagicTrace) ||
+          len > kMaxPayload)
         break;  // corrupt stream
       std::string payload(len, '\0');
       if (len > 0 && !ReadFull(conn->fd, payload.data(), len)) break;
+      uint64_t ingress_us = NowUs();
+      uint64_t trace_id = 0;
+      if (magic == kMagicTrace) {
+        // Traced request: payload = u64 trace_id | tensor payload.
+        if (payload.size() < 8) {
+          // Malformed, but the frame itself parsed — answer inline
+          // (status -1) instead of poisoning the whole stream.
+          static const char kShort[] = "traced frame shorter than its "
+                                       "8-byte trace id";
+          uint8_t rhdr[8 + 8 + 4];
+          int64_t status = -1;
+          std::memcpy(rhdr, &tag, 8);
+          std::memcpy(rhdr + 8, &status, 8);
+          uint32_t l = sizeof(kShort) - 1;
+          std::memcpy(rhdr + 16, &l, 4);
+          std::lock_guard<std::mutex> wl(conn->write_mu);
+          if (!WriteFull(conn->fd, rhdr, sizeof(rhdr)) ||
+              !WriteFull(conn->fd, kShort, l))
+            break;
+          continue;
+        }
+        std::memcpy(&trace_id, payload.data(), 8);
+        payload.erase(0, 8);
+        traced_total_.fetch_add(1);
+        pt_mon_add("serving.traced_total", 1);
+      }
       if (magic == kMagicCtl) {
         // Control request: answered inline by this reader thread (never
         // queued), so stats stay reachable under full-queue backpressure.
@@ -386,7 +440,8 @@ class Server {
                stopping_.load();
       });
       if (stopping_.load()) break;
-      queue_.push_back(Request{next_id_++, tag, conn, std::move(payload)});
+      queue_.push_back(Request{next_id_++, tag, trace_id, ingress_us,
+                               conn, std::move(payload)});
       accepted_total_.fetch_add(1);
       pt_mon_add("serving.accepted_total", 1);
       cv_.notify_one();
@@ -407,6 +462,7 @@ class Server {
   std::atomic<uint64_t> oversized_total_{0};
   std::atomic<uint64_t> conns_total_{0};
   std::atomic<uint64_t> stats_requests_total_{0};
+  std::atomic<uint64_t> traced_total_{0};
   std::chrono::steady_clock::time_point start_ =
       std::chrono::steady_clock::now();
   std::thread accept_thread_;
@@ -470,6 +526,17 @@ int64_t pt_srv_next(int64_t h, int timeout_ms, uint64_t* req_id,
   auto s = Get(h);
   if (!s) return -1;
   return s->Next(timeout_ms, req_id, buf, cap);
+}
+
+// Trace-aware dequeue: same contract as pt_srv_next plus the request's
+// client trace id (0 for untraced 'PTSV' frames) and its ingress stamp
+// (unix microseconds, taken by the reader thread when the frame parsed).
+int64_t pt_srv_next_ex(int64_t h, int timeout_ms, uint64_t* req_id,
+                       uint64_t* trace_id, uint64_t* ingress_us,
+                       uint8_t* buf, int64_t cap) {
+  auto s = Get(h);
+  if (!s) return -1;
+  return s->Next(timeout_ms, req_id, buf, cap, trace_id, ingress_us);
 }
 
 int pt_srv_reply(int64_t h, uint64_t req_id, int64_t status,

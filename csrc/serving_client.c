@@ -19,11 +19,20 @@
  * "key=value\n" text with queue/served/uptime counters
  * (docs/serving_protocol.md "STATS control frames").
  *
+ * Traced requests (magic 'PTSR', same header layout, payload = u64
+ * trace id | tensor payload) tag the request with a caller-assigned
+ * id the server's per-request span records carry — see
+ * docs/serving_protocol.md "Request tracing". The reply framing is
+ * identical to an untraced request.
+ *
  * API (all return 0 on success, negative on error):
  *   ptsc_connect(host, port)                 -> fd (>=0) or -errno
  *   ptsc_request(fd, payload, len, &tag)     -> sends one frame
+ *   ptsc_request_traced(fd, trace_id, payload, len, &tag)
  *   ptsc_wait_reply(fd, tag, buf, cap, &status, &out_len)
  *   ptsc_infer(fd, payload, len, buf, cap, &status, &out_len)
+ *   ptsc_infer_traced(fd, trace_id, payload, len, buf, cap, &status,
+ *                     &out_len)
  *   ptsc_stats(fd, buf, cap, &status, &out_len)
  *   ptsc_close(fd)
  */
@@ -38,8 +47,9 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
-#define PTSC_MAGIC 0x56535450u     /* 'PTSV' */
-#define PTSC_MAGIC_CTL 0x43535450u /* 'PTSC' control frame */
+#define PTSC_MAGIC 0x56535450u       /* 'PTSV' */
+#define PTSC_MAGIC_CTL 0x43535450u   /* 'PTSC' control frame */
+#define PTSC_MAGIC_TRACE 0x52535450u /* 'PTSR' traced request */
 #define PTSC_OP_STATS 1u
 
 #define PTSC_ERR_CONNECT -1
@@ -152,6 +162,25 @@ int ptsc_request(int fd, const void *payload, uint32_t len, uint64_t *tag) {
   return 0;
 }
 
+/* Traced variant: 'PTSR' frame whose payload is the LE u64 trace_id
+ * followed by the caller's payload bytes (len on the wire covers
+ * both). trace_id 0 is legal but indistinguishable from untraced. */
+int ptsc_request_traced(int fd, uint64_t trace_id, const void *payload,
+                        uint32_t len, uint64_t *tag) {
+  unsigned char hdr[24];
+  uint64_t t = PTSC_NEXT_TAG();
+  int rc;
+  if (len > 0xFFFFFFFFu - 8u) return PTSC_ERR_TOOBIG;
+  ptsc_put_u32(hdr, PTSC_MAGIC_TRACE);
+  ptsc_put_u64(hdr + 4, t);
+  ptsc_put_u32(hdr + 12, len + 8u);
+  ptsc_put_u64(hdr + 16, trace_id);
+  if ((rc = ptsc_write_all(fd, hdr, sizeof(hdr))) != 0) return rc;
+  if (len > 0 && (rc = ptsc_write_all(fd, payload, len)) != 0) return rc;
+  if (tag) *tag = t;
+  return 0;
+}
+
 /* Read frames until the one tagged `tag` arrives. Out-of-order frames
  * for other tags are discarded (single-outstanding-request callers
  * never see any; pipelining callers should issue waits in send order
@@ -207,6 +236,15 @@ int ptsc_infer(int fd, const void *payload, uint32_t len, void *buf,
   return ptsc_wait_reply(fd, tag, buf, cap, status, out_len);
 }
 
+int ptsc_infer_traced(int fd, uint64_t trace_id, const void *payload,
+                      uint32_t len, void *buf, uint32_t cap,
+                      int64_t *status, uint32_t *out_len) {
+  uint64_t tag;
+  int rc = ptsc_request_traced(fd, trace_id, payload, len, &tag);
+  if (rc != 0) return rc;
+  return ptsc_wait_reply(fd, tag, buf, cap, status, out_len);
+}
+
 /* STATS control round trip: reply payload is "key=value\n" text. */
 int ptsc_stats(int fd, void *buf, uint32_t cap, int64_t *status,
                uint32_t *out_len) {
@@ -227,8 +265,11 @@ int ptsc_close(int fd) { return close(fd); }
 #include <stdlib.h>
 /* Demo/test binary: send argv[3] (default "ping") as one request,
  * print "status=<s> len=<n>" then the payload bytes to stdout. With
- * payload "--stats" issue a STATS control request instead.
- * Usage: ptsc_demo <host> <port> [payload-string | --stats] */
+ * payload "--stats" issue a STATS control request instead; with
+ * payload "--traced" send a traced request (trace id argv[4], default
+ * 42) carrying the payload argv[5] (default "ping").
+ * Usage: ptsc_demo <host> <port>
+ *            [payload-string | --stats | --traced [id [payload]]] */
 int main(int argc, char **argv) {
   static char reply[1 << 22];
   const char *msg;
@@ -236,7 +277,8 @@ int main(int argc, char **argv) {
   int64_t status = -999;
   int fd, rc;
   if (argc < 3) {
-    fprintf(stderr, "usage: %s host port [payload|--stats]\n", argv[0]);
+    fprintf(stderr, "usage: %s host port [payload|--stats|--traced]\n",
+            argv[0]);
     return 2;
   }
   msg = argc > 3 ? argv[3] : "ping";
@@ -247,7 +289,13 @@ int main(int argc, char **argv) {
   }
   if (strcmp(msg, "--stats") == 0)
     rc = ptsc_stats(fd, reply, sizeof(reply), &status, &out_len);
-  else
+  else if (strcmp(msg, "--traced") == 0) {
+    uint64_t trace_id = argc > 4 ? (uint64_t)strtoull(argv[4], NULL, 10)
+                                 : 42u;
+    const char *body = argc > 5 ? argv[5] : "ping";
+    rc = ptsc_infer_traced(fd, trace_id, body, (uint32_t)strlen(body),
+                           reply, sizeof(reply), &status, &out_len);
+  } else
     rc = ptsc_infer(fd, msg, (uint32_t)strlen(msg), reply, sizeof(reply),
                     &status, &out_len);
   if (rc != 0) {

@@ -20,6 +20,7 @@ from __future__ import annotations
 import os
 import random
 import signal
+import socket
 import subprocess
 import sys
 import time
@@ -27,7 +28,8 @@ from collections import deque
 from typing import Dict, List, Optional, Sequence
 
 __all__ = ["launch_procs", "launch_elastic", "terminate_local_procs",
-           "get_cluster_env", "classify_exit", "spawn"]
+           "get_cluster_env", "fleet_observability_env",
+           "classify_exit", "spawn"]
 
 
 def classify_exit(code: int) -> str:
@@ -53,6 +55,33 @@ def get_cluster_env(rank: int, world: int, cp_endpoint: str) \
         "PT_TRAINER_ID": str(rank),
         "PT_TRAINERS_NUM": str(world),
         "PT_CP_ENDPOINT": cp_endpoint,
+    }
+
+
+def fleet_observability_env(rank: int, env: Dict[str, str]
+                            ) -> Dict[str, str]:
+    """Per-worker observability wiring (docs/observability.md, "Fleet
+    view"). With a positive FLAGS_metrics_port in the job env as the
+    *base* port, every worker gets its own exporter port (base + rank
+    — N workers on one host no longer collide on one bind) and the
+    fleet-federation discovery env: PT_FLEET_AGGREGATOR points every
+    worker at rank 0's exporter (the aggregator) and PT_FLEET_HOST
+    names the worker in the merged view. The assigned port is both in
+    the worker's env and in every snapshot it pushes (fleet.py
+    local_snapshot), so /fleet/health lists where each worker serves.
+    Base <= 0 (ephemeral/off) leaves everything untouched — federation
+    then needs explicit fleet.start_reporter wiring."""
+    try:
+        base = int(env.get("FLAGS_metrics_port",
+                           os.environ.get("FLAGS_metrics_port", "0")))
+    except ValueError:
+        return {}
+    if base <= 0:
+        return {}
+    return {
+        "FLAGS_metrics_port": str(base + rank),
+        "PT_FLEET_AGGREGATOR": f"127.0.0.1:{base}",
+        "PT_FLEET_HOST": f"{socket.gethostname()}:{rank}",
     }
 
 
@@ -92,6 +121,9 @@ def launch_procs(cmd: Sequence[str], nproc: int,
             env.update(get_cluster_env(rank, nproc, cp_endpoint))
             if env_extra:
                 env.update(env_extra)
+            # per-worker exporter port + fleet discovery (base+rank
+            # scheme; no-op unless a positive base port is configured)
+            env.update(fleet_observability_env(rank, env))
             procs.append(subprocess.Popen(list(cmd), env=env))
         exit_code = 0
         while True:
@@ -279,6 +311,7 @@ def spawn(func, args=(), nprocs: int = 1, join: bool = True,
         endpoint = f"127.0.0.1:{server.port}"
         for rank in range(nprocs):
             env = get_cluster_env(rank, nprocs, endpoint)
+            env.update(fleet_observability_env(rank, env))
             p = ctx.Process(target=_spawn_entry,
                             args=(func, args, env), daemon=False)
             p.start()

@@ -342,6 +342,38 @@ define_flag("straggler_factor", 0.0,
             "is a reasonable production starting point.")
 
 
+define_flag("fleet_push_interval_s", 2.0,
+            "Seconds between fleet-federation snapshot pushes from a "
+            "worker's FleetReporter to the rank-0 aggregator "
+            "(observability/fleet.py). The reporter starts when the "
+            "observability exporter comes up and PT_FLEET_AGGREGATOR "
+            "is set (launch_procs/launch_elastic set it); a push is "
+            "one stdlib HTTP POST and a failed push is counted "
+            "(fleet_push_failures_total), never raised.")
+define_flag("fleet_stale_after_s", 15.0,
+            "The /fleet/health endpoint marks a host stale (and "
+            "answers HTTP 503) when its last snapshot push is older "
+            "than this many seconds — a SIGKILLed worker flips the "
+            "fleet unhealthy while its last snapshot keeps serving in "
+            "the merged /fleet view. 0 disables staleness (hosts are "
+            "then only unhealthy if they pushed health.ok=false).")
+
+
+def _request_ring_changed(value) -> None:
+    from .observability import reqtrace as _obs_reqtrace
+    _obs_reqtrace.ring().resize(int(value))
+
+
+define_flag("serving_request_ring", 256,
+            "Capacity of the inference server's per-request span ring "
+            "(observability/reqtrace.py): the last N request trace "
+            "records — trace id, the five lifecycle timestamps "
+            "(ingress/dequeue/assembly/dispatch/reply) and the derived "
+            "serving_*_ms spans — served at /requests?n= on the "
+            "observability exporter.",
+            on_change=_request_ring_changed)
+
+
 def _flight_buffer_changed(value) -> None:
     from .observability import flight as _obs_flight
     _obs_flight.recorder().resize(int(value))

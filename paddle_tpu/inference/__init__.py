@@ -383,8 +383,11 @@ class Server:
         self.n_errors = 0
         self.n_shed = 0
         # arrival-stamped staging queue: requests are drained off the
-        # native transport eagerly so their queue age is measurable
-        # (the native queue carries no enqueue timestamps)
+        # native transport eagerly so their queue age is measurable.
+        # Entries are (perf_counter_at_dequeue, request-dict) — the
+        # dict carries the per-request span fields (trace_id + the
+        # ingress/dequeue unix stamps) that feed the /requests ring
+        # and the serving_*_ms histograms.
         self._rq: collections.deque = collections.deque()
         self._thread.start()
         # live observability: flag-gated HTTP exporter + a bridge thread
@@ -446,12 +449,20 @@ class Server:
                 v = 0
         return max(0, int(v or 0)) / 1e3
 
+    @staticmethod
+    def _mk_req(r) -> Dict[str, Any]:
+        """Wrap one transport dequeue into the request-span dict the
+        batcher threads through to the reply (reqtrace.STAMPS order)."""
+        rid, payload, trace_id, ingress = r
+        return {"rid": rid, "payload": payload, "trace_id": trace_id,
+                "ingress_unix": ingress, "dequeue_unix": time.time()}
+
     def _drain_transport(self) -> None:
         while True:
-            r = self.transport.next_request(timeout_ms=0)
+            r = self.transport.next_request_ex(timeout_ms=0)
             if r is None:
                 return
-            self._rq.append((time.perf_counter(), r[0], r[1]))
+            self._rq.append((time.perf_counter(), self._mk_req(r)))
 
     def _next_request(self, timeout_ms: int):
         """The batcher's Next() path: stamped staging queue first, then
@@ -459,25 +470,26 @@ class Server:
         deadline are shed here — counted, never silently dropped."""
         self._drain_transport()
         if not self._rq:
-            r = self.transport.next_request(timeout_ms=timeout_ms)
+            r = self.transport.next_request_ex(timeout_ms=timeout_ms)
             if r is None:
                 return None
-            self._rq.append((time.perf_counter(), r[0], r[1]))
+            self._rq.append((time.perf_counter(), self._mk_req(r)))
         ddl = self._queue_deadline_s()
         while self._rq:
-            ts, rid, payload = self._rq.popleft()
+            ts, req = self._rq.popleft()
             age = time.perf_counter() - ts
             if ddl > 0 and age > ddl:
-                self._shed(rid, age, ddl)
+                self._shed(req, age, ddl)
                 continue
-            return rid, payload
+            return req
         return None
 
-    def _shed(self, rid: int, age_s: float, deadline_s: float) -> None:
+    def _shed(self, req: Dict[str, Any], age_s: float,
+              deadline_s: float) -> None:
         self.n_shed += 1
         try:
             self.transport.reply(
-                rid,
+                req["rid"],
                 f"request shed: queued {age_s * 1e3:.0f}ms > queue "
                 f"deadline {deadline_s * 1e3:.0f}ms".encode(),
                 status=-1)
@@ -488,12 +500,19 @@ class Server:
             stat_add("serving.shed_total")
         except Exception:  # noqa: BLE001
             pass
+        from ..observability import flight as _flight
+        _flight.record("serving_shed", force=True,
+                       trace_id=req.get("trace_id"),
+                       age_ms=round(age_s * 1e3, 3),
+                       deadline_ms=round(deadline_s * 1e3, 3))
         from .. import observability as obs
         if obs.enabled():
             obs.counter("requests_shed_total",
                         "requests answered with an error because they "
                         "sat in the serving queue longer than the "
                         "queue deadline").inc()
+            self._record_span(req, status=-1, outcome="shed",
+                              reply_unix=time.time())
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -521,24 +540,32 @@ class Server:
                 traceback.print_exc()
 
     def _serve_group(self, group) -> None:
+        # batch-assembly stamp: the dynamic-batch window for this group
+        # just closed — everything before is queueing/assembly wait
+        t_assembly = time.time()
         decoded = []
-        for rid, payload in group:
+        for req in group:
+            req["assembly_unix"] = t_assembly
             try:
-                arrs = decode_tensors(payload)
+                arrs = decode_tensors(req["payload"])
                 # batching concatenates along dim 0: every tensor needs one
                 if not arrs or any(a.ndim == 0 for a in arrs):
                     raise ValueError(
                         "request must carry >=1 tensors, each with a "
                         "leading batch dim")
-                decoded.append((rid, arrs))
+                decoded.append((req, arrs))
             except Exception as e:  # noqa: BLE001
-                self.transport.reply(rid, str(e).encode(), status=-1)
+                self.transport.reply(req["rid"], str(e).encode(),
+                                     status=-1)
+                self._record_span(req, status=-1, outcome="decode_error",
+                                  reply_unix=time.time())
         # group by per-row signature (shape minus batch dim + dtypes)
-        sigs: Dict[Tuple, List[Tuple[int, List[np.ndarray]]]] = {}
-        for rid, arrs in decoded:
+        sigs: Dict[Tuple, List[Tuple[Dict, List[np.ndarray]]]] = {}
+        for req, arrs in decoded:
             sig = tuple((a.shape[1:], str(a.dtype)) for a in arrs)
-            sigs.setdefault(sig, []).append((rid, arrs))
+            sigs.setdefault(sig, []).append((req, arrs))
         for batch_members in sigs.values():
+            t_dispatch = time.time()
             try:
                 rows = [m[1][0].shape[0] for m in batch_members]
                 joined = [np.concatenate([m[1][i] for m in batch_members],
@@ -548,16 +575,94 @@ class Server:
                 self.n_batches += 1
                 self._note_batch(len(batch_members), sum(rows))
                 off = 0
-                for (rid, _), r in zip(batch_members, rows):
+                for (req, _), r in zip(batch_members, rows):
                     part = [o[off:off + r] for o in outs]
-                    self.transport.reply(rid, encode_tensors(part))
+                    self.transport.reply(req["rid"], encode_tensors(part))
                     off += r
                     self.n_requests += 1
+                    self._record_span(req, status=0, outcome="ok",
+                                      dispatch_unix=t_dispatch,
+                                      reply_unix=time.time(),
+                                      batch_rows=sum(rows),
+                                      batch_members=len(batch_members))
             except Exception as e:  # noqa: BLE001
                 self.n_errors += len(batch_members)
                 self._note_error(len(batch_members))
-                for rid, _ in batch_members:
-                    self.transport.reply(rid, str(e).encode(), status=-1)
+                for req, _ in batch_members:
+                    self.transport.reply(req["rid"], str(e).encode(),
+                                         status=-1)
+                    self._record_span(req, status=-1,
+                                      outcome="execute_error",
+                                      dispatch_unix=t_dispatch,
+                                      reply_unix=time.time(),
+                                      error=str(e)[:200])
+
+    def _record_span(self, req: Dict[str, Any], status: int,
+                     outcome: str,
+                     dispatch_unix: Optional[float] = None,
+                     reply_unix: Optional[float] = None,
+                     batch_rows: Optional[int] = None,
+                     batch_members: Optional[int] = None,
+                     error: Optional[str] = None) -> None:
+        """Close one request's span record: derive the four latency
+        spans, observe the serving_*_ms histograms (successful serves
+        only — shed/error records still enter the ring), and append to
+        the /requests ring. Never raises."""
+        from .. import observability as obs
+        if not obs.enabled():
+            return
+        try:
+            from ..observability import metrics as _m
+            from ..observability import reqtrace as _reqtrace
+            rec = {"trace_id": req.get("trace_id") or 0,
+                   "req_id": req.get("rid"),
+                   "status": status, "outcome": outcome,
+                   "ingress_unix": req.get("ingress_unix"),
+                   "dequeue_unix": req.get("dequeue_unix"),
+                   "assembly_unix": req.get("assembly_unix"),
+                   "dispatch_unix": dispatch_unix,
+                   "reply_unix": reply_unix}
+            if batch_rows is not None:
+                rec["batch_rows"] = batch_rows
+            if batch_members is not None:
+                rec["batch_members"] = batch_members
+            if error is not None:
+                rec["error"] = error
+
+            def span_ms(a, b):
+                if rec.get(a) is None or rec.get(b) is None:
+                    return None
+                return max(0.0, (rec[b] - rec[a]) * 1e3)
+
+            rec["queue_wait_ms"] = span_ms("ingress_unix",
+                                           "dequeue_unix")
+            rec["batch_assembly_ms"] = span_ms("dequeue_unix",
+                                               "assembly_unix")
+            rec["compute_ms"] = span_ms("dispatch_unix", "reply_unix")
+            rec["e2e_ms"] = span_ms("ingress_unix", "reply_unix")
+            if status == 0:
+                spans = {
+                    "serving_queue_wait_ms":
+                        ("native-queue wait: frame ingress to batcher "
+                         "dequeue", rec["queue_wait_ms"]),
+                    "serving_batch_assembly_ms":
+                        ("dynamic-batch window: dequeue to batch close",
+                         rec["batch_assembly_ms"]),
+                    "serving_compute_ms":
+                        ("predictor dispatch to reply written (XLA run "
+                         "+ scatter)", rec["compute_ms"]),
+                    "serving_e2e_ms":
+                        ("whole server-side round trip: ingress to "
+                         "reply written", rec["e2e_ms"]),
+                }
+                for name, (help_, v) in spans.items():
+                    if v is not None:
+                        obs.histogram(
+                            name, help_,
+                            buckets=_m.LATENCY_MS_BUCKETS).observe(v)
+            _reqtrace.record(rec)
+        except Exception:  # noqa: BLE001 — never fail a reply on spans
+            pass
 
     def _note_batch(self, n_members: int, n_rows: int) -> None:
         """Batch accounting on both planes: the native stat registry
@@ -628,23 +733,41 @@ class Client:
       stats read has no side effects. ``infer()`` deliberately does
       not (the server may have executed the request); it reconnects
       the transport for subsequent calls and raises.
+
+    Request tracing (docs/serving_protocol.md, "Request tracing"):
+    every ``infer`` is assigned a unique 64-bit trace id (or pass
+    ``trace_id=`` explicitly) and sent as a ``PTSR`` frame; the server
+    stamps the request's lifecycle against that id and serves the span
+    record at ``/requests`` on its observability exporter. The id of
+    the most recent call is ``last_trace_id``. ``traced=False``
+    restores the old untraced ``PTSV`` frames (e.g. against a server
+    predating the trace field).
     """
 
     _MAGIC = 0x56535450       # 'PTSV' tensor request
     _MAGIC_CTL = 0x43535450   # 'PTSC' control frame
+    _MAGIC_TRACE = 0x52535450  # 'PTSR' traced tensor request
     _OP_STATS = 1
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  timeout_s: float = 30.0,
                  deadline_s: Optional[float] = None,
                  max_reconnects: int = 2,
-                 reconnect_backoff_s: float = 0.05):
+                 reconnect_backoff_s: float = 0.05,
+                 traced: bool = True):
         self._host = host
         self._port = port
         self._timeout_s = timeout_s
         self._deadline_s = deadline_s
         self._max_reconnects = int(max_reconnects)
         self._reconnect_backoff_s = float(reconnect_backoff_s)
+        self._traced = bool(traced)
+        # trace ids: random 48-bit client base | 16-bit call counter —
+        # unique across clients without coordination, never 0 (0 is the
+        # wire's "untraced" value)
+        self._trace_base = int.from_bytes(os.urandom(6), "little") << 16
+        self._trace_n = 0
+        self.last_trace_id: Optional[int] = None
         self._wlock = threading.Lock()
         self._rlock = threading.Lock()
         self._conn_lock = threading.Lock()
@@ -654,6 +777,14 @@ class Client:
         self._sock: Optional[socket.socket] = None
         self._gen = 0
         self._connect()
+
+    def make_trace_id(self) -> int:
+        """Next unique nonzero trace id for this client."""
+        with self._conn_lock:
+            self._trace_n += 1
+            tid = (self._trace_base | (self._trace_n & 0xFFFF)) \
+                & 0xFFFFFFFFFFFFFFFF
+        return tid or 1
 
     # -- connection management -------------------------------------------
 
@@ -686,6 +817,10 @@ class Client:
                                 deadline: Optional[float]) -> int:
         """One bounded retry step; returns the new attempt count or
         raises the terminal error."""
+        from ..observability import flight as _flight
+        _flight.record("client_reconnect", force=True,
+                       host=self._host, port=self._port,
+                       attempt=attempts + 1)
         if attempts >= self._max_reconnects:
             raise ConnectionError(
                 f"server unreachable after {attempts} reconnect "
@@ -719,14 +854,18 @@ class Client:
     # -- public API -------------------------------------------------------
 
     def infer(self, arrays: Sequence[np.ndarray],
-              deadline_s: Optional[float] = None) -> List[np.ndarray]:
+              deadline_s: Optional[float] = None,
+              trace_id: Optional[int] = None) -> List[np.ndarray]:
+        if trace_id is None and self._traced:
+            trace_id = self.make_trace_id()
+        self.last_trace_id = trace_id
         deadline = self._deadline_of(deadline_s)
         attempts = 0
         while True:
             with self._rcond:
                 gen = self._gen
             try:
-                tag = self._send(arrays)
+                tag = self._send(arrays, trace_id)
             except (ConnectionError, OSError) as e:
                 # nothing reached the server: reconnect and resend
                 self._poison(gen)
@@ -786,9 +925,17 @@ class Client:
 
     # -- wire -------------------------------------------------------------
 
-    def _send(self, arrays: Sequence[np.ndarray]) -> int:
-        """Encode + send one tensor request; returns its tag."""
-        return self._send_frame(self._MAGIC, encode_tensors(arrays))
+    def _send(self, arrays: Sequence[np.ndarray],
+              trace_id: Optional[int] = None) -> int:
+        """Encode + send one tensor request; returns its tag. With a
+        trace id the frame is 'PTSR' and the payload is prefixed with
+        the LE u64 id (docs/serving_protocol.md, "Request tracing")."""
+        payload = encode_tensors(arrays)
+        if trace_id:
+            return self._send_frame(
+                self._MAGIC_TRACE,
+                struct.pack("<Q", trace_id) + payload)
+        return self._send_frame(self._MAGIC, payload)
 
     def _send_frame(self, magic: int, payload: bytes) -> int:
         with self._wlock:
@@ -847,6 +994,10 @@ class Client:
                     # mid-frame timeout: the stream position is lost —
                     # poison so other waiters don't read garbage
                     self._poison(gen)
+                    from ..observability import flight as _flight
+                    _flight.record("client_deadline_expired",
+                                   force=True, host=self._host,
+                                   port=self._port, tag=want_tag)
                     raise TimeoutError(
                         "deadline exceeded waiting for server reply"
                     ) from e

@@ -168,6 +168,12 @@ def _load():
             "pt_srv_stop": ([c.c_int64], None),
             "pt_srv_next": ([c.c_int64, c.c_int, c.POINTER(c.c_uint64),
                              c.POINTER(c.c_uint8), c.c_int64], c.c_int64),
+            "pt_srv_next_ex": ([c.c_int64, c.c_int,
+                                c.POINTER(c.c_uint64),
+                                c.POINTER(c.c_uint64),
+                                c.POINTER(c.c_uint64),
+                                c.POINTER(c.c_uint8), c.c_int64],
+                               c.c_int64),
             "pt_srv_reply": ([c.c_int64, c.c_uint64, c.c_int64,
                               c.POINTER(c.c_uint8), c.c_int64], c.c_int),
             "pt_srv_pending": ([c.c_int64], c.c_int64),
@@ -717,6 +723,25 @@ class ServingTransport:
             return None
         return rid.value, ctypes.string_at(self._buf, n)
 
+    def next_request_ex(self, timeout_ms: int = 100
+                        ) -> Optional[Tuple[int, bytes, int, float]]:
+        """Trace-aware dequeue: one (req_id, payload, trace_id,
+        ingress_unix_s) or None. trace_id is 0 for untraced ('PTSV')
+        frames; ingress_unix_s is the reader thread's arrival stamp —
+        the first of the request-span timestamps (/requests)."""
+        rid = ctypes.c_uint64(0)
+        trace = ctypes.c_uint64(0)
+        ingress = ctypes.c_uint64(0)
+        n = _load().pt_srv_next_ex(self._h, timeout_ms,
+                                   ctypes.byref(rid),
+                                   ctypes.byref(trace),
+                                   ctypes.byref(ingress),
+                                   self._buf, self._max_payload)
+        if n <= 0:
+            return None
+        return (rid.value, ctypes.string_at(self._buf, n),
+                trace.value, ingress.value / 1e6)
+
     def reply(self, req_id: int, payload: bytes, status: int = 0) -> int:
         """Send a reply. Returns the native rc (0 ok, -1 unknown id,
         -3 client gone) and counts nonzero outcomes in the stat
@@ -732,6 +757,13 @@ class ServingTransport:
             stat_add("serving.reply_rc_unknown_id" if rc == -1
                      else "serving.reply_rc_client_gone" if rc == -3
                      else "serving.reply_rc_other")
+            try:
+                from ..observability import flight as _flight
+                _flight.record("serving_reply_dropped", force=True,
+                               req_id=int(req_id), rc=int(rc),
+                               status=int(status))
+            except Exception:  # noqa: BLE001 — telemetry must not raise
+                pass
         return rc
 
     def pending(self) -> int:

@@ -25,7 +25,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "registry", "counter", "gauge", "histogram",
-           "enabled", "set_enabled", "DEFAULT_BUCKETS"]
+           "enabled", "set_enabled", "DEFAULT_BUCKETS",
+           "LATENCY_MS_BUCKETS"]
 
 # Module-level enabled cache: read on every instrument write, so it must
 # be one attribute load — FLAGS_enable_metrics keeps it in sync via its
@@ -158,6 +159,14 @@ class Gauge(_Instrument):
 DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
+# Shared fixed-boundary scheme for millisecond latency histograms
+# (serving_*_ms and anything else fleet-federated): every host using the
+# same declared boundaries is what makes the cross-host bucket-wise
+# merge in observability/fleet.py exact rather than approximate.
+LATENCY_MS_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                      100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
+                      10000.0)
+
 
 class Histogram(_Instrument):
     """Cumulative-bucket histogram (Prometheus semantics)."""
@@ -168,7 +177,8 @@ class Histogram(_Instrument):
                  always: bool = False,
                  buckets: Optional[Sequence[float]] = None) -> None:
         super().__init__(name, help, lock, always)
-        self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS))
+        self.buckets = tuple(sorted(
+            float(b) for b in (buckets or DEFAULT_BUCKETS)))
         self._series: Dict[Tuple, Dict[str, Any]] = {}
 
     def observe(self, value: float, **labels) -> None:
@@ -248,8 +258,24 @@ class MetricsRegistry:
 
     def histogram(self, name: str, help: str = "", always: bool = False,
                   buckets: Optional[Sequence[float]] = None) -> Histogram:
-        return self._get_or_make(Histogram, name, help, always,
-                                 buckets=buckets)
+        """Bucket boundaries are part of the instrument's declaration:
+        the first registration fixes them (``None`` → DEFAULT_BUCKETS);
+        a later registration that declares *different* boundaries
+        raises — silently returning the old instrument would mis-merge
+        fleet-federated bucket counts (observability/fleet.py).
+        ``buckets=None`` on an existing histogram means "whatever was
+        declared" and never conflicts."""
+        h = self._get_or_make(Histogram, name, help, always,
+                              buckets=buckets)
+        if buckets is not None:
+            declared = tuple(sorted(float(b) for b in buckets))
+            if declared != h.buckets:
+                raise ValueError(
+                    f"histogram '{name}' already declared with buckets "
+                    f"{h.buckets}; re-registration with {declared} "
+                    "would silently mis-merge — use one shared "
+                    "boundary scheme (e.g. metrics.LATENCY_MS_BUCKETS)")
+        return h
 
     def get(self, name: str) -> Optional[_Instrument]:
         with self._lock:

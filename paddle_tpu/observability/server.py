@@ -27,6 +27,16 @@ Endpoints:
   the goodput headline, observability/goodput.py).
 - ``/flight``   — the crash flight recorder's live event ring
   (observability/flight.py).
+- ``/requests?n=`` — the last N per-request serving span records
+  (observability/reqtrace.py: trace id + the five lifecycle
+  timestamps + derived latency spans).
+- ``/fleet`` (+ ``/fleet/goodput``, ``/fleet/health``, and the
+  worker-facing ``POST /fleet/push``) — the cross-host federation
+  plane (observability/fleet.py): any process's exporter doubles as
+  the fleet aggregator; workers push snapshots here and the merged
+  view (counters summed, gauges ``{host=}``-labeled, histograms
+  merged bucket-wise) is served back. ``/fleet/health`` answers 503
+  when any host's push is stale.
 
 Port selection (``FLAGS_metrics_port``): a positive value binds that
 port; **0 (the default) binds an ephemeral port** — the chosen port is
@@ -49,10 +59,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
+from . import fleet as _fleet
 from . import flight as _flight
 from . import goodput as _goodput
 from . import metrics as _metrics
 from . import recompile as _recompile
+from . import reqtrace as _reqtrace
 from . import tracer as _tracer
 from . import xprof as _xprof
 
@@ -206,13 +218,67 @@ class _Handler(BaseHTTPRequestHandler):
                 rec = _flight.recorder()
                 self._send_json(200, {"capacity": rec.capacity,
                                       "events": rec.events()})
+            elif url.path == "/requests":
+                q = parse_qs(url.query)
+                try:
+                    n = int(q.get("n", ["0"])[0]) or None
+                except ValueError:
+                    n = None
+                r = _reqtrace.ring()
+                self._send_json(200, {"capacity": r.capacity,
+                                      "requests": r.recent(n)})
+            elif url.path == "/fleet":
+                q = parse_qs(url.query)
+                if q.get("format", [""])[0] == "json":
+                    self._send_json(200, _fleet.fleet_view())
+                else:
+                    self._send(200,
+                               _fleet.fleet_prometheus_text().encode(),
+                               "text/plain; version=0.0.4")
+            elif url.path == "/fleet/goodput":
+                self._send_json(200, _fleet.fleet_goodput())
+            elif url.path == "/fleet/health":
+                ok, payload = _fleet.fleet_health()
+                self._send_json(200 if ok else 503, payload)
             elif url.path == "/":
                 self._send(200,
                            b"paddle_tpu observability: /metrics /healthz "
-                           b"/varz /trace?ms=N /goodput /flight\n",
+                           b"/varz /trace?ms=N /goodput /flight "
+                           b"/requests?n=N /fleet /fleet/goodput "
+                           b"/fleet/health\n",
                            "text/plain")
             else:
                 self._send(404, b"not found\n", "text/plain")
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # noqa: BLE001 — keep the exporter alive
+            try:
+                self._send_json(500,
+                                {"error": f"{type(e).__name__}: {e}"})
+            except Exception:
+                pass
+
+    def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        try:
+            url = urlparse(self.path)
+            if url.path != "/fleet/push":
+                self._send(404, b"not found\n", "text/plain")
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+            except ValueError:
+                n = 0
+            if n <= 0 or n > 64 << 20:  # bound a bad/abusive length
+                self._send_json(400, {"error": "bad Content-Length"})
+                return
+            body = self.rfile.read(n)
+            try:
+                snapshot = json.loads(body)
+                host = _fleet.aggregator().ingest(snapshot)
+            except (ValueError, TypeError) as e:
+                self._send_json(400, {"error": f"bad fleet push: {e}"})
+                return
+            self._send_json(200, {"ok": True, "host": host})
         except BrokenPipeError:
             pass
         except Exception as e:  # noqa: BLE001 — keep the exporter alive
@@ -260,8 +326,8 @@ def start(port: int = 0) -> ObservabilityServer:
                 "TCP port of the live observability HTTP exporter",
                 always=True).set(float(_server.port))
             _log.info("observability exporter serving /metrics /healthz "
-                      "/varz /trace /goodput /flight on :%d",
-                      _server.port)
+                      "/varz /trace /goodput /flight /requests /fleet "
+                      "on :%d", _server.port)
         elif port > 0 and port != _server.port:
             _log.info("observability exporter already bound on :%d; "
                       "ignoring request for :%d", _server.port, port)
@@ -283,7 +349,9 @@ def stop() -> None:
 def maybe_start() -> Optional[ObservabilityServer]:
     """Flag-driven start, called from hapi.Model.fit and
     inference.Server: metrics enabled and FLAGS_metrics_port >= 0
-    (0 = ephemeral bind, negative = exporter off)."""
+    (0 = ephemeral bind, negative = exporter off). Also the fleet
+    hook: when the launcher provided PT_FLEET_AGGREGATOR, the push
+    reporter starts alongside the exporter (fleet.py)."""
     if not _metrics.enabled():
         return _server
     try:
@@ -293,7 +361,12 @@ def maybe_start() -> Optional[ObservabilityServer]:
         return _server
     if port < 0:
         return _server
-    return start(port)
+    srv = start(port)
+    try:
+        _fleet.maybe_start_reporter()
+    except Exception:  # noqa: BLE001 — federation must not break fit
+        _log.exception("fleet reporter failed to start")
+    return srv
 
 
 # ----------------------------------------------------------------- CLI
@@ -337,9 +410,34 @@ def self_test() -> int:
         gp = json.loads(text)
         assert code == 200 and "goodput_ratio" in gp \
             and set(gp["buckets"]) >= set(_goodput.BUCKETS), text
+        _reqtrace.record({"trace_id": 7, "ingress_unix": time.time(),
+                          "reply_unix": time.time()})
+        code, text = fetch("/requests?n=5")
+        rq = json.loads(text)
+        assert code == 200 and any(
+            r.get("trace_id") == 7 for r in rq["requests"]), text
+        # fleet plane: push one snapshot to ourselves, read it back
+        body = json.dumps(_fleet.local_snapshot("selftest-host"),
+                          default=str).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/fleet/push", data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+        code, text = fetch("/fleet")
+        assert code == 200 and "selftest_http_total 3" in text, text
+        code, text = fetch("/fleet/health")
+        fh = json.loads(text)
+        assert code == 200 and "selftest-host" in fh["hosts"], text
+        code, text = fetch("/fleet/goodput")
+        assert code == 200 and "selftest-host" in \
+            json.loads(text)["hosts"], text
     finally:
         srv.stop()
         _metrics.set_enabled(False)
+        _fleet.aggregator().reset()
+        _reqtrace.ring().reset()
     print("self-test OK")
     return 0
 
@@ -355,7 +453,8 @@ def main() -> int:
     if args.self_test:
         return self_test()
     srv = start(args.port)
-    print(f"serving /metrics /healthz /varz /trace on :{srv.port}")
+    print(f"serving /metrics /healthz /varz /trace /goodput /flight "
+          f"/requests /fleet on :{srv.port}")
     try:
         while True:
             time.sleep(3600)

@@ -1,0 +1,344 @@
+"""Fleet-wide observability federation (observability/fleet.py):
+merge semantics (counters summed, gauges host-labeled, histograms
+bucket-wise with mismatch-raises), the aggregator store + /fleet
+endpoints, the worker push reporter, launcher discovery wiring, and
+the tools/fleet_status.py 3-process self-test drill (the ISSUE
+acceptance run).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import fleet
+from paddle_tpu.observability import server as obs_server
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def metrics_on():
+    pt.set_flags({"enable_metrics": True})
+    try:
+        yield
+    finally:
+        pt.set_flags({"enable_metrics": False,
+                      "fleet_stale_after_s": 15.0,
+                      "fleet_push_interval_s": 2.0})
+        fleet.stop_reporter()
+        obs_server.stop()
+        obs.reset_all()
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# merge semantics
+# ---------------------------------------------------------------------------
+
+def _counter_snap(value, **labels):
+    return {"type": "counter", "help": "h",
+            "series": [{"labels": labels, "value": value}]}
+
+
+def test_merge_counters_summed_per_label_set():
+    merged = fleet.merge_metric_snapshots({
+        "a": {"reqs_total": _counter_snap(3)},
+        "b": {"reqs_total": _counter_snap(4)},
+        "c": {"reqs_total": _counter_snap(5, route="x")},
+    })
+    series = {tuple(sorted(s["labels"].items())): s["value"]
+              for s in merged["reqs_total"]["series"]}
+    assert series[()] == 7
+    assert series[(("route", "x"),)] == 5
+
+
+def test_merge_gauges_get_host_label():
+    merged = fleet.merge_metric_snapshots({
+        "a": {"loss": {"type": "gauge", "help": "",
+                       "series": [{"labels": {}, "value": 1.0}]}},
+        "b": {"loss": {"type": "gauge", "help": "",
+                       "series": [{"labels": {}, "value": 2.0}]}},
+    })
+    got = {s["labels"]["host"]: s["value"]
+           for s in merged["loss"]["series"]}
+    assert got == {"a": 1.0, "b": 2.0}
+
+
+def _hist_snap(buckets, count, total):
+    return {"type": "histogram", "help": "",
+            "series": [{"labels": {}, "count": count, "sum": total,
+                        "buckets": dict(buckets)}]}
+
+
+def test_merge_histograms_bucketwise_exact():
+    h1 = _hist_snap({"1.0": 1, "5.0": 2, "+Inf": 3}, 3, 4.5)
+    h2 = _hist_snap({"1.0": 0, "5.0": 4, "+Inf": 4}, 4, 9.0)
+    merged = fleet.merge_metric_snapshots({"a": {"lat_ms": h1},
+                                           "b": {"lat_ms": h2}})
+    s = merged["lat_ms"]["series"][0]
+    assert s["buckets"] == {"1.0": 1, "5.0": 6, "+Inf": 7}
+    assert s["count"] == 7 and s["sum"] == 13.5
+
+
+def test_merge_histogram_boundary_mismatch_raises():
+    """ISSUE satellite: a bucket-boundary mismatch must raise, not
+    silently mis-merge."""
+    h1 = _hist_snap({"1.0": 1, "+Inf": 1}, 1, 0.5)
+    h2 = _hist_snap({"2.0": 1, "+Inf": 1}, 1, 0.5)
+    with pytest.raises(ValueError, match="bucket boundaries differ"):
+        fleet.merge_metric_snapshots({"a": {"lat_ms": h1},
+                                      "b": {"lat_ms": h2}})
+
+
+def test_merge_type_clash_raises():
+    with pytest.raises(ValueError, match="counter.*gauge|gauge.*counter"):
+        fleet.merge_metric_snapshots({
+            "a": {"x": _counter_snap(1)},
+            "b": {"x": {"type": "gauge", "help": "",
+                        "series": [{"labels": {}, "value": 1.0}]}},
+        })
+
+
+def test_merged_prometheus_text_renders_all_kinds():
+    merged = fleet.merge_metric_snapshots({
+        "a": {"c_total": _counter_snap(2),
+              "g": {"type": "gauge", "help": "gh",
+                    "series": [{"labels": {}, "value": 7.0}]},
+              "h_ms": _hist_snap({"1.0": 1, "+Inf": 2}, 2, 3.0)},
+    })
+    text = fleet.merged_prometheus_text(merged)
+    assert "c_total 2" in text
+    assert 'g{host="a"} 7.0' in text
+    assert 'h_ms_bucket{le="1.0"} 1' in text
+    assert "h_ms_count 2" in text
+
+
+# ---------------------------------------------------------------------------
+# registration-time bucket declaration (metrics.py satellite)
+# ---------------------------------------------------------------------------
+
+def test_histogram_bucket_redeclaration_raises(metrics_on):
+    h = obs.histogram("t_decl_ms", buckets=(1.0, 5.0))
+    assert h.buckets == (1.0, 5.0)
+    # same boundaries (any order/int spelling) and None are fine
+    assert obs.histogram("t_decl_ms", buckets=(5, 1.0)) is h
+    assert obs.histogram("t_decl_ms") is h
+    with pytest.raises(ValueError, match="already declared"):
+        obs.histogram("t_decl_ms", buckets=(1.0, 10.0))
+
+
+def test_latency_ms_scheme_shared():
+    assert obs.metrics.LATENCY_MS_BUCKETS[0] == 0.1
+    assert list(obs.metrics.LATENCY_MS_BUCKETS) == \
+        sorted(obs.metrics.LATENCY_MS_BUCKETS)
+
+
+# ---------------------------------------------------------------------------
+# aggregator + endpoints + reporter
+# ---------------------------------------------------------------------------
+
+def test_aggregator_ingest_and_fleet_endpoints(metrics_on):
+    srv = obs_server.start(0)
+    obs.counter("t_fed_total").inc(2)
+    obs.gauge("t_fed_gauge").set(0.5)
+    # two "hosts": one pushed over real HTTP by the reporter, one
+    # ingested directly (distinct host id)
+    rep = fleet.FleetReporter(f"127.0.0.1:{srv.port}", host_id="hA",
+                              interval_s=60)
+    try:
+        assert rep.push_once()
+        fleet.aggregator().ingest(fleet.local_snapshot("hB"))
+
+        code, text = _get(srv.port, "/fleet")
+        assert code == 200
+        assert "t_fed_total 4" in text, text
+        assert 't_fed_gauge{host="hA"} 0.5' in text
+
+        code, body = _get(srv.port, "/fleet?format=json")
+        view = json.loads(body)
+        assert view["n_hosts"] == 2
+        assert set(view["hosts"]) == {"hA", "hB"}
+        assert "merge_error" not in view
+
+        code, body = _get(srv.port, "/fleet/health")
+        assert code == 200
+        health = json.loads(body)
+        assert not health["hosts"]["hA"]["stale"]
+        # exporter port report-back rides the snapshot
+        assert health["hosts"]["hA"]["port"] == srv.port
+
+        code, body = _get(srv.port, "/fleet/goodput")
+        gp = json.loads(body)
+        assert set(gp["hosts"]) == {"hA", "hB"}
+        assert "step_compute" in gp["buckets"]
+    finally:
+        rep.stop()
+
+
+def test_fleet_health_stale_flips_503(metrics_on):
+    srv = obs_server.start(0)
+    fleet.aggregator().ingest(fleet.local_snapshot("dead-host"))
+    pt.set_flags({"fleet_stale_after_s": 0.05})
+    time.sleep(0.1)
+    code, body = _get(srv.port, "/fleet/health")
+    assert code == 503, body
+    assert json.loads(body)["hosts"]["dead-host"]["stale"]
+    # the merged view still serves the dead host's last snapshot
+    code, body = _get(srv.port, "/fleet?format=json")
+    assert code == 200
+    assert json.loads(body)["n_hosts"] == 1
+
+
+def test_fleet_push_rejects_garbage(metrics_on):
+    srv = obs_server.start(0)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/fleet/push",
+        data=b"not json", method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 400
+    # a body without a host field is rejected too
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/fleet/push",
+        data=json.dumps({"metrics": {}}).encode(), method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 400
+
+
+def test_reporter_survives_dead_aggregator(metrics_on):
+    """A dead aggregator must cost the worker nothing but a counted
+    failure — push_once returns False, never raises."""
+    rep = fleet.FleetReporter("127.0.0.1:9", host_id="w",  # port 9: discard
+                              interval_s=60)
+    try:
+        before = obs.counter("fleet_push_failures_total",
+                             always=True).value()
+        assert rep.push_once(timeout_s=0.5) is False
+        after = obs.counter("fleet_push_failures_total",
+                            always=True).value()
+        assert after == before + 1
+    finally:
+        rep.stop()
+
+
+def test_merge_error_degrades_readable(metrics_on):
+    """Mismatched boundaries across hosts: /fleet JSON surfaces
+    merge_error + per-host raw views instead of blanking."""
+    srv = obs_server.start(0)
+    snap_a = fleet.local_snapshot("mA")
+    snap_a["metrics"] = {"bad_ms": _hist_snap({"1.0": 1, "+Inf": 1},
+                                              1, 0.5)}
+    snap_b = fleet.local_snapshot("mB")
+    snap_b["metrics"] = {"bad_ms": _hist_snap({"2.0": 1, "+Inf": 1},
+                                              1, 0.5)}
+    fleet.aggregator().ingest(snap_a)
+    fleet.aggregator().ingest(snap_b)
+    code, body = _get(srv.port, "/fleet?format=json")
+    view = json.loads(body)
+    assert "bucket boundaries differ" in view.get("merge_error", "")
+    assert set(view["per_host_metrics"]) == {"mA", "mB"}
+
+
+# ---------------------------------------------------------------------------
+# launcher discovery wiring
+# ---------------------------------------------------------------------------
+
+def test_fleet_observability_env_assigns_per_worker_ports():
+    """ISSUE satellite: workers no longer share one FLAGS_metrics_port
+    — base + rank per worker, aggregator + host identity in env."""
+    from paddle_tpu.distributed.launch import fleet_observability_env
+    base_env = {"FLAGS_metrics_port": "9300"}
+    envs = [fleet_observability_env(r, dict(base_env)) for r in range(3)]
+    ports = [int(e["FLAGS_metrics_port"]) for e in envs]
+    assert ports == [9300, 9301, 9302]
+    assert all(e["PT_FLEET_AGGREGATOR"] == "127.0.0.1:9300"
+               for e in envs)
+    assert len({e["PT_FLEET_HOST"] for e in envs}) == 3
+    assert all(e["PT_FLEET_HOST"].endswith(f":{r}")
+               for r, e in enumerate(envs))
+
+
+def test_fleet_observability_env_noop_without_base_port():
+    from paddle_tpu.distributed.launch import fleet_observability_env
+    assert fleet_observability_env(1, {"FLAGS_metrics_port": "0"}) == {}
+    assert fleet_observability_env(1, {"FLAGS_metrics_port": "-1"}) == {}
+    assert fleet_observability_env(1, {"FLAGS_metrics_port": "junk"}) \
+        == {}
+
+
+def test_maybe_start_reporter_from_env(metrics_on, monkeypatch):
+    srv = obs_server.start(0)
+    monkeypatch.setenv(fleet.AGGREGATOR_ENV, f"127.0.0.1:{srv.port}")
+    monkeypatch.setenv(fleet.HOST_ENV, "env-worker")
+    pt.set_flags({"fleet_push_interval_s": 30.0})
+    rep = obs_server.maybe_start()
+    assert fleet.reporter() is not None
+    assert fleet.reporter().host_id == "env-worker"
+    assert fleet.reporter().push_once()
+    assert "env-worker" in fleet.aggregator().hosts()
+
+
+# ---------------------------------------------------------------------------
+# request-span ring anomaly path (reqtrace satellite)
+# ---------------------------------------------------------------------------
+
+def test_reqtrace_out_of_order_stamps_flag_anomaly(metrics_on):
+    from paddle_tpu.observability import flight, reqtrace
+    now = time.time()
+    reqtrace.record({"trace_id": 9, "ingress_unix": now,
+                     "dequeue_unix": now - 1.0,  # went backwards
+                     "assembly_unix": now, "dispatch_unix": now,
+                     "reply_unix": now})
+    rec = reqtrace.ring().find(9)
+    assert rec is not None and rec.get("anomaly") is True
+    assert any(e["kind"] == "reqtrace_anomaly"
+               for e in flight.recorder().events())
+
+
+def test_reqtrace_ring_bounded_and_resizable(metrics_on):
+    from paddle_tpu.observability import reqtrace
+    reqtrace.ring().resize(8)
+    try:
+        for i in range(20):
+            reqtrace.record({"trace_id": 100 + i})
+        recs = reqtrace.ring().recent()
+        assert len(recs) == 8
+        assert recs[-1]["trace_id"] == 119
+        assert reqtrace.ring().recent(3)[-1]["trace_id"] == 119
+    finally:
+        reqtrace.ring().resize(256)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill: 3-process mini-fleet
+# ---------------------------------------------------------------------------
+
+def test_fleet_status_self_test_subprocess():
+    """ISSUE acceptance: tools/fleet_status.py --self-test passes in
+    tier-1 — 3 workers, merged counters equal the per-host sum, gauges
+    carry {host=}, one worker SIGKILLed flips /fleet/health stale
+    without breaking the merged view."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "fleet_status.py"),
+         "--self-test"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "self-test OK" in proc.stdout

@@ -449,3 +449,224 @@ def test_c_client_round_trip(tmp_path):
         stop.set()
         t.join(timeout=5)
         transport.stop()
+
+
+# ---------------------------------------------------------------------------
+# per-request serving traces (docs/serving_protocol.md "Request tracing",
+# docs/observability.md "Per-request serving traces")
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def metrics_on():
+    pt.set_flags({"enable_metrics": True})
+    try:
+        yield
+    finally:
+        from paddle_tpu import observability as obs
+        pt.set_flags({"enable_metrics": False})
+        obs.reset_all()
+
+
+def _wait_for(fn, timeout_s=10.0, what="condition"):
+    import time
+    deadline = time.time() + timeout_s
+    last = None
+    while time.time() < deadline:
+        last = fn()
+        if last:
+            return last
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what} (last={last!r})")
+
+
+SERVING_HISTS = ("serving_queue_wait_ms", "serving_batch_assembly_ms",
+                 "serving_compute_ms", "serving_e2e_ms")
+
+
+def test_traced_request_round_trip(artifact, metrics_on):
+    """ISSUE acceptance: a Client-issued request round-trips its trace
+    id into /requests with all five timestamps ordered, and the four
+    serving_*_ms histograms are populated and exported on /metrics."""
+    import json
+    import urllib.request
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import reqtrace
+    from paddle_tpu.observability import server as obs_server
+
+    d, x, want = artifact
+    pred = create_predictor(Config(d))
+    with Server(pred, max_batch=8, wait_ms=2) as srv:
+        with Client(port=srv.port) as cli:
+            out = cli.infer([x[:2]])[0]
+            np.testing.assert_allclose(out, want[:2], rtol=1e-5,
+                                       atol=1e-5)
+            tid = cli.last_trace_id
+            assert tid, "client must auto-assign a nonzero trace id"
+            rec = _wait_for(lambda: reqtrace.ring().find(tid),
+                            what=f"trace {tid} in the ring")
+        # the five stamps exist and are ordered ingress <= ... <= reply
+        stamps = [rec[k] for k in reqtrace.STAMPS]
+        assert all(s is not None for s in stamps), rec
+        assert all(a <= b for a, b in zip(stamps, stamps[1:])), rec
+        assert rec["status"] == 0 and rec["outcome"] == "ok"
+        assert not rec.get("anomaly"), rec
+        for k in ("queue_wait_ms", "batch_assembly_ms", "compute_ms",
+                  "e2e_ms"):
+            assert rec[k] is not None and rec[k] >= 0.0, (k, rec)
+        # all four histograms populated, on the shared ms boundaries
+        for name in SERVING_HISTS:
+            h = obs.registry().get(name)
+            assert h is not None and h.count() >= 1, name
+            assert h.buckets == obs.metrics.LATENCY_MS_BUCKETS, name
+        # ... and exported on /metrics + the record on /requests
+        es = obs_server.ObservabilityServer(0)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{es.port}/metrics",
+                    timeout=10) as r:
+                text = r.read().decode()
+            for name in SERVING_HISTS:
+                assert f"{name}_count" in text, name
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{es.port}/requests?n=10",
+                    timeout=10) as r:
+                body = json.loads(r.read())
+            assert any(e.get("trace_id") == tid
+                       for e in body["requests"]), body
+        finally:
+            es.stop()
+
+
+def test_old_format_frame_still_served(artifact, metrics_on):
+    """ISSUE acceptance: an old-format request frame (plain PTSV, no
+    trace field) is still served correctly — and its span record rides
+    the ring with trace_id 0."""
+    from paddle_tpu.observability import reqtrace
+
+    d, x, want = artifact
+    pred = create_predictor(Config(d))
+    with Server(pred, max_batch=8, wait_ms=2) as srv:
+        with Client(port=srv.port, traced=False) as old:
+            out = old.infer([x[:3]])[0]
+            np.testing.assert_allclose(out, want[:3], rtol=1e-5,
+                                       atol=1e-5)
+            assert old.last_trace_id is None
+            rec = _wait_for(lambda: reqtrace.ring().find(0),
+                            what="untraced span record")
+            assert rec["status"] == 0
+            # untraced and traced interleave on one server
+            with Client(port=srv.port) as new:
+                new.infer([x[:1]])
+                tid = new.last_trace_id
+                assert _wait_for(lambda: reqtrace.ring().find(tid),
+                                 what="traced record after untraced")
+
+
+def test_trace_ids_unique_and_explicit(artifact, metrics_on):
+    """Auto-assigned ids never repeat within a client; an explicit
+    trace_id= is used verbatim and lands in the ring."""
+    from paddle_tpu.observability import reqtrace
+
+    d, x, want = artifact
+    pred = create_predictor(Config(d))
+    with Server(pred, max_batch=8, wait_ms=1) as srv:
+        with Client(port=srv.port) as cli:
+            ids = {cli.make_trace_id() for _ in range(100)}
+            assert len(ids) == 100 and 0 not in ids
+            cli.infer([x[:1]], trace_id=31337)
+            assert cli.last_trace_id == 31337
+            rec = _wait_for(lambda: reqtrace.ring().find(31337),
+                            what="explicit trace id in ring")
+            assert rec["outcome"] == "ok"
+
+
+def test_traced_total_on_stats(artifact, metrics_on):
+    """serving.traced_total counts PTSR frames on the STATS reply."""
+    d, x, want = artifact
+    pred = create_predictor(Config(d))
+    with Server(pred, max_batch=8, wait_ms=1) as srv:
+        with Client(port=srv.port) as cli:
+            cli.infer([x[:1]])
+            cli.infer([x[:1]])
+            stats = cli.stats()
+    assert stats.get("traced_total", 0) >= 2, stats
+
+
+def test_shed_and_error_requests_enter_ring(artifact, metrics_on):
+    """Shed and decode-error requests get span records (with their
+    outcome) so /requests tells the whole story, not just successes;
+    the shed path also emits a serving_shed flight event."""
+    import time
+
+    from paddle_tpu.observability import flight, reqtrace
+
+    d, x, want = artifact
+    pred = create_predictor(Config(d))
+    with Server(pred, max_batch=8, wait_ms=1) as srv:
+        now = time.time()
+        srv._shed({"rid": 99991, "trace_id": 777,
+                   "ingress_unix": now - 0.5, "dequeue_unix": now},
+                  age_s=0.5, deadline_s=0.1)
+        rec = reqtrace.ring().find(777)
+        assert rec is not None and rec["outcome"] == "shed"
+        assert rec["status"] == -1
+        assert any(e["kind"] == "serving_shed" and
+                   e.get("trace_id") == 777
+                   for e in flight.recorder().events())
+        # a garbage payload: served as an error reply + ring record
+        with Client(port=srv.port) as cli:
+            tid = cli.make_trace_id()
+            with pytest.raises(RuntimeError):
+                cli.infer([np.float32(1.0)], trace_id=tid)  # 0-d tensor
+            rec = _wait_for(lambda: reqtrace.ring().find(tid),
+                            what="decode-error record")
+            assert rec["outcome"] == "decode_error", rec
+
+
+def test_c_client_traced_round_trip(tmp_path):
+    """The C client's PTSR frame: trace id and ingress stamp surface
+    through pt_srv_next_ex, payload round-trips byte-exact."""
+    import subprocess
+    import threading
+    import time
+
+    from paddle_tpu.native import ServingTransport
+
+    src = os.path.join(os.path.dirname(__file__), "..", "csrc",
+                       "serving_client.c")
+    exe = str(tmp_path / "ptsc_traced_demo")
+    subprocess.run(["cc", "-O2", "-DPTSC_DEMO_MAIN", "-o", exe, src],
+                   check=True, capture_output=True)
+    transport = ServingTransport(port=0, queue_cap=8)
+    stop = threading.Event()
+    seen = {}
+
+    def serve():
+        while not stop.is_set():
+            got = transport.next_request_ex(timeout_ms=50)
+            if got is None:
+                continue
+            rid, payload, trace_id, ingress = got
+            seen["trace_id"] = trace_id
+            seen["ingress"] = ingress
+            transport.reply(rid, b"echo:" + payload, status=0)
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    try:
+        t0 = time.time()
+        out = subprocess.run(
+            [exe, "127.0.0.1", str(transport.port), "--traced", "4242",
+             "traced-from-c"],
+            capture_output=True, timeout=30)
+        assert out.returncode == 0, out.stderr.decode()
+        text = out.stdout.decode()
+        assert text.startswith("status=0 len=18\n"), text
+        assert text.endswith("echo:traced-from-c"), text
+        assert seen["trace_id"] == 4242, seen
+        assert t0 - 5 <= seen["ingress"] <= time.time(), seen
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        transport.stop()

@@ -869,6 +869,39 @@ def test_check_metrics_doc_catches_undocumented(tmp_path):
     assert "totally_new_metric_total" not in open(cmd.DOC).read()
 
 
+def test_check_metrics_doc_scans_native_stats(tmp_path):
+    """ISSUE satellite: pt_mon stat names in csrc/*.cc (and Python
+    stat_add literals) are scanned too, so C++-side metrics can't
+    drift undocumented."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import check_metrics_doc as cmd
+        # the real tree: serving.cc's pt_mon names are collected
+        native = cmd.collect_native_metrics()
+        assert "serving.traced_total" in native
+        assert any(site.startswith("csrc/serving.cc")
+                   for site in native["serving.traced_total"])
+        # a synthetic tree: literal pt_mon_add / stat_add names found,
+        # dynamic ones skipped
+        csrc = tmp_path / "csrc"
+        csrc.mkdir()
+        (csrc / "x.cc").write_text(
+            'pt_mon_add("demo.native_total", 1);\n'
+            'pt_mon_add(name.c_str(), 1);\n')
+        found = cmd.collect_native_metrics(str(csrc))
+        assert set(found) == {"demo.native_total"}
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "m.py").write_text(
+            'from native import stat_add\n'
+            'stat_add("demo.py_total")\n'
+            'stat_add(f"demo.le_{b}")\n')
+        found = cmd.collect_metrics(str(pkg))
+        assert set(found) == {"demo.py_total"}
+    finally:
+        sys.path.pop(0)
+
+
 # ---------------------------------------------------------------------------
 # goodput ledger
 # ---------------------------------------------------------------------------
@@ -1191,3 +1224,60 @@ def test_goodput_report_self_test_subprocess():
     assert proc.returncode == 0, proc.stderr + proc.stdout
     assert "self-test OK" in proc.stdout
     assert "goodput_ratio" in proc.stdout
+
+
+def test_exporter_concurrent_scrape_under_fit(metrics_on):
+    """ISSUE satellite: hammer /metrics + /varz from threads while a
+    fit loop mutates the registry — every scrape must return 200 with
+    parseable output, no exception anywhere."""
+    import re
+    import urllib.request
+
+    from paddle_tpu.observability import server as obs_server
+
+    srv = obs_server.ObservabilityServer(0)
+    stop = threading.Event()
+    results = {"metrics": [], "varz": []}
+    errors = []
+    prom_line = re.compile(r"^[a-zA-Z_:][\w:.]*(\{.*\})? \S+$")
+
+    def scrape(path, bucket):
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.port}{path}",
+                        timeout=10) as r:
+                    body = r.read().decode()
+                    if path == "/metrics":
+                        for line in body.splitlines():
+                            if line and not line.startswith("#"):
+                                assert prom_line.match(line), line
+                    else:
+                        json.loads(body)
+                    bucket.append(r.status)
+            except Exception as e:  # noqa: BLE001 — the assertion
+                errors.append(f"{path}: {type(e).__name__}: {e}")
+                return
+
+    threads = [threading.Thread(
+        target=scrape,
+        args=(p, results[k]), daemon=True)
+        for p, k in (("/metrics", "metrics"), ("/metrics", "metrics"),
+                     ("/varz", "varz"), ("/varz", "varz"))]
+    for t in threads:
+        t.start()
+    try:
+        m = pt.hapi.Model(_MLP())
+        m.prepare(optimizer=pt.optimizer.Adam(learning_rate=1e-2),
+                  loss=pt.nn.CrossEntropyLoss())
+        m.fit(_loader(n=256, batch=16), epochs=2, verbose=0)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        srv.stop()
+    assert not errors, errors
+    assert all(s == 200 for b in results.values() for s in b)
+    # the scrapers genuinely overlapped the fit
+    assert len(results["metrics"]) >= 5, len(results["metrics"])
+    assert len(results["varz"]) >= 2, len(results["varz"])

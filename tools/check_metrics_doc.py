@@ -10,6 +10,13 @@ user-facing ``obs.counter(my_name)`` API) have non-constant first
 arguments and are out of scope by construction; names starting with
 ``selftest_`` (CLI self-test fixtures) are ignored.
 
+Also covers the NATIVE stat registry: literal ``pt_mon_add("...")``
+names in ``csrc/*.cc`` and literal ``stat_add("...")`` names in the
+Python tree (both land in the same ``pt_mon`` registry and surface on
+the STATS wire reply and the ``pt_native_stat`` bridge) must appear in
+``docs/observability.md`` too — C++-side metrics used to be able to
+drift undocumented.
+
 Usage: python tools/check_metrics_doc.py   (exit 0 ok, 1 violations)
 """
 
@@ -17,13 +24,18 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG_DIR = os.path.join(ROOT, "paddle_tpu")
+CSRC_DIR = os.path.join(ROOT, "csrc")
 DOC = os.path.join(ROOT, "docs", "observability.md")
 
 _FACTORIES = {"counter", "gauge", "histogram"}
+# native stat registrations: C++ pt_mon_add / Python native.stat_add
+_NATIVE_FACTORIES = {"stat_add"}
+_PT_MON_RE = re.compile(r'pt_mon_add\(\s*"([^"]+)"')
 
 
 def _call_name(node: ast.Call) -> str:
@@ -51,7 +63,8 @@ def collect_metrics(pkg_dir: str = PKG_DIR):
                 return None
             for node in ast.walk(tree):
                 if not (isinstance(node, ast.Call)
-                        and _call_name(node) in _FACTORIES
+                        and (_call_name(node) in _FACTORIES
+                             or _call_name(node) in _NATIVE_FACTORIES)
                         and node.args
                         and isinstance(node.args[0], ast.Constant)
                         and isinstance(node.args[0].value, str)):
@@ -65,6 +78,29 @@ def collect_metrics(pkg_dir: str = PKG_DIR):
     return out
 
 
+def collect_native_metrics(csrc_dir: str = CSRC_DIR):
+    """{name: [file:line, ...]} for every literal pt_mon_add() stat in
+    the C++ sources (regex scan — no C++ parser needed for literal
+    first arguments; dynamically-built names are out of scope like
+    their Python counterparts)."""
+    out = {}
+    if not os.path.isdir(csrc_dir):
+        return out
+    for fname in sorted(os.listdir(csrc_dir)):
+        if not fname.endswith((".cc", ".c", ".h")):
+            continue
+        path = os.path.join(csrc_dir, fname)
+        try:
+            text = open(path).read()
+        except OSError:  # pragma: no cover
+            continue
+        for i, line in enumerate(text.splitlines(), 1):
+            for m in _PT_MON_RE.finditer(line):
+                rel = os.path.relpath(path, ROOT)
+                out.setdefault(m.group(1), []).append(f"{rel}:{i}")
+    return out
+
+
 def main() -> int:
     metrics = collect_metrics()
     if metrics is None:
@@ -73,6 +109,8 @@ def main() -> int:
         print("check_metrics_doc: no instrument registrations found "
               f"under {PKG_DIR} — parser broken?", file=sys.stderr)
         return 1
+    for name, sites in collect_native_metrics().items():
+        metrics.setdefault(name, []).extend(sites)
     try:
         doc = open(DOC).read()
     except OSError as e:

@@ -1,0 +1,286 @@
+"""Fleet status CLI: render one live table for a multi-host job.
+
+Reads the rank-0 aggregator's federation endpoints
+(observability/fleet.py — ``/fleet?format=json``, ``/fleet/goodput``,
+``/fleet/health``) and prints the per-host table an operator would
+otherwise assemble by ssh-ing N hosts: push freshness, self-reported
+health, exporter port, goodput headline, worst badput bucket, and
+straggler events.
+
+Usage:
+    python tools/fleet_status.py HOST:PORT        # rank-0 exporter
+    python tools/fleet_status.py --self-test      # no-TPU CI drill
+
+``--self-test`` boots a real 3-process mini-fleet against an in-process
+aggregator and asserts the federation contract end to end: merged
+counters equal the per-host sum, gauges carry ``{host=}`` labels,
+histograms merge bucket-wise, and a SIGKILLed worker flips
+``/fleet/health`` to 503 (stale) without breaking the merged view.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+
+def _get(addr: str, path: str, timeout_s: float = 5.0
+         ) -> Tuple[int, Any]:
+    """GET http://addr/path; returns (status, parsed-JSON-or-text).
+    Error statuses (e.g. /fleet/health 503) are returned, not raised."""
+    try:
+        with urllib.request.urlopen(f"http://{addr}{path}",
+                                    timeout=timeout_s) as r:
+            body = r.read().decode()
+            status = r.status
+    except urllib.error.HTTPError as e:
+        body = e.read().decode()
+        status = e.code
+    try:
+        return status, json.loads(body)
+    except ValueError:
+        return status, body
+
+
+def render(addr: str) -> int:
+    """Print the fleet table; exit 0 healthy, 1 degraded/unreachable."""
+    try:
+        _, view = _get(addr, "/fleet?format=json")
+        hcode, health = _get(addr, "/fleet/health")
+        _, gp = _get(addr, "/fleet/goodput")
+    except OSError as e:
+        print(f"fleet_status: aggregator {addr} unreachable: {e}",
+              file=sys.stderr)
+        return 1
+    hosts = sorted(set(view.get("hosts", {}))
+                   | set(health.get("hosts", {})))
+    print(f"fleet @ {addr}: {len(hosts)} host(s), "
+          f"health={'OK' if hcode == 200 else 'STALE (503)'}, "
+          f"fleet goodput {gp.get('goodput_ratio', 0.0):.1%} over "
+          f"{gp.get('wall_seconds', 0.0):.1f}s wall")
+    cols = ("host", "age_s", "stale", "healthy", "port", "goodput",
+            "worst badput", "stragglers")
+    rows = []
+    for h in hosts:
+        hh = health.get("hosts", {}).get(h, {})
+        gh = gp.get("hosts", {}).get(h, {})
+        rows.append((h,
+                     f"{hh.get('age_s', float('nan')):.1f}",
+                     "STALE" if hh.get("stale") else "fresh",
+                     "yes" if hh.get("healthy") else "NO",
+                     str(hh.get("port") or "-"),
+                     f"{gh.get('goodput_ratio', 0.0):.1%}",
+                     str(gh.get("worst_badput_bucket") or "-"),
+                     f"{gh.get('straggler_events', 0):.0f}"))
+    widths = [max(len(c), *(len(r[i]) for r in rows)) if rows
+              else len(c) for i, c in enumerate(cols)]
+    print("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
+    for r in rows:
+        print("  ".join(v.ljust(w) for v, w in zip(r, widths)))
+    if view.get("merge_error"):
+        print(f"MERGE ERROR: {view['merge_error']}", file=sys.stderr)
+        return 1
+    return 0 if hcode == 200 else 1
+
+
+# ------------------------------------------------------------- self-test
+
+_WORKER_SRC = r"""
+import os, sys, time
+sys.path.insert(0, os.environ["PT_SELFTEST_ROOT"])
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import server as obs_server
+from paddle_tpu.observability import fleet, goodput
+
+rank = int(os.environ["PT_SELFTEST_RANK"])
+pt.set_flags({"enable_metrics": True, "fleet_push_interval_s": 0.15})
+# own exporter on an ephemeral port: the report-back half of discovery
+# (the chosen port rides every pushed snapshot)
+obs_server.start(0)
+obs.counter("fleet_selftest_total").inc(rank + 1)
+obs.counter("fleet_selftest_total").inc(10, route="labeled")
+obs.gauge("fleet_selftest_gauge").set(float(rank))
+obs.histogram("fleet_selftest_ms",
+              buckets=obs.metrics.LATENCY_MS_BUCKETS
+              ).observe(1.0 * (rank + 1))
+led = goodput.ledger()
+led.start()
+led.attribute("step_compute", 2.0 + rank)
+led.attribute("data_wait", 1.0)
+fleet.start_reporter(os.environ["PT_FLEET_AGGREGATOR"],
+                     host_id=os.environ["PT_FLEET_HOST"])
+print("worker %d up" % rank, flush=True)
+while True:
+    time.sleep(0.1)
+"""
+
+
+def _poll(fn, timeout_s: float, what: str, interval_s: float = 0.25):
+    """Poll fn() until it returns a truthy value; raise on timeout."""
+    deadline = time.time() + timeout_s
+    last = None
+    while time.time() < deadline:
+        try:
+            last = fn()
+            if last:
+                return last
+        except (OSError, ValueError, KeyError):
+            pass
+        time.sleep(interval_s)
+    raise AssertionError(f"self-test: timed out waiting for {what} "
+                         f"(last={last!r})")
+
+
+def _counter_total(view: Dict[str, Any], name: str, **labels) -> float:
+    ent = (view.get("metrics") or {}).get(name) or {}
+    want = {k: str(v) for k, v in labels.items()}
+    total = 0.0
+    for s in ent.get("series", []):
+        if {k: str(v) for k, v in s["labels"].items()} == want:
+            total += float(s["value"])
+    return total
+
+
+def self_test() -> int:
+    """3-process federation drill (no TPU, CPU jax): counters sum,
+    gauges get host labels, histograms merge exactly, SIGKILL of one
+    worker flips /fleet/health stale without breaking /fleet."""
+    import paddle_tpu as pt
+    from paddle_tpu.observability import server as obs_server
+
+    pt.set_flags({"enable_metrics": True, "fleet_stale_after_s": 2.0})
+    srv = obs_server.start(0)
+    addr = f"127.0.0.1:{srv.port}"
+    workers = []
+    try:
+        for rank in range(3):
+            env = dict(os.environ)
+            env.update({"PT_SELFTEST_ROOT": ROOT,
+                        "PT_SELFTEST_RANK": str(rank),
+                        "PT_FLEET_AGGREGATOR": addr,
+                        "PT_FLEET_HOST": f"w{rank}",
+                        "JAX_PLATFORMS": "cpu"})
+            workers.append(subprocess.Popen(
+                [sys.executable, "-c", _WORKER_SRC], env=env))
+
+        def fleet_ready():
+            code, v = _get(addr, "/fleet?format=json")
+            if code != 200 or v.get("n_hosts", 0) < 3:
+                return None
+            # unlabeled series summed: 1 + 2 + 3
+            if _counter_total(v, "fleet_selftest_total") != 6.0:
+                return None
+            return v
+
+        view = _poll(fleet_ready, 90, "3 hosts with summed counters")
+        # labeled counter series also summed per label set: 3 x 10
+        assert _counter_total(view, "fleet_selftest_total",
+                              route="labeled") == 30.0, view
+        # gauges: one series per host, labeled {host=}
+        gauges = {s["labels"]["host"]: s["value"]
+                  for s in view["metrics"]["fleet_selftest_gauge"]
+                  ["series"]}
+        assert gauges == {"w0": 0.0, "w1": 1.0, "w2": 2.0}, gauges
+        # histogram merged bucket-wise across identical boundaries
+        hist = view["metrics"]["fleet_selftest_ms"]["series"][0]
+        assert hist["count"] == 3 and hist["sum"] == 6.0, hist
+        assert hist["buckets"]["2.5"] == 2, hist["buckets"]
+        # the same numbers on the Prometheus rendering of /fleet
+        code, prom = _get(addr, "/fleet")
+        assert code == 200 and "fleet_selftest_total 6" in prom, prom
+        assert 'fleet_selftest_gauge{host="w1"} 1' in prom, prom
+        # health: every worker fresh, each reporting its exporter port
+        code, health = _get(addr, "/fleet/health")
+        assert code == 200, health
+        assert all(not h["stale"] and h["port"]
+                   for h in health["hosts"].values()), health
+        # goodput roll-up with per-host badput attribution
+        code, gp = _get(addr, "/fleet/goodput")
+        assert set(gp["hosts"]) == {"w0", "w1", "w2"}, gp
+        assert gp["buckets"]["step_compute"] == 9.0, gp["buckets"]
+        assert gp["goodput_ratio"] > 0, gp
+        assert gp["hosts"]["w0"]["worst_badput_bucket"] == \
+            "data_wait", gp["hosts"]["w0"]
+        print(f"fleet up: 3 hosts, merged counters/gauges/histograms "
+              f"OK @ {addr}")
+
+        # SIGKILL one worker: /fleet/health must flip stale for it
+        # while the merged /fleet view keeps serving its last snapshot
+        workers[1].kill()
+        workers[1].wait(10)
+
+        def w1_stale():
+            code, h = _get(addr, "/fleet/health")
+            if code != 503:
+                return None
+            hosts = h["hosts"]
+            if not hosts["w1"]["stale"]:
+                return None
+            assert not hosts["w0"]["stale"], hosts
+            assert not hosts["w2"]["stale"], hosts
+            return h
+
+        _poll(w1_stale, 30, "w1 stale after SIGKILL")
+        code, view = _get(addr, "/fleet?format=json")
+        assert code == 200, view
+        assert _counter_total(view, "fleet_selftest_total") == 6.0, \
+            "merged view broke after a host died"
+        assert "merge_error" not in view, view.get("merge_error")
+        print("w1 SIGKILLed: /fleet/health 503 (w1 stale), merged "
+              "/fleet intact")
+        render(addr)
+    finally:
+        for p in workers:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in workers:
+            try:
+                p.wait(10)
+            except subprocess.TimeoutExpired:
+                pass
+        obs_server.stop()
+    print("self-test OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render the fleet-federation status table from a "
+                    "rank-0 observability exporter")
+    ap.add_argument("aggregator", nargs="?",
+                    help="rank-0 exporter address, host:port")
+    ap.add_argument("--watch", type=float, metavar="S", default=0,
+                    help="re-render every S seconds")
+    ap.add_argument("--self-test", action="store_true")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return self_test()
+    if not args.aggregator:
+        ap.error("aggregator address required (or --self-test)")
+    addr = args.aggregator.split("//", 1)[-1].rstrip("/")
+    if args.watch > 0:
+        try:
+            while True:
+                print("\033[2J\033[H", end="")
+                render(addr)
+                time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return 0
+    return render(addr)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
