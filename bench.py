@@ -451,6 +451,21 @@ def bench_bert(on_accel: bool) -> None:
             pt.set_flags({"optimizer_moment_dtype": "bfloat16"})
             log(f"optimizer_moment_dtype=bfloat16 from captures "
                 f"({pair[0]:.0f} vs {pair[1]:.0f} tok/s)")
+    if on_accel and os.environ.get("FLAGS_fused_softmax_xent") is None:
+        pair = capture_pair("bert_b16_fusedloss", "bert_b16_flash")
+        if pair is not None and pair[0] > pair[1]:
+            pt.set_flags({"fused_softmax_xent": True})
+            log(f"fused_softmax_xent=True from captures (fusedloss "
+                f"{pair[0]:.0f} vs flash {pair[1]:.0f} tok/s)")
+    if on_accel and os.environ.get("FLAGS_fused_adam") is None:
+        # stacked A/B: fused Adam measured on top of the fused loss
+        # region, so the pin compares like against like
+        pair = capture_pair("bert_b16_fusedloss_fusedadam",
+                            "bert_b16_fusedloss")
+        if pair is not None and pair[0] > pair[1]:
+            pt.set_flags({"fused_adam": True})
+            log(f"fused_adam=True from captures "
+                f"({pair[0]:.0f} vs {pair[1]:.0f} tok/s)")
     candidates = [(b_, f_) for b_ in batch_opts for f_ in fused_opts]
     log(f"BERT-base pretrain, seq={seq} candidates {candidates}")
 

@@ -172,6 +172,27 @@ define_flag("use_pallas_adam", False,
             "elementwise adam chain itself; 34.4 vs 39.6 ms/step on "
             "BERT-base b8xs512). Useful again only if params are kept in "
             "a 1-D flat buffer.")
+define_flag("fused_adam", False,
+            "Route Adam/AdamW moment+param updates through the "
+            "layout-preserving Pallas fused-adam kernel "
+            "(kernels.fused_adam.fused_adam_leaf): one VMEM-resident "
+            "elementwise pass over p/g/m/v per leaf, bitwise-identical "
+            "to the unfused update (same op order, no reciprocal "
+            "rewrite) including under the skip-step guard and "
+            "GradScaler. Unlike FLAGS_use_pallas_adam it keeps each "
+            "leaf's native 2-D layout (no ravel copies — the measured "
+            "regression that keeps use_pallas_adam off). [assumed — "
+            "conservative] Off until the bert_b16_fusedloss_fusedadam "
+            "capture stage lands chip evidence.")
+define_flag("fused_softmax_xent", False,
+            "Fuse BERT's masked-LM head (hidden->vocab projection) "
+            "with its softmax cross-entropy into one Pallas loss-"
+            "region kernel (kernels/fused_softmax_xent.py): online "
+            "log-sum-exp over vocab chunks, so the [B, T, V] logits "
+            "tensor never exists in HBM in either direction "
+            "(custom_vjp backward recomputes chunks and fuses dlogits "
+            "into dh/dW/db). [assumed — conservative] Off until the "
+            "bert_b16_fusedloss capture stage lands chip evidence.")
 define_flag("use_pallas_layer_norm", True,
             "Use the Pallas layer_norm kernel (subject to the master "
             "switch). [measured] r5 chip A/B at the best BERT config "
@@ -392,6 +413,29 @@ define_flag("health_heartbeat_timeout_s", 300.0,
             "training heartbeat exists but is older than this many "
             "seconds — a wedged fit() loop reads unhealthy while the "
             "process is still up. 0 disables the staleness check.")
+def _compile_cache_dir_changed(value) -> None:
+    # apply immediately when set programmatically; env-set values are
+    # applied by the entry points (fit / to_static / Predictor) since
+    # define() does not fire on_change (lazy import: sysconfig is
+    # standalone)
+    if value:
+        from . import sysconfig as _sysconfig
+        _sysconfig.apply_compile_cache_flag()
+
+
+define_flag("compile_cache_dir", "",
+            "Persistent on-disk XLA compilation cache directory "
+            "(jax_compilation_cache_dir), applied by hapi.Model.fit, "
+            "jit.to_static and inference.Predictor/Server. A second "
+            "process of the same fit loads its executables from here "
+            "instead of cold-compiling; the goodput ledger then books "
+            "dispatch compile time to jit_compile_cache_hit instead of "
+            "jit_compile_cold, and compile_cache_hits_total / "
+            "compile_cache_misses_total count the cache traffic. "
+            "Empty (default) = no persistent cache and all compile "
+            "time books as cold. tools/compile_cache_report.py is the "
+            "proof drill.",
+            on_change=_compile_cache_dir_changed)
 define_flag("trace_dir", "",
             "If set, observability.export_all()/Model.fit write the "
             "host chrome-trace (host_trace.json) and metrics snapshot "

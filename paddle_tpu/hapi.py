@@ -347,6 +347,10 @@ class Model:
                        "host_driven", False):
                 callbacks.append(LRSchedulerCallback(self._optimizer))
         history: Dict[str, List[float]] = {}
+        # persistent compile cache (env-set FLAGS_compile_cache_dir
+        # never fires on_change — apply here, before the first trace)
+        from . import sysconfig as _sysconfig
+        _sysconfig.apply_compile_cache_flag()
         # live observability plane: flag-gated, idempotent, daemon thread
         _obs.server.maybe_start()
         ledger = _obs.goodput_ledger()
@@ -503,6 +507,7 @@ class Model:
                         _faults.hit("sigterm", step=global_step)
                     if obs_on:
                         compile_before = _obs.goodput.compile_seconds_total()
+                        cache_before = _obs.goodput.compile_cache_stats()
                         t0 = time.perf_counter()
                     metrics = step(*inputs, labels=(label,))
                     if obs_on:
@@ -511,12 +516,17 @@ class Model:
                         # the allocator, never the stream
                         dt = time.perf_counter() - t0
                         # a dispatch that traced spent its wall time in
-                        # XLA, not the model: charge it to jit_compile
+                        # XLA, not the model: charge it to the compile
+                        # bucket — cold, or cache_hit when the persistent
+                        # cache (FLAGS_compile_cache_dir) served it
                         compile_dt = min(dt, max(
                             0.0,
                             _obs.goodput.compile_seconds_total()
                             - compile_before))
-                        ledger.attribute("jit_compile", compile_dt)
+                        if compile_dt > 0:
+                            ledger.attribute(
+                                _obs.goodput.classify_compile_bucket(
+                                    cache_before), compile_dt)
                         ledger.attribute("step_compute", dt - compile_dt)
                         _obs.flight.record("step", epoch=epoch, step=i)
                         if straggler is not None:

@@ -158,6 +158,8 @@ class Predictor:
     def __init__(self, config: Config, _shared=None):
         import jax
 
+        from ..sysconfig import apply_compile_cache_flag
+        apply_compile_cache_flag()  # before the first jit compile
         self.config = config
         if _shared is not None:
             (self._exported, self._params, self._buffers, self._meta,
@@ -365,7 +367,9 @@ class Server:
                  stats_interval_s: float = 1.0,
                  queue_deadline_ms: Optional[int] = None):
         from ..native import ServingTransport
+        from ..sysconfig import apply_compile_cache_flag
 
+        apply_compile_cache_flag()  # serving warm-start path
         self.predictor = predictor
         self.max_batch = max_batch
         self.wait_ms = wait_ms

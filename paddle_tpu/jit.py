@@ -95,6 +95,10 @@ class StaticFunction:
             except Exception as e:  # noqa: BLE001
                 run, self.conversion_note = fn, f"conversion failed: {e}"
         self._converted = run
+        # env-set FLAGS_compile_cache_dir applies at the compile entry
+        # points (define() fires no on_change)
+        from . import sysconfig as _sysconfig
+        _sysconfig.apply_compile_cache_flag()
         # jit through the recompile tracker: every retrace of this
         # function is counted (and storm-warned) per display name
         if self._name is None:

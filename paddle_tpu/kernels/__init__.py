@@ -52,6 +52,37 @@ def maybe_layer_norm(x, weight, bias, epsilon: float, begin_norm_axis: int):
     return ref_impl(x, weight, bias, epsilon, begin_norm_axis)
 
 
+def fused_softmax_xent_enabled() -> bool:
+    return pallas_enabled() and GLOBAL_FLAGS.get("fused_softmax_xent")
+
+
+def maybe_fused_linear_xent(hidden, weight, bias, labels,
+                            ignore_index: int = -100):
+    """Per-position softmax cross-entropy of the linear projection
+    ``logits = hidden @ weight.T + bias`` — the masked-LM loss region.
+    hidden: [..., H]; weight: [V, H]; bias: [V] or None; labels: [...]
+    int. Returns f32 loss of labels' shape (0.0 at ignore_index).
+
+    Routed (FLAGS_fused_softmax_xent + Pallas on-accelerator) the
+    [..., V] logits tensor is never materialized in either direction;
+    the fallback composes the projection with the reference
+    ops.loss.softmax_with_cross_entropy so both paths share semantics.
+    """
+    if fused_softmax_xent_enabled():
+        from .fused_softmax_xent import fused_linear_softmax_xent
+        return fused_linear_softmax_xent(hidden, weight, bias, labels,
+                                         ignore_index=ignore_index)
+    import jax.numpy as jnp
+
+    from ..ops.loss import softmax_with_cross_entropy
+    logits = hidden @ weight.T
+    if bias is not None:
+        logits = logits + bias
+    loss = softmax_with_cross_entropy(
+        logits, labels[..., None], ignore_index=ignore_index)
+    return jnp.squeeze(loss, axis=-1)
+
+
 def _is_key_padding_mask(mask, batch: int, tk: int) -> bool:
     """True for exactly-shaped [B, 1, 1, Tk] masks (no broadcasting)."""
     return (getattr(mask, "ndim", 0) == 4

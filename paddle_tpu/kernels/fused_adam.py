@@ -10,6 +10,13 @@ and to fold bias correction + weight decay into the same sweep, and as the
 registration point for a future multi-tensor horizontally-fused launch).
 
 Operates on flat fp32 views; the optimizer flattens/unflattens around it.
+
+``fused_adam_leaf`` is the newer LAYOUT-PRESERVING entry point
+(FLAGS_fused_adam): it keeps each leaf's native 2-D tiling (collapsing
+only leading dims) so no relayout copies are forced — the measured
+regression that keeps the ravel-based FLAGS_use_pallas_adam path off —
+and mirrors the unfused update's exact op order so results are BITWISE
+identical to it (no reciprocal rewrite, same multiply/divide order).
 """
 
 from __future__ import annotations
@@ -42,6 +49,67 @@ def _adam_kernel(p_ref, g_ref, m_ref, v_ref, sc_ref,
     p_out[:] = p_new.astype(p_out.dtype)
     m_out[:] = m
     v_out[:] = v
+
+
+def _adam_leaf_kernel(p_ref, g_ref, m_ref, v_ref, sc_ref,
+                      p_out, m_out, v_out, *, beta1, beta2, eps):
+    # EXACTLY the unfused Adam.update expression (optimizer/__init__.py)
+    # in the same order — parity with it is bitwise, which is what the
+    # skip-step guard / GradScaler interaction tests pin down
+    g = g_ref[:]
+    m = beta1 * m_ref[:] + (1.0 - beta1) * g
+    v = beta2 * v_ref[:] + (1.0 - beta2) * jnp.square(g)
+    p_out[:] = p_ref[:] - sc_ref[0] * m / (jnp.sqrt(v) + eps)
+    m_out[:] = m
+    v_out[:] = v
+
+
+def _leaf_2d(x):
+    """Native-layout 2-D view: collapse leading dims onto rows, keep
+    the minor (lane) dim — a free reshape, unlike ravel on >=2-D."""
+    if x.ndim >= 2:
+        return x.reshape(-1, x.shape[-1])
+    return x.reshape(1, -1)
+
+
+def _round_up(n: int, mult: int) -> int:
+    return max(mult, -(-n // mult) * mult)
+
+
+def fused_adam_leaf(p, g, m, v, lr_corrected, beta1: float, beta2: float,
+                    eps: float, interpret: bool = False):
+    """One fused Adam step on a single fp32 leaf, layout preserved.
+
+    Returns (p_new, m_new, v_new) with p's shape/dtype. lr_corrected
+    already carries bias correction (caller folds it, same as the
+    unfused path). Bitwise-identical to the unfused update.
+    """
+    shape = p.shape
+    p2, g2, m2, v2 = (_leaf_2d(x) for x in (p, g, m, v))
+    rows, cols = p2.shape
+    bm = min(256, _round_up(rows, 8))
+    bn = min(2048, _round_up(cols, 128))
+    grid = (pl.cdiv(rows, bm), pl.cdiv(cols, bn))
+    kernel = functools.partial(_adam_leaf_kernel, beta1=beta1,
+                               beta2=beta2, eps=eps)
+    sc = jnp.asarray(lr_corrected, jnp.float32).reshape(1)
+    tile = pl.BlockSpec((bm, bn), lambda i, j: (i, j),
+                        memory_space=pltpu.VMEM)
+    p_new, m_new, v_new = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[tile, tile, tile, tile,
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=[tile, tile, tile],
+        out_shape=[
+            jax.ShapeDtypeStruct(p2.shape, p.dtype),
+            jax.ShapeDtypeStruct(m2.shape, jnp.float32),
+            jax.ShapeDtypeStruct(v2.shape, jnp.float32),
+        ],
+        interpret=interpret,
+    )(p2, g2, m2, v2, sc)
+    return (p_new.reshape(shape), m_new.reshape(shape),
+            v_new.reshape(shape))
 
 
 def fused_adam_flat(p, g, m, v, lr_corrected, beta1: float, beta2: float,

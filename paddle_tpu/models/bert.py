@@ -11,11 +11,23 @@ nn.LayerNorm). bf16-friendly: keep LN/softmax fp32 via amp black list.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax.numpy as jnp
 
 from .. import nn
+
+
+class MLMHeadOutput(NamedTuple):
+    """Loss-region handoff for the fused MLM head
+    (FLAGS_fused_softmax_xent): the transformed hidden states plus the
+    tied decoder weight/bias instead of the materialized [B, P, V]
+    logits — pretraining_loss feeds them to the fused projection+xent
+    kernel so the logits never exist in HBM. A NamedTuple so it flows
+    through functional_call/jit as a pytree."""
+    hidden: jnp.ndarray
+    weight: jnp.ndarray
+    bias: jnp.ndarray
 
 
 @dataclass
@@ -112,10 +124,16 @@ class BertPretrainingHeads(nn.Layer):
         self.seq_relationship = nn.Linear(config.hidden_size, 2)
 
     def forward(self, sequence_output, pooled_output, word_embedding_weight):
+        from ..kernels import fused_softmax_xent_enabled
         h = self.transform_norm(self.transform_act(
             self.transform(sequence_output)))
-        mlm_logits = h @ word_embedding_weight.T + self.decoder_bias
         nsp_logits = self.seq_relationship(pooled_output)
+        if fused_softmax_xent_enabled():
+            # defer the vocab projection into the loss region so the
+            # fused kernel can stream it (pretraining_loss unpacks)
+            return MLMHeadOutput(h, word_embedding_weight,
+                                 self.decoder_bias), nsp_logits
+        mlm_logits = h @ word_embedding_weight.T + self.decoder_bias
         return mlm_logits, nsp_logits
 
 
@@ -153,7 +171,16 @@ def pretraining_loss(outputs, mlm_labels, nsp_labels,
     """Masked-LM + next-sentence loss."""
     from ..ops import loss as L
     mlm_logits, nsp_logits = outputs
-    mlm = L.cross_entropy(mlm_logits, mlm_labels,
-                          ignore_index=ignore_index, reduction="mean")
+    if isinstance(mlm_logits, MLMHeadOutput):
+        # fused loss region: per-position xent straight off the hidden
+        # states; mean over all positions matches the reference
+        # cross_entropy (ignored positions contribute exact zeros)
+        from ..kernels import maybe_fused_linear_xent
+        mlm = jnp.mean(maybe_fused_linear_xent(
+            mlm_logits.hidden, mlm_logits.weight, mlm_logits.bias,
+            mlm_labels, ignore_index=ignore_index))
+    else:
+        mlm = L.cross_entropy(mlm_logits, mlm_labels,
+                              ignore_index=ignore_index, reduction="mean")
     nsp = L.cross_entropy(nsp_logits, nsp_labels, reduction="mean")
     return mlm + nsp

@@ -24,7 +24,8 @@ from .layers.norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
                           InstanceNorm3D, LayerNorm, LocalResponseNorm,
                           SpectralNorm, SyncBatchNorm)
 from .layers.loss import (BCELoss, BCEWithLogitsLoss, CTCLoss,
-                          CosineEmbeddingLoss, CrossEntropyLoss, KLDivLoss,
+                          CosineEmbeddingLoss, CrossEntropyLoss,
+                          FusedLinearCrossEntropy, KLDivLoss,
                           L1Loss, MSELoss, MarginRankingLoss, NLLLoss,
                           SmoothL1Loss, TripletMarginLoss)
 from .layers.moe import MoELayer, moe_param_rule  # noqa: F401

@@ -29,6 +29,28 @@ class CrossEntropyLoss(Layer):
                                self.use_softmax, self.weight)
 
 
+class FusedLinearCrossEntropy(Layer):
+    """Linear projection + softmax cross-entropy as ONE loss-region op:
+    ``loss = xent(hidden @ weight.T + bias, label)`` without ever
+    materializing the [..., V] logits when the Pallas fused kernel is
+    routed (FLAGS_fused_softmax_xent; falls back to the composed
+    projection + ops.loss path with identical semantics otherwise).
+    The class-level entry point for tied-embedding LM heads — BERT's
+    pretraining_loss uses the same kernels.maybe_fused_linear_xent."""
+
+    def __init__(self, ignore_index: int = -100,
+                 reduction: str = "mean") -> None:
+        super().__init__()
+        self.ignore_index = ignore_index
+        self.reduction = reduction
+
+    def forward(self, hidden, weight, label, bias=None):
+        from ...kernels import maybe_fused_linear_xent
+        loss = maybe_fused_linear_xent(hidden, weight, bias, label,
+                                       ignore_index=self.ignore_index)
+        return L._reduce(loss, self.reduction)
+
+
 class MSELoss(Layer):
     def __init__(self, reduction: str = "mean") -> None:
         super().__init__()

@@ -11,7 +11,12 @@ that accounting:
   time into **exclusive** buckets::
 
       step_compute   the train step itself (the goodput)
-      jit_compile    dispatches that traced (from the recompile tracker)
+      jit_compile_cold      dispatches that traced AND compiled from
+                            scratch (from the recompile tracker)
+      jit_compile_cache_hit dispatches that traced but loaded their
+                            executable from the persistent compile
+                            cache (FLAGS_compile_cache_dir) — the
+                            warm-process proof signal
       data_wait      blocking on DataLoader/reader for the next batch
       eval           in-fit evaluation passes
       checkpoint     Model.save / io.AsyncCheckpointer / auto_checkpoint
@@ -55,8 +60,8 @@ __all__ = ["BUCKETS", "GOODPUT_BUCKET", "GoodputLedger", "ledger",
            "StragglerDetector", "flag_stragglers"]
 
 GOODPUT_BUCKET = "step_compute"
-BUCKETS = (GOODPUT_BUCKET, "jit_compile", "data_wait", "eval",
-           "checkpoint", "restart_idle", "other")
+BUCKETS = (GOODPUT_BUCKET, "jit_compile_cold", "jit_compile_cache_hit",
+           "data_wait", "eval", "checkpoint", "restart_idle", "other")
 
 # process-start anchor: a relaunched elastic worker charges the time
 # from interpreter start to its first ledger.start() as restart_idle
@@ -211,11 +216,22 @@ class GoodputLedger:
         bad = _metrics.counter(
             "badput_seconds_total",
             "ledger seconds per non-goodput bucket "
-            "(jit_compile | data_wait | eval | checkpoint | "
-            "restart_idle | other)")
+            "(jit_compile_cold | jit_compile_cache_hit | data_wait | "
+            "eval | checkpoint | restart_idle | other)")
         for b, s in snap["buckets"].items():
             if b != GOODPUT_BUCKET:
                 bad.set_total(s, bucket=b)
+        stats = compile_cache_stats()
+        _metrics.counter(
+            "compile_cache_hits_total",
+            "persistent compile cache hits (executables loaded from "
+            "FLAGS_compile_cache_dir instead of compiled)"
+        ).set_total(stats["hits"])
+        _metrics.counter(
+            "compile_cache_misses_total",
+            "persistent compile cache misses (cold compiles written "
+            "through to FLAGS_compile_cache_dir)"
+        ).set_total(stats["misses"])
 
     def reset(self) -> None:
         with self._lock:
@@ -228,11 +244,42 @@ class GoodputLedger:
 def compile_seconds_total() -> float:
     """Total jit-compile wall seconds seen by the recompile tracker —
     the fit loop diffs this around each step dispatch to split the
-    step's wall time into jit_compile vs step_compute."""
+    step's wall time into jit_compile_{cold,cache_hit} vs
+    step_compute."""
     total = 0.0
     for rec in _recompile.tracker().snapshot().values():
         total += sum(rec.get("compile_times_s", ()))
     return total
+
+
+def compile_cache_stats() -> Dict[str, int]:
+    """Persistent-cache hit/miss counters (sysconfig pass-through)."""
+    from .. import sysconfig as _sysconfig
+    return _sysconfig.compile_cache_stats()
+
+
+def classify_compile_bucket(cache_before: Dict[str, int]) -> str:
+    """Which jit_compile bucket a just-measured trace's seconds belong
+    to, given the cache stats snapshotted before the dispatch.
+
+    cache_hit only when FLAGS_compile_cache_dir is active AND the
+    persistent cache reported hits (and no fresh miss) during the
+    dispatch. The flag gate keeps classification deterministic when
+    some OTHER cache config is live (the test conftest enables a
+    shared dev cache) — without the operator opting in, everything
+    books as cold, exactly like before the split."""
+    try:
+        from ..flags import GLOBAL_FLAGS
+        if not GLOBAL_FLAGS.get("compile_cache_dir"):
+            return "jit_compile_cold"
+    except Exception:
+        return "jit_compile_cold"
+    now = compile_cache_stats()
+    hits = now["hits"] - cache_before.get("hits", 0)
+    misses = now["misses"] - cache_before.get("misses", 0)
+    if hits > 0 and misses == 0:
+        return "jit_compile_cache_hit"
+    return "jit_compile_cold"
 
 
 _LEDGER = GoodputLedger()

@@ -561,6 +561,19 @@ class Adam(Optimizer):
         g = g.astype(p.dtype)
         from ..flags import GLOBAL_FLAGS
         from ..kernels import pallas_enabled
+        if (pallas_enabled() and GLOBAL_FLAGS.get("fused_adam")
+                and p.dtype == jnp.float32
+                and slots["m"].dtype == jnp.float32
+                and slots["v"].dtype == jnp.float32):
+            # layout-preserving fused kernel; bitwise-identical to the
+            # unfused expression below (takes precedence over the
+            # ravel-based use_pallas_adam path)
+            from ..kernels.fused_adam import fused_adam_leaf
+            lr_c = self._bias_correct_lr(lr_t, step)
+            p_new, m, v = fused_adam_leaf(
+                p, g, slots["m"], slots["v"], lr_c, self.beta1,
+                self.beta2, self.epsilon)
+            return p_new, {"m": m, "v": v}
         if (pallas_enabled() and GLOBAL_FLAGS.get("use_pallas_adam")
                 and p.dtype == jnp.float32
                 and slots["m"].dtype == jnp.float32 and p.size >= 1024):

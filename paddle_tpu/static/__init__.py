@@ -349,6 +349,18 @@ def _skip_guard_default() -> bool:
         return True
 
 
+def _defer_probes_default() -> bool:
+    """XLA refuses to persist an executable that contains host
+    callbacks, so with FLAGS_compile_cache_dir set the step must keep
+    its HLO callback-free: the probe signals (anomaly scalars, the
+    skip-guard verdict) ride the step's outputs and are drained on the
+    host instead of streaming through jax.debug.callback."""
+    try:
+        return bool(GLOBAL_FLAGS.get("compile_cache_dir"))
+    except KeyError:  # pragma: no cover - partial installs
+        return False
+
+
 def inject_fault_mults(batch) -> None:
     """Thread in-graph value faults (testing.faults: nonfinite_grad /
     loss_spike) into a step's batch as scalar multipliers. Keys are
@@ -423,6 +435,10 @@ class TrainStep:
         # finiteness guard for every precision (bf16/fp32 runs get the
         # skip alone, without scaling); flag read at construction
         self._skip_guard = _skip_guard_default()
+        # persistent-cache mode: keep the step HLO callback-free so the
+        # executable can be written to / read from FLAGS_compile_cache_dir
+        self._defer_probes = _defer_probes_default()
+        self._pending_signals = []
         # host-LR rescale applied on divergence-rollback re-entry
         # (FLAGS_rollback_lr_factor); changing it retraces once
         self.lr_scale = 1.0
@@ -479,17 +495,24 @@ class TrainStep:
             loss = loss / state["scaler"]["scale"].astype(loss.dtype)
         elif self._skip_guard:
             found_inf = ~_amp.all_finite(grads)
+        deferred = {}
         if _obs.enabled():
-            # anomaly sentinel: async host callbacks baked in at trace
-            # time (observe_traced semantics) — NaN/Inf + spike watch on
-            # the loss and the gradient global norm, no per-step sync
-            _obs.anomaly.probe("loss", loss)
+            # anomaly sentinel: NaN/Inf + spike watch on the loss and
+            # the gradient global norm. Default: async host callbacks
+            # baked in at trace time (observe_traced semantics, no
+            # per-step sync). In persistent-cache mode the scalars ride
+            # the step outputs instead and are drained host-side — a
+            # callback in the HLO would make the executable uncacheable.
             gnorm = jnp.sqrt(sum(
                 jnp.sum(jnp.square(g.astype(jnp.float32)))
                 for g in jax.tree.leaves(grads)
                 if jnp.issubdtype(getattr(g, "dtype", jnp.int32),
                                   jnp.inexact)) + 0.0)
-            _obs.anomaly.probe("grad_norm", gnorm)
+            if self._defer_probes:
+                deferred["_pt_gnorm"] = gnorm
+            else:
+                _obs.anomaly.probe("loss", loss)
+                _obs.anomaly.probe("grad_norm", gnorm)
         lr = batch.get("lr")
         if "lr_scale" in batch:
             # rollback LR rescale: reproduce the LR apply_gradients
@@ -512,10 +535,14 @@ class TrainStep:
                                          state["opt"])
             new_buffers = _amp.select_update(found_inf, new_buffers,
                                              buffers)
-            probe_nonfinite(found_inf)
+            if self._defer_probes and _obs.enabled():
+                deferred["_pt_nonfinite"] = found_inf
+            else:
+                probe_nonfinite(found_inf)
         metrics = {"loss": loss}
         for name, fn in self.extra_metrics.items():
             metrics[name] = fn(out, *batch["labels"])
+        metrics.update(deferred)
         new_state = {"params": new_params, "buffers": new_buffers,
                      "opt": new_opt, "rng": rng}
         if scaler is not None:
@@ -557,7 +584,7 @@ class TrainStep:
                          "optimizer update steps applied").inc()
         else:
             self.state, metrics = self._jitted(self.state, batch)
-        return metrics
+        return self._drain_signals(metrics)
 
     def run_steps(self, *args, labels=(), **kwargs):
         """Run K fused optimizer steps in one dispatch: every leaf of
@@ -582,7 +609,52 @@ class TrainStep:
         else:
             self.state, metrics = self._jitted_multi(self.state, batch,
                                                      lr)
+        return self._drain_signals(metrics)
+
+    # -- persistent-cache probe drain ------------------------------------
+    # With FLAGS_compile_cache_dir set the step's anomaly/skip-guard
+    # signals come back as reserved "_pt_*" metric leaves instead of
+    # jax.debug.callback (a host callback in the HLO disqualifies the
+    # executable from the persistent cache). The drain feeds them to
+    # the exact host handlers the callbacks would have hit, reading a
+    # value only once its buffer is ready — still no forced sync on
+    # the hot path; anything left over is flushed at sync_to_model.
+
+    def _drain_signals(self, metrics):
+        nf = metrics.pop("_pt_nonfinite", None)
+        gn = metrics.pop("_pt_gnorm", None)
+        if nf is not None or gn is not None:
+            self._pending_signals.append((nf, gn, metrics.get("loss")))
+            self.flush_signals(block=False)
         return metrics
+
+    def flush_signals(self, block: bool = True) -> None:
+        """Deliver pending deferred probe signals to their host-side
+        handlers (anomaly sentinel, nonfinite-step counter). With
+        ``block=False`` only values whose buffers are already on the
+        host are consumed; the rest stay queued."""
+        keep = []
+        for item in self._pending_signals:
+            if not block and not all(
+                    getattr(v, "is_ready", lambda: True)()
+                    for v in item if v is not None):
+                keep.append(item)
+                continue
+            nf, gn, loss = item
+            if nf is not None:
+                for _ in range(int(np.sum(np.asarray(nf, dtype=bool)))):
+                    _note_nonfinite_host(True)
+            if gn is not None:
+                # [K]-stacked leaves from run_steps flatten to K samples
+                # in step order; scalars from __call__ to one
+                sent = _obs.anomaly.sentinel()
+                if loss is not None:
+                    for x in np.ravel(np.asarray(loss,
+                                                 dtype=np.float64)):
+                        sent.observe("loss", float(x))
+                for x in np.ravel(np.asarray(gn, dtype=np.float64)):
+                    sent.observe("grad_norm", float(x))
+        self._pending_signals = keep
 
     def compiled_hlo(self, *args, labels=(), **kwargs) -> str:
         """Optimized-HLO text of the whole train step for these inputs
@@ -605,6 +677,7 @@ class TrainStep:
 
     # sync trained state back into the eager model
     def sync_to_model(self) -> None:
+        self.flush_signals()
         state = {**self.state["params"], **self.state["buffers"]}
         # A step that failed mid-execution may have consumed (deleted) the
         # donated buffers with no result to replace them; those weights are

@@ -1,0 +1,204 @@
+"""Fused MLM-head + softmax-xent loss-region kernel vs the reference
+composition (interpret mode on CPU; the same kernel compiles natively
+on TPU). Parity must hold for the forward value and all three
+gradients (dhidden, dweight, dbias) to fp32 tolerance, including
+ignore_index rows, odd row counts and vocab-tile remainders."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _ref_loss(hidden, weight, bias, labels, ignore_index=-100):
+    """The exact composition the kernel replaces: materialized logits
+    through ops.loss.softmax_with_cross_entropy's hard-label path."""
+    from paddle_tpu.ops.loss import softmax_with_cross_entropy
+    logits = hidden @ weight.T
+    if bias is not None:
+        logits = logits + bias
+    loss = softmax_with_cross_entropy(logits, labels[..., None],
+                                      ignore_index=ignore_index)
+    return jnp.squeeze(loss, axis=-1)
+
+
+def _fused(hidden, weight, bias, labels, ignore_index=-100):
+    from paddle_tpu.kernels.fused_softmax_xent import \
+        fused_linear_softmax_xent
+    return fused_linear_softmax_xent(hidden, weight, bias, labels,
+                                     ignore_index=ignore_index,
+                                     interpret=True)
+
+
+def _case(rng, lead, v, h, ignore_frac=0.0, dtype=np.float32):
+    hidden = rng.standard_normal((*lead, h)).astype(dtype)
+    weight = (rng.standard_normal((v, h)) * 0.5).astype(dtype)
+    bias = rng.standard_normal((v,)).astype(np.float32)
+    labels = rng.integers(0, v, lead).astype(np.int64)
+    if ignore_frac:
+        mask = rng.random(lead) < ignore_frac
+        labels = np.where(mask, -100, labels)
+    return (jnp.asarray(hidden), jnp.asarray(weight), jnp.asarray(bias),
+            jnp.asarray(labels))
+
+
+# odd B*T (tile remainders on the row axis) and odd V (vocab-chunk
+# remainders: 300 < one 512 chunk, 513 = one chunk + 1, 1024 = exact)
+SHAPES = [((2, 7), 300, 32), ((1, 13), 513, 64), ((3, 5), 1024, 48)]
+
+
+class TestForwardParity:
+    @pytest.mark.parametrize("lead,v,h", SHAPES)
+    def test_matches_reference(self, rng, lead, v, h):
+        args = _case(rng, lead, v, h)
+        got = _fused(*args)
+        ref = _ref_loss(*args)
+        assert got.shape == lead
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-6, atol=2e-6)
+
+    def test_ignore_index_rows_are_exact_zero(self, rng):
+        args = _case(rng, (4, 9), 300, 32, ignore_frac=0.5)
+        got = np.asarray(_fused(*args))
+        ref = np.asarray(_ref_loss(*args))
+        ignored = np.asarray(args[3]) == -100
+        assert ignored.any() and (~ignored).any()
+        np.testing.assert_array_equal(got[ignored], 0.0)
+        np.testing.assert_allclose(got, ref, rtol=2e-6, atol=2e-6)
+
+    def test_bias_none(self, rng):
+        hidden, weight, _, labels = _case(rng, (3, 4), 257, 32)
+        got = _fused(hidden, weight, None, labels)
+        ref = _ref_loss(hidden, weight, None, labels)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-6, atol=2e-6)
+
+    def test_single_lead_dim(self, rng):
+        hidden, weight, bias, labels = _case(rng, (11,), 130, 16)
+        got = _fused(hidden, weight, bias, labels)
+        ref = _ref_loss(hidden, weight, bias, labels)
+        assert got.shape == (11,)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-6, atol=2e-6)
+
+    def test_bf16_inputs(self, rng):
+        """bf16 hidden/weight: the fused kernel accumulates the logits
+        in f32 on the MXU while the reference rounds the materialized
+        logits to bf16 first — agreement is to bf16 resolution only."""
+        args = _case(rng, (2, 8), 300, 32)
+        h16 = args[0].astype(jnp.bfloat16)
+        w16 = args[1].astype(jnp.bfloat16)
+        got = _fused(h16, w16, args[2], args[3])
+        ref = _ref_loss(h16, w16, args[2], args[3])
+        assert got.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-2, atol=2e-2)
+
+
+class TestBackwardParity:
+    @pytest.mark.parametrize("lead,v,h", SHAPES)
+    def test_grads_match_reference(self, rng, lead, v, h):
+        hidden, weight, bias, labels = _case(rng, lead, v, h,
+                                             ignore_frac=0.25)
+
+        def mean_fused(h_, w_, b_):
+            return jnp.mean(_fused(h_, w_, b_, labels))
+
+        def mean_ref(h_, w_, b_):
+            return jnp.mean(_ref_loss(h_, w_, b_, labels))
+
+        gf = jax.grad(mean_fused, argnums=(0, 1, 2))(hidden, weight,
+                                                     bias)
+        gr = jax.grad(mean_ref, argnums=(0, 1, 2))(hidden, weight, bias)
+        for a, r, name in zip(gf, gr, ("dhidden", "dweight", "dbias")):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(r), rtol=2e-5, atol=2e-6,
+                err_msg=name)
+
+    def test_ignored_rows_contribute_zero_gradient(self, rng):
+        hidden, weight, bias, _ = _case(rng, (6,), 200, 16)
+        labels = jnp.asarray(np.full((6,), -100, np.int64))
+
+        g = jax.grad(lambda h_: jnp.sum(_fused(h_, weight, bias,
+                                               labels)))(hidden)
+        np.testing.assert_array_equal(np.asarray(g), 0.0)
+
+    def test_grad_dtypes_follow_inputs(self, rng):
+        hidden, weight, bias, labels = _case(rng, (2, 4), 200, 32)
+        h16, w16 = hidden.astype(jnp.bfloat16), weight.astype(
+            jnp.bfloat16)
+        gh, gw, gb = jax.grad(
+            lambda h_, w_, b_: jnp.mean(_fused(h_, w_, b_, labels)),
+            argnums=(0, 1, 2))(h16, w16, bias)
+        assert gh.dtype == jnp.bfloat16
+        assert gw.dtype == jnp.bfloat16
+        assert gb.dtype == jnp.float32
+
+
+class TestRouting:
+    def test_layer_routes_through_flag(self, rng, monkeypatch):
+        """nn.FusedLinearCrossEntropy under FLAGS_fused_softmax_xent
+        (kernel forced to interpret mode) matches the flag-off
+        reference composition it falls back to."""
+        from paddle_tpu import kernels
+        from paddle_tpu.kernels import fused_softmax_xent as fx_mod
+
+        hidden, weight, bias, labels = _case(rng, (3, 7), 300, 32,
+                                             ignore_frac=0.3)
+        layer = pt.nn.FusedLinearCrossEntropy()
+        off = layer(hidden, weight, labels, bias=bias)
+
+        monkeypatch.setattr(kernels, "_on_tpu", lambda: True)
+        monkeypatch.setattr(
+            fx_mod, "fused_linear_softmax_xent",
+            functools.partial(fx_mod.fused_linear_softmax_xent,
+                              interpret=True))
+        pt.set_flags({"fused_softmax_xent": True})
+        try:
+            on = layer(hidden, weight, labels, bias=bias)
+        finally:
+            pt.set_flags({"fused_softmax_xent": False})
+        np.testing.assert_allclose(np.asarray(on), np.asarray(off),
+                                   rtol=2e-6, atol=2e-6)
+
+    def test_bert_pretraining_loss_parity(self, rng, monkeypatch):
+        """End-to-end route: BertForPretraining + pretraining_loss with
+        the flag on defers the vocab projection into the fused kernel
+        (MLMHeadOutput) — total loss must match the flag-off
+        materialized-logits path on identical weights."""
+        from paddle_tpu import kernels
+        from paddle_tpu.kernels import fused_softmax_xent as fx_mod
+        from paddle_tpu.models import (BertConfig, BertForPretraining,
+                                       pretraining_loss)
+
+        config = BertConfig(num_hidden_layers=1, hidden_size=32,
+                            num_attention_heads=2, intermediate_size=64,
+                            vocab_size=300, max_position_embeddings=16)
+        ids = rng.integers(0, 300, (2, 16)).astype(np.int32)
+        mlm = rng.integers(0, 300, (2, 16)).astype(np.int64)
+        mlm[0, :8] = -100
+        nsp = rng.integers(0, 2, (2,)).astype(np.int64)
+
+        pt.seed(0)
+        model = BertForPretraining(config)
+        model.eval()
+        off = float(pretraining_loss(model(ids), mlm, nsp))
+
+        monkeypatch.setattr(kernels, "_on_tpu", lambda: True)
+        monkeypatch.setattr(
+            fx_mod, "fused_linear_softmax_xent",
+            functools.partial(fx_mod.fused_linear_softmax_xent,
+                              interpret=True))
+        pt.set_flags({"fused_softmax_xent": True})
+        try:
+            out = model(ids)
+            from paddle_tpu.models.bert import MLMHeadOutput
+            assert isinstance(out[0], MLMHeadOutput)
+            on = float(pretraining_loss(out, mlm, nsp))
+        finally:
+            pt.set_flags({"fused_softmax_xent": False})
+        np.testing.assert_allclose(on, off, rtol=2e-6, atol=2e-6)

@@ -157,6 +157,19 @@ STAGES = {
                                      "PT_BENCH_BERT_BATCH": "16",
                                      "PT_BENCH_FUSED": "0",
                                      "PT_BENCH_MASKED_LM": "1"}, 900),
+    # ISSUE 8 loss-region A/B at the b16 headline: fused MLM-head+xent
+    # kernel (never materializes the [B,T,V] logits) vs bert_b16_flash,
+    # then the fused-Adam default candidate stacked on top of it
+    "bert_b16_fusedloss": ([], {**_SKIP, "PT_BENCH_BERT_BATCH": "16",
+                                "PT_BENCH_FUSED": "0",
+                                "FLAGS_fused_softmax_xent": "1"}, 900),
+    "bert_b16_fusedloss_fusedadam": ([], {**_SKIP,
+                                          "PT_BENCH_BERT_BATCH": "16",
+                                          "PT_BENCH_FUSED": "0",
+                                          "FLAGS_fused_softmax_xent":
+                                          "1",
+                                          "FLAGS_fused_adam": "1"},
+                                     900),
     # ladder midpoint: b16 139.3k > b32 136.1k — the peak may sit
     # between
     "bert_b24_flash": ([], {**_SKIP, "PT_BENCH_BERT_BATCH": "24",

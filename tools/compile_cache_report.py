@@ -1,0 +1,180 @@
+"""Prove the persistent compile cache: warm processes skip cold XLA.
+
+Usage:
+    python tools/compile_cache_report.py [TRACE_DIR | metrics.json]
+                                        [--self-test]
+
+Renders the compile-side view of an exported ``metrics.json``: the
+goodput ledger's ``jit_compile_cold`` vs ``jit_compile_cache_hit``
+seconds and the ``compile_cache_{hits,misses}_total`` counters fed by
+jax's persistent compilation cache (FLAGS_compile_cache_dir).
+
+``--self-test`` is the no-TPU CI drill behind ISSUE 8's acceptance
+criterion: it runs the SAME tiny fit in two sequential subprocesses
+sharing one fresh cache directory and asserts the second (warm)
+process books < 10% of the first process's cold-compile seconds while
+its cache-hit counter is > 0 — i.e. a restarted job really does load
+its executables from disk instead of paying the cold compiles again
+(PR 5's skip-step guard changed every train step's HLO, so before this
+cache every fresh process paid them in full).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _counter_total(metrics: dict, name: str) -> float:
+    return sum(s.get("value", 0)
+               for s in metrics.get(name, {}).get("series", []))
+
+
+def render(snap: dict) -> str:
+    goodput = snap.get("goodput") or {}
+    buckets = goodput.get("buckets", {})
+    metrics = snap.get("metrics", {})
+    cold = buckets.get("jit_compile_cold", 0.0)
+    warm = buckets.get("jit_compile_cache_hit", 0.0)
+    hits = _counter_total(metrics, "compile_cache_hits_total")
+    misses = _counter_total(metrics, "compile_cache_misses_total")
+    lines = ["== compile cache ==",
+             f"{'jit_compile_cold':<24} {cold:>10.3f} s",
+             f"{'jit_compile_cache_hit':<24} {warm:>10.3f} s",
+             f"{'cache hits':<24} {int(hits):>10}",
+             f"{'cache misses':<24} {int(misses):>10}"]
+    if not buckets:
+        lines.append("(no goodput section — run the fit with "
+                     "FLAGS_enable_metrics=1)")
+    elif cold + warm > 0:
+        lines.append(f"{'warm share':<24} "
+                     f"{100 * warm / (cold + warm):>9.1f} %")
+    return "\n".join(lines)
+
+
+def report(path: str) -> int:
+    mpath = path
+    if os.path.isdir(path):
+        mpath = os.path.join(path, "metrics.json")
+    if not os.path.exists(mpath):
+        print(f"no metrics.json at {mpath} — run with "
+              "FLAGS_enable_metrics=1 and FLAGS_trace_dir set",
+              file=sys.stderr)
+        return 1
+    with open(mpath) as f:
+        snap = json.load(f)
+    print(render(snap))
+    return 0
+
+
+# ------------------------------------------------------------------ CI
+
+def _child(trace_dir: str, cache_dir: str) -> int:
+    """One fresh-interpreter fit against a shared persistent cache —
+    the unit the self-test measures twice."""
+    import numpy as np
+
+    import paddle_tpu as pt
+
+    pt.set_flags({"enable_metrics": True, "trace_dir": trace_dir,
+                  "compile_cache_dir": cache_dir})
+
+    class MLP(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = pt.nn.Linear(8, 16)
+            self.fc2 = pt.nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.fc2(pt.nn.functional.relu(self.fc1(x)))
+
+    rng = np.random.default_rng(0)
+    # compile seconds, not step count, carry the cold/warm contrast —
+    # keep the fit tiny so the drill stays cheap inside tier-1
+    n = 8 * 4
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    y = rng.integers(0, 4, n).astype(np.int64)
+    loader = pt.data.DataLoader(pt.data.TensorDataset(x, y),
+                                batch_size=4)
+    m = pt.hapi.Model(MLP())
+    m.prepare(optimizer=pt.optimizer.Adam(learning_rate=1e-3),
+              loss=pt.nn.CrossEntropyLoss())
+    m.fit(loader, epochs=1, verbose=0)
+    from paddle_tpu import observability as obs
+    obs.export_all(trace_dir)
+    return 0
+
+
+def _run_child(trace_dir: str, cache_dir: str) -> dict:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # a stray dev-cache env var would defeat the drill's fresh-dir
+    # cold/warm contrast
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child",
+         trace_dir, cache_dir],
+        capture_output=True, text=True, env=env, timeout=480)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    with open(os.path.join(trace_dir, "metrics.json")) as f:
+        return json.load(f)
+
+
+def self_test() -> int:
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        cache = os.path.join(d, "cache")
+        snap1 = _run_child(os.path.join(d, "run1"), cache)
+        snap2 = _run_child(os.path.join(d, "run2"), cache)
+        b1 = snap1["goodput"]["buckets"]
+        b2 = snap2["goodput"]["buckets"]
+        cold1 = b1.get("jit_compile_cold", 0.0)
+        cold2 = b2.get("jit_compile_cold", 0.0)
+        hits2 = _counter_total(snap2.get("metrics", {}),
+                               "compile_cache_hits_total")
+        misses1 = _counter_total(snap1.get("metrics", {}),
+                                 "compile_cache_misses_total")
+        print("== cold process ==")
+        print(render(snap1))
+        print("\n== warm process ==")
+        print(render(snap2))
+        # process 1 populated a fresh cache: real cold compiles, all
+        # misses on lookup
+        assert cold1 > 0, b1
+        assert misses1 > 0, snap1["metrics"].keys()
+        # process 2 is warm: executables load from the shared dir —
+        # near-zero cold seconds (< 10% of process 1's), hits counted
+        assert hits2 > 0, snap2["metrics"].keys()
+        assert cold2 < 0.10 * cold1, (cold1, cold2)
+    print("\nself-test OK")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("path", nargs="?", default="")
+    ap.add_argument("--self-test", action="store_true")
+    ap.add_argument("--child", nargs=2,
+                    metavar=("TRACE_DIR", "CACHE_DIR"),
+                    default=None,
+                    help=argparse.SUPPRESS)  # internal: one measured fit
+    args = ap.parse_args()
+    if args.child:
+        return _child(*args.child)
+    if args.self_test:
+        return self_test()
+    path = args.path
+    if not path:
+        from paddle_tpu.flags import GLOBAL_FLAGS
+        path = GLOBAL_FLAGS.get("trace_dir") or "/tmp/pt_trace"
+    return report(path)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

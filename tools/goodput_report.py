@@ -33,7 +33,8 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
-_BUCKET_ORDER = ("step_compute", "jit_compile", "data_wait", "eval",
+_BUCKET_ORDER = ("step_compute", "jit_compile_cold",
+                 "jit_compile_cache_hit", "data_wait", "eval",
                  "checkpoint", "restart_idle", "other")
 
 
@@ -171,7 +172,8 @@ def self_test() -> int:
         assert all(v >= 0 for v in buckets.values()), buckets
         assert abs(sum(gp["ratios"].values()) - 1.0) <= 0.02
         assert buckets["step_compute"] > 0 and buckets["eval"] > 0
-        assert buckets["checkpoint"] > 0 and buckets["jit_compile"] > 0
+        assert buckets["checkpoint"] > 0 \
+            and buckets["jit_compile_cold"] > 0
         assert gp["goodput_ratio"] == \
             buckets["step_compute"] / max(wall, 1e-12)
 
