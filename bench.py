@@ -845,6 +845,85 @@ def bench_flash_attention(on_accel: bool) -> None:
     })
 
 
+def bench_llm_decode(on_accel: bool) -> None:
+    """LLM serving decode path (paddle_tpu/serving_llm): paged-KV
+    continuous batching on the toy GPT decoder vs the dense
+    GenerationMixin loop serving the same requests sequentially.
+    Reports aggregate decode tokens/s plus TTFT p50/p99; vs_baseline
+    is the paged/dense throughput ratio (batching is the win — one
+    ragged decode step serves every running sequence)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import GPTLanguageModel
+    from paddle_tpu.serving_llm import LLMEngine
+
+    model = GPTLanguageModel()
+    rng = np.random.default_rng(0)
+    n_req, max_new = (8, 32) if on_accel else (6, 8)
+    prompts = [rng.integers(0, model.config.vocab_size,
+                            size=ln).astype(np.int32)
+               for ln in ([8, 48] * n_req)[:n_req]]
+
+    # warm the compile caches so both timings measure steady state
+    list(np.asarray(model.generate(jnp.asarray([prompts[0]]),
+                                   max_new_tokens=2)))
+    warm = LLMEngine(model, block_size=16, pool_blocks=128)
+    warm.add_request(prompts[0], max_new_tokens=2)
+    while warm.active():
+        warm.step()
+
+    engine = LLMEngine(model, block_size=16, pool_blocks=128)
+    t_add = {}
+    ttft_ms = {}
+    n_tok = 0
+    t0 = time.perf_counter()
+    for p in prompts:
+        t_add[engine.add_request(p, max_new_tokens=max_new)] = \
+            time.perf_counter()
+    while engine.active():
+        for ev in engine.step():
+            if ev["type"] == "token":
+                n_tok += 1
+                if ev["index"] == 0:
+                    ttft_ms[ev["seq_id"]] = \
+                        (time.perf_counter()
+                         - t_add[ev["seq_id"]]) * 1e3
+    paged_s = time.perf_counter() - t0
+    assert n_tok == n_req * max_new, (n_tok, n_req, max_new)
+    assert engine.allocator.num_used == 0
+
+    t0 = time.perf_counter()
+    for p in prompts:
+        model.generate(jnp.asarray([p]), max_new_tokens=max_new)
+    dense_s = time.perf_counter() - t0
+
+    ttfts = sorted(ttft_ms.values())
+    p50 = ttfts[len(ttfts) // 2]
+    p99 = ttfts[min(len(ttfts) - 1,
+                    int(round(0.99 * (len(ttfts) - 1))))]
+    toks_per_s = n_tok / paged_s
+    ratio = round((n_tok / paged_s) / (n_tok / dense_s), 3)
+    log(f"paged {paged_s:.2f}s ({toks_per_s:.1f} tok/s) vs dense "
+        f"sequential {dense_s:.2f}s; ttft p50={p50:.0f}ms "
+        f"p99={p99:.0f}ms")
+    emit_partial({
+        "metric": f"llm decode TTFT p50 ({n_req} reqs)",
+        "value": round(p50, 1), "unit": "ms",
+        "vs_baseline": ratio, "ttft_p99_ms": round(p99, 1),
+    })
+    emit({
+        "metric": f"llm paged decode throughput ({n_req} reqs x "
+                  f"{max_new} tokens)",
+        "value": round(toks_per_s, 2),
+        "unit": "tokens/s",
+        "vs_baseline": ratio,
+        "ttft_p50_ms": round(p50, 1),
+        "ttft_p99_ms": round(p99, 1),
+    })
+
+
 def bench_flash_train(on_accel: bool) -> None:
     """Training-mode flash crossover: fwd+bwd at BERT geometry (head
     dim 64, attention dropout 0.1) — the numbers that set
@@ -1072,6 +1151,8 @@ def main() -> None:
         bench_flash_attention(on_accel)
     elif which == "flash_train":
         bench_flash_train(on_accel)
+    elif which == "llm_decode":
+        bench_llm_decode(on_accel)
     else:
         bench_bert(on_accel)
 

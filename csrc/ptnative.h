@@ -180,6 +180,17 @@ int64_t pt_srv_next_ex(int64_t h, int timeout_ms, uint64_t* req_id,
 // Reply to a dequeued request. 0 ok, -1 unknown id, -3 client gone.
 int pt_srv_reply(int64_t h, uint64_t req_id, int64_t status,
                  const uint8_t* data, int64_t len);
+// Stream-aware dequeue: pt_srv_next_ex plus is_stream (1 for 'PTST'
+// streaming-generate frames, which expect chunked replies).
+int64_t pt_srv_next_ex2(int64_t h, int timeout_ms, uint64_t* req_id,
+                        uint64_t* trace_id, uint64_t* ingress_us,
+                        uint8_t* is_stream, uint8_t* buf, int64_t cap);
+// One reply chunk for a streaming request; final_chunk=0 keeps the
+// request inflight for more chunks. 0 ok, -1 unknown id, -3 client
+// gone (request closed — the engine should cancel the sequence).
+int pt_srv_reply_chunk(int64_t h, uint64_t req_id, int64_t status,
+                       const uint8_t* data, int64_t len,
+                       int final_chunk);
 int64_t pt_srv_pending(int64_t h);
 // "key=value\n" server stats (queue depth, inflight, accepted/replied
 // totals, uptime, plus monitor-registry "serving.*" lines) — the local

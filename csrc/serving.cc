@@ -33,6 +33,15 @@
 // microseconds) when the reader thread parses the frame; Python reads
 // both through pt_srv_next_ex and builds the per-request span records
 // served at /requests (docs/serving_protocol.md, "Request tracing").
+//
+// Streaming requests use magic 'PTST': payload = u64 trace_id | body
+// (the LLM serving engine owns the body layout). One request produces
+// MANY reply frames on the same tag: intermediate chunks carry status
+// 1 ("more coming"), the terminal frame status 0 (or negative on
+// error). The inflight entry survives until the terminal chunk, so
+// pt_srv_reply_chunk can be called repeatedly for one req_id. Old
+// 'PTSV' clients never see multi-frame replies
+// (docs/serving_protocol.md, "Streaming generation").
 
 #include "ptnative.h"
 
@@ -61,6 +70,7 @@ namespace {
 constexpr uint32_t kMagic = 0x56535450;      // "PTSV"
 constexpr uint32_t kMagicCtl = 0x43535450;   // "PTSC" control frame
 constexpr uint32_t kMagicTrace = 0x52535450; // "PTSR" traced request
+constexpr uint32_t kMagicStream = 0x54535450; // "PTST" streaming request
 constexpr uint32_t kCtlStats = 1;
 // Hard cap on a single request payload: a corrupt/malicious length must
 // fail the request, not drive an unchecked allocation (same rule as the
@@ -103,8 +113,9 @@ struct Conn {
 struct Request {
   uint64_t id;  // server-assigned, returned to Python
   uint64_t tag;  // client-assigned, echoed in the reply
-  uint64_t trace_id;    // client-assigned ('PTSR' frames); 0 = untraced
+  uint64_t trace_id;    // client-assigned ('PTSR'/'PTST'); 0 = untraced
   uint64_t ingress_us;  // unix microseconds when the frame was parsed
+  bool stream;          // 'PTST' frame: expects chunked replies
   std::shared_ptr<Conn> conn;
   std::string payload;
 };
@@ -177,7 +188,8 @@ class Server {
   // (0 = untraced 'PTSV' frame) and its reader-thread arrival stamp.
   int64_t Next(int timeout_ms, uint64_t* req_id, uint8_t* buf, int64_t cap,
                uint64_t* trace_id = nullptr,
-               uint64_t* ingress_us = nullptr) {
+               uint64_t* ingress_us = nullptr,
+               uint8_t* is_stream = nullptr) {
     auto deadline = std::chrono::steady_clock::now() +
                     std::chrono::milliseconds(timeout_ms);
     for (;;) {
@@ -197,6 +209,7 @@ class Server {
           *req_id = r.id;
           if (trace_id) *trace_id = r.trace_id;
           if (ingress_us) *ingress_us = r.ingress_us;
+          if (is_stream) *is_stream = r.stream ? 1 : 0;
           std::memcpy(buf, r.payload.data(), r.payload.size());
           inflight_.emplace(r.id, InFlight{r.tag, r.conn});
           queue_.pop_front();
@@ -257,6 +270,55 @@ class Server {
     return 0;
   }
 
+  // Streaming variant of Reply: the inflight entry survives non-final
+  // chunks, so one req_id can carry a whole token stream on its tag.
+  // 0 ok, -1 unknown id, -3 client gone (the entry is erased on ANY
+  // failure so the engine learns the client left and can cancel the
+  // sequence — freeing its KV blocks — instead of writing into a
+  // dead socket token by token).
+  int ReplyChunk(uint64_t req_id, int64_t status, const uint8_t* data,
+                 int64_t len, int final_chunk) {
+    InFlight inf;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = inflight_.find(req_id);
+      if (it == inflight_.end()) return -1;
+      inf = it->second;
+      if (final_chunk) inflight_.erase(it);
+    }
+    auto drop = [&] {
+      inf.conn->alive.store(false);
+      if (!final_chunk) {
+        std::lock_guard<std::mutex> lk(mu_);
+        inflight_.erase(req_id);
+      }
+      reply_dropped_total_.fetch_add(1);
+      pt_mon_add("serving.reply_dropped_total", 1);
+      return -3;
+    };
+    if (!inf.conn->alive.load()) return drop();
+    uint8_t hdr[8 + 8 + 4];
+    std::memcpy(hdr, &inf.tag, 8);
+    std::memcpy(hdr + 8, &status, 8);
+    uint32_t l = static_cast<uint32_t>(len);
+    std::memcpy(hdr + 16, &l, 4);
+    if (final_chunk) {
+      // Only the terminal frame counts as "the reply" — replied_total
+      // keeps its one-per-request meaning; chunks have their own line.
+      replied_total_.fetch_add(1);
+      pt_mon_add("serving.replied_total", 1);
+      if (status != 0) pt_mon_add("serving.error_replies_total", 1);
+    } else {
+      stream_chunks_total_.fetch_add(1);
+      pt_mon_add("serving.stream_chunks_total", 1);
+    }
+    std::lock_guard<std::mutex> wl(inf.conn->write_mu);
+    if (!WriteFull(inf.conn->fd, hdr, sizeof(hdr)) ||
+        (len > 0 && !WriteFull(inf.conn->fd, data, len)))
+      return drop();
+    return 0;
+  }
+
   int64_t Pending() {
     std::lock_guard<std::mutex> lk(mu_);
     return static_cast<int64_t>(queue_.size());
@@ -298,6 +360,9 @@ class Server {
     add("stats_requests_total",
         static_cast<long long>(stats_requests_total_.load()));
     add("traced_total", static_cast<long long>(traced_total_.load()));
+    add("stream_total", static_cast<long long>(stream_total_.load()));
+    add("stream_chunks_total",
+        static_cast<long long>(stream_chunks_total_.load()));
     int64_t need = pt_mon_dump(nullptr, 0);
     if (need > 0) {
       std::string mon(static_cast<size_t>(need), '\0');
@@ -376,15 +441,15 @@ class Server {
       std::memcpy(&tag, hdr + 4, 8);
       std::memcpy(&len, hdr + 12, 4);
       if ((magic != kMagic && magic != kMagicCtl &&
-           magic != kMagicTrace) ||
+           magic != kMagicTrace && magic != kMagicStream) ||
           len > kMaxPayload)
         break;  // corrupt stream
       std::string payload(len, '\0');
       if (len > 0 && !ReadFull(conn->fd, payload.data(), len)) break;
       uint64_t ingress_us = NowUs();
       uint64_t trace_id = 0;
-      if (magic == kMagicTrace) {
-        // Traced request: payload = u64 trace_id | tensor payload.
+      if (magic == kMagicTrace || magic == kMagicStream) {
+        // Traced/streaming request: payload = u64 trace_id | body.
         if (payload.size() < 8) {
           // Malformed, but the frame itself parsed — answer inline
           // (status -1) instead of poisoning the whole stream.
@@ -404,8 +469,13 @@ class Server {
         }
         std::memcpy(&trace_id, payload.data(), 8);
         payload.erase(0, 8);
-        traced_total_.fetch_add(1);
-        pt_mon_add("serving.traced_total", 1);
+        if (magic == kMagicStream) {
+          stream_total_.fetch_add(1);
+          pt_mon_add("serving.stream_total", 1);
+        } else {
+          traced_total_.fetch_add(1);
+          pt_mon_add("serving.traced_total", 1);
+        }
       }
       if (magic == kMagicCtl) {
         // Control request: answered inline by this reader thread (never
@@ -441,7 +511,8 @@ class Server {
       });
       if (stopping_.load()) break;
       queue_.push_back(Request{next_id_++, tag, trace_id, ingress_us,
-                               conn, std::move(payload)});
+                               magic == kMagicStream, conn,
+                               std::move(payload)});
       accepted_total_.fetch_add(1);
       pt_mon_add("serving.accepted_total", 1);
       cv_.notify_one();
@@ -463,6 +534,8 @@ class Server {
   std::atomic<uint64_t> conns_total_{0};
   std::atomic<uint64_t> stats_requests_total_{0};
   std::atomic<uint64_t> traced_total_{0};
+  std::atomic<uint64_t> stream_total_{0};
+  std::atomic<uint64_t> stream_chunks_total_{0};
   std::chrono::steady_clock::time_point start_ =
       std::chrono::steady_clock::now();
   std::thread accept_thread_;
@@ -544,6 +617,29 @@ int pt_srv_reply(int64_t h, uint64_t req_id, int64_t status,
   auto s = Get(h);
   if (!s) return -1;
   return s->Reply(req_id, status, data, len);
+}
+
+// Stream-aware dequeue: pt_srv_next_ex plus whether the request is a
+// 'PTST' streaming frame (expects chunked replies on its tag).
+int64_t pt_srv_next_ex2(int64_t h, int timeout_ms, uint64_t* req_id,
+                        uint64_t* trace_id, uint64_t* ingress_us,
+                        uint8_t* is_stream, uint8_t* buf, int64_t cap) {
+  auto s = Get(h);
+  if (!s) return -1;
+  return s->Next(timeout_ms, req_id, buf, cap, trace_id, ingress_us,
+                 is_stream);
+}
+
+// Send one reply chunk for a streaming request. final_chunk=0 keeps
+// the request inflight for further chunks; final_chunk!=0 closes it
+// (the terminal status/EOS frame). 0 ok, -1 unknown id, -3 client gone
+// (the request is closed — stop generating for it).
+int pt_srv_reply_chunk(int64_t h, uint64_t req_id, int64_t status,
+                       const uint8_t* data, int64_t len,
+                       int final_chunk) {
+  auto s = Get(h);
+  if (!s) return -1;
+  return s->ReplyChunk(req_id, status, data, len, final_chunk);
 }
 
 int64_t pt_srv_pending(int64_t h) {

@@ -25,10 +25,18 @@
  * docs/serving_protocol.md "Request tracing". The reply framing is
  * identical to an untraced request.
  *
+ * Streaming requests (magic 'PTST', same header layout, payload = u64
+ * trace id | generate body) produce MANY reply frames on one tag:
+ * chunks carry status 1 and a token payload, the terminal frame status
+ * 0 (or negative + UTF-8 message on error) — see
+ * docs/serving_protocol.md "Streaming generation". Call
+ * ptsc_wait_reply in a loop on the same tag until status != 1.
+ *
  * API (all return 0 on success, negative on error):
  *   ptsc_connect(host, port)                 -> fd (>=0) or -errno
  *   ptsc_request(fd, payload, len, &tag)     -> sends one frame
  *   ptsc_request_traced(fd, trace_id, payload, len, &tag)
+ *   ptsc_request_stream(fd, trace_id, payload, len, &tag)
  *   ptsc_wait_reply(fd, tag, buf, cap, &status, &out_len)
  *   ptsc_infer(fd, payload, len, buf, cap, &status, &out_len)
  *   ptsc_infer_traced(fd, trace_id, payload, len, buf, cap, &status,
@@ -50,7 +58,9 @@
 #define PTSC_MAGIC 0x56535450u       /* 'PTSV' */
 #define PTSC_MAGIC_CTL 0x43535450u   /* 'PTSC' control frame */
 #define PTSC_MAGIC_TRACE 0x52535450u /* 'PTSR' traced request */
+#define PTSC_MAGIC_STREAM 0x54535450u /* 'PTST' streaming request */
 #define PTSC_OP_STATS 1u
+#define PTSC_STATUS_CHUNK 1 /* stream chunk: more frames follow */
 
 #define PTSC_ERR_CONNECT -1
 #define PTSC_ERR_IO -2
@@ -172,6 +182,26 @@ int ptsc_request_traced(int fd, uint64_t trace_id, const void *payload,
   int rc;
   if (len > 0xFFFFFFFFu - 8u) return PTSC_ERR_TOOBIG;
   ptsc_put_u32(hdr, PTSC_MAGIC_TRACE);
+  ptsc_put_u64(hdr + 4, t);
+  ptsc_put_u32(hdr + 12, len + 8u);
+  ptsc_put_u64(hdr + 16, trace_id);
+  if ((rc = ptsc_write_all(fd, hdr, sizeof(hdr))) != 0) return rc;
+  if (len > 0 && (rc = ptsc_write_all(fd, payload, len)) != 0) return rc;
+  if (tag) *tag = t;
+  return 0;
+}
+
+/* Streaming variant: 'PTST' frame, same layout as 'PTSR'. The server
+ * answers with chunk frames (status PTSC_STATUS_CHUNK) on this tag
+ * until the terminal status-0/negative frame; loop ptsc_wait_reply on
+ * the returned tag until status != PTSC_STATUS_CHUNK. */
+int ptsc_request_stream(int fd, uint64_t trace_id, const void *payload,
+                        uint32_t len, uint64_t *tag) {
+  unsigned char hdr[24];
+  uint64_t t = PTSC_NEXT_TAG();
+  int rc;
+  if (len > 0xFFFFFFFFu - 8u) return PTSC_ERR_TOOBIG;
+  ptsc_put_u32(hdr, PTSC_MAGIC_STREAM);
   ptsc_put_u64(hdr + 4, t);
   ptsc_put_u32(hdr + 12, len + 8u);
   ptsc_put_u64(hdr + 16, trace_id);

@@ -458,6 +458,29 @@ define_flag("serving_queue_deadline_ms", 0,
             "serving.shed_total stat). 0 (default) disables shedding. "
             "Age is measured from when the server first dequeues the "
             "request off the native transport.")
+define_flag("kv_block_size", 16,
+            "LLM serving (serving_llm): tokens per KV-cache block. "
+            "The paged allocator hands out cache memory in fixed "
+            "blocks of this many token slots; the ragged paged "
+            "attention kernel scans one block per grid step, so this "
+            "is also its K/V tile length. Read when an LLMEngine is "
+            "constructed (pool geometry is baked into the compiled "
+            "decode step; changing it needs a new engine).")
+define_flag("kv_pool_blocks", 64,
+            "LLM serving (serving_llm): total KV-cache blocks in the "
+            "preallocated per-layer HBM pools — the hard capacity of "
+            "the paged allocator (kv_block_size tokens each, shared "
+            "by every running sequence). When a sequence cannot grow, "
+            "the scheduler preempts the youngest running sequence "
+            "back to the waiting queue (recompute-on-readmit), "
+            "counted in kv_blocks_preempted_total. Read at LLMEngine "
+            "construction.")
+define_flag("max_decode_batch", 8,
+            "LLM serving (serving_llm): max sequences decoding "
+            "concurrently — the continuous-batching scheduler admits "
+            "waiting prefills only while the running set is below "
+            "this AND the pool has blocks for the prompt. Read every "
+            "scheduler step, so it can be retuned on a live server.")
 
 
 def _fault_spec_changed(value) -> None:

@@ -361,16 +361,25 @@ class Server:
     # STATS reply): cumulative "le" semantics like the Python histogram
     _BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 
-    def __init__(self, predictor: Predictor, port: int = 0,
+    def __init__(self, predictor: Optional[Predictor], port: int = 0,
                  max_batch: int = 32, wait_ms: int = 2,
                  queue_cap: int = 512, max_payload: int = 64 << 20,
                  stats_interval_s: float = 1.0,
-                 queue_deadline_ms: Optional[int] = None):
+                 queue_deadline_ms: Optional[int] = None,
+                 llm_engine=None):
         from ..native import ServingTransport
         from ..sysconfig import apply_compile_cache_flag
 
         apply_compile_cache_flag()  # serving warm-start path
+        # predictor serves tensor (PTSV/PTSR) requests; llm_engine (an
+        # serving_llm.LLMEngine) serves streaming generate (PTST)
+        # requests. Either may be None; a request hitting the missing
+        # half gets an error reply, not a hang.
         self.predictor = predictor
+        self._llm = None
+        if llm_engine is not None:
+            from ..serving_llm.server import LLMStreamBridge
+            self._llm = LLMStreamBridge(self, llm_engine)
         self.max_batch = max_batch
         self.wait_ms = wait_ms
         # load shedding: requests older than this when the batcher
@@ -457,13 +466,14 @@ class Server:
     def _mk_req(r) -> Dict[str, Any]:
         """Wrap one transport dequeue into the request-span dict the
         batcher threads through to the reply (reqtrace.STAMPS order)."""
-        rid, payload, trace_id, ingress = r
+        rid, payload, trace_id, ingress, is_stream = r
         return {"rid": rid, "payload": payload, "trace_id": trace_id,
-                "ingress_unix": ingress, "dequeue_unix": time.time()}
+                "ingress_unix": ingress, "dequeue_unix": time.time(),
+                "stream": is_stream}
 
     def _drain_transport(self) -> None:
         while True:
-            r = self.transport.next_request_ex(timeout_ms=0)
+            r = self.transport.next_request_ex2(timeout_ms=0)
             if r is None:
                 return
             self._rq.append((time.perf_counter(), self._mk_req(r)))
@@ -474,7 +484,7 @@ class Server:
         deadline are shed here — counted, never silently dropped."""
         self._drain_transport()
         if not self._rq:
-            r = self.transport.next_request_ex(timeout_ms=timeout_ms)
+            r = self.transport.next_request_ex2(timeout_ms=timeout_ms)
             if r is None:
                 return None
             self._rq.append((time.perf_counter(), self._mk_req(r)))
@@ -492,11 +502,14 @@ class Server:
               deadline_s: float) -> None:
         self.n_shed += 1
         try:
-            self.transport.reply(
-                req["rid"],
-                f"request shed: queued {age_s * 1e3:.0f}ms > queue "
-                f"deadline {deadline_s * 1e3:.0f}ms".encode(),
-                status=-1)
+            msg = (f"request shed: queued {age_s * 1e3:.0f}ms > queue "
+                   f"deadline {deadline_s * 1e3:.0f}ms").encode()
+            if req.get("stream"):
+                # streaming requests shed with a terminal error frame
+                self.transport.reply_chunk(req["rid"], msg, status=-1,
+                                           final=True)
+            else:
+                self.transport.reply(req["rid"], msg, status=-1)
         except Exception:  # noqa: BLE001 — client may already be gone
             pass
         try:
@@ -520,8 +533,15 @@ class Server:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
-            first = self._next_request(timeout_ms=100)
+            # while generations are in flight, poll the transport with
+            # a tiny timeout so new prefills are admitted into the
+            # running decode batch (continuous batching) instead of
+            # waiting for it to drain
+            llm_busy = self._llm is not None and self._llm.active()
+            first = self._next_request(timeout_ms=1 if llm_busy else 100)
             if first is None:
+                if llm_busy:
+                    self._llm_step()
                 continue
             group = [first]
             deadline = time.perf_counter() + self.wait_ms / 1e3
@@ -535,13 +555,34 @@ class Server:
                 if nxt is None:
                     break
                 group.append(nxt)
-            try:
-                self._serve_group(group)
-            except Exception:  # noqa: BLE001
-                # One bad batch must not kill the serving loop; members
-                # that were not yet answered time out client-side.
-                import traceback
-                traceback.print_exc()
+            for req in [r for r in group if r.get("stream")]:
+                if self._llm is None:
+                    self.transport.reply_chunk(
+                        req["rid"], b"server has no LLM engine",
+                        status=-1, final=True)
+                    self._record_span(req, status=-1,
+                                      outcome="no_engine",
+                                      reply_unix=time.time())
+                else:
+                    self._llm.admit(req)
+            plain = [r for r in group if not r.get("stream")]
+            if plain:
+                try:
+                    self._serve_group(plain)
+                except Exception:  # noqa: BLE001
+                    # One bad batch must not kill the serving loop;
+                    # members not yet answered time out client-side.
+                    import traceback
+                    traceback.print_exc()
+            if self._llm is not None and self._llm.active():
+                self._llm_step()
+
+    def _llm_step(self) -> None:
+        try:
+            self._llm.step()
+        except Exception:  # noqa: BLE001 — keep the serving loop alive
+            import traceback
+            traceback.print_exc()
 
     def _serve_group(self, group) -> None:
         # batch-assembly stamp: the dynamic-batch window for this group
@@ -551,6 +592,10 @@ class Server:
         for req in group:
             req["assembly_unix"] = t_assembly
             try:
+                if self.predictor is None:
+                    raise ValueError(
+                        "server has no predictor (LLM-only server: "
+                        "use streaming generate frames)")
                 arrs = decode_tensors(req["payload"])
                 # batching concatenates along dim 0: every tensor needs one
                 if not arrs or any(a.ndim == 0 for a in arrs):
@@ -708,6 +753,8 @@ class Server:
         self._stop.set()
         self._thread.join(timeout=5)
         self._bridge.join(timeout=5)
+        if self._llm is not None:
+            self._llm.close()
         self.transport.stop()
 
     def __enter__(self):
@@ -751,6 +798,7 @@ class Client:
     _MAGIC = 0x56535450       # 'PTSV' tensor request
     _MAGIC_CTL = 0x43535450   # 'PTSC' control frame
     _MAGIC_TRACE = 0x52535450  # 'PTSR' traced tensor request
+    _MAGIC_STREAM = 0x54535450  # 'PTST' streaming generate request
     _OP_STATS = 1
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
@@ -926,6 +974,54 @@ class Client:
                     except ValueError:
                         pass
             return out
+
+    def generate_stream(self, prompt_ids,
+                        max_new_tokens: int = 16,
+                        eos_token_id: Optional[int] = None,
+                        temperature: float = 0.0, seed: int = 0,
+                        deadline_s: Optional[float] = None,
+                        trace_id: Optional[int] = None):
+        """Streaming generate: send one 'PTST' frame, then yield each
+        token chunk (an int32 array, length 1 per chunk) as the server
+        streams it, until the terminal frame (docs/serving_protocol.md,
+        "Streaming generation"). A negative terminal status raises
+        RuntimeError with the server's message.
+
+        Deliberately NOT retried across reconnects: generation is not
+        idempotent and the server keeps decoding until its next write
+        fails, so a resend could double-generate.
+        """
+        if trace_id is None:
+            trace_id = self.make_trace_id()
+        self.last_trace_id = trace_id
+        deadline = self._deadline_of(deadline_s)
+        body = struct.pack(
+            "<IIfI", int(max_new_tokens),
+            0xFFFFFFFF if eos_token_id is None else int(eos_token_id),
+            float(temperature), int(seed))
+        body += encode_tensors(
+            [np.ascontiguousarray(prompt_ids, dtype=np.int32)])
+        with self._rcond:
+            gen = self._gen
+        tag = self._send_frame(self._MAGIC_STREAM,
+                               struct.pack("<Q", trace_id) + body)
+        while True:
+            status, payload = self._recv(tag, gen, deadline)
+            if status == 1:
+                yield decode_tensors(payload)[0]
+            elif status == 0:
+                return
+            else:
+                raise RuntimeError(
+                    f"server error: {payload.decode()!r}")
+
+    def generate(self, prompt_ids, **kw) -> np.ndarray:
+        """Blocking convenience over :meth:`generate_stream`: the
+        whole generated int32 token sequence."""
+        chunks = list(self.generate_stream(prompt_ids, **kw))
+        if not chunks:
+            return np.zeros((0,), np.int32)
+        return np.concatenate(chunks)
 
     # -- wire -------------------------------------------------------------
 

@@ -83,6 +83,21 @@ def maybe_fused_linear_xent(hidden, weight, bias, labels,
     return jnp.squeeze(loss, axis=-1)
 
 
+def maybe_paged_attention(q, k_pool, v_pool, block_tables, context_lens,
+                          scale: Optional[float] = None):
+    """Ragged paged decode attention over the serving KV block pool
+    (q [B, H, D], pools [N, block_size, H, D] — see
+    kernels/paged_attention.py). Unlike the other maybe_* entries this
+    has no separate XLA composition: off-accelerator the SAME kernel
+    runs under the Pallas interpreter, so tier-1 exercises the exact
+    production code path (the dense gather reference exists for parity
+    tests, not routing)."""
+    from .paged_attention import paged_attention
+    return paged_attention(q, k_pool, v_pool, block_tables,
+                           context_lens, scale=scale,
+                           interpret=not pallas_enabled())
+
+
 def _is_key_padding_mask(mask, batch: int, tk: int) -> bool:
     """True for exactly-shaped [B, 1, 1, Tk] masks (no broadcasting)."""
     return (getattr(mask, "ndim", 0) == 4

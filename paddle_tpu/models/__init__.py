@@ -10,6 +10,7 @@ from .mobilenet import (MobileNetV1, MobileNetV2, mobilenet_v1,  # noqa
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .transformer_seq2seq import Seq2SeqConfig, TransformerSeq2Seq  # noqa
 from .lstm_lm import LMConfig, LSTMLanguageModel  # noqa: F401
+from .gpt_lm import GPTConfig, GPTLanguageModel  # noqa: F401
 from .word2vec import NGramLM, SkipGramNCE  # noqa: F401
 from .recommender import DeepFM, RecommenderSystem  # noqa: F401
 from .gan import Discriminator, GANTrainStep, Generator  # noqa: F401

@@ -1,0 +1,167 @@
+"""GPT-style decoder-only language model with KV-cached generation.
+
+The serving model for the LLM subsystem (paddle_tpu/serving_llm):
+capability parity with the reference's GPT/ERNIE-gen decoding path
+(its fused multi_transformer decode ops and the GenerationMixin-style
+``generate()`` loop). Two deliberate design points:
+
+* ``forward_with_attn`` exposes the attention contract as a callback
+  ``attn_fn(layer_idx, q, k, v) -> context`` with q/k/v in [B, T, H,
+  Dh]. The dense path (training/eval, ``forward``) passes causal
+  softmax attention; the serving engine passes a closure that writes
+  K/V into its paged block pools and attends through the Pallas
+  ragged paged kernel — the MODEL is identical in both worlds, so
+  paged-vs-dense parity is a pure kernel test.
+* ``generate()`` is the self-contained GenerationMixin-style loop on a
+  dense concat KV cache: greedy or temperature sampling, EOS stop,
+  batch of one or many. It needs no serving machinery — the engine's
+  continuous-batching output is asserted against it in tests.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+
+__all__ = ["GPTConfig", "GPTLanguageModel", "dense_causal_attention"]
+
+# attn_fn contract: (layer_idx, q, k, v) -> context, all [B, T, H, Dh]
+AttnFn = Callable[[int, jnp.ndarray, jnp.ndarray, jnp.ndarray],
+                  jnp.ndarray]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 256
+    hidden_size: int = 128
+    num_layers: int = 2
+    num_heads: int = 4
+    intermediate_size: int = 512
+    max_position_embeddings: int = 1024
+    layer_norm_epsilon: float = 1e-5
+
+
+def dense_causal_attention(q, k, v, q_offset: int = 0):
+    """Plain causal softmax attention, [B, T, H, Dh] layout, fp32
+    math. ``q_offset``: absolute position of q's first token within
+    k/v's timeline (0 for full-sequence forward; ctx-1 for a cached
+    decode step) — query i may attend keys [0, q_offset + i]."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / jnp.sqrt(
+                       jnp.float32(d))
+    q_pos = q_offset + jnp.arange(q.shape[1])[:, None]
+    k_pos = jnp.arange(k.shape[1])[None, :]
+    s = jnp.where((k_pos <= q_pos)[None, None], s, jnp.float32(-1e30))
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, config: GPTConfig) -> None:
+        super().__init__()
+        h = config.hidden_size
+        self.ln_1 = nn.LayerNorm(h, epsilon=config.layer_norm_epsilon)
+        self.qkv = nn.Linear(h, 3 * h)
+        self.out_proj = nn.Linear(h, h)
+        self.ln_2 = nn.LayerNorm(h, epsilon=config.layer_norm_epsilon)
+        self.fc_in = nn.Linear(h, config.intermediate_size)
+        self.act = nn.GELU()
+        self.fc_out = nn.Linear(config.intermediate_size, h)
+        self._heads = config.num_heads
+        self._head_dim = h // config.num_heads
+
+    def forward(self, x, layer_idx: int, attn_fn: AttnFn):
+        b, t, h = x.shape
+        qkv = self.qkv(self.ln_1(x))
+        qkv = qkv.reshape(b, t, 3, self._heads, self._head_dim)
+        ctx = attn_fn(layer_idx, qkv[:, :, 0], qkv[:, :, 1],
+                      qkv[:, :, 2])
+        x = x + self.out_proj(ctx.reshape(b, t, h))
+        x = x + self.fc_out(self.act(self.fc_in(self.ln_2(x))))
+        return x
+
+
+class GPTLanguageModel(nn.Layer):
+    def __init__(self, config: Optional[GPTConfig] = None) -> None:
+        super().__init__()
+        self.config = cfg = config or GPTConfig()
+        if cfg.hidden_size % cfg.num_heads != 0:
+            raise ValueError("hidden_size must divide num_heads")
+        self.embed = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.pos_embed = nn.Embedding(cfg.max_position_embeddings,
+                                      cfg.hidden_size)
+        self.blocks = nn.LayerList(
+            [GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size,
+                                 epsilon=cfg.layer_norm_epsilon)
+
+    def forward_with_attn(self, ids, positions, attn_fn: AttnFn):
+        """ids [B, T] int, positions [B, T] int (absolute positions —
+        a decode step passes [ctx-1]); attention is whatever attn_fn
+        computes over the projected q/k/v. Returns logits [B, T, V]
+        (output head tied to the input embedding)."""
+        h = self.embed(ids) + self.pos_embed(positions)
+        for i, blk in enumerate(self.blocks):
+            h = blk(h, i, attn_fn)
+        h = self.ln_f(h)
+        return h @ self.embed.weight.T
+
+    def forward(self, ids):
+        """Dense causal forward: ids [B, T] -> logits [B, T, V]."""
+        b, t = ids.shape
+        pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+        return self.forward_with_attn(
+            ids, pos, lambda i, q, k, v: dense_causal_attention(q, k, v))
+
+    def generate(self, ids, max_new_tokens: int = 16,
+                 eos_token_id: Optional[int] = None,
+                 temperature: float = 0.0, seed: int = 0):
+        """GenerationMixin-style KV-cached generation on a dense
+        concat cache. ids [B, T] prompt -> [B, <=max_new_tokens] of
+        generated ids per row (stops early only when EVERY row has
+        emitted eos; per-row EOS tails are padded with eos). Greedy at
+        temperature 0, else temperature sampling from a per-call key.
+        """
+        ids = jnp.asarray(ids, jnp.int32)
+        b, t = ids.shape
+        caches: List[List[jnp.ndarray]] = [[] for _ in self.blocks]
+
+        def attn_fn(i, q, k, v):
+            if caches[i]:
+                k = jnp.concatenate([caches[i][0], k], axis=1)
+                v = jnp.concatenate([caches[i][1], v], axis=1)
+            caches[i] = [k, v]
+            return dense_causal_attention(q, k, v,
+                                          q_offset=k.shape[1]
+                                          - q.shape[1])
+
+        pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+        logits = self.forward_with_attn(ids, pos, attn_fn)[:, -1]
+        key = jax.random.PRNGKey(seed)
+        out: List[jnp.ndarray] = []
+        done = jnp.zeros((b,), bool)
+        for step in range(max_new_tokens):
+            if temperature > 0.0:
+                key, sub = jax.random.split(key)
+                nxt = jax.random.categorical(
+                    sub, logits / jnp.float32(temperature), axis=-1)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            nxt = nxt.astype(jnp.int32)
+            if eos_token_id is not None:
+                nxt = jnp.where(done, jnp.int32(eos_token_id), nxt)
+                done = done | (nxt == eos_token_id)
+            out.append(nxt)
+            if eos_token_id is not None and bool(done.all()):
+                break
+            p = jnp.full((b, 1), t + step, jnp.int32)
+            logits = self.forward_with_attn(nxt[:, None], p,
+                                            attn_fn)[:, -1]
+        return jnp.stack(out, axis=1)

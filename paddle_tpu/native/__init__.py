@@ -174,8 +174,18 @@ def _load():
                                 c.POINTER(c.c_uint64),
                                 c.POINTER(c.c_uint8), c.c_int64],
                                c.c_int64),
+            "pt_srv_next_ex2": ([c.c_int64, c.c_int,
+                                 c.POINTER(c.c_uint64),
+                                 c.POINTER(c.c_uint64),
+                                 c.POINTER(c.c_uint64),
+                                 c.POINTER(c.c_uint8),
+                                 c.POINTER(c.c_uint8), c.c_int64],
+                                c.c_int64),
             "pt_srv_reply": ([c.c_int64, c.c_uint64, c.c_int64,
                               c.POINTER(c.c_uint8), c.c_int64], c.c_int),
+            "pt_srv_reply_chunk": ([c.c_int64, c.c_uint64, c.c_int64,
+                                    c.POINTER(c.c_uint8), c.c_int64,
+                                    c.c_int], c.c_int),
             "pt_srv_pending": ([c.c_int64], c.c_int64),
             "pt_srv_stats": ([c.c_int64, c.c_char_p, c.c_int64],
                              c.c_int64),
@@ -741,6 +751,54 @@ class ServingTransport:
             return None
         return (rid.value, ctypes.string_at(self._buf, n),
                 trace.value, ingress.value / 1e6)
+
+    def next_request_ex2(self, timeout_ms: int = 100
+                         ) -> Optional[Tuple[int, bytes, int, float,
+                                             bool]]:
+        """Stream-aware dequeue: one (req_id, payload, trace_id,
+        ingress_unix_s, is_stream) or None. is_stream is True for
+        'PTST' streaming-generate frames, which must be answered with
+        reply_chunk (possibly many times) instead of reply."""
+        rid = ctypes.c_uint64(0)
+        trace = ctypes.c_uint64(0)
+        ingress = ctypes.c_uint64(0)
+        stream = ctypes.c_uint8(0)
+        n = _load().pt_srv_next_ex2(self._h, timeout_ms,
+                                    ctypes.byref(rid),
+                                    ctypes.byref(trace),
+                                    ctypes.byref(ingress),
+                                    ctypes.byref(stream),
+                                    self._buf, self._max_payload)
+        if n <= 0:
+            return None
+        return (rid.value, ctypes.string_at(self._buf, n),
+                trace.value, ingress.value / 1e6, bool(stream.value))
+
+    def reply_chunk(self, req_id: int, payload: bytes, status: int = 0,
+                    final: bool = True) -> int:
+        """Send one streaming reply chunk. Non-final chunks keep the
+        request inflight so more chunks can follow on the same tag;
+        the final chunk closes it. Returns the native rc (0 ok, -1
+        unknown id, -3 client gone — on -3 the request is closed and
+        the caller should cancel the sequence)."""
+        buf = (ctypes.c_uint8 * max(1, len(payload))).from_buffer_copy(
+            payload or b"\0")
+        rc = _load().pt_srv_reply_chunk(self._h, req_id, status, buf,
+                                        len(payload), 1 if final else 0)
+        if rc != 0:
+            from ..profiler import stat_add
+            stat_add("serving.dropped_replies")
+            stat_add("serving.reply_rc_unknown_id" if rc == -1
+                     else "serving.reply_rc_client_gone" if rc == -3
+                     else "serving.reply_rc_other")
+            try:
+                from ..observability import flight as _flight
+                _flight.record("serving_reply_dropped", force=True,
+                               req_id=int(req_id), rc=int(rc),
+                               status=int(status))
+            except Exception:  # noqa: BLE001 — telemetry must not raise
+                pass
+        return rc
 
     def reply(self, req_id: int, payload: bytes, status: int = 0) -> int:
         """Send a reply. Returns the native rc (0 ok, -1 unknown id,

@@ -1,0 +1,27 @@
+"""LLM serving subsystem: paged KV cache, continuous batching, and
+streaming token generation.
+
+Layering (each piece is independently testable):
+
+* :mod:`.kv_cache` — ``KVBlockAllocator``: fixed-size token blocks in
+  a preallocated pool, per-sequence block tables, free-list with
+  alloc/eviction accounting.
+* :mod:`.scheduler` — ``ContinuousBatchingScheduler``: FCFS admission
+  of prefills into the running decode batch, youngest-first
+  preemption (recompute-on-readmit) when the pool runs dry.
+* :mod:`.engine` — ``LLMEngine``: owns the per-layer K/V pools,
+  prefills via a dense causal forward that scatters into the pool,
+  decodes via the Pallas ragged paged attention kernel, emits token
+  events.
+* :mod:`.server` — ``LLMStreamBridge``: glues engine events to
+  ``inference.Server``'s streaming (PTST) reply frames, TTFT/TPOT
+  histograms, and the reqtrace ring.
+"""
+
+from .kv_cache import KVBlockAllocator
+from .scheduler import ContinuousBatchingScheduler, Sequence
+from .engine import LLMEngine
+from .server import LLMStreamBridge
+
+__all__ = ["KVBlockAllocator", "ContinuousBatchingScheduler",
+           "Sequence", "LLMEngine", "LLMStreamBridge"]

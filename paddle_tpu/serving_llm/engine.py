@@ -1,0 +1,254 @@
+"""LLM decode engine: paged KV pools + continuous-batching step loop.
+
+The execution half of the serving subsystem. The engine owns the
+per-layer K/V block POOLS (``[num_blocks, block_size, heads,
+head_dim]`` arrays — the layout kernels/paged_attention.py scans),
+drives the scheduler, and turns ``step()`` calls into token events:
+
+* admitted sequences are PREFILLED — one dense causal forward over
+  the prompt whose attention callback also scatters each layer's K/V
+  into the sequence's pool blocks, yielding the first sampled token
+  (the TTFT token);
+* the running set then takes ONE decode step as a single ragged
+  batch: every sequence's newest token is written into its next pool
+  slot and attention runs through the Pallas ragged paged kernel over
+  the block tables (interpret-mode on CPU — the same code path tier-1
+  tests).
+
+The model is any ``GPTLanguageModel``-shaped layer exposing
+``forward_with_attn(ids, positions, attn_fn)``; the engine never
+copies or concatenates cache tensors, so per-step cost tracks real
+context tokens, not max context.
+
+``step()`` returns plain event dicts (token / finished / error) and
+knows nothing about sockets; serving_llm/server.py turns events into
+streaming wire frames, which keeps this whole file testable without a
+server.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.gpt_lm import dense_causal_attention
+from .kv_cache import KVBlockAllocator
+from .scheduler import ContinuousBatchingScheduler, Sequence
+
+__all__ = ["LLMEngine"]
+
+
+class LLMEngine:
+    def __init__(self, model, block_size: Optional[int] = None,
+                 pool_blocks: Optional[int] = None,
+                 max_decode_batch: Optional[int] = None):
+        from ..flags import GLOBAL_FLAGS
+        cfg = model.config
+        self.model = model
+        self.block_size = int(block_size
+                              or GLOBAL_FLAGS.get("kv_block_size"))
+        self.pool_blocks = int(pool_blocks
+                               or GLOBAL_FLAGS.get("kv_pool_blocks"))
+        self.allocator = KVBlockAllocator(self.pool_blocks,
+                                          self.block_size)
+        self.scheduler = ContinuousBatchingScheduler(
+            self.allocator, max_decode_batch=max_decode_batch)
+        self._heads = cfg.num_heads
+        self._head_dim = cfg.hidden_size // cfg.num_heads
+        shape = (self.pool_blocks, self.block_size, self._heads,
+                 self._head_dim)
+        self._k_pools = [jnp.zeros(shape, jnp.float32)
+                         for _ in range(cfg.num_layers)]
+        self._v_pools = [jnp.zeros(shape, jnp.float32)
+                         for _ in range(cfg.num_layers)]
+        self._seqs: Dict[int, Sequence] = {}
+        self._next_seq = 0
+        self.tokens_generated = 0
+
+    # -- request lifecycle ------------------------------------------------
+
+    def add_request(self, prompt_ids, max_new_tokens: int = 16,
+                    eos_token_id: Optional[int] = None,
+                    temperature: float = 0.0, seed: int = 0) -> int:
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        vocab = self.model.config.vocab_size
+        if any(t < 0 or t >= vocab for t in prompt):
+            raise ValueError(f"prompt token out of range [0, {vocab})")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self._next_seq += 1
+        seq = Sequence(seq_id=self._next_seq, prompt=prompt,
+                       max_new_tokens=int(max_new_tokens),
+                       eos_token_id=eos_token_id,
+                       temperature=float(temperature), seed=int(seed))
+        self._seqs[seq.seq_id] = seq
+        self.scheduler.add(seq)
+        return seq.seq_id
+
+    def cancel(self, seq_id: int) -> bool:
+        """Drop a sequence (client disconnect): blocks freed, no
+        further events for it. True if it was live."""
+        seq = self.scheduler.cancel(seq_id)
+        self._seqs.pop(seq_id, None)
+        return seq is not None
+
+    def active(self) -> bool:
+        return self.scheduler.active()
+
+    # -- one engine step --------------------------------------------------
+
+    def step(self) -> List[Dict[str, Any]]:
+        """Admit + prefill new sequences, then one decode step for the
+        running batch. Returns token/finished/error event dicts in
+        emission order (a sequence's events are ordered; the chunk
+        stream is built from exactly this order)."""
+        events: List[Dict[str, Any]] = []
+        for seq in self.scheduler.admit():
+            try:
+                events += self._prefill(seq)
+            except Exception as e:  # noqa: BLE001 — fail ONE request
+                events.append(self._fail(seq, str(e)))
+        events += self._decode()
+        self._publish()
+        return events
+
+    # -- internals --------------------------------------------------------
+
+    def _slots(self, seq: Sequence, positions: np.ndarray):
+        """(block, offset) pool coordinates for absolute token
+        positions of one sequence."""
+        table = np.asarray(self.allocator.table(seq.seq_id), np.int32)
+        return table[positions // self.block_size], \
+            positions % self.block_size
+
+    def _prefill(self, seq: Sequence) -> List[Dict[str, Any]]:
+        if seq.dispatch_unix is None:
+            seq.dispatch_unix = time.time()
+        ids = seq.prompt + seq.generated  # re-prefill keeps generated
+        t = len(ids)
+        pos = np.arange(t, dtype=np.int32)
+        blks, offs = self._slots(seq, pos)
+
+        def attn_fn(i, q, k, v):
+            self._k_pools[i] = self._k_pools[i].at[blks, offs].set(
+                k[0].astype(jnp.float32))
+            self._v_pools[i] = self._v_pools[i].at[blks, offs].set(
+                v[0].astype(jnp.float32))
+            return dense_causal_attention(q, k, v)
+
+        logits = self.model.forward_with_attn(
+            jnp.asarray([ids], jnp.int32), jnp.asarray([pos], jnp.int32),
+            attn_fn)[0, -1]
+        seq.ctx_len = t
+        return self._emit(seq, self._sample(seq, logits))
+
+    def _decode(self) -> List[Dict[str, Any]]:
+        events: List[Dict[str, Any]] = []
+        # oldest-first growth: preemption evicts from the young end,
+        # so by the time a young sequence grows it may already be gone
+        todo = sorted((s for s in self.scheduler.running
+                       if s.ctx_len > 0 and s.generated),
+                      key=lambda s: s.admit_order)
+        batch: List[Sequence] = []
+        for seq in todo:
+            if seq not in self.scheduler.running:
+                continue  # preempted by an older sequence's growth
+            if not self.scheduler.grow(seq, seq.ctx_len + 1):
+                events.append(self._fail(
+                    seq, f"sequence needs {seq.ctx_len + 1} tokens of "
+                         f"KV cache but the pool holds "
+                         f"{self.pool_blocks * self.block_size}"))
+                continue
+            batch.append(seq)
+        batch = [s for s in batch if s in self.scheduler.running]
+        if not batch:
+            return events
+        b = len(batch)
+        feed = np.asarray([[s.generated[-1]] for s in batch], np.int32)
+        newpos = np.asarray([s.ctx_len for s in batch], np.int32)
+        slots = [self._slots(s, np.asarray([s.ctx_len]))
+                 for s in batch]
+        blks = np.asarray([s[0][0] for s in slots], np.int32)
+        offs = np.asarray([s[1][0] for s in slots], np.int32)
+        tables = [self.allocator.table(s.seq_id) for s in batch]
+        maxb = max(len(tb) for tb in tables)
+        tbl = np.zeros((b, maxb), np.int32)
+        for i, tb in enumerate(tables):
+            tbl[i, :len(tb)] = tb
+        lens = newpos + 1
+
+        def attn_fn(i, q, k, v):
+            from ..kernels import maybe_paged_attention
+            self._k_pools[i] = self._k_pools[i].at[blks, offs].set(
+                k[:, 0].astype(jnp.float32))
+            self._v_pools[i] = self._v_pools[i].at[blks, offs].set(
+                v[:, 0].astype(jnp.float32))
+            out = maybe_paged_attention(q[:, 0], self._k_pools[i],
+                                        self._v_pools[i], tbl, lens)
+            return out[:, None].astype(q.dtype)
+
+        logits = self.model.forward_with_attn(
+            jnp.asarray(feed), jnp.asarray(newpos[:, None]),
+            attn_fn)[:, -1]
+        from .. import observability as obs
+        if obs.enabled():
+            obs.histogram("llm_decode_batch_size",
+                          "sequences per continuous-batching decode "
+                          "step",
+                          buckets=[1.0, 2.0, 4.0, 8.0, 16.0, 32.0]
+                          ).observe(float(b))
+        for i, seq in enumerate(batch):
+            seq.ctx_len += 1
+            events += self._emit(seq, self._sample(seq, logits[i]))
+        return events
+
+    def _sample(self, seq: Sequence, logits) -> int:
+        if seq.temperature > 0.0:
+            key = jax.random.fold_in(jax.random.PRNGKey(seq.seed),
+                                     len(seq.generated))
+            return int(jax.random.categorical(
+                key, logits / jnp.float32(seq.temperature)))
+        return int(jnp.argmax(logits))
+
+    def _emit(self, seq: Sequence, token: int) -> List[Dict[str, Any]]:
+        idx = len(seq.generated)
+        seq.generated.append(token)
+        self.tokens_generated += 1
+        events: List[Dict[str, Any]] = [{
+            "type": "token", "seq_id": seq.seq_id, "token": token,
+            "index": idx, "dispatch_unix": seq.dispatch_unix}]
+        reason = None
+        if seq.eos_token_id is not None and token == seq.eos_token_id:
+            reason = "eos"
+        elif len(seq.generated) >= seq.max_new_tokens:
+            reason = "length"
+        if reason is not None:
+            self.scheduler.finish(seq)
+            self._seqs.pop(seq.seq_id, None)
+            events.append({"type": "finished", "seq_id": seq.seq_id,
+                           "reason": reason,
+                           "tokens": len(seq.generated)})
+        return events
+
+    def _fail(self, seq: Sequence, error: str) -> Dict[str, Any]:
+        self.scheduler.finish(seq)
+        self._seqs.pop(seq.seq_id, None)
+        return {"type": "error", "seq_id": seq.seq_id, "error": error,
+                "tokens": len(seq.generated)}
+
+    def _publish(self) -> None:
+        from .. import observability as obs
+        if not obs.enabled():
+            return
+        obs.gauge("llm_running_seqs",
+                  "sequences in the continuous-batching running set"
+                  ).set(float(len(self.scheduler.running)))
+        obs.gauge("llm_waiting_seqs",
+                  "sequences queued for admission (prefill pending)"
+                  ).set(float(len(self.scheduler.waiting)))

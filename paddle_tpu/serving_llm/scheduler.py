@@ -1,0 +1,157 @@
+"""Continuous-batching scheduler for the LLM serving engine.
+
+Sequences JOIN and LEAVE the decode batch every step instead of
+waiting for a static batch to drain (the reference's serving stack
+behavior this rebuilds; PAPERS.md arxiv 2605.25645 describes the
+fleet-scale lifecycle on TPU). Policy, deliberately simple and fully
+tested:
+
+* **Admission** is FCFS off the waiting queue: a prefill is admitted
+  when the running set is below ``FLAGS_max_decode_batch`` AND the
+  paged allocator can cover its whole prompt (plus any tokens
+  generated before a preemption). A short prompt arriving mid-decode
+  of a long one is therefore in the batch on the very next step —
+  the interleaving property the tests assert.
+* **Growth** happens one token per decode step. When the pool is
+  exhausted the scheduler preempts the YOUNGEST running sequence
+  (LIFO): its blocks are freed and it returns to the FRONT of the
+  waiting queue to be re-prefilled later (recompute-on-readmit, the
+  vLLM recovery model — generated tokens are kept, only the cache is
+  recomputed). Oldest work is protected, so progress is monotone and
+  a sequence that fits alone can never starve.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional
+
+from .kv_cache import KVBlockAllocator
+
+__all__ = ["Sequence", "ContinuousBatchingScheduler"]
+
+
+@dataclass
+class Sequence:
+    """One generate request's decoding state. ``prompt`` is the token
+    id list; ``generated`` accumulates sampled ids (kept across
+    preemptions); ``ctx_len`` counts tokens whose K/V currently sit in
+    the pool (0 while waiting)."""
+    seq_id: int
+    prompt: List[int]
+    max_new_tokens: int = 16
+    eos_token_id: Optional[int] = None
+    temperature: float = 0.0
+    seed: int = 0
+    generated: List[int] = field(default_factory=list)
+    ctx_len: int = 0
+    admit_order: int = -1   # admission stamp; youngest = max
+    preemptions: int = 0
+    dispatch_unix: Optional[float] = None  # first prefill wall time
+
+    @property
+    def cached_tokens(self) -> int:
+        """Tokens a (re-)prefill must write: prompt plus everything
+        generated before a preemption reset the cache."""
+        return len(self.prompt) + len(self.generated)
+
+
+class ContinuousBatchingScheduler:
+    def __init__(self, allocator: KVBlockAllocator,
+                 max_decode_batch: Optional[int] = None):
+        self.allocator = allocator
+        self._max_decode_batch = max_decode_batch
+        self.waiting: Deque[Sequence] = deque()
+        self.running: List[Sequence] = []
+        self._admit_n = 0
+        self.preemptions_total = 0
+
+    def max_decode_batch(self) -> int:
+        if self._max_decode_batch is not None:
+            return int(self._max_decode_batch)
+        from ..flags import GLOBAL_FLAGS
+        return max(1, int(GLOBAL_FLAGS.get("max_decode_batch")))
+
+    # -- lifecycle --------------------------------------------------------
+
+    def add(self, seq: Sequence) -> None:
+        self.waiting.append(seq)
+
+    def admit(self) -> List[Sequence]:
+        """FCFS admission pass: move waiting sequences into the
+        running set while there is batch room and the pool covers
+        their prefill (+1 headroom is NOT reserved — growth is handled
+        per-step with preemption as the backstop). Returns the newly
+        admitted sequences, which the engine must prefill."""
+        admitted: List[Sequence] = []
+        cap = self.max_decode_batch()
+        while self.waiting and len(self.running) < cap:
+            seq = self.waiting[0]
+            if not self.allocator.allocate(seq.seq_id,
+                                           seq.cached_tokens):
+                break  # FCFS: never skip the queue head
+            self.waiting.popleft()
+            seq.ctx_len = 0  # prefill pending
+            self._admit_n += 1
+            seq.admit_order = self._admit_n
+            self.running.append(seq)
+            admitted.append(seq)
+        return admitted
+
+    def grow(self, seq: Sequence, n_tokens: int) -> bool:
+        """Extend ``seq``'s cache to ``n_tokens`` slots, preempting
+        YOUNGER running sequences one at a time if the pool is short.
+        False only when the pool cannot cover it even with ``seq``
+        alone (caller should fail the request: it can never fit)."""
+        while True:
+            if self.allocator.extend_to(seq.seq_id, n_tokens):
+                return True
+            victim = self._youngest(exclude=seq)
+            if victim is None:
+                return False
+            self.preempt(victim)
+
+    def _youngest(self, exclude: Sequence) -> Optional[Sequence]:
+        cands = [s for s in self.running if s is not exclude]
+        return max(cands, key=lambda s: s.admit_order) if cands else None
+
+    def preempt(self, seq: Sequence) -> None:
+        """Evict ``seq`` from the running set back to the FRONT of the
+        waiting queue: blocks freed, generated tokens kept, cache
+        recomputed at readmission."""
+        self.allocator.free(seq.seq_id)
+        self.running.remove(seq)
+        seq.ctx_len = 0
+        seq.preemptions += 1
+        self.preemptions_total += 1
+        self.waiting.appendleft(seq)
+        from .. import observability as obs
+        if obs.enabled():
+            obs.counter("kv_blocks_preempted_total",
+                        "running sequences preempted back to the "
+                        "waiting queue because the KV pool was "
+                        "exhausted (recompute-on-readmit)").inc()
+
+    def finish(self, seq: Sequence) -> None:
+        self.allocator.free(seq.seq_id)
+        if seq in self.running:
+            self.running.remove(seq)
+
+    def cancel(self, seq_id: int) -> Optional[Sequence]:
+        """Remove a sequence wherever it lives (client disconnect).
+        Frees its blocks; returns the sequence or None if unknown."""
+        for seq in list(self.running):
+            if seq.seq_id == seq_id:
+                self.allocator.free(seq_id)
+                self.running.remove(seq)
+                return seq
+        for seq in list(self.waiting):
+            if seq.seq_id == seq_id:
+                self.allocator.free(seq_id)  # no-op: waiting holds none
+                self.waiting.remove(seq)
+                return seq
+        return None
+
+    def active(self) -> bool:
+        return bool(self.waiting or self.running)

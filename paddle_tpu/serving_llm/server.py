@@ -1,0 +1,222 @@
+"""Bridge between LLMEngine events and the streaming serving wire.
+
+``inference.Server`` hands every 'PTST' streaming-generate request to
+an :class:`LLMStreamBridge`, which owns the request's serving-side
+lifecycle:
+
+* ``admit`` parses the generate body (``<IIfI`` header —
+  max_new_tokens, eos id with ``0xFFFFFFFF`` meaning none,
+  temperature, seed — followed by one int32 prompt tensor in the
+  standard tensor codec; docs/serving_protocol.md "Streaming
+  generation") and registers the sequence with the engine;
+* ``step`` runs one engine step and turns its token events into
+  status-1 reply chunks on the request's tag, the finish event into
+  the terminal status-0 frame, and a failed chunk write (client gone)
+  into an engine ``cancel`` that frees the sequence's KV blocks —
+  the property the disconnect chaos drill asserts;
+* every token is stamped into the request's span record; at terminal
+  time the record (5 reqtrace stamps + ``token_unix`` list + TTFT /
+  mean-TPOT) enters the /requests ring, and ``serving_ttft_ms`` /
+  ``serving_tpot_ms`` histograms are observed per token.
+
+Only the serving thread calls into a bridge, mirroring the engine's
+single-owner contract.
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .engine import LLMEngine
+
+__all__ = ["LLMStreamBridge", "GENERATE_HEADER", "EOS_NONE"]
+
+# body header after the u64 trace id: max_new_tokens, eos_token_id
+# (EOS_NONE = no eos), temperature, seed — then the tensor codec
+GENERATE_HEADER = "<IIfI"
+EOS_NONE = 0xFFFFFFFF
+
+
+class LLMStreamBridge:
+    def __init__(self, server, engine: LLMEngine):
+        self.server = server
+        self.engine = engine
+        self._reqs: Dict[int, Dict[str, Any]] = {}  # seq_id -> req span
+
+    def active(self) -> bool:
+        return self.engine.active()
+
+    # -- request intake ---------------------------------------------------
+
+    def admit(self, req: Dict[str, Any]) -> None:
+        """Parse one streaming-generate request and hand it to the
+        engine. Malformed bodies are answered immediately with a
+        terminal error frame; nothing enters the scheduler."""
+        from ..inference import decode_tensors
+        req["assembly_unix"] = time.time()
+        req["token_unix"] = []
+        try:
+            buf = req["payload"]
+            hdr = struct.calcsize(GENERATE_HEADER)
+            if len(buf) < hdr:
+                raise ValueError("generate body shorter than header")
+            max_new, eos_raw, temperature, seed = struct.unpack_from(
+                GENERATE_HEADER, buf, 0)
+            arrs = decode_tensors(buf[hdr:])
+            if len(arrs) != 1 or arrs[0].ndim != 1 \
+                    or arrs[0].dtype != np.int32:
+                raise ValueError(
+                    "generate body must carry exactly one int32 [T] "
+                    "prompt tensor")
+            seq_id = self.engine.add_request(
+                arrs[0], max_new_tokens=max_new,
+                eos_token_id=None if eos_raw == EOS_NONE else int(eos_raw),
+                temperature=temperature, seed=seed)
+        except Exception as e:  # noqa: BLE001 — fail ONE request
+            self.server.transport.reply_chunk(
+                req["rid"], str(e).encode(), status=-1, final=True)
+            self._record(req, status=-1, outcome="decode_error",
+                         error=str(e)[:200])
+            return
+        self._reqs[seq_id] = req
+        from .. import observability as obs
+        if obs.enabled():
+            obs.counter("serving_stream_requests_total",
+                        "streaming generate (PTST) requests admitted "
+                        "to the LLM engine").inc()
+
+    # -- one serving step -------------------------------------------------
+
+    def step(self) -> None:
+        """One engine step; fan its events out to the wire."""
+        from ..inference import encode_tensors
+        for ev in self.engine.step():
+            req = self._reqs.get(ev["seq_id"])
+            if req is None:
+                continue  # cancelled earlier this step
+            if ev["type"] == "token":
+                req.setdefault("dispatch_unix", ev["dispatch_unix"])
+                now = time.time()
+                rc = self.server.transport.reply_chunk(
+                    req["rid"],
+                    encode_tensors([np.asarray([ev["token"]],
+                                               np.int32)]),
+                    status=1, final=False)
+                if rc != 0:
+                    self._cancel(ev["seq_id"], req, now)
+                    continue
+                self._note_token(req, now)
+            elif ev["type"] == "finished":
+                self.server.transport.reply_chunk(
+                    req["rid"], b"", status=0, final=True)
+                del self._reqs[ev["seq_id"]]
+                self._record(req, status=0, outcome="ok",
+                             reason=ev["reason"])
+            elif ev["type"] == "error":
+                self.server.transport.reply_chunk(
+                    req["rid"], ev["error"].encode(), status=-1,
+                    final=True)
+                del self._reqs[ev["seq_id"]]
+                self._record(req, status=-1, outcome="execute_error",
+                             error=ev["error"][:200])
+
+    def _note_token(self, req: Dict[str, Any], now: float) -> None:
+        stamps: List[float] = req["token_unix"]
+        from .. import observability as obs
+        if obs.enabled():
+            from ..observability import metrics as _m
+            obs.counter("serving_stream_tokens_total",
+                        "tokens streamed to clients as status-1 "
+                        "chunks").inc()
+            if not stamps and req.get("ingress_unix") is not None:
+                obs.histogram(
+                    "serving_ttft_ms",
+                    "time to first token: request ingress to first "
+                    "streamed chunk",
+                    buckets=_m.LATENCY_MS_BUCKETS).observe(
+                        max(0.0, (now - req["ingress_unix"]) * 1e3))
+            elif stamps:
+                obs.histogram(
+                    "serving_tpot_ms",
+                    "time per output token: gap between consecutive "
+                    "streamed chunks of one request",
+                    buckets=_m.LATENCY_MS_BUCKETS).observe(
+                        max(0.0, (now - stamps[-1]) * 1e3))
+        stamps.append(now)
+
+    def _cancel(self, seq_id: int, req: Dict[str, Any],
+                now: float) -> None:
+        """Chunk write failed (client gone): drop the sequence so its
+        KV blocks return to the pool. NOT a shed — the request was
+        being served; requests_shed_total stays untouched."""
+        self.engine.cancel(seq_id)
+        self._reqs.pop(seq_id, None)
+        from ..observability import flight as _flight
+        _flight.record("serving_stream_cancelled", force=True,
+                       trace_id=req.get("trace_id"), seq_id=seq_id,
+                       tokens_streamed=len(req["token_unix"]))
+        from .. import observability as obs
+        if obs.enabled():
+            obs.counter("serving_stream_cancelled_total",
+                        "streaming requests cancelled mid-generation "
+                        "because the client connection died (KV "
+                        "blocks freed)").inc()
+        self._record(req, status=-3, outcome="cancelled",
+                     reply_unix=now)
+
+    def close(self) -> None:
+        """Server stop: cancel everything still streaming."""
+        for seq_id, req in list(self._reqs.items()):
+            self.engine.cancel(seq_id)
+            self.server.transport.reply_chunk(
+                req["rid"], b"server stopping", status=-1, final=True)
+            self._record(req, status=-1, outcome="server_stop")
+        self._reqs.clear()
+
+    # -- span records -----------------------------------------------------
+
+    def _record(self, req: Dict[str, Any], status: int, outcome: str,
+                reply_unix: Optional[float] = None,
+                reason: Optional[str] = None,
+                error: Optional[str] = None) -> None:
+        """Terminal span record for one streaming request: the 5
+        reqtrace stamps plus the per-token timeline and derived
+        TTFT / mean-TPOT. Never raises."""
+        from .. import observability as obs
+        if not obs.enabled():
+            return
+        try:
+            from ..observability import reqtrace as _reqtrace
+            toks: List[float] = req.get("token_unix") or []
+            rec = {"trace_id": req.get("trace_id") or 0,
+                   "req_id": req.get("rid"),
+                   "status": status, "outcome": outcome,
+                   "stream": True,
+                   "ingress_unix": req.get("ingress_unix"),
+                   "dequeue_unix": req.get("dequeue_unix"),
+                   "assembly_unix": req.get("assembly_unix"),
+                   "dispatch_unix": req.get("dispatch_unix"),
+                   "reply_unix": reply_unix
+                   if reply_unix is not None else time.time(),
+                   "token_unix": list(toks),
+                   "tokens": len(toks)}
+            if reason is not None:
+                rec["finish_reason"] = reason
+            if error is not None:
+                rec["error"] = error
+            ing = rec["ingress_unix"]
+            if toks and ing is not None:
+                rec["ttft_ms"] = max(0.0, (toks[0] - ing) * 1e3)
+            if len(toks) > 1:
+                rec["tpot_ms"] = (toks[-1] - toks[0]) * 1e3 \
+                    / (len(toks) - 1)
+            if ing is not None:
+                rec["e2e_ms"] = max(0.0,
+                                    (rec["reply_unix"] - ing) * 1e3)
+            _reqtrace.record(rec)
+        except Exception:  # noqa: BLE001 — never fail a reply on spans
+            pass

@@ -91,3 +91,10 @@ def test_bert_pretraining_sharded():
     r = bert_pretraining.main(steps=4, batch=8, sharded=True,
                               verbose=False)
     assert r["last_loss"] < r["first_loss"]
+
+
+def test_llm_serving():
+    import llm_serving
+    r = llm_serving.main(n_clients=3, max_new_tokens=3, verbose=False)
+    assert r["ok"] and r["tokens"] == 9
+    assert r["ttft_p50_ms"] > 0 and r["tokens_per_s"] > 0

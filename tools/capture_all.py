@@ -78,6 +78,10 @@ STAGES = {
                           "PT_BENCH_FUSED": "1"}, 1200),
     "flash": (["flash"], _SKIP, 1800),
     "flash_train": (["flash_train"], _SKIP, 1800),
+    # LLM serving decode path: paged-KV continuous batching vs dense
+    # sequential generation (tokens/s + TTFT p50/p99); small model,
+    # bounded token count — cheap enough for every campaign
+    "llm_decode": (["llm_decode"], _SKIP, 600),
     # tile-size sweep for the flash kernel (only worth chip time if the
     # default-tile flash_train stage loses to XLA)
     "flash_train_t128": (["flash_train"],

@@ -319,6 +319,88 @@ def drill_nonfinite_skip(tmp):
             "in-graph, weights finite, fit completed")
 
 
+_STREAM_DISCONNECT = r"""
+import json, socket, sys, time
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu.inference import Client, Server
+from paddle_tpu.models import GPTLanguageModel
+from paddle_tpu.serving_llm import LLMEngine
+
+out = sys.argv[1]
+model = GPTLanguageModel()
+engine = LLMEngine(model, block_size=4, pool_blocks=32)
+srv = Server(None, llm_engine=engine)
+cli = Client(port=srv.port, timeout_s=60.0)
+# ask for far more tokens than we will read, then vanish mid-stream
+gen = cli.generate_stream([7] * 9, max_new_tokens=200)
+got = [int(next(gen)[0]) for _ in range(2)]
+used_mid = engine.allocator.num_used
+cli._sock.close()                     # abrupt close, no goodbye frame
+# server notices on its next chunk write (rc=-3) and cancels the
+# sequence; give it a bounded window to quiesce
+deadline = time.time() + 60
+while engine.active() and time.time() < deadline:
+    time.sleep(0.1)
+leak_check = True
+try:
+    engine.allocator.check()
+except AssertionError:
+    leak_check = False
+res = {
+    "tokens_read": len(got),
+    "used_mid_stream": used_mid,
+    "active_after": engine.active(),
+    "kv_used_after": engine.allocator.num_used,
+    "kv_used_gauge": obs.gauge("kv_blocks_used").value(),
+    "kv_freed_total": engine.allocator.freed_total,
+    "allocator_check_ok": leak_check,
+    "cancelled_total": obs.counter(
+        "serving_stream_cancelled_total").value(),
+    "shed_total": obs.counter("requests_shed_total").value(),
+    "flight_cancel_events": sum(
+        1 for e in obs.flight.recorder().events()
+        if e.get("kind") == "serving_stream_cancelled"),
+}
+srv.stop()
+json.dump(res, open(out, "w"))
+"""
+
+
+def drill_stream_disconnect(tmp):
+    """Streaming client vanishes mid-generation: the serving loop must
+    cancel the sequence and return every KV block to the pool — no
+    leak, and the disconnect is a *cancel*, never a *shed*."""
+    script = os.path.join(tmp, "stream_disconnect.py")
+    with open(script, "w") as f:
+        f.write(_STREAM_DISCONNECT)
+    out = os.path.join(tmp, "stream_disconnect.json")
+    proc = subprocess.run(
+        [sys.executable, script, out], env=_env(tmp),
+        capture_output=True, text=True, timeout=240)
+    _check(proc.returncode == 0,
+           f"stream-disconnect run died rc={proc.returncode}\n"
+           f"{proc.stderr}")
+    res = json.load(open(out))
+    _check(res["tokens_read"] == 2 and res["used_mid_stream"] > 0,
+           f"stream never got going: {res}")
+    _check(not res["active_after"],
+           f"engine still active after disconnect: {res}")
+    _check(res["kv_used_after"] == 0 and res["kv_used_gauge"] == 0.0,
+           f"KV blocks leaked after disconnect: {res}")
+    _check(res["allocator_check_ok"],
+           f"allocator invariant audit failed: {res}")
+    _check(res["cancelled_total"] >= 1,
+           f"serving_stream_cancelled_total not counted: {res}")
+    _check(res["flight_cancel_events"] >= 1,
+           f"no serving_stream_cancelled flight event: {res}")
+    _check(res["shed_total"] == 0,
+           f"disconnect was miscounted as a shed: {res}")
+    return (f"client vanished after {res['tokens_read']} tokens; "
+            f"{res['kv_freed_total']} KV blocks freed, pool clean, "
+            f"cancel counted (sheds untouched)")
+
+
 def drill_exact_resume(tmp):
     """SIGKILL mid-epoch + v3 resume == uninterrupted run, bitwise."""
     try:
@@ -338,6 +420,7 @@ DRILLS = {
     "crash_loop": drill_crash_loop,
     "nonfinite_skip": drill_nonfinite_skip,
     "exact_resume": drill_exact_resume,
+    "stream_disconnect": drill_stream_disconnect,
 }
 
 
