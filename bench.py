@@ -924,6 +924,108 @@ def bench_llm_decode(on_accel: bool) -> None:
     })
 
 
+def bench_llm_overload(on_accel: bool) -> None:
+    """LLM serving under overload: a stream flood whose projected KV
+    demand is 2x the pool, against the admission watermark
+    (FLAGS_kv_admission_watermark=1.0). Overflow is refused at
+    admission with a retry hint instead of entering preemption
+    thrash; reports the reject rate and p99 TTFT of the streams that
+    were admitted, and asserts the pool drains to zero — overload
+    must never leak KV blocks."""
+    import threading
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.inference import Client, Server
+    from paddle_tpu.models import GPTLanguageModel
+    from paddle_tpu.serving_llm import LLMEngine
+
+    model = GPTLanguageModel()
+    rng = np.random.default_rng(0)
+    n_req, max_new, block_size = (16, 32, 16) if on_accel \
+        else (12, 8, 16)
+    blocks_per_req = -(-(8 + max_new) // block_size)
+    # pool sized for half the flood's projected demand
+    pool_blocks = n_req * blocks_per_req // 2
+    prompts = [rng.integers(0, model.config.vocab_size,
+                            size=8).astype(np.int32)
+               for _ in range(n_req)]
+
+    pt.set_flags({"kv_admission_watermark": 1.0})
+    engine = LLMEngine(model, block_size=block_size,
+                       pool_blocks=pool_blocks)
+    srv = Server(None, llm_engine=engine)
+    results = []
+    lock = threading.Lock()
+
+    def worker(p):
+        cli = Client(port=srv.port, timeout_s=300.0)
+        t0 = time.perf_counter()
+        try:
+            gen = cli.generate_stream(p, max_new_tokens=max_new)
+            next(gen)
+            ttft = (time.perf_counter() - t0) * 1e3
+            n = 1 + sum(1 for _ in gen)
+            with lock:
+                results.append(("ok", ttft, n))
+        except RuntimeError as e:
+            with lock:
+                results.append(("rejected", None,
+                                "retry_after_ms=" in str(e)))
+        finally:
+            cli.close()
+
+    try:
+        # warm the compile caches outside the timed flood
+        wcli = Client(port=srv.port, timeout_s=300.0)
+        wcli.generate(prompts[0], max_new_tokens=2)
+        wcli.close()
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker, args=(p,))
+                   for p in prompts]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        flood_s = time.perf_counter() - t0
+    finally:
+        srv.stop()
+        pt.set_flags({"kv_admission_watermark": 0.0})
+
+    served = [r for r in results if r[0] == "ok"]
+    rejected = [r for r in results if r[0] == "rejected"]
+    assert len(served) + len(rejected) == n_req, results
+    assert served, "overload flood starved every request"
+    assert all(r[2] == max_new for r in served), \
+        "admitted stream truncated"
+    assert all(r[2] for r in rejected), "rejection lacked retry hint"
+    # the zero-leak contract: however the flood resolved, the pool
+    # comes back empty and internally consistent
+    assert engine.allocator.num_used == 0
+    engine.allocator.check()
+
+    ttfts = sorted(r[1] for r in served)
+    p99 = ttfts[min(len(ttfts) - 1,
+                    int(round(0.99 * (len(ttfts) - 1))))]
+    reject_rate = len(rejected) / n_req
+    log(f"{n_req}-stream flood vs pool for {n_req // 2}: "
+        f"{len(served)} served, {len(rejected)} refused at admission "
+        f"({reject_rate:.0%}) in {flood_s:.2f}s; admitted ttft "
+        f"p99={p99:.0f}ms; pool drained to 0")
+    emit({
+        "metric": f"llm overload admitted TTFT p99 "
+                  f"({n_req}-stream flood, 2x pool demand)",
+        "value": round(p99, 1),
+        "unit": "ms",
+        "reject_rate": round(reject_rate, 3),
+        "served": len(served),
+        "rejected": len(rejected),
+        "flood_s": round(flood_s, 2),
+    })
+
+
 def bench_flash_train(on_accel: bool) -> None:
     """Training-mode flash crossover: fwd+bwd at BERT geometry (head
     dim 64, attention dropout 0.1) — the numbers that set
@@ -1153,6 +1255,8 @@ def main() -> None:
         bench_flash_train(on_accel)
     elif which == "llm_decode":
         bench_llm_decode(on_accel)
+    elif which == "llm_overload":
+        bench_llm_overload(on_accel)
     else:
         bench_bert(on_accel)
 

@@ -481,6 +481,35 @@ define_flag("max_decode_batch", 8,
             "waiting prefills only while the running set is below "
             "this AND the pool has blocks for the prompt. Read every "
             "scheduler step, so it can be retuned on a live server.")
+define_flag("kv_admission_watermark", 0.0,
+            "LLM serving overload control: admission-time KV "
+            "watermark as a fraction of kv_pool_blocks. A new "
+            "sequence is rejected at add_request when the projected "
+            "peak block demand of all live sequences plus its own "
+            "(blocks for prompt + max_new_tokens) would exceed "
+            "watermark * pool — fail-fast with a retry-after hint "
+            "instead of admit-then-preempt-thrash. Rejections are "
+            "counted in llm_admission_rejected_total. 0 (default) "
+            "disables the gate; admitted load can then exceed the "
+            "pool and is handled by preemption.")
+define_flag("serving_drain_deadline_s", 5.0,
+            "Graceful drain budget for inference.Server. When a "
+            "drain starts (SIGTERM under Server.serve_forever, or "
+            "Server.drain()), new requests are refused immediately "
+            "(tensor requests error-replied, streams shed with a "
+            "terminal frame) and in-flight generations may keep "
+            "decoding for up to this many seconds; sequences still "
+            "running at the deadline are cancelled with a terminal "
+            "negative-status frame so no client is left hanging.")
+define_flag("llm_stall_factor", 10.0,
+            "LLM engine stall watchdog: an engine step (or the gap "
+            "since the last step while sequences are active) longer "
+            "than this factor times the EWMA step time marks the "
+            "engine stalled — a forced llm_engine_stalled flight "
+            "event plus llm_engine_stalled_total, and /healthz "
+            "reports the serving section unhealthy (HTTP 503). A "
+            "floor of 0.5s avoids flapping on scheduler jitter. 0 "
+            "disables the watchdog.")
 
 
 def _fault_spec_changed(value) -> None:

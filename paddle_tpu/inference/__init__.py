@@ -390,6 +390,13 @@ class Server:
                                           max_payload=max_payload)
         self.port = self.transport.port
         self._stop = threading.Event()
+        # graceful-drain lifecycle (docs/fault_tolerance.md, "LLM
+        # serving lifecycle"): once draining, new work is refused and
+        # in-flight generations get up to the drain deadline to finish
+        self._draining = False
+        self._drain_deadline_pc: Optional[float] = None
+        self._drained = threading.Event()
+        self.n_drain_rejected = 0
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self.n_batches = 0
         self.n_requests = 0
@@ -527,12 +534,17 @@ class Server:
             obs.counter("requests_shed_total",
                         "requests answered with an error because they "
                         "sat in the serving queue longer than the "
-                        "queue deadline").inc()
+                        "queue deadline (kind=stream for PTST "
+                        "generates, kind=tensor otherwise)").inc(
+                kind="stream" if req.get("stream") else "tensor")
             self._record_span(req, status=-1, outcome="shed",
                               reply_unix=time.time())
 
     def _loop(self) -> None:
         while not self._stop.is_set():
+            if self._draining:
+                self._drain_tick()
+                continue
             # while generations are in flight, poll the transport with
             # a tiny timeout so new prefills are admitted into the
             # running decode batch (continuous batching) instead of
@@ -583,6 +595,112 @@ class Server:
         except Exception:  # noqa: BLE001 — keep the serving loop alive
             import traceback
             traceback.print_exc()
+
+    # -- graceful drain ---------------------------------------------------
+
+    def drain(self, deadline_s: Optional[float] = None,
+              wait: bool = True) -> None:
+        """Begin a graceful drain: refuse every request that arrives
+        from now on (tensor requests error-replied, streams shed with
+        a terminal frame), let in-flight generations keep decoding for
+        up to ``deadline_s`` (default
+        ``FLAGS_serving_drain_deadline_s``), then cancel the rest with
+        terminal negative-status frames. With ``wait`` (default) the
+        call blocks until the drain completes. Idempotent."""
+        if deadline_s is None:
+            try:
+                from ..flags import GLOBAL_FLAGS
+                deadline_s = float(
+                    GLOBAL_FLAGS.get("serving_drain_deadline_s"))
+            except Exception:  # noqa: BLE001
+                deadline_s = 5.0
+        deadline_s = max(0.0, float(deadline_s))
+        if not self._draining:
+            self._drain_deadline_pc = time.perf_counter() + deadline_s
+            self._draining = True
+            from ..observability import flight as _flight
+            _flight.record("serving_drain_begin", force=True,
+                           deadline_s=deadline_s,
+                           llm_active=self._llm is not None
+                           and self._llm.active())
+        if wait:
+            self._drained.wait(deadline_s + 30.0)
+
+    def _reject_draining(self, req: Dict[str, Any]) -> None:
+        """Refuse one request that arrived during a drain."""
+        self.n_drain_rejected += 1
+        msg = b"server draining: not accepting new requests"
+        try:
+            if req.get("stream"):
+                self.transport.reply_chunk(req["rid"], msg, status=-1,
+                                           final=True)
+            else:
+                self.transport.reply(req["rid"], msg, status=-1)
+        except Exception:  # noqa: BLE001 — client may already be gone
+            pass
+        from .. import observability as obs
+        if obs.enabled():
+            obs.counter("requests_shed_total",
+                        "requests answered with an error because they "
+                        "sat in the serving queue longer than the "
+                        "queue deadline (kind=stream for PTST "
+                        "generates, kind=tensor otherwise)").inc(
+                kind="stream" if req.get("stream") else "tensor")
+            self._record_span(req, status=-1, outcome="draining",
+                              reply_unix=time.time())
+
+    def _drain_tick(self) -> None:
+        """One serving-loop pass while draining: refuse new arrivals,
+        step in-flight generations until they finish or the deadline
+        expires, then sweep the stragglers with terminal frames and
+        mark the drain complete."""
+        self._drain_transport()
+        while self._rq:
+            _, req = self._rq.popleft()
+            self._reject_draining(req)
+        llm_busy = self._llm is not None and self._llm.active()
+        if llm_busy:
+            if time.perf_counter() < (self._drain_deadline_pc or 0):
+                self._llm_step()
+                return
+            # deadline expired: every still-open stream gets a
+            # terminal frame and its KV blocks go back to the pool
+            self._llm.close(
+                message=b"server draining: drain deadline exceeded",
+                outcome="drain_deadline")
+        if not self._drained.is_set():
+            self._drained.set()
+            from ..observability import flight as _flight
+            _flight.record("serving_drain_complete", force=True,
+                           rejected=self.n_drain_rejected,
+                           deadline_expired=llm_busy)
+        self._stop.wait(0.02)  # idle: keep refusing stragglers
+
+    def serve_forever(self, drain_deadline_s: Optional[float] = None,
+                      on_drained=None) -> None:
+        """Block the calling thread (normally the main thread) until
+        the process is asked to stop, draining gracefully on SIGTERM:
+        stop admitting, finish in-flight generations up to the drain
+        deadline, terminal-frame the rest, then re-deliver the signal
+        (PreemptionGuard contract) so the exit status stays honest.
+        ``on_drained`` runs after the drain completes and before the
+        transport stops — drills use it to snapshot server state.
+        Returns normally only if ``stop()`` was called elsewhere."""
+        from .. import preemption
+        with preemption.guard() as g:
+            while not g.preempted and not self._stop.is_set():
+                time.sleep(0.05)
+            if not g.preempted:
+                return
+            self.drain(deadline_s=drain_deadline_s, wait=True)
+            if on_drained is not None:
+                try:
+                    on_drained(self)
+                except Exception:  # noqa: BLE001
+                    import traceback
+                    traceback.print_exc()
+            self.stop()
+            g.reraise()
 
     def _serve_group(self, group) -> None:
         # batch-assembly stamp: the dynamic-batch window for this group
@@ -987,14 +1105,22 @@ class Client:
         "Streaming generation"). A negative terminal status raises
         RuntimeError with the server's message.
 
+        ``deadline_s`` is a PER-CHUNK deadline: the clock restarts on
+        every frame, so a long generation streams indefinitely while a
+        stream that goes SILENT past the deadline raises TimeoutError
+        and poisons the connection (stream position unknowable —
+        mirroring ``infer``'s mid-frame semantics; the next call
+        reconnects).
+
         Deliberately NOT retried across reconnects: generation is not
         idempotent and the server keeps decoding until its next write
-        fails, so a resend could double-generate.
+        fails, so a resend could double-generate. (``generate`` allows
+        exactly one retry iff zero chunks arrived.)
         """
         if trace_id is None:
             trace_id = self.make_trace_id()
         self.last_trace_id = trace_id
-        deadline = self._deadline_of(deadline_s)
+        eff = deadline_s if deadline_s is not None else self._deadline_s
         body = struct.pack(
             "<IIfI", int(max_new_tokens),
             0xFFFFFFFF if eos_token_id is None else int(eos_token_id),
@@ -1006,7 +1132,15 @@ class Client:
         tag = self._send_frame(self._MAGIC_STREAM,
                                struct.pack("<Q", trace_id) + body)
         while True:
-            status, payload = self._recv(tag, gen, deadline)
+            deadline = None if eff is None \
+                else time.monotonic() + float(eff)
+            try:
+                status, payload = self._recv(tag, gen, deadline)
+            except TimeoutError:
+                # silent stream: the server may still write chunks for
+                # this tag later, so the connection is unusable
+                self._poison(gen)
+                raise
             if status == 1:
                 yield decode_tensors(payload)[0]
             elif status == 0:
@@ -1015,10 +1149,42 @@ class Client:
                 raise RuntimeError(
                     f"server error: {payload.decode()!r}")
 
-    def generate(self, prompt_ids, **kw) -> np.ndarray:
+    def generate(self, prompt_ids, retry: bool = True,
+                 **kw) -> np.ndarray:
         """Blocking convenience over :meth:`generate_stream`: the
-        whole generated int32 token sequence."""
-        chunks = list(self.generate_stream(prompt_ids, **kw))
+        whole generated int32 token sequence.
+
+        Allows ONE retry when the stream dies (timeout / connection
+        loss) before the first chunk arrived: with zero chunks
+        received the request is still idempotent client-side, and the
+        poisoned connection guarantees the server's next write for the
+        abandoned attempt fails, cancelling its sequence. After the
+        first chunk a retry could double-generate, so the error is
+        surfaced instead."""
+        chunks: List[np.ndarray] = []
+
+        def attempt():
+            # a known-dead socket is repaired first: nothing was sent,
+            # so this never consumes the retry (like infer's resend)
+            with self._conn_lock:
+                with self._rcond:
+                    dead = self._sock is None
+                if dead:
+                    try:
+                        self._connect()
+                    except OSError as e:
+                        raise ConnectionError(
+                            f"reconnect to {self._host}:{self._port} "
+                            f"failed: {e}") from e
+            for c in self.generate_stream(prompt_ids, **kw):
+                chunks.append(c)
+
+        try:
+            attempt()
+        except (TimeoutError, ConnectionError):
+            if not retry or chunks:
+                raise
+            attempt()
         if not chunks:
             return np.zeros((0,), np.int32)
         return np.concatenate(chunks)

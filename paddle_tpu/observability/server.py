@@ -129,8 +129,29 @@ def _healthz() -> Dict[str, Any]:
     if age is not None and timeout > 0 and age > timeout:
         out["ok"] = False
         out["wedged"] = True
+    serving = _serving_health()
+    if serving is not None:
+        out["serving"] = serving
+        if not serving.get("ok", True):
+            out["ok"] = False
     out["status"] = "ok" if out["ok"] else "unhealthy"
     return out
+
+
+def _serving_health() -> Optional[Dict[str, Any]]:
+    """LLM-serving section for /healthz: engine stall-watchdog and
+    KV-audit state. None when the serving subsystem was never imported
+    (checking must not drag jax/serving_llm into a trainer) or holds
+    no engines."""
+    import sys
+    mod = sys.modules.get("paddle_tpu.serving_llm.engine")
+    if mod is None:
+        return None
+    try:
+        snap = mod.health_snapshot()
+    except Exception:  # noqa: BLE001 — health must never 500
+        return None
+    return snap if snap.get("engines") else None
 
 
 def _varz() -> Dict[str, Any]:

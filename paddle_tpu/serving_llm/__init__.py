@@ -20,8 +20,9 @@ Layering (each piece is independently testable):
 
 from .kv_cache import KVBlockAllocator
 from .scheduler import ContinuousBatchingScheduler, Sequence
-from .engine import LLMEngine
+from .engine import AdmissionRejected, LLMEngine, health_snapshot
 from .server import LLMStreamBridge
 
 __all__ = ["KVBlockAllocator", "ContinuousBatchingScheduler",
-           "Sequence", "LLMEngine", "LLMStreamBridge"]
+           "Sequence", "LLMEngine", "LLMStreamBridge",
+           "AdmissionRejected", "health_snapshot"]

@@ -29,6 +29,7 @@ server.
 from __future__ import annotations
 
 import time
+import weakref
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -39,7 +40,37 @@ from ..models.gpt_lm import dense_causal_attention
 from .kv_cache import KVBlockAllocator
 from .scheduler import ContinuousBatchingScheduler, Sequence
 
-__all__ = ["LLMEngine"]
+__all__ = ["LLMEngine", "AdmissionRejected", "health_snapshot"]
+
+# stall watchdog floor: a step (or inter-step gap) must exceed both
+# the floor and stall_factor * EWMA before the engine reads as stalled
+# (tests monkeypatch this to exercise the path deterministically)
+STALL_MIN_S = 0.5
+
+# live engines, for the /healthz "serving" section
+# (observability/server.py calls health_snapshot via sys.modules so an
+# unused serving subsystem costs nothing)
+_ENGINES: "weakref.WeakSet[LLMEngine]" = weakref.WeakSet()
+
+
+class AdmissionRejected(RuntimeError):
+    """New sequence refused by the KV-watermark admission gate
+    (FLAGS_kv_admission_watermark). Fail-fast overload control: the
+    pool could not cover the projected peak demand, so the request is
+    rejected before prefill instead of admitted into preempt-thrash.
+    ``retry_after_ms`` is a backoff hint sized to the current load."""
+
+    def __init__(self, msg: str, retry_after_ms: int):
+        super().__init__(msg)
+        self.retry_after_ms = int(retry_after_ms)
+
+
+def health_snapshot() -> Dict[str, Any]:
+    """Aggregate engine health for /healthz: per-engine stall /
+    KV-audit state, ok=False when any live engine is unhealthy."""
+    engines = [eng.health() for eng in list(_ENGINES)]
+    ok = not any(h["stalled"] or h["audit_failed"] for h in engines)
+    return {"ok": ok, "engines": engines}
 
 
 class LLMEngine:
@@ -68,6 +99,16 @@ class LLMEngine:
         self._seqs: Dict[int, Sequence] = {}
         self._next_seq = 0
         self.tokens_generated = 0
+        # projected peak blocks per live sequence (watermark gate)
+        self._projected: Dict[int, int] = {}
+        # stall watchdog / post-step audit state (health_snapshot)
+        self._step_begin_unix: Optional[float] = None
+        self._step_end_unix: Optional[float] = None
+        self._step_ewma_s: Optional[float] = None
+        self._audit_failed = False
+        self.stalls_total = 0
+        self.admission_rejected_total = 0
+        _ENGINES.add(self)
 
     # -- request lifecycle ------------------------------------------------
 
@@ -82,20 +123,65 @@ class LLMEngine:
             raise ValueError(f"prompt token out of range [0, {vocab})")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        projected = self._admission_gate(len(prompt),
+                                         int(max_new_tokens))
         self._next_seq += 1
         seq = Sequence(seq_id=self._next_seq, prompt=prompt,
                        max_new_tokens=int(max_new_tokens),
                        eos_token_id=eos_token_id,
                        temperature=float(temperature), seed=int(seed))
         self._seqs[seq.seq_id] = seq
+        self._projected[seq.seq_id] = projected
         self.scheduler.add(seq)
         return seq.seq_id
+
+    def _admission_gate(self, prompt_len: int, max_new: int) -> int:
+        """KV-watermark admission control: compute the sequence's
+        projected peak block demand (an upper bound — blocks for
+        prompt + max_new tokens) and reject when the summed projection
+        of every live sequence would cross the watermark. Admitted
+        load then provably fits without preemption."""
+        projected = self.allocator.blocks_for(prompt_len + max_new)
+        from ..flags import GLOBAL_FLAGS
+        try:
+            watermark = float(GLOBAL_FLAGS.get("kv_admission_watermark"))
+        except Exception:  # noqa: BLE001
+            watermark = 0.0
+        if watermark <= 0:
+            return projected
+        budget = watermark * self.pool_blocks
+        committed = sum(self._projected.values())
+        if committed + projected <= budget:
+            return projected
+        self.admission_rejected_total += 1
+        # backoff hint scaled to how much work is ahead of the caller
+        load = len(self.scheduler.running) + len(self.scheduler.waiting)
+        retry_after_ms = 50 * (1 + load)
+        from ..observability import flight as _flight
+        _flight.record("llm_admission_rejected", force=True,
+                       projected_blocks=projected,
+                       committed_blocks=committed,
+                       budget_blocks=round(budget, 1),
+                       retry_after_ms=retry_after_ms)
+        from .. import observability as obs
+        if obs.enabled():
+            obs.counter("llm_admission_rejected_total",
+                        "new sequences refused by the KV-watermark "
+                        "admission gate (kv_admission_watermark) "
+                        "before prefill — overload fail-fast, not a "
+                        "shed or a preemption").inc()
+        raise AdmissionRejected(
+            f"admission rejected: projected {projected} KV blocks + "
+            f"{committed} committed exceeds watermark budget "
+            f"{budget:.1f} of {self.pool_blocks}; "
+            f"retry_after_ms={retry_after_ms}", retry_after_ms)
 
     def cancel(self, seq_id: int) -> bool:
         """Drop a sequence (client disconnect): blocks freed, no
         further events for it. True if it was live."""
         seq = self.scheduler.cancel(seq_id)
         self._seqs.pop(seq_id, None)
+        self._projected.pop(seq_id, None)
         return seq is not None
 
     def active(self) -> bool:
@@ -107,9 +193,33 @@ class LLMEngine:
         """Admit + prefill new sequences, then one decode step for the
         running batch. Returns token/finished/error event dicts in
         emission order (a sequence's events are ordered; the chunk
-        stream is built from exactly this order)."""
+        stream is built from exactly this order).
+
+        Wrapped by the stall watchdog (EWMA of step wall time, see
+        FLAGS_llm_stall_factor) and followed by the KV invariant audit
+        — a leak or gauge drift raises here, loudly, instead of
+        surfacing as slow corruption."""
+        self._step_begin_unix = time.time()
+        t0 = time.perf_counter()
+        try:
+            events = self._step_inner()
+        finally:
+            self._note_step(time.perf_counter() - t0)
+        self._audit()
+        return events
+
+    def _step_inner(self) -> List[Dict[str, Any]]:
         events: List[Dict[str, Any]] = []
-        for seq in self.scheduler.admit():
+        try:
+            admitted = self.scheduler.admit()
+        except Exception as e:  # noqa: BLE001 — kv_alloc fault path
+            # allocate() raised before the head left the waiting
+            # queue: fail that one request, keep the engine alive
+            admitted = []
+            if self.scheduler.waiting:
+                seq = self.scheduler.waiting.popleft()
+                events.append(self._fail(seq, f"kv allocation: {e}"))
+        for seq in admitted:
             try:
                 events += self._prefill(seq)
             except Exception as e:  # noqa: BLE001 — fail ONE request
@@ -128,6 +238,8 @@ class LLMEngine:
             positions % self.block_size
 
     def _prefill(self, seq: Sequence) -> List[Dict[str, Any]]:
+        from ..testing import faults as _faults
+        _faults.hit("llm_prefill")
         if seq.dispatch_unix is None:
             seq.dispatch_unix = time.time()
         ids = seq.prompt + seq.generated  # re-prefill keeps generated
@@ -156,10 +268,17 @@ class LLMEngine:
                        if s.ctx_len > 0 and s.generated),
                       key=lambda s: s.admit_order)
         batch: List[Sequence] = []
+        from ..testing import faults as _faults
         for seq in todo:
             if seq not in self.scheduler.running:
                 continue  # preempted by an older sequence's growth
-            if not self.scheduler.grow(seq, seq.ctx_len + 1):
+            try:
+                _faults.hit("llm_decode")
+                grown = self.scheduler.grow(seq, seq.ctx_len + 1)
+            except Exception as e:  # noqa: BLE001 — fail ONE sequence
+                events.append(self._fail(seq, f"decode: {e}"))
+                continue
+            if not grown:
                 events.append(self._fail(
                     seq, f"sequence needs {seq.ctx_len + 1} tokens of "
                          f"KV cache but the pool holds "
@@ -193,9 +312,17 @@ class LLMEngine:
                                         self._v_pools[i], tbl, lens)
             return out[:, None].astype(q.dtype)
 
-        logits = self.model.forward_with_attn(
-            jnp.asarray(feed), jnp.asarray(newpos[:, None]),
-            attn_fn)[:, -1]
+        try:
+            logits = self.model.forward_with_attn(
+                jnp.asarray(feed), jnp.asarray(newpos[:, None]),
+                attn_fn)[:, -1]
+        except Exception as e:  # noqa: BLE001
+            # a batched-forward failure would otherwise strand the
+            # whole running set mid-decode forever: fail every member
+            # loudly so their blocks free and clients get error frames
+            for seq in batch:
+                events.append(self._fail(seq, f"decode step: {e}"))
+            return events
         from .. import observability as obs
         if obs.enabled():
             obs.histogram("llm_decode_batch_size",
@@ -231,6 +358,7 @@ class LLMEngine:
         if reason is not None:
             self.scheduler.finish(seq)
             self._seqs.pop(seq.seq_id, None)
+            self._projected.pop(seq.seq_id, None)
             events.append({"type": "finished", "seq_id": seq.seq_id,
                            "reason": reason,
                            "tokens": len(seq.generated)})
@@ -239,8 +367,103 @@ class LLMEngine:
     def _fail(self, seq: Sequence, error: str) -> Dict[str, Any]:
         self.scheduler.finish(seq)
         self._seqs.pop(seq.seq_id, None)
+        self._projected.pop(seq.seq_id, None)
         return {"type": "error", "seq_id": seq.seq_id, "error": error,
                 "tokens": len(seq.generated)}
+
+    # -- watchdog + invariant audit ---------------------------------------
+
+    @staticmethod
+    def _stall_factor() -> float:
+        from ..flags import GLOBAL_FLAGS
+        try:
+            return float(GLOBAL_FLAGS.get("llm_stall_factor"))
+        except Exception:  # noqa: BLE001
+            return 0.0
+
+    def _note_step(self, dt: float) -> None:
+        """EWMA stall watchdog: a step that took stall_factor times
+        longer than the running average (and past the floor) is a
+        stall — forced flight event + counter; /healthz picks up the
+        live case (a step that never returns) from the stamps."""
+        self._step_end_unix = time.time()
+        ewma = self._step_ewma_s
+        factor = self._stall_factor()
+        if factor > 0 and ewma is not None \
+                and dt > max(STALL_MIN_S, factor * ewma):
+            self.stalls_total += 1
+            from ..observability import flight as _flight
+            _flight.record("llm_engine_stalled", force=True,
+                           step_s=round(dt, 4),
+                           ewma_s=round(ewma, 4), factor=factor)
+            from .. import observability as obs
+            if obs.enabled():
+                obs.counter("llm_engine_stalled_total",
+                            "engine steps flagged by the stall "
+                            "watchdog: wall time exceeded "
+                            "llm_stall_factor x the EWMA step time"
+                            ).inc()
+        self._step_ewma_s = dt if ewma is None \
+            else 0.8 * ewma + 0.2 * dt
+
+    def _audit(self) -> None:
+        """Post-step KV invariant audit: the allocator's internal
+        accounting must be consistent and the published gauges must
+        agree with it. Raises AssertionError — a serving loop that
+        leaks blocks must fail loudly, not degrade quietly."""
+        agree = None
+        try:
+            self.allocator.check()
+            agree = self.allocator.gauges_agree()
+            if agree is False:
+                raise AssertionError(
+                    "kv_blocks_used/free gauges disagree with the "
+                    f"allocator (used={self.allocator.num_used}, "
+                    f"free={self.allocator.num_free})")
+        except AssertionError:
+            self._audit_failed = True
+            from ..observability import flight as _flight
+            _flight.record("llm_kv_audit_failed", force=True,
+                           used=self.allocator.num_used,
+                           free=self.allocator.num_free,
+                           gauges_agree=agree)
+            from .. import observability as obs
+            if obs.enabled():
+                obs.counter("llm_kv_audit_failures_total",
+                            "post-step KV invariant audits that "
+                            "failed (allocator accounting broken or "
+                            "gauges drifted) — the engine reports "
+                            "unhealthy on /healthz until restart"
+                            ).inc()
+            raise
+
+    def health(self) -> Dict[str, Any]:
+        """Live health for /healthz's serving section. ``stalled`` is
+        judged from the step stamps so a step wedged RIGHT NOW (or a
+        serving loop that stopped stepping an active engine) reads
+        unhealthy without waiting for the step to return."""
+        now = time.time()
+        begin, end = self._step_begin_unix, self._step_end_unix
+        last = max(x for x in (begin, end) if x is not None) \
+            if (begin is not None or end is not None) else None
+        age = None if last is None else max(0.0, now - last)
+        factor = self._stall_factor()
+        ewma = self._step_ewma_s
+        stalled = bool(
+            factor > 0 and self.active() and age is not None
+            and ewma is not None
+            and age > max(STALL_MIN_S, factor * ewma))
+        return {"active": self.active(),
+                "running": len(self.scheduler.running),
+                "waiting": len(self.scheduler.waiting),
+                "kv_blocks_used": self.allocator.num_used,
+                "last_step_age_s":
+                    None if age is None else round(age, 3),
+                "step_ewma_s":
+                    None if ewma is None else round(ewma, 4),
+                "stalls_total": self.stalls_total,
+                "stalled": stalled,
+                "audit_failed": self._audit_failed}
 
     def _publish(self) -> None:
         from .. import observability as obs

@@ -19,9 +19,16 @@ no internal locking.
 
 from __future__ import annotations
 
+import itertools
 from typing import Dict, List, Optional
 
 __all__ = ["KVBlockAllocator"]
+
+# last allocator to publish the kv_blocks_* gauges (engines audit
+# gauge-vs-allocator agreement only when their own allocator wrote the
+# gauge last — several engines in one test process share the registry)
+_pub_tokens = itertools.count(1)
+_last_pub_token: Optional[int] = None
 
 
 class KVBlockAllocator:
@@ -38,6 +45,7 @@ class KVBlockAllocator:
         self.allocs_total = 0
         self.freed_total = 0
         self.alloc_failures_total = 0
+        self._pub_token = next(_pub_tokens)
         self._publish()
 
     # -- queries ----------------------------------------------------------
@@ -71,6 +79,8 @@ class KVBlockAllocator:
         assigned and the failure is counted."""
         if seq_id in self._tables:
             raise ValueError(f"seq {seq_id} already has a block table")
+        from ..testing import faults as _faults
+        _faults.hit("kv_alloc")
         need = self.blocks_for(n_tokens)
         if need > len(self._free):
             self.alloc_failures_total += 1
@@ -91,6 +101,8 @@ class KVBlockAllocator:
             raise KeyError(f"seq {seq_id} has no block table")
         if n_tokens <= self._tokens[seq_id]:
             return True
+        from ..testing import faults as _faults
+        _faults.hit("kv_alloc")
         need = self.blocks_for(n_tokens) - len(self._tables[seq_id])
         if need > len(self._free):
             self.alloc_failures_total += 1
@@ -151,10 +163,25 @@ class KVBlockAllocator:
         }[name]
         obs.counter(name, help_).inc(n)
 
+    def gauges_agree(self) -> Optional[bool]:
+        """Do the kv_blocks_* gauges match this allocator's counts?
+        None when unjudgeable (metrics off, or another allocator wrote
+        the gauges last); the engine's post-step audit consumes this."""
+        from .. import observability as obs
+        if not obs.enabled() or _last_pub_token != self._pub_token:
+            return None
+        used = obs.gauge("kv_blocks_used").value()
+        free = obs.gauge("kv_blocks_free").value()
+        if used is None or free is None:
+            return None
+        return int(used) == self.num_used and int(free) == self.num_free
+
     def _publish(self) -> None:
+        global _last_pub_token
         from .. import observability as obs
         if not obs.enabled():
             return
+        _last_pub_token = self._pub_token
         obs.gauge("kv_blocks_used",
                   "KV cache blocks currently owned by sequences "
                   "(paged allocator)").set(float(self.num_used))

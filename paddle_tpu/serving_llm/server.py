@@ -77,9 +77,14 @@ class LLMStreamBridge:
                 eos_token_id=None if eos_raw == EOS_NONE else int(eos_raw),
                 temperature=temperature, seed=seed)
         except Exception as e:  # noqa: BLE001 — fail ONE request
+            from .engine import AdmissionRejected
+            outcome = "admission_rejected" \
+                if isinstance(e, AdmissionRejected) else "decode_error"
+            # AdmissionRejected's message carries the retry-after hint
+            # (retry_after_ms=N) — it ships verbatim in the payload
             self.server.transport.reply_chunk(
                 req["rid"], str(e).encode(), status=-1, final=True)
-            self._record(req, status=-1, outcome="decode_error",
+            self._record(req, status=-1, outcome=outcome,
                          error=str(e)[:200])
             return
         self._reqs[seq_id] = req
@@ -92,8 +97,13 @@ class LLMStreamBridge:
     # -- one serving step -------------------------------------------------
 
     def step(self) -> None:
-        """One engine step; fan its events out to the wire."""
+        """One engine step; fan its events out to the wire. Waiting
+        sequences past the queue deadline are shed first — a stream
+        that never reached prefill is refused exactly like an aged
+        tensor request (requests_shed_total{kind=stream})."""
         from ..inference import encode_tensors
+        from ..testing import faults as _faults
+        self._shed_expired()
         for ev in self.engine.step():
             req = self._reqs.get(ev["seq_id"])
             if req is None:
@@ -101,11 +111,15 @@ class LLMStreamBridge:
             if ev["type"] == "token":
                 req.setdefault("dispatch_unix", ev["dispatch_unix"])
                 now = time.time()
-                rc = self.server.transport.reply_chunk(
-                    req["rid"],
-                    encode_tensors([np.asarray([ev["token"]],
-                                               np.int32)]),
-                    status=1, final=False)
+                try:
+                    _faults.hit("llm_chunk_write")
+                    rc = self.server.transport.reply_chunk(
+                        req["rid"],
+                        encode_tensors([np.asarray([ev["token"]],
+                                                   np.int32)]),
+                        status=1, final=False)
+                except Exception:  # noqa: BLE001 — treat as client gone
+                    rc = -3
                 if rc != 0:
                     self._cancel(ev["seq_id"], req, now)
                     continue
@@ -123,6 +137,27 @@ class LLMStreamBridge:
                 del self._reqs[ev["seq_id"]]
                 self._record(req, status=-1, outcome="execute_error",
                              error=ev["error"][:200])
+
+    def _shed_expired(self) -> None:
+        """Queue-deadline shedding for streams that have not started:
+        a sequence still waiting for prefill (no tokens generated,
+        never preempted) older than FLAGS_serving_queue_deadline_ms is
+        cancelled and answered with a terminal shed frame. Sequences
+        that already streamed tokens are never shed — ending those is
+        a cancel or a drain, not a shed."""
+        ddl = self.server._queue_deadline_s()
+        if ddl <= 0:
+            return
+        now = time.time()
+        for seq in list(self.engine.scheduler.waiting):
+            req = self._reqs.get(seq.seq_id)
+            if req is None or seq.generated or seq.preemptions:
+                continue
+            age = now - (req.get("dequeue_unix") or now)
+            if age > ddl:
+                self.engine.cancel(seq.seq_id)
+                self._reqs.pop(seq.seq_id, None)
+                self.server._shed(req, age, ddl)
 
     def _note_token(self, req: Dict[str, Any], now: float) -> None:
         stamps: List[float] = req["token_unix"]
@@ -168,13 +203,20 @@ class LLMStreamBridge:
         self._record(req, status=-3, outcome="cancelled",
                      reply_unix=now)
 
-    def close(self) -> None:
-        """Server stop: cancel everything still streaming."""
+    def close(self, message: bytes = b"server stopping",
+              outcome: str = "server_stop") -> None:
+        """Terminal sweep (server stop, or drain deadline expiry):
+        every still-open stream gets a terminal negative-status frame
+        BEFORE its sequence is cancelled and the socket goes away —
+        clients see an explicit error, never a bare TCP reset."""
         for seq_id, req in list(self._reqs.items()):
+            try:
+                self.server.transport.reply_chunk(
+                    req["rid"], message, status=-1, final=True)
+            except Exception:  # noqa: BLE001 — client may be gone
+                pass
             self.engine.cancel(seq_id)
-            self.server.transport.reply_chunk(
-                req["rid"], b"server stopping", status=-1, final=True)
-            self._record(req, status=-1, outcome="server_stop")
+            self._record(req, status=-1, outcome=outcome)
         self._reqs.clear()
 
     # -- span records -----------------------------------------------------

@@ -43,6 +43,15 @@ keys (``at=``/``step=``/``p=``) work unchanged; ``step=`` matches the
 trainer's global step (set via :func:`set_step_context` by the fit
 loop).
 
+**LLM serving points** (``SERVING_POINTS``): the serving plane calls
+:func:`hit` at ``llm_prefill`` (engine prefill entry, per sequence),
+``llm_decode`` (decode growth, per sequence per step), ``kv_alloc``
+(paged allocator allocate/extend), and ``llm_chunk_write`` (before
+each streamed token frame). An exception at any of these terminates
+exactly one sequence/stream (error frame or cancel, blocks freed);
+the engine and serving loop survive — the property the serving chaos
+drills assert.
+
 Every fired fault increments ``faults_injected_total{point=}`` and
 records a forced flight-recorder event before acting, so a drill can
 assert the injection actually happened. See docs/fault_tolerance.md.
@@ -60,11 +69,16 @@ from typing import List, Optional
 
 __all__ = ["FaultSpec", "parse_spec", "format_spec", "configure",
            "active", "hit", "value_mult", "value_points_armed",
-           "set_step_context", "VALUE_POINTS"]
+           "set_step_context", "VALUE_POINTS", "SERVING_POINTS"]
 
 # in-graph value-fault points: they never raise/kill; the train step
 # consumes their multiplier (grads x NaN / loss x spike factor)
 VALUE_POINTS = ("nonfinite_grad", "loss_spike")
+
+# LLM serving plane injection points (serving_llm/ + kv_cache);
+# firing any of them fails ONE sequence, never the serving loop
+SERVING_POINTS = ("llm_prefill", "llm_decode", "llm_chunk_write",
+                  "kv_alloc")
 _VALUE_DEFAULT_MUL = {"nonfinite_grad": float("nan"),
                       "loss_spike": 1e6}
 

@@ -541,10 +541,26 @@ def test_server_sheds_requests_past_queue_deadline():
 # chaos drill harness (ISSUE acceptance: wired into tier-1)
 # ---------------------------------------------------------------------------
 
+def test_chaos_drill_list_inventory():
+    """--list prints the drill roster (one line each) without touching
+    jax, so CI can keep the inventory honest for near-free."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "chaos_drill.py"),
+         "--list"],
+        capture_output=True, text=True, timeout=60, cwd=ROOT)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    for name in ("kill_mid_save", "corrupt_leaf", "sigterm_mid_fit",
+                 "crash_loop", "nonfinite_skip", "exact_resume",
+                 "stream_disconnect", "llm_overload_shed",
+                 "llm_drain_sigterm", "llm_decode_error"):
+        assert name in proc.stdout, f"{name} missing from --list"
+
+
 def test_chaos_drill_self_test_subprocess():
     """The full drill suite — kill -9 mid-save, corrupted leaf, SIGTERM
     mid-fit, crash-loop budget, nonfinite-grad skip, bitwise-exact
-    SIGKILL resume — must pass end to end on CPU."""
+    SIGKILL resume, plus the LLM serving drills (overload shed, drain
+    on SIGTERM, decode fault) — must pass end to end on CPU."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("FLAGS_fault_spec", None)
     env.pop("FLAGS_enable_metrics", None)

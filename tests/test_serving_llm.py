@@ -9,6 +9,10 @@ socket loopback with streaming frames, reqtrace stamps, and
 TTFT/TPOT histograms.
 """
 
+import random
+import socket
+import struct
+import threading
 import time
 
 import numpy as np
@@ -20,7 +24,8 @@ import jax.numpy as jnp  # noqa: E402
 import paddle_tpu as pt  # noqa: E402
 from paddle_tpu import observability as obs  # noqa: E402
 from paddle_tpu.models import GPTLanguageModel  # noqa: E402
-from paddle_tpu.serving_llm import (ContinuousBatchingScheduler,  # noqa: E402
+from paddle_tpu.serving_llm import (AdmissionRejected,  # noqa: E402
+                                    ContinuousBatchingScheduler,
                                     KVBlockAllocator, LLMEngine, Sequence)
 
 
@@ -451,3 +456,619 @@ class TestStreamingLoopback:
         stats = cli.stats()
         assert stats.get("stream_total", 0) >= 1
         assert stats.get("stream_chunks_total", 0) >= 3
+
+# ---------------------------------------------------------------------------
+# robustness: admission watermark, stall watchdog, KV audit, fault points
+# ---------------------------------------------------------------------------
+
+class TestAdmissionControl:
+    def test_watermark_admission_gate(self, model, metrics_on):
+        pt.set_flags({"kv_admission_watermark": 0.5})
+        try:
+            # budget = 0.5 * 8 = 4 blocks; each request projects
+            # ceil((5 + 6) / 4) = 3 blocks, so a second one cannot fit
+            eng = LLMEngine(model, block_size=4, pool_blocks=8)
+            a = eng.add_request([1] * 5, max_new_tokens=6)
+            with pytest.raises(AdmissionRejected) as ei:
+                eng.add_request([2] * 5, max_new_tokens=6)
+            assert ei.value.retry_after_ms > 0
+            assert "retry_after_ms=" in str(ei.value)
+            assert eng.admission_rejected_total == 1
+            assert obs.counter(
+                "llm_admission_rejected_total").value() == 1
+            _, order, _ = _run(eng)
+            assert order == [a]
+            # the finish released a's projection: the same request
+            # that was refused now fits
+            b = eng.add_request([2] * 5, max_new_tokens=6)
+            out, _, _ = _run(eng)
+            assert np.array_equal(
+                out[b], _ref(model, [2] * 5, max_new_tokens=6))
+            assert eng.allocator.num_used == 0
+        finally:
+            pt.set_flags({"kv_admission_watermark": 0.0})
+
+    def test_watermark_disabled_by_default(self, model):
+        # flag defaults to 0 (off): oversubscription falls through to
+        # the scheduler's preemption machinery, never a rejection
+        eng = LLMEngine(model, block_size=4, pool_blocks=8)
+        sids = [eng.add_request([i + 1] * 5, max_new_tokens=20)
+                for i in range(3)]          # 3 x 7 projected > pool
+        assert eng.admission_rejected_total == 0
+        for sid in sids:
+            assert eng.cancel(sid)
+        assert eng.allocator.num_used == 0
+
+    def test_cancel_releases_projection(self, model, metrics_on):
+        pt.set_flags({"kv_admission_watermark": 0.5})
+        try:
+            eng = LLMEngine(model, block_size=4, pool_blocks=8)
+            a = eng.add_request([1] * 5, max_new_tokens=6)
+            with pytest.raises(AdmissionRejected):
+                eng.add_request([2] * 5, max_new_tokens=6)
+            eng.cancel(a)
+            eng.add_request([2] * 5, max_new_tokens=6)  # fits now
+        finally:
+            pt.set_flags({"kv_admission_watermark": 0.0})
+
+
+class TestEngineWatchdog:
+    def test_stall_watchdog_posthoc_event(self, model, metrics_on):
+        eng = LLMEngine(model, block_size=4, pool_blocks=8)
+        eng._step_ewma_s = 0.01
+        eng._note_step(5.0)        # >> max(STALL_MIN_S, 10 * ewma)
+        assert eng.stalls_total == 1
+        assert obs.counter("llm_engine_stalled_total").value() == 1
+        assert eng._step_ewma_s == pytest.approx(0.8 * 0.01 + 0.2 * 5.0)
+        events = [e for e in obs.flight.recorder().events()
+                  if e.get("kind") == "llm_engine_stalled"]
+        assert events and events[-1]["step_s"] == 5.0
+
+    def test_fast_step_is_not_a_stall(self, model, metrics_on):
+        eng = LLMEngine(model, block_size=4, pool_blocks=8)
+        eng._step_ewma_s = 0.01
+        eng._note_step(0.02)       # 2x ewma but below the 0.5s floor
+        assert eng.stalls_total == 0
+
+    def test_stalled_engine_flips_healthz(self, model, metrics_on):
+        from paddle_tpu.observability.server import _healthz
+        from paddle_tpu.serving_llm import health_snapshot
+        eng = LLMEngine(model, block_size=4, pool_blocks=8)
+        sid = eng.add_request([1, 2, 3], max_new_tokens=4)
+        eng._step_ewma_s = 0.01
+        eng._step_begin_unix = eng._step_end_unix = time.time() - 100.0
+        h = eng.health()
+        assert h["stalled"] and h["active"] == 1
+        assert health_snapshot()["ok"] is False
+        out = _healthz()
+        assert out["ok"] is False
+        assert out["serving"]["ok"] is False
+        assert out["status"] == "unhealthy"
+        # an idle engine cannot be stalled, however old its stamps
+        eng.cancel(sid)
+        assert eng.health()["stalled"] is False
+
+
+class TestKVAudit:
+    def test_audit_detects_unpublished_gauge_drift(self, model,
+                                                   metrics_on):
+        eng = LLMEngine(model, block_size=4, pool_blocks=8)
+        eng.add_request([1, 2, 3], max_new_tokens=8)
+        eng.step()                           # publishes gauges
+        alloc = eng.allocator
+        assert alloc.gauges_agree() is True
+        # consistent-but-unpublished mutation: a block moves from the
+        # free list into a table with no gauge republish
+        alloc._tables[999] = [alloc._free.pop()]
+        alloc._tokens[999] = 1
+        alloc.check()                        # ownership still sound
+        assert alloc.gauges_agree() is False
+        with pytest.raises(AssertionError, match="gauges disagree"):
+            eng._audit()
+        assert eng._audit_failed
+        assert eng.health()["audit_failed"]
+        assert obs.counter(
+            "llm_kv_audit_failures_total").value() >= 1
+        events = [e for e in obs.flight.recorder().events()
+                  if e.get("kind") == "llm_kv_audit_failed"]
+        assert events
+
+    def test_step_raises_on_corrupt_block_table(self, model,
+                                                metrics_on):
+        eng = LLMEngine(model, block_size=4, pool_blocks=8)
+        eng.allocator._tables[999] = [0]     # block 0 is still free
+        with pytest.raises(AssertionError):
+            eng.step()
+        assert eng._audit_failed
+
+
+class TestServingFaultPoints:
+    def test_prefill_fault_fails_one_sequence(self, model):
+        from paddle_tpu.testing import faults
+        faults.configure("llm_prefill:at=1:exc=ValueError")
+        try:
+            eng = LLMEngine(model, block_size=4, pool_blocks=16)
+            a = eng.add_request([1, 2, 3], max_new_tokens=4)
+            b = eng.add_request([5, 9, 2], max_new_tokens=4)
+            out, order, errors = _run(eng, collect_errors=True)
+            assert [e["seq_id"] for e in errors] == [a]
+            assert "fault injected" in errors[0]["error"]
+            assert order == [b]
+            assert np.array_equal(
+                out[b], _ref(model, [5, 9, 2], max_new_tokens=4))
+            assert eng.allocator.num_used == 0
+        finally:
+            faults.configure(None)
+
+    def test_decode_fault_fails_one_sequence(self, model):
+        from paddle_tpu.testing import faults
+        faults.configure("llm_decode:at=3:exc=RuntimeError")
+        try:
+            eng = LLMEngine(model, block_size=4, pool_blocks=16)
+            a = eng.add_request([1, 2, 3], max_new_tokens=6)
+            b = eng.add_request([5, 9, 2], max_new_tokens=6)
+            out, order, errors = _run(eng, collect_errors=True)
+            assert len(errors) == 1 and len(order) == 1
+            survivor = order[0]
+            prompt = [1, 2, 3] if survivor == a else [5, 9, 2]
+            assert np.array_equal(
+                out[survivor], _ref(model, prompt, max_new_tokens=6))
+            assert eng.allocator.num_used == 0
+        finally:
+            faults.configure(None)
+
+    def test_kv_alloc_fault_is_one_error_event(self, model):
+        from paddle_tpu.testing import faults
+        faults.configure("kv_alloc:at=1:exc=RuntimeError")
+        try:
+            eng = LLMEngine(model, block_size=4, pool_blocks=16)
+            sid = eng.add_request([1, 2, 3], max_new_tokens=4)
+            _, order, errors = _run(eng, collect_errors=True)
+            assert order == []
+            assert len(errors) == 1 and errors[0]["seq_id"] == sid
+            assert "kv allocation" in errors[0]["error"]
+            assert eng.allocator.num_used == 0 and not eng.active()
+        finally:
+            faults.configure(None)
+
+
+# ---------------------------------------------------------------------------
+# allocator stress + scheduler preemption storm (property-style)
+# ---------------------------------------------------------------------------
+
+class TestAllocatorStress:
+    def test_random_ops_match_shadow_model(self, metrics_on):
+        nb, bs = 16, 4
+        rng = random.Random(0)
+        a = KVBlockAllocator(num_blocks=nb, block_size=bs)
+        a.free(-1)                # prime the gauge publish token
+        stack = list(range(nb - 1, -1, -1))  # shadow LIFO free list
+        tables, toks = {}, {}
+        allocs = frees = 0
+        for _ in range(300):
+            op = rng.choice(("alloc", "extend", "free"))
+            if op == "alloc":
+                sid = rng.randrange(24)
+                n = rng.randrange(0, 5 * bs)
+                if sid in tables:
+                    with pytest.raises(ValueError):
+                        a.allocate(sid, n)
+                else:
+                    need = -(-n // bs)
+                    ok = a.allocate(sid, n)
+                    if need <= len(stack):
+                        assert ok
+                        tables[sid] = [stack.pop()
+                                       for _ in range(need)]
+                        toks[sid] = n
+                        allocs += need
+                    else:
+                        assert not ok
+            elif op == "extend" and tables:
+                sid = rng.choice(sorted(tables))
+                n = toks[sid] + rng.randrange(-bs, 2 * bs)
+                ok = a.extend_to(sid, n)
+                if n <= toks[sid]:
+                    assert ok            # covered: no-op, tokens keep
+                else:
+                    need = -(-n // bs) - len(tables[sid])
+                    if need <= len(stack):
+                        assert ok
+                        tables[sid] += [stack.pop()
+                                        for _ in range(need)]
+                        toks[sid] = n
+                        allocs += max(0, need)
+                    else:
+                        assert not ok    # all-or-nothing
+            elif op == "free":
+                sid = rng.choice(sorted(tables)) \
+                    if tables and rng.random() < 0.9 \
+                    else rng.randrange(24)
+                got = a.free(sid)
+                blocks = tables.pop(sid, [])
+                toks.pop(sid, None)
+                assert got == len(blocks)
+                stack.extend(reversed(blocks))
+                frees += len(blocks)
+            # full-state agreement after EVERY op
+            for sid, t in tables.items():
+                assert a.table(sid) == t
+                assert a.tokens(sid) == toks[sid]
+            assert a.num_free == len(stack)
+            a.check()
+            assert a.gauges_agree() is True
+        assert a.allocs_total == allocs and a.freed_total == frees
+
+
+class TestPreemptionStorm:
+    def test_eight_seqs_through_two_blocks_fcfs_no_livelock(self):
+        # 8 sequences contending for a 2-block pool: every sequence
+        # must finish, in FCFS order, within a bounded iteration
+        # budget (no preemption livelock), leaving the pool clean
+        a = KVBlockAllocator(num_blocks=2, block_size=4)
+        s = ContinuousBatchingScheduler(a, max_decode_batch=8)
+        seqs = [_seq(i, n_prompt=2) for i in range(8)]
+        for x in seqs:
+            s.add(x)
+        finished = []
+        iters = 0
+        while s.active():
+            iters += 1
+            assert iters <= 500, "preemption storm never converged"
+            for x in s.admit():              # simulate prefill
+                x.ctx_len = len(x.prompt) + len(x.generated)
+            for x in list(s.running):        # simulate one decode step
+                if x not in s.running:
+                    continue                 # preempted this round
+                assert s.grow(x, x.ctx_len + 1), \
+                    "grow failed with victims available"
+                x.ctx_len += 1
+                x.generated.append(7)
+                if len(x.generated) == 4:
+                    s.finish(x)
+                    finished.append(x.seq_id)
+        assert finished == sorted(finished), \
+            f"FCFS violated: {finished}"
+        assert len(finished) == 8
+        assert a.num_used == 0
+        a.check()
+
+
+# ---------------------------------------------------------------------------
+# bridge shedding, drain lifecycle, terminal-frame sweep
+# ---------------------------------------------------------------------------
+
+class _StubTransport:
+    def __init__(self):
+        self.chunks = []
+
+    def reply_chunk(self, rid, payload, status=0, final=False):
+        self.chunks.append((rid, bytes(payload), status, final))
+        return 0
+
+
+class _StubServer:
+    def __init__(self, deadline_s=0.05):
+        self.transport = _StubTransport()
+        self.shed = []
+        self._ddl = deadline_s
+
+    def _queue_deadline_s(self):
+        return self._ddl
+
+    def _shed(self, req, age_s, deadline_s):
+        self.shed.append((req, age_s, deadline_s))
+
+
+class TestBridgeShedding:
+    def test_shed_expired_only_hits_unstarted_waiting(self, model):
+        from paddle_tpu.serving_llm.server import LLMStreamBridge
+        eng = LLMEngine(model, block_size=4, pool_blocks=8,
+                        max_decode_batch=1)
+        stub = _StubServer(deadline_s=0.05)
+        bridge = LLMStreamBridge(stub, eng)
+        a = eng.add_request([1] * 8, max_new_tokens=4)
+        b = eng.add_request([2, 3], max_new_tokens=4)   # behind the cap
+        eng.step()
+        assert [x.seq_id for x in eng.scheduler.waiting] == [b]
+        old = time.time() - 1.0
+        bridge._reqs[a] = {"rid": 1, "dequeue_unix": old,
+                           "token_unix": []}
+        bridge._reqs[b] = {"rid": 2, "dequeue_unix": old,
+                           "token_unix": []}
+        bridge._shed_expired()
+        # b (never prefetched a single token) is shed; a is running
+        # and therefore untouchable by the shedder
+        assert [r[0]["rid"] for r in stub.shed] == [2]
+        assert b not in bridge._reqs and a in bridge._reqs
+        assert not eng.scheduler.waiting
+        assert eng.cancel(a)
+        assert eng.allocator.num_used == 0
+
+    def test_shed_disabled_without_deadline(self, model):
+        from paddle_tpu.serving_llm.server import LLMStreamBridge
+        eng = LLMEngine(model, block_size=4, pool_blocks=3)
+        stub = _StubServer(deadline_s=0.0)   # deadline off
+        bridge = LLMStreamBridge(stub, eng)
+        b = eng.add_request([2, 3], max_new_tokens=4)
+        bridge._reqs[b] = {"rid": 2, "dequeue_unix": time.time() - 99,
+                           "token_unix": []}
+        bridge._shed_expired()
+        assert stub.shed == [] and b in bridge._reqs
+        eng.cancel(b)
+
+    def test_server_shed_counts_stream_kind(self, model, metrics_on):
+        from paddle_tpu.inference import Server
+        eng = LLMEngine(model, block_size=4, pool_blocks=8)
+        srv = Server(None, llm_engine=eng)
+        try:
+            srv._shed({"rid": 0, "stream": True, "trace_id": 1},
+                      age_s=1.0, deadline_s=0.5)
+            srv._shed({"rid": 0, "trace_id": 2},
+                      age_s=1.0, deadline_s=0.5)
+            c = obs.counter("requests_shed_total")
+            assert c.value(kind="stream") == 1
+            assert c.value(kind="tensor") == 1
+        finally:
+            srv.stop()
+
+
+class TestDrainLifecycle:
+    def test_drain_refuses_new_and_terminates_streams(self, model,
+                                                      metrics_on):
+        from paddle_tpu.inference import Client, Server
+        eng = LLMEngine(model, block_size=4, pool_blocks=64)
+        srv = Server(None, llm_engine=eng)
+        cli = Client(port=srv.port, timeout_s=30.0)
+        cli2 = None
+        try:
+            gen = cli.generate_stream([3, 4, 5], max_new_tokens=100,
+                                      deadline_s=30.0)
+            for _ in range(2):
+                next(gen)
+            srv.drain(deadline_s=0.3, wait=True)
+            assert srv._drained.is_set()
+            # the live stream ended with an explicit terminal frame
+            with pytest.raises(RuntimeError, match="drain"):
+                for _ in gen:
+                    pass
+            # new arrivals are refused while draining
+            cli2 = Client(port=srv.port, timeout_s=30.0)
+            with pytest.raises(RuntimeError, match="draining"):
+                cli2.generate([1, 2], max_new_tokens=2, retry=False)
+            assert srv.n_drain_rejected >= 1
+            assert eng.allocator.num_used == 0
+            eng.allocator.check()
+        finally:
+            if cli2 is not None:
+                cli2.close()
+            cli.close()
+            srv.stop()
+
+    def test_drain_idle_server_completes_immediately(self, model):
+        from paddle_tpu.inference import Server
+        eng = LLMEngine(model, block_size=4, pool_blocks=8)
+        srv = Server(None, llm_engine=eng)
+        try:
+            srv.drain(deadline_s=5.0, wait=True)
+            assert srv._drained.is_set()
+        finally:
+            srv.stop()
+
+    def test_stop_mid_stream_sends_terminal_frame(self, model):
+        # regression: Server.stop() must sweep open streams with a
+        # terminal error frame, not leave clients hanging on a socket
+        from paddle_tpu.inference import Client, Server
+        eng = LLMEngine(model, block_size=4, pool_blocks=64)
+        srv = Server(None, llm_engine=eng)
+        cli = Client(port=srv.port, timeout_s=30.0)
+        try:
+            gen = cli.generate_stream([5, 9, 2], max_new_tokens=100,
+                                      deadline_s=20.0)
+            next(gen)
+            t = threading.Thread(target=srv.stop)
+            t.start()
+            with pytest.raises(RuntimeError, match="server stopping"):
+                for _ in gen:
+                    pass
+            t.join(timeout=30)
+            assert eng.allocator.num_used == 0
+        finally:
+            cli.close()
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# client resilience: per-chunk stream deadline, zero-chunk retry
+# ---------------------------------------------------------------------------
+
+class _FakeStreamServer:
+    """Minimal wire-speaking listener: one scripted handler per
+    accepted connection (tests drive pathological server behaviour
+    the real engine never exhibits)."""
+
+    def __init__(self, handlers):
+        self._handlers = list(handlers)
+        self.requests = []
+        self._lsock = socket.socket()
+        self._lsock.setsockopt(socket.SOL_SOCKET,
+                               socket.SO_REUSEADDR, 1)
+        self._lsock.bind(("127.0.0.1", 0))
+        self._lsock.listen(8)
+        self.port = self._lsock.getsockname()[1]
+        self._thread = threading.Thread(target=self._serve,
+                                        daemon=True)
+        self._thread.start()
+
+    @staticmethod
+    def _readn(conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            buf += chunk
+        return buf
+
+    @staticmethod
+    def reply(conn, tag, status, payload=b""):
+        conn.sendall(struct.pack("<QqI", tag, status, len(payload))
+                     + payload)
+
+    def _serve(self):
+        for handler in self._handlers:
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                return
+            try:
+                hdr = self._readn(conn, struct.calcsize("<IQI"))
+                magic, tag, ln = struct.unpack("<IQI", hdr)
+                self.requests.append((magic, tag,
+                                      self._readn(conn, ln)))
+                handler(conn, tag)
+            except Exception:  # noqa: BLE001 — scripted teardown
+                pass
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def close(self):
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+
+
+class TestClientResilience:
+    def test_stream_deadline_times_out_and_poisons(self):
+        from paddle_tpu.inference import Client, encode_tensors
+
+        def one_chunk_then_silence(conn, tag):
+            _FakeStreamServer.reply(
+                conn, tag, 1,
+                encode_tensors([np.asarray([7], np.int32)]))
+            time.sleep(3.0)          # silent past the chunk deadline
+
+        fake = _FakeStreamServer([one_chunk_then_silence])
+        cli = Client(port=fake.port, timeout_s=30.0)
+        try:
+            gen = cli.generate_stream([1, 2], max_new_tokens=4,
+                                      deadline_s=0.3)
+            assert int(next(gen)[0]) == 7
+            with pytest.raises(TimeoutError):
+                next(gen)
+            # the connection is poisoned: stream position unknowable
+            with cli._rcond:
+                assert cli._sock is None
+        finally:
+            cli.close()
+            fake.close()
+
+    def test_generate_retries_once_with_zero_chunks(self):
+        from paddle_tpu.inference import Client, encode_tensors
+
+        def die_before_first_chunk(conn, tag):
+            conn.close()             # zero chunks: safe to resend
+
+        def serve_properly(conn, tag):
+            for tok in (1, 2, 3):
+                _FakeStreamServer.reply(
+                    conn, tag, 1,
+                    encode_tensors([np.asarray([tok], np.int32)]))
+            _FakeStreamServer.reply(conn, tag, 0)
+
+        fake = _FakeStreamServer([die_before_first_chunk,
+                                  serve_properly])
+        cli = Client(port=fake.port, timeout_s=30.0)
+        try:
+            out = cli.generate([1, 2], max_new_tokens=3)
+            assert out.tolist() == [1, 2, 3]
+            assert len(fake.requests) == 2   # original + one retry
+        finally:
+            cli.close()
+            fake.close()
+
+    def test_generate_does_not_retry_after_first_chunk(self):
+        from paddle_tpu.inference import Client, encode_tensors
+
+        def one_chunk_then_die(conn, tag):
+            _FakeStreamServer.reply(
+                conn, tag, 1,
+                encode_tensors([np.asarray([9], np.int32)]))
+            time.sleep(0.1)          # let the chunk land first
+            conn.close()
+
+        fake = _FakeStreamServer([one_chunk_then_die])
+        cli = Client(port=fake.port, timeout_s=30.0)
+        try:
+            with pytest.raises(ConnectionError):
+                cli.generate([1, 2], max_new_tokens=4)
+            assert len(fake.requests) == 1   # no second attempt
+        finally:
+            cli.close()
+            fake.close()
+
+
+# ---------------------------------------------------------------------------
+# wire fuzz: malformed PTST/PTSR/PTSV frames must never hurt the server
+# ---------------------------------------------------------------------------
+
+class TestWireFuzz:
+    @pytest.fixture
+    def served(self, model):
+        from paddle_tpu.inference import Client, Server
+        eng = LLMEngine(model, block_size=4, pool_blocks=32)
+        srv = Server(None, llm_engine=eng)
+        cli = Client(port=srv.port, timeout_s=30.0)
+        try:
+            yield srv, cli, eng
+        finally:
+            cli.close()
+            srv.stop()
+
+    def test_malformed_frames_then_clean_generate(self, served, model):
+        srv, cli, eng = served
+        rng = random.Random(0)
+        magics = [0x54535450,        # PTST stream
+                  0x52535450,        # PTSR traced tensor request
+                  0x56535450,        # PTSV version probe
+                  0x43535450,        # PTSC cancel
+                  0xDEADBEEF]        # not a protocol magic at all
+        n_frames = 0
+        for _ in range(120):
+            s = socket.create_connection(("127.0.0.1", srv.port),
+                                         timeout=5.0)
+            try:
+                kind = rng.randrange(4)
+                magic = rng.choice(magics)
+                tag = rng.getrandbits(32)
+                if kind == 0:        # truncated header, then vanish
+                    s.sendall(struct.pack("<I", magic) + b"\x01")
+                elif kind == 1:      # declared length never delivered
+                    ln = rng.randrange(64, 1 << 20)
+                    s.sendall(struct.pack("<IQI", magic, tag, ln)
+                              + b"x" * rng.randrange(0, 64))
+                elif kind == 2:      # well-framed garbage body
+                    body = bytes(rng.randrange(256) for _ in
+                                 range(rng.randrange(0, 64)))
+                    s.sendall(struct.pack("<IQI", magic, tag,
+                                          len(body)) + body)
+                else:                # pure junk bytes
+                    s.sendall(bytes(rng.randrange(256) for _ in
+                                    range(rng.randrange(1, 40))))
+                n_frames += 1
+            finally:
+                s.close()
+        assert n_frames >= 100
+        # the server is still fully functional and leak-free
+        out = cli.generate([5, 9, 2], max_new_tokens=5)
+        assert np.array_equal(
+            out, _ref(model, [5, 9, 2], max_new_tokens=5))
+        deadline = time.time() + 30
+        while eng.active() and time.time() < deadline:
+            time.sleep(0.05)
+        assert eng.allocator.num_used == 0
+        eng.allocator.check()

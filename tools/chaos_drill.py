@@ -28,10 +28,24 @@ the recovery contract from docs/fault_tolerance.md:
                      GradScaler state restored): final weights are
                      BITWISE-identical to an uninterrupted control run
                      (delegates to tools/replay_check.py).
+  llm_overload_shed — a stream flood beyond the KV admission watermark
+                     is refused AT ADMISSION (retry_after_ms hint in
+                     the error payload, llm_admission_rejected_total
+                     counted, zero preemptions) while admitted streams
+                     decode to exact dense parity and the pool drains
+                     to zero.
+  llm_drain_sigterm — SIGTERM during live streams: serve_forever
+                     drains, every client gets a terminal frame (never
+                     a bare reset), KV pool empties, and the process
+                     dies with the honest SIGTERM wait status.
+  llm_decode_error — an injected decode exception error-terminates
+                     exactly ONE sequence; the other finishes with
+                     dense parity and every KV block is freed.
 
 Usage:
   python tools/chaos_drill.py --self-test        # all drills (CPU)
   python tools/chaos_drill.py --drill kill_mid_save
+  python tools/chaos_drill.py --list             # drill inventory
 """
 
 from __future__ import annotations
@@ -357,7 +371,8 @@ res = {
     "allocator_check_ok": leak_check,
     "cancelled_total": obs.counter(
         "serving_stream_cancelled_total").value(),
-    "shed_total": obs.counter("requests_shed_total").value(),
+    "shed_total": (obs.counter("requests_shed_total").value(kind="stream")
+                   + obs.counter("requests_shed_total").value(kind="tensor")),
     "flight_cancel_events": sum(
         1 for e in obs.flight.recorder().events()
         if e.get("kind") == "serving_stream_cancelled"),
@@ -401,6 +416,315 @@ def drill_stream_disconnect(tmp):
             f"cancel counted (sheds untouched)")
 
 
+_LLM_OVERLOAD = r"""
+import json, sys, threading
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu.inference import Client, Server
+from paddle_tpu.models import GPTLanguageModel
+from paddle_tpu.serving_llm import LLMEngine
+
+out = sys.argv[1]
+model = GPTLanguageModel()
+# 8-block pool, watermark 0.5 -> admission budget of 4 blocks; each
+# request projects ceil((5 prompt + 6 new)/4) = 3 blocks, so only one
+# fits at a time and a 6-client flood MUST see rejections
+engine = LLMEngine(model, block_size=4, pool_blocks=8)
+srv = Server(None, llm_engine=engine)
+PROMPT = [5, 6, 7, 8, 9]
+results = []
+lock = threading.Lock()
+
+def worker(i):
+    cli = Client(port=srv.port, timeout_s=120.0)
+    try:
+        toks = cli.generate(PROMPT, max_new_tokens=6, retry=False)
+        with lock:
+            results.append(("ok", [int(t) for t in toks]))
+    except RuntimeError as e:
+        with lock:
+            results.append(("rejected", str(e)))
+    finally:
+        cli.close()
+
+threads = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+# parity reference: the same prompt on the now-idle server (greedy
+# decode is batch-independent, so admitted-under-load == solo)
+cli = Client(port=srv.port, timeout_s=120.0)
+ref = [int(t) for t in cli.generate(PROMPT, max_new_tokens=6)]
+cli.close()
+ok = [r for r in results if r[0] == "ok"]
+rej = [r for r in results if r[0] == "rejected"]
+res = {
+    "n_ok": len(ok),
+    "n_rejected": len(rej),
+    "parity": all(r[1] == ref for r in ok),
+    "hints": all("retry_after_ms=" in r[1] for r in rej),
+    "admission_rejected_total": obs.counter(
+        "llm_admission_rejected_total").value(),
+    "preempted_total": obs.counter("kv_blocks_preempted_total").value(),
+    "kv_used_after": engine.allocator.num_used,
+}
+srv.stop()
+json.dump(res, open(out, "w"))
+"""
+
+
+def drill_llm_overload_shed(tmp):
+    """Stream flood past the KV watermark: extras rejected at
+    admission with a retry-after hint, zero preemption thrash,
+    admitted streams keep exact parity, pool drains to zero."""
+    script = os.path.join(tmp, "llm_overload.py")
+    with open(script, "w") as f:
+        f.write(_LLM_OVERLOAD)
+    out = os.path.join(tmp, "llm_overload.json")
+    env = _env(tmp)
+    env["FLAGS_kv_admission_watermark"] = "0.5"
+    proc = subprocess.run(
+        [sys.executable, script, out], env=env,
+        capture_output=True, text=True, timeout=300)
+    _check(proc.returncode == 0,
+           f"overload run died rc={proc.returncode}\n{proc.stderr}")
+    res = json.load(open(out))
+    _check(res["n_ok"] >= 1 and res["n_rejected"] >= 1
+           and res["n_ok"] + res["n_rejected"] == 6,
+           f"flood should split into admitted + rejected: {res}")
+    _check(res["hints"],
+           f"rejection payloads lack the retry_after_ms hint: {res}")
+    _check(res["admission_rejected_total"] == res["n_rejected"],
+           f"llm_admission_rejected_total disagrees with client "
+           f"rejections: {res}")
+    _check(res["preempted_total"] == 0,
+           f"watermark admission must prevent preemption thrash: {res}")
+    _check(res["parity"],
+           f"admitted-under-load output diverged from solo run: {res}")
+    _check(res["kv_used_after"] == 0,
+           f"KV blocks leaked after the flood: {res}")
+    return (f"{res['n_rejected']} of 6 refused at admission with "
+            f"retry hints, 0 preemptions, {res['n_ok']} admitted with "
+            f"exact parity, pool drained")
+
+
+_LLM_DRAIN_SERVER = r"""
+import json, sys
+import paddle_tpu as pt
+from paddle_tpu.inference import Server
+from paddle_tpu.models import GPTLanguageModel
+from paddle_tpu.serving_llm import LLMEngine
+
+out, portfile = sys.argv[1], sys.argv[2]
+model = GPTLanguageModel()
+# pool sized so 4 concurrent 209-token streams fit WITHOUT
+# preemption (4 x 53 blocks) — the drill measures drain behaviour,
+# not pool contention, and a starved stream would stall the driver
+engine = LLMEngine(model, block_size=4, pool_blocks=256)
+srv = Server(None, llm_engine=engine)
+
+def on_drained(server):
+    ok = True
+    try:
+        engine.allocator.check()
+    except AssertionError:
+        ok = False
+    json.dump({"kv_used": engine.allocator.num_used,
+               "check_ok": ok,
+               "open_streams": len(server._llm._reqs)},
+              open(out, "w"))
+
+with open(portfile, "w") as f:
+    f.write(str(srv.port))
+srv.serve_forever(on_drained=on_drained)
+"""
+
+
+def drill_llm_drain_sigterm(tmp):
+    """SIGTERM with 4 live streams: drain gives every client a
+    terminal frame (finish or explicit drain error, never a bare
+    reset), empties the KV pool, and exits with the SIGTERM status."""
+    import threading
+    from paddle_tpu.inference import Client
+    script = os.path.join(tmp, "llm_drain_server.py")
+    with open(script, "w") as f:
+        f.write(_LLM_DRAIN_SERVER)
+    out = os.path.join(tmp, "llm_drain_state.json")
+    portfile = os.path.join(tmp, "llm_drain_port.txt")
+    for p in (out, portfile):
+        if os.path.exists(p):
+            os.remove(p)
+    env = _env(tmp)
+    env["FLAGS_serving_drain_deadline_s"] = "1.0"
+    proc = subprocess.Popen(
+        [sys.executable, script, out, portfile], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        deadline = time.time() + 120
+        while not os.path.exists(portfile) and time.time() < deadline:
+            if proc.poll() is not None:  # communicate() only on death
+                raise DrillFailure(
+                    f"drain server died during startup\n"
+                    f"{proc.communicate()[1]}")
+            time.sleep(0.1)
+        _check(os.path.exists(portfile), "drain server never bound")
+        port = int(open(portfile).read())
+
+        outcomes, started = [], []
+        lock = threading.Lock()
+
+        def worker():
+            ev = threading.Event()
+            with lock:
+                started.append(ev)
+            cli = Client(port=port, timeout_s=120.0)
+            try:
+                gen = cli.generate_stream([3, 4, 5] * 3,
+                                          max_new_tokens=200)
+                for _ in range(2):
+                    next(gen)
+                ev.set()
+                for _ in gen:
+                    pass
+                outcome = ("finished", "")
+            except RuntimeError as e:
+                outcome = ("drain" if "drain" in str(e) else "error",
+                           str(e))
+            except Exception as e:  # noqa: BLE001
+                outcome = (type(e).__name__, str(e))
+            finally:
+                ev.set()
+                cli.close()
+            with lock:
+                outcomes.append(outcome)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            with lock:
+                if len(started) == 4 and all(e.is_set()
+                                             for e in started):
+                    break
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGTERM)
+        for t in threads:
+            t.join(timeout=120)
+        rc = proc.wait(timeout=120)
+        err = proc.stderr.read()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    _check(rc == -signal.SIGTERM,
+           f"drained server must die with the SIGTERM wait status, "
+           f"rc={rc}\n{err}")
+    _check(len(outcomes) == 4 and all(o[0] in ("finished", "drain")
+                                      for o in outcomes),
+           f"every client must see a terminal frame, got {outcomes}")
+    _check(os.path.exists(out), "on_drained state never written")
+    state = json.load(open(out))
+    _check(state["kv_used"] == 0 and state["check_ok"]
+           and state["open_streams"] == 0,
+           f"pool not clean after drain: {state}")
+    n_drain = sum(1 for o in outcomes if o[0] == "drain")
+    return (f"4 streams: {4 - n_drain} finished, {n_drain} got drain "
+            f"frames; pool empty, exit status honest (SIGTERM)")
+
+
+_LLM_DECODE_ERROR = r"""
+import json, sys
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu.models import GPTLanguageModel
+from paddle_tpu.serving_llm import LLMEngine
+
+out = sys.argv[1]
+model = GPTLanguageModel()
+engine = LLMEngine(model, block_size=4, pool_blocks=32)
+prompts = {"a": [1, 2, 3, 4, 5], "b": [7, 8, 9]}
+ids = {k: engine.add_request(np.asarray(p, np.int32),
+                             max_new_tokens=8, temperature=0.0, seed=0)
+       for k, p in prompts.items()}
+events = []
+for _ in range(64):
+    events.extend(engine.step())
+    if not engine.active():
+        break
+errors = [e for e in events if e["type"] == "error"]
+finished = [e for e in events if e["type"] == "finished"]
+toks = {}
+for e in events:
+    if e["type"] == "token":
+        toks.setdefault(e["seq_id"], []).append(int(e["token"]))
+ref_match = None
+if len(finished) == 1:
+    sid = finished[0]["seq_id"]
+    name = [k for k, v in ids.items() if v == sid][0]
+    # the at=5 fault already fired, so a fresh engine decodes clean
+    eng2 = LLMEngine(model, block_size=4, pool_blocks=32)
+    eng2.add_request(np.asarray(prompts[name], np.int32),
+                     max_new_tokens=8, temperature=0.0, seed=0)
+    ref = []
+    for _ in range(64):
+        for e in eng2.step():
+            if e["type"] == "token":
+                ref.append(int(e["token"]))
+        if not eng2.active():
+            break
+    ref_match = toks.get(sid, []) == ref
+check_ok = True
+try:
+    engine.allocator.check()
+except AssertionError:
+    check_ok = False
+res = {
+    "n_error": len(errors),
+    "n_finished": len(finished),
+    "error_msgs": [e["error"] for e in errors],
+    "ref_match": ref_match,
+    "kv_used_after": engine.allocator.num_used,
+    "check_ok": check_ok,
+    "faults_injected": obs.counter(
+        "faults_injected_total").value(point="llm_decode"),
+}
+json.dump(res, open(out, "w"))
+"""
+
+
+def drill_llm_decode_error(tmp):
+    """Injected decode exception: exactly one sequence error-
+    terminates, the other finishes with dense parity, blocks freed."""
+    script = os.path.join(tmp, "llm_decode_error.py")
+    with open(script, "w") as f:
+        f.write(_LLM_DECODE_ERROR)
+    out = os.path.join(tmp, "llm_decode_error.json")
+    proc = subprocess.run(
+        [sys.executable, script, out],
+        env=_env(tmp, fault_spec="llm_decode:at=5:exc=RuntimeError"),
+        capture_output=True, text=True, timeout=300)
+    _check(proc.returncode == 0,
+           f"decode-error run died rc={proc.returncode}\n{proc.stderr}")
+    res = json.load(open(out))
+    _check(res["n_error"] == 1 and res["n_finished"] == 1,
+           f"exactly one sequence should fail, one finish: {res}")
+    _check(any("fault injected" in m for m in res["error_msgs"]),
+           f"error event does not carry the injected fault: {res}")
+    _check(res["faults_injected"] == 1,
+           f"faults_injected_total{{point=llm_decode}} should be 1: "
+           f"{res}")
+    _check(res["ref_match"],
+           f"survivor diverged from the clean reference: {res}")
+    _check(res["kv_used_after"] == 0 and res["check_ok"],
+           f"KV blocks leaked after the decode error: {res}")
+    return ("decode fault killed one of two sequences; survivor kept "
+            "exact parity, all KV blocks freed")
+
+
 def drill_exact_resume(tmp):
     """SIGKILL mid-epoch + v3 resume == uninterrupted run, bitwise."""
     try:
@@ -421,6 +745,9 @@ DRILLS = {
     "nonfinite_skip": drill_nonfinite_skip,
     "exact_resume": drill_exact_resume,
     "stream_disconnect": drill_stream_disconnect,
+    "llm_overload_shed": drill_llm_overload_shed,
+    "llm_drain_sigterm": drill_llm_drain_sigterm,
+    "llm_decode_error": drill_llm_decode_error,
 }
 
 
@@ -432,9 +759,20 @@ def main(argv=None) -> int:
                         help="run one drill")
     parser.add_argument("--keep", action="store_true",
                         help="keep the scratch directory")
+    parser.add_argument("--list", action="store_true",
+                        help="print the drill inventory and exit")
     args = parser.parse_args(argv)
+    if args.list:
+        # inventory only: exits before the jax import below, so it is
+        # cheap enough for CI to sanity-check the drill roster
+        for name in sorted(DRILLS):
+            doc = (DRILLS[name].__doc__ or "").strip()
+            first = " ".join(
+                line.strip() for line in doc.splitlines()[:3]).strip()
+            print(f"{name:20s} {first}")
+        return 0
     if not args.self_test and not args.drill:
-        parser.error("pass --self-test or --drill NAME")
+        parser.error("pass --self-test, --drill NAME, or --list")
 
     # the driver half imports paddle_tpu itself — force CPU first
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
