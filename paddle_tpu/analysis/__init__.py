@@ -1,0 +1,48 @@
+"""paddle_tpu.analysis — the ptlint pass-based static-analysis layer.
+
+The rebuild's answer to the reference framework's registered-graph-pass
+system: small composable AST passes over the Python tree, driven by
+``tools/ptlint.py`` and the tier-1 test suite.
+
+**Import contract:** everything in this package is stdlib-only (ast /
+json / os / re).  ``tools/ptlint.py`` loads it standalone via
+``importlib`` *without* going through ``paddle_tpu/__init__.py`` (which
+imports jax), so the linter keeps the doc checkers' milliseconds-fast,
+jax-free property.  Never import from the parent package here.
+
+Rule catalog (docs/static_analysis.md has the long form):
+
+- ``trace-purity``     host effects in jit-reachable code
+- ``callback-cache``   raw host callbacks vs the persistent compile cache
+- ``lock-discipline``  `# guarded-by:` fields mutate only under their lock
+- ``clock-hygiene``    wall-clock time.time() in duration subtractions
+- ``silent-failure``   `except …: pass` without a counter or a reason
+- ``flag-freeze``      GLOBAL_FLAGS.get(...) at module import time
+- ``flags-doc``        flags need help= + docs (ex check_flags_doc.py)
+- ``metrics-doc``      metric names need docs (ex check_metrics_doc.py)
+"""
+
+from . import base, jitgraph  # noqa: F401  (re-exported submodules)
+from . import (callback_cache, clock_hygiene, flag_freeze, flags_doc,
+               lock_discipline, metrics_doc, silent_failure,
+               trace_purity)
+from .base import Context, Finding, Pass, SourceModule  # noqa: F401
+
+_PASSES = None
+
+
+def all_passes():
+    """One fresh registry instance list (stable order = report order)."""
+    global _PASSES
+    if _PASSES is None:
+        _PASSES = [
+            trace_purity.TracePurityPass(),
+            callback_cache.CallbackCachePass(),
+            lock_discipline.LockDisciplinePass(),
+            clock_hygiene.ClockHygienePass(),
+            silent_failure.SilentFailurePass(),
+            flag_freeze.FlagFreezePass(),
+            flags_doc.FlagsDocPass(),
+            metrics_doc.MetricsDocPass(),
+        ]
+    return list(_PASSES)

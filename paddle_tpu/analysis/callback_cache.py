@@ -1,0 +1,140 @@
+"""callback-cache: host callbacks must not silently kill cacheability.
+
+XLA refuses to persist an executable whose HLO contains a host callback
+— with ``FLAGS_compile_cache_dir`` set, one stray ``jax.debug.callback``
+or ``io_callback`` in the traced program means every restart pays full
+compile again (the bug PR 8 burned a root-cause cycle on dynamically).
+The sanctioned pattern routes probe signals through reserved ``_pt_*``
+metric leaves on the step outputs when deferring (see
+``static/__init__.py`` ``_defer_probes``): the callback only appears in
+branches controlled by a defer test, so the cached program is
+callback-free.
+
+This pass walks the same jit call graph as trace-purity and flags any
+callback call reachable from a jit entry point that is not lexically
+under an ``if`` whose test mentions ``defer``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .base import FUNC_NODES, Finding, Pass
+from .jitgraph import ModuleGraph, is_callback_call
+
+
+class CallbackCachePass(Pass):
+    name = "callback-cache"
+    help = ("jax.debug.callback/io_callback reachable from a jit entry "
+            "point outside a deferred-probe guard (disqualifies the "
+            "persistent compile cache)")
+
+    def run(self, modules, ctx):
+        findings = []
+        for mod in modules:
+            graph = ModuleGraph(mod)
+            roots = graph.jit_roots()
+            if not roots:
+                continue
+            seen_sites = set()
+            visited = set()
+            stack = [(fn, desc, False) for fn, desc in roots]
+
+            def scan(node, guarded, cls, desc):
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, FUNC_NODES):
+                        continue  # reached via calls, scanned separately
+                    if isinstance(child, ast.If):
+                        try:
+                            test = ast.unparse(child.test)
+                        except Exception:  # pragma: no cover
+                            test = ""
+                        scan(child, guarded or "defer" in test, cls, desc)
+                        continue
+                    if isinstance(child, ast.Call):
+                        if is_callback_call(child):
+                            if not guarded \
+                                    and child.lineno not in seen_sites:
+                                seen_sites.add(child.lineno)
+                                findings.append(Finding(
+                                    self.name, mod.rel, child.lineno,
+                                    "host callback reachable from jit "
+                                    f"entry point {desc} outside a "
+                                    "deferred-probe guard — a callback "
+                                    "in the HLO disqualifies the "
+                                    "executable from the persistent "
+                                    "compile cache "
+                                    "(FLAGS_compile_cache_dir); route "
+                                    "it through the `_pt_*` deferred "
+                                    "path (static/__init__.py, "
+                                    "`_defer_probes`) or suppress with "
+                                    "a reason"))
+                            # callback args are host-side: don't descend
+                            continue
+                        for callee in graph.resolve_call(child, cls):
+                            stack.append((callee, desc, guarded))
+                    scan(child, guarded, cls, desc)
+
+            while stack:
+                fn, desc, guarded = stack.pop()
+                if (id(fn), guarded) in visited:
+                    continue
+                visited.add((id(fn), guarded))
+                scan(fn, guarded, graph.enclosing_class_name(fn), desc)
+        return findings
+
+    positive = (
+        # raw callback in a jitted function
+        """
+        import jax
+
+        def step(x):
+            jax.debug.callback(print, x)
+            return x
+
+        f = jax.jit(step)
+        """,
+        # transitive io_callback through a helper
+        """
+        import jax
+        from jax.experimental import io_callback
+
+        def emit(x):
+            io_callback(print, None, x)
+
+        def step(x):
+            emit(x)
+            return x
+
+        f = jax.jit(step)
+        """,
+    )
+    negative = (
+        # the PR 8 pattern: callback only in the defer-guarded branch
+        """
+        import jax
+
+        class T:
+            def _step(self, x):
+                if self._defer_probes:
+                    x = x + 1
+                else:
+                    jax.debug.callback(print, x)
+                return x
+
+            def build(self):
+                self._jitted = jax.jit(self._step)
+        """,
+        # callback in host-only code, never traced
+        """
+        import jax
+
+        def host_only(x):
+            jax.debug.callback(print, x)
+
+        def step(x):
+            return x * 2
+
+        f = jax.jit(step)
+        """,
+    )

@@ -1,0 +1,159 @@
+"""Shared jit-entry-point discovery and same-module call-graph walking.
+
+Used by the trace-purity and callback-cache passes.  The model is
+deliberately lexical and same-module only:
+
+- **roots** are functions handed to ``jax.jit`` / ``instrumented_jit``
+  / ``pl.pallas_call`` (first positional argument, unwrapping one level
+  of ``functools.partial`` / ``shard_map``-style wrapper calls) or
+  decorated with a jit wrapper (``@jax.jit``, ``@to_static``,
+  ``@declarative``).
+- **edges** resolve bare-name calls to same-module ``def``s (any
+  nesting level; if several defs share the name, all are traversed —
+  conservative) and ``self.m()`` calls to methods of the enclosing
+  class.  Cross-module calls are out of scope: a known heuristic limit,
+  documented in docs/static_analysis.md.
+- ``jax.debug.callback`` / ``io_callback`` *arguments* are never
+  traversed: the payload runs on the host, which is exactly the
+  allowlisted probe pattern.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from .base import FUNC_NODES
+
+#: callables whose first positional argument becomes traced code
+JIT_WRAPPERS = {"jit", "instrumented_jit", "to_static", "declarative"}
+PALLAS_WRAPPERS = {"pallas_call"}
+
+
+def attr_chain(node: ast.AST) -> str:
+    """Dotted name of an attribute chain rooted at a Name, else ''."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def is_callback_call(call: ast.Call) -> bool:
+    chain = attr_chain(call.func)
+    return (chain.endswith("debug.callback")
+            or chain.split(".")[-1] == "io_callback")
+
+
+def iter_scope(fn: ast.AST):
+    """Nodes lexically in ``fn``'s own executed scope: nested ``def``s
+    are skipped (they run only when called — the graph walks them as
+    separate functions) and callback-call *arguments* are skipped
+    (host-side payloads).  Lambda bodies are kept: traced control flow
+    runs them."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, FUNC_NODES):
+            continue
+        if isinstance(node, ast.Call) and is_callback_call(node):
+            stack.append(node.func)
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class ModuleGraph:
+    """Function index + jit-root discovery for one SourceModule."""
+
+    def __init__(self, mod):
+        self.mod = mod
+        self.defs: Dict[str, List[ast.AST]] = {}
+        self.methods: Dict[Tuple[str, str], ast.AST] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, FUNC_NODES):
+                self.defs.setdefault(node.name, []).append(node)
+                cls = mod.enclosing(node, (ast.ClassDef,))
+                if cls is not None:
+                    self.methods.setdefault((cls.name, node.name), node)
+
+    def enclosing_class_name(self, node: ast.AST):
+        cls = self.mod.enclosing(node, (ast.ClassDef,))
+        return cls.name if cls is not None else None
+
+    def resolve_target(self, expr: ast.AST, class_name) -> List[ast.AST]:
+        """Resolve an expression handed to a jit wrapper to local
+        function defs (unwraps one wrapper-call level for partial /
+        shard_map shapes)."""
+        if isinstance(expr, ast.Call):
+            if expr.args:
+                return self.resolve_target(expr.args[0], class_name)
+            return []
+        if isinstance(expr, ast.Lambda):
+            return [expr]
+        if isinstance(expr, ast.Name):
+            return list(self.defs.get(expr.id, ()))
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and class_name):
+            m = self.methods.get((class_name, expr.attr))
+            return [m] if m is not None else []
+        return []
+
+    def resolve_call(self, call: ast.Call, class_name) -> List[ast.AST]:
+        """Same-module callees of a direct call (no wrapper unwrap)."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            return list(self.defs.get(f.id, ()))
+        if (isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "self" and class_name):
+            m = self.methods.get((class_name, f.attr))
+            return [m] if m is not None else []
+        return []
+
+    def jit_roots(self) -> List[Tuple[ast.AST, str]]:
+        """[(fn_node, description)] for every traced entry point."""
+        roots: List[Tuple[ast.AST, str]] = []
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.Call):
+                chain = attr_chain(node.func)
+                last = chain.split(".")[-1] if chain else ""
+                if (last in JIT_WRAPPERS or last in PALLAS_WRAPPERS) \
+                        and node.args:
+                    cls = self.enclosing_class_name(node)
+                    for fn in self.resolve_target(node.args[0], cls):
+                        roots.append(
+                            (fn, f"`{chain}(…)` at line {node.lineno}"))
+            elif isinstance(node, FUNC_NODES):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    chain = attr_chain(target)
+                    if chain.split(".")[-1] in JIT_WRAPPERS:
+                        roots.append((node, f"`@{chain}`"))
+        seen, out = set(), []
+        for fn, desc in roots:
+            if id(fn) not in seen:
+                seen.add(id(fn))
+                out.append((fn, desc))
+        return out
+
+    def reachable(self, roots) -> Dict[int, Tuple[ast.AST, str]]:
+        """{id(fn): (fn, root_description)} over same-module edges."""
+        out: Dict[int, Tuple[ast.AST, str]] = {}
+        stack = list(roots)
+        while stack:
+            fn, desc = stack.pop()
+            if id(fn) in out:
+                continue
+            out[id(fn)] = (fn, desc)
+            cls = self.enclosing_class_name(fn)
+            for node in iter_scope(fn):
+                if isinstance(node, ast.Call):
+                    for callee in self.resolve_call(node, cls):
+                        if id(callee) not in out:
+                            stack.append((callee, desc))
+        return out

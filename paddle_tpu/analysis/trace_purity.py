@@ -1,0 +1,166 @@
+"""trace-purity: host effects must not reach jit-traced code.
+
+A function reachable from a jit entry point (``jax.jit`` /
+``instrumented_jit`` target, ``pallas_call`` kernel, ``@to_static``
+body) runs at **trace time**: a ``time.time()``, ``random.*``,
+``os.environ`` or flag read there bakes one host value into the
+compiled program forever (or silently changes it across retraces), and
+metric/flight writes fire once per trace instead of once per step.  The
+deliberate escape hatch is ``jax.debug.callback`` — its payload runs on
+the host per execution — so callback arguments are allowlisted and
+never traversed (the callback-cache pass owns *their* hygiene).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .base import Finding, Pass, flags_aliases
+from .jitgraph import ModuleGraph, attr_chain, is_callback_call, iter_scope
+
+_ENV_CALLS = {"os.getenv", "os.environ.get", "os.putenv"}
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+
+
+def _effects(fn, aliases):
+    """[(lineno, description)] host effects lexically in fn's scope."""
+    out = []
+    for node in iter_scope(fn):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            kind = "global" if isinstance(node, ast.Global) else "nonlocal"
+            out.append((node.lineno,
+                        f"`{kind} {', '.join(node.names)}` write"))
+        elif isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            if not chain or is_callback_call(node):
+                continue
+            parts = chain.split(".")
+            root, last = parts[0], parts[-1]
+            if root in ("time", "_time"):
+                out.append((node.lineno, f"`{chain}()` host clock read"))
+            elif root == "random" or chain.startswith(("np.random.",
+                                                       "numpy.random.")):
+                out.append((node.lineno, f"`{chain}()` host RNG"))
+            elif chain in _ENV_CALLS:
+                out.append((node.lineno, f"`{chain}()` environment read"))
+            elif last == "get" and any(
+                    "FLAGS" in p or p in aliases for p in parts[:-1]):
+                out.append((node.lineno, f"`{chain}()` flag read"))
+            elif (last in _METRIC_FACTORIES and len(parts) <= 2
+                  and root not in ("self", "cls")):
+                out.append((node.lineno,
+                            f"`{chain}()` metric registration/mutation"))
+            elif (last == "record" and len(parts) >= 2
+                  and "flight" in parts[-2].lower()):
+                out.append((node.lineno,
+                            f"`{chain}()` flight-recorder write"))
+        elif isinstance(node, ast.Attribute):
+            if (node.attr == "environ" and isinstance(node.value, ast.Name)
+                    and node.value.id == "os"):
+                out.append((node.lineno, "`os.environ` access"))
+    return out
+
+
+class TracePurityPass(Pass):
+    name = "trace-purity"
+    help = ("host effects (time/random/os.environ/flag reads/metric "
+            "writes/global writes) in functions reachable from jit "
+            "entry points")
+
+    def run(self, modules, ctx):
+        findings = []
+        for mod in modules:
+            graph = ModuleGraph(mod)
+            roots = graph.jit_roots()
+            if not roots:
+                continue
+            aliases = flags_aliases(mod.tree)
+            seen = set()
+            for fn, desc in graph.reachable(roots).values():
+                fname = getattr(fn, "name", "<lambda>")
+                for lineno, what in _effects(fn, aliases):
+                    key = (lineno, what)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    findings.append(Finding(
+                        self.name, mod.rel, lineno,
+                        f"host effect {what} in `{fname}`, reachable "
+                        f"from jit entry point {desc} — traced code must "
+                        "be pure: the value is baked in at trace time "
+                        "(or silently changes across retraces)"))
+        return findings
+
+    positive = (
+        # direct host clock in a jitted function
+        """
+        import time
+        import jax
+
+        def step(x):
+            t = time.time()
+            return x + t
+
+        f = jax.jit(step)
+        """,
+        # flag read in a method jitted via self-reference
+        """
+        import jax
+        from paddle_tpu.flags import GLOBAL_FLAGS
+
+        class T:
+            def _step(self, x):
+                if GLOBAL_FLAGS.get("debug"):
+                    return x
+                return x * 2
+
+            def build(self):
+                self._jitted = jax.jit(self._step)
+        """,
+        # transitive: global write in a helper called from the root
+        """
+        import jax
+
+        _n = 0
+
+        def _inner(x):
+            global _n
+            _n = 1
+            return x
+
+        def outer(x):
+            return _inner(x)
+
+        f = jax.jit(outer)
+        """,
+    )
+    negative = (
+        # host effects confined to never-traced functions
+        """
+        import time
+        import jax
+
+        def host_loop(x):
+            t0 = time.monotonic()
+            return x, t0
+
+        def step(x):
+            return x * 2
+
+        f = jax.jit(step)
+        """,
+        # the allowlisted probe pattern: callback args are host-side
+        """
+        import time
+        import jax
+
+        def probe(v):
+            jax.debug.callback(lambda x: time.time(), v)
+
+        def step(x):
+            probe(x)
+            return x
+
+        f = jax.jit(step)
+        """,
+    )

@@ -111,6 +111,7 @@ def accelerator_configured() -> bool:
         cfg = jax.config.read("jax_platforms")
         if cfg:
             plats = cfg
+    # ptlint: disable=silent-failure -- jax.config.read is a best-effort probe for a config key older jax builds lack; the env fallback above stands
     except Exception:  # noqa: BLE001
         pass
     return any(p in plats.lower()

@@ -377,12 +377,14 @@ class DeviceLoader:
         try:
             for _ in range(self.buffer_size):
                 buf.append(self._put(next(it)))
+        # ptlint: disable=silent-failure -- StopIteration is normal exhaustion: the source had fewer items than the prefetch depth
         except StopIteration:
             pass
         while buf:
             out = buf.pop(0)
             try:
                 buf.append(self._put(next(it)))
+            # ptlint: disable=silent-failure -- StopIteration is normal exhaustion: drain the remaining buffer
             except StopIteration:
                 pass
             yield out

@@ -37,6 +37,25 @@ import numpy as np
 _SHM_MIN_BYTES = 1 << 14
 
 
+def _note_swallowed(where: str, exc: BaseException) -> None:
+    """A teardown/decode-path error was deliberately swallowed: count
+    it instead of losing it — a failed leftover decode is a leaked shm
+    segment, and a string of them should be visible on a dashboard."""
+    try:
+        from ..observability import metrics as _metrics
+        _metrics.counter(
+            "dataloader_swallowed_errors_total",
+            "errors swallowed on DataLoader teardown/decode paths "
+            "(where: decode_sweep | decode_leftover | shutdown_put | "
+            "shutdown_close)", always=True).inc(where=where)
+        from ..observability import flight as _flight
+        _flight.record("dataloader_swallowed_error", where=where,
+                       error=repr(exc)[:200])
+    # ptlint: disable=silent-failure -- telemetry about a swallowed error must never itself raise (interpreter may be tearing down)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 class WorkerInfo:
     """Per-worker shard info, available inside worker processes via
     :func:`get_worker_info` (ref: dataloader/worker.py get_worker_info)."""
@@ -65,6 +84,7 @@ def _encode(obj, segments: List[SharedMemory]):
             # copy-out); keep this process's resource tracker out of it.
             try:
                 resource_tracker.unregister(shm._name, "shared_memory")
+            # ptlint: disable=silent-failure -- resource_tracker unregistration is best-effort across Python versions; worst case is a spurious tracker warning at exit
             except Exception:
                 pass
             dst = np.ndarray(obj.shape, obj.dtype, buffer=shm.buf)
@@ -121,15 +141,15 @@ def _drain_and_reap(result_qs, workers, leftovers, timeout: float = 10.0):
             if item[2] is None:
                 try:
                     _decode(item[1])
-                except Exception:
-                    pass
+                except Exception as e:  # noqa: BLE001
+                    _note_swallowed("decode_sweep", e)
         return got
 
     for payload in leftovers:
         try:
             _decode(payload)
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001
+            _note_swallowed("decode_leftover", e)
     deadline = time.monotonic() + timeout
     while (any(w.is_alive() for w in workers)
            and time.monotonic() < deadline):
@@ -203,6 +223,7 @@ def _iterable_worker_loop(dataset, collate_fn, batch_size: int,
                     shm.close()
                     try:
                         shm.unlink()
+                    # ptlint: disable=silent-failure -- the parent may have unlinked first on a racing teardown; either side unlinking is enough
                     except Exception:
                         pass
                 break
@@ -302,20 +323,21 @@ class MultiprocessIter:
         for q in self._index_qs:
             try:
                 q.put(None)
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001
+                _note_swallowed("shutdown_put", e)
         leftovers = list(self._reorder.values())
         self._reorder.clear()
         _drain_and_reap(self._result_q, self._workers, leftovers)
         for q in self._index_qs + [self._result_q]:
             try:
                 q.close()
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001
+                _note_swallowed("shutdown_close", e)
 
     def __del__(self):
         try:
             self.shutdown()
+        # ptlint: disable=silent-failure -- finalizer: shutdown() already counts its own swallowed errors; raising from __del__ only prints noise
         except Exception:
             pass
 
@@ -397,5 +419,6 @@ class IterableMultiprocessIter:
     def __del__(self):
         try:
             self.shutdown()
+        # ptlint: disable=silent-failure -- finalizer: shutdown() already counts its own swallowed errors; raising from __del__ only prints noise
         except Exception:
             pass

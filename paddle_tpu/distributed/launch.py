@@ -201,7 +201,7 @@ def launch_elastic(cmd: Sequence[str], nproc: int,
                             start_control_plane=start_control_plane)
         if code == 0:
             return 0
-        t_dead = time.time()
+        t_dead = time.monotonic()
         kind = classify_exit(code)
         _metrics.counter(
             "elastic_restarts_total",
@@ -212,7 +212,7 @@ def launch_elastic(cmd: Sequence[str], nproc: int,
         if attempt >= max_restarts:
             return code
         if kind == "crash":
-            now = time.time()
+            now = time.monotonic()
             crash_times.append(now)
             while crash_times and now - crash_times[0] > restart_window_s:
                 crash_times.popleft()
@@ -241,7 +241,7 @@ def launch_elastic(cmd: Sequence[str], nproc: int,
         print(f"[launch] job {'preempted' if kind == 'preempt' else 'failed'}"
               f" rc={code}; gang restart {attempt + 1}/{max_restarts}",
               file=sys.stderr, flush=True)
-        idle_s += time.time() - t_dead
+        idle_s += time.monotonic() - t_dead
         attempt += 1
 
 
@@ -343,9 +343,9 @@ def spawn(func, args=(), nprocs: int = 1, join: bool = True,
         for p in procs:
             if p.is_alive():
                 p.terminate()
-        grace_deadline = time.time() + 5.0
+        grace_deadline = time.monotonic() + 5.0
         for p in procs:
-            p.join(max(0.0, grace_deadline - time.time()))
+            p.join(max(0.0, grace_deadline - time.monotonic()))
             if p.is_alive():
                 p.kill()
                 p.join(1.0)

@@ -53,7 +53,7 @@ class ProgBarLogger(Callback):
         self._t0 = 0.0
 
     def on_epoch_begin(self, epoch, logs=None):
-        self._t0 = time.time()
+        self._t0 = time.perf_counter()
         self._epoch = epoch
 
     def on_batch_end(self, step, logs=None):
@@ -64,7 +64,7 @@ class ProgBarLogger(Callback):
 
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose:
-            dt = time.time() - self._t0
+            dt = time.perf_counter() - self._t0
             items = " ".join(f"{k}={float(v):.4f}"
                              for k, v in (logs or {}).items())
             print(f"[epoch {epoch} done in {dt:.1f}s] {items}")

@@ -408,7 +408,7 @@ class Server:
         # dict carries the per-request span fields (trace_id + the
         # ingress/dequeue unix stamps) that feed the /requests ring
         # and the serving_*_ms histograms.
-        self._rq: collections.deque = collections.deque()
+        self._rq: collections.deque = collections.deque()  # guarded-by: single-owner (batcher thread)
         self._thread.start()
         # live observability: flag-gated HTTP exporter + a bridge thread
         # that scrapes the native transport's stats into the metrics
@@ -476,7 +476,7 @@ class Server:
         rid, payload, trace_id, ingress, is_stream = r
         return {"rid": rid, "payload": payload, "trace_id": trace_id,
                 "ingress_unix": ingress, "dequeue_unix": time.time(),
-                "stream": is_stream}
+                "dequeue_mono": time.monotonic(), "stream": is_stream}
 
     def _drain_transport(self) -> None:
         while True:
@@ -517,11 +517,13 @@ class Server:
                                            final=True)
             else:
                 self.transport.reply(req["rid"], msg, status=-1)
+        # ptlint: disable=silent-failure -- shed notice is courtesy: the client that aged out may already be gone, and the shed is counted right below
         except Exception:  # noqa: BLE001 — client may already be gone
             pass
         try:
             from ..native import stat_add
             stat_add("serving.shed_total")
+        # ptlint: disable=silent-failure -- the native stat registry may not be built in pure-Python runs; the flight record below still fires
         except Exception:  # noqa: BLE001
             pass
         from ..observability import flight as _flight
@@ -636,6 +638,7 @@ class Server:
                                            final=True)
             else:
                 self.transport.reply(req["rid"], msg, status=-1)
+        # ptlint: disable=silent-failure -- error reply is best-effort: the client may already be gone, and _note_error below still counts the outcome
         except Exception:  # noqa: BLE001 — client may already be gone
             pass
         from .. import observability as obs
@@ -828,6 +831,7 @@ class Server:
                             name, help_,
                             buckets=_m.LATENCY_MS_BUCKETS).observe(v)
             _reqtrace.record(rec)
+        # ptlint: disable=silent-failure -- span records are best-effort by contract: a reply must never fail on telemetry
         except Exception:  # noqa: BLE001 — never fail a reply on spans
             pass
 
@@ -843,6 +847,7 @@ class Server:
                 if n_rows <= b:
                     stat_add(f"serving.batch_size_le_{b}")
             stat_add("serving.batch_size_le_inf")
+        # ptlint: disable=silent-failure -- the native stat registry may be absent (pure-Python run); the Python metrics below still record the batch
         except Exception:  # noqa: BLE001 — never fail a batch on stats
             pass
         from .. import observability as obs
@@ -859,6 +864,7 @@ class Server:
         try:
             from ..native import stat_add
             stat_add("serving.batch_errors_total")
+        # ptlint: disable=silent-failure -- the native stat registry may be absent (pure-Python run); serving_errors_total below still counts it
         except Exception:  # noqa: BLE001
             pass
         from .. import observability as obs
@@ -936,16 +942,16 @@ class Client:
         # unique across clients without coordination, never 0 (0 is the
         # wire's "untraced" value)
         self._trace_base = int.from_bytes(os.urandom(6), "little") << 16
-        self._trace_n = 0
+        self._trace_n = 0  # guarded-by: self._conn_lock
         self.last_trace_id: Optional[int] = None
         self._wlock = threading.Lock()
         self._rlock = threading.Lock()
         self._conn_lock = threading.Lock()
-        self._tag = 0
-        self._replies: Dict[int, Tuple[int, bytes]] = {}
+        self._tag = 0  # guarded-by: self._wlock
+        self._replies: Dict[int, Tuple[int, bytes]] = {}  # guarded-by: self._rcond
         self._rcond = threading.Condition()
-        self._sock: Optional[socket.socket] = None
-        self._gen = 0
+        self._sock: Optional[socket.socket] = None  # guarded-by: self._rcond
+        self._gen = 0  # guarded-by: self._rcond
         self._connect()
 
     def make_trace_id(self) -> int:
@@ -980,6 +986,7 @@ class Client:
         if sock is not None:
             try:
                 sock.close()
+            # ptlint: disable=silent-failure -- closing a broken socket: the kernel may refuse, but the fd is dropped either way
             except OSError:
                 pass
 
@@ -1052,6 +1059,7 @@ class Client:
                 try:
                     self._reconnect_with_backoff(
                         max(0, self._max_reconnects - 1), gen, deadline)
+                # ptlint: disable=silent-failure -- transport repair is opportunistic: the original error is re-raised on the next line either way
                 except (ConnectionError, TimeoutError):
                     pass
                 raise
@@ -1089,6 +1097,7 @@ class Client:
                     k, v = line.rsplit("=", 1)
                     try:
                         out[k] = int(v)
+                    # ptlint: disable=silent-failure -- a non-integer stat line is skipped, not fatal: the STATS wire format is k=v per line
                     except ValueError:
                         pass
             return out
@@ -1293,6 +1302,7 @@ class Client:
         try:
             if sock is not None:
                 sock.close()
+        # ptlint: disable=silent-failure -- close() teardown: the fd is dropped whether or not the kernel objects
         except Exception:
             pass
 

@@ -35,6 +35,24 @@ _lib = None
 _lib_lock = threading.Lock()
 
 
+def _note_close_error(kind: str, exc: BaseException) -> None:
+    """A finalizer-path stop()/close() failed: count it instead of
+    losing it — a leaked native handle is otherwise invisible."""
+    try:
+        from ..observability import metrics as _metrics
+        _metrics.counter(
+            "native_close_errors_total",
+            "errors swallowed while closing native handles on "
+            "finalizer paths (kind: control_plane | datafeed | "
+            "ps_server | serving_transport)", always=True).inc(kind=kind)
+        from ..observability import flight as _flight
+        _flight.record("native_close_error", force=True, kind=kind,
+                       error=repr(exc)[:200])
+    # ptlint: disable=silent-failure -- telemetry about a finalizer failure must never itself raise (interpreter may be tearing down)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def _needs_build() -> bool:
     have_so = os.path.exists(_SO_PATH)
     if not os.path.isdir(_CSRC):
@@ -243,8 +261,8 @@ class ControlPlaneServer:
     def __del__(self):
         try:
             self.stop()
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001
+            _note_close_error("control_plane", e)
 
 
 class ControlPlaneClient:
@@ -431,8 +449,8 @@ class NativeDataFeed:
     def __del__(self):
         try:
             self.close()
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001
+            _note_close_error("datafeed", e)
 
 
 # ------------------------------------------------------------ parameter server
@@ -474,8 +492,8 @@ class PsServer:
     def __del__(self):
         try:
             self.stop()
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001
+            _note_close_error("ps_server", e)
 
 
 class PsClient:
@@ -796,6 +814,7 @@ class ServingTransport:
                 _flight.record("serving_reply_dropped", force=True,
                                req_id=int(req_id), rc=int(rc),
                                status=int(status))
+            # ptlint: disable=silent-failure -- reply-drop flight telemetry is best-effort; the rc is still returned and stat-counted above
             except Exception:  # noqa: BLE001 — telemetry must not raise
                 pass
         return rc
@@ -820,6 +839,7 @@ class ServingTransport:
                 _flight.record("serving_reply_dropped", force=True,
                                req_id=int(req_id), rc=int(rc),
                                status=int(status))
+            # ptlint: disable=silent-failure -- reply-drop flight telemetry is best-effort; the rc is still returned and stat-counted above
             except Exception:  # noqa: BLE001 — telemetry must not raise
                 pass
         return rc
@@ -858,8 +878,8 @@ class ServingTransport:
     def __del__(self):
         try:
             self.stop()
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001
+            _note_close_error("serving_transport", e)
 
 
 # --------------------------------------------------------------------- monitor

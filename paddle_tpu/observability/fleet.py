@@ -229,7 +229,7 @@ class FleetAggregator:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._hosts: Dict[str, Dict[str, Any]] = {}
+        self._hosts: Dict[str, Dict[str, Any]] = {}  # guarded-by: self._lock
 
     def ingest(self, snapshot: Dict[str, Any]) -> str:
         """Store one pushed snapshot; returns the host id it was filed
@@ -241,6 +241,7 @@ class FleetAggregator:
         host = str(snapshot["host"])
         entry = dict(snapshot)
         entry["received_unix"] = time.time()
+        entry["received_mono"] = time.monotonic()
         with self._lock:
             known = host in self._hosts
             self._hosts[host] = entry
@@ -288,11 +289,17 @@ def fleet_health() -> Tuple[bool, Dict[str, Any]]:
     healthy-but-empty (200, hosts={}) — before the first push there is
     nothing to be stale."""
     now = time.time()
+    now_mono = time.monotonic()
     stale_after = _stale_after_s()
     hosts: Dict[str, Any] = {}
     ok = True
     for host, entry in sorted(aggregator().hosts().items()):
-        age = max(0.0, now - float(entry.get("received_unix", 0)))
+        mono0 = entry.get("received_mono")
+        if mono0 is not None:
+            age = max(0.0, now_mono - float(mono0))
+        else:
+            # ptlint: disable=clock-hygiene -- test-injected snapshots carry only the wall stamp; ingest() always adds received_mono
+            age = max(0.0, now - float(entry.get("received_unix", 0)))
         stale = stale_after > 0 and age > stale_after
         healthy = bool((entry.get("health") or {}).get("ok", False))
         if stale:
@@ -465,7 +472,7 @@ class FleetReporter:
 
 
 _reporter_lock = threading.Lock()
-_reporter: Optional[FleetReporter] = None
+_reporter: Optional[FleetReporter] = None  # guarded-by: _reporter_lock
 
 
 def start_reporter(aggregator_addr: str,

@@ -63,7 +63,7 @@ class FlightRecorder:
 
     def __init__(self, capacity: Optional[int] = None) -> None:
         self._lock = threading.Lock()
-        self._buf: deque = deque(maxlen=capacity or _capacity())
+        self._buf: deque = deque(maxlen=capacity or _capacity())  # guarded-by: self._lock
         self._installed = False
         self._prev_handlers: Dict[int, Any] = {}
         self._prev_excepthook = None

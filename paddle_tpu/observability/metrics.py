@@ -85,7 +85,7 @@ class Counter(_Instrument):
 
     def __init__(self, *args, **kwargs) -> None:
         super().__init__(*args, **kwargs)
-        self._series: Dict[Tuple, float] = {}
+        self._series: Dict[Tuple, float] = {}  # guarded-by: self._lock
 
     def inc(self, value: float = 1, **labels) -> None:
         if not self._on():
@@ -119,7 +119,7 @@ class Gauge(_Instrument):
 
     def __init__(self, *args, **kwargs) -> None:
         super().__init__(*args, **kwargs)
-        self._series: Dict[Tuple, Any] = {}
+        self._series: Dict[Tuple, Any] = {}  # guarded-by: self._lock
 
     def set(self, value: Any, **labels) -> None:
         if not self._on():
@@ -179,7 +179,7 @@ class Histogram(_Instrument):
         super().__init__(name, help, lock, always)
         self.buckets = tuple(sorted(
             float(b) for b in (buckets or DEFAULT_BUCKETS)))
-        self._series: Dict[Tuple, Dict[str, Any]] = {}
+        self._series: Dict[Tuple, Dict[str, Any]] = {}  # guarded-by: self._lock
 
     def observe(self, value: float, **labels) -> None:
         if not self._on():
@@ -234,7 +234,7 @@ class MetricsRegistry:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._metrics: Dict[str, _Instrument] = {}
+        self._metrics: Dict[str, _Instrument] = {}  # guarded-by: self._lock
 
     def _get_or_make(self, cls, name: str, help: str, always: bool,
                      **kwargs) -> _Instrument:
@@ -353,6 +353,8 @@ def histogram(name: str, help: str = "", always: bool = False,
 # sync through the hook in flags.py).
 try:  # pragma: no cover - trivial wiring
     from ..flags import GLOBAL_FLAGS as _GF
+    # ptlint: disable=flag-freeze -- deliberate: seeds _ENABLED from the env once; flags.py's on_change hook keeps it in sync afterwards
     _ENABLED = bool(_GF.get("enable_metrics"))
+# ptlint: disable=silent-failure -- direct submodule import order: the flag may not be defined yet; enable() still works explicitly
 except Exception:  # flag not defined yet (direct submodule import)
     pass

@@ -111,6 +111,7 @@ def _heartbeat_age_s() -> Optional[float]:
     if v is None:
         return None
     try:
+        # ptlint: disable=clock-hygiene -- the heartbeat gauge is an exported wall stamp by name (train_heartbeat_timestamp_seconds); its age is necessarily wall-minus-wall
         return max(0.0, time.time() - float(v))
     except (TypeError, ValueError):
         return None
@@ -270,12 +271,14 @@ class _Handler(BaseHTTPRequestHandler):
                            "text/plain")
             else:
                 self._send(404, b"not found\n", "text/plain")
+        # ptlint: disable=silent-failure -- client hung up mid-response; nothing to answer and nothing to fix
         except BrokenPipeError:
             pass
         except Exception as e:  # noqa: BLE001 — keep the exporter alive
             try:
                 self._send_json(500,
                                 {"error": f"{type(e).__name__}: {e}"})
+            # ptlint: disable=silent-failure -- the 500 itself failed (socket dead): the exporter thread must survive any request
             except Exception:
                 pass
 
@@ -300,12 +303,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(400, {"error": f"bad fleet push: {e}"})
                 return
             self._send_json(200, {"ok": True, "host": host})
+        # ptlint: disable=silent-failure -- client hung up mid-response; nothing to answer and nothing to fix
         except BrokenPipeError:
             pass
         except Exception as e:  # noqa: BLE001 — keep the exporter alive
             try:
                 self._send_json(500,
                                 {"error": f"{type(e).__name__}: {e}"})
+            # ptlint: disable=silent-failure -- the 500 itself failed (socket dead): the exporter thread must survive any request
             except Exception:
                 pass
 
@@ -330,7 +335,7 @@ class ObservabilityServer:
 
 
 _lock = threading.Lock()
-_server: Optional[ObservabilityServer] = None
+_server: Optional[ObservabilityServer] = None  # guarded-by: _lock
 
 
 def start(port: int = 0) -> ObservabilityServer:

@@ -47,6 +47,7 @@ def _note_preempted(signum: int) -> None:
             always=True).inc()
         _flight.record("preemption_notice", force=True,
                        signum=int(signum))
+    # ptlint: disable=silent-failure -- signal-handler context: telemetry must never block setting the preemption flag, which already happened above
     except Exception:  # noqa: BLE001 — telemetry never blocks the flag
         pass
 
@@ -109,6 +110,7 @@ class PreemptionGuard:
             try:
                 signal.signal(sig, prev if prev is not None
                               else signal.SIG_DFL)
+            # ptlint: disable=silent-failure -- restoring handlers from a non-main thread raises ValueError; the guard is exiting either way
             except (ValueError, OSError):
                 pass
         self._prev.clear()

@@ -96,11 +96,11 @@ class LLMEngine:
                          for _ in range(cfg.num_layers)]
         self._v_pools = [jnp.zeros(shape, jnp.float32)
                          for _ in range(cfg.num_layers)]
-        self._seqs: Dict[int, Sequence] = {}
+        self._seqs: Dict[int, Sequence] = {}  # guarded-by: single-owner (serving thread)
         self._next_seq = 0
         self.tokens_generated = 0
         # projected peak blocks per live sequence (watermark gate)
-        self._projected: Dict[int, int] = {}
+        self._projected: Dict[int, int] = {}  # guarded-by: single-owner (serving thread)
         # stall watchdog / post-step audit state (health_snapshot)
         self._step_begin_unix: Optional[float] = None
         self._step_end_unix: Optional[float] = None

@@ -45,7 +45,9 @@ class LLMStreamBridge:
     def __init__(self, server, engine: LLMEngine):
         self.server = server
         self.engine = engine
-        self._reqs: Dict[int, Dict[str, Any]] = {}  # seq_id -> req span
+        # seq_id -> req span
+        # guarded-by: single-owner (serving thread)
+        self._reqs: Dict[int, Dict[str, Any]] = {}
 
     def active(self) -> bool:
         return self.engine.active()
@@ -59,6 +61,7 @@ class LLMStreamBridge:
         from ..inference import decode_tensors
         req["assembly_unix"] = time.time()
         req["token_unix"] = []
+        req.setdefault("token_mono", [])
         try:
             buf = req["payload"]
             hdr = struct.calcsize(GENERATE_HEADER)
@@ -111,6 +114,7 @@ class LLMStreamBridge:
             if ev["type"] == "token":
                 req.setdefault("dispatch_unix", ev["dispatch_unix"])
                 now = time.time()
+                now_mono = time.monotonic()
                 try:
                     _faults.hit("llm_chunk_write")
                     rc = self.server.transport.reply_chunk(
@@ -123,7 +127,7 @@ class LLMStreamBridge:
                 if rc != 0:
                     self._cancel(ev["seq_id"], req, now)
                     continue
-                self._note_token(req, now)
+                self._note_token(req, now, now_mono)
             elif ev["type"] == "finished":
                 self.server.transport.reply_chunk(
                     req["rid"], b"", status=0, final=True)
@@ -149,18 +153,26 @@ class LLMStreamBridge:
         if ddl <= 0:
             return
         now = time.time()
+        now_mono = time.monotonic()
         for seq in list(self.engine.scheduler.waiting):
             req = self._reqs.get(seq.seq_id)
             if req is None or seq.generated or seq.preemptions:
                 continue
-            age = now - (req.get("dequeue_unix") or now)
+            mono0 = req.get("dequeue_mono")
+            if mono0 is not None:
+                age = now_mono - mono0
+            else:
+                # ptlint: disable=clock-hygiene -- fallback for spans injected without a dequeue_mono stamp (tests); production requests are stamped in _mk_req
+                age = now - (req.get("dequeue_unix") or now)
             if age > ddl:
                 self.engine.cancel(seq.seq_id)
                 self._reqs.pop(seq.seq_id, None)
                 self.server._shed(req, age, ddl)
 
-    def _note_token(self, req: Dict[str, Any], now: float) -> None:
+    def _note_token(self, req: Dict[str, Any], now: float,
+                    now_mono: float) -> None:
         stamps: List[float] = req["token_unix"]
+        mono: List[float] = req.setdefault("token_mono", [])
         from .. import observability as obs
         if obs.enabled():
             from ..observability import metrics as _m
@@ -173,15 +185,17 @@ class LLMStreamBridge:
                     "time to first token: request ingress to first "
                     "streamed chunk",
                     buckets=_m.LATENCY_MS_BUCKETS).observe(
+                        # ptlint: disable=clock-hygiene -- ingress_unix is the C++ wire-ingress wall stamp; TTFT necessarily crosses the process boundary
                         max(0.0, (now - req["ingress_unix"]) * 1e3))
-            elif stamps:
+            elif stamps and mono:
                 obs.histogram(
                     "serving_tpot_ms",
                     "time per output token: gap between consecutive "
                     "streamed chunks of one request",
                     buckets=_m.LATENCY_MS_BUCKETS).observe(
-                        max(0.0, (now - stamps[-1]) * 1e3))
+                        max(0.0, (now_mono - mono[-1]) * 1e3))
         stamps.append(now)
+        mono.append(now_mono)
 
     def _cancel(self, seq_id: int, req: Dict[str, Any],
                 now: float) -> None:
@@ -213,6 +227,7 @@ class LLMStreamBridge:
             try:
                 self.server.transport.reply_chunk(
                     req["rid"], message, status=-1, final=True)
+            # ptlint: disable=silent-failure -- terminal sweep: the client is likely already gone; the record below still logs the outcome
             except Exception:  # noqa: BLE001 — client may be gone
                 pass
             self.engine.cancel(seq_id)
@@ -260,5 +275,6 @@ class LLMStreamBridge:
                 rec["e2e_ms"] = max(0.0,
                                     (rec["reply_unix"] - ing) * 1e3)
             _reqtrace.record(rec)
+        # ptlint: disable=silent-failure -- span records are best-effort by contract: a reply must never fail on telemetry
         except Exception:  # noqa: BLE001 — never fail a reply on spans
             pass

@@ -321,6 +321,7 @@ def _note_nonfinite_host(fired: bool) -> None:
             "optimizer/scaler/buffer update was skipped in-graph "
             "(skip-step guard, FLAGS_skip_nonfinite_steps)").inc()
         _flight.record("nonfinite_step", force=True)
+    # ptlint: disable=silent-failure -- runs inside a jax.debug.callback: telemetry must never break the dispatch stream
     except Exception:  # telemetry must never break the stream
         pass
 
@@ -333,6 +334,7 @@ def probe_nonfinite(found_inf) -> None:
         return
     # register at trace time so the TYPE line is on /metrics before
     # the first incident
+    # ptlint: disable=trace-purity -- deliberate trace-time registration: creating the counter early puts its TYPE line on /metrics before the first incident; the inc() itself rides the deferred callback
     _obs.counter(
         "nonfinite_steps_total",
         "train steps whose gradients contained NaN/Inf — the "

@@ -65,6 +65,7 @@ def enable_compile_cache(cache_dir: str = None,
         # the cache key's job
         jax.config.update("jax_persistent_cache_min_entry_size_bytes",
                           -1)
+    # ptlint: disable=silent-failure -- these config keys vary across jax versions; a missing one means that knob does not exist to set
     except Exception:  # noqa: BLE001
         pass
     _install_cache_listener()
@@ -97,6 +98,7 @@ def _install_cache_listener() -> None:
             from jax import monitoring
             monitoring.register_event_listener(_on_cache_event)
             _LISTENER_INSTALLED = True
+        # ptlint: disable=silent-failure -- jax.monitoring is an optional surface; without it cache hit/miss counters simply stay absent
         except Exception:  # noqa: BLE001
             pass
 

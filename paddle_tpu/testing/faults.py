@@ -282,6 +282,7 @@ def _note(point: str, s: FaultSpec, step: Optional[int]) -> None:
             always=True).inc(point=point)
         _flight.record("fault_injected", force=True, point=point,
                        step=step, spec=format_spec([s]))
+    # ptlint: disable=silent-failure -- chaos-drill telemetry: the injected fault (the point of the exercise) already fired; counting it is best-effort
     except Exception:  # noqa: BLE001
         pass
 
@@ -346,8 +347,10 @@ def value_mult(point: str, step: Optional[int] = None) -> float:
 # path: the drill exports FLAGS_fault_spec before the trainer starts).
 try:  # pragma: no cover - trivial wiring
     from ..flags import GLOBAL_FLAGS as _GF
+    # ptlint: disable=flag-freeze -- deliberate: the subprocess drill exports FLAGS_fault_spec before the trainer starts, so arming at import is the contract
     _spec = _GF.get("fault_spec")
     if _spec:
         configure(_spec)
+# ptlint: disable=silent-failure -- direct submodule import order: the flag may not be defined yet; configure() still arms explicitly
 except Exception:  # flag not defined yet (direct submodule import)
     pass

@@ -425,7 +425,7 @@ def run_verification(artifact_path: str | None = None) -> dict:
     from .core.place import accelerator_available
     on_accel = accelerator_available()
     _log(f"backend={backend} on_accel={on_accel}")
-    t0 = time.time()
+    t0 = time.perf_counter()
     kernel_failures = validate_kernels_on_tpu() if on_accel else \
         ["skipped: no accelerator (Mosaic lowers only on TPU)"]
     parity = train_parity_10steps()
@@ -442,7 +442,7 @@ def run_verification(artifact_path: str | None = None) -> dict:
         "kernel_failures": kernel_failures,
         "train_parity": parity,
         "ok": parity["ok"] and (not on_accel or not kernel_failures),
-        "elapsed_s": round(time.time() - t0, 1),
+        "elapsed_s": round(time.perf_counter() - t0, 1),
     }
     if artifact_path:
         with open(artifact_path, "w") as f:

@@ -1,0 +1,320 @@
+"""ptlint framework tests: pass fixtures (every pass must catch its
+positive snippets and stay quiet on its negative ones), suppression
+round-trips, baseline shrink-only policy, the standalone no-jax import
+contract, and the tier-1 CI gate (``ptlint --all --self-test`` exits 0
+on the real tree).
+
+These tests import the analysis package exactly the way the CLI does —
+standalone by path, never through ``paddle_tpu.__init__`` — so they run
+without jax and double as a regression test for that loading contract.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(ROOT, "tools")
+BASELINE = os.path.join(TOOLS, "ptlint_baseline.json")
+
+sys.path.insert(0, TOOLS)
+import ptlint  # noqa: E402
+
+ANALYSIS = ptlint.ANALYSIS
+base = ANALYSIS.base
+
+ALL_PASSES = ANALYSIS.all_passes()
+PASS_IDS = [p.name for p in ALL_PASSES]
+
+
+# ---------------------------------------------------------------------------
+# fixture self-tests: >=2 positive and >=2 negative snippets per pass
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("p", ALL_PASSES, ids=PASS_IDS)
+def test_pass_has_enough_fixtures(p):
+    """ISSUE contract: at least 2 positive AND 2 negative fixtures per
+    pass, so every rule demonstrably fires and demonstrably does not
+    over-fire."""
+    assert len(p.positive) >= 2, f"{p.name}: needs >=2 positive fixtures"
+    assert len(p.negative) >= 2, f"{p.name}: needs >=2 negative fixtures"
+
+
+@pytest.mark.parametrize("p", ALL_PASSES, ids=PASS_IDS)
+def test_pass_fixtures_behave(p):
+    """Every positive fixture produces >=1 unsuppressed finding; every
+    negative fixture produces none (the same check `--self-test` runs)."""
+    errs = p.self_test()
+    assert errs == [], "\n".join(errs)
+
+
+def test_registry_covers_expected_rules():
+    assert set(PASS_IDS) == {
+        "trace-purity", "callback-cache", "lock-discipline",
+        "clock-hygiene", "silent-failure", "flag-freeze",
+        "flags-doc", "metrics-doc",
+    }
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+def _lint_source(src, rel="paddle_tpu/fixture_mod.py", passes=None):
+    mod = base.SourceModule.from_source(src, rel=rel)
+    ctx = base.Context(root=None, docs_text="", metrics_doc_text="x")
+    passes = passes if passes is not None else ALL_PASSES
+    findings = []
+    for p in passes:
+        findings.extend(p.run([mod], ctx))
+    by_rel = {mod.rel: mod}
+    return base.apply_suppressions(
+        findings, by_rel, {p.name: p for p in passes})
+
+
+def test_suppression_round_trip():
+    """The same violation with and without a `# ptlint: disable=`
+    comment: finding present, then suppressed."""
+    bare = """
+    import time
+
+    def f():
+        t0 = time.time()
+        return time.time() - t0
+    """
+    active, suppressed = _lint_source(bare)
+    assert any(f.rule == "clock-hygiene" for f in active)
+    fixed = """
+    import time
+
+    def f():
+        t0 = time.time()
+        # ptlint: disable=clock-hygiene -- test fixture
+        return time.time() - t0
+    """
+    active, suppressed = _lint_source(fixed)
+    assert not [f for f in active if f.rule == "clock-hygiene"]
+    assert any(f.rule == "clock-hygiene" for f in suppressed)
+
+
+def test_suppression_requires_reason_for_silent_failure():
+    """silent-failure sets requires_reason: a bare disable comment is
+    rejected (stays active, message explains), `-- why` is honoured."""
+    no_reason = """
+    def f():
+        try:
+            g()
+        except Exception:  # ptlint: disable=silent-failure
+            pass
+    """
+    active, suppressed = _lint_source(no_reason)
+    assert any(f.rule == "silent-failure"
+               and "requires a reason" in f.message for f in active)
+    with_reason = """
+    def f():
+        try:
+            g()
+        # ptlint: disable=silent-failure -- teardown path, nothing to do
+        except Exception:
+            pass
+    """
+    active, suppressed = _lint_source(with_reason)
+    assert not [f for f in active if f.rule == "silent-failure"]
+    assert len(suppressed) == 1
+
+
+def test_annotations_in_strings_are_ignored():
+    """`# guarded-by:` / `# ptlint:` inside a docstring or string
+    literal is prose, not an annotation (comments come from tokenize,
+    not substring search)."""
+    src = '''
+    MSG = "self._q is declared  # guarded-by: self._lock"
+
+    def f():
+        """Docs may say # guarded-by: self._lock without declaring."""
+        return MSG
+    '''
+    active, _ = _lint_source(src)
+    assert not [f for f in active if f.rule == "lock-discipline"]
+
+
+def test_lock_discipline_catches_seeded_violation():
+    """ISSUE acceptance: the pass must flag a mutation outside the
+    declared lock and stay quiet when the with-block is present."""
+    src = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._q = []  # guarded-by: self._lock
+
+        def bad(self, x):
+            self._q.append(x)
+
+        def good(self, x):
+            with self._lock:
+                self._q.append(x)
+    """
+    active, _ = _lint_source(src)
+    locks = [f for f in active if f.rule == "lock-discipline"]
+    assert len(locks) == 1
+    mod = base.SourceModule.from_source(src)
+    assert "self._q.append(x)" in mod.line(locks[0].line)
+    # the flagged line is the unlocked one (inside `bad`, before `good`)
+    assert "def good" not in "\n".join(mod.lines[:locks[0].line])
+
+
+# ---------------------------------------------------------------------------
+# baseline policy: shrink-only, reasons mandatory
+# ---------------------------------------------------------------------------
+
+def test_checked_in_baseline_is_small_and_reasoned():
+    """The baseline is for deliberate deferrals only: it may not grow
+    past the count fixed here (shrink it, never bump this number), and
+    every entry carries a reason."""
+    with open(BASELINE) as fh:
+        entries = json.load(fh)["entries"]
+    assert len(entries) <= 1, (
+        "the ptlint baseline may only shrink — fix or suppress new "
+        "findings instead of adding entries")
+    for e in entries:
+        assert str(e.get("reason", "")).strip(), e
+        assert e.get("rule") and e.get("path") and e.get("anchor"), e
+
+
+def test_baseline_stale_entry_errors(tmp_path):
+    """An entry matching no live finding is stale and errors — that is
+    the runtime enforcement of shrink-only."""
+    entries = [{"rule": "clock-hygiene", "path": "paddle_tpu/gone.py",
+                "anchor": "x = 1", "reason": "old"}]
+    active, baselined, errors = base.apply_baseline(
+        [], entries, {}, check_stale=True)
+    assert any("stale" in e for e in errors)
+    # explicit-path subset runs skip the stale check (partial scans
+    # cannot tell stale from out-of-scope)
+    active, baselined, errors = base.apply_baseline(
+        [], entries, {}, check_stale=False)
+    assert errors == []
+
+
+def test_baseline_entry_without_reason_errors():
+    src = """
+    import time
+
+    def f():
+        t0 = time.time()
+        return time.time() - t0
+    """
+    mod = base.SourceModule.from_source(src, rel="paddle_tpu/m.py")
+    ctx = base.Context(root=None)
+    findings = [p.run([mod], ctx) for p in ALL_PASSES
+                if p.name == "clock-hygiene"][0]
+    assert findings
+    anchor = mod.line(findings[0].line).strip()
+    entries = [{"rule": "clock-hygiene", "path": "paddle_tpu/m.py",
+                "anchor": anchor}]
+    active, baselined, errors = base.apply_baseline(
+        findings, entries, {mod.rel: mod})
+    assert baselined and not active
+    assert any("no reason" in e for e in errors)
+
+
+def test_baseline_matches_by_anchor_not_line():
+    """Entries anchor on the stripped source line, so the baseline
+    survives unrelated line drift above the finding."""
+    src = """
+    import time
+
+    def f():
+        t0 = time.time()
+        return time.time() - t0
+    """
+    mod = base.SourceModule.from_source(src, rel="paddle_tpu/m.py")
+    ctx = base.Context(root=None)
+    p = [q for q in ALL_PASSES if q.name == "clock-hygiene"][0]
+    findings = p.run([mod], ctx)
+    anchor = mod.line(findings[0].line).strip()
+    entries = [{"rule": "clock-hygiene", "path": "paddle_tpu/m.py",
+                "anchor": anchor, "reason": "pinned"}]
+    drifted = "# new header comment\n# another line\n" \
+        + mod.text  # same code, shifted two lines down
+    mod2 = base.SourceModule("<fixture>", "paddle_tpu/m.py", drifted)
+    findings2 = p.run([mod2], ctx)
+    assert findings2[0].line == findings[0].line + 2
+    active, baselined, errors = base.apply_baseline(
+        findings2, entries, {mod2.rel: mod2})
+    assert not active and baselined and not errors
+
+
+# ---------------------------------------------------------------------------
+# standalone loading contract + CI gate
+# ---------------------------------------------------------------------------
+
+def test_analysis_loads_without_jax():
+    """The analysis package must be importable standalone — loading it
+    (as ptlint does) must not drag in jax or paddle_tpu proper."""
+    code = (
+        "import importlib.util, os, sys\n"
+        f"pkg = os.path.join({ROOT!r}, 'paddle_tpu', 'analysis')\n"
+        "spec = importlib.util.spec_from_file_location(\n"
+        "    'pt_analysis', os.path.join(pkg, '__init__.py'),\n"
+        "    submodule_search_locations=[pkg])\n"
+        "mod = importlib.util.module_from_spec(spec)\n"
+        "sys.modules['pt_analysis'] = mod\n"
+        "spec.loader.exec_module(mod)\n"
+        "assert len(mod.all_passes()) == 8\n"
+        "assert 'jax' not in sys.modules, 'analysis imported jax'\n"
+        "assert 'paddle_tpu' not in sys.modules, "
+        "'analysis imported the framework'\n"
+        "print('standalone-ok')\n")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert "standalone-ok" in proc.stdout
+
+
+def test_ptlint_all_self_test_subprocess():
+    """Tier-1 CI gate: the full pass registry over the real tree plus
+    every pass's fixture self-test must exit 0 — zero unsuppressed
+    findings, healthy baseline."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "ptlint.py"),
+         "--all", "--self-test"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert "ptlint self-test: OK" in proc.stdout
+    assert "ptlint: OK" in proc.stdout
+
+
+def test_ptlint_flags_explicit_paths(tmp_path):
+    """Lint a seeded-violation file by explicit path: finding reported,
+    exit 1, and baseline entries for unscanned files don't false-error
+    as stale."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import time\n\n"
+        "def f():\n"
+        "    t0 = time.time()\n"
+        "    return time.time() - t0\n")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "ptlint.py"), str(bad)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "clock-hygiene" in proc.stderr
+    assert "stale" not in proc.stderr
+
+
+def test_ptlint_json_output():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "ptlint.py"),
+         "--all", "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    data = json.loads(proc.stdout)
+    assert data["findings"] == []
+    assert data["errors"] == []
+    assert data["suppressed"] > 0

@@ -1,89 +1,32 @@
 """CI check: every framework flag must have help text and docs.
 
-Walks the ``define_flag`` calls in ``paddle_tpu/flags.py`` by AST (no
-framework import, so the check runs in milliseconds with no jax) and
-fails when
-
-- a flag's ``help`` argument is empty or missing, or
-- the flag is not mentioned (as ``FLAGS_<name>``) anywhere under
-  ``docs/``.
-
-``docs/flags.md`` is the canonical index; adding a new flag means
-adding its row there (or documenting it in a feature doc). This is the
-observability analogue of the reference's convention that every
-``DEFINE_*`` in platform/flags.cc carries a descriptive string.
+Thin shim over the ``flags-doc`` ptlint pass
+(``paddle_tpu/analysis/flags_doc.py``) — the AST walk, the doc scan,
+and the CLI output live there now; this file only preserves the
+historical entry point and public API (``collect_flags`` /
+``docs_text`` / ``main``).  Run ``python tools/ptlint.py --all`` for
+the full pass registry, or this script for just the flags contract.
 
 Usage: python tools/check_flags_doc.py   (exit 0 ok, 1 violations)
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-FLAGS_PY = os.path.join(ROOT, "paddle_tpu", "flags.py")
-DOCS_DIR = os.path.join(ROOT, "docs")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from ptlint import ANALYSIS  # noqa: E402
 
+_impl = ANALYSIS.flags_doc
 
-def collect_flags(path: str = FLAGS_PY):
-    """[(name, has_help)] for every define_flag(...) call."""
-    tree = ast.parse(open(path).read(), filename=path)
-    out = []
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Name)
-                and node.func.id == "define_flag"):
-            continue
-        if not node.args or not isinstance(node.args[0], ast.Constant):
-            continue
-        name = node.args[0].value
-        help_node = None
-        if len(node.args) >= 3:
-            help_node = node.args[2]
-        for kw in node.keywords:
-            if kw.arg == "help":
-                help_node = kw.value
-        has_help = (isinstance(help_node, ast.Constant)
-                    and isinstance(help_node.value, str)
-                    and bool(help_node.value.strip()))
-        out.append((name, has_help))
-    return out
+ROOT = _impl.ROOT
+FLAGS_PY = _impl.FLAGS_PY
+DOCS_DIR = _impl.DOCS_DIR
 
-
-def docs_text(docs_dir: str = DOCS_DIR) -> str:
-    chunks = []
-    for dirpath, _, files in os.walk(docs_dir):
-        for f in files:
-            if f.endswith((".md", ".rst", ".txt")):
-                with open(os.path.join(dirpath, f)) as fh:
-                    chunks.append(fh.read())
-    return "\n".join(chunks)
-
-
-def main() -> int:
-    flags = collect_flags()
-    if not flags:
-        print("check_flags_doc: no define_flag calls found "
-              f"in {FLAGS_PY} — parser broken?", file=sys.stderr)
-        return 1
-    docs = docs_text()
-    bad_help = [n for n, has_help in flags if not has_help]
-    undocumented = [n for n, _ in flags if f"FLAGS_{n}" not in docs]
-    for n in bad_help:
-        print(f"FLAGS_{n}: empty or missing help= in flags.py",
-              file=sys.stderr)
-    for n in undocumented:
-        print(f"FLAGS_{n}: not documented anywhere under docs/ "
-              "(add it to docs/flags.md)", file=sys.stderr)
-    if bad_help or undocumented:
-        print(f"check_flags_doc: {len(bad_help)} empty-help, "
-              f"{len(undocumented)} undocumented "
-              f"(of {len(flags)} flags)", file=sys.stderr)
-        return 1
-    print(f"check_flags_doc: OK ({len(flags)} flags documented)")
-    return 0
+collect_flags = _impl.collect_flags
+docs_text = _impl.docs_text
+main = _impl.cli_main
 
 
 if __name__ == "__main__":

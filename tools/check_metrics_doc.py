@@ -1,134 +1,35 @@
 """CI check: every metric name registered in code must be documented.
 
-Mirror of ``check_flags_doc.py`` for the metrics registry: walks every
-``counter(...)`` / ``gauge(...)`` / ``histogram(...)`` call under
-``paddle_tpu/`` by AST (no framework import — milliseconds, no jax) and
-fails when a literal metric name does not appear in
-``docs/observability.md`` — the canonical metric index scrapers and
-dashboards are built from. Dynamically-named instruments (the
-user-facing ``obs.counter(my_name)`` API) have non-constant first
-arguments and are out of scope by construction; names starting with
-``selftest_`` (CLI self-test fixtures) are ignored.
-
-Also covers the NATIVE stat registry: literal ``pt_mon_add("...")``
-names in ``csrc/*.cc`` and literal ``stat_add("...")`` names in the
-Python tree (both land in the same ``pt_mon`` registry and surface on
-the STATS wire reply and the ``pt_native_stat`` bridge) must appear in
-``docs/observability.md`` too — C++-side metrics used to be able to
-drift undocumented.
+Thin shim over the ``metrics-doc`` ptlint pass
+(``paddle_tpu/analysis/metrics_doc.py``) — the AST walk over the
+Python factories, the ``pt_mon_add`` regex scan of ``csrc/``, and the
+CLI output live there now; this file only preserves the historical
+entry point and public API (``collect_metrics`` /
+``collect_native_metrics`` / ``main``).  Run
+``python tools/ptlint.py --all`` for the full pass registry, or this
+script for just the metrics contract.
 
 Usage: python tools/check_metrics_doc.py   (exit 0 ok, 1 violations)
 """
 
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
 
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG_DIR = os.path.join(ROOT, "paddle_tpu")
-CSRC_DIR = os.path.join(ROOT, "csrc")
-DOC = os.path.join(ROOT, "docs", "observability.md")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from ptlint import ANALYSIS  # noqa: E402
 
-_FACTORIES = {"counter", "gauge", "histogram"}
-# native stat registrations: C++ pt_mon_add / Python native.stat_add
-_NATIVE_FACTORIES = {"stat_add"}
-_PT_MON_RE = re.compile(r'pt_mon_add\(\s*"([^"]+)"')
+_impl = ANALYSIS.metrics_doc
 
+ROOT = _impl.ROOT
+PKG_DIR = _impl.PKG_DIR
+CSRC_DIR = _impl.CSRC_DIR
+DOC = _impl.DOC
 
-def _call_name(node: ast.Call) -> str:
-    f = node.func
-    if isinstance(f, ast.Name):
-        return f.id
-    if isinstance(f, ast.Attribute):
-        return f.attr
-    return ""
-
-
-def collect_metrics(pkg_dir: str = PKG_DIR):
-    """{name: [file:line, ...]} for every literal-named instrument."""
-    out = {}
-    for dirpath, _, files in os.walk(pkg_dir):
-        for fname in files:
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            try:
-                tree = ast.parse(open(path).read(), filename=path)
-            except SyntaxError as e:  # pragma: no cover
-                print(f"check_metrics_doc: cannot parse {path}: {e}",
-                      file=sys.stderr)
-                return None
-            for node in ast.walk(tree):
-                if not (isinstance(node, ast.Call)
-                        and (_call_name(node) in _FACTORIES
-                             or _call_name(node) in _NATIVE_FACTORIES)
-                        and node.args
-                        and isinstance(node.args[0], ast.Constant)
-                        and isinstance(node.args[0].value, str)):
-                    continue
-                name = node.args[0].value
-                if not name or name.startswith("selftest_"):
-                    continue
-                rel = os.path.relpath(path, ROOT)
-                out.setdefault(name, []).append(
-                    f"{rel}:{node.lineno}")
-    return out
-
-
-def collect_native_metrics(csrc_dir: str = CSRC_DIR):
-    """{name: [file:line, ...]} for every literal pt_mon_add() stat in
-    the C++ sources (regex scan — no C++ parser needed for literal
-    first arguments; dynamically-built names are out of scope like
-    their Python counterparts)."""
-    out = {}
-    if not os.path.isdir(csrc_dir):
-        return out
-    for fname in sorted(os.listdir(csrc_dir)):
-        if not fname.endswith((".cc", ".c", ".h")):
-            continue
-        path = os.path.join(csrc_dir, fname)
-        try:
-            text = open(path).read()
-        except OSError:  # pragma: no cover
-            continue
-        for i, line in enumerate(text.splitlines(), 1):
-            for m in _PT_MON_RE.finditer(line):
-                rel = os.path.relpath(path, ROOT)
-                out.setdefault(m.group(1), []).append(f"{rel}:{i}")
-    return out
-
-
-def main() -> int:
-    metrics = collect_metrics()
-    if metrics is None:
-        return 1
-    if not metrics:
-        print("check_metrics_doc: no instrument registrations found "
-              f"under {PKG_DIR} — parser broken?", file=sys.stderr)
-        return 1
-    for name, sites in collect_native_metrics().items():
-        metrics.setdefault(name, []).extend(sites)
-    try:
-        doc = open(DOC).read()
-    except OSError as e:
-        print(f"check_metrics_doc: cannot read {DOC}: {e}",
-              file=sys.stderr)
-        return 1
-    missing = {n: sites for n, sites in metrics.items() if n not in doc}
-    for name in sorted(missing):
-        print(f"{name}: registered at {', '.join(missing[name])} but "
-              "not mentioned in docs/observability.md",
-              file=sys.stderr)
-    if missing:
-        print(f"check_metrics_doc: {len(missing)} undocumented of "
-              f"{len(metrics)} metric names", file=sys.stderr)
-        return 1
-    print(f"check_metrics_doc: OK ({len(metrics)} metric names "
-          "documented)")
-    return 0
+collect_metrics = _impl.collect_metrics
+collect_native_metrics = _impl.collect_native_metrics
+main = _impl.cli_main
 
 
 if __name__ == "__main__":
