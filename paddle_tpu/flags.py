@@ -501,6 +501,33 @@ define_flag("serving_drain_deadline_s", 5.0,
             "decoding for up to this many seconds; sequences still "
             "running at the deadline are cancelled with a terminal "
             "negative-status frame so no client is left hanging.")
+define_flag("kv_prefix_sharing", False,
+            "LLM serving (serving_llm): copy-on-write shared-prefix "
+            "KV reuse. The paged allocator refcounts physical blocks "
+            "and satisfies the already-resident prefix of a new "
+            "sequence's prompt (hash-of-full-blocks index plus a "
+            "partial-tail match against live sequences) by bumping "
+            "refcounts instead of popping the free list; prefill "
+            "skips recomputing the shared tokens "
+            "(kv_prefix_hit_tokens_total), the first divergent write "
+            "copies the shared block to a private one in-pool "
+            "(kv_cow_copies_total), and free() only returns "
+            "refcount-0 blocks. The admission watermark projects "
+            "post-sharing demand, so shared-prefix floods admit ~N "
+            "times more streams. Off [assumed] pending chip capture "
+            "(bench.py llm_prefix_reuse).")
+define_flag("prefill_chunk_tokens", 0,
+            "LLM serving (serving_llm): chunked prefill. When > 0, "
+            "prefill runs in chunks of this many tokens (floored to "
+            "a kv_block_size multiple), ONE chunk per engine step "
+            "interleaved with the decode tick — a long prompt no "
+            "longer spikes every running stream's TPOT. A sequence "
+            "joins the decode batch only when its last chunk lands; "
+            "preempting it mid-prefill resets to its last shared or "
+            "cached block. 0 (default) prefills whole prompts in one "
+            "step — 0 [assumed] pending chip capture (bench.py "
+            "llm_mixed_prefill; ~256 is the expected setting). Read "
+            "every step, so it can be retuned on a live server.")
 define_flag("llm_stall_factor", 10.0,
             "LLM engine stall watchdog: an engine step (or the gap "
             "since the last step while sequences are active) longer "

@@ -5,15 +5,22 @@ per-layer K/V block POOLS (``[num_blocks, block_size, heads,
 head_dim]`` arrays — the layout kernels/paged_attention.py scans),
 drives the scheduler, and turns ``step()`` calls into token events:
 
-* admitted sequences are PREFILLED — one dense causal forward over
-  the prompt whose attention callback also scatters each layer's K/V
-  into the sequence's pool blocks, yielding the first sampled token
-  (the TTFT token);
-* the running set then takes ONE decode step as a single ragged
-  batch: every sequence's newest token is written into its next pool
-  slot and attention runs through the Pallas ragged paged kernel over
-  the block tables (interpret-mode on CPU — the same code path tier-1
-  tests).
+* admitted sequences are PREFILLED — a dense causal forward over the
+  not-yet-cached suffix of the prompt whose attention callback also
+  scatters each layer's K/V into the sequence's pool blocks. Under
+  ``FLAGS_kv_prefix_sharing`` the already-resident shared prefix is
+  skipped (its K/V rows are gathered from the pool instead of
+  recomputed), and the first write into a still-shared block goes
+  through copy-on-write. Under ``FLAGS_prefill_chunk_tokens`` the
+  prefill is CHUNKED: a sequence advances one chunk per step —
+  interleaved with the decode tick below, so one long prompt no
+  longer spikes every running stream's TPOT — and yields its first
+  sampled token (the TTFT token) only when the last chunk lands;
+* the running set (sequences whose prefill is done) then takes ONE
+  decode step as a single ragged batch: every sequence's newest token
+  is written into its next pool slot and attention runs through the
+  Pallas ragged paged kernel over the block tables (interpret-mode on
+  CPU — the same code path tier-1 tests).
 
 The model is any ``GPTLanguageModel``-shaped layer exposing
 ``forward_with_attn(ids, positions, attn_fn)``; the engine never
@@ -123,8 +130,7 @@ class LLMEngine:
             raise ValueError(f"prompt token out of range [0, {vocab})")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        projected = self._admission_gate(len(prompt),
-                                         int(max_new_tokens))
+        projected = self._admission_gate(prompt, int(max_new_tokens))
         self._next_seq += 1
         seq = Sequence(seq_id=self._next_seq, prompt=prompt,
                        max_new_tokens=int(max_new_tokens),
@@ -135,13 +141,37 @@ class LLMEngine:
         self.scheduler.add(seq)
         return seq.seq_id
 
-    def _admission_gate(self, prompt_len: int, max_new: int) -> int:
+    def _projected_blocks(self, prompt: List[int],
+                          max_new: int) -> int:
+        """Peak private-block demand of a new sequence. With prefix
+        sharing on, full prompt blocks that are already resident — or
+        that a live sequence's prompt will make resident by the time
+        this one admits — are satisfied by refcount bumps, so they
+        are subtracted from the projection (a partially-shared tail
+        block still counts: its first divergent write costs a private
+        copy). This is what lets a shared-prefix flood admit ~N× more
+        streams through the same watermark."""
+        projected = self.allocator.blocks_for(len(prompt) + max_new)
+        if not self.allocator._sharing():
+            return projected
+        m = self.allocator.probe_shared_tokens(prompt)
+        for seq in self._seqs.values():
+            other = seq.prompt
+            limit = min(len(prompt) - 1, len(other))
+            c = 0
+            while c < limit and prompt[c] == other[c]:
+                c += 1
+            m = max(m, c)
+        return max(1, projected - m // self.block_size)
+
+    def _admission_gate(self, prompt: List[int], max_new: int) -> int:
         """KV-watermark admission control: compute the sequence's
         projected peak block demand (an upper bound — blocks for
-        prompt + max_new tokens) and reject when the summed projection
-        of every live sequence would cross the watermark. Admitted
-        load then provably fits without preemption."""
-        projected = self.allocator.blocks_for(prompt_len + max_new)
+        prompt + max_new tokens, minus blocks prefix sharing will
+        satisfy) and reject when the summed projection of every live
+        sequence would cross the watermark. Admitted load then
+        provably fits without preemption."""
+        projected = self._projected_blocks(prompt, max_new)
         from ..flags import GLOBAL_FLAGS
         try:
             watermark = float(GLOBAL_FLAGS.get("kv_admission_watermark"))
@@ -211,17 +241,23 @@ class LLMEngine:
     def _step_inner(self) -> List[Dict[str, Any]]:
         events: List[Dict[str, Any]] = []
         try:
-            admitted = self.scheduler.admit()
+            self.scheduler.admit()
         except Exception as e:  # noqa: BLE001 — kv_alloc fault path
             # allocate() raised before the head left the waiting
             # queue: fail that one request, keep the engine alive
-            admitted = []
             if self.scheduler.waiting:
                 seq = self.scheduler.waiting.popleft()
                 events.append(self._fail(seq, f"kv allocation: {e}"))
-        for seq in admitted:
+        # chunked prefill tick: every running sequence with unwritten
+        # context advances ONE chunk (the whole remainder when
+        # FLAGS_prefill_chunk_tokens is 0), newly admitted sequences
+        # included — interleaved with the decode tick below
+        for seq in [s for s in self.scheduler.running
+                    if not s.prefill_done]:
+            if seq not in self.scheduler.running:
+                continue  # preempted by an earlier sequence's COW
             try:
-                events += self._prefill(seq)
+                events += self._prefill_chunk(seq)
             except Exception as e:  # noqa: BLE001 — fail ONE request
                 events.append(self._fail(seq, str(e)))
         events += self._decode()
@@ -237,27 +273,111 @@ class LLMEngine:
         return table[positions // self.block_size], \
             positions % self.block_size
 
-    def _prefill(self, seq: Sequence) -> List[Dict[str, Any]]:
+    @staticmethod
+    def _chunk_tokens(block_size: int) -> int:
+        """FLAGS_prefill_chunk_tokens, floored to a block-size
+        multiple (0 = chunking off: whole prompt in one step)."""
+        from ..flags import GLOBAL_FLAGS
+        try:
+            chunk = int(GLOBAL_FLAGS.get("prefill_chunk_tokens"))
+        # ptlint: disable=silent-failure -- flag may not be defined under direct submodule import; chunking simply stays off
+        except Exception:  # noqa: BLE001
+            return 0
+        if chunk <= 0:
+            return 0
+        return max(block_size, chunk - chunk % block_size)
+
+    def _make_writable(self, seq: Sequence, lo: int, hi: int) -> None:
+        """Copy-on-write gate before writing K/V rows at positions
+        [lo, hi): any still-shared block in that range is replaced
+        with a private copy — the shared block's rows are copied
+        in-pool via a scatter — preempting younger sequences if the
+        pool cannot supply the copy target. Raises when the pool can
+        never cover it (caller fails the one sequence)."""
+        bs = self.block_size
+        for idx in range(lo // bs, (max(lo, hi - 1)) // bs + 1):
+            r = self.scheduler.make_writable(seq, idx)
+            if r is None:
+                continue
+            if r is False:
+                raise RuntimeError(
+                    f"sequence needs a private copy of a shared KV "
+                    f"block but the pool holds "
+                    f"{self.pool_blocks * self.block_size} tokens "
+                    f"with no victims left")
+            old, new = r
+            for i in range(len(self._k_pools)):
+                self._k_pools[i] = self._k_pools[i].at[new].set(
+                    self._k_pools[i][old])
+                self._v_pools[i] = self._v_pools[i].at[new].set(
+                    self._v_pools[i][old])
+
+    def _prefill_chunk(self, seq: Sequence) -> List[Dict[str, Any]]:
+        """One prefill chunk for ``seq``: forward the next
+        FLAGS_prefill_chunk_tokens positions (everything left when
+        chunking is off), attending over the already-cached prefix
+        gathered from the pool, and scatter the fresh K/V rows into
+        the sequence's blocks. The shared prefix (cached_tokens) is
+        never recomputed. The final chunk samples the first token."""
         from ..testing import faults as _faults
-        _faults.hit("llm_prefill")
+        if seq.ctx_len == seq.cached_tokens:
+            # first chunk of this (re)admission — the historical
+            # per-sequence prefill fault point fires here once
+            _faults.hit("llm_prefill")
+        _faults.hit("llm_chunk_prefill")
         if seq.dispatch_unix is None:
             seq.dispatch_unix = time.time()
+        t0 = time.perf_counter()
         ids = seq.prompt + seq.generated  # re-prefill keeps generated
         t = len(ids)
-        pos = np.arange(t, dtype=np.int32)
+        c0 = seq.ctx_len
+        chunk = self._chunk_tokens(self.block_size)
+        n = t - c0 if chunk <= 0 else min(chunk, t - c0)
+        # COW before any write: the first uncached position may land
+        # in a block still shared with another sequence
+        self._make_writable(seq, c0, c0 + n)
+        pos = np.arange(c0, c0 + n, dtype=np.int32)
         blks, offs = self._slots(seq, pos)
+        cb = co = None
+        if c0 > 0:
+            cpos = np.arange(c0, dtype=np.int32)
+            cb, co = self._slots(seq, cpos)
 
         def attn_fn(i, q, k, v):
             self._k_pools[i] = self._k_pools[i].at[blks, offs].set(
                 k[0].astype(jnp.float32))
             self._v_pools[i] = self._v_pools[i].at[blks, offs].set(
                 v[0].astype(jnp.float32))
-            return dense_causal_attention(q, k, v)
+            if cb is None:
+                return dense_causal_attention(q, k, v)
+            # cached prefix (shared blocks / earlier chunks) comes
+            # from the pool; queries attend [cached + fresh] with
+            # their absolute positions
+            kc = self._k_pools[i][cb, co][None]
+            vc = self._v_pools[i][cb, co][None]
+            return dense_causal_attention(
+                q,
+                jnp.concatenate([kc, k.astype(jnp.float32)], axis=1),
+                jnp.concatenate([vc, v.astype(jnp.float32)], axis=1),
+                q_offset=c0)
 
         logits = self.model.forward_with_attn(
-            jnp.asarray([ids], jnp.int32), jnp.asarray([pos], jnp.int32),
-            attn_fn)[0, -1]
-        seq.ctx_len = t
+            jnp.asarray([ids[c0:c0 + n]], jnp.int32),
+            jnp.asarray([pos], jnp.int32), attn_fn)[0, -1]
+        seq.ctx_len = c0 + n
+        self.allocator.note_written(seq.seq_id, ids[:seq.ctx_len])
+        from .. import observability as obs
+        if obs.enabled():
+            from ..observability import metrics as _m
+            obs.histogram("llm_prefill_chunk_ms",
+                          "wall time of one prefill chunk "
+                          "(FLAGS_prefill_chunk_tokens; whole-prompt "
+                          "prefill when chunking is off)",
+                          buckets=_m.LATENCY_MS_BUCKETS).observe(
+                              (time.perf_counter() - t0) * 1e3)
+        if seq.ctx_len < t:
+            return []  # mid-prefill: decode keeps ticking meanwhile
+        seq.prefill_done = True
         return self._emit(seq, self._sample(seq, logits))
 
     def _decode(self) -> List[Dict[str, Any]]:
@@ -265,7 +385,7 @@ class LLMEngine:
         # oldest-first growth: preemption evicts from the young end,
         # so by the time a young sequence grows it may already be gone
         todo = sorted((s for s in self.scheduler.running
-                       if s.ctx_len > 0 and s.generated),
+                       if s.prefill_done and s.generated),
                       key=lambda s: s.admit_order)
         batch: List[Sequence] = []
         from ..testing import faults as _faults
@@ -275,6 +395,14 @@ class LLMEngine:
             try:
                 _faults.hit("llm_decode")
                 grown = self.scheduler.grow(seq, seq.ctx_len + 1)
+                if grown:
+                    # defensive COW gate: prefill already privatized
+                    # every block it wrote, so this is a refcount
+                    # lookup that never copies today — it keeps the
+                    # write path safe if sharing ever extends past
+                    # prefill (e.g. forked sampling)
+                    self._make_writable(seq, seq.ctx_len,
+                                        seq.ctx_len + 1)
             except Exception as e:  # noqa: BLE001 — fail ONE sequence
                 events.append(self._fail(seq, f"decode: {e}"))
                 continue
@@ -332,6 +460,8 @@ class LLMEngine:
                           ).observe(float(b))
         for i, seq in enumerate(batch):
             seq.ctx_len += 1
+            self.allocator.note_written(
+                seq.seq_id, (seq.prompt + seq.generated)[:seq.ctx_len])
             events += self._emit(seq, self._sample(seq, logits[i]))
         return events
 
@@ -455,6 +585,8 @@ class LLMEngine:
             and age > max(STALL_MIN_S, factor * ewma))
         return {"active": self.active(),
                 "running": len(self.scheduler.running),
+                "prefilling": sum(1 for s in self.scheduler.running
+                                  if not s.prefill_done),
                 "waiting": len(self.scheduler.waiting),
                 "kv_blocks_used": self.allocator.num_used,
                 "last_step_age_s":
@@ -475,3 +607,8 @@ class LLMEngine:
         obs.gauge("llm_waiting_seqs",
                   "sequences queued for admission (prefill pending)"
                   ).set(float(len(self.scheduler.waiting)))
+        obs.gauge("llm_prefilling_seqs",
+                  "admitted sequences still mid-chunked-prefill (not "
+                  "yet in the decode batch)").set(float(
+                      sum(1 for s in self.scheduler.running
+                          if not s.prefill_done)))

@@ -11,7 +11,11 @@ tested:
   paged allocator can cover its whole prompt (plus any tokens
   generated before a preemption). A short prompt arriving mid-decode
   of a long one is therefore in the batch on the very next step —
-  the interleaving property the tests assert.
+  the interleaving property the tests assert. Under
+  ``FLAGS_kv_prefix_sharing`` the allocator satisfies the already-
+  resident prefix by refcount bumps, so admission passes the token
+  timeline and records the shared-token count on the sequence
+  (prefill resumes from there).
 * **Growth** happens one token per decode step. When the pool is
   exhausted the scheduler preempts the YOUNGEST running sequence
   (LIFO): its blocks are freed and it returns to the FRONT of the
@@ -37,7 +41,11 @@ class Sequence:
     """One generate request's decoding state. ``prompt`` is the token
     id list; ``generated`` accumulates sampled ids (kept across
     preemptions); ``ctx_len`` counts tokens whose K/V currently sit in
-    the pool (0 while waiting)."""
+    the pool (0 while waiting). ``cached_tokens`` is the leading-token
+    count satisfied by prefix sharing at admission — prefill starts
+    there instead of position 0. ``prefill_done`` flips when the last
+    prefill chunk lands; only then does the sequence join the decode
+    batch (chunked prefill advances one chunk per step)."""
     seq_id: int
     prompt: List[int]
     max_new_tokens: int = 16
@@ -46,14 +54,16 @@ class Sequence:
     seed: int = 0
     generated: List[int] = field(default_factory=list)
     ctx_len: int = 0
+    cached_tokens: int = 0
+    prefill_done: bool = False
     admit_order: int = -1   # admission stamp; youngest = max
     preemptions: int = 0
     dispatch_unix: Optional[float] = None  # first prefill wall time
 
     @property
-    def cached_tokens(self) -> int:
-        """Tokens a (re-)prefill must write: prompt plus everything
-        generated before a preemption reset the cache."""
+    def total_tokens(self) -> int:
+        """Tokens the cache must cover for a (re-)prefill: prompt
+        plus everything generated before any preemption reset."""
         return len(self.prompt) + len(self.generated)
 
 
@@ -88,11 +98,16 @@ class ContinuousBatchingScheduler:
         cap = self.max_decode_batch()
         while self.waiting and len(self.running) < cap:
             seq = self.waiting[0]
-            if not self.allocator.allocate(seq.seq_id,
-                                           seq.cached_tokens):
+            tokens = seq.prompt + seq.generated
+            if not self.allocator.allocate(seq.seq_id, len(tokens),
+                                           tokens=tokens):
                 break  # FCFS: never skip the queue head
             self.waiting.popleft()
-            seq.ctx_len = 0  # prefill pending
+            # the shared prefix (if any) is already resident: prefill
+            # starts at cached_tokens instead of position 0
+            seq.cached_tokens = self.allocator.shared_tokens(seq.seq_id)
+            seq.ctx_len = seq.cached_tokens
+            seq.prefill_done = False
             self._admit_n += 1
             seq.admit_order = self._admit_n
             self.running.append(seq)
@@ -112,6 +127,24 @@ class ContinuousBatchingScheduler:
                 return False
             self.preempt(victim)
 
+    def make_writable(self, seq: Sequence, block_idx: int):
+        """Copy-on-write backstop: make the block at ``seq``'s table
+        position ``block_idx`` private, preempting YOUNGER running
+        sequences one at a time if the pool cannot supply the copy
+        target. Returns what allocator.make_private returns — None
+        (already private), an (old, new) pair the engine must copy
+        in-pool, or False when it can never fit. Preempting the very
+        sequence the block is shared with drops its refcount to 1, so
+        the retry then needs no copy at all."""
+        while True:
+            r = self.allocator.make_private(seq.seq_id, block_idx)
+            if r is not False:
+                return r
+            victim = self._youngest(exclude=seq)
+            if victim is None:
+                return False
+            self.preempt(victim)
+
     def _youngest(self, exclude: Sequence) -> Optional[Sequence]:
         cands = [s for s in self.running if s is not exclude]
         return max(cands, key=lambda s: s.admit_order) if cands else None
@@ -123,6 +156,8 @@ class ContinuousBatchingScheduler:
         self.allocator.free(seq.seq_id)
         self.running.remove(seq)
         seq.ctx_len = 0
+        seq.cached_tokens = 0
+        seq.prefill_done = False
         seq.preemptions += 1
         self.preemptions_total += 1
         self.waiting.appendleft(seq)

@@ -44,10 +44,13 @@ trainer's global step (set via :func:`set_step_context` by the fit
 loop).
 
 **LLM serving points** (``SERVING_POINTS``): the serving plane calls
-:func:`hit` at ``llm_prefill`` (engine prefill entry, per sequence),
-``llm_decode`` (decode growth, per sequence per step), ``kv_alloc``
-(paged allocator allocate/extend), and ``llm_chunk_write`` (before
-each streamed token frame). An exception at any of these terminates
+:func:`hit` at ``llm_prefill`` (engine prefill entry, once per
+sequence (re-)admission), ``llm_chunk_prefill`` (every prefill chunk
+under ``FLAGS_prefill_chunk_tokens`` — hits mid-prompt, where
+``llm_prefill`` cannot), ``llm_decode`` (decode growth, per sequence
+per step), ``kv_alloc`` (paged allocator allocate/extend), and
+``llm_chunk_write`` (before each streamed token frame). An exception
+at any of these terminates
 exactly one sequence/stream (error frame or cancel, blocks freed);
 the engine and serving loop survive — the property the serving chaos
 drills assert.
@@ -77,8 +80,8 @@ VALUE_POINTS = ("nonfinite_grad", "loss_spike")
 
 # LLM serving plane injection points (serving_llm/ + kv_cache);
 # firing any of them fails ONE sequence, never the serving loop
-SERVING_POINTS = ("llm_prefill", "llm_decode", "llm_chunk_write",
-                  "kv_alloc")
+SERVING_POINTS = ("llm_prefill", "llm_chunk_prefill", "llm_decode",
+                  "llm_chunk_write", "kv_alloc")
 _VALUE_DEFAULT_MUL = {"nonfinite_grad": float("nan"),
                       "loss_spike": 1e6}
 

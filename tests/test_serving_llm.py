@@ -222,7 +222,7 @@ class TestScheduler:
         s.finish(mid)
         young.generated = [9, 9, 9, 9, 9]
         assert [x.seq_id for x in s.admit()] == [3]
-        assert a.tokens(3) == young.cached_tokens == 9
+        assert a.tokens(3) == young.total_tokens == 9
 
     def test_grow_false_only_when_alone_and_too_big(self):
         a = KVBlockAllocator(num_blocks=2, block_size=4)
@@ -559,7 +559,9 @@ class TestKVAudit:
         assert alloc.gauges_agree() is True
         # consistent-but-unpublished mutation: a block moves from the
         # free list into a table with no gauge republish
-        alloc._tables[999] = [alloc._free.pop()]
+        blk = alloc._free.pop()
+        alloc._tables[999] = [blk]
+        alloc._refs[blk] = 1
         alloc._tokens[999] = 1
         alloc.check()                        # ownership still sound
         assert alloc.gauges_agree() is False
@@ -1072,3 +1074,405 @@ class TestWireFuzz:
             time.sleep(0.05)
         assert eng.allocator.num_used == 0
         eng.allocator.check()
+
+
+# ---------------------------------------------------------------------------
+# copy-on-write prefix sharing + chunked prefill
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def sharing_on():
+    pt.set_flags({"kv_prefix_sharing": True})
+    try:
+        yield
+    finally:
+        pt.set_flags({"kv_prefix_sharing": False})
+
+
+class TestPrefixSharingAllocator:
+    def test_allocate_shares_resident_prefix_and_partial_tail(
+            self, sharing_on):
+        a = KVBlockAllocator(num_blocks=8, block_size=4)
+        t1 = list(range(16))
+        assert a.allocate(1, 16, tokens=t1)
+        assert a.shared_tokens(1) == 0      # nothing resident yet
+        a.note_written(1, t1)               # blocks 0-3 enter the index
+        # 3 full shared blocks + a partial tail of block 3 (14 of 15
+        # tokens match; the final position is never shared)
+        t2 = t1[:14] + [99]
+        assert a.allocate(2, 15, tokens=t2)
+        assert a.table(2) == a.table(1) == [0, 1, 2, 3]
+        assert a.shared_tokens(2) == 14
+        assert a.num_shared == 4
+        assert all(a.refcount(b) == 2 for b in range(4))
+        assert a.prefix_hit_tokens_total == 14
+
+    def test_cow_and_refcounted_free(self, sharing_on):
+        a = KVBlockAllocator(num_blocks=8, block_size=4)
+        t1 = list(range(16))
+        a.allocate(1, 16, tokens=t1)
+        a.note_written(1, t1)
+        a.allocate(2, 15, tokens=t1[:14] + [99])
+        # first divergent write: block 3 is copied, not mutated
+        old, new = a.make_private(2, 3)
+        assert (old, new) == (3, 4)
+        assert a.table(1) == [0, 1, 2, 3]
+        assert a.table(2) == [0, 1, 2, 4]
+        assert a.refcount(3) == a.refcount(4) == 1
+        assert a.cow_copies_total == 1
+        assert a.make_private(2, 3) is None  # already private
+        # freeing the donor keeps blocks the survivor references
+        assert a.free(1) == 1                # only block 3 returns
+        assert a.num_used == 4
+        a.check()
+        assert a.free(2) == 4
+        assert a.num_used == 0
+        a.check()
+
+    def test_fully_cached_prompt_still_computes_last_position(
+            self, sharing_on):
+        a = KVBlockAllocator(num_blocks=8, block_size=4)
+        t = list(range(8))
+        a.allocate(1, 8, tokens=t)
+        a.note_written(1, t)
+        # identical prompt: the match is capped at len-1 so the engine
+        # always has a final position to forward for logits
+        assert a.probe_shared_tokens(t) == 7
+        a.allocate(2, 8, tokens=t)
+        assert a.shared_tokens(2) == 7
+
+    def test_random_sharing_ops_match_shadow_model(self, metrics_on,
+                                                   sharing_on):
+        # the PR-9 shadow-model stress, extended to refcount/COW/share
+        # ops: the shadow mirrors per-block refcounts and the exact
+        # LIFO free-list order; after every op the refcount map, the
+        # free list, check() and the published gauges must all agree
+        nb, bs = 16, 4
+        rng = random.Random(7)
+        a = KVBlockAllocator(num_blocks=nb, block_size=bs)
+        a.free(-1)                # prime the gauge publish token
+        stack = list(range(nb - 1, -1, -1))  # shadow LIFO free list
+        tables, toks, refs, written = {}, {}, {}, {}
+        for _ in range(300):
+            op = rng.choice(("alloc", "extend", "free", "cow",
+                             "written"))
+            if op == "alloc":
+                sid = rng.randrange(24)
+                if sid in tables:
+                    with pytest.raises(ValueError):
+                        a.allocate(sid, 4)
+                else:
+                    n = rng.randrange(0, 5 * bs)
+                    # tiny alphabet so prefix collisions are common
+                    tokens = [rng.randrange(2) for _ in range(n)]
+                    probe = a.probe_shared_tokens(tokens)
+                    before = set(refs)
+                    if a.allocate(sid, n, tokens=tokens):
+                        t = a.table(sid)
+                        shared = [b for b in t if b in before]
+                        fresh = [b for b in t if b not in before]
+                        m = a.shared_tokens(sid)
+                        assert m == probe
+                        assert 0 <= m <= max(0, n - 1)
+                        # shared blocks are a PREFIX of the table and
+                        # cover exactly the matched tokens
+                        assert t[:len(shared)] == shared
+                        assert len(shared) == -(-m // bs)
+                        assert len(t) == a.blocks_for(n)
+                        # fresh blocks came off the free stack in LIFO
+                        popped = [stack.pop()
+                                  for _ in range(len(fresh))]
+                        assert fresh == popped
+                        for b in shared:
+                            refs[b] += 1
+                        for b in fresh:
+                            refs[b] = 1
+                        tables[sid] = t
+                        toks[sid] = n
+                        written[sid] = tokens[:m]
+                    else:
+                        # failure implies the pool really was short
+                        assert a.blocks_for(n) > len(stack)
+                        assert a.table(sid) == []
+            elif op == "extend" and tables:
+                sid = rng.choice(sorted(tables))
+                n = toks[sid] + rng.randrange(-bs, 2 * bs)
+                ok = a.extend_to(sid, n)
+                if n <= toks[sid]:
+                    assert ok
+                else:
+                    need = -(-n // bs) - len(tables[sid])
+                    if need <= len(stack):
+                        assert ok
+                        popped = [stack.pop() for _ in range(need)]
+                        for b in popped:
+                            refs[b] = 1
+                        tables[sid] = tables[sid] + popped
+                        toks[sid] = n
+                    else:
+                        assert not ok
+            elif op == "cow" and tables:
+                sid = rng.choice(sorted(tables))
+                if tables[sid]:
+                    idx = rng.randrange(len(tables[sid]))
+                    old = tables[sid][idx]
+                    r = a.make_private(sid, idx)
+                    if refs[old] <= 1:
+                        assert r is None
+                    elif not stack:
+                        assert r is False
+                    else:
+                        new = stack.pop()
+                        assert r == (old, new)
+                        refs[old] -= 1
+                        refs[new] = 1
+                        tables[sid][idx] = new
+            elif op == "written" and tables:
+                # engine contract: monotone timeline of tokens whose
+                # K/V really are in the table's blocks
+                sid = rng.choice(sorted(tables))
+                tl = written.get(sid, [])
+                room = toks[sid] - len(tl)
+                if room > 0:
+                    tl = tl + [rng.randrange(2)
+                               for _ in range(rng.randrange(1,
+                                                            room + 1))]
+                    written[sid] = tl
+                    a.note_written(sid, tl)
+            elif op == "free":
+                sid = rng.choice(sorted(tables)) \
+                    if tables and rng.random() < 0.9 \
+                    else rng.randrange(24)
+                got = a.free(sid)
+                blocks = tables.pop(sid, [])
+                toks.pop(sid, None)
+                written.pop(sid, None)
+                returned = []
+                for b in reversed(blocks):
+                    refs[b] -= 1
+                    if refs[b] == 0:
+                        del refs[b]
+                        returned.append(b)
+                assert got == len(returned)
+                stack.extend(returned)
+            # full-state agreement after EVERY op
+            for sid, t in tables.items():
+                assert a.table(sid) == t
+                assert a.tokens(sid) == toks[sid]
+            assert a._free == stack          # exact LIFO order
+            assert a._refs == refs
+            a.check()
+            assert a.gauges_agree() is True
+
+
+class TestSchedulerSharing:
+    def test_fcfs_holds_when_shared_admit_would_fit(self, sharing_on):
+        # a shared-prefix sequence behind a blocked PRIVATE head must
+        # not jump the queue, even though its post-sharing demand fits
+        a = KVBlockAllocator(num_blocks=5, block_size=4)
+        s = ContinuousBatchingScheduler(a, max_decode_batch=8)
+        pre = list(range(4))
+        s1 = Sequence(seq_id=1, prompt=pre + [9, 9, 9, 9])
+        s.add(s1)
+        assert [x.seq_id for x in s.admit()] == [1]
+        a.note_written(1, s1.prompt)         # preamble now resident
+        s2 = Sequence(seq_id=2, prompt=[7] * 17)   # 5 blocks > 3 free
+        s3 = Sequence(seq_id=3, prompt=pre + [8])  # shares 1 block
+        s.add(s2)
+        s.add(s3)
+        assert s.admit() == []               # head blocked; 3 WAITS
+        assert [x.seq_id for x in s.waiting] == [2, 3]
+        s.cancel(2)                          # unblock the queue head
+        admitted = s.admit()
+        assert [x.seq_id for x in admitted] == [3]
+        # ...and 3 really admitted BY SHARING, not a fresh block
+        assert a.table(3)[0] == a.table(1)[0]
+        assert a.refcount(a.table(1)[0]) == 2
+        assert admitted[0].cached_tokens == 4
+
+
+class TestPrefixSharingEngine:
+    def _collect(self, eng, out, max_steps=400):
+        """Drive to quiescence; step() audits check()+gauges_agree()
+        after every step. Returns the peak shared-block count seen."""
+        peak_shared = 0
+        steps = 0
+        while eng.active():
+            steps += 1
+            assert steps <= max_steps, "engine did not quiesce"
+            for ev in eng.step():
+                assert ev["type"] in ("token", "finished"), ev
+                if ev["type"] == "token":
+                    out.setdefault(ev["seq_id"],
+                                   []).append(ev["token"])
+            peak_shared = max(peak_shared, eng.allocator.num_shared)
+        return peak_shared
+
+    def test_cow_divergence_exact_parity(self, model, metrics_on):
+        # two prompts sharing 14 tokens (3.5 blocks): B shares full
+        # blocks AND a partial tail of A's block 3, then diverges
+        # mid-block — its first write fires copy-on-write. Both must
+        # match the dense reference exactly, through chunked prefill.
+        pt.set_flags({"kv_prefix_sharing": True,
+                      "prefill_chunk_tokens": 4})
+        try:
+            eng = LLMEngine(model, block_size=4, pool_blocks=32)
+            shared = list(range(1, 15))
+            p1 = shared + [20, 21]
+            p2 = shared + [30]
+            out = {}
+            i1 = eng.add_request(p1, max_new_tokens=8)
+            for _ in range(6):   # A fully prefilled + decoding
+                for ev in eng.step():
+                    if ev["type"] == "token":
+                        out.setdefault(ev["seq_id"],
+                                       []).append(ev["token"])
+            i2 = eng.add_request(p2, max_new_tokens=8)
+            peak_shared = self._collect(eng, out)
+            assert np.array_equal(out[i1],
+                                  _ref(model, p1, max_new_tokens=8))
+            assert np.array_equal(out[i2],
+                                  _ref(model, p2, max_new_tokens=8))
+            assert peak_shared > 0
+            assert eng.allocator.cow_copies_total >= 1
+            assert eng.allocator.prefix_hit_tokens_total >= 14
+            assert eng.allocator.num_used == 0
+            eng.allocator.check()
+        finally:
+            pt.set_flags({"kv_prefix_sharing": False,
+                          "prefill_chunk_tokens": 0})
+
+    def test_preempt_mid_prefill_readmit_parity(self, model,
+                                                metrics_on):
+        # pool sized so A's decode growth lands while B is still
+        # mid-chunked-prefill: B is preempted (partial-prefill blocks
+        # freed, shared blocks stay with A), waits for A to finish,
+        # re-prefills from scratch, and still matches dense exactly
+        pt.set_flags({"kv_prefix_sharing": True,
+                      "prefill_chunk_tokens": 4})
+        try:
+            eng = LLMEngine(model, block_size=4, pool_blocks=9)
+            pa = list(range(1, 9))
+            ra = _ref(model, pa, max_new_tokens=16)
+            # B shares A's 8-token prompt; its 9th token must differ
+            # from A's first SAMPLED token or the shared-block math
+            # below shifts by one
+            v = (int(ra[0]) + 1) % model.config.vocab_size
+            pb = pa + [v] * 24                  # 32 tokens, 8 chunks
+            out = {}
+            ia = eng.add_request(pa, max_new_tokens=16)
+            for _ in range(2):   # A prefills (2 chunks) + first decode
+                for ev in eng.step():
+                    if ev["type"] == "token":
+                        out.setdefault(ev["seq_id"],
+                                       []).append(ev["token"])
+            ib = eng.add_request(pb, max_new_tokens=4)
+            self._collect(eng, out)
+            assert eng.scheduler.preemptions_total == 1
+            assert np.array_equal(out[ia], ra)
+            assert np.array_equal(out[ib],
+                                  _ref(model, pb, max_new_tokens=4))
+            # B's first admission shared A's two prompt blocks
+            assert eng.allocator.prefix_hit_tokens_total >= 8
+            assert eng.allocator.num_used == 0
+            eng.allocator.check()
+        finally:
+            pt.set_flags({"kv_prefix_sharing": False,
+                          "prefill_chunk_tokens": 0})
+
+    def test_readmit_resumes_from_shared_prefix(self, model,
+                                                metrics_on):
+        # preempted mid-prefill while the donor is still live: the
+        # readmission re-shares the resident prefix, so prefill
+        # RESUMES from the shared block instead of position 0
+        pt.set_flags({"kv_prefix_sharing": True,
+                      "prefill_chunk_tokens": 4})
+        try:
+            eng = LLMEngine(model, block_size=4, pool_blocks=32)
+            pa = list(range(1, 13))
+            pb = pa[:8] + [77] * 8              # shares 8 tokens
+            out = {}
+            ia = eng.add_request(pa, max_new_tokens=20)
+            for _ in range(4):
+                for ev in eng.step():
+                    if ev["type"] == "token":
+                        out.setdefault(ev["seq_id"],
+                                       []).append(ev["token"])
+            def step_collect():
+                for ev in eng.step():
+                    if ev["type"] == "token":
+                        out.setdefault(ev["seq_id"],
+                                       []).append(ev["token"])
+
+            ib = eng.add_request(pb, max_new_tokens=4)
+            step_collect()                      # B admits + chunk 1
+            sb = next(s for s in eng.scheduler.running
+                      if s.seq_id == ib)
+            assert not sb.prefill_done and sb.ctx_len == 12
+            eng.scheduler.preempt(sb)           # mid-prefill eviction
+            assert sb.ctx_len == 0 and sb.cached_tokens == 0
+            step_collect()                      # readmitted next step
+            assert sb.cached_tokens == 8        # resumed from sharing
+            assert sb.ctx_len > 8
+            self._collect(eng, out)
+            assert np.array_equal(out[ia],
+                                  _ref(model, pa, max_new_tokens=20))
+            assert np.array_equal(out[ib],
+                                  _ref(model, pb, max_new_tokens=4))
+            assert eng.allocator.num_used == 0
+            eng.allocator.check()
+        finally:
+            pt.set_flags({"kv_prefix_sharing": False,
+                          "prefill_chunk_tokens": 0})
+
+    def test_shared_flood_admits_more_streams(self, model,
+                                              metrics_on):
+        # PR 10 acceptance, sharing edition: a shared-preamble flood
+        # at 2x the UNSHARED pool demand. The watermark projects
+        # post-sharing demand, so sharing admits strictly more
+        # streams with zero preemptions and zero leak (step() audits
+        # check() + gauges_agree() after every step).
+        pre = list(range(100, 116))             # 16-token preamble
+        prompts = [pre + [i, i + 1, 200 + i, 7] for i in range(8)]
+        blocks_per_req = -(-(20 + 8) // 4)      # prompt + max_new
+        pool = 8 * blocks_per_req // 2          # half the flood
+
+        def flood(sharing):
+            pt.set_flags({"kv_admission_watermark": 1.0,
+                          "kv_prefix_sharing": sharing,
+                          "prefill_chunk_tokens": 8})
+            try:
+                eng = LLMEngine(model, block_size=4, pool_blocks=pool)
+                admitted, out = [], {}
+                for p in prompts:
+                    try:
+                        admitted.append(
+                            eng.add_request(p, max_new_tokens=8))
+                    except AdmissionRejected:
+                        pass
+                    # stagger arrivals so the preamble a later stream
+                    # will share is actually WRITTEN (2 chunks), not
+                    # merely projected
+                    for _ in range(2):
+                        for ev in eng.step():
+                            if ev["type"] == "token":
+                                out.setdefault(ev["seq_id"],
+                                               []).append(ev["token"])
+                self._collect(eng, out)
+                assert eng.scheduler.preemptions_total == 0
+                assert not obs.counter(
+                    "kv_blocks_preempted_total").value()
+                assert eng.allocator.num_used == 0
+                eng.allocator.check()
+                for sid in admitted:   # every admitted stream served
+                    assert len(out[sid]) == 8
+                return len(admitted)
+            finally:
+                pt.set_flags({"kv_admission_watermark": 0.0,
+                              "kv_prefix_sharing": False,
+                              "prefill_chunk_tokens": 0})
+
+        unshared = flood(False)
+        shared = flood(True)
+        assert shared == len(prompts)           # full flood admitted
+        assert shared > unshared

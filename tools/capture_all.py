@@ -82,6 +82,12 @@ STAGES = {
     # sequential generation (tokens/s + TTFT p50/p99); small model,
     # bounded token count — cheap enough for every campaign
     "llm_decode": (["llm_decode"], _SKIP, 600),
+    # serving speed tier A/Bs: copy-on-write shared-prefix KV reuse
+    # (admitted-streams x + kv_blocks_used vs unshared) and chunked
+    # prefill (p99 inter-token with long-prompt arrivals, on vs off).
+    # Both flags are [assumed off] until these land on-chip numbers.
+    "llm_prefix_reuse": (["llm_prefix_reuse"], _SKIP, 600),
+    "llm_mixed_prefill": (["llm_mixed_prefill"], _SKIP, 600),
     # tile-size sweep for the flash kernel (only worth chip time if the
     # default-tile flash_train stage loses to XLA)
     "flash_train_t128": (["flash_train"],
@@ -189,6 +195,7 @@ STAGES = {
                                     "FLAGS_fused_qkv_projection": "0",
                                     "FLAGS_flash_attention_min_seq_train":
                                     "512",
+                                    "FLAGS_attention_bthd_layout": "0",
                                     "PT_BENCH_STEPS_PER_LOOP": "32"},
                                900),
     # block remat on the HBM-bound step: recompute FLOPs ride idle MXU
@@ -244,6 +251,7 @@ STAGES = {
                                    "FLAGS_fused_qkv_projection": "0",
                                    "FLAGS_flash_attention_min_seq_train":
                                    "512",
+                                   "FLAGS_attention_bthd_layout": "0",
                                    "PT_BENCH_STEPS_PER_LOOP": "8"}, 900),
     # flash512 at the b4 ladder point (only worth running if plain b4
     # lands within noise of b8)
@@ -261,6 +269,7 @@ STAGES = {
                                 "FLAGS_fused_qkv_projection": "0",
                                 "FLAGS_flash_attention_min_seq_train":
                                 "512",
+                                "FLAGS_attention_bthd_layout": "0",
                                 "FLAGS_use_pallas_layer_norm": "0",
                                 "PT_BENCH_STEPS_PER_LOOP": "8"}, 900),
     "bert_b32_remat": ([], {**_SKIP, **_SPL1,

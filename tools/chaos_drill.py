@@ -41,6 +41,11 @@ the recovery contract from docs/fault_tolerance.md:
   llm_decode_error — an injected decode exception error-terminates
                      exactly ONE sequence; the other finishes with
                      dense parity and every KV block is freed.
+  llm_prefix_cow_leak — one of two prefix-sharing streams dies
+                     mid-chunked-prefill (llm_chunk_prefill fault,
+                     after its copy-on-write fired): the survivor
+                     keeps exact dense parity, refcounted blocks are
+                     NOT freed while referenced, pool drains to zero.
 
 Usage:
   python tools/chaos_drill.py --self-test        # all drills (CPU)
@@ -725,6 +730,119 @@ def drill_llm_decode_error(tmp):
             "exact parity, all KV blocks freed")
 
 
+_LLM_PREFIX_COW_LEAK = r"""
+import json, sys
+import numpy as np
+import jax.numpy as jnp
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu.models import GPTLanguageModel
+from paddle_tpu.serving_llm import LLMEngine
+
+out = sys.argv[1]
+pt.set_flags({"kv_prefix_sharing": True, "prefill_chunk_tokens": 8})
+model = GPTLanguageModel()
+engine = LLMEngine(model, block_size=4, pool_blocks=32)
+shared = list(range(1, 15))              # 14 tokens: 3.5 blocks
+prompt_a = shared + [20, 21]             # 16 tokens
+prompt_b = shared + list(range(30, 41))  # 25 tokens, diverges at 14
+sid_a = engine.add_request(np.asarray(prompt_a, np.int32),
+                           max_new_tokens=8)
+toks, errors = {}, []
+max_shared = 0
+used_after_error = check_after_error = None
+sid_b = None
+for step in range(64):
+    if step == 3:
+        # A is decoded past its prompt: B admits sharing 3 full
+        # blocks + a partial tail of A's block 3 (COW material)
+        sid_b = engine.add_request(np.asarray(prompt_b, np.int32),
+                                   max_new_tokens=8)
+    for e in engine.step():
+        if e["type"] == "token":
+            toks.setdefault(e["seq_id"], []).append(int(e["token"]))
+        elif e["type"] == "error":
+            errors.append(e)
+            used_after_error = engine.allocator.num_used
+            try:
+                engine.allocator.check()
+                check_after_error = True
+            except AssertionError:
+                check_after_error = False
+    max_shared = max(max_shared, engine.allocator.num_shared)
+    if not engine.active():
+        break
+ref = [int(t) for t in np.asarray(model.generate(
+    jnp.asarray([prompt_a], jnp.int32), max_new_tokens=8))[0]]
+check_ok = True
+try:
+    engine.allocator.check()
+except AssertionError:
+    check_ok = False
+res = {
+    "n_error": len(errors),
+    "error_seq": errors[0]["seq_id"] if errors else None,
+    "error_msgs": [e["error"] for e in errors],
+    "sid_a": sid_a, "sid_b": sid_b,
+    "a_tokens": toks.get(sid_a, []),
+    "dense_ref": ref,
+    "max_shared": max_shared,
+    "cow_copies": engine.allocator.cow_copies_total,
+    "prefix_hits": engine.allocator.prefix_hit_tokens_total,
+    "used_after_error": used_after_error,
+    "check_after_error": check_after_error,
+    "kv_used_final": engine.allocator.num_used,
+    "check_ok": check_ok,
+    "faults_injected": obs.counter(
+        "faults_injected_total").value(point="llm_chunk_prefill"),
+}
+json.dump(res, open(out, "w"))
+"""
+
+
+def drill_llm_prefix_cow_leak(tmp):
+    """Cancel one of two prefix-sharing streams mid-chunked-prefill
+    (llm_chunk_prefill fault): the survivor keeps exact dense parity,
+    blocks stay held while referenced, and the pool drains to zero."""
+    script = os.path.join(tmp, "llm_prefix_cow_leak.py")
+    with open(script, "w") as f:
+        f.write(_LLM_PREFIX_COW_LEAK)
+    out = os.path.join(tmp, "llm_prefix_cow_leak.json")
+    # chunk hits: A prefills in 2 chunks (16 tokens / 8), B's shared
+    # prefix leaves 11 tokens = 2 more chunks; at=4 lands in B's
+    # SECOND chunk — mid-prefill, after its COW copy fired
+    proc = subprocess.run(
+        [sys.executable, script, out],
+        env=_env(tmp,
+                 fault_spec="llm_chunk_prefill:at=4:exc=RuntimeError"),
+        capture_output=True, text=True, timeout=300)
+    _check(proc.returncode == 0,
+           f"cow-leak run died rc={proc.returncode}\n{proc.stderr}")
+    res = json.load(open(out))
+    _check(res["faults_injected"] == 1,
+           f"faults_injected_total{{point=llm_chunk_prefill}} should "
+           f"be 1: {res}")
+    _check(res["n_error"] == 1 and res["error_seq"] == res["sid_b"],
+           f"exactly the prefix-sharing stream B should die "
+           f"mid-prefill: {res}")
+    _check(any("fault injected" in m for m in res["error_msgs"]),
+           f"error event does not carry the injected fault: {res}")
+    _check(res["max_shared"] > 0 and res["prefix_hits"] >= 14,
+           f"B never actually shared A's prefix blocks: {res}")
+    _check(res["cow_copies"] >= 1,
+           f"B's divergent write never triggered copy-on-write: {res}")
+    _check(res["used_after_error"] and res["check_after_error"],
+           f"freeing dead B released blocks still referenced by A "
+           f"(or broke allocator invariants): {res}")
+    _check(res["a_tokens"] == res["dense_ref"],
+           f"survivor diverged from the dense reference after B's "
+           f"mid-prefill death: {res}")
+    _check(res["kv_used_final"] == 0 and res["check_ok"],
+           f"KV blocks leaked after the drill: {res}")
+    return ("mid-prefill death of a prefix-sharing stream left the "
+            "survivor bit-exact and leaked zero KV blocks")
+
+
 def drill_exact_resume(tmp):
     """SIGKILL mid-epoch + v3 resume == uninterrupted run, bitwise."""
     try:
@@ -748,6 +866,7 @@ DRILLS = {
     "llm_overload_shed": drill_llm_overload_shed,
     "llm_drain_sigterm": drill_llm_drain_sigterm,
     "llm_decode_error": drill_llm_decode_error,
+    "llm_prefix_cow_leak": drill_llm_prefix_cow_leak,
 }
 
 
