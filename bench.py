@@ -1190,6 +1190,103 @@ def bench_llm_mixed_prefill(on_accel: bool) -> None:
     })
 
 
+def bench_llm_spec_decode(on_accel: bool) -> None:
+    """Speculative decoding (FLAGS_speculative_k): same request set
+    decoded with and without a draft proposing k tokens per step for
+    the target to verify in one batched ragged multi-query paged
+    forward. The CPU sanity configuration is SELF-drafting (draft ==
+    target): the accept rate must be exactly 1.0 at temperature 0 and
+    the output token-for-token identical — what the stage measures is
+    the verify-step amortization (accepted tokens per target step),
+    which is the on-chip speedup lever once a cheap draft exists.
+    Reports accepted tokens/s; vs_baseline is the speculative/
+    non-speculative throughput ratio, with accept-rate and
+    verify-latency partials."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.models import GPTLanguageModel
+    from paddle_tpu.serving_llm import LLMEngine
+
+    model = GPTLanguageModel()
+    rng = np.random.default_rng(0)
+    n_req, max_new, spec_k = (8, 32, 4) if on_accel else (4, 12, 3)
+    prompts = [rng.integers(0, model.config.vocab_size,
+                            size=ln).astype(np.int32)
+               for ln in ([8, 48] * n_req)[:n_req]]
+
+    def run(k: int):
+        pt.set_flags({"speculative_k": k})
+        engine = LLMEngine(model, block_size=16, pool_blocks=128,
+                           draft_model=model if k else None)
+        toks = {}
+        try:
+            # warm the compile caches outside the timed window
+            wid = engine.add_request(prompts[0], max_new_tokens=3)
+            while engine.active():
+                engine.step()
+            assert engine.allocator.num_used == 0
+            t0 = time.perf_counter()
+            sids = [engine.add_request(p, max_new_tokens=max_new)
+                    for p in prompts]
+            while engine.active():
+                for ev in engine.step():
+                    if ev["type"] == "token":
+                        toks.setdefault(ev["seq_id"],
+                                        []).append(int(ev["token"]))
+                    elif ev["type"] == "error":
+                        raise AssertionError(f"decode error: {ev}")
+            dt = time.perf_counter() - t0
+        finally:
+            pt.set_flags({"speculative_k": 0})
+        # the zero-leak contract survives the rollback machinery
+        assert engine.allocator.num_used == 0, "KV leak"
+        engine.allocator.check()
+        toks.pop(wid, None)
+        assert sorted(len(t) for t in toks.values()) \
+            == [max_new] * n_req
+        return dt, [toks[s] for s in sids], engine
+
+    base_s, base_toks, _ = run(0)
+    spec_s, spec_toks, eng = run(spec_k)
+    assert spec_toks == base_toks, \
+        "speculative output diverged from non-speculative decode"
+    accept_rate = (eng.spec_accepted_total / eng.spec_proposed_total
+                   if eng.spec_proposed_total else 0.0)
+    assert accept_rate == 1.0, \
+        f"self-draft accept rate must be 1.0, got {accept_rate}"
+    verify_ms = (eng.spec_verify_ms_total / eng.spec_verify_steps
+                 if eng.spec_verify_steps else 0.0)
+    n_tok = n_req * max_new
+    ratio = round((n_tok / spec_s) / (n_tok / base_s), 3)
+    log(f"speculative k={spec_k} self-draft: {spec_s:.2f}s "
+        f"({n_tok / spec_s:.1f} tok/s) vs non-speculative "
+        f"{base_s:.2f}s ({ratio}x); accept rate "
+        f"{accept_rate:.2f}, verify {verify_ms:.1f}ms/step, "
+        f"{eng.spec_verify_steps} verify steps for {n_tok} tokens")
+    emit_partial({
+        "metric": f"llm spec decode accept rate (self-draft, "
+                  f"k={spec_k})",
+        "value": round(accept_rate, 3), "unit": "ratio",
+        "accepted_tokens": eng.spec_accepted_total,
+        "proposed_tokens": eng.spec_proposed_total,
+    })
+    emit_partial({
+        "metric": "llm spec decode verify latency",
+        "value": round(verify_ms, 1), "unit": "ms",
+        "verify_steps": eng.spec_verify_steps,
+    })
+    emit({
+        "metric": f"llm speculative decode throughput ({n_req} reqs "
+                  f"x {max_new} tokens, self-draft k={spec_k})",
+        "value": round(n_tok / spec_s, 2),
+        "unit": "tokens/s",
+        "vs_baseline": ratio,
+        "accept_rate": round(accept_rate, 3),
+        "verify_ms_mean": round(verify_ms, 1),
+    })
+
+
 def bench_flash_train(on_accel: bool) -> None:
     """Training-mode flash crossover: fwd+bwd at BERT geometry (head
     dim 64, attention dropout 0.1) — the numbers that set
@@ -1425,6 +1522,8 @@ def main() -> None:
         bench_llm_prefix_reuse(on_accel)
     elif which == "llm_mixed_prefill":
         bench_llm_mixed_prefill(on_accel)
+    elif which == "llm_spec_decode":
+        bench_llm_spec_decode(on_accel)
     else:
         bench_bert(on_accel)
 

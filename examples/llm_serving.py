@@ -6,10 +6,17 @@ in docs/serving_protocol.md, "Streaming generation").
 The point to watch in the output: short prompts that arrive while a
 long prompt is mid-decode still get fast first tokens — admission is
 continuous, not batch-synchronous.
+
+``--speculative`` runs the same workload with speculative decoding on
+(FLAGS_speculative_k, self-drafting so the accept rate is exactly 1.0
+at temperature 0) and prints the accept rate alongside TTFT/TPOT —
+the CPU-visible proof that drafts verify and commit without changing
+a single output token.
 """
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
 
@@ -25,13 +32,30 @@ def _percentile(xs, q):
 
 
 def main(n_clients: int = 8, max_new_tokens: int = 8,
-         verbose: bool = True):
-    from paddle_tpu.inference import Client, Server
+         verbose: bool = True, speculative: bool = False):
+    import paddle_tpu as pt
     from paddle_tpu.models import GPTLanguageModel
     from paddle_tpu.serving_llm import LLMEngine
 
     model = GPTLanguageModel()
-    engine = LLMEngine(model, block_size=16, pool_blocks=64)
+    if speculative:
+        pt.set_flags({"speculative_k": 4})
+        engine = LLMEngine(model, block_size=16, pool_blocks=64,
+                           draft_model=model)
+    else:
+        engine = LLMEngine(model, block_size=16, pool_blocks=64)
+    try:
+        return _run(engine, n_clients, max_new_tokens, verbose,
+                    speculative)
+    finally:
+        if speculative:
+            pt.set_flags({"speculative_k": 0})
+
+
+def _run(engine, n_clients, max_new_tokens, verbose, speculative):
+    from paddle_tpu.inference import Client, Server
+
+    model = engine.model
     rng = np.random.default_rng(0)
     # mixed prompt lengths: half short chat-style, half long-context
     prompts = [rng.integers(0, model.config.vocab_size,
@@ -82,15 +106,30 @@ def main(n_clients: int = 8, max_new_tokens: int = 8,
         "tpot_p50_ms": _percentile(tpots, 50),
         "preemptions": engine.scheduler.preemptions_total,
     }
+    if speculative:
+        # self-drafting at temperature 0: anything below 1.0 means the
+        # verify/commit path changed a token it should not have
+        accept_rate = (engine.spec_accepted_total
+                       / engine.spec_proposed_total
+                       if engine.spec_proposed_total else 0.0)
+        assert accept_rate == 1.0, accept_rate
+        summary["accept_rate"] = accept_rate
+        summary["proposed_tokens"] = engine.spec_proposed_total
     if verbose:
-        print(f"llm_serving: {n_clients} concurrent streaming clients, "
-              f"{n_tokens} tokens in {wall_s:.2f}s "
+        mode = " [speculative]" if speculative else ""
+        print(f"llm_serving{mode}: {n_clients} concurrent streaming "
+              f"clients, {n_tokens} tokens in {wall_s:.2f}s "
               f"({summary['tokens_per_s']:.1f} tok/s aggregate)")
         print(f"  TTFT p50={summary['ttft_p50_ms']:.1f}ms "
               f"p99={summary['ttft_p99_ms']:.1f}ms | "
               f"TPOT p50={summary['tpot_p50_ms']:.1f}ms | "
               f"KV pool clean, "
               f"preemptions={summary['preemptions']}")
+        if speculative:
+            print(f"  speculative: accept rate "
+                  f"{summary['accept_rate']:.2f} over "
+                  f"{summary['proposed_tokens']} proposed draft "
+                  f"tokens (self-draft, temp 0 — must be 1.00)")
         for i, r in enumerate(results):
             kind = "short" if i % 2 else "long "
             print(f"  client {i} ({kind}, {len(prompts[i])} prompt "
@@ -100,4 +139,4 @@ def main(n_clients: int = 8, max_new_tokens: int = 8,
 
 
 if __name__ == "__main__":
-    main()
+    main(speculative="--speculative" in sys.argv[1:])

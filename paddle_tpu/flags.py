@@ -537,6 +537,34 @@ define_flag("llm_stall_factor", 10.0,
             "reports the serving section unhealthy (HTTP 503). A "
             "floor of 0.5s avoids flapping on scheduler jitter. 0 "
             "disables the watchdog.")
+define_flag("speculative_k", 0,
+            "LLM serving (serving_llm): speculative decoding. When "
+            "> 0, a small draft model proposes up to this many tokens "
+            "per running sequence per engine step; the target model "
+            "verifies every window in ONE batched ragged multi-query "
+            "paged-attention step and commits the longest accepted "
+            "prefix plus the target's bonus token (temperature 0 and "
+            "the position-keyed sampler make the output token-for-"
+            "token identical to non-speculative decode). Draft K/V "
+            "written past the accepted point is rolled back via the "
+            "allocator's truncate_to (llm_spec_*_tokens_total, "
+            "llm_spec_accept_rate, llm_spec_verify_ms). 0 (default) "
+            "disables — 0 [assumed] pending chip capture (bench.py "
+            "llm_spec_decode). Read every step, so it can be retuned "
+            "on a live server.")
+define_flag("speculative_draft_layers", 1,
+            "LLM serving (serving_llm): transformer layers of the "
+            "auto-built draft model used when speculative_k > 0 and "
+            "LLMEngine was given no draft_model (same hidden/head/"
+            "vocab geometry as the target, this many layers). Read "
+            "when the draft is first built (once per engine).")
+define_flag("speculative_draft_tie_embeddings", True,
+            "LLM serving (serving_llm): share the target model's "
+            "token and position embedding tables with the auto-built "
+            "draft model (the output head is tied to the input "
+            "embedding, so this ties it too) — the standard "
+            "memory-free draft head. Only consulted when the engine "
+            "builds its own draft (draft_model=None).")
 
 
 def _fault_spec_changed(value) -> None:

@@ -99,6 +99,23 @@ def maybe_paged_attention(q, k_pool, v_pool, block_tables, context_lens,
                            interpret=not pallas_enabled())
 
 
+def maybe_paged_attention_multiquery(q, q_lens, k_pool, v_pool,
+                                     block_tables, context_lens,
+                                     scale: Optional[float] = None):
+    """Ragged MULTI-QUERY paged attention — the speculative-decode
+    verify step (q [B, Qmax, H, D] plus per-sequence q_lens; see
+    kernels/paged_attention.py). Same routing story as
+    maybe_paged_attention: no separate XLA composition — off-
+    accelerator the kernel runs under the Pallas interpreter, and a
+    Qmax == 1 batch reduces to the single-query kernel path
+    bit-for-bit."""
+    from .paged_attention import paged_attention_multiquery
+    return paged_attention_multiquery(q, q_lens, k_pool, v_pool,
+                                      block_tables, context_lens,
+                                      scale=scale,
+                                      interpret=not pallas_enabled())
+
+
 def _is_key_padding_mask(mask, batch: int, tk: int) -> bool:
     """True for exactly-shaped [B, 1, 1, Tk] masks (no broadcasting)."""
     return (getattr(mask, "ndim", 0) == 4

@@ -20,7 +20,15 @@ drives the scheduler, and turns ``step()`` calls into token events:
   decode step as a single ragged batch: every sequence's newest token
   is written into its next pool slot and attention runs through the
   Pallas ragged paged kernel over the block tables (interpret-mode on
-  CPU — the same code path tier-1 tests).
+  CPU — the same code path tier-1 tests). Under
+  ``FLAGS_speculative_k`` the step is SPECULATIVE instead: a small
+  draft model proposes up to k tokens per sequence, the target
+  verifies every window in one batched ragged MULTI-QUERY paged
+  forward, the longest accepted prefix is committed plus the
+  target's bonus token, and draft K/V past the accepted point is
+  rolled back (``KVBlockAllocator.truncate_to``) — output stays
+  token-for-token identical to non-speculative decode because both
+  paths sample through the same position-keyed RNG.
 
 The model is any ``GPTLanguageModel``-shaped layer exposing
 ``forward_with_attn(ids, positions, attn_fn)``; the engine never
@@ -83,7 +91,8 @@ def health_snapshot() -> Dict[str, Any]:
 class LLMEngine:
     def __init__(self, model, block_size: Optional[int] = None,
                  pool_blocks: Optional[int] = None,
-                 max_decode_batch: Optional[int] = None):
+                 max_decode_batch: Optional[int] = None,
+                 draft_model=None):
         from ..flags import GLOBAL_FLAGS
         cfg = model.config
         self.model = model
@@ -115,6 +124,16 @@ class LLMEngine:
         self._audit_failed = False
         self.stalls_total = 0
         self.admission_rejected_total = 0
+        # speculative decoding (FLAGS_speculative_k): the draft model
+        # proposing tokens for the target to verify. None here means
+        # it is auto-built on first use (FLAGS_speculative_draft_*);
+        # pass draft_model=model for self-drafting (accept rate 1.0
+        # at temperature 0 — the CPU sanity configuration)
+        self._draft_model = draft_model
+        self.spec_proposed_total = 0
+        self.spec_accepted_total = 0
+        self.spec_verify_steps = 0
+        self.spec_verify_ms_total = 0.0
         _ENGINES.add(self)
 
     # -- request lifecycle ------------------------------------------------
@@ -381,6 +400,8 @@ class LLMEngine:
         return self._emit(seq, self._sample(seq, logits))
 
     def _decode(self) -> List[Dict[str, Any]]:
+        if self._spec_k() > 0:
+            return self._decode_speculative(self._spec_k())
         events: List[Dict[str, Any]] = []
         # oldest-first growth: preemption evicts from the young end,
         # so by the time a young sequence grows it may already be gone
@@ -465,10 +486,267 @@ class LLMEngine:
             events += self._emit(seq, self._sample(seq, logits[i]))
         return events
 
+    # -- speculative decoding (FLAGS_speculative_k) ------------------------
+
+    @staticmethod
+    def _spec_k() -> int:
+        from ..flags import GLOBAL_FLAGS
+        try:
+            return max(0, int(GLOBAL_FLAGS.get("speculative_k")))
+        # ptlint: disable=silent-failure -- flag may not be defined under direct submodule import; speculative decoding simply stays off
+        except Exception:  # noqa: BLE001
+            return 0
+
+    def _draft(self):
+        """The draft model: the one passed at construction, else a
+        small GPTLanguageModel auto-built once — same geometry as the
+        target with FLAGS_speculative_draft_layers layers, embedding
+        tables (and therefore the tied output head) shared with the
+        target under FLAGS_speculative_draft_tie_embeddings."""
+        if self._draft_model is not None:
+            return self._draft_model
+        from ..flags import GLOBAL_FLAGS
+        from ..models.gpt_lm import GPTConfig, GPTLanguageModel
+        cfg = self.model.config
+        layers = max(1, int(GLOBAL_FLAGS.get("speculative_draft_layers")))
+        draft = GPTLanguageModel(GPTConfig(
+            vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+            num_layers=layers, num_heads=cfg.num_heads,
+            intermediate_size=cfg.intermediate_size,
+            max_position_embeddings=cfg.max_position_embeddings,
+            layer_norm_epsilon=cfg.layer_norm_epsilon))
+        if bool(GLOBAL_FLAGS.get("speculative_draft_tie_embeddings")):
+            draft.embed = self.model.embed
+            draft.pos_embed = self.model.pos_embed
+        self._draft_model = draft
+        return draft
+
+    def _propose(self, seq: Sequence, draft, k: int) -> List[int]:
+        """Draft-propose ``k`` continuation tokens for ``seq`` with a
+        dense concat KV cache rebuilt from the full token history (the
+        draft is small; recompute keeps it stateless across the
+        target's preemptions/rollbacks). Proposals use the SAME
+        position-keyed sampler as the target (`_sample_at`), so a
+        self-drafting configuration accepts every token at any
+        temperature."""
+        ids = seq.prompt + seq.generated
+        caches: List[Optional[tuple]] = [None] * len(draft.blocks)
+
+        def attn_fn(i, q, kk, vv):
+            if caches[i] is not None:
+                kk = jnp.concatenate([caches[i][0], kk], axis=1)
+                vv = jnp.concatenate([caches[i][1], vv], axis=1)
+            caches[i] = (kk, vv)
+            return dense_causal_attention(
+                q, kk, vv, q_offset=kk.shape[1] - q.shape[1])
+
+        pos = jnp.arange(len(ids), dtype=jnp.int32)[None]
+        logits = draft.forward_with_attn(
+            jnp.asarray([ids], jnp.int32), pos, attn_fn)[0, -1]
+        out: List[int] = []
+        for j in range(k):
+            tok = self._sample_at(seq, logits,
+                                  len(seq.generated) + j)
+            out.append(tok)
+            if j + 1 == k:
+                break
+            p = jnp.asarray([[len(ids) + j]], jnp.int32)
+            logits = draft.forward_with_attn(
+                jnp.asarray([[tok]], jnp.int32), p, attn_fn)[0, -1]
+        return out
+
+    def _decode_speculative(self, k: int) -> List[Dict[str, Any]]:
+        """One speculative decode step: per running sequence the
+        draft proposes up to ``k`` tokens, the TARGET verifies every
+        window in ONE batched ragged multi-query paged-attention
+        forward, and the longest accepted prefix is committed plus
+        the target's bonus token from the last verified position
+        (greedy/longest-prefix acceptance against the position-keyed
+        sampler — token-for-token identical to non-speculative decode
+        at any temperature). Draft K/V written past the accepted
+        point is rolled back through the allocator's truncate_to, so
+        the post-step audit sees exactly the committed context."""
+        events: List[Dict[str, Any]] = []
+        todo = sorted((s for s in self.scheduler.running
+                       if s.prefill_done and s.generated),
+                      key=lambda s: s.admit_order)
+        from ..testing import faults as _faults
+        draft = self._draft()
+        batch: List[Sequence] = []
+        windows: Dict[int, List[int]] = {}
+        for seq in todo:
+            if seq not in self.scheduler.running:
+                continue  # preempted by an older sequence's growth
+            # never propose past the emission budget: the window can
+            # emit at most k accepted tokens + 1 bonus token
+            k_eff = max(0, min(k, seq.max_new_tokens
+                               - len(seq.generated) - 1))
+            try:
+                _faults.hit("llm_spec_verify")
+                proposal = self._propose(seq, draft, k_eff) \
+                    if k_eff else []
+                grown = self.scheduler.grow(
+                    seq, seq.ctx_len + len(proposal) + 1)
+                if grown:
+                    # COW gate over the whole window: a rejected draft
+                    # must never scribble a block another sequence
+                    # still reads — divergence copies it private first
+                    self._make_writable(
+                        seq, seq.ctx_len,
+                        seq.ctx_len + len(proposal) + 1)
+            except Exception as e:  # noqa: BLE001 — fail ONE sequence
+                events.append(self._fail(seq, f"speculative: {e}"))
+                continue
+            if not grown:
+                events.append(self._fail(
+                    seq, f"sequence needs "
+                         f"{seq.ctx_len + len(proposal) + 1} tokens "
+                         f"of KV cache but the pool holds "
+                         f"{self.pool_blocks * self.block_size}"))
+                continue
+            batch.append(seq)
+            windows[seq.seq_id] = proposal
+        batch = [s for s in batch if s in self.scheduler.running]
+        if not batch:
+            return events
+        b = len(batch)
+        q_lens = np.asarray([len(windows[s.seq_id]) + 1
+                             for s in batch], np.int32)
+        qmax = int(q_lens.max())
+        feed = np.zeros((b, qmax), np.int32)
+        newpos = np.zeros((b, qmax), np.int32)
+        seq_slots = []
+        for i, s in enumerate(batch):
+            win = [s.generated[-1]] + windows[s.seq_id]
+            feed[i, :len(win)] = win
+            wpos = np.arange(s.ctx_len, s.ctx_len + qmax,
+                             dtype=np.int32)
+            # padded rows clamp to the last valid position (keeps
+            # pos_embed in range; their outputs are discarded)
+            newpos[i] = np.minimum(wpos, s.ctx_len + len(win) - 1)
+            seq_slots.append(self._slots(
+                s, np.arange(s.ctx_len, s.ctx_len + len(win),
+                             dtype=np.int32)))
+        tables = [self.allocator.table(s.seq_id) for s in batch]
+        maxb = max(len(tb) for tb in tables)
+        tbl = np.zeros((b, maxb), np.int32)
+        for i, tb in enumerate(tables):
+            tbl[i, :len(tb)] = tb
+        lens = np.asarray([s.ctx_len for s in batch],
+                          np.int32) + q_lens
+        qlens_j = jnp.asarray(q_lens)
+
+        def attn_fn(i, q, kk, vv):
+            from ..kernels import maybe_paged_attention_multiquery
+            for si in range(b):
+                blks, offs = seq_slots[si]
+                n = int(q_lens[si])
+                self._k_pools[i] = self._k_pools[i].at[blks, offs].set(
+                    kk[si, :n].astype(jnp.float32))
+                self._v_pools[i] = self._v_pools[i].at[blks, offs].set(
+                    vv[si, :n].astype(jnp.float32))
+            out = maybe_paged_attention_multiquery(
+                q, qlens_j, self._k_pools[i], self._v_pools[i], tbl,
+                lens)
+            return out.astype(q.dtype)
+
+        t0 = time.perf_counter()
+        try:
+            logits = self.model.forward_with_attn(
+                jnp.asarray(feed), jnp.asarray(newpos), attn_fn)
+        except Exception as e:  # noqa: BLE001
+            # same stance as the non-speculative batch: a failed
+            # verify forward must not strand the running set
+            for seq in batch:
+                events.append(self._fail(seq, f"verify step: {e}"))
+            return events
+        verify_ms = (time.perf_counter() - t0) * 1e3
+        self.spec_verify_steps += 1
+        self.spec_verify_ms_total += verify_ms
+        accepted_step = 0
+        # proposed counts only windows that actually reached the
+        # verifier (a window preempted between propose and verify
+        # never had an acceptance chance, so it would skew the rate)
+        proposed_step = int(q_lens.sum()) - b
+        self.spec_proposed_total += proposed_step
+        for i, seq in enumerate(batch):
+            proposal = windows[seq.seq_id]
+            emitted: List[int] = []
+            m = 0
+            for j in range(len(proposal) + 1):
+                tok = self._sample_at(seq, logits[i, j],
+                                      len(seq.generated) + j)
+                emitted.append(tok)
+                if j < len(proposal) and tok == proposal[j]:
+                    m += 1
+                    continue
+                break  # first divergence: tok is the bonus token
+            accepted_step += m
+            self.spec_accepted_total += m
+            # commit: window rows 0..m hold K/V for [last, d1..dm] —
+            # all part of the accepted timeline; everything past that
+            # is a rejected draft and is rolled back before anyone
+            # can prefix-match or audit it
+            new_ctx = seq.ctx_len + m + 1
+            if m < len(proposal):
+                self.allocator.truncate_to(seq.seq_id, new_ctx)
+            seq.ctx_len = new_ctx
+            self.allocator.note_written(
+                seq.seq_id,
+                seq.prompt + seq.generated + proposal[:m])
+            for tok in emitted:
+                events += self._emit(seq, tok)
+                if seq.seq_id not in self._seqs:
+                    break  # eos/length finished the sequence
+        self._publish_spec(proposed_step, accepted_step, verify_ms,
+                           float(b))
+        return events
+
+    def _publish_spec(self, proposed: int, accepted: int,
+                      verify_ms: float, batch: float) -> None:
+        from .. import observability as obs
+        if not obs.enabled():
+            return
+        if proposed:
+            obs.counter("llm_spec_proposed_tokens_total",
+                        "draft tokens proposed to the target verifier "
+                        "by speculative decoding "
+                        "(FLAGS_speculative_k)").inc(proposed)
+        if accepted:
+            obs.counter("llm_spec_accepted_tokens_total",
+                        "draft tokens accepted by the target's "
+                        "longest-prefix verification — each one "
+                        "skipped a full target decode step"
+                        ).inc(accepted)
+        if self.spec_proposed_total:
+            obs.gauge("llm_spec_accept_rate",
+                      "cumulative accepted/proposed draft-token ratio "
+                      "of this engine (1.0 = every draft token "
+                      "matched the target)").set(
+                          self.spec_accepted_total
+                          / self.spec_proposed_total)
+        from ..observability import metrics as _m
+        obs.histogram("llm_spec_verify_ms",
+                      "wall time of one batched ragged multi-query "
+                      "verify forward (speculative decoding)",
+                      buckets=_m.LATENCY_MS_BUCKETS).observe(verify_ms)
+        obs.histogram("llm_decode_batch_size",
+                      "sequences per continuous-batching decode step",
+                      buckets=[1.0, 2.0, 4.0, 8.0, 16.0, 32.0]
+                      ).observe(batch)
+
     def _sample(self, seq: Sequence, logits) -> int:
+        return self._sample_at(seq, logits, len(seq.generated))
+
+    def _sample_at(self, seq: Sequence, logits, index: int) -> int:
+        """Sample the token at generated-index ``index``. The RNG key
+        is derived from (seed, index) — NOT from call order — so
+        speculative verification reproduces exactly the token the
+        sequential sampler would have drawn at that position, at any
+        temperature."""
         if seq.temperature > 0.0:
             key = jax.random.fold_in(jax.random.PRNGKey(seq.seed),
-                                     len(seq.generated))
+                                     index)
             return int(jax.random.categorical(
                 key, logits / jnp.float32(seq.temperature)))
         return int(jnp.argmax(logits))
@@ -539,11 +817,24 @@ class LLMEngine:
     def _audit(self) -> None:
         """Post-step KV invariant audit: the allocator's internal
         accounting must be consistent and the published gauges must
-        agree with it. Raises AssertionError — a serving loop that
-        leaks blocks must fail loudly, not degrade quietly."""
+        agree with it, and no decode-phase sequence may hold cache
+        past its committed context (a rejected draft window that was
+        not rolled back would show up exactly there). Raises
+        AssertionError — a serving loop that leaks blocks must fail
+        loudly, not degrade quietly."""
         agree = None
         try:
             self.allocator.check()
+            for seq in self.scheduler.running:
+                if not seq.prefill_done:
+                    continue
+                held = self.allocator.tokens(seq.seq_id)
+                if held != seq.ctx_len:
+                    raise AssertionError(
+                        f"seq {seq.seq_id} holds cache for {held} "
+                        f"tokens but committed ctx_len is "
+                        f"{seq.ctx_len} — speculative rollback "
+                        f"missed a rejected draft window")
             agree = self.allocator.gauges_agree()
             if agree is False:
                 raise AssertionError(
@@ -595,7 +886,19 @@ class LLMEngine:
                     None if ewma is None else round(ewma, 4),
                 "stalls_total": self.stalls_total,
                 "stalled": stalled,
-                "audit_failed": self._audit_failed}
+                "audit_failed": self._audit_failed,
+                "speculative": {
+                    "k": self._spec_k(),
+                    "proposed_tokens": self.spec_proposed_total,
+                    "accepted_tokens": self.spec_accepted_total,
+                    "accept_rate":
+                        round(self.spec_accepted_total
+                              / self.spec_proposed_total, 4)
+                        if self.spec_proposed_total else None,
+                    "verify_ms_mean":
+                        round(self.spec_verify_ms_total
+                              / self.spec_verify_steps, 3)
+                        if self.spec_verify_steps else None}}
 
     def _publish(self) -> None:
         from .. import observability as obs

@@ -301,6 +301,65 @@ class KVBlockAllocator:
                 self._full_index[key] = b
                 self._index_key[b] = key
 
+    def truncate_to(self, seq_id: int, n_tokens: int) -> int:
+        """Rewind ``seq_id``'s table to cover exactly ``n_tokens``
+        token slots — the speculative-decode rollback: draft-window
+        K/V written past the accepted point must stop being part of
+        the sequence's cache. Trailing blocks beyond
+        ``blocks_for(n_tokens)`` are dereferenced exactly like
+        :meth:`free` (refcount decrement; only refcount-0 blocks
+        return to the free list, preserving LIFO order — a block
+        still shared with another sequence is never recycled), and
+        the written timeline is cut back so the rolled-back tokens
+        can no longer be prefix-matched. A retained boundary block
+        whose full-block index key extends past ``n_tokens`` drops
+        its index entry: once this sequence holds it privately its
+        tail rows get scribbled by future writes with no COW gate, so
+        the content address would go stale (a co-owner's legitimate
+        full block is simply re-registered by its next
+        note_written). No-op returning 0 when ``n_tokens`` already
+        covers the table. Returns blocks returned to the free list.
+        """
+        if seq_id not in self._tables:
+            raise KeyError(f"seq {seq_id} has no block table")
+        n_tokens = max(0, int(n_tokens))
+        if n_tokens >= self._tokens[seq_id]:
+            return 0
+        table = self._tables[seq_id]
+        keep = self.blocks_for(n_tokens)
+        dropped = table[keep:]
+        del table[keep:]
+        self._tokens[seq_id] = n_tokens
+        if seq_id in self._shared_tokens:
+            self._shared_tokens[seq_id] = min(
+                self._shared_tokens[seq_id], n_tokens)
+        tl = self._timelines.get(seq_id)
+        if tl is not None and len(tl) > n_tokens:
+            del tl[n_tokens:]
+        returned: List[int] = []
+        for b in reversed(dropped):
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                del self._refs[b]
+                key = self._index_key.pop(b, None)
+                if key is not None:
+                    self._full_index.pop(key, None)
+                returned.append(b)
+        # the retained boundary block: an index key that now extends
+        # past the truncation point is (or can silently become) stale
+        # content-addressing — drop it
+        if table:
+            key = self._index_key.get(table[-1])
+            if key is not None and len(key) > n_tokens:
+                del self._index_key[table[-1]]
+                self._full_index.pop(key, None)
+        self._free.extend(returned)
+        if returned:
+            self.freed_total += len(returned)
+            self._count("kv_blocks_freed_total", len(returned))
+        self._publish()
+        return len(returned)
+
     def free(self, seq_id: int) -> int:
         """Drop every block reference of ``seq_id`` (finish, cancel,
         or preemption); blocks whose refcount hits 0 return to the
@@ -339,7 +398,9 @@ class KVBlockAllocator:
         equals the per-table reference counts exactly (so no
         refcount-0 block lives outside the free list, and no free
         block carries a refcount); index entries only point at live
-        blocks."""
+        blocks; every table is exactly sized for its token count and
+        no written timeline overhangs it (the truncate/rewind
+        contract — rolled-back draft tokens must be gone from BOTH)."""
         counts = Counter(b for t in self._tables.values() for b in t)
         distinct = set(counts)
         free_set = set(self._free)
@@ -358,6 +419,19 @@ class KVBlockAllocator:
         if stale:
             raise AssertionError(
                 f"prefix index points at free blocks: {stale}")
+        for sid, table in self._tables.items():
+            if len(table) != self.blocks_for(self._tokens.get(sid, 0)):
+                raise AssertionError(
+                    f"seq {sid} table holds {len(table)} blocks but "
+                    f"covers {self._tokens.get(sid, 0)} tokens "
+                    f"(truncate/extend accounting broken)")
+            tl = self._timelines.get(sid)
+            if tl is not None and len(tl) > self._tokens.get(sid, 0):
+                raise AssertionError(
+                    f"seq {sid} written timeline ({len(tl)} tokens) "
+                    f"overhangs its table "
+                    f"({self._tokens.get(sid, 0)} tokens) — "
+                    f"rolled-back tokens still prefix-matchable")
 
     def _count(self, name: str, n: int = 1) -> None:
         from .. import observability as obs

@@ -48,7 +48,10 @@ loop).
 sequence (re-)admission), ``llm_chunk_prefill`` (every prefill chunk
 under ``FLAGS_prefill_chunk_tokens`` — hits mid-prompt, where
 ``llm_prefill`` cannot), ``llm_decode`` (decode growth, per sequence
-per step), ``kv_alloc`` (paged allocator allocate/extend), and
+per step), ``llm_spec_verify`` (speculative decode: per sequence per
+step before its draft window is proposed/verified — the
+``llm_decode`` analog of the FLAGS_speculative_k path),
+``kv_alloc`` (paged allocator allocate/extend), and
 ``llm_chunk_write`` (before each streamed token frame). An exception
 at any of these terminates
 exactly one sequence/stream (error frame or cancel, blocks freed);
@@ -81,7 +84,7 @@ VALUE_POINTS = ("nonfinite_grad", "loss_spike")
 # LLM serving plane injection points (serving_llm/ + kv_cache);
 # firing any of them fails ONE sequence, never the serving loop
 SERVING_POINTS = ("llm_prefill", "llm_chunk_prefill", "llm_decode",
-                  "llm_chunk_write", "kv_alloc")
+                  "llm_spec_verify", "llm_chunk_write", "kv_alloc")
 _VALUE_DEFAULT_MUL = {"nonfinite_grad": float("nan"),
                       "loss_spike": 1e6}
 

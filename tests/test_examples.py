@@ -98,3 +98,15 @@ def test_llm_serving():
     r = llm_serving.main(n_clients=3, max_new_tokens=3, verbose=False)
     assert r["ok"] and r["tokens"] == 9
     assert r["ttft_p50_ms"] > 0 and r["tokens_per_s"] > 0
+
+
+def test_llm_serving_speculative():
+    import llm_serving
+    r = llm_serving.main(n_clients=2, max_new_tokens=5, verbose=False,
+                         speculative=True)
+    assert r["ok"] and r["tokens"] == 10
+    # self-draft at temp 0: every proposed draft token must verify
+    assert r["accept_rate"] == 1.0 and r["proposed_tokens"] > 0
+    # the flag is restored for whatever example runs next
+    from paddle_tpu.flags import GLOBAL_FLAGS
+    assert GLOBAL_FLAGS.get("speculative_k") == 0

@@ -648,7 +648,7 @@ class TestAllocatorStress:
         tables, toks = {}, {}
         allocs = frees = 0
         for _ in range(300):
-            op = rng.choice(("alloc", "extend", "free"))
+            op = rng.choice(("alloc", "extend", "free", "truncate"))
             if op == "alloc":
                 sid = rng.randrange(24)
                 n = rng.randrange(0, 5 * bs)
@@ -682,6 +682,28 @@ class TestAllocatorStress:
                         allocs += max(0, need)
                     else:
                         assert not ok    # all-or-nothing
+            elif op == "truncate":
+                if not tables or rng.random() < 0.1:
+                    with pytest.raises(KeyError):
+                        a.truncate_to(999, 0)
+                else:
+                    # speculative-rollback op: rewind to n tokens;
+                    # the shadow predicts the exact trailing blocks
+                    # popped and the exact LIFO free-stack order
+                    sid = rng.choice(sorted(tables))
+                    n = rng.randrange(-2, toks[sid] + bs)
+                    got = a.truncate_to(sid, n)
+                    n = max(0, n)
+                    if n >= toks[sid]:
+                        assert got == 0
+                    else:
+                        keep = -(-n // bs)
+                        dropped = tables[sid][keep:]
+                        del tables[sid][keep:]
+                        toks[sid] = n
+                        assert got == len(dropped)
+                        stack.extend(reversed(dropped))
+                        frees += len(dropped)
             elif op == "free":
                 sid = rng.choice(sorted(tables)) \
                     if tables and rng.random() < 0.9 \
@@ -1155,7 +1177,7 @@ class TestPrefixSharingAllocator:
         tables, toks, refs, written = {}, {}, {}, {}
         for _ in range(300):
             op = rng.choice(("alloc", "extend", "free", "cow",
-                             "written"))
+                             "written", "truncate"))
             if op == "alloc":
                 sid = rng.randrange(24)
                 if sid in tables:
@@ -1239,6 +1261,32 @@ class TestPrefixSharingAllocator:
                                                             room + 1))]
                     written[sid] = tl
                     a.note_written(sid, tl)
+            elif op == "truncate" and tables:
+                # speculative rollback under sharing: only blocks
+                # whose refcount hits 0 return (in reversed-table
+                # order), shared blocks are dereferenced but never
+                # recycled, and the written timeline is cut so the
+                # rolled-back tokens stop being prefix-matchable
+                sid = rng.choice(sorted(tables))
+                n = rng.randrange(0, toks[sid] + bs)
+                got = a.truncate_to(sid, n)
+                if n >= toks[sid]:
+                    assert got == 0
+                else:
+                    keep = -(-n // bs)
+                    dropped = tables[sid][keep:]
+                    del tables[sid][keep:]
+                    toks[sid] = n
+                    if sid in written:
+                        written[sid] = written[sid][:n]
+                    returned = []
+                    for b in reversed(dropped):
+                        refs[b] -= 1
+                        if refs[b] == 0:
+                            del refs[b]
+                            returned.append(b)
+                    assert got == len(returned)
+                    stack.extend(returned)
             elif op == "free":
                 sid = rng.choice(sorted(tables)) \
                     if tables and rng.random() < 0.9 \
@@ -1476,3 +1524,365 @@ class TestPrefixSharingEngine:
         shared = flood(True)
         assert shared == len(prompts)           # full flood admitted
         assert shared > unshared
+
+
+# ---------------------------------------------------------------------------
+# multi-query ragged paged attention (speculative verify kernel)
+# ---------------------------------------------------------------------------
+
+class TestMultiQueryPagedAttentionKernel:
+    def _rand(self, b, qmax, h, d, n_blocks, bs, lens, qlens, seed=0):
+        rng = np.random.RandomState(seed)
+        q = rng.randn(b, qmax, h, d).astype(np.float32)
+        kp = rng.randn(n_blocks, bs, h, d).astype(np.float32)
+        vp = rng.randn(n_blocks, bs, h, d).astype(np.float32)
+        perm = rng.permutation(n_blocks)
+        maxb = -(-max(lens) // bs)
+        tbl = np.zeros((b, maxb), np.int32)
+        off = 0
+        for i, ln in enumerate(lens):
+            nb = -(-ln // bs)
+            tbl[i, :nb] = perm[off:off + nb]
+            off += nb
+        return (q, kp, vp, tbl, np.asarray(lens, np.int32),
+                np.asarray(qlens, np.int32))
+
+    @pytest.mark.parametrize("lens,qlens", [
+        ([17, 80, 5, 32], [3, 4, 1, 2]),    # remainders + full blocks
+        ([33, 4, 64], [2, 4, 1]),
+        ([3], [3]),                         # window == whole context
+    ])
+    def test_interpret_matches_dense_reference(self, lens, qlens):
+        from paddle_tpu.kernels.paged_attention import (
+            paged_attention_multiquery,
+            paged_attention_multiquery_reference)
+        bs = 16
+        q, kp, vp, tbl, ln, ql = self._rand(
+            len(lens), max(qlens), 4, 32, 48, bs, lens, qlens)
+        got = np.asarray(paged_attention_multiquery(
+            q, ql, kp, vp, tbl, ln, interpret=True))
+        want = np.asarray(paged_attention_multiquery_reference(
+            q, ql, kp, vp, tbl, ln))
+        assert np.isfinite(got).all()       # padded rows never NaN
+        for i, n in enumerate(qlens):       # padded rows: don't-care
+            assert np.max(np.abs(got[i, :n] - want[i, :n])) <= 2e-6
+
+    def test_matches_numpy_oracle_per_row(self):
+        # independent float64 numpy oracle, one (sequence, window
+        # row, head) at a time: row qi at absolute position
+        # ctx - q_len + qi attends exactly keys [0, that position]
+        from paddle_tpu.kernels.paged_attention import (
+            paged_attention_multiquery)
+        bs, h, d = 4, 2, 16
+        lens, qlens = [7, 12, 4], [3, 2, 4]
+        q, kp, vp, tbl, ln, ql = self._rand(
+            3, 4, h, d, 16, bs, lens, qlens, seed=3)
+        got = np.asarray(paged_attention_multiquery(
+            q, ql, kp, vp, tbl, ln, interpret=True))
+        scale = 1.0 / np.sqrt(d)
+        for i, (ctx, qlen) in enumerate(zip(lens, qlens)):
+            nb = -(-ctx // bs)
+            keys = np.concatenate([kp[tbl[i, j]] for j in range(nb)])
+            vals = np.concatenate([vp[tbl[i, j]] for j in range(nb)])
+            for qi in range(qlen):
+                qpos = ctx - qlen + qi
+                k = keys[:qpos + 1].astype(np.float64)
+                v = vals[:qpos + 1].astype(np.float64)
+                for hh in range(h):
+                    s = (k[:, hh] @ q[i, qi, hh].astype(np.float64))
+                    s *= scale
+                    p = np.exp(s - s.max())
+                    p /= p.sum()
+                    o = p @ v[:, hh]
+                    assert np.max(np.abs(got[i, qi, hh] - o)) <= 2e-6
+
+    def test_qmax1_is_bitwise_single_query_path(self):
+        # the acceptance criterion: a q_len == 1 batch must be BIT
+        # compatible with today's single-query kernel (the router
+        # sends Qmax == 1 through that exact code path)
+        from paddle_tpu.kernels.paged_attention import (
+            paged_attention, paged_attention_multiquery)
+        lens = [9, 17, 32]
+        q, kp, vp, tbl, ln, ql = self._rand(
+            3, 1, 4, 32, 16, 8, lens, [1, 1, 1], seed=2)
+        got = paged_attention_multiquery(q, ql, kp, vp, tbl, ln,
+                                         interpret=True)
+        want = paged_attention(q[:, 0], kp, vp, tbl, ln,
+                               interpret=True)
+        assert np.array_equal(np.asarray(got),
+                              np.asarray(want)[:, None])
+
+    def test_padded_single_rows_match_single_query_kernel(self):
+        # a qlen-1 sequence inside a Qmax > 1 batch runs the GENERAL
+        # kernel with padded rows; its one real row must agree with
+        # the dedicated single-query kernel
+        from paddle_tpu.kernels.paged_attention import (
+            paged_attention, paged_attention_multiquery)
+        lens = [9, 20]
+        q, kp, vp, tbl, ln, ql = self._rand(
+            2, 3, 2, 16, 12, 8, lens, [1, 3], seed=4)
+        got = np.asarray(paged_attention_multiquery(
+            q, ql, kp, vp, tbl, ln, interpret=True))
+        single = np.asarray(paged_attention(
+            q[:, 0], kp, vp, tbl, ln, interpret=True))
+        assert np.max(np.abs(got[0, 0] - single[0])) <= 2e-6
+        assert np.isfinite(got).all()
+
+    def test_scale_override_and_wrapper(self):
+        from paddle_tpu.kernels import maybe_paged_attention_multiquery
+        from paddle_tpu.kernels.paged_attention import (
+            paged_attention_multiquery_reference)
+        q, kp, vp, tbl, ln, ql = self._rand(
+            2, 2, 2, 16, 8, 8, [9, 6], [2, 2], seed=1)
+        got = np.asarray(maybe_paged_attention_multiquery(
+            q, ql, kp, vp, tbl, ln, scale=0.5))
+        want = np.asarray(paged_attention_multiquery_reference(
+            q, ql, kp, vp, tbl, ln, scale=0.5))
+        for i in range(2):
+            assert np.max(np.abs(got[i, :2] - want[i, :2])) <= 2e-6
+
+
+# ---------------------------------------------------------------------------
+# allocator truncate (speculative rollback)
+# ---------------------------------------------------------------------------
+
+class TestAllocatorTruncate:
+    def test_truncate_pops_trailing_blocks_lifo(self):
+        a = KVBlockAllocator(num_blocks=8, block_size=4)
+        assert a.allocate(1, 14)
+        assert a.table(1) == [0, 1, 2, 3]
+        assert a.truncate_to(1, 9) == 1     # keep 3 blocks
+        assert a.table(1) == [0, 1, 2] and a.tokens(1) == 9
+        # the freed block is the first re-issued (LIFO hot region)
+        assert a.allocate(2, 2) and a.table(2) == [3]
+        a.check()
+        # no-op when the table already covers n
+        assert a.truncate_to(1, 9) == 0
+        assert a.truncate_to(1, 100) == 0
+        # negative clamps to 0: everything returns
+        assert a.truncate_to(1, -3) == 3
+        assert a.table(1) == [] and a.tokens(1) == 0
+        with pytest.raises(KeyError):
+            a.truncate_to(99, 0)
+        a.check()
+
+    def test_truncate_never_recycles_shared_blocks(self, sharing_on):
+        a = KVBlockAllocator(num_blocks=8, block_size=4)
+        t = list(range(8))
+        a.allocate(1, 8, tokens=t)
+        a.note_written(1, t)
+        a.allocate(2, 8, tokens=t)          # shares blocks [0, 1]
+        assert a.table(2) == [0, 1] and a.refcount(1) == 2
+        free_before = a.num_free
+        # rolling seq 2 back past block 1 dereferences it but must
+        # NOT recycle it — seq 1 is still reading it
+        assert a.truncate_to(2, 2) == 0
+        assert a.refcount(1) == 1
+        assert a.num_free == free_before
+        assert a.table(2) == [0] and a.tokens(2) == 2
+        a.check()
+        assert a.free(1) == 1               # now block 1 returns
+        assert a.free(2) == 1
+        assert a.num_used == 0
+        a.check()
+
+    def test_truncate_drops_stale_boundary_index_entry(
+            self, sharing_on):
+        a = KVBlockAllocator(num_blocks=8, block_size=4)
+        t = list(range(8))
+        a.allocate(1, 8, tokens=t)
+        a.note_written(1, t)                # blocks 0, 1 indexed
+        assert a.probe_shared_tokens(t) == 7
+        # rollback into block 1: its full-block key describes content
+        # the sequence no longer holds, so it must leave the index
+        # (positions 6-7 stop being prefix-matchable; 4-5 remain as a
+        # partial-tail match against the live timeline)
+        a.truncate_to(1, 6)
+        assert a.probe_shared_tokens(t) == 6
+        # the next full write re-registers the block's NEW content
+        t2 = t[:6] + [9, 9]
+        assert a.extend_to(1, 8)
+        a.note_written(1, t2)
+        assert a.probe_shared_tokens(t2) == 7
+        assert a.probe_shared_tokens(t) == 6
+        a.check()
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding engine
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def spec_on():
+    pt.set_flags({"speculative_k": 3})
+    try:
+        yield
+    finally:
+        pt.set_flags({"speculative_k": 0})
+
+
+class TestSpeculativeEngine:
+    def test_self_draft_exact_parity_and_metrics(self, model, spec_on,
+                                                 metrics_on):
+        # draft == target at temperature 0: every proposed token must
+        # verify, output token-for-token identical to plain decode
+        eng = LLMEngine(model, block_size=4, pool_blocks=32,
+                        draft_model=model)
+        prompts = [[5, 9, 2], [7] * 17, [1, 2]]
+        sids = [eng.add_request(p, max_new_tokens=6) for p in prompts]
+        out, order, _ = _run(eng)
+        assert set(order) == set(sids)
+        for p, s in zip(prompts, sids):
+            assert np.array_equal(out[s],
+                                  _ref(model, p, max_new_tokens=6))
+        assert eng.spec_proposed_total > 0
+        assert eng.spec_accepted_total == eng.spec_proposed_total
+        assert eng.allocator.num_used == 0
+        eng.allocator.check()
+        assert obs.counter("llm_spec_proposed_tokens_total").value() \
+            == eng.spec_proposed_total
+        assert obs.counter("llm_spec_accepted_tokens_total").value() \
+            == eng.spec_accepted_total
+        assert obs.gauge("llm_spec_accept_rate").value() == 1.0
+        snap = obs.registry().snapshot()
+        assert snap["llm_spec_verify_ms"]["series"][0]["count"] > 0
+        h = eng.health()["speculative"]
+        assert h["k"] == 3 and h["accept_rate"] == 1.0
+        assert h["proposed_tokens"] == eng.spec_proposed_total
+        assert h["verify_ms_mean"] is not None
+
+    def test_auto_draft_rollback_keeps_exact_parity(self, model):
+        # no explicit draft: a 1-layer tied-embedding draft is built
+        # from FLAGS_speculative_draft_layers. It disagrees with the
+        # target constantly, so the truncate/rollback path runs on
+        # nearly every step — parity must hold regardless
+        pt.set_flags({"speculative_k": 4})
+        try:
+            eng = LLMEngine(model, block_size=4, pool_blocks=32)
+            prompts = [[5, 9, 2], [7] * 17]
+            sids = [eng.add_request(p, max_new_tokens=8)
+                    for p in prompts]
+            out, _, _ = _run(eng)
+            for p, s in zip(prompts, sids):
+                assert np.array_equal(
+                    out[s], _ref(model, p, max_new_tokens=8))
+            assert eng.spec_proposed_total > 0
+            assert eng.spec_accepted_total < eng.spec_proposed_total
+            assert eng.allocator.num_used == 0
+            eng.allocator.check()
+        finally:
+            pt.set_flags({"speculative_k": 0})
+
+    def test_temperature_parity_with_non_speculative(self, model):
+        # the sampler is keyed by (seed, position), not by decode
+        # schedule — so parity holds at ANY temperature, not just 0
+        def run(k):
+            pt.set_flags({"speculative_k": k})
+            try:
+                eng = LLMEngine(model, block_size=4, pool_blocks=16,
+                                draft_model=model if k else None)
+                sid = eng.add_request([5, 9], max_new_tokens=6,
+                                      temperature=0.8, seed=11)
+                out, _, _ = _run(eng)
+                assert eng.allocator.num_used == 0
+                return out[sid]
+            finally:
+                pt.set_flags({"speculative_k": 0})
+
+        assert run(3) == run(0)
+
+    def test_preemption_mid_window_is_exact(self, model, spec_on):
+        # pool too small for both sequences' speculative growth: one
+        # gets preempted between windows; `generated` holds only
+        # committed tokens, so recompute-on-readmit stays exact
+        eng = LLMEngine(model, block_size=4, pool_blocks=5,
+                        max_decode_batch=4, draft_model=model)
+        a = eng.add_request([5, 9, 2], max_new_tokens=10)
+        b = eng.add_request([7, 7, 7], max_new_tokens=10)
+        out, _, _ = _run(eng)
+        assert eng.scheduler.preemptions_total >= 1
+        assert np.array_equal(out[a],
+                              _ref(model, [5, 9, 2],
+                                   max_new_tokens=10))
+        assert np.array_equal(out[b],
+                              _ref(model, [7, 7, 7],
+                                   max_new_tokens=10))
+        assert eng.allocator.num_used == 0
+        eng.allocator.check()
+
+    def test_spec_with_sharing_and_chunked_prefill(self, model,
+                                                   metrics_on):
+        # all three serving-speed levers at once: COW prefix sharing,
+        # chunked prefill, speculative decoding with the auto-built
+        # draft. B diverges from the shared prefix mid-block, so its
+        # first write — inside a draft window that may later be
+        # rejected — fires copy-on-write; both streams stay exact.
+        pt.set_flags({"kv_prefix_sharing": True,
+                      "prefill_chunk_tokens": 8,
+                      "speculative_k": 2})
+        try:
+            eng = LLMEngine(model, block_size=4, pool_blocks=32)
+            shared = list(range(1, 15))
+            p1 = shared + [20, 21]
+            p2 = shared + [30]
+            out = {}
+
+            def drain():
+                for ev in eng.step():
+                    assert ev["type"] in ("token", "finished"), ev
+                    if ev["type"] == "token":
+                        out.setdefault(ev["seq_id"],
+                                       []).append(ev["token"])
+
+            i1 = eng.add_request(p1, max_new_tokens=8)
+            for _ in range(3):      # A prefilled (2 chunks) + first
+                drain()             # draft window — still running
+            assert eng.active()
+            i2 = eng.add_request(p2, max_new_tokens=8)
+            for step in range(200):
+                if not eng.active():
+                    break
+                drain()
+            assert not eng.active(), "engine did not quiesce"
+            assert np.array_equal(out[i1],
+                                  _ref(model, p1, max_new_tokens=8))
+            assert np.array_equal(out[i2],
+                                  _ref(model, p2, max_new_tokens=8))
+            assert eng.allocator.prefix_hit_tokens_total >= 14
+            assert eng.allocator.cow_copies_total >= 1
+            assert eng.allocator.num_used == 0
+            eng.allocator.check()
+        finally:
+            pt.set_flags({"kv_prefix_sharing": False,
+                          "prefill_chunk_tokens": 0,
+                          "speculative_k": 0})
+
+    def test_spec_verify_fault_fails_one_sequence(self, model,
+                                                  spec_on):
+        from paddle_tpu.testing import faults
+        faults.configure("llm_spec_verify:at=3:exc=RuntimeError")
+        try:
+            eng = LLMEngine(model, block_size=4, pool_blocks=32,
+                            draft_model=model)
+            a = eng.add_request([1, 2, 3], max_new_tokens=12)
+            b = eng.add_request([5, 9, 2], max_new_tokens=12)
+            out, order, errors = _run(eng, collect_errors=True)
+            assert len(errors) == 1 and len(order) == 1
+            assert "speculative" in errors[0]["error"]
+            assert "fault injected" in errors[0]["error"]
+            survivor = order[0]
+            prompt = [1, 2, 3] if survivor == a else [5, 9, 2]
+            assert np.array_equal(
+                out[survivor],
+                _ref(model, prompt, max_new_tokens=12))
+            assert eng.allocator.num_used == 0
+            eng.allocator.check()
+        finally:
+            faults.configure(None)
+
+    def test_health_section_without_speculation(self, model):
+        eng = LLMEngine(model, block_size=4, pool_blocks=8)
+        h = eng.health()["speculative"]
+        assert h["k"] == 0 and h["proposed_tokens"] == 0
+        assert h["accept_rate"] is None
+        assert h["verify_ms_mean"] is None

@@ -88,6 +88,11 @@ STAGES = {
     # Both flags are [assumed off] until these land on-chip numbers.
     "llm_prefix_reuse": (["llm_prefix_reuse"], _SKIP, 600),
     "llm_mixed_prefill": (["llm_mixed_prefill"], _SKIP, 600),
+    # speculative decoding (self-draft sanity config): accepted
+    # tokens/s vs non-speculative, accept-rate + verify-latency
+    # partials. FLAGS_speculative_k is [assumed off] until this lands
+    # an on-chip number with a real (cheap) draft.
+    "llm_spec_decode": (["llm_spec_decode"], _SKIP, 600),
     # tile-size sweep for the flash kernel (only worth chip time if the
     # default-tile flash_train stage loses to XLA)
     "flash_train_t128": (["flash_train"],

@@ -843,6 +843,129 @@ def drill_llm_prefix_cow_leak(tmp):
             "survivor bit-exact and leaked zero KV blocks")
 
 
+_LLM_SPEC_ROLLBACK = r"""
+import json, sys
+import numpy as np
+import jax.numpy as jnp
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu.models import GPTLanguageModel
+from paddle_tpu.serving_llm import LLMEngine
+
+out = sys.argv[1]
+pt.set_flags({"speculative_k": 3})
+model = GPTLanguageModel()
+# self-drafting: accept rate is exactly 1.0 at temp 0, so every
+# surviving sequence must keep token-for-token dense parity even
+# though the rollback machinery runs under it
+engine = LLMEngine(model, block_size=4, pool_blocks=32,
+                   draft_model=model)
+prompt_a = list(range(1, 10))    # 9 tokens
+prompt_b = list(range(40, 52))   # 12 tokens
+# 16 tokens = four k=3 draft windows per sequence: the survivor is
+# still mid-decode (holding KV blocks) when the at=3 fault kills the
+# other stream in its second window
+sid_a = engine.add_request(np.asarray(prompt_a, np.int32),
+                           max_new_tokens=16)
+sid_b = engine.add_request(np.asarray(prompt_b, np.int32),
+                           max_new_tokens=16)
+toks, errors = {}, []
+used_after_error = check_after_error = accepted_at_error = None
+for step in range(64):
+    for e in engine.step():
+        if e["type"] == "token":
+            toks.setdefault(e["seq_id"], []).append(int(e["token"]))
+        elif e["type"] == "error":
+            errors.append(e)
+            accepted_at_error = engine.spec_accepted_total
+            used_after_error = engine.allocator.num_used
+            try:
+                engine.allocator.check()
+                check_after_error = True
+            except AssertionError:
+                check_after_error = False
+    if not engine.active():
+        break
+check_ok = True
+try:
+    engine.allocator.check()
+except AssertionError:
+    check_ok = False
+surv = sid_b if errors and errors[0]["seq_id"] == sid_a else sid_a
+surv_prompt = prompt_b if surv == sid_b else prompt_a
+ref = [int(t) for t in np.asarray(model.generate(
+    jnp.asarray([surv_prompt], jnp.int32), max_new_tokens=16))[0]]
+res = {
+    "n_error": len(errors),
+    "error_seq": errors[0]["seq_id"] if errors else None,
+    "error_msgs": [e["error"] for e in errors],
+    "sid_a": sid_a, "sid_b": sid_b,
+    "survivor_tokens": toks.get(surv, []),
+    "dense_ref": ref,
+    "accepted_at_error": accepted_at_error,
+    "spec_proposed": engine.spec_proposed_total,
+    "spec_accepted": engine.spec_accepted_total,
+    "used_after_error": used_after_error,
+    "check_after_error": check_after_error,
+    "kv_used_final": engine.allocator.num_used,
+    "check_ok": check_ok,
+    "faults_injected": obs.counter(
+        "faults_injected_total").value(point="llm_spec_verify"),
+}
+json.dump(res, open(out, "w"))
+"""
+
+
+def drill_llm_spec_rollback(tmp):
+    """Fault a speculative verify step after at least one accepted
+    draft window has been committed (llm_spec_verify fault): the
+    failed sequence's KV — including any uncommitted draft window —
+    is released, the co-batched survivor keeps exact dense parity,
+    and the pool drains to zero with clean allocator invariants."""
+    script = os.path.join(tmp, "llm_spec_rollback.py")
+    with open(script, "w") as f:
+        f.write(_LLM_SPEC_ROLLBACK)
+    out = os.path.join(tmp, "llm_spec_rollback.json")
+    # hits count per sequence per decode step in admission order, so
+    # at=3 always lands in the SECOND speculative step of whichever
+    # sequence it strikes — at least one full draft window (k tokens +
+    # bonus) is already committed when the fault fires
+    proc = subprocess.run(
+        [sys.executable, script, out],
+        env=_env(tmp,
+                 fault_spec="llm_spec_verify:at=3:exc=RuntimeError"),
+        capture_output=True, text=True, timeout=300)
+    _check(proc.returncode == 0,
+           f"spec-rollback run died rc={proc.returncode}\n"
+           f"{proc.stderr}")
+    res = json.load(open(out))
+    _check(res["faults_injected"] == 1,
+           f"faults_injected_total{{point=llm_spec_verify}} should "
+           f"be 1: {res}")
+    _check(res["n_error"] == 1,
+           f"exactly one sequence should die mid-verify: {res}")
+    _check(any("fault injected" in m for m in res["error_msgs"]),
+           f"error event does not carry the injected fault: {res}")
+    _check(res["accepted_at_error"] is not None
+           and res["accepted_at_error"] >= 3,
+           f"no draft window was accepted before the fault — the "
+           f"drill never exercised commit-then-rollback: {res}")
+    _check(res["used_after_error"] and res["check_after_error"],
+           f"failing one speculative stream broke allocator "
+           f"invariants or freed the survivor's blocks: {res}")
+    _check(res["survivor_tokens"] == res["dense_ref"],
+           f"survivor diverged from the dense reference after the "
+           f"co-batched stream died mid-verify: {res}")
+    _check(res["spec_accepted"] == res["spec_proposed"] > 0,
+           f"self-draft accept rate should stay exactly 1.0 for "
+           f"windows that reached the verifier: {res}")
+    _check(res["kv_used_final"] == 0 and res["check_ok"],
+           f"KV blocks leaked after the drill: {res}")
+    return ("mid-verify death of a speculative stream rolled its KV "
+            "back cleanly; survivor kept exact parity, pool drained "
+            "to zero")
+
+
 def drill_exact_resume(tmp):
     """SIGKILL mid-epoch + v3 resume == uninterrupted run, bitwise."""
     try:
@@ -867,6 +990,7 @@ DRILLS = {
     "llm_drain_sigterm": drill_llm_drain_sigterm,
     "llm_decode_error": drill_llm_decode_error,
     "llm_prefix_cow_leak": drill_llm_prefix_cow_leak,
+    "llm_spec_rollback": drill_llm_spec_rollback,
 }
 
 
