@@ -567,6 +567,42 @@ define_flag("speculative_draft_tie_embeddings", True,
             "builds its own draft (draft_model=None).")
 
 
+def _llm_seqtrace_ring_changed(value) -> None:
+    from .observability import seqtrace as _obs_seqtrace
+    _obs_seqtrace.ring().resize(int(value))
+
+
+define_flag("llm_seqtrace_ring", 256,
+            "Capacity of the finished per-sequence lifecycle-timeline "
+            "ring (observability/seqtrace.py): the last N terminal "
+            "sequence timelines — queued/admitted/prefill_chunk/"
+            "cow_copy/preempted/spec_window/token events, each "
+            "monotonic-stamped, plus the wire trace id — served at "
+            "/llm/seqs on the observability exporter and joined "
+            "against step records by tools/serving_report.py. "
+            "Rotation-style eviction (oldest out first); timelines "
+            "ending in error/cancelled/shed are also dumped to the "
+            "flight recorder so post-mortems survive the ring.",
+            on_change=_llm_seqtrace_ring_changed)
+
+
+def _llm_step_ring_changed(value) -> None:
+    from .observability import stepprof as _obs_stepprof
+    _obs_stepprof.ring().resize(int(value))
+
+
+define_flag("llm_step_ring", 256,
+            "Capacity of the LLM engine step-record ring "
+            "(observability/stepprof.py): the last N step profiles — "
+            "per-phase durations (admit/prefill/decode/spec_verify "
+            "plus sample/scatter sub-segments), batch composition, "
+            "KV-pool snapshot, prefix-hit and speculative-accept "
+            "deltas, stall verdict — served at /llm/steps together "
+            "with the live in-flight step (begin stamps + current "
+            "phase). Rotation-style eviction, oldest out first.",
+            on_change=_llm_step_ring_changed)
+
+
 def _fault_spec_changed(value) -> None:
     # (re)arm the chaos-injection registry; lazy import mirrors
     # _enable_metrics_changed (testing.faults imports this module)

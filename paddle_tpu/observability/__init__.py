@@ -30,7 +30,8 @@ import warnings
 from typing import Any, Dict, Optional
 
 from . import (anomaly, fleet, flight, goodput, metrics, recompile,
-               reqtrace, rotation, server, trace_agg, tracer, xprof)
+               reqtrace, rotation, seqtrace, server, stepprof,
+               trace_agg, tracer, xprof)
 from .anomaly import sentinel as anomaly_sentinel
 from .flight import recorder as flight_recorder
 from .goodput import ledger as goodput_ledger
@@ -44,7 +45,7 @@ from .xprof import cards as program_cards
 
 __all__ = ["metrics", "tracer", "recompile", "trace_agg", "xprof",
            "anomaly", "server", "goodput", "flight", "rotation",
-           "fleet", "reqtrace",
+           "fleet", "reqtrace", "seqtrace", "stepprof",
            "counter", "gauge", "histogram", "registry", "enabled",
            "set_enabled", "span", "export_chrome_trace", "get_tracer",
            "instrumented_jit", "recompile_tracker", "program_cards",
@@ -175,8 +176,9 @@ def export_all(path: Optional[str] = None) -> Dict[str, str]:
 
 def reset_all() -> None:
     """Clear metrics, spans, recompile records, program cards, anomaly
-    state, the goodput ledger, the flight buffer, the request-span
-    ring, and the fleet aggregator store (tests/new runs)."""
+    state, the goodput ledger, the flight buffer, the request-span /
+    seq-timeline / step-record rings, and the fleet aggregator store
+    (tests/new runs)."""
     registry().reset()
     get_tracer().reset()
     recompile_tracker().reset()
@@ -185,4 +187,6 @@ def reset_all() -> None:
     goodput_ledger().reset()
     flight_recorder().reset()
     reqtrace.ring().reset()
+    seqtrace.ring().reset()
+    stepprof.ring().reset()
     fleet.aggregator().reset()

@@ -30,6 +30,13 @@ Endpoints:
 - ``/requests?n=`` — the last N per-request serving span records
   (observability/reqtrace.py: trace id + the five lifecycle
   timestamps + derived latency spans).
+- ``/llm/seqs?n=&trace_id=`` — per-sequence engine lifecycle
+  timelines (observability/seqtrace.py): live + last N finished, or
+  every timeline carrying a wire ``trace_id`` (the /requests join).
+- ``/llm/steps?n=`` — engine step records (observability/stepprof.py):
+  the last N sealed records plus the LIVE in-flight step per engine
+  (begin stamps + current phase — a wedged step is visible here
+  while it hangs).
 - ``/fleet`` (+ ``/fleet/goodput``, ``/fleet/health``, and the
   worker-facing ``POST /fleet/push``) — the cross-host federation
   plane (observability/fleet.py): any process's exporter doubles as
@@ -65,6 +72,8 @@ from . import goodput as _goodput
 from . import metrics as _metrics
 from . import recompile as _recompile
 from . import reqtrace as _reqtrace
+from . import seqtrace as _seqtrace
+from . import stepprof as _stepprof
 from . import tracer as _tracer
 from . import xprof as _xprof
 
@@ -249,6 +258,35 @@ class _Handler(BaseHTTPRequestHandler):
                 r = _reqtrace.ring()
                 self._send_json(200, {"capacity": r.capacity,
                                       "requests": r.recent(n)})
+            elif url.path == "/llm/seqs":
+                q = parse_qs(url.query)
+                try:
+                    n = int(q.get("n", ["0"])[0]) or None
+                except ValueError:
+                    n = None
+                sr = _seqtrace.ring()
+                tid = q.get("trace_id", [None])[0]
+                if tid is not None:
+                    try:
+                        timelines = sr.find(int(tid))
+                    except ValueError:
+                        timelines = []
+                    self._send_json(200, {"trace_id": tid,
+                                          "timelines": timelines})
+                else:
+                    self._send_json(200, {"capacity": sr.capacity,
+                                          "live": sr.live(),
+                                          "finished": sr.recent(n)})
+            elif url.path == "/llm/steps":
+                q = parse_qs(url.query)
+                try:
+                    n = int(q.get("n", ["0"])[0]) or None
+                except ValueError:
+                    n = None
+                pr = _stepprof.ring()
+                self._send_json(200, {"capacity": pr.capacity,
+                                      "live": pr.live(),
+                                      "steps": pr.recent(n)})
             elif url.path == "/fleet":
                 q = parse_qs(url.query)
                 if q.get("format", [""])[0] == "json":
@@ -266,7 +304,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200,
                            b"paddle_tpu observability: /metrics /healthz "
                            b"/varz /trace?ms=N /goodput /flight "
-                           b"/requests?n=N /fleet /fleet/goodput "
+                           b"/requests?n=N /llm/seqs?n=N&trace_id=T "
+                           b"/llm/steps?n=N /fleet /fleet/goodput "
                            b"/fleet/health\n",
                            "text/plain")
             else:
@@ -352,8 +391,9 @@ def start(port: int = 0) -> ObservabilityServer:
                 "TCP port of the live observability HTTP exporter",
                 always=True).set(float(_server.port))
             _log.info("observability exporter serving /metrics /healthz "
-                      "/varz /trace /goodput /flight /requests /fleet "
-                      "on :%d", _server.port)
+                      "/varz /trace /goodput /flight /requests "
+                      "/llm/seqs /llm/steps /fleet on :%d",
+                      _server.port)
         elif port > 0 and port != _server.port:
             _log.info("observability exporter already bound on :%d; "
                       "ignoring request for :%d", _server.port, port)
@@ -442,6 +482,33 @@ def self_test() -> int:
         rq = json.loads(text)
         assert code == 200 and any(
             r.get("trace_id") == 7 for r in rq["requests"]), text
+        # serving flight deck: one finished timeline, one live one,
+        # one sealed step record + one live in-flight step
+        _seqtrace.begin(11, trace_id=7)
+        _seqtrace.event(11, "token", index=0)
+        _seqtrace.finish(11, "finished", reason="eos", tokens=1)
+        _seqtrace.begin(12, trace_id=9)
+        _stepprof.ring().step_begin(1, step=4, begin_unix=time.time())
+        _stepprof.ring().record(1, {"step": 4, "engine": 1,
+                                    "phase_ms": {"decode": 1.5}})
+        _stepprof.ring().step_begin(2, step=5, begin_unix=time.time())
+        _stepprof.ring().set_phase(2, "prefill")
+        code, text = fetch("/llm/seqs?n=5")
+        sq = json.loads(text)
+        assert code == 200 and any(
+            t["seq_id"] == 11 and t["outcome"] == "finished"
+            for t in sq["finished"]), text
+        assert any(t["seq_id"] == 12 for t in sq["live"]), text
+        code, text = fetch("/llm/seqs?trace_id=7")
+        sq = json.loads(text)
+        assert code == 200 and len(sq["timelines"]) == 1 \
+            and sq["timelines"][0]["seq_id"] == 11, text
+        code, text = fetch("/llm/steps?n=5")
+        st = json.loads(text)
+        assert code == 200 and any(
+            r["step"] == 4 for r in st["steps"]), text
+        assert any(d["step"] == 5 and d["phase"] == "prefill"
+                   and "age_s" in d for d in st["live"]), text
         # fleet plane: push one snapshot to ourselves, read it back
         body = json.dumps(_fleet.local_snapshot("selftest-host"),
                           default=str).encode()
@@ -464,6 +531,8 @@ def self_test() -> int:
         _metrics.set_enabled(False)
         _fleet.aggregator().reset()
         _reqtrace.ring().reset()
+        _seqtrace.ring().reset()
+        _stepprof.ring().reset()
     print("self-test OK")
     return 0
 
@@ -480,7 +549,7 @@ def main() -> int:
         return self_test()
     srv = start(args.port)
     print(f"serving /metrics /healthz /varz /trace /goodput /flight "
-          f"/requests /fleet on :{srv.port}")
+          f"/requests /llm/seqs /llm/steps /fleet on :{srv.port}")
     try:
         while True:
             time.sleep(3600)

@@ -52,6 +52,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.gpt_lm import dense_causal_attention
+from ..observability import seqtrace as _seqtrace
+from ..observability import stepprof as _stepprof
 from .kv_cache import KVBlockAllocator
 from .scheduler import ContinuousBatchingScheduler, Sequence
 
@@ -124,6 +126,14 @@ class LLMEngine:
         self._audit_failed = False
         self.stalls_total = 0
         self.admission_rejected_total = 0
+        # step profiler (observability/stepprof.py): per-step phase-ms
+        # accumulator, None while metrics are off or between steps
+        self._steps_total = 0
+        self._step_begin_mono: Optional[float] = None
+        self._phase_ms: Optional[Dict[str, float]] = None
+        self._spec_batch = 0  # sequences verified this step
+        self._prefix_hits_snap = 0
+        self._spec_snap = (0, 0)
         # speculative decoding (FLAGS_speculative_k): the draft model
         # proposing tokens for the target to verify. None here means
         # it is auto-built on first use (FLAGS_speculative_draft_*);
@@ -140,7 +150,8 @@ class LLMEngine:
 
     def add_request(self, prompt_ids, max_new_tokens: int = 16,
                     eos_token_id: Optional[int] = None,
-                    temperature: float = 0.0, seed: int = 0) -> int:
+                    temperature: float = 0.0, seed: int = 0,
+                    trace_id: int = 0) -> int:
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -158,6 +169,11 @@ class LLMEngine:
         self._seqs[seq.seq_id] = seq
         self._projected[seq.seq_id] = projected
         self.scheduler.add(seq)
+        # seq timeline opens here; trace_id is the wire id the bridge
+        # carries so /requests records link to this /llm/seqs entry
+        _seqtrace.begin(seq.seq_id, trace_id=int(trace_id),
+                        engine=id(self), prompt_tokens=len(prompt),
+                        max_new_tokens=int(max_new_tokens))
         return seq.seq_id
 
     def _projected_blocks(self, prompt: List[int],
@@ -225,12 +241,16 @@ class LLMEngine:
             f"{budget:.1f} of {self.pool_blocks}; "
             f"retry_after_ms={retry_after_ms}", retry_after_ms)
 
-    def cancel(self, seq_id: int) -> bool:
-        """Drop a sequence (client disconnect): blocks freed, no
-        further events for it. True if it was live."""
+    def cancel(self, seq_id: int, outcome: str = "cancelled") -> bool:
+        """Drop a sequence (client disconnect; ``outcome="shed"``
+        when the bridge sheds an aged waiting stream): blocks freed,
+        no further events for it. True if it was live."""
         seq = self.scheduler.cancel(seq_id)
         self._seqs.pop(seq_id, None)
         self._projected.pop(seq_id, None)
+        if seq is not None:
+            _seqtrace.finish(seq_id, outcome,
+                             tokens=len(seq.generated))
         return seq is not None
 
     def active(self) -> bool:
@@ -247,41 +267,137 @@ class LLMEngine:
         Wrapped by the stall watchdog (EWMA of step wall time, see
         FLAGS_llm_stall_factor) and followed by the KV invariant audit
         — a leak or gauge drift raises here, loudly, instead of
-        surfacing as slow corruption."""
+        surfacing as slow corruption. Each step also emits one step
+        record into the /llm/steps ring (observability/stepprof.py),
+        with the in-flight half registered up front so a wedged step
+        is visible there while it hangs."""
         self._step_begin_unix = time.time()
         t0 = time.perf_counter()
+        self._steps_total += 1
+        self._prof_begin()
+        events: List[Dict[str, Any]] = []
         try:
             events = self._step_inner()
         finally:
-            self._note_step(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            stalls_before = self.stalls_total
+            self._note_step(dt)
+            self._prof_end(dt, events,
+                           stalled=self.stalls_total > stalls_before)
         self._audit()
         return events
 
     def _step_inner(self) -> List[Dict[str, Any]]:
         events: List[Dict[str, Any]] = []
+        self._prof_phase("admit")
+        _t = time.perf_counter()
+        admitted: List[Sequence] = []
         try:
-            self.scheduler.admit()
+            admitted = self.scheduler.admit()
         except Exception as e:  # noqa: BLE001 — kv_alloc fault path
             # allocate() raised before the head left the waiting
             # queue: fail that one request, keep the engine alive
             if self.scheduler.waiting:
                 seq = self.scheduler.waiting.popleft()
                 events.append(self._fail(seq, f"kv allocation: {e}"))
+        self._prof_acc("admit", (time.perf_counter() - _t) * 1e3)
+        for seq in admitted:
+            _seqtrace.event(seq.seq_id,
+                            "readmitted" if seq.preemptions
+                            else "admitted",
+                            cached_tokens=seq.cached_tokens,
+                            order=seq.admit_order)
         # chunked prefill tick: every running sequence with unwritten
         # context advances ONE chunk (the whole remainder when
         # FLAGS_prefill_chunk_tokens is 0), newly admitted sequences
         # included — interleaved with the decode tick below
+        self._prof_phase("prefill")
         for seq in [s for s in self.scheduler.running
                     if not s.prefill_done]:
             if seq not in self.scheduler.running:
                 continue  # preempted by an earlier sequence's COW
+            _t = time.perf_counter()
             try:
                 events += self._prefill_chunk(seq)
             except Exception as e:  # noqa: BLE001 — fail ONE request
                 events.append(self._fail(seq, str(e)))
+            finally:
+                self._prof_acc("prefill",
+                               (time.perf_counter() - _t) * 1e3)
+        self._prof_phase("decode")
+        _t = time.perf_counter()
+        spec0 = (self._phase_ms or {}).get("spec_verify", 0.0)
         events += self._decode()
+        dec_ms = (time.perf_counter() - _t) * 1e3 \
+            - ((self._phase_ms or {}).get("spec_verify", 0.0) - spec0)
+        self._prof_acc("decode", max(0.0, dec_ms))
         self._publish()
         return events
+
+    # -- step profiler (observability/stepprof.py) -------------------------
+
+    def _prof_begin(self) -> None:
+        """Open the step record: arm the phase-ms accumulator and
+        register the live in-flight entry on the /llm/steps ring (a
+        step wedged mid-flight is diagnosable there — begin stamps +
+        current phase — not just counted by health())."""
+        from .. import observability as obs
+        self._step_begin_mono = time.monotonic()
+        self._spec_batch = 0
+        if not obs.enabled():
+            self._phase_ms = None
+            return
+        self._phase_ms = {}
+        self._prefix_hits_snap = self.allocator.prefix_hit_tokens_total
+        self._spec_snap = (self.spec_proposed_total,
+                           self.spec_accepted_total)
+        _stepprof.ring().step_begin(id(self), step=self._steps_total,
+                                    begin_unix=self._step_begin_unix)
+
+    def _prof_phase(self, phase: str) -> None:
+        if self._phase_ms is not None:
+            _stepprof.ring().set_phase(id(self), phase)
+
+    def _prof_acc(self, phase: str, ms: float) -> None:
+        p = self._phase_ms
+        if p is not None:
+            p[phase] = p.get(phase, 0.0) + ms
+
+    def _prof_end(self, dt: float, events: List[Dict[str, Any]],
+                  stalled: bool) -> None:
+        """Seal the step record and append it to the /llm/steps ring
+        (also observes llm_step_phase_ms{phase=})."""
+        p, self._phase_ms = self._phase_ms, None
+        if p is None:
+            return
+        run = self.scheduler.running
+        dp = self.spec_proposed_total - self._spec_snap[0]
+        da = self.spec_accepted_total - self._spec_snap[1]
+        rec = {
+            "step": self._steps_total,
+            "engine": id(self) & 0xFFFF,
+            "begin_unix": self._step_begin_unix,  # display only
+            "begin_mono": self._step_begin_mono,
+            "dur_ms": round(dt * 1e3, 3),
+            "phase_ms": {k: round(v, 3) for k, v in sorted(p.items())},
+            "batch": {
+                "prefilling": sum(1 for s in run
+                                  if not s.prefill_done),
+                "decoding": sum(1 for s in run if s.prefill_done),
+                "verifying": self._spec_batch,
+                "waiting": len(self.scheduler.waiting)},
+            "kv": {"used": self.allocator.num_used,
+                   "free": self.allocator.num_free,
+                   "shared": self.allocator.num_shared},
+            "prefix_hit_tokens": self.allocator.prefix_hit_tokens_total
+            - self._prefix_hits_snap,
+            "spec": {"proposed": dp, "accepted": da,
+                     "accept_rate": round(da / dp, 4) if dp else None},
+            "tokens": sum(1 for e in events if e["type"] == "token"),
+            "events": len(events),
+            "stalled": bool(stalled),
+        }
+        _stepprof.ring().record(id(self), rec)
 
     # -- internals --------------------------------------------------------
 
@@ -325,11 +441,17 @@ class LLMEngine:
                     f"{self.pool_blocks * self.block_size} tokens "
                     f"with no victims left")
             old, new = r
+            _t = time.perf_counter()
+            from ..testing import faults as _faults
+            _faults.hit("llm_cow_copy")
             for i in range(len(self._k_pools)):
                 self._k_pools[i] = self._k_pools[i].at[new].set(
                     self._k_pools[i][old])
                 self._v_pools[i] = self._v_pools[i].at[new].set(
                     self._v_pools[i][old])
+            _seqtrace.event(
+                seq.seq_id, "cow_copy", block_old=old, block_new=new,
+                ms=round((time.perf_counter() - _t) * 1e3, 3))
 
     def _prefill_chunk(self, seq: Sequence) -> List[Dict[str, Any]]:
         """One prefill chunk for ``seq``: forward the next
@@ -339,6 +461,8 @@ class LLMEngine:
         the sequence's blocks. The shared prefix (cached_tokens) is
         never recomputed. The final chunk samples the first token."""
         from ..testing import faults as _faults
+        t0 = time.perf_counter()  # before the fault hits: an injected
+        # slow chunk (sleep=) must show in this chunk's measured ms
         if seq.ctx_len == seq.cached_tokens:
             # first chunk of this (re)admission — the historical
             # per-sequence prefill fault point fires here once
@@ -346,7 +470,6 @@ class LLMEngine:
         _faults.hit("llm_chunk_prefill")
         if seq.dispatch_unix is None:
             seq.dispatch_unix = time.time()
-        t0 = time.perf_counter()
         ids = seq.prompt + seq.generated  # re-prefill keeps generated
         t = len(ids)
         c0 = seq.ctx_len
@@ -363,10 +486,13 @@ class LLMEngine:
             cb, co = self._slots(seq, cpos)
 
         def attn_fn(i, q, k, v):
+            _ts = time.perf_counter()
             self._k_pools[i] = self._k_pools[i].at[blks, offs].set(
                 k[0].astype(jnp.float32))
             self._v_pools[i] = self._v_pools[i].at[blks, offs].set(
                 v[0].astype(jnp.float32))
+            self._prof_acc("scatter",
+                           (time.perf_counter() - _ts) * 1e3)
             if cb is None:
                 return dense_causal_attention(q, k, v)
             # cached prefix (shared blocks / earlier chunks) comes
@@ -385,6 +511,7 @@ class LLMEngine:
             jnp.asarray([pos], jnp.int32), attn_fn)[0, -1]
         seq.ctx_len = c0 + n
         self.allocator.note_written(seq.seq_id, ids[:seq.ctx_len])
+        chunk_ms = (time.perf_counter() - t0) * 1e3
         from .. import observability as obs
         if obs.enabled():
             from ..observability import metrics as _m
@@ -393,7 +520,12 @@ class LLMEngine:
                           "(FLAGS_prefill_chunk_tokens; whole-prompt "
                           "prefill when chunking is off)",
                           buckets=_m.LATENCY_MS_BUCKETS).observe(
-                              (time.perf_counter() - t0) * 1e3)
+                              chunk_ms)
+        # timeline event BEFORE the final chunk's first token, so the
+        # chunk lands inside the gap the token anchors (attribution)
+        _seqtrace.event(seq.seq_id, "prefill_chunk",
+                        ms=round(chunk_ms, 3), ctx=seq.ctx_len,
+                        done=seq.ctx_len >= t)
         if seq.ctx_len < t:
             return []  # mid-prefill: decode keeps ticking meanwhile
         seq.prefill_done = True
@@ -453,10 +585,13 @@ class LLMEngine:
 
         def attn_fn(i, q, k, v):
             from ..kernels import maybe_paged_attention
+            _ts = time.perf_counter()
             self._k_pools[i] = self._k_pools[i].at[blks, offs].set(
                 k[:, 0].astype(jnp.float32))
             self._v_pools[i] = self._v_pools[i].at[blks, offs].set(
                 v[:, 0].astype(jnp.float32))
+            self._prof_acc("scatter",
+                           (time.perf_counter() - _ts) * 1e3)
             out = maybe_paged_attention(q[:, 0], self._k_pools[i],
                                         self._v_pools[i], tbl, lens)
             return out[:, None].astype(q.dtype)
@@ -574,6 +709,7 @@ class LLMEngine:
         draft = self._draft()
         batch: List[Sequence] = []
         windows: Dict[int, List[int]] = {}
+        prop_ms_by: Dict[int, float] = {}
         for seq in todo:
             if seq not in self.scheduler.running:
                 continue  # preempted by an older sequence's growth
@@ -581,6 +717,7 @@ class LLMEngine:
             # emit at most k accepted tokens + 1 bonus token
             k_eff = max(0, min(k, seq.max_new_tokens
                                - len(seq.generated) - 1))
+            _t = time.perf_counter()
             try:
                 _faults.hit("llm_spec_verify")
                 proposal = self._propose(seq, draft, k_eff) \
@@ -597,6 +734,9 @@ class LLMEngine:
             except Exception as e:  # noqa: BLE001 — fail ONE sequence
                 events.append(self._fail(seq, f"speculative: {e}"))
                 continue
+            prop_ms = (time.perf_counter() - _t) * 1e3
+            self._prof_acc("spec_verify", prop_ms)
+            prop_ms_by[seq.seq_id] = prop_ms
             if not grown:
                 events.append(self._fail(
                     seq, f"sequence needs "
@@ -610,6 +750,7 @@ class LLMEngine:
         if not batch:
             return events
         b = len(batch)
+        self._spec_batch = b
         q_lens = np.asarray([len(windows[s.seq_id]) + 1
                              for s in batch], np.int32)
         qmax = int(q_lens.max())
@@ -638,6 +779,7 @@ class LLMEngine:
 
         def attn_fn(i, q, kk, vv):
             from ..kernels import maybe_paged_attention_multiquery
+            _ts = time.perf_counter()
             for si in range(b):
                 blks, offs = seq_slots[si]
                 n = int(q_lens[si])
@@ -645,6 +787,8 @@ class LLMEngine:
                     kk[si, :n].astype(jnp.float32))
                 self._v_pools[i] = self._v_pools[i].at[blks, offs].set(
                     vv[si, :n].astype(jnp.float32))
+            self._prof_acc("scatter",
+                           (time.perf_counter() - _ts) * 1e3)
             out = maybe_paged_attention_multiquery(
                 q, qlens_j, self._k_pools[i], self._v_pools[i], tbl,
                 lens)
@@ -661,6 +805,7 @@ class LLMEngine:
                 events.append(self._fail(seq, f"verify step: {e}"))
             return events
         verify_ms = (time.perf_counter() - t0) * 1e3
+        self._prof_acc("spec_verify", verify_ms)
         self.spec_verify_steps += 1
         self.spec_verify_ms_total += verify_ms
         accepted_step = 0
@@ -694,6 +839,13 @@ class LLMEngine:
             self.allocator.note_written(
                 seq.seq_id,
                 seq.prompt + seq.generated + proposal[:m])
+            # recorded before the tokens it produced, so the window
+            # lands inside the gap those tokens anchor in attribution
+            _seqtrace.event(
+                seq.seq_id, "spec_window", proposed=len(proposal),
+                accepted=m, rollback=len(proposal) - m,
+                ms=round(prop_ms_by.get(seq.seq_id, 0.0)
+                         + verify_ms / b, 3))
             for tok in emitted:
                 events += self._emit(seq, tok)
                 if seq.seq_id not in self._seqs:
@@ -744,12 +896,17 @@ class LLMEngine:
         speculative verification reproduces exactly the token the
         sequential sampler would have drawn at that position, at any
         temperature."""
-        if seq.temperature > 0.0:
-            key = jax.random.fold_in(jax.random.PRNGKey(seq.seed),
-                                     index)
-            return int(jax.random.categorical(
-                key, logits / jnp.float32(seq.temperature)))
-        return int(jnp.argmax(logits))
+        _t = time.perf_counter()
+        try:
+            if seq.temperature > 0.0:
+                key = jax.random.fold_in(
+                    jax.random.PRNGKey(seq.seed), index)
+                return int(jax.random.categorical(
+                    key, logits / jnp.float32(seq.temperature)))
+            return int(jnp.argmax(logits))
+        finally:
+            self._prof_acc("sample",
+                           (time.perf_counter() - _t) * 1e3)
 
     def _emit(self, seq: Sequence, token: int) -> List[Dict[str, Any]]:
         idx = len(seq.generated)
@@ -758,6 +915,7 @@ class LLMEngine:
         events: List[Dict[str, Any]] = [{
             "type": "token", "seq_id": seq.seq_id, "token": token,
             "index": idx, "dispatch_unix": seq.dispatch_unix}]
+        _seqtrace.event(seq.seq_id, "token", index=idx)
         reason = None
         if seq.eos_token_id is not None and token == seq.eos_token_id:
             reason = "eos"
@@ -770,12 +928,16 @@ class LLMEngine:
             events.append({"type": "finished", "seq_id": seq.seq_id,
                            "reason": reason,
                            "tokens": len(seq.generated)})
+            _seqtrace.finish(seq.seq_id, "finished", reason=reason,
+                             tokens=len(seq.generated))
         return events
 
     def _fail(self, seq: Sequence, error: str) -> Dict[str, Any]:
         self.scheduler.finish(seq)
         self._seqs.pop(seq.seq_id, None)
         self._projected.pop(seq.seq_id, None)
+        _seqtrace.finish(seq.seq_id, "error", error=error[:200],
+                         tokens=len(seq.generated))
         return {"type": "error", "seq_id": seq.seq_id, "error": error,
                 "tokens": len(seq.generated)}
 

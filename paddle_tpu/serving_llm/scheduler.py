@@ -162,6 +162,10 @@ class ContinuousBatchingScheduler:
         self.preemptions_total += 1
         self.waiting.appendleft(seq)
         from .. import observability as obs
+        from ..observability import seqtrace as _seqtrace
+        _seqtrace.event(seq.seq_id, "preempted",
+                        preemptions=seq.preemptions,
+                        tokens=len(seq.generated))
         if obs.enabled():
             obs.counter("kv_blocks_preempted_total",
                         "running sequences preempted back to the "

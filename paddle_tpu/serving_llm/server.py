@@ -78,7 +78,8 @@ class LLMStreamBridge:
             seq_id = self.engine.add_request(
                 arrs[0], max_new_tokens=max_new,
                 eos_token_id=None if eos_raw == EOS_NONE else int(eos_raw),
-                temperature=temperature, seed=seed)
+                temperature=temperature, seed=seed,
+                trace_id=req.get("trace_id") or 0)
         except Exception as e:  # noqa: BLE001 — fail ONE request
             from .engine import AdmissionRejected
             outcome = "admission_rejected" \
@@ -90,6 +91,9 @@ class LLMStreamBridge:
             self._record(req, status=-1, outcome=outcome,
                          error=str(e)[:200])
             return
+        # the join key both ways: /requests records carry seq_id, and
+        # the engine timeline at /llm/seqs carries this trace_id
+        req["seq_id"] = seq_id
         self._reqs[seq_id] = req
         from .. import observability as obs
         if obs.enabled():
@@ -165,7 +169,7 @@ class LLMStreamBridge:
                 # ptlint: disable=clock-hygiene -- fallback for spans injected without a dequeue_mono stamp (tests); production requests are stamped in _mk_req
                 age = now - (req.get("dequeue_unix") or now)
             if age > ddl:
-                self.engine.cancel(seq.seq_id)
+                self.engine.cancel(seq.seq_id, outcome="shed")
                 self._reqs.pop(seq.seq_id, None)
                 self.server._shed(req, age, ddl)
 
@@ -251,6 +255,7 @@ class LLMStreamBridge:
             toks: List[float] = req.get("token_unix") or []
             rec = {"trace_id": req.get("trace_id") or 0,
                    "req_id": req.get("rid"),
+                   "seq_id": req.get("seq_id"),
                    "status": status, "outcome": outcome,
                    "stream": True,
                    "ingress_unix": req.get("ingress_unix"),

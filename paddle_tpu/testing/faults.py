@@ -26,6 +26,11 @@ Trigger keys (an entry fires when ALL of its conditions hold):
 
 Action keys (first present wins):
 
+- ``sleep=MS`` — ``time.sleep(MS/1000)`` then return normally: a
+  latency fault, not a failure. The call site proceeds as if nothing
+  happened, just late — the action the serving flight deck's latency
+  -attribution drills inject (a slow chunk, a slow COW copy, a slow
+  verify) without killing the sequence
 - ``exc=Name`` — raise that builtin exception (default RuntimeError)
 - ``kill=SIG`` — ``os.kill(self, SIG)`` (number or name, e.g. ``9``,
   ``KILL``, ``SIGTERM``)
@@ -51,7 +56,9 @@ under ``FLAGS_prefill_chunk_tokens`` — hits mid-prompt, where
 per step), ``llm_spec_verify`` (speculative decode: per sequence per
 step before its draft window is proposed/verified — the
 ``llm_decode`` analog of the FLAGS_speculative_k path),
-``kv_alloc`` (paged allocator allocate/extend), and
+``llm_cow_copy`` (engine copy-on-write: before the in-pool copy that
+privatizes a shared block), ``kv_alloc`` (paged allocator
+allocate/extend), and
 ``llm_chunk_write`` (before each streamed token frame). An exception
 at any of these terminates
 exactly one sequence/stream (error frame or cancel, blocks freed);
@@ -84,7 +91,8 @@ VALUE_POINTS = ("nonfinite_grad", "loss_spike")
 # LLM serving plane injection points (serving_llm/ + kv_cache);
 # firing any of them fails ONE sequence, never the serving loop
 SERVING_POINTS = ("llm_prefill", "llm_chunk_prefill", "llm_decode",
-                  "llm_spec_verify", "llm_chunk_write", "kv_alloc")
+                  "llm_spec_verify", "llm_cow_copy",
+                  "llm_chunk_write", "kv_alloc")
 _VALUE_DEFAULT_MUL = {"nonfinite_grad": float("nan"),
                       "loss_spike": 1e6}
 
@@ -99,6 +107,7 @@ class FaultSpec:
     kill: Optional[int] = None
     exit: Optional[int] = None
     mul: Optional[float] = None
+    sleep: Optional[float] = None  # milliseconds
     seed: int = 0
 
 
@@ -147,6 +156,8 @@ def parse_spec(text: Optional[str]) -> List[FaultSpec]:
                 kwargs["p"] = float(v)
             elif k == "mul":
                 kwargs["mul"] = float(v)
+            elif k == "sleep":
+                kwargs["sleep"] = float(v)
             elif k in _INT_KEYS:
                 kwargs[k] = int(v)
             elif k == "kill":
@@ -156,7 +167,8 @@ def parse_spec(text: Optional[str]) -> List[FaultSpec]:
             else:
                 raise ValueError(
                     f"fault spec entry {entry!r}: unknown key {k!r} "
-                    f"(known: p, at, step, exc, kill, exit, mul, seed)")
+                    f"(known: p, at, step, exc, kill, exit, mul, "
+                    f"sleep, seed)")
         specs.append(FaultSpec(point, **kwargs))
     return specs
 
@@ -180,6 +192,8 @@ def format_spec(specs: List[FaultSpec]) -> str:
             fields.append(f"exit={s.exit}")
         if s.mul is not None:
             fields.append(f"mul={s.mul:g}")
+        if s.sleep is not None:
+            fields.append(f"sleep={s.sleep:g}")
         if s.seed:
             fields.append(f"seed={s.seed}")
         parts.append(":".join(fields))
@@ -260,6 +274,11 @@ class FaultRegistry:
     def _fire(self, point: str, s: FaultSpec,
               step: Optional[int]) -> None:
         _note(point, s, step)
+        if s.sleep is not None:
+            # latency fault: delay, then let the call site proceed
+            import time
+            time.sleep(s.sleep / 1e3)
+            return
         where = f"fault injected at {point!r}" + (
             f" (step {step})" if step is not None else "")
         if s.exc is not None:

@@ -60,6 +60,26 @@ def test_parse_spec_round_trip():
     assert faults.parse_spec(faults.format_spec(specs)) == specs
 
 
+def test_parse_spec_sleep_action_round_trip():
+    specs = faults.parse_spec("llm_decode:at=3:sleep=250,"
+                              "llm_cow_copy:sleep=12.5")
+    assert specs[0].sleep == 250.0 and specs[0].at == 3
+    assert specs[1].sleep == 12.5
+    assert faults.parse_spec(faults.format_spec(specs)) == specs
+
+
+def test_fault_sleep_action_delays_without_raising():
+    faults.configure("pt_sleep_point:sleep=30")
+    try:
+        t0 = time.monotonic()
+        faults.hit("pt_sleep_point")      # must NOT raise
+        assert time.monotonic() - t0 >= 0.025
+        c = obs.metrics.counter("faults_injected_total", always=True)
+        assert c.value(point="pt_sleep_point") >= 1
+    finally:
+        faults.configure(None)
+
+
 def test_parse_spec_signal_names_and_errors():
     assert faults.parse_spec("x:kill=TERM")[0].kill == int(signal.SIGTERM)
     assert faults.parse_spec("x:kill=SIGKILL")[0].kill == int(signal.SIGKILL)
@@ -553,7 +573,8 @@ def test_chaos_drill_list_inventory():
                  "crash_loop", "nonfinite_skip", "exact_resume",
                  "stream_disconnect", "llm_overload_shed",
                  "llm_drain_sigterm", "llm_decode_error",
-                 "llm_prefix_cow_leak"):
+                 "llm_prefix_cow_leak", "llm_spec_rollback",
+                 "llm_flight_deck"):
         assert name in proc.stdout, f"{name} missing from --list"
 
 

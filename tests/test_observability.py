@@ -1241,6 +1241,65 @@ def test_compile_cache_report_self_test_subprocess():
     assert "warm share" in proc.stdout
 
 
+def test_serving_report_self_test_subprocess():
+    """ISSUE acceptance: the flight-deck attribution CLI self-test
+    passes on CPU — each latency cause injected in isolation via
+    testing.faults wins the plurality of its engineered gap with
+    exclusive buckets, the chrome export round-trips, and the rings
+    stay bounded under a 200-stream flood with zero KV leak."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "serving_report.py"),
+         "--self-test"],
+        capture_output=True, text=True, env=env, timeout=540)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert "self-test OK" in proc.stdout
+    assert "flood bounding OK" in proc.stdout
+
+
+def test_llm_flight_deck_endpoints(http_server):
+    """/llm/seqs serves live + finished timelines with a ?trace_id=
+    filter joining the wire id; /llm/steps serves the bounded step
+    ring plus the live in-flight step."""
+    from paddle_tpu.observability import seqtrace, stepprof
+    try:
+        seqtrace.begin(7, trace_id=0xFEED, engine=1, prompt_tokens=3)
+        seqtrace.event(7, "token", index=0)
+        seqtrace.finish(7, "finished", tokens=1)
+        seqtrace.begin(8, trace_id=0xBEEF, engine=1, prompt_tokens=2)
+        stepprof.ring().step_begin(1, step=3, begin_unix=0.0)
+        stepprof.ring().record(1, {
+            "step": 3, "dur_ms": 2.5, "begin_mono": 0.0,
+            "phase_ms": {"decode": 2.0}})
+        stepprof.ring().step_begin(1, step=4, begin_unix=0.0)
+
+        code, text = _get(http_server.port, "/llm/seqs")
+        body = json.loads(text)
+        assert code == 200
+        assert [t["seq_id"] for t in body["live"]] == [8]
+        assert [t["seq_id"] for t in body["finished"]] == [7]
+        assert body["capacity"] == seqtrace.ring().capacity
+
+        code, text = _get(http_server.port,
+                          f"/llm/seqs?trace_id={0xFEED}")
+        body = json.loads(text)
+        assert code == 200 and int(body["trace_id"]) == 0xFEED
+        assert [t["seq_id"] for t in body["timelines"]] == [7]
+        assert [e["ev"] for e in body["timelines"][0]["events"]] \
+            == ["queued", "token", "finished"]
+
+        code, text = _get(http_server.port, "/llm/steps")
+        body = json.loads(text)
+        assert code == 200
+        assert [r["step"] for r in body["steps"]] == [3]
+        assert [r["step"] for r in body["live"]] == [4]
+        assert body["live"][0]["age_s"] >= 0
+    finally:
+        seqtrace.ring().reset()
+        stepprof.ring().reset()
+
+
 def test_deferred_probes_reach_host_handlers(metrics_on, monkeypatch):
     """Persistent-cache mode strips the step's jax.debug.callbacks (an
     HLO host callback disqualifies the executable from the cache) and

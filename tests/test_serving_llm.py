@@ -1886,3 +1886,116 @@ class TestSpeculativeEngine:
         assert h["k"] == 0 and h["proposed_tokens"] == 0
         assert h["accept_rate"] is None
         assert h["verify_ms_mean"] is None
+
+
+# ---------------------------------------------------------------------------
+# serving flight deck: per-sequence timelines + step profiler
+# ---------------------------------------------------------------------------
+
+class TestFlightDeck:
+    def test_timeline_lifecycle_and_trace_id_join(self, model,
+                                                  metrics_on):
+        from paddle_tpu.observability import seqtrace, stepprof
+        eng = LLMEngine(model, block_size=4, pool_blocks=32)
+        sid = eng.add_request([5, 9, 2], max_new_tokens=4,
+                              trace_id=0xABCD)
+        live = seqtrace.ring().live()
+        assert [tl["seq_id"] for tl in live] == [sid]
+        assert live[0]["trace_id"] == 0xABCD
+        assert [e["ev"] for e in live[0]["events"]] == ["queued"]
+        out, _, _ = _run(eng)
+        assert len(out[sid]) == 4
+        # terminal: moved live -> finished, events in lifecycle order
+        assert seqtrace.ring().live() == []
+        tl = seqtrace.ring().get(sid)
+        assert tl["outcome"] == "finished"
+        names = [e["ev"] for e in tl["events"]]
+        assert names[0] == "queued" and names[-1] == "finished"
+        assert names.index("admitted") < names.index("token")
+        assert sum(1 for n in names if n == "token") == 4
+        stamps = [e["t_mono"] for e in tl["events"]]
+        assert stamps == sorted(stamps)
+        # the wire join key finds it (live ring already drained)
+        assert [t["seq_id"]
+                for t in seqtrace.ring().find(0xABCD)] == [sid]
+        assert seqtrace.ring().find(0x1234) == []
+
+    def test_step_records_have_phases_and_live_view(self, model,
+                                                    metrics_on):
+        from paddle_tpu.observability import stepprof
+        eng = LLMEngine(model, block_size=4, pool_blocks=32)
+        eng.add_request([1, 2, 3, 4, 5], max_new_tokens=3)
+        _run(eng)
+        recs = stepprof.ring().recent()
+        assert recs, "no step records emitted"
+        assert stepprof.ring().live() == []   # nothing in flight
+        for r in recs:
+            assert set(r["phase_ms"]) <= set(stepprof.PHASES)
+            assert {"prefilling", "decoding", "verifying",
+                    "waiting"} <= set(r["batch"])
+            assert {"used", "free", "shared"} <= set(r["kv"])
+            assert r["dur_ms"] >= 0 and "begin_mono" in r
+        assert [r["step"] for r in recs] == sorted(
+            r["step"] for r in recs)
+        # phase histogram observed at least once per phase family
+        h = obs.metrics.histogram("llm_step_phase_ms")
+        assert h.count(phase="decode") >= 1
+
+    def test_preempted_and_shed_events(self, model, metrics_on):
+        from paddle_tpu.observability import seqtrace
+        eng = LLMEngine(model, block_size=4, pool_blocks=3,
+                        max_decode_batch=4)
+        a = eng.add_request([5, 9, 2], max_new_tokens=6)
+        b = eng.add_request([7, 7, 7], max_new_tokens=6)
+        _run(eng)
+        assert eng.scheduler.preemptions_total >= 1
+        evs = [e for s in (a, b)
+               for e in seqtrace.ring().get(s)["events"]]
+        pre = [e for e in evs if e["ev"] == "preempted"]
+        assert pre and all("preemptions" in e for e in pre)
+        assert any(e["ev"] == "readmitted" for e in evs)
+        # cancel with an explicit outcome closes the timeline as shed
+        # and dumps it to the flight recorder
+        c = eng.add_request([4, 4, 4, 4], max_new_tokens=8)
+        eng.cancel(c, outcome="shed")
+        tl = seqtrace.ring().get(c)
+        assert tl["outcome"] == "shed"
+        assert any(ev["kind"] == "seq_timeline"
+                   and ev["seq_id"] == c
+                   for ev in obs.flight_recorder().events())
+
+    def test_rings_bounded_and_resizable(self, metrics_on):
+        from paddle_tpu.observability import seqtrace, stepprof
+        sr, pr = seqtrace.ring(), stepprof.ring()
+        pt.set_flags({"llm_seqtrace_ring": 16, "llm_step_ring": 16})
+        try:
+            for i in range(50):
+                sr.begin(i, trace_id=1000 + i)
+                sr.event(i, "token", index=0)
+                sr.finish(i, "finished")
+                pr.step_begin(1, step=i, begin_unix=0.0)
+                pr.record(1, {"step": i, "dur_ms": 1.0,
+                              "phase_ms": {}})
+            assert len(sr.recent()) == 16 and sr.capacity == 16
+            assert len(pr.recent()) == 16 and pr.capacity == 16
+            # rotation: oldest evicted first, newest kept
+            assert [t["seq_id"] for t in sr.recent()] == list(
+                range(34, 50))
+            assert pr.recent()[-1]["step"] == 49
+            # shrink in place via the flag hook; floor of 8 enforced
+            pt.set_flags({"llm_seqtrace_ring": 4, "llm_step_ring": 4})
+            assert sr.capacity == 8 and pr.capacity == 8
+            assert len(sr.recent()) == 8 and len(pr.recent()) == 8
+        finally:
+            pt.set_flags({"llm_seqtrace_ring": 256,
+                          "llm_step_ring": 256})
+
+    def test_seqtrace_off_without_metrics(self, model):
+        from paddle_tpu.observability import seqtrace, stepprof
+        seqtrace.ring().reset()
+        stepprof.ring().reset()
+        eng = LLMEngine(model, block_size=4, pool_blocks=32)
+        sid = eng.add_request([5, 9, 2], max_new_tokens=2)
+        _run(eng)
+        assert seqtrace.ring().get(sid) is None
+        assert stepprof.ring().recent() == []

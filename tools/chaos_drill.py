@@ -46,6 +46,13 @@ the recovery contract from docs/fault_tolerance.md:
                      after its copy-on-write fired): the survivor
                      keeps exact dense parity, refcounted blocks are
                      NOT freed while referenced, pool drains to zero.
+  llm_flight_deck  — a prefix-sharing stream is preempted MID-prefill,
+                     re-COWs at its divergence point on readmission,
+                     and rolls back draft windows: its /llm/seqs
+                     timeline orders preempted < cow_copy <
+                     spec_window{rollback}, serving_report attributes
+                     its gaps to those causes with exclusive buckets,
+                     and ptlint stays green on the flight-deck code.
 
 Usage:
   python tools/chaos_drill.py --self-test        # all drills (CPU)
@@ -966,6 +973,175 @@ def drill_llm_spec_rollback(tmp):
             "to zero")
 
 
+_LLM_FLIGHT_DECK = r"""
+import json, sys
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import seqtrace
+from paddle_tpu.models import GPTConfig, GPTLanguageModel
+from paddle_tpu.serving_llm import LLMEngine
+from tools import serving_report
+
+out = sys.argv[1]
+# speculation starts OFF so every decoder grows exactly one token per
+# step — the pool-pressure preemption lands deterministically while
+# the victim is still prefilling
+pt.set_flags({"kv_prefix_sharing": True, "prefill_chunk_tokens": 4,
+              "speculative_k": 0})
+model = GPTLanguageModel()
+# a 1-layer draft disagrees with the target often enough that some
+# verify windows MUST roll back (the flight-deck event under test)
+draft = GPTLanguageModel(GPTConfig(num_layers=1))
+engine = LLMEngine(model, block_size=4, pool_blocks=16,
+                   draft_model=draft)
+shared = list(range(1, 11))               # 10 tokens: 2.5 blocks
+prompt_a = shared + [20, 21]              # 12 tokens: the prefix OWNER
+prompt_b = list(range(100, 108))          # 8 tokens: pool ballast
+prompt_v = shared + list(range(30, 60))   # 40 tokens, diverges at 10
+# the cast: A owns the shared prefix and must outlive the victim's
+# readmission (its partial tail block is what the victim re-COWs); B
+# is ballast whose block growth exhausts the pool mid-way through the
+# victim's 8-chunk prefill, preempting the YOUNGEST — the victim.
+# When the victim readmits, its own freed blocks are the slack that
+# lets make_private take a real copy instead of degenerating into a
+# preempt-the-sharer retry (which copies nothing).
+sid_a = engine.add_request(np.asarray(prompt_a, np.int32),
+                           max_new_tokens=12)
+sid_b = engine.add_request(np.asarray(prompt_b, np.int32),
+                           max_new_tokens=10)
+sid_v = None
+toks = {}
+spec_on = False
+for step in range(200):
+    if step == 4:
+        # A and B are decoding: V admits sharing A's 10-token prefix
+        # (COW material in A's partial block 2)
+        sid_v = engine.add_request(np.asarray(prompt_v, np.int32),
+                                   max_new_tokens=8)
+    for e in engine.step():
+        if e["type"] == "token":
+            toks.setdefault(e["seq_id"], []).append(int(e["token"]))
+    engine._audit()
+    if not spec_on and engine.allocator.cow_copies_total >= 2:
+        # the post-readmit COW landed: turn speculation on so the
+        # victim's decode proposes draft windows (and rolls some back
+        # — the draft is 1-layer, the target is not)
+        pt.set_flags({"speculative_k": 3})
+        spec_on = True
+    if not engine.active():
+        break
+check_ok = True
+try:
+    engine.allocator.check()
+except AssertionError:
+    check_ok = False
+tl = seqtrace.ring().get(sid_v)
+timelines, steps = serving_report.load_rings()
+rep = serving_report.analyze(timelines, steps, threshold_ms=1.0)
+res = {
+    "sid_a": sid_a, "sid_v": sid_v,
+    "outcome": tl["outcome"] if tl else None,
+    "events": tl["events"] if tl else [],
+    "v_tokens": len(toks.get(sid_v, [])),
+    "preemptions": engine.scheduler.preemptions_total,
+    "cow_copies": engine.allocator.cow_copies_total,
+    "spec_proposed": engine.spec_proposed_total,
+    "spec_accepted": engine.spec_accepted_total,
+    "kv_used_final": engine.allocator.num_used,
+    "check_ok": check_ok,
+    "steps_recorded": len(steps),
+    "findings_v": [f for f in rep["findings"]
+                   if f["seq_id"] == sid_v],
+}
+json.dump(res, open(out, "w"))
+"""
+
+
+def drill_llm_flight_deck(tmp):
+    """Flight-deck lifecycle drill: a prefix-sharing stream is
+    preempted MID-prefill by an older stream's speculative growth,
+    re-prefills with a fresh copy-on-write at the divergence point,
+    and takes draft-window rollbacks — its /llm/seqs timeline must
+    order preempted < cow_copy < spec_window{rollback} by monotonic
+    stamp, serving_report must attribute its gaps to exactly those
+    causes with exclusive buckets, and ptlint (clock-hygiene among the
+    passes) must stay green on the flight-deck sources."""
+    script = os.path.join(tmp, "llm_flight_deck.py")
+    with open(script, "w") as f:
+        f.write(_LLM_FLIGHT_DECK)
+    out = os.path.join(tmp, "llm_flight_deck.json")
+    proc = subprocess.run(
+        [sys.executable, script, out],
+        env=_env(tmp), capture_output=True, text=True, timeout=300)
+    _check(proc.returncode == 0,
+           f"flight-deck run died rc={proc.returncode}\n{proc.stderr}")
+    res = json.load(open(out))
+    _check(res["outcome"] == "finished" and res["v_tokens"] == 8,
+           f"victim stream should finish all 8 tokens: {res}")
+    evs = res["events"]
+    stamps = [e["t_mono"] for e in evs]
+    _check(stamps == sorted(stamps),
+           "timeline stamps are not monotonically non-decreasing")
+    names = [e["ev"] for e in evs]
+    _check(names[0] == "queued" and names[-1] == "finished",
+           f"timeline must run queued..finished: {names}")
+    pre = [i for i, e in enumerate(evs) if e["ev"] == "preempted"]
+    _check(bool(pre) and res["preemptions"] >= 1,
+           f"victim was never preempted: {names}")
+    _check(any(e["ev"] == "prefill_chunk" for e in evs[:pre[0]])
+           and evs[pre[0]].get("tokens") == 0,
+           f"preemption did not land MID-prefill (chunks before it, "
+           f"no tokens yet): {names}")
+    readmit = [i for i, e in enumerate(evs) if e["ev"] == "readmitted"]
+    _check(bool(readmit) and readmit[0] > pre[0],
+           f"no readmission after the preemption: {names}")
+    cow = [i for i, e in enumerate(evs)
+           if e["ev"] == "cow_copy" and i > readmit[0]]
+    _check(bool(cow) and res["cow_copies"] >= 2,
+           f"recompute prefill never re-fired copy-on-write at the "
+           f"divergence point: {names}")
+    roll = [i for i, e in enumerate(evs)
+            if e["ev"] == "spec_window" and e.get("rollback")]
+    _check(bool(roll) and roll[-1] > cow[0],
+           f"no draft-window rollback after the post-readmit COW: "
+           f"{names}")
+    _check(res["spec_proposed"] > res["spec_accepted"],
+           f"draft never disagreed with the target — rollback path "
+           f"unexercised: {res['spec_proposed']} proposed, "
+           f"{res['spec_accepted']} accepted")
+    # attribution: the engineered causes must carry real ledger weight
+    vf = res["findings_v"]
+    _check(bool(vf), "serving_report found no gaps for the victim")
+    for f in vf:
+        total = sum(f["buckets"].values())
+        _check(abs(total - f["gap_ms"]) <= max(0.05 * f["gap_ms"], 0.5),
+               f"buckets not exclusive/complete: {f}")
+    first = [f for f in vf if f["first_token"]]
+    _check(bool(first) and first[0]["cause"] == "preempt_recompute",
+           f"victim TTFT gap should be attributed to "
+           f"preempt_recompute: {first}")
+    _check(any(f["buckets"]["cow_copy"] > 0 for f in vf)
+           and any(f["buckets"]["spec_rollback"] > 0 for f in vf),
+           f"cow_copy / spec_rollback never charged: {vf}")
+    _check(res["steps_recorded"] > 0 and res["kv_used_final"] == 0
+           and res["check_ok"],
+           f"step ring empty or KV leaked after the drill: {res}")
+    # the attribution above only holds if every stamp it subtracted
+    # came from the monotonic clock — keep the linter's word for it
+    lint = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "ptlint.py"),
+         os.path.join(ROOT, "paddle_tpu", "observability"),
+         os.path.join(ROOT, "paddle_tpu", "serving_llm")],
+        capture_output=True, text=True, timeout=120)
+    _check(lint.returncode == 0,
+           f"ptlint (clock-hygiene et al) not green on the flight "
+           f"deck:\n{lint.stdout}\n{lint.stderr}")
+    return ("mid-prefill preemption, re-COW and spec rollback all "
+            "landed on one timeline in stamp order; gaps attributed "
+            "to the engineered causes; ptlint green")
+
+
 def drill_exact_resume(tmp):
     """SIGKILL mid-epoch + v3 resume == uninterrupted run, bitwise."""
     try:
@@ -991,6 +1167,7 @@ DRILLS = {
     "llm_decode_error": drill_llm_decode_error,
     "llm_prefix_cow_leak": drill_llm_prefix_cow_leak,
     "llm_spec_rollback": drill_llm_spec_rollback,
+    "llm_flight_deck": drill_llm_flight_deck,
 }
 
 
