@@ -24,11 +24,10 @@ import numpy as np
 
 
 def _percentile(xs, q):
-    if not xs:
-        return float("nan")
-    xs = sorted(xs)
-    i = min(len(xs) - 1, int(round(q / 100 * (len(xs) - 1))))
-    return xs[i]
+    # shared estimator so the example's numbers agree with the
+    # SLO/report planes (observability/metrics.py)
+    from paddle_tpu.observability import metrics as _m
+    return _m.percentile(xs, q)
 
 
 def main(n_clients: int = 8, max_new_tokens: int = 8,

@@ -20,12 +20,13 @@ Rule catalog (docs/static_analysis.md has the long form):
 - ``flag-freeze``      GLOBAL_FLAGS.get(...) at module import time
 - ``flags-doc``        flags need help= + docs (ex check_flags_doc.py)
 - ``metrics-doc``      metric names need docs (ex check_metrics_doc.py)
+- ``metric-hygiene``   instrument kind must match the name contract
 """
 
 from . import base, jitgraph  # noqa: F401  (re-exported submodules)
 from . import (callback_cache, clock_hygiene, flag_freeze, flags_doc,
-               lock_discipline, metrics_doc, silent_failure,
-               trace_purity)
+               lock_discipline, metric_hygiene, metrics_doc,
+               silent_failure, trace_purity)
 from .base import Context, Finding, Pass, SourceModule  # noqa: F401
 
 _PASSES = None
@@ -44,5 +45,6 @@ def all_passes():
             flag_freeze.FlagFreezePass(),
             flags_doc.FlagsDocPass(),
             metrics_doc.MetricsDocPass(),
+            metric_hygiene.MetricHygienePass(),
         ]
     return list(_PASSES)

@@ -380,6 +380,36 @@ define_flag("fleet_stale_after_s", 15.0,
             "then only unhealthy if they pushed health.ok=false).")
 
 
+def _tsdb_ring_changed(value) -> None:
+    from .observability import tsdb as _obs_tsdb
+    _obs_tsdb.ring().resize(int(value))
+
+
+define_flag("tsdb_ring", 512,
+            "Per-series capacity of the in-process time-series ring "
+            "(observability/tsdb.py): each watched metric keeps the "
+            "last N sampler snapshots (monotonic-stamped) so windowed "
+            "rate()/increase()/quantile_over_window() — and therefore "
+            "SLO burn-rate evaluation — are answerable locally. "
+            "Rotation-style eviction, oldest out first; memory bound "
+            "is watched-series count times this.",
+            on_change=_tsdb_ring_changed)
+define_flag("tsdb_interval_s", 1.0,
+            "Seconds between tsdb sampler ticks (observability/"
+            "tsdb.py): each tick snapshots every watched metric from "
+            "the registry into its ring and re-evaluates the SLO "
+            "alert state machines (observability/slo.py). The sampler "
+            "thread starts with the observability exporter; the "
+            "interval is re-read every tick so live set_flags() "
+            "changes apply.")
+define_flag("slo_window_scale", 1.0,
+            "Multiplier on every SLO burn-rate window "
+            "(observability/slo.py): the fast 5m/1h and slow 30m/6h "
+            "pairs all scale by this, so tests and chaos drills can "
+            "run the production alert arithmetic in seconds (e.g. "
+            "0.01 makes the fast pair 3s/36s). 1.0 in production.")
+
+
 def _request_ring_changed(value) -> None:
     from .observability import reqtrace as _obs_reqtrace
     _obs_reqtrace.ring().resize(int(value))

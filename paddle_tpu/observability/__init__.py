@@ -30,8 +30,8 @@ import warnings
 from typing import Any, Dict, Optional
 
 from . import (anomaly, fleet, flight, goodput, metrics, recompile,
-               reqtrace, rotation, seqtrace, server, stepprof,
-               trace_agg, tracer, xprof)
+               reqtrace, rotation, seqtrace, server, slo, stepprof,
+               trace_agg, tracer, tsdb, xprof)
 from .anomaly import sentinel as anomaly_sentinel
 from .flight import recorder as flight_recorder
 from .goodput import ledger as goodput_ledger
@@ -45,7 +45,7 @@ from .xprof import cards as program_cards
 
 __all__ = ["metrics", "tracer", "recompile", "trace_agg", "xprof",
            "anomaly", "server", "goodput", "flight", "rotation",
-           "fleet", "reqtrace", "seqtrace", "stepprof",
+           "fleet", "reqtrace", "seqtrace", "stepprof", "tsdb", "slo",
            "counter", "gauge", "histogram", "registry", "enabled",
            "set_enabled", "span", "export_chrome_trace", "get_tracer",
            "instrumented_jit", "recompile_tracker", "program_cards",
@@ -177,8 +177,9 @@ def export_all(path: Optional[str] = None) -> Dict[str, str]:
 def reset_all() -> None:
     """Clear metrics, spans, recompile records, program cards, anomaly
     state, the goodput ledger, the flight buffer, the request-span /
-    seq-timeline / step-record rings, and the fleet aggregator store
-    (tests/new runs)."""
+    seq-timeline / step-record rings, the fleet aggregator store, the
+    tsdb sample ring (stopping its sampler thread), and the SLO alert
+    engine (tests/new runs)."""
     registry().reset()
     get_tracer().reset()
     recompile_tracker().reset()
@@ -190,3 +191,6 @@ def reset_all() -> None:
     seqtrace.ring().reset()
     stepprof.ring().reset()
     fleet.aggregator().reset()
+    tsdb.stop()
+    tsdb.ring().reset()
+    slo.engine().reset()

@@ -42,6 +42,9 @@ Endpoints (observability/server.py):
   merged view keeps serving the dead host's last snapshot, clearly
   aged, so a SIGKILLed worker degrades the fleet page instead of
   breaking it.
+- ``GET  /fleet/alerts`` — SLO alert states merged worst-state-wins
+  across hosts with per-host attribution (observability/slo.py);
+  stale hosts are listed but age out of the fleet verdict.
 
 ``tools/fleet_status.py`` renders the live table;
 ``tools/fleet_status.py --self-test`` drills a real 3-process
@@ -67,7 +70,7 @@ __all__ = ["FleetReporter", "FleetAggregator", "aggregator",
            "start_reporter", "stop_reporter", "maybe_start_reporter",
            "local_snapshot", "merge_metric_snapshots",
            "merged_prometheus_text", "fleet_view", "fleet_goodput",
-           "fleet_health", "default_host_id"]
+           "fleet_health", "fleet_alerts", "default_host_id"]
 
 # env names the launcher uses for discovery (distributed/launch.py)
 AGGREGATOR_ENV = "PT_FLEET_AGGREGATOR"
@@ -110,13 +113,20 @@ def local_snapshot(host_id: Optional[str] = None) -> Dict[str, Any]:
         health = _server._healthz()
     except Exception as e:  # noqa: BLE001 — health must not break a push
         health = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+    try:
+        from . import slo as _slo
+        alerts = _slo.engine().alerts_view()
+    # ptlint: disable=silent-failure -- alert evaluation must not break a push; the snapshot just ships without an alerts section
+    except Exception:  # noqa: BLE001
+        alerts = None
     return {"host": host_id or default_host_id(),
             "pid": os.getpid(),
             "port": port,
             "ts_unix": time.time(),
             "metrics": _metrics.registry().snapshot(),
             "goodput": _goodput.ledger().snapshot(),
-            "health": health}
+            "health": health,
+            "alerts": alerts}
 
 
 # ---------------------------------------------------------------- merging
@@ -340,12 +350,82 @@ def fleet_view() -> Dict[str, Any]:
     return out
 
 
-def fleet_prometheus_text() -> str:
-    """The /fleet Prometheus body (merged exposition)."""
+def fleet_prometheus_text(name_prefixes=None) -> str:
+    """The /fleet Prometheus body (merged exposition).
+    ``name_prefixes`` (the ``/fleet?name=`` filter) keeps only metrics
+    whose name starts with any given prefix."""
     entries = aggregator().hosts()
     merged = merge_metric_snapshots(
         {h: e.get("metrics", {}) for h, e in entries.items()})
+    if name_prefixes is not None:
+        prefixes = tuple(p for p in name_prefixes if p)
+        merged = ({n: m for n, m in merged.items()
+                   if n.startswith(prefixes)} if prefixes else {})
     return merged_prometheus_text(merged)
+
+
+def fleet_alerts() -> Dict[str, Any]:
+    """The /fleet/alerts body: per-SLO worst-state-wins across hosts
+    with per-host attribution.
+
+    Each host's pushed snapshot carries its local ``alerts`` view
+    (observability/slo.py states). The merge keeps, per SLO, every
+    host's state/burn/budget and promotes the *worst* fresh state
+    (firing > pending > resolved > inactive) to the fleet verdict; a
+    host whose push is older than ``FLAGS_fleet_stale_after_s`` is
+    listed with ``stale: true`` but does NOT drive the verdict — its
+    alert state ages out the way /fleet/health ages its liveness."""
+    from .slo import STATE_ORDER
+    now_mono = time.monotonic()
+    stale_after = _stale_after_s()
+    slos: Dict[str, Any] = {}
+    stale_hosts: List[str] = []
+    n_reporting = 0
+    for host, entry in sorted(aggregator().hosts().items()):
+        mono0 = entry.get("received_mono")
+        age = (max(0.0, now_mono - float(mono0))
+               if mono0 is not None else float("inf"))
+        stale = stale_after > 0 and age > stale_after
+        if stale:
+            stale_hosts.append(host)
+        view = entry.get("alerts") or {}
+        alerts = view.get("alerts") or []
+        if alerts and not stale:
+            n_reporting += 1
+        for a in alerts:
+            name = a.get("slo")
+            state = a.get("state", "inactive")
+            if name is None or state not in STATE_ORDER:
+                continue
+            ent = slos.setdefault(
+                name, {"state": "inactive", "firing_hosts": [],
+                       "hosts": {}})
+            ent["hosts"][host] = {
+                "state": state,
+                "stale": stale,
+                "push_age_s": round(age, 3),
+                "budget_remaining": a.get("budget_remaining"),
+                "trigger_pair": a.get("trigger_pair"),
+                "age_s": a.get("age_s"),
+            }
+            if stale:
+                continue
+            if (STATE_ORDER.index(state)
+                    > STATE_ORDER.index(ent["state"])):
+                ent["state"] = state
+            if state == "firing":
+                ent["firing_hosts"].append(host)
+    worst = "inactive"
+    for ent in slos.values():
+        if STATE_ORDER.index(ent["state"]) > STATE_ORDER.index(worst):
+            worst = ent["state"]
+    return {"unix_time": time.time(),
+            "n_hosts": len(aggregator().hosts()),
+            "n_reporting": n_reporting,
+            "worst_state": worst,
+            "stale_after_s": stale_after,
+            "stale_hosts": stale_hosts,
+            "slos": slos}
 
 
 def _straggler_counts(metrics_snap: Dict[str, Any]) -> float:

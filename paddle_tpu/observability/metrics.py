@@ -26,7 +26,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "registry", "counter", "gauge", "histogram",
            "enabled", "set_enabled", "DEFAULT_BUCKETS",
-           "LATENCY_MS_BUCKETS"]
+           "LATENCY_MS_BUCKETS", "quantile_from_buckets", "percentile"]
 
 # Module-level enabled cache: read on every instrument write, so it must
 # be one attribute load — FLAGS_enable_metrics keeps it in sync via its
@@ -60,6 +60,68 @@ def _as_float(v: Any) -> float:
         return float(v)
     except (TypeError, ValueError):
         return float("nan")
+
+
+def quantile_from_buckets(buckets: Any, q: float) -> float:
+    """Prometheus ``histogram_quantile``-style estimate from cumulative
+    bucket counts.
+
+    ``buckets`` is either the snapshot-dict shape a :class:`Histogram`
+    series exposes (``{"0.5": 3, "1.0": 7, ..., "+Inf": 9}``) or a
+    ``(boundaries, cumulative_counts)`` pair where the last boundary may
+    be ``inf``. Returns the linearly interpolated value at quantile
+    ``q`` in [0, 1] (each bucket's mass spread uniformly across its
+    span, the Prometheus convention), ``nan`` when the histogram is
+    empty. The quantile landing in the ``+Inf`` bucket clamps to the
+    highest finite boundary — the estimator cannot see past it.
+
+    This is the ONE shared bucket-percentile estimator: the report CLIs
+    (serving_report / fleet_status), the tsdb window quantiles, and the
+    SLO latency objectives all call it so their numbers agree.
+    """
+    if isinstance(buckets, dict):
+        pairs = [(float("inf") if k == "+Inf" else float(k), float(c))
+                 for k, c in buckets.items()]
+    else:
+        bounds, counts = buckets
+        pairs = [(float(b), float(c)) for b, c in zip(bounds, counts)]
+    pairs.sort()
+    if not pairs:
+        return float("nan")
+    total = pairs[-1][1]
+    if total <= 0:
+        return float("nan")
+    q = min(1.0, max(0.0, float(q)))
+    rank = q * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for bound, cum in pairs:
+        if cum >= rank:
+            if bound == float("inf"):
+                # cannot interpolate into the open-ended bucket; clamp
+                # to the highest finite boundary (Prometheus does too)
+                return prev_bound
+            if cum <= prev_cum:
+                return bound
+            frac = (rank - prev_cum) / (cum - prev_cum)
+            return prev_bound + (bound - prev_bound) * frac
+        prev_bound, prev_cum = (0.0 if bound == float("inf")
+                                else bound), cum
+    return pairs[-1][0]
+
+
+def percentile(vals: Sequence[float], pct: float) -> float:
+    """Linear-interpolation percentile of raw samples (``pct`` in
+    [0, 100]); ``nan`` on an empty sequence. Shared by the report CLIs
+    so their list-based percentiles agree with each other."""
+    xs = sorted(float(v) for v in vals)
+    if not xs:
+        return float("nan")
+    if len(xs) == 1:
+        return xs[0]
+    pos = (min(100.0, max(0.0, float(pct))) / 100.0) * (len(xs) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(xs) - 1)
+    return xs[lo] + (xs[hi] - xs[lo]) * (pos - lo)
 
 
 class _Instrument:
@@ -301,10 +363,20 @@ class MetricsRegistry:
     def snapshot_json(self, indent: int = 1) -> str:
         return json.dumps(self.snapshot(), indent=indent, sort_keys=True)
 
-    def prometheus_text(self) -> str:
-        """Prometheus text exposition format."""
+    def prometheus_text(
+            self, name_prefixes: Optional[Sequence[str]] = None) -> str:
+        """Prometheus text exposition format.
+
+        ``name_prefixes`` (the exporter's ``/metrics?name=`` filter and
+        the tsdb sampler's fetch) keeps only metrics whose name starts
+        with any given prefix; the output stays valid exposition text.
+        """
         with self._lock:
             metrics = list(self._metrics.items())
+        if name_prefixes is not None:
+            prefixes = tuple(p for p in name_prefixes if p)
+            metrics = [(n, m) for n, m in metrics
+                       if n.startswith(prefixes)] if prefixes else []
         lines: List[str] = []
         for name, m in metrics:
             if m.help:

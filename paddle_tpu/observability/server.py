@@ -13,7 +13,12 @@ Endpoints:
 
 - ``/metrics``  — Prometheus text exposition of the metrics registry,
   plus the native stat registry (``pt_mon_dump``) bridged as
-  ``pt_native_stat{name=...}`` series.
+  ``pt_native_stat{name=...}`` series; ``?name=prefix[,prefix]``
+  keeps only matching metric names (still valid exposition text).
+- ``/alerts``   — SLO alert states (observability/slo.py): per-spec
+  state machine, observed burn rates per window, exact error-budget
+  remaining, transition history, tsdb ring stats.
+- ``/slo``      — the SLO specs themselves + lifetime compliance.
 - ``/healthz``  — device liveness (``jax.local_devices()``) + training
   heartbeat staleness: a wedged fit() loop reads unhealthy (HTTP 503)
   once the last-step heartbeat is older than
@@ -37,13 +42,16 @@ Endpoints:
   the last N sealed records plus the LIVE in-flight step per engine
   (begin stamps + current phase — a wedged step is visible here
   while it hangs).
-- ``/fleet`` (+ ``/fleet/goodput``, ``/fleet/health``, and the
-  worker-facing ``POST /fleet/push``) — the cross-host federation
-  plane (observability/fleet.py): any process's exporter doubles as
-  the fleet aggregator; workers push snapshots here and the merged
-  view (counters summed, gauges ``{host=}``-labeled, histograms
-  merged bucket-wise) is served back. ``/fleet/health`` answers 503
-  when any host's push is stale.
+- ``/fleet`` (+ ``/fleet/goodput``, ``/fleet/health``,
+  ``/fleet/alerts``, and the worker-facing ``POST /fleet/push``) —
+  the cross-host federation plane (observability/fleet.py): any
+  process's exporter doubles as the fleet aggregator; workers push
+  snapshots here and the merged view (counters summed, gauges
+  ``{host=}``-labeled, histograms merged bucket-wise) is served back.
+  ``/fleet`` honours the same ``?name=`` prefix filter as
+  ``/metrics``; ``/fleet/health`` answers 503 when any host's push is
+  stale; ``/fleet/alerts`` merges SLO alert states worst-state-wins
+  with per-host attribution.
 
 Port selection (``FLAGS_metrics_port``): a positive value binds that
 port; **0 (the default) binds an ephemeral port** — the chosen port is
@@ -63,7 +71,7 @@ import logging
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from . import fleet as _fleet
@@ -73,8 +81,10 @@ from . import metrics as _metrics
 from . import recompile as _recompile
 from . import reqtrace as _reqtrace
 from . import seqtrace as _seqtrace
+from . import slo as _slo
 from . import stepprof as _stepprof
 from . import tracer as _tracer
+from . import tsdb as _tsdb
 from . import xprof as _xprof
 
 _log = logging.getLogger("paddle_tpu.observability")
@@ -178,10 +188,17 @@ def _varz() -> Dict[str, Any]:
     }
 
 
-def metrics_text() -> str:
+def metrics_text(name_prefixes=None) -> str:
     """Prometheus page body: registry exposition + bridged native
-    stats (shared by the HTTP handler and export_all's metrics.prom)."""
-    text = _metrics.registry().prometheus_text()
+    stats (shared by the HTTP handler and export_all's metrics.prom).
+    ``name_prefixes`` (the ``/metrics?name=`` filter) keeps only
+    metrics whose name starts with any given prefix — the bridged
+    ``pt_native_stat`` block filters by its own name like any other."""
+    text = _metrics.registry().prometheus_text(name_prefixes)
+    if name_prefixes is not None:
+        prefixes = tuple(p for p in name_prefixes if p)
+        if not prefixes or not "pt_native_stat".startswith(prefixes):
+            return text
     native = _native_stats()
     if native:
         lines = ["# HELP pt_native_stat native stat registry "
@@ -210,6 +227,17 @@ def _trace_window(ms: int) -> Dict[str, Any]:
                          "metrics_enabled": _metrics.enabled()}}
 
 
+def _name_prefixes(q: Dict[str, Any]) -> Optional[Tuple[str, ...]]:
+    """The ``?name=`` filter: comma-separated metric-name prefixes
+    (repeatable); None when the parameter is absent (no filter)."""
+    if "name" not in q:
+        return None
+    out: Tuple[str, ...] = ()
+    for v in q["name"]:
+        out += tuple(p.strip() for p in v.split(",") if p.strip())
+    return out
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "paddle_tpu_obs/1"
 
@@ -232,7 +260,9 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             url = urlparse(self.path)
             if url.path == "/metrics":
-                self._send(200, metrics_text().encode(),
+                q = parse_qs(url.query)
+                prefixes = _name_prefixes(q)
+                self._send(200, metrics_text(prefixes).encode(),
                            "text/plain; version=0.0.4")
             elif url.path == "/healthz":
                 h = _healthz()
@@ -245,6 +275,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, _trace_window(ms))
             elif url.path == "/goodput":
                 self._send_json(200, _goodput.ledger().snapshot())
+            elif url.path == "/alerts":
+                _slo.ensure_default_pack()
+                view = _slo.engine().alerts_view()
+                view["tsdb"] = _tsdb.ring().stats()
+                self._send_json(200, view)
+            elif url.path == "/slo":
+                _slo.ensure_default_pack()
+                self._send_json(200, _slo.engine().slo_view())
             elif url.path == "/flight":
                 rec = _flight.recorder()
                 self._send_json(200, {"capacity": rec.capacity,
@@ -292,21 +330,26 @@ class _Handler(BaseHTTPRequestHandler):
                 if q.get("format", [""])[0] == "json":
                     self._send_json(200, _fleet.fleet_view())
                 else:
-                    self._send(200,
-                               _fleet.fleet_prometheus_text().encode(),
-                               "text/plain; version=0.0.4")
+                    prefixes = _name_prefixes(q)
+                    self._send(
+                        200,
+                        _fleet.fleet_prometheus_text(prefixes).encode(),
+                        "text/plain; version=0.0.4")
             elif url.path == "/fleet/goodput":
                 self._send_json(200, _fleet.fleet_goodput())
             elif url.path == "/fleet/health":
                 ok, payload = _fleet.fleet_health()
                 self._send_json(200 if ok else 503, payload)
+            elif url.path == "/fleet/alerts":
+                self._send_json(200, _fleet.fleet_alerts())
             elif url.path == "/":
                 self._send(200,
-                           b"paddle_tpu observability: /metrics /healthz "
-                           b"/varz /trace?ms=N /goodput /flight "
+                           b"paddle_tpu observability: /metrics?name=P "
+                           b"/healthz /varz /trace?ms=N /goodput "
+                           b"/alerts /slo /flight "
                            b"/requests?n=N /llm/seqs?n=N&trace_id=T "
-                           b"/llm/steps?n=N /fleet /fleet/goodput "
-                           b"/fleet/health\n",
+                           b"/llm/steps?n=N /fleet?name=P /fleet/goodput "
+                           b"/fleet/health /fleet/alerts\n",
                            "text/plain")
             else:
                 self._send(404, b"not found\n", "text/plain")
@@ -432,6 +475,14 @@ def maybe_start() -> Optional[ObservabilityServer]:
         _fleet.maybe_start_reporter()
     except Exception:  # noqa: BLE001 — federation must not break fit
         _log.exception("fleet reporter failed to start")
+    try:
+        # the SLO/tsdb judgment layer rides the exporter's lifecycle:
+        # install the default pack (its metrics join the watch set)
+        # and start the sampler so burn-rate windows begin filling
+        _slo.ensure_default_pack()
+        _tsdb.start()
+    except Exception:  # noqa: BLE001 — judgment layer must not break fit
+        _log.exception("tsdb sampler failed to start")
     return srv
 
 
@@ -476,6 +527,23 @@ def self_test() -> int:
         gp = json.loads(text)
         assert code == 200 and "goodput_ratio" in gp \
             and set(gp["buckets"]) >= set(_goodput.BUCKETS), text
+        # ?name= prefix filter keeps the exposition parseable
+        code, text = fetch("/metrics?name=selftest_")
+        assert code == 200 and "selftest_http_total 3" in text, text
+        assert "observability_server_port" not in text, text
+        # SLO plane: default pack installed on first read, every spec
+        # starts inactive with a full budget
+        code, text = fetch("/alerts")
+        al = json.loads(text)
+        assert code == 200 and al["worst_state"] == "inactive", text
+        names = {a["slo"] for a in al["alerts"]}
+        assert {"serving_availability", "serving_ttft_p99",
+                "kv_audit_clean"} <= names, names
+        code, text = fetch("/slo")
+        sl = json.loads(text)
+        assert code == 200 and len(sl["slos"]) == len(names), text
+        assert all(s["budget_remaining"] == 1.0 or s["lifetime"]["total"]
+                   for s in sl["slos"]), text
         _reqtrace.record({"trace_id": 7, "ingress_unix": time.time(),
                           "reply_unix": time.time()})
         code, text = fetch("/requests?n=5")
@@ -520,12 +588,20 @@ def self_test() -> int:
             assert r.status == 200
         code, text = fetch("/fleet")
         assert code == 200 and "selftest_http_total 3" in text, text
+        code, text = fetch("/fleet?name=selftest_")
+        assert code == 200 and "selftest_http_total 3" in text, text
+        assert "observability_server_port" not in text, text
         code, text = fetch("/fleet/health")
         fh = json.loads(text)
         assert code == 200 and "selftest-host" in fh["hosts"], text
         code, text = fetch("/fleet/goodput")
         assert code == 200 and "selftest-host" in \
             json.loads(text)["hosts"], text
+        code, text = fetch("/fleet/alerts")
+        fa = json.loads(text)
+        assert code == 200 and fa["worst_state"] == "inactive", text
+        assert "serving_availability" in fa["slos"] and "selftest-host" \
+            in fa["slos"]["serving_availability"]["hosts"], text
     finally:
         srv.stop()
         _metrics.set_enabled(False)
@@ -533,6 +609,9 @@ def self_test() -> int:
         _reqtrace.ring().reset()
         _seqtrace.ring().reset()
         _stepprof.ring().reset()
+        _tsdb.stop()
+        _tsdb.ring().reset()
+        _slo.engine().reset()
     print("self-test OK")
     return 0
 

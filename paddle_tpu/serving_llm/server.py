@@ -143,6 +143,13 @@ class LLMStreamBridge:
                     req["rid"], ev["error"].encode(), status=-1,
                     final=True)
                 del self._reqs[ev["seq_id"]]
+                from .. import observability as obs
+                if obs.enabled():
+                    obs.counter(
+                        "serving_stream_errors_total",
+                        "admitted streams terminated by an engine "
+                        "execute error (a bad event in the "
+                        "serving_availability SLO)").inc()
                 self._record(req, status=-1, outcome="execute_error",
                              error=ev["error"][:200])
 

@@ -222,6 +222,56 @@ def test_fleet_push_rejects_garbage(metrics_on):
     assert ei.value.code == 400
 
 
+def test_fleet_alerts_merge_worst_state_and_staleness(metrics_on):
+    """/fleet/alerts promotes each SLO's worst fresh host state to the
+    fleet verdict with per-host attribution; a stale host stays listed
+    but ages out of the verdict like /fleet/health liveness."""
+    from paddle_tpu.observability import slo
+    srv = obs_server.start(0)
+    slo.ensure_default_pack()
+    fleet.aggregator().ingest(fleet.local_snapshot("hA"))
+    # second host: same snapshot shape, alerts view hand-built to the
+    # state a burning host would push
+    snap = fleet.local_snapshot("hB")
+    snap["alerts"] = {
+        "worst_state": "firing",
+        "alerts": [
+            {"slo": "serving_availability", "state": "firing",
+             "trigger_pair": "fast", "budget_remaining": -3.0,
+             "age_s": 1.0},
+            {"slo": "kv_audit_clean", "state": "pending",
+             "budget_remaining": 1.0, "age_s": 0.5},
+        ],
+    }
+    fleet.aggregator().ingest(snap)
+
+    code, body = _get(srv.port, "/fleet/alerts")
+    assert code == 200
+    view = json.loads(body)
+    assert view["worst_state"] == "firing"
+    assert view["n_hosts"] == 2 and view["n_reporting"] == 2
+    avail = view["slos"]["serving_availability"]
+    assert avail["state"] == "firing"
+    assert avail["firing_hosts"] == ["hB"]
+    assert avail["hosts"]["hA"]["state"] == "inactive"
+    assert avail["hosts"]["hB"]["state"] == "firing"
+    assert avail["hosts"]["hB"]["trigger_pair"] == "fast"
+    assert view["slos"]["kv_audit_clean"]["state"] == "pending"
+
+    # the firing host goes stale; the healthy host keeps pushing
+    pt.set_flags({"fleet_stale_after_s": 0.05})
+    time.sleep(0.1)
+    fleet.aggregator().ingest(fleet.local_snapshot("hA"))
+    view = fleet.fleet_alerts()
+    assert view["stale_hosts"] == ["hB"]
+    assert view["n_reporting"] == 1
+    assert view["worst_state"] == "inactive"
+    avail = view["slos"]["serving_availability"]
+    assert avail["state"] == "inactive" and avail["firing_hosts"] == []
+    assert avail["hosts"]["hB"]["stale"]
+    assert avail["hosts"]["hB"]["state"] == "firing"
+
+
 def test_reporter_survives_dead_aggregator(metrics_on):
     """A dead aggregator must cost the worker nothing but a counted
     failure — push_once returns False, never raises."""

@@ -55,7 +55,7 @@ def test_registry_covers_expected_rules():
     assert set(PASS_IDS) == {
         "trace-purity", "callback-cache", "lock-discipline",
         "clock-hygiene", "silent-failure", "flag-freeze",
-        "flags-doc", "metrics-doc",
+        "flags-doc", "metrics-doc", "metric-hygiene",
     }
 
 
@@ -266,7 +266,7 @@ def test_analysis_loads_without_jax():
         "mod = importlib.util.module_from_spec(spec)\n"
         "sys.modules['pt_analysis'] = mod\n"
         "spec.loader.exec_module(mod)\n"
-        "assert len(mod.all_passes()) == 8\n"
+        "assert len(mod.all_passes()) == 9\n"
         "assert 'jax' not in sys.modules, 'analysis imported jax'\n"
         "assert 'paddle_tpu' not in sys.modules, "
         "'analysis imported the framework'\n"

@@ -53,6 +53,13 @@ the recovery contract from docs/fault_tolerance.md:
                      spec_window{rollback}, serving_report attributes
                      its gaps to those causes with exclusive buckets,
                      and ptlint stays green on the flight-deck code.
+  slo_burn_alert   — an engineered overload (slow prefill fault +
+                     admission-watermark flood) burns the
+                     serving_availability SLO: the fast multi-window
+                     burn-rate alert fires with a flight-recorder
+                     transition, resolves once the load stops, and the
+                     serving plane comes out with zero KV leak and a
+                     clean engine audit.
 
 Usage:
   python tools/chaos_drill.py --self-test        # all drills (CPU)
@@ -520,6 +527,156 @@ def drill_llm_overload_shed(tmp):
     return (f"{res['n_rejected']} of 6 refused at admission with "
             f"retry hints, 0 preemptions, {res['n_ok']} admitted with "
             f"exact parity, pool drained")
+
+
+_SLO_BURN = r"""
+import json, sys, threading, time
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu.inference import Client, Server
+from paddle_tpu.models import GPTLanguageModel
+from paddle_tpu.observability import slo as slo_mod
+from paddle_tpu.observability import tsdb as tsdb_mod
+from paddle_tpu.serving_llm import LLMEngine
+from paddle_tpu.sysconfig import enable_compile_cache
+
+enable_compile_cache()
+out = sys.argv[1]
+# scaled windows: fast pair 3s/36s @ 14.4, slow pair 18s/216s @ 6 —
+# the production burn arithmetic, compressed into drill seconds
+pt.set_flags({"slo_window_scale": 0.01, "tsdb_interval_s": 0.1,
+              "kv_admission_watermark": 0.0, "fault_spec": ""})
+slo_mod.ensure_default_pack()
+eng = slo_mod.engine()
+
+def alert():
+    return {a["slo"]: a for a in eng.evaluate()}["serving_availability"]
+
+model = GPTLanguageModel()
+# 8-block pool + 0.5 watermark (armed below): budget 4 blocks, each
+# request projects 3, so a 6-client wave MUST see rejections (burn)
+engine = LLMEngine(model, block_size=4, pool_blocks=8)
+srv = Server(None, llm_engine=engine)
+PROMPT = [5, 6, 7, 8, 9]
+
+def wave(n):
+    def worker():
+        cli = Client(port=srv.port, timeout_s=120.0)
+        try:
+            cli.generate(PROMPT, max_new_tokens=4, retry=False)
+        except RuntimeError:
+            pass
+        finally:
+            cli.close()
+    ts = [threading.Thread(target=worker) for _ in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+wave(1)  # jit warm-up lands inside the first tsdb sample (baseline)
+tsdb_mod.start()
+time.sleep(0.4)
+baseline = alert()["state"]
+
+# overload: slow prefill + watermark flood -> availability burns fast
+pt.set_flags({"kv_admission_watermark": 0.5,
+              "fault_spec": "llm_prefill:sleep=1200"})
+fired = False
+fast_over = False
+fast_burn = 0.0
+deadline = time.monotonic() + 120.0
+while time.monotonic() < deadline and not fired:
+    wave(6)
+    a = alert()
+    if a["state"] == "firing":
+        fired = True
+        fast_over = a["windows"]["fast"]["over"]
+        fast_burn = a["windows"]["fast"]["short"]["burn_rate"]
+
+# load stops: the short windows drain and the alert must resolve.
+# Shrink the scale further so the slow pair's windows age out the
+# rejection burst in CI seconds instead of 18 drill-seconds.
+pt.set_flags({"fault_spec": "", "kv_admission_watermark": 0.0,
+              "slo_window_scale": 0.002})
+resolved = False
+deadline = time.monotonic() + 90.0
+while time.monotonic() < deadline:
+    if alert()["state"] != "firing":
+        resolved = True
+        break
+    time.sleep(0.25)
+
+tsdb_mod.stop()
+srv.stop()
+ev = [e for e in obs.flight.recorder().events()
+      if e.get("kind") == "slo_alert"
+      and e.get("slo") == "serving_availability"]
+hist = [t["to"] for a in eng.alerts_view()["alerts"]
+        if a["slo"] == "serving_availability" for t in a["history"]]
+audit_ok = True
+try:
+    engine.allocator.check()
+    engine._audit()
+except Exception:
+    audit_ok = False
+res = {
+    "baseline": baseline,
+    "fired": fired,
+    "fast_over": fast_over,
+    "fast_burn": fast_burn,
+    "resolved": resolved,
+    "history": hist,
+    "flight_firing": sum(1 for e in ev if e["to_state"] == "firing"),
+    "flight_resolved": sum(1 for e in ev if e["to_state"] == "resolved"),
+    "rejected_total": obs.counter(
+        "llm_admission_rejected_total").value(),
+    "kv_used_after": engine.allocator.num_used,
+    "audit_ok": audit_ok,
+}
+json.dump(res, open(out, "w"))
+"""
+
+
+def drill_slo_burn_alert(tmp):
+    """Engineered overload burns the availability SLO: the fast
+    multi-window burn-rate alert fires (both windows over the page
+    threshold) with a flight-recorder transition, then resolves after
+    the load stops — and the serving plane comes out clean (zero KV
+    leak, engine audit passes)."""
+    script = os.path.join(tmp, "slo_burn.py")
+    with open(script, "w") as f:
+        f.write(_SLO_BURN)
+    out = os.path.join(tmp, "slo_burn.json")
+    proc = subprocess.run(
+        [sys.executable, script, out], env=_env(tmp),
+        capture_output=True, text=True, timeout=420)
+    _check(proc.returncode == 0,
+           f"slo-burn run died rc={proc.returncode}\n{proc.stderr}")
+    res = json.load(open(out))
+    _check(res["baseline"] != "firing",
+           f"availability alert already firing before overload: {res}")
+    _check(res["fired"],
+           f"overload never tripped serving_availability: {res}")
+    _check(res["fast_over"] and res["fast_burn"] > 14.4,
+           f"firing without the fast pair over the page threshold: "
+           f"{res}")
+    _check(res["rejected_total"] >= 1,
+           f"flood produced no admission rejections (nothing burned): "
+           f"{res}")
+    _check(res["resolved"],
+           f"alert never left firing after the load stopped: {res}")
+    _check("firing" in res["history"] and "resolved" in res["history"],
+           f"state-machine history is missing transitions: {res}")
+    _check(res["flight_firing"] >= 1 and res["flight_resolved"] >= 1,
+           f"slo_alert flight events missing: {res}")
+    _check(res["kv_used_after"] == 0,
+           f"KV blocks leaked across the overload: {res}")
+    _check(res["audit_ok"],
+           f"allocator/engine audit failed after the drill: {res}")
+    return (f"availability burned at {res['fast_burn']:.0f}x budget "
+            f"(fast pair over 14.4), flight-recorded, resolved after "
+            f"load stopped; pool clean")
 
 
 _LLM_DRAIN_SERVER = r"""
@@ -1163,6 +1320,7 @@ DRILLS = {
     "exact_resume": drill_exact_resume,
     "stream_disconnect": drill_stream_disconnect,
     "llm_overload_shed": drill_llm_overload_shed,
+    "slo_burn_alert": drill_slo_burn_alert,
     "llm_drain_sigterm": drill_llm_drain_sigterm,
     "llm_decode_error": drill_llm_decode_error,
     "llm_prefix_cow_leak": drill_llm_prefix_cow_leak,

@@ -54,24 +54,61 @@ def _get(addr: str, path: str, timeout_s: float = 5.0
         return status, body
 
 
+def _fleet_ttft_p99_ms(view: Dict[str, Any]) -> Optional[float]:
+    """Fleet-wide p99 TTFT from the merged serving_ttft_ms histogram
+    (the shared bucket estimator in observability/metrics.py)."""
+    ent = (view.get("metrics") or {}).get("serving_ttft_ms") or {}
+    merged: Dict[str, float] = {}
+    for s in ent.get("series", []):
+        for le, c in (s.get("buckets") or {}).items():
+            merged[le] = merged.get(le, 0.0) + float(c)
+    if not merged or merged.get("+Inf", 0) <= 0:
+        return None
+    from paddle_tpu.observability.metrics import quantile_from_buckets
+    return quantile_from_buckets(merged, 0.99)
+
+
+def _host_alert_states(alerts: Dict[str, Any]) -> Dict[str, str]:
+    """host -> its worst alert state across every SLO."""
+    order = ("inactive", "resolved", "pending", "firing")
+    worst: Dict[str, str] = {}
+    for ent in (alerts.get("slos") or {}).values():
+        for host, ha in (ent.get("hosts") or {}).items():
+            st = ha.get("state", "inactive")
+            if st not in order:
+                continue
+            cur = worst.get(host, "inactive")
+            if order.index(st) > order.index(cur):
+                worst[host] = st
+            else:
+                worst.setdefault(host, cur)
+    return worst
+
+
 def render(addr: str) -> int:
     """Print the fleet table; exit 0 healthy, 1 degraded/unreachable."""
     try:
         _, view = _get(addr, "/fleet?format=json")
         hcode, health = _get(addr, "/fleet/health")
         _, gp = _get(addr, "/fleet/goodput")
+        _, alerts = _get(addr, "/fleet/alerts")
     except OSError as e:
         print(f"fleet_status: aggregator {addr} unreachable: {e}",
               file=sys.stderr)
         return 1
     hosts = sorted(set(view.get("hosts", {}))
                    | set(health.get("hosts", {})))
+    p99 = _fleet_ttft_p99_ms(view)
+    alerts = alerts if isinstance(alerts, dict) else {}
     print(f"fleet @ {addr}: {len(hosts)} host(s), "
           f"health={'OK' if hcode == 200 else 'STALE (503)'}, "
           f"fleet goodput {gp.get('goodput_ratio', 0.0):.1%} over "
-          f"{gp.get('wall_seconds', 0.0):.1f}s wall")
+          f"{gp.get('wall_seconds', 0.0):.1f}s wall, "
+          f"TTFT p99 {'-' if p99 is None else f'{p99:.1f}ms'}, "
+          f"alerts={alerts.get('worst_state', 'inactive')}")
+    host_alerts = _host_alert_states(alerts)
     cols = ("host", "age_s", "stale", "healthy", "port", "goodput",
-            "worst badput", "stragglers")
+            "worst badput", "stragglers", "alerts")
     rows = []
     for h in hosts:
         hh = health.get("hosts", {}).get(h, {})
@@ -83,7 +120,8 @@ def render(addr: str) -> int:
                      str(hh.get("port") or "-"),
                      f"{gh.get('goodput_ratio', 0.0):.1%}",
                      str(gh.get("worst_badput_bucket") or "-"),
-                     f"{gh.get('straggler_events', 0):.0f}"))
+                     f"{gh.get('straggler_events', 0):.0f}",
+                     host_alerts.get(h, "inactive")))
     widths = [max(len(c), *(len(r[i]) for r in rows)) if rows
               else len(c) for i, c in enumerate(cols)]
     print("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
@@ -116,6 +154,11 @@ obs.gauge("fleet_selftest_gauge").set(float(rank))
 obs.histogram("fleet_selftest_ms",
               buckets=obs.metrics.LATENCY_MS_BUCKETS
               ).observe(1.0 * (rank + 1))
+obs.histogram("serving_ttft_ms",
+              "time to first token: request ingress to first streamed "
+              "chunk",
+              buckets=obs.metrics.LATENCY_MS_BUCKETS
+              ).observe(40.0 * (rank + 1))
 led = goodput.ledger()
 led.start()
 led.attribute("step_compute", 2.0 + rank)
@@ -214,6 +257,16 @@ def self_test() -> int:
         assert gp["goodput_ratio"] > 0, gp
         assert gp["hosts"]["w0"]["worst_badput_bucket"] == \
             "data_wait", gp["hosts"]["w0"]
+        # fleet TTFT p99 via the shared bucket estimator: observations
+        # 40/80/120ms all land in finite buckets, so the interpolated
+        # p99 sits inside the top straddled bucket (100, 250]
+        p99 = _fleet_ttft_p99_ms(view)
+        assert p99 is not None and 100.0 < p99 <= 250.0, p99
+        # the merged alerts plane answers (no specs registered on the
+        # workers, so the fleet verdict is a quiet inactive)
+        code, alerts = _get(addr, "/fleet/alerts")
+        assert code == 200 and alerts["worst_state"] == "inactive", \
+            alerts
         print(f"fleet up: 3 hosts, merged counters/gauges/histograms "
               f"OK @ {addr}")
 

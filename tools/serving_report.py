@@ -114,12 +114,12 @@ def load_url(url: str) -> Tuple[List[dict], List[dict]]:
 # -------------------------------------------------------------- analysis
 
 def _percentile(vals: List[float], pct: float) -> float:
+    # the one shared estimator (observability/metrics.py) so this
+    # report's percentiles agree with the SLO engine's
     if not vals:
         return 0.0
-    s = sorted(vals)
-    k = (len(s) - 1) * min(max(pct, 0.0), 100.0) / 100.0
-    lo, hi = int(k), min(int(k) + 1, len(s) - 1)
-    return s[lo] + (s[hi] - s[lo]) * (k - lo)
+    from paddle_tpu.observability import metrics as _m
+    return _m.percentile(vals, pct)
 
 
 def gaps_of(tl: dict) -> List[dict]:
@@ -382,7 +382,9 @@ def self_test() -> int:
     from paddle_tpu.models.gpt_lm import GPTConfig, GPTLanguageModel
     from paddle_tpu.serving_llm import engine as engine_mod
     from paddle_tpu.serving_llm.engine import LLMEngine
+    from paddle_tpu.sysconfig import enable_compile_cache
 
+    enable_compile_cache()
     model = GPTLanguageModel(GPTConfig())
 
     def prompt(n, base=1):
