@@ -85,6 +85,77 @@ def fleet_observability_env(rank: int, env: Dict[str, str]
     }
 
 
+class _WedgeWatch:
+    """Launcher-side hang forensics (the elastic-launch heartbeat
+    path of the hang doctor, observability/stacks.py).
+
+    When the fleet wiring is active every worker serves /healthz on
+    its assigned exporter port; the watch polls each live child every
+    ``POLL_S`` seconds (0.5 s timeout — a wedged worker's exporter
+    thread still answers while its step thread hangs) and, on the
+    *transition* to wedged (heartbeat stale or a serving engine
+    stalled), records a forced ``worker_wedged`` flight event in the
+    launcher and sends the child SIGUSR2 — which makes the worker
+    dump its own all-thread stacks into its flight file
+    (stacks.install_signal_dump). One poke per wedge episode; a
+    worker that recovers re-arms."""
+
+    POLL_S = 5.0
+
+    def __init__(self, ports: Dict[int, int]) -> None:
+        self.ports = ports
+        self._last_mono: Optional[float] = None
+        self._wedged: Dict[int, bool] = {}
+
+    @staticmethod
+    def _wedged_payload(body: bytes) -> bool:
+        import json
+        try:
+            h = json.loads(body)
+        except ValueError:
+            return False
+        serving = h.get("serving") or {}
+        return bool(h.get("wedged")
+                    or any(e.get("stalled")
+                           for e in serving.get("engines", [])))
+
+    def poll(self, procs: Sequence[subprocess.Popen]) -> None:
+        if not self.ports:
+            return
+        now = time.monotonic()
+        if self._last_mono is not None \
+                and now - self._last_mono < self.POLL_S:
+            return
+        self._last_mono = now
+        import urllib.error
+        import urllib.request
+        for rank, port in self.ports.items():
+            if rank >= len(procs) or procs[rank].poll() is not None:
+                continue
+            wedged = False
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz",
+                        timeout=0.5) as r:
+                    r.read()
+            except urllib.error.HTTPError as e:
+                wedged = e.code == 503 and self._wedged_payload(
+                    e.read())
+            # ptlint: disable=silent-failure -- worker still booting or exporter off; liveness is the exit-code watch's job
+            except Exception:  # noqa: BLE001
+                continue
+            if wedged and not self._wedged.get(rank):
+                from ..observability import flight as _flight
+                _flight.record("worker_wedged", force=True, rank=rank,
+                               port=port, action="SIGUSR2")
+                try:
+                    os.kill(procs[rank].pid, signal.SIGUSR2)
+                # ptlint: disable=silent-failure -- raced the worker's death; the worker_wedged flight event above already records the episode and the exit watch owns dead children
+                except OSError:
+                    pass
+            self._wedged[rank] = wedged
+
+
 def terminate_local_procs(procs: Sequence[subprocess.Popen],
                           grace_s: float = 5.0) -> None:
     """(ref: distributed/utils.py:252)."""
@@ -115,6 +186,7 @@ def launch_procs(cmd: Sequence[str], nproc: int,
         server = native.ControlPlaneServer()
         cp_endpoint = f"127.0.0.1:{server.port}"
     procs: List[subprocess.Popen] = []
+    worker_ports: Dict[int, int] = {}
     try:
         for rank in range(nproc):
             env = dict(os.environ)
@@ -123,9 +195,13 @@ def launch_procs(cmd: Sequence[str], nproc: int,
                 env.update(env_extra)
             # per-worker exporter port + fleet discovery (base+rank
             # scheme; no-op unless a positive base port is configured)
-            env.update(fleet_observability_env(rank, env))
+            fleet_env = fleet_observability_env(rank, env)
+            env.update(fleet_env)
+            if fleet_env:
+                worker_ports[rank] = int(fleet_env["FLAGS_metrics_port"])
             procs.append(subprocess.Popen(list(cmd), env=env))
         exit_code = 0
+        wedge_watch = _WedgeWatch(worker_ports)
         while True:
             states = [p.poll() for p in procs]
             if any(s not in (None, 0) for s in states):
@@ -134,6 +210,7 @@ def launch_procs(cmd: Sequence[str], nproc: int,
                 break
             if all(s == 0 for s in states):
                 break
+            wedge_watch.poll(procs)
             time.sleep(poll_interval)
         return exit_code
     finally:

@@ -443,6 +443,39 @@ define_flag("health_heartbeat_timeout_s", 300.0,
             "training heartbeat exists but is older than this many "
             "seconds — a wedged fit() loop reads unhealthy while the "
             "process is still up. 0 disables the staleness check.")
+
+
+def _stack_sample_hz_changed(value) -> None:
+    from .observability import stacks as _obs_stacks
+    _obs_stacks.sampler().apply_rate(value)
+
+
+define_flag("stack_sample_hz", 0.0,
+            "Ticks per second of the continuous stack-sampling "
+            "profiler (observability/stacks.py): each tick folds "
+            "every Python thread's stack into a bounded profile "
+            "(collapsed-text + Chrome flame export at /stacks). "
+            "0 (the default) disables sampling; the rate is re-read "
+            "every tick so live set_flags() changes apply. Measured "
+            "self-overhead is exported as "
+            "stack_sampler_overhead_ratio.",
+            on_change=_stack_sample_hz_changed)
+define_flag("stack_profile_max", 512,
+            "Cap on distinct folded stacks the sampling profiler "
+            "keeps (observability/stacks.py): new stacks past the "
+            "cap aggregate into a per-thread [overflow] bucket and "
+            "count stack_profile_dropped_total, so a deep-recursion "
+            "or codegen-heavy workload cannot grow the profile "
+            "unboundedly.")
+define_flag("hang_check_interval_s", 1.0,
+            "Seconds between hang-monitor ticks (observability/"
+            "stacks.py): the monitor watches for a *live* wedge — a "
+            "serving engine whose current step is stalled (engine "
+            "step stamps) or a training heartbeat past "
+            "FLAGS_health_heartbeat_timeout_s — and captures + "
+            "classifies all thread stacks while the hang is in "
+            "progress, recording a hang_diagnosis flight event "
+            "naming the culprit frame. <= 0 disables the monitor.")
 def _compile_cache_dir_changed(value) -> None:
     # apply immediately when set programmatically; env-set values are
     # applied by the entry points (fit / to_static / Predictor) since

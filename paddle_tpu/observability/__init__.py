@@ -30,8 +30,8 @@ import warnings
 from typing import Any, Dict, Optional
 
 from . import (anomaly, fleet, flight, goodput, metrics, recompile,
-               reqtrace, rotation, seqtrace, server, slo, stepprof,
-               trace_agg, tracer, tsdb, xprof)
+               reqtrace, rotation, seqtrace, server, slo, stacks,
+               stepprof, trace_agg, tracer, tsdb, xprof)
 from .anomaly import sentinel as anomaly_sentinel
 from .flight import recorder as flight_recorder
 from .goodput import ledger as goodput_ledger
@@ -46,6 +46,7 @@ from .xprof import cards as program_cards
 __all__ = ["metrics", "tracer", "recompile", "trace_agg", "xprof",
            "anomaly", "server", "goodput", "flight", "rotation",
            "fleet", "reqtrace", "seqtrace", "stepprof", "tsdb", "slo",
+           "stacks",
            "counter", "gauge", "histogram", "registry", "enabled",
            "set_enabled", "span", "export_chrome_trace", "get_tracer",
            "instrumented_jit", "recompile_tracker", "program_cards",
@@ -178,8 +179,9 @@ def reset_all() -> None:
     """Clear metrics, spans, recompile records, program cards, anomaly
     state, the goodput ledger, the flight buffer, the request-span /
     seq-timeline / step-record rings, the fleet aggregator store, the
-    tsdb sample ring (stopping its sampler thread), and the SLO alert
-    engine (tests/new runs)."""
+    tsdb sample ring (stopping its sampler thread), the SLO alert
+    engine, and the hang-doctor plane (stack sampler + monitor
+    stopped, profile cleared) (tests/new runs)."""
     registry().reset()
     get_tracer().reset()
     recompile_tracker().reset()
@@ -194,3 +196,4 @@ def reset_all() -> None:
     tsdb.stop()
     tsdb.ring().reset()
     slo.engine().reset()
+    stacks.reset()

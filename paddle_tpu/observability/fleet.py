@@ -70,7 +70,8 @@ __all__ = ["FleetReporter", "FleetAggregator", "aggregator",
            "start_reporter", "stop_reporter", "maybe_start_reporter",
            "local_snapshot", "merge_metric_snapshots",
            "merged_prometheus_text", "fleet_view", "fleet_goodput",
-           "fleet_health", "fleet_alerts", "default_host_id"]
+           "fleet_health", "fleet_alerts", "fleet_stacks",
+           "default_host_id"]
 
 # env names the launcher uses for discovery (distributed/launch.py)
 AGGREGATOR_ENV = "PT_FLEET_AGGREGATOR"
@@ -241,10 +242,14 @@ class FleetAggregator:
         self._lock = threading.Lock()
         self._hosts: Dict[str, Dict[str, Any]] = {}  # guarded-by: self._lock
 
-    def ingest(self, snapshot: Dict[str, Any]) -> str:
+    def ingest(self, snapshot: Dict[str, Any],
+               peer: Optional[str] = None) -> str:
         """Store one pushed snapshot; returns the host id it was filed
         under. Malformed bodies raise ValueError (the HTTP handler
-        answers 400)."""
+        answers 400). ``peer`` is the pushing socket's source IP (the
+        HTTP handler passes it): together with the snapshot's exporter
+        ``port`` it gives fan-out endpoints (/fleet/stacks) a dialable
+        address even though host ids are display labels."""
         if not isinstance(snapshot, dict) or "host" not in snapshot:
             raise ValueError("fleet push body must be a JSON object "
                              "with a 'host' field")
@@ -252,6 +257,8 @@ class FleetAggregator:
         entry = dict(snapshot)
         entry["received_unix"] = time.time()
         entry["received_mono"] = time.monotonic()
+        if peer:
+            entry["peer_ip"] = str(peer)
         with self._lock:
             known = host in self._hosts
             self._hosts[host] = entry
@@ -426,6 +433,47 @@ def fleet_alerts() -> Dict[str, Any]:
             "stale_after_s": stale_after,
             "stale_hosts": stale_hosts,
             "slos": slos}
+
+
+def fleet_stacks(top_n: int = 16,
+                 timeout_s: float = 2.0) -> Dict[str, Any]:
+    """The /fleet/stacks body: fan the live ``GET /stacks`` question
+    out to every registered worker and merge the answers.
+
+    Unlike the other fleet views this is a *pull*, not a merge of
+    pushed state — stacks must be captured at ask-time to be worth
+    anything, and a wedged worker's push loop may itself be stuck
+    while its exporter thread still answers. Each worker is dialed at
+    its push source IP (recorded at ingest) + its pushed exporter
+    port with a short timeout; a worker that cannot be reached
+    degrades to a per-host ``error`` entry instead of failing the
+    endpoint."""
+    import urllib.request
+    hosts: Dict[str, Any] = {}
+    for host, entry in sorted(aggregator().hosts().items()):
+        port = entry.get("port") or 0
+        ip = entry.get("peer_ip") or "127.0.0.1"
+        rec: Dict[str, Any] = {"port": port, "ip": ip,
+                               "error": None, "stacks": None}
+        try:
+            port = int(port)
+        except (TypeError, ValueError):
+            port = 0
+        if port <= 0:
+            rec["error"] = "no exporter port in last push"
+            hosts[host] = rec
+            continue
+        try:
+            with urllib.request.urlopen(
+                    f"http://{ip}:{port}/stacks?n={int(top_n)}",
+                    timeout=timeout_s) as r:
+                rec["stacks"] = json.loads(r.read())
+        except Exception as e:  # noqa: BLE001 — degrade per host
+            rec["error"] = f"{type(e).__name__}: {e}"
+        hosts[host] = rec
+    return {"unix_time": time.time(),
+            "n_hosts": len(hosts),
+            "hosts": hosts}
 
 
 def _straggler_counts(metrics_snap: Dict[str, Any]) -> float:

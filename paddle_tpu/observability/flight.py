@@ -122,6 +122,17 @@ class FlightRecorder:
             snap["goodput"] = _goodput.ledger().snapshot()
         except Exception:  # noqa: BLE001 — a dump must never raise
             snap = {"metrics": {}}
+        try:
+            # the SLO verdict + tsdb ring state must survive a crash
+            # the same way the registry does (the alert that was
+            # firing when the process died is the postmortem headline)
+            from . import slo as _slo
+            from . import tsdb as _tsdb
+            snap["alerts"] = _slo.engine().alerts_view()
+            snap["tsdb"] = _tsdb.ring().stats()
+        # ptlint: disable=silent-failure -- a dump must never raise; the final record simply ships without the SLO section
+        except Exception:  # noqa: BLE001
+            pass
         final = {"kind": "final_metrics", "ts_unix": time.time()}
         final.update(snap)
         try:
@@ -158,6 +169,14 @@ class FlightRecorder:
 
     def _on_signal(self, signum, frame) -> None:
         self.record("signal", force=True, signum=int(signum))
+        try:
+            # all-thread stacks ride the fatal dump: the last question
+            # a postmortem asks is "what was every thread executing"
+            from . import stacks as _stacks
+            _stacks.dump_to_flight(f"signal:{int(signum)}")
+        # ptlint: disable=silent-failure -- the dump itself must proceed even if stack capture breaks mid-death
+        except Exception:  # noqa: BLE001
+            pass
         self.dump(f"signal:{int(signum)}")
         prev = self._prev_handlers.get(signum)
         # restore whatever was there and re-deliver, so the process

@@ -42,6 +42,12 @@ Endpoints:
   the last N sealed records plus the LIVE in-flight step per engine
   (begin stamps + current phase — a wedged step is visible here
   while it hangs).
+- ``/stacks?n=&format=`` — instant all-thread stack dump + the
+  sampling profiler's state (observability/stacks.py). **Not gated on
+  FLAGS_enable_metrics**: wedge forensics must answer while a process
+  hangs, flags notwithstanding. ``format=collapsed`` returns the
+  folded-stack profile as text, ``format=flame`` the Chrome
+  ``traceEvents`` flame view (Perfetto-loadable).
 - ``/fleet`` (+ ``/fleet/goodput``, ``/fleet/health``,
   ``/fleet/alerts``, and the worker-facing ``POST /fleet/push``) —
   the cross-host federation plane (observability/fleet.py): any
@@ -68,6 +74,7 @@ from __future__ import annotations
 
 import json
 import logging
+import sys
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -82,6 +89,7 @@ from . import recompile as _recompile
 from . import reqtrace as _reqtrace
 from . import seqtrace as _seqtrace
 from . import slo as _slo
+from . import stacks as _stacks
 from . import stepprof as _stepprof
 from . import tracer as _tracer
 from . import tsdb as _tsdb
@@ -174,10 +182,37 @@ def _serving_health() -> Optional[Dict[str, Any]]:
     return snap if snap.get("engines") else None
 
 
+def _flags_snapshot() -> Dict[str, Any]:
+    try:
+        from ..flags import GLOBAL_FLAGS
+        return {n: GLOBAL_FLAGS.get(n) for n in GLOBAL_FLAGS.names()}
+    except Exception:  # noqa: BLE001 — telemetry must not raise
+        return {}
+
+
+def _versions() -> Dict[str, Any]:
+    out: Dict[str, Any] = {"python": sys.version.split()[0]}
+    try:
+        import jax
+        out["jax"] = getattr(jax, "__version__", None)
+    # ptlint: disable=silent-failure -- version probing only; a backend that cannot even import is visible everywhere else
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from .. import __version__ as _pt_version
+        out["paddle_tpu"] = _pt_version
+    # ptlint: disable=silent-failure -- version attribute is optional metadata
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
 def _varz() -> Dict[str, Any]:
     from . import device_memory_stats
     return {
         "unix_time": time.time(),
+        "versions": _versions(),
+        "flags": _flags_snapshot(),
         "metrics": _metrics.registry().snapshot(),
         "recompile": _recompile.tracker().snapshot(),
         "programs": _xprof.cards().snapshot(),
@@ -325,6 +360,23 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, {"capacity": pr.capacity,
                                       "live": pr.live(),
                                       "steps": pr.recent(n)})
+            elif url.path == "/stacks":
+                q = parse_qs(url.query)
+                fmt = q.get("format", [""])[0]
+                if fmt == "collapsed":
+                    self._send(200, _stacks.collapsed_text().encode(),
+                               "text/plain")
+                elif fmt == "flame":
+                    self._send_json(200, _stacks.flame_trace())
+                else:
+                    try:
+                        n = int(q.get("n", ["0"])[0]) \
+                            or _stacks.DEFAULT_TOP_N
+                    except ValueError:
+                        n = _stacks.DEFAULT_TOP_N
+                    self._send_json(200, _stacks.stacks_view(n))
+            elif url.path == "/fleet/stacks":
+                self._send_json(200, _fleet.fleet_stacks())
             elif url.path == "/fleet":
                 q = parse_qs(url.query)
                 if q.get("format", [""])[0] == "json":
@@ -348,8 +400,10 @@ class _Handler(BaseHTTPRequestHandler):
                            b"/healthz /varz /trace?ms=N /goodput "
                            b"/alerts /slo /flight "
                            b"/requests?n=N /llm/seqs?n=N&trace_id=T "
-                           b"/llm/steps?n=N /fleet?name=P /fleet/goodput "
-                           b"/fleet/health /fleet/alerts\n",
+                           b"/llm/steps?n=N /stacks?format=F "
+                           b"/fleet?name=P /fleet/goodput "
+                           b"/fleet/health /fleet/alerts "
+                           b"/fleet/stacks\n",
                            "text/plain")
             else:
                 self._send(404, b"not found\n", "text/plain")
@@ -380,7 +434,10 @@ class _Handler(BaseHTTPRequestHandler):
             body = self.rfile.read(n)
             try:
                 snapshot = json.loads(body)
-                host = _fleet.aggregator().ingest(snapshot)
+                # peer IP gives the stacks fan-out a dialable address
+                # even when PT_FLEET_HOST is a hostname:rank label
+                host = _fleet.aggregator().ingest(
+                    snapshot, peer=self.client_address[0])
             except (ValueError, TypeError) as e:
                 self._send_json(400, {"error": f"bad fleet push: {e}"})
                 return
@@ -483,6 +540,12 @@ def maybe_start() -> Optional[ObservabilityServer]:
         _tsdb.start()
     except Exception:  # noqa: BLE001 — judgment layer must not break fit
         _log.exception("tsdb sampler failed to start")
+    try:
+        # hang-doctor plane: stack sampler (flag-gated), live wedge
+        # monitor, and the SIGUSR2 dump handler ride the same lifecycle
+        _stacks.maybe_start()
+    except Exception:  # noqa: BLE001 — forensics must not break fit
+        _log.exception("hang doctor failed to start")
     return srv
 
 
@@ -498,6 +561,11 @@ def self_test() -> int:
     try:
         _metrics.counter("selftest_http_total", always=True).inc(3)
         _metrics.gauge(HEARTBEAT_GAUGE, always=True).set(time.time())
+        # the port gauge normally comes from start(); /fleet/stacks
+        # dials back through the pushed port, so set it here too
+        _metrics.gauge("observability_server_port",
+                       "TCP port of the live observability HTTP "
+                       "exporter", always=True).set(float(srv.port))
         with _tracer.tracer().span("selftest/http", force=True):
             time.sleep(0.001)
 
@@ -577,6 +645,26 @@ def self_test() -> int:
             r["step"] == 4 for r in st["steps"]), text
         assert any(d["step"] == 5 and d["phase"] == "prefill"
                    and "age_s" in d for d in st["live"]), text
+        # hang-doctor plane: the live dump always answers, the sampled
+        # profile appears once the sampler ticks, and both export
+        # shapes parse
+        from ..flags import set_flags as _set_flags
+        _set_flags({"stack_sample_hz": 200.0})
+        try:
+            time.sleep(0.05)
+            code, text = fetch("/stacks")
+            sv = json.loads(text)
+            assert code == 200 and any(
+                t["name"] == "MainThread" for t in sv["threads"]), text
+            assert sv["sampler"]["running"], text
+            code, text = fetch("/stacks?format=collapsed")
+            assert code == 200 and "pt-observability-http" in text, text
+            code, text = fetch("/stacks?format=flame")
+            fl2 = json.loads(text)
+            assert code == 200 and any(
+                e.get("ph") == "X" for e in fl2["traceEvents"]), text
+        finally:
+            _set_flags({"stack_sample_hz": 0.0})
         # fleet plane: push one snapshot to ourselves, read it back
         body = json.dumps(_fleet.local_snapshot("selftest-host"),
                           default=str).encode()
@@ -602,6 +690,15 @@ def self_test() -> int:
         assert code == 200 and fa["worst_state"] == "inactive", text
         assert "serving_availability" in fa["slos"] and "selftest-host" \
             in fa["slos"]["serving_availability"]["hosts"], text
+        # /fleet/stacks fans back out to our own /stacks via the
+        # recorded peer IP + pushed port
+        code, text = fetch("/fleet/stacks")
+        fs = json.loads(text)
+        assert code == 200 and "selftest-host" in fs["hosts"], text
+        worker = fs["hosts"]["selftest-host"]
+        assert worker.get("error") is None, text
+        assert any(t["name"] == "MainThread"
+                   for t in worker["stacks"]["threads"]), text
     finally:
         srv.stop()
         _metrics.set_enabled(False)
@@ -612,6 +709,7 @@ def self_test() -> int:
         _tsdb.stop()
         _tsdb.ring().reset()
         _slo.engine().reset()
+        _stacks.reset()
     print("self-test OK")
     return 0
 

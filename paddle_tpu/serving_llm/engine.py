@@ -966,6 +966,15 @@ class LLMEngine:
             _flight.record("llm_engine_stalled", force=True,
                            step_s=round(dt, 4),
                            ewma_s=round(ewma, 4), factor=factor)
+            # hang doctor: capture + classify thread stacks for the
+            # post-hoc record (the live capture mid-wedge is the hang
+            # monitor's job — this path runs after the step returned).
+            # Debounced per source inside the doctor; never raises.
+            from ..observability import stacks as _stacks
+            _stacks.doctor().on_stall(
+                "serving_step",
+                detail={"step_s": round(dt, 4),
+                        "ewma_s": round(ewma, 4), "factor": factor})
             from .. import observability as obs
             if obs.enabled():
                 obs.counter("llm_engine_stalled_total",

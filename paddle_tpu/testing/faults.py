@@ -275,9 +275,7 @@ class FaultRegistry:
               step: Optional[int]) -> None:
         _note(point, s, step)
         if s.sleep is not None:
-            # latency fault: delay, then let the call site proceed
-            import time
-            time.sleep(s.sleep / 1e3)
+            _injected_wedge_sleep(s.sleep)
             return
         where = f"fault injected at {point!r}" + (
             f" (step {step})" if step is not None else "")
@@ -292,6 +290,16 @@ class FaultRegistry:
             os.kill(os.getpid(), signal.SIGTERM)
             return
         raise RuntimeError(where)
+
+
+def _injected_wedge_sleep(ms: float) -> None:
+    """The ``sleep=MS`` latency action: delay, then let the call site
+    proceed. A dedicated function so an injected wedge has a stable,
+    nameable stack frame — the hang doctor's diagnosis (and the
+    ``hang_doctor`` chaos drill's assertion) points here, at
+    ``faults.py:_injected_wedge_sleep``, when the stall was ours."""
+    import time
+    time.sleep(ms / 1e3)
 
 
 def _note(point: str, s: FaultSpec, step: Optional[int]) -> None:

@@ -1,0 +1,318 @@
+"""Hang doctor tests: instant stack capture + wedge classification,
+the bounded sampling profiler, /stacks availability with metrics OFF,
+the SIGUSR2 dump round-trip, post-hoc diagnosis suppression, the SLO
+snapshot riding flight-recorder finals, and the tools/postmortem.py
+CLI self-test.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import stacks
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def stacks_clean():
+    try:
+        yield
+    finally:
+        pt.set_flags({"enable_metrics": False, "stack_sample_hz": 0.0,
+                      "trace_dir": ""})
+        obs.reset_all()
+
+
+# ---------------------------------------------------------------------------
+# capture + classification
+# ---------------------------------------------------------------------------
+
+def test_capture_sees_current_threads(stacks_clean):
+    recs = stacks.capture(top_n=8)
+    by_name = {r["name"]: r for r in recs}
+    assert "MainThread" in by_name
+    main = by_name["MainThread"]
+    assert main["daemon"] is False
+    assert 1 <= len(main["frames"]) <= 8
+    # innermost frame of the capturing thread is capture() itself
+    assert main["frames"][0].endswith(":capture")
+    # internal raw frames never leave the process
+    assert all("_frames_raw" not in t
+               for t in stacks._public(recs))
+
+
+def test_classify_lock_and_io_wedges(stacks_clean, tmp_path):
+    # classification reads source lines through linecache, so the
+    # wedge module must live in a real file
+    mod = tmp_path / "wedge_mod.py"
+    mod.write_text(textwrap.dedent("""
+        import threading, time
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._data = 0  # guarded-by: self._lock
+
+            def use(self, started, release):
+                started.set()
+                with self._lock:
+                    self._data += 1
+                release.wait()
+
+        def sleeper(started, release):
+            started.set()
+            while not release.is_set():
+                time.sleep(0.05)
+    """))
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("wedge_mod",
+                                                  str(mod))
+    wedge_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(wedge_mod)
+
+    box = wedge_mod.Box()
+    release = threading.Event()
+    started_l = threading.Event()
+    started_s = threading.Event()
+    box._lock.acquire()  # make the lock path contended
+    t_lock = threading.Thread(target=box.use,
+                              args=(started_l, release),
+                              name="t-lock", daemon=True)
+    t_io = threading.Thread(target=wedge_mod.sleeper,
+                            args=(started_s, release),
+                            name="t-io", daemon=True)
+    t_lock.start()
+    t_io.start()
+    try:
+        assert started_l.wait(5) and started_s.wait(5)
+        deadline = time.monotonic() + 5
+        lock_rec = io_rec = None
+        while time.monotonic() < deadline:
+            by_name = {r["name"]: r for r in stacks.capture()}
+            lock_rec = by_name.get("t-lock")
+            io_rec = by_name.get("t-io")
+            if lock_rec and io_rec \
+                    and lock_rec["state"] == "blocked_on_lock" \
+                    and io_rec["state"] == "blocked_in_io":
+                break
+            time.sleep(0.02)
+        assert lock_rec["state"] == "blocked_on_lock", lock_rec
+        assert lock_rec["lock"] == "self._lock", lock_rec
+        # the guarded-by annotation names what the lock protects
+        assert lock_rec["guards"] == ["_data"], lock_rec
+        assert io_rec["state"] == "blocked_in_io", io_rec
+        assert "time.sleep" in io_rec["source_line"], io_rec
+    finally:
+        box._lock.release()
+        release.set()
+        t_lock.join(5)
+        t_io.join(5)
+
+
+# ---------------------------------------------------------------------------
+# sampling profiler
+# ---------------------------------------------------------------------------
+
+def test_sampler_profile_is_bounded(stacks_clean):
+    pt.set_flags({"enable_metrics": True, "stack_profile_max": 8})
+    stop = threading.Event()
+
+    def vary(n):
+        if n > 0:
+            vary(n - 1)
+        else:
+            time.sleep(0.003)
+
+    def churn():
+        # every recursion depth folds to a distinct stack, so this
+        # thread alone produces far more than 8 unique keys
+        while not stop.is_set():
+            for depth in range(30):
+                vary(depth)
+
+    t = threading.Thread(target=churn, name="t-churn", daemon=True)
+    t.start()
+    # the on_change hook starts the sampler the moment the rate flips
+    pt.set_flags({"stack_sample_hz": 200.0})
+    try:
+        assert stacks.sampler().running()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            st = stacks.sampler().status()
+            if st["dropped_total"] > 0:
+                break
+            time.sleep(0.05)
+        st = stacks.sampler().status()
+        assert st["samples_total"] > 0
+        assert st["dropped_total"] > 0, st
+        prof = stacks.sampler().profile()
+        real = [k for k in prof if k[1] != stacks._OVERFLOW_KEY]
+        assert len(real) <= 8, len(real)
+        # overflow aggregates instead of growing the dict
+        assert any(k[1] == stacks._OVERFLOW_KEY for k in prof)
+        # exports stay parseable under overflow
+        text = stacks.collapsed_text()
+        assert any(line.rsplit(" ", 1)[1].isdigit()
+                   for line in text.splitlines())
+        flame = stacks.flame_trace()
+        assert any(e.get("ph") == "X" for e in flame["traceEvents"])
+    finally:
+        stop.set()
+        pt.set_flags({"stack_sample_hz": 0.0})
+        t.join(5)
+    assert not stacks.sampler().running()
+
+
+def test_sampler_overhead_stays_low(stacks_clean):
+    pt.set_flags({"enable_metrics": True, "stack_sample_hz": 50.0})
+    try:
+        time.sleep(1.0)
+        ratio = stacks.sampler().overhead_ratio()
+        assert ratio is not None
+        # acceptance bar: < 2% of wall time at a modest rate
+        assert ratio < 0.02, ratio
+    finally:
+        pt.set_flags({"stack_sample_hz": 0.0})
+
+
+# ---------------------------------------------------------------------------
+# endpoint availability (metrics OFF — forensics must not need flags)
+# ---------------------------------------------------------------------------
+
+def test_stacks_endpoint_serves_with_metrics_off(stacks_clean):
+    import urllib.request
+
+    from paddle_tpu.observability import server as obs_server
+
+    assert not obs.enabled()
+    srv = obs_server.ObservabilityServer(0)
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        with urllib.request.urlopen(base + "/stacks?n=4",
+                                    timeout=10) as r:
+            assert r.status == 200
+            body = json.loads(r.read().decode())
+        names = [t["name"] for t in body["threads"]]
+        assert "MainThread" in names
+        assert all(len(t["frames"]) <= 4 for t in body["threads"])
+        assert body["sampler"]["running"] is False
+        with urllib.request.urlopen(
+                base + "/stacks?format=collapsed", timeout=10) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+        with urllib.request.urlopen(
+                base + "/stacks?format=flame", timeout=10) as r:
+            flame = json.loads(r.read().decode())
+            assert "traceEvents" in flame
+        # unknown paths stay 404 — /stacks being flag-free must not
+        # turn the exporter into a catch-all
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/stacks/nope", timeout=10)
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# signal dump round-trip
+# ---------------------------------------------------------------------------
+
+_SIGUSR2_SCRIPT = r"""
+import json, os, signal, sys, time
+import paddle_tpu as pt
+from paddle_tpu.observability import flight, stacks
+
+trace_dir = sys.argv[1]
+pt.set_flags({"trace_dir": trace_dir})
+stacks.install_signal_dump()
+os.kill(os.getpid(), signal.SIGUSR2)
+deadline = time.monotonic() + 10
+path = None
+while time.monotonic() < deadline and path is None:
+    hits = [f for f in os.listdir(trace_dir)
+            if f.startswith("flight_")]
+    if hits:
+        path = os.path.join(trace_dir, hits[0])
+    time.sleep(0.05)
+print("survived")        # the handler must not kill the process
+lines = [json.loads(l) for l in open(path)]
+kinds = [l["kind"] for l in lines]
+assert kinds[0] == "flight_header", kinds
+assert "thread_stacks" in kinds[1:-1], kinds
+ev = next(l for l in lines if l["kind"] == "thread_stacks")
+assert ev["reason"] == "sigusr2", ev
+assert any(t["name"] == "MainThread" for t in ev["threads"])
+assert lines[-1]["kind"] == "final_metrics"
+# PR satellite: finals carry the SLO engine + tsdb snapshot
+assert "alerts" in lines[-1] and "tsdb" in lines[-1], lines[-1].keys()
+print("sigusr2 roundtrip OK")
+"""
+
+
+def test_sigusr2_dumps_stacks_to_flight(stacks_clean, tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "-c", _SIGUSR2_SCRIPT, str(tmp_path)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "survived" in proc.stdout
+    assert "sigusr2 roundtrip OK" in proc.stdout
+
+
+def test_flight_final_carries_slo_snapshot(stacks_clean, tmp_path):
+    pt.set_flags({"enable_metrics": True,
+                  "trace_dir": str(tmp_path)})
+    rec = obs.flight.FlightRecorder(capacity=16)
+    rec.record("step", step=1)
+    path = rec.dump("manual", str(tmp_path))
+    lines = [json.loads(l) for l in open(path)]
+    final = lines[-1]
+    assert final["kind"] == "final_metrics"
+    assert "alerts" in final and "worst_state" in final["alerts"]
+    assert "tsdb" in final
+
+
+# ---------------------------------------------------------------------------
+# hang doctor
+# ---------------------------------------------------------------------------
+
+def test_hang_doctor_debounce_and_post_hoc_suppression(stacks_clean):
+    doc = stacks.doctor()
+    doc.reset()
+    d1 = doc.diagnose("serving")
+    assert d1 is not None and d1["culprit"] is not None
+    # same source inside the window: debounced
+    assert doc.diagnose("serving") is None
+    # the post-hoc watchdog record of the episode the live monitor
+    # already diagnosed is suppressed too — its capture runs after
+    # the step returned and can only show the doctor itself
+    assert doc.diagnose("serving_step") is None
+    assert doc.diagnose("serving_step", force=True) is not None
+    doc.reset()
+    # with no live diagnosis, the post-hoc path stands alone
+    assert doc.diagnose("serving_step") is not None
+
+
+# ---------------------------------------------------------------------------
+# postmortem CLI
+# ---------------------------------------------------------------------------
+
+def test_postmortem_cli_self_test():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "postmortem.py"),
+         "--self-test"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, \
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    assert "self-test OK" in proc.stdout

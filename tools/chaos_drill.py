@@ -53,6 +53,13 @@ the recovery contract from docs/fault_tolerance.md:
                      spec_window{rollback}, serving_report attributes
                      its gaps to those causes with exclusive buckets,
                      and ptlint stays green on the flight-deck code.
+  hang_doctor      — an injected decode wedge (faults sleep inside the
+                     engine step) is diagnosed LIVE: /stacks serves
+                     during the stall, the hang monitor's
+                     hang_diagnosis flight event names the injected
+                     frame (faults.py:_injected_wedge_sleep), and a
+                     postmortem bundle pulled from the wedged process
+                     renders a report attributing the stall.
   slo_burn_alert   — an engineered overload (slow prefill fault +
                      admission-watermark flood) burns the
                      serving_availability SLO: the fast multi-window
@@ -677,6 +684,190 @@ def drill_slo_burn_alert(tmp):
     return (f"availability burned at {res['fast_burn']:.0f}x budget "
             f"(fast pair over 14.4), flight-recorded, resolved after "
             f"load stopped; pool clean")
+
+
+_HANG_DOCTOR = r"""
+import json, os, subprocess, sys, threading, time, urllib.request
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu.models import GPTLanguageModel
+from paddle_tpu.observability import server as obs_server
+from paddle_tpu.observability import stacks as stacks_mod
+from paddle_tpu.serving_llm import LLMEngine
+from paddle_tpu.sysconfig import enable_compile_cache
+
+enable_compile_cache()
+out, bundle, root = sys.argv[1], sys.argv[2], sys.argv[3]
+pt.set_flags({"enable_metrics": True, "stack_sample_hz": 50.0,
+              "hang_check_interval_s": 0.1, "llm_stall_factor": 4.0,
+              "fault_spec": ""})
+srv = obs_server.start(0)
+stacks_mod.maybe_start()  # sampler + hang monitor + SIGUSR2 dump
+base = "http://127.0.0.1:%d" % srv.port
+
+model = GPTLanguageModel()
+engine = LLMEngine(model, block_size=4, pool_blocks=64)
+
+# baseline: identical requests so run 2+ reuse run 1's compiled
+# shapes — the step-time EWMA the live stall judgement compares
+# against must reflect warm steps, not jit compiles
+for _ in range(3):
+    engine.add_request([5, 6, 7], max_new_tokens=8)
+    while engine.active():
+        engine.step()
+
+# wedge: the 3rd decode hit of the NEXT request parks inside
+# faults._injected_wedge_sleep for 3s — a live, diagnosable stall
+pt.set_flags({"fault_spec": "llm_decode:sleep=3000:at=3"})
+engine.add_request([5, 6, 7], max_new_tokens=8)
+
+def step_loop():
+    while engine.active():
+        engine.step()
+
+stepper = threading.Thread(target=step_loop, name="llm-stepper",
+                           daemon=False)
+
+stacks_codes = []
+wedged_rec = None
+healthz_stalled = False
+
+def http_json(path):
+    # /healthz answers 503 while the engine is stalled — that IS the
+    # signal, so read HTTPError bodies instead of treating them as
+    # connection failures
+    try:
+        with urllib.request.urlopen(base + path, timeout=5) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+stepper.start()
+deadline = time.monotonic() + 60.0
+while stepper.is_alive() and time.monotonic() < deadline:
+    try:
+        code, view = http_json("/stacks?n=16")
+    except Exception:
+        time.sleep(0.05)
+        continue
+    stacks_codes.append(code)
+    for t in view.get("threads", []):
+        if t["name"] == "llm-stepper" and any(
+                "_injected_wedge_sleep" in f for f in t["frames"]):
+            if wedged_rec is None:
+                wedged_rec = t
+            try:
+                _, h = http_json("/healthz")
+                for e in (h.get("serving") or {}).get("engines", []):
+                    healthz_stalled = healthz_stalled or e["stalled"]
+            except Exception:
+                pass
+    time.sleep(0.05)
+stepper.join(60.0)
+
+# the monitor diagnoses DURING the wedge; give its 0.1s tick a beat
+diag = None
+deadline = time.monotonic() + 10.0
+while diag is None and time.monotonic() < deadline:
+    evs = [e for e in obs.flight.recorder().events()
+           if e.get("kind") == "hang_diagnosis"
+           and e.get("source") == "serving"]
+    diag = evs[-1] if evs else None
+    time.sleep(0.1)
+
+# operator flow: postmortem bundle pulled from the live process, then
+# rendered offline — the report must attribute the stall by itself
+pm = os.path.join(root, "tools", "postmortem.py")
+env = dict(os.environ); env["JAX_PLATFORMS"] = "cpu"
+collect = subprocess.run(
+    [sys.executable, pm, "collect", "--url", base, "--out", bundle],
+    capture_output=True, text=True, timeout=120, env=env)
+render = subprocess.run(
+    [sys.executable, pm, "render", bundle],
+    capture_output=True, text=True, timeout=120, env=env)
+
+status = stacks_mod.sampler().status()
+audit_ok = True
+try:
+    engine.allocator.check()
+    engine._audit()
+except Exception:
+    audit_ok = False
+res = {
+    "stacks_codes": sorted(set(stacks_codes)),
+    "n_polls": len(stacks_codes),
+    "wedged_state": (wedged_rec or {}).get("state"),
+    "wedged_frames": (wedged_rec or {}).get("frames", []),
+    "healthz_stalled": healthz_stalled,
+    "diagnosis": diag,
+    "stalls_total": engine.stalls_total,
+    "collect_rc": collect.returncode,
+    "collect_err": collect.stderr[-800:],
+    "render_rc": render.returncode,
+    "render_out": render.stdout,
+    "overhead_ratio": status.get("overhead_ratio"),
+    "samples": status.get("samples"),
+    "kv_used_after": engine.allocator.num_used,
+    "audit_ok": audit_ok,
+}
+srv.stop()
+json.dump(res, open(out, "w"))
+"""
+
+
+def drill_hang_doctor(tmp):
+    """An injected decode wedge (faults sleep inside the engine step)
+    is caught LIVE: /stacks serves during the stall, the hang monitor
+    records a hang_diagnosis flight event whose culprit frame names
+    faults.py:_injected_wedge_sleep, and a postmortem bundle pulled
+    from the wedged process renders a report attributing the stall."""
+    script = os.path.join(tmp, "hang_doctor.py")
+    with open(script, "w") as f:
+        f.write(_HANG_DOCTOR)
+    out = os.path.join(tmp, "hang_doctor.json")
+    bundle = os.path.join(tmp, "hang_bundle")
+    proc = subprocess.run(
+        [sys.executable, script, out, bundle, ROOT], env=_env(tmp),
+        capture_output=True, text=True, timeout=420)
+    _check(proc.returncode == 0,
+           f"hang-doctor run died rc={proc.returncode}\n{proc.stderr}")
+    res = json.load(open(out))
+    _check(res["stacks_codes"] == [200] and res["n_polls"] >= 1,
+           f"/stacks did not serve 200 during the wedge: {res}")
+    _check(res["wedged_state"] == "blocked_in_io",
+           f"wedged stepper not classified blocked_in_io: "
+           f"{res['wedged_state']} {res['wedged_frames']}")
+    _check(any("_injected_wedge_sleep" in fr
+               for fr in res["wedged_frames"]),
+           f"live /stacks never showed the injected frame: {res}")
+    _check(res["healthz_stalled"],
+           f"/healthz never reported the engine stalled mid-wedge: "
+           f"{res}")
+    diag = res["diagnosis"]
+    _check(diag is not None,
+           f"no hang_diagnosis flight event from the monitor: {res}")
+    culprit = diag.get("culprit") or {}
+    _check(culprit.get("thread") == "llm-stepper",
+           f"diagnosis blamed the wrong thread: {culprit}")
+    _check(any("_injected_wedge_sleep" in fr
+               for fr in culprit.get("frames", [])),
+           f"diagnosis culprit does not name the injected frame: "
+           f"{culprit}")
+    _check(res["collect_rc"] == 0,
+           f"postmortem collect failed: {res['collect_err']}")
+    _check(res["render_rc"] == 0 and "CULPRIT" in res["render_out"]
+           and "_injected_wedge_sleep" in res["render_out"],
+           f"postmortem render did not attribute the stall:\n"
+           f"{res['render_out'][:2000]}")
+    _check(res["overhead_ratio"] is not None
+           and res["overhead_ratio"] < 0.02,
+           f"sampler overhead {res['overhead_ratio']} >= 2%: {res}")
+    _check(res["kv_used_after"] == 0 and res["audit_ok"],
+           f"engine came out dirty after the wedge: {res}")
+    return (f"live wedge diagnosed (culprit "
+            f"{culprit.get('frame')}), /stacks 200 x{res['n_polls']} "
+            f"during stall, postmortem report attributes it, sampler "
+            f"overhead {res['overhead_ratio']:.1%}")
 
 
 _LLM_DRAIN_SERVER = r"""
@@ -1321,6 +1512,7 @@ DRILLS = {
     "stream_disconnect": drill_stream_disconnect,
     "llm_overload_shed": drill_llm_overload_shed,
     "slo_burn_alert": drill_slo_burn_alert,
+    "hang_doctor": drill_hang_doctor,
     "llm_drain_sigterm": drill_llm_drain_sigterm,
     "llm_decode_error": drill_llm_decode_error,
     "llm_prefix_cow_leak": drill_llm_prefix_cow_leak,

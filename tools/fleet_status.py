@@ -8,8 +8,15 @@ health, exporter port, goodput headline, worst badput bucket, and
 straggler events.
 
 Usage:
-    python tools/fleet_status.py HOST:PORT        # rank-0 exporter
-    python tools/fleet_status.py --self-test      # no-TPU CI drill
+    python tools/fleet_status.py HOST:PORT            # rank-0 exporter
+    python tools/fleet_status.py HOST:PORT --stacks   # + live top frame
+    python tools/fleet_status.py --self-test          # no-TPU CI drill
+
+``--stacks`` adds each worker's *current top frame* beside its health
+row, pulled live through the aggregator's ``/fleet/stacks`` fan-out
+(observability/stacks.py): the most-blocked thread per worker wins
+(blocked_on_lock / blocked_in_collective / blocked_in_io before
+running), so a wedged worker's row shows the wedged frame itself.
 
 ``--self-test`` boots a real 3-process mini-fleet against an in-process
 aggregator and asserts the federation contract end to end: merged
@@ -85,13 +92,39 @@ def _host_alert_states(alerts: Dict[str, Any]) -> Dict[str, str]:
     return worst
 
 
-def render(addr: str) -> int:
+def _host_top_frames(fstacks: Dict[str, Any]) -> Dict[str, str]:
+    """host -> one-line current-frame summary from the /fleet/stacks
+    fan-out: the most-blocked thread wins (a wedge beats an idle
+    accept loop); unreachable workers show the dial error."""
+    out: Dict[str, str] = {}
+    for host, rec in (fstacks.get("hosts") or {}).items():
+        if rec.get("error"):
+            out[host] = f"unreachable: {rec['error']}"[:60]
+            continue
+        threads = (rec.get("stacks") or {}).get("threads", [])
+        if not threads:
+            out[host] = "-"
+            continue
+        blocked = [t for t in threads
+                   if t.get("state", "running") != "running"]
+        pick = blocked[0] if blocked else next(
+            (t for t in threads if t.get("name") == "MainThread"),
+            threads[0])
+        frame = pick.get("frame") or pick.get("top") or "?"
+        out[host] = f"{pick.get('name')}:{pick.get('state')} {frame}"
+    return out
+
+
+def render(addr: str, stacks: bool = False) -> int:
     """Print the fleet table; exit 0 healthy, 1 degraded/unreachable."""
     try:
         _, view = _get(addr, "/fleet?format=json")
         hcode, health = _get(addr, "/fleet/health")
         _, gp = _get(addr, "/fleet/goodput")
         _, alerts = _get(addr, "/fleet/alerts")
+        fstacks: Dict[str, Any] = {}
+        if stacks:
+            _, fstacks = _get(addr, "/fleet/stacks")
     except OSError as e:
         print(f"fleet_status: aggregator {addr} unreachable: {e}",
               file=sys.stderr)
@@ -109,19 +142,25 @@ def render(addr: str) -> int:
     host_alerts = _host_alert_states(alerts)
     cols = ("host", "age_s", "stale", "healthy", "port", "goodput",
             "worst badput", "stragglers", "alerts")
+    top_frames = _host_top_frames(fstacks) if stacks else {}
+    if stacks:
+        cols = cols + ("top frame",)
     rows = []
     for h in hosts:
         hh = health.get("hosts", {}).get(h, {})
         gh = gp.get("hosts", {}).get(h, {})
-        rows.append((h,
-                     f"{hh.get('age_s', float('nan')):.1f}",
-                     "STALE" if hh.get("stale") else "fresh",
-                     "yes" if hh.get("healthy") else "NO",
-                     str(hh.get("port") or "-"),
-                     f"{gh.get('goodput_ratio', 0.0):.1%}",
-                     str(gh.get("worst_badput_bucket") or "-"),
-                     f"{gh.get('straggler_events', 0):.0f}",
-                     host_alerts.get(h, "inactive")))
+        row = (h,
+               f"{hh.get('age_s', float('nan')):.1f}",
+               "STALE" if hh.get("stale") else "fresh",
+               "yes" if hh.get("healthy") else "NO",
+               str(hh.get("port") or "-"),
+               f"{gh.get('goodput_ratio', 0.0):.1%}",
+               str(gh.get("worst_badput_bucket") or "-"),
+               f"{gh.get('straggler_events', 0):.0f}",
+               host_alerts.get(h, "inactive"))
+        if stacks:
+            row = row + (top_frames.get(h, "-"),)
+        rows.append(row)
     widths = [max(len(c), *(len(r[i]) for r in rows)) if rows
               else len(c) for i, c in enumerate(cols)]
     print("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
@@ -294,7 +333,23 @@ def self_test() -> int:
         assert "merge_error" not in view, view.get("merge_error")
         print("w1 SIGKILLed: /fleet/health 503 (w1 stale), merged "
               "/fleet intact")
-        render(addr)
+        # --stacks column: live workers answer the /fleet/stacks
+        # dial-back with real thread dumps; the dead one degrades to
+        # a per-host error instead of poisoning the table
+        code, fstk = _get(addr, "/fleet/stacks")
+        assert code == 200, fstk
+        for live in ("w0", "w2"):
+            rec = fstk["hosts"][live]
+            assert rec.get("error") is None, (live, rec)
+            names = [t["name"] for t in rec["stacks"]["threads"]]
+            assert "MainThread" in names, (live, names)
+        assert fstk["hosts"]["w1"].get("error"), fstk["hosts"]["w1"]
+        tops = _host_top_frames(fstk)
+        assert tops["w0"] and tops["w0"] != "-", tops
+        assert tops["w1"].startswith("unreachable"), tops
+        print("/fleet/stacks: live workers dumped, dead worker "
+              "degraded to error")
+        render(addr, stacks=True)
     finally:
         for p in workers:
             if p.poll() is None:
@@ -317,6 +372,9 @@ def main(argv=None) -> int:
                     help="rank-0 exporter address, host:port")
     ap.add_argument("--watch", type=float, metavar="S", default=0,
                     help="re-render every S seconds")
+    ap.add_argument("--stacks", action="store_true",
+                    help="add each worker's current top frame "
+                         "(live /fleet/stacks fan-out)")
     ap.add_argument("--self-test", action="store_true")
     args = ap.parse_args(argv)
     if args.self_test:
@@ -328,11 +386,11 @@ def main(argv=None) -> int:
         try:
             while True:
                 print("\033[2J\033[H", end="")
-                render(addr)
+                render(addr, stacks=args.stacks)
                 time.sleep(args.watch)
         except KeyboardInterrupt:
             return 0
-    return render(addr)
+    return render(addr, stacks=args.stacks)
 
 
 if __name__ == "__main__":

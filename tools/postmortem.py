@@ -1,0 +1,411 @@
+#!/usr/bin/env python3
+"""postmortem — one-command wedge-forensics bundle + human report.
+
+Collects everything a "why is this process stuck / why did it die"
+investigation needs from a live observability exporter
+(observability/server.py) into a single bundle directory: flags and
+versions (``/varz``), the Prometheus metrics page, the flight-recorder
+ring, the serving flight deck (``/llm/seqs``, ``/llm/steps``,
+``/requests``), the SLO/alert state, goodput, health, and — the hang
+doctor's half (observability/stacks.py) — the instant all-thread stack
+dump plus the sampling profiler's collapsed and Chrome-flame exports.
+
+``--fleet`` additionally pulls the PR 6 federation plane: the merged
+``/fleet`` views and the ``/fleet/stacks`` fan-out (every registered
+worker's live stacks through the aggregator), splitting per-host
+answers into ``fleet/hosts/<host>/``.
+
+``render`` prints the human report: the wedged/culprit thread first
+(the last ``hang_diagnosis`` flight event when one exists, else the
+blocked threads from the live dump), then health, the last flight
+events, the top sampled stacks, and the alert headline. ``render``
+with ``--url`` collects first — one command from wedge to report.
+
+Usage:
+  python tools/postmortem.py collect --url HOST:PORT [--out DIR]
+                                     [--fleet] [--tar]
+  python tools/postmortem.py render  BUNDLE_DIR
+  python tools/postmortem.py [--fleet] render --url HOST:PORT
+  python tools/postmortem.py --self-test
+
+Bundle layout (docs/observability.md, "Hang doctor"):
+  manifest.json  varz.json  metrics.prom  healthz.json  flight.json
+  goodput.json  slo.json  alerts.json  requests.json  llm_seqs.json
+  llm_steps.json  stacks.json  stacks_collapsed.txt  stacks_flame.json
+  fleet/{fleet,health,goodput,alerts,stacks}.json
+  fleet/hosts/<host>/stacks.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:  # runnable from any cwd
+    sys.path.insert(0, ROOT)
+
+# endpoint -> bundle file; .prom keeps the raw exposition text
+_ENDPOINTS = [
+    ("/varz", "varz.json"),
+    ("/metrics", "metrics.prom"),
+    ("/healthz", "healthz.json"),
+    ("/flight", "flight.json"),
+    ("/goodput", "goodput.json"),
+    ("/slo", "slo.json"),
+    ("/alerts", "alerts.json"),
+    ("/requests?n=64", "requests.json"),
+    ("/llm/seqs?n=64", "llm_seqs.json"),
+    ("/llm/steps?n=64", "llm_steps.json"),
+    ("/stacks", "stacks.json"),
+    ("/stacks?format=collapsed", "stacks_collapsed.txt"),
+    ("/stacks?format=flame", "stacks_flame.json"),
+]
+
+_FLEET_ENDPOINTS = [
+    ("/fleet?format=json", "fleet.json"),
+    ("/fleet/health", "health.json"),
+    ("/fleet/goodput", "goodput.json"),
+    ("/fleet/alerts", "alerts.json"),
+    ("/fleet/stacks", "stacks.json"),
+]
+
+
+def _fetch(url: str, timeout_s: float = 10.0):
+    """(status, body_bytes) — non-2xx bodies are still forensics
+    (e.g. a 503 /healthz is exactly what we came for)."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def collect(url: str, out_dir: str, fleet: bool = False,
+            tar: bool = False, quiet: bool = False) -> str:
+    """Pull every endpoint from ``url`` (host:port) into ``out_dir``;
+    returns the bundle path (the .tar.gz path with ``tar=True``). A
+    failing endpoint degrades to an ``<name>.error`` file — a half
+    bundle from a half-dead process beats no bundle."""
+    base = url if "//" in url else f"http://{url}"
+    base = base.rstrip("/")
+    os.makedirs(out_dir, exist_ok=True)
+    manifest = {"created_unix": time.time(), "url": url,
+                "collector_python": sys.version.split()[0],
+                "fleet": fleet, "files": [], "errors": []}
+
+    def grab(path, fname, sub=""):
+        dest_dir = os.path.join(out_dir, sub) if sub else out_dir
+        os.makedirs(dest_dir, exist_ok=True)
+        dest = os.path.join(dest_dir, fname)
+        rel = os.path.join(sub, fname) if sub else fname
+        try:
+            status, body = _fetch(base + path)
+        except Exception as e:  # noqa: BLE001 — degrade per endpoint
+            with open(dest + ".error", "w") as f:
+                f.write(f"{type(e).__name__}: {e}\n")
+            manifest["errors"].append({"path": path,
+                                       "error": f"{type(e).__name__}: {e}"})
+            return None
+        with open(dest, "wb") as f:
+            f.write(body)
+        manifest["files"].append({"file": rel, "path": path,
+                                  "status": status})
+        return body
+
+    for path, fname in _ENDPOINTS:
+        grab(path, fname)
+    if fleet:
+        for path, fname in _FLEET_ENDPOINTS:
+            body = grab(path, fname, sub="fleet")
+            if fname == "stacks.json" and body:
+                try:
+                    hosts = json.loads(body).get("hosts", {})
+                except ValueError:
+                    hosts = {}
+                for host, rec in hosts.items():
+                    safe = "".join(c if c.isalnum() or c in "-_."
+                                   else "_" for c in host)
+                    hdir = os.path.join("fleet", "hosts", safe)
+                    os.makedirs(os.path.join(out_dir, hdir),
+                                exist_ok=True)
+                    with open(os.path.join(out_dir, hdir,
+                                           "stacks.json"), "w") as f:
+                        json.dump(rec, f, indent=1, default=str)
+    # versions of the *observed* process live in varz.json; mirror
+    # them into the manifest for one-file triage
+    try:
+        with open(os.path.join(out_dir, "varz.json")) as f:
+            varz = json.load(f)
+        manifest["versions"] = varz.get("versions")
+        manifest["flags"] = varz.get("flags")
+    except (OSError, ValueError):
+        pass
+    with open(os.path.join(out_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True, default=str)
+    if not quiet:
+        print(f"[postmortem] bundle at {out_dir} "
+              f"({len(manifest['files'])} files, "
+              f"{len(manifest['errors'])} errors)")
+    if tar:
+        archive = shutil.make_archive(out_dir.rstrip("/"), "gztar",
+                                      root_dir=out_dir)
+        if not quiet:
+            print(f"[postmortem] archived to {archive}")
+        return archive
+    return out_dir
+
+
+# ------------------------------------------------------------- render
+
+def _load_json(bundle: str, *parts):
+    try:
+        with open(os.path.join(bundle, *parts)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _fmt_thread(t) -> str:
+    bits = [f"{t.get('name')}: {t.get('state', '?')}"]
+    frame = t.get("frame") or t.get("top")
+    if frame:
+        bits.append(f"at {frame}")
+    if t.get("lock"):
+        bits.append(f"lock={t['lock']}")
+        if t.get("guards"):
+            bits.append(f"guards={','.join(t['guards'])}")
+    if t.get("same_top_s") is not None:
+        bits.append(f"same top frame for {t['same_top_s']}s")
+    return "  ".join(bits)
+
+
+def render(bundle: str, out=None) -> int:
+    """Print the human report; returns 0, or 1 when the path holds no
+    readable bundle."""
+    out = out or sys.stdout
+    manifest = _load_json(bundle, "manifest.json")
+    if manifest is None:
+        print(f"postmortem: no manifest.json under {bundle}",
+              file=sys.stderr)
+        return 1
+    w = out.write
+    w(f"== postmortem: {bundle} ==\n")
+    w(f"collected {time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(manifest.get('created_unix', 0)))}"
+      f" from {manifest.get('url')}\n")
+    versions = manifest.get("versions") or {}
+    if versions:
+        w("versions: " + "  ".join(f"{k}={v}" for k, v
+                                   in sorted(versions.items())) + "\n")
+
+    # -- the wedged thread, first ----------------------------------------
+    flight = _load_json(bundle, "flight.json") or {}
+    events = flight.get("events", [])
+    diag = next((e for e in reversed(events)
+                 if e.get("kind") == "hang_diagnosis"), None)
+    w("\n-- wedged thread --\n")
+    if diag is not None and diag.get("culprit"):
+        c = diag["culprit"]
+        w(f"CULPRIT (hang_diagnosis, source={diag.get('source')}): "
+          f"thread '{c.get('thread')}' {c.get('state')} "
+          f"at {c.get('frame')}\n")
+        if c.get("lock"):
+            w(f"  contended lock: {c['lock']}"
+              + (f" (guards: {', '.join(c['guards'])})"
+                 if c.get("guards") else "") + "\n")
+        for fr in (c.get("frames") or [])[:8]:
+            w(f"    {fr}\n")
+    else:
+        stacks = _load_json(bundle, "stacks.json") or {}
+        blocked = [t for t in stacks.get("threads", [])
+                   if t.get("state", "running") != "running"]
+        if blocked:
+            for t in blocked:
+                w("  " + _fmt_thread(t) + "\n")
+        else:
+            w("  no hang_diagnosis recorded and no blocked threads "
+              "in the live dump\n")
+
+    # -- health ----------------------------------------------------------
+    health = _load_json(bundle, "healthz.json") or {}
+    w("\n-- health --\n")
+    w(f"  status={health.get('status', '?')}"
+      f"  heartbeat_age_s={health.get('heartbeat_age_s')}\n")
+    serving = health.get("serving")
+    if serving:
+        for e in serving.get("engines", []):
+            w(f"  engine: stalled={e.get('stalled')} "
+              f"last_step_age_s={e.get('last_step_age_s')} "
+              f"stalls_total={e.get('stalls_total')}\n")
+
+    # -- last flight events ----------------------------------------------
+    w("\n-- last flight events --\n")
+    for e in events[-12:]:
+        kind = e.get("kind", "?")
+        extras = {k: v for k, v in e.items()
+                  if k not in ("kind", "ts_unix", "threads")}
+        brief = json.dumps(extras, default=str)
+        w(f"  {kind:24s} {brief[:120]}\n")
+    if not events:
+        w("  (flight ring empty)\n")
+
+    # -- top sampled stacks ----------------------------------------------
+    w("\n-- top sampled stacks --\n")
+    try:
+        with open(os.path.join(bundle, "stacks_collapsed.txt")) as f:
+            lines = [ln for ln in f.read().splitlines() if ln]
+    except OSError:
+        lines = []
+    if lines:
+        def count(ln):
+            try:
+                return int(ln.rsplit(" ", 1)[1])
+            except (IndexError, ValueError):
+                return 0
+        for ln in sorted(lines, key=count, reverse=True)[:8]:
+            w(f"  {ln[:160]}\n")
+    else:
+        w("  (sampler off or no samples)\n")
+
+    # -- alerts ----------------------------------------------------------
+    alerts = _load_json(bundle, "alerts.json") or {}
+    w("\n-- alerts --\n")
+    w(f"  worst_state={alerts.get('worst_state', '?')}\n")
+    for a in alerts.get("alerts", []):
+        if a.get("state") not in (None, "inactive"):
+            w(f"  {a.get('slo')}: {a.get('state')} "
+              f"budget_remaining={a.get('budget_remaining')}\n")
+
+    # -- fleet -----------------------------------------------------------
+    fstacks = _load_json(bundle, "fleet", "stacks.json")
+    if fstacks is not None:
+        w("\n-- fleet stacks --\n")
+        for host, rec in sorted((fstacks.get("hosts") or {}).items()):
+            if rec.get("error"):
+                w(f"  {host}: UNREACHABLE ({rec['error']})\n")
+                continue
+            threads = (rec.get("stacks") or {}).get("threads", [])
+            blocked = [t for t in threads
+                       if t.get("state", "running") != "running"]
+            pick = blocked[0] if blocked else (threads[0] if threads
+                                               else None)
+            w(f"  {host}: " + (_fmt_thread(pick) if pick
+                               else "(no threads)") + "\n")
+    w("\n")
+    return 0
+
+
+# ---------------------------------------------------------- self-test
+
+def self_test() -> int:
+    """No-accelerator CI check: boot an exporter, stage a diagnosable
+    wedge (hang_diagnosis + sampled profile + a pushed fleet
+    snapshot), collect a --fleet bundle over HTTP, render it, and
+    assert the report names the culprit thread."""
+    import threading
+
+    import paddle_tpu as pt
+    from paddle_tpu.observability import fleet as _fleet
+    from paddle_tpu.observability import flight as _flight
+    from paddle_tpu.observability import metrics as _metrics
+    from paddle_tpu.observability import server as _server
+    from paddle_tpu.observability import stacks as _stacks
+
+    _metrics.set_enabled(True)
+    srv = _server.ObservabilityServer(0)
+    tmp = tempfile.mkdtemp(prefix="postmortem_selftest_")
+    try:
+        _metrics.gauge("observability_server_port",
+                       "TCP port of the live observability HTTP "
+                       "exporter", always=True).set(float(srv.port))
+        _flight.record("selftest_step", step=1)
+        # a real blocked thread for capture + diagnosis to find
+        release = threading.Event()
+        t = threading.Thread(target=release.wait, args=(30,),
+                             name="selftest-wedge", daemon=True)
+        t.start()
+        pt.set_flags({"stack_sample_hz": 100.0})
+        time.sleep(0.3)
+        diag = _stacks.doctor().diagnose("manual", force=True)
+        assert diag and diag["culprit"], diag
+        body = json.dumps(_fleet.local_snapshot("selftest-host"),
+                          default=str).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/fleet/push", data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+        bundle = collect(f"127.0.0.1:{srv.port}",
+                         os.path.join(tmp, "bundle"), fleet=True,
+                         quiet=True)
+        for fname in ("manifest.json", "stacks.json", "flight.json",
+                      "metrics.prom", "stacks_collapsed.txt",
+                      os.path.join("fleet", "stacks.json")):
+            assert os.path.exists(os.path.join(bundle, fname)), fname
+        manifest = _load_json(bundle, "manifest.json")
+        assert not manifest["errors"], manifest["errors"]
+        assert manifest.get("flags"), "flags missing from manifest"
+        import io
+        buf = io.StringIO()
+        rc = render(bundle, out=buf)
+        report = buf.getvalue()
+        assert rc == 0
+        assert "CULPRIT" in report, report
+        assert "selftest-host" in report, report
+        assert "selftest_step" in report, report
+        release.set()
+    finally:
+        pt.set_flags({"stack_sample_hz": 0.0})
+        srv.stop()
+        _metrics.set_enabled(False)
+        _fleet.aggregator().reset()
+        _stacks.reset()
+        _flight.recorder().reset()
+        shutil.rmtree(tmp, ignore_errors=True)
+    print("self-test OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="collect + render wedge-forensics bundles")
+    ap.add_argument("command", nargs="?", default="collect",
+                    choices=["collect", "render"])
+    ap.add_argument("bundle", nargs="?",
+                    help="bundle dir (render mode)")
+    ap.add_argument("--url", help="exporter host:port to collect from")
+    ap.add_argument("--out", help="bundle output dir "
+                                  "(default postmortem-<ts>)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="also pull the /fleet views incl. the "
+                         "/fleet/stacks fan-out")
+    ap.add_argument("--tar", action="store_true",
+                    help="archive the bundle as .tar.gz")
+    ap.add_argument("--self-test", action="store_true")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return self_test()
+    if args.command == "render" and args.bundle and not args.url:
+        return render(args.bundle)
+    if not args.url:
+        ap.error("--url HOST:PORT is required to collect "
+                 "(or pass a bundle dir to render)")
+    out = args.out or args.bundle \
+        or f"postmortem-{time.strftime('%Y%m%d-%H%M%S')}"
+    bundle = collect(args.url, out, fleet=args.fleet, tar=args.tar)
+    if args.command == "render":
+        return render(out)
+    return 0
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())
