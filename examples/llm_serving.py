@@ -12,6 +12,14 @@ continuous, not batch-synchronous.
 at temperature 0) and prints the accept rate alongside TTFT/TPOT —
 the CPU-visible proof that drafts verify and commit without changing
 a single output token.
+
+``--router`` puts the front-door router (serving_llm/router.py) over
+TWO backends, stops the one actively serving a sampled stream after
+two delivered tokens, and shows the client-visible sequence is
+bitwise identical to an uninterrupted reference (position-keyed
+sampling + sample_offset resume; docs/fault_tolerance.md, "Router
+failover taxonomy") — then keeps serving the concurrent workload on
+the survivor through the same front door.
 """
 
 from __future__ import annotations
@@ -31,12 +39,15 @@ def _percentile(xs, q):
 
 
 def main(n_clients: int = 8, max_new_tokens: int = 8,
-         verbose: bool = True, speculative: bool = False):
+         verbose: bool = True, speculative: bool = False,
+         router: bool = False):
     import paddle_tpu as pt
     from paddle_tpu.models import GPTLanguageModel
     from paddle_tpu.serving_llm import LLMEngine
 
     model = GPTLanguageModel()
+    if router:
+        return _run_router(model, n_clients, max_new_tokens, verbose)
     if speculative:
         pt.set_flags({"speculative_k": 4})
         engine = LLMEngine(model, block_size=16, pool_blocks=64,
@@ -137,5 +148,107 @@ def _run(engine, n_clients, max_new_tokens, verbose, speculative):
     return summary
 
 
+def _run_router(model, n_clients, max_new_tokens, verbose):
+    import paddle_tpu as pt
+    from paddle_tpu.inference import Client, Server
+    from paddle_tpu.serving_llm import LLMEngine
+    from paddle_tpu.serving_llm.router import Router
+
+    pt.set_flags({"router_retry_backoff_s": 0.0})
+    eng_a = LLMEngine(model, block_size=16, pool_blocks=64)
+    eng_b = LLMEngine(model, block_size=16, pool_blocks=64)
+    srv_a = Server(None, llm_engine=eng_a)
+    srv_b = Server(None, llm_engine=eng_b)
+    prompt = np.arange(6, dtype=np.int32) * 7 % model.config.vocab_size
+    kw = dict(max_new_tokens=max(max_new_tokens, 6), temperature=0.8,
+              seed=7)
+
+    # the uninterrupted reference, straight off backend A
+    with Client(port=srv_a.port, timeout_s=120.0,
+                deadline_s=120.0) as cli:
+        ref = [int(c[0]) for c in cli.generate_stream(prompt, **kw)]
+
+    fo_router = Router([("127.0.0.1", srv_a.port),
+                        ("127.0.0.1", srv_b.port)],
+                       probe_interval_s=0.3).start()
+    try:
+        # stream through the front door; stop the backend actively
+        # serving it after two delivered tokens. Decode is paced so
+        # the stream is still mid-flight when the stop lands — a fast
+        # warm engine can otherwise buffer every chunk before the
+        # client reads the second one
+        pt.set_flags({"fault_spec": "llm_decode:sleep=100"})
+        try:
+            got, victim = [], None
+            with Client(port=fo_router.port, timeout_s=120.0,
+                        deadline_s=120.0) as cli:
+                for i, chunk in enumerate(cli.generate_stream(prompt,
+                                                              **kw)):
+                    got.append(int(chunk[0]))
+                    if i == 1:
+                        busy = [b for b in
+                                fo_router.snapshot()["backends"]
+                                if b["streams_active"] > 0]
+                        port = int(busy[0]["name"].rsplit(":", 1)[1])
+                        victim = srv_a if port == srv_a.port else srv_b
+                        victim.stop()
+        finally:
+            pt.set_flags({"fault_spec": ""})
+        assert got == ref, (got, ref)  # bitwise, at temperature 0.8
+        snap = fo_router.snapshot()
+        assert snap["failovers_total"] == 1, snap
+
+        # the survivor keeps serving the concurrent workload through
+        # the same front door
+        results = [None] * n_clients
+
+        def run_client(i):
+            with Client(port=fo_router.port, timeout_s=120.0,
+                        deadline_s=120.0) as cli:
+                toks = [int(c[0]) for c in cli.generate_stream(
+                    prompt, max_new_tokens=max_new_tokens)]
+                results[i] = toks
+
+        threads = [threading.Thread(target=run_client, args=(i,))
+                   for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert all(r is not None and len(r) == max_new_tokens
+                   for r in results), results
+        summary = {
+            "ok": True,
+            "clients": n_clients,
+            "tokens": len(got) + sum(len(r) for r in results),
+            "failovers": snap["failovers_total"],
+            "shed": snap["shed_total"],
+            "victim_state": next(
+                b["state"] for b in fo_router.snapshot()["backends"]
+                if b["streams_active"] == 0 and b["state"] != "closed"),
+        }
+    finally:
+        fo_router.stop()
+        for srv in (srv_a, srv_b):
+            try:
+                srv.stop()
+            # ptlint: disable=silent-failure -- the failover victim is already stopped
+            except Exception:
+                pass
+        pt.set_flags({"router_retry_backoff_s": 0.05})
+    assert eng_a.allocator.num_used == 0
+    assert eng_b.allocator.num_used == 0
+    if verbose:
+        print(f"llm_serving [router]: mid-stream backend stop after "
+              f"2 tokens — spliced stream == reference bitwise at "
+              f"temperature 0.8 ({len(got)} tokens, "
+              f"{summary['failovers']} failover)")
+        print(f"  survivor then served {n_clients} concurrent "
+              f"clients through the same front door; victim state: "
+              f"{summary['victim_state']}; KV pools clean")
+    return summary
+
+
 if __name__ == "__main__":
-    main(speculative="--speculative" in sys.argv[1:])
+    main(speculative="--speculative" in sys.argv[1:],
+         router="--router" in sys.argv[1:])

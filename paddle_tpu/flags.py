@@ -666,6 +666,63 @@ define_flag("llm_step_ring", 256,
             on_change=_llm_step_ring_changed)
 
 
+define_flag("router_failover_budget", 2,
+            "Front-door router (serving_llm/router.py): maximum "
+            "mid-stream failovers per client stream. A stream that "
+            "already delivered tokens is resumed on a surviving "
+            "backend (prompt+delivered re-issued with the sample "
+            "offset, bitwise-exact continuation) at most this many "
+            "times before the router gives up with a terminal error "
+            "that names the delivered count. Read per failover "
+            "decision.")
+define_flag("router_retry_budget", 2,
+            "Front-door router: maximum re-sends of an UNSTARTED "
+            "(zero tokens delivered) stream or idempotent tensor "
+            "request to another backend after a connect/deadline "
+            "failure. Started streams never consume this — they fail "
+            "over instead (never blind-resent). Read per retry "
+            "decision.")
+define_flag("router_retry_backoff_s", 0.05,
+            "Front-door router: base of the jittered exponential "
+            "backoff slept before each unstarted-request retry "
+            "(actual sleep is base * 2^(attempt-1) * uniform[0.5,1) "
+            "— full-jitter, so N clients retrying a blip don't "
+            "stampede the survivor). 0 disables the sleep (tests). "
+            "Read per retry.")
+define_flag("router_breaker_threshold", 3,
+            "Front-door router: consecutive connect/deadline "
+            "failures (data path or probe) that trip a backend's "
+            "circuit breaker closed -> open. Drain refusals and "
+            "admission rejections are NOT failures — they park the "
+            "backend as draining/saturated without touching the "
+            "breaker. Read lazily per breaker decision.")
+define_flag("router_breaker_backoff_s", 0.5,
+            "Front-door router: open-state backoff of a freshly "
+            "tripped circuit breaker — how long the backend is left "
+            "alone before the single half-open probe. Doubles on "
+            "every re-open (failed probe) up to "
+            "FLAGS_router_breaker_backoff_max_s; any success resets "
+            "it. Read lazily per breaker decision.")
+define_flag("router_breaker_backoff_max_s", 30.0,
+            "Front-door router: cap on the doubling open-state "
+            "breaker backoff, bounding how stale a recovered "
+            "backend's exile can get. Read lazily per breaker "
+            "decision.")
+define_flag("router_probe_interval_s", 1.0,
+            "Front-door router: period of the backend health-probe "
+            "thread (PTSC STATS round trip reading serving.draining, "
+            "plus an optional exporter GET /healthz). Probe failures "
+            "feed the breaker; a tripped breaker's backend is probed "
+            "again only after its backoff (the half-open single "
+            "probe). Read per probe cycle.")
+define_flag("router_backend_deadline_s", 30.0,
+            "Front-door router: per-chunk deadline on router->backend "
+            "streams and total deadline on proxied tensor requests. A "
+            "backend silent past this is treated as dead: breaker "
+            "failure plus retry (unstarted) or deterministic failover "
+            "(started). Read per backend attempt.")
+
+
 def _fault_spec_changed(value) -> None:
     # (re)arm the chaos-injection registry; lazy import mirrors
     # _enable_metrics_changed (testing.faults imports this module)

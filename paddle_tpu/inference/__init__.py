@@ -39,7 +39,47 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 __all__ = ["Config", "PrecisionType", "Predictor", "create_predictor",
-           "Tensor", "Server", "Client"]
+           "Tensor", "Server", "Client", "StreamInterrupted",
+           "StreamConnectionLost", "StreamTimeout"]
+
+
+class StreamInterrupted(Exception):
+    """A streaming generate died MID-STREAM with the tokens already
+    delivered attached — the resume substrate the front-door router
+    (serving_llm/router.py) and end users build on. Raised only by
+    :meth:`Client.generate_stream`, always as one of the two concrete
+    subclasses so existing ``except ConnectionError`` /
+    ``except TimeoutError`` discipline keeps working:
+
+    * :class:`StreamConnectionLost` (a ``ConnectionError``) — the
+      transport died between chunks (backend killed, socket reset);
+    * :class:`StreamTimeout` (a ``TimeoutError``) — the stream went
+      silent past the per-chunk deadline and the connection was
+      poisoned.
+
+    ``delivered_tokens`` is the exact client-visible token list (in
+    order); ``partial()`` returns it as an int32 array. With PR 13's
+    position-keyed sampling, re-sending prompt+delivered with
+    ``sample_offset=len(delivered_tokens)`` reproduces the rest of the
+    stream bitwise (docs/serving_protocol.md, "Stream failover &
+    resume")."""
+
+    def __init__(self, message: str, delivered_tokens=()):
+        super().__init__(message)
+        self.delivered_tokens: List[int] = [int(t)
+                                            for t in delivered_tokens]
+
+    def partial(self) -> np.ndarray:
+        """Delivered tokens as an int32 [n] array (possibly empty)."""
+        return np.asarray(self.delivered_tokens, np.int32)
+
+
+class StreamConnectionLost(StreamInterrupted, ConnectionError):
+    pass
+
+
+class StreamTimeout(StreamInterrupted, TimeoutError):
+    pass
 
 
 class PrecisionType:
@@ -390,6 +430,19 @@ class Server:
                                           max_payload=max_payload)
         self.port = self.transport.port
         self._stop = threading.Event()
+        try:
+            # the serving.draining monitor stat is process-global and
+            # sticky: an earlier in-process server's drain would make
+            # a front-door router's probe park THIS fresh server as
+            # `draining` forever. A newly constructed server is by
+            # definition not draining — clear the stale flag (exact
+            # per-backend semantics hold in the one-server-per-process
+            # production shape either way).
+            from ..native import stat_reset
+            stat_reset("serving.draining")
+        # ptlint: disable=silent-failure -- the draining stat is advisory telemetry; serving must start even without the native lib
+        except Exception:  # noqa: BLE001
+            pass
         # graceful-drain lifecycle (docs/fault_tolerance.md, "LLM
         # serving lifecycle"): once draining, new work is refused and
         # in-flight generations get up to the drain deadline to finish
@@ -620,6 +673,22 @@ class Server:
         if not self._draining:
             self._drain_deadline_pc = time.perf_counter() + deadline_s
             self._draining = True
+            try:
+                # publish drain on the STATS wire (serving.* monitor
+                # lines ride the inline PTSC reply, csrc/serving.cc):
+                # a front-door router's probe then sees draining=1 and
+                # parks the backend as `draining` instead of tripping
+                # its breaker. The monitor registry is process-global,
+                # so with several in-process Servers the flag reads as
+                # "some server here is draining" — exact per-backend
+                # semantics hold in the one-server-per-process
+                # production shape.
+                from ..native import stat_add, stat_reset
+                stat_reset("serving.draining")
+                stat_add("serving.draining", 1)
+            # ptlint: disable=silent-failure -- drain must proceed even when the native lib is unavailable; the draining stat is advisory telemetry
+            except Exception:  # noqa: BLE001
+                pass
             from ..observability import flight as _flight
             _flight.record("serving_drain_begin", force=True,
                            deadline_s=deadline_s,
@@ -930,10 +999,17 @@ class Client:
                  deadline_s: Optional[float] = None,
                  max_reconnects: int = 2,
                  reconnect_backoff_s: float = 0.05,
-                 traced: bool = True):
+                 traced: bool = True,
+                 connect_timeout_s: Optional[float] = None):
         self._host = host
         self._port = port
         self._timeout_s = timeout_s
+        # connect can be gated tighter than reads: a refused/blackholed
+        # connect should fail fast even when per-chunk reads must sit
+        # through a cold backend's first-request compile (the router's
+        # failover detector depends on this split)
+        self._connect_timeout_s = (timeout_s if connect_timeout_s is None
+                                   else connect_timeout_s)
         self._deadline_s = deadline_s
         self._max_reconnects = int(max_reconnects)
         self._reconnect_backoff_s = float(reconnect_backoff_s)
@@ -966,8 +1042,9 @@ class Client:
 
     def _connect(self) -> None:
         sock = socket.create_connection((self._host, self._port),
-                                        timeout=self._timeout_s)
+                                        timeout=self._connect_timeout_s)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(self._timeout_s)
         with self._rcond:
             self._sock = sock
             self._gen += 1
@@ -1107,7 +1184,8 @@ class Client:
                         eos_token_id: Optional[int] = None,
                         temperature: float = 0.0, seed: int = 0,
                         deadline_s: Optional[float] = None,
-                        trace_id: Optional[int] = None):
+                        trace_id: Optional[int] = None,
+                        sample_offset: int = 0):
         """Streaming generate: send one 'PTST' frame, then yield each
         token chunk (an int32 array, length 1 per chunk) as the server
         streams it, until the terminal frame (docs/serving_protocol.md,
@@ -1116,10 +1194,20 @@ class Client:
 
         ``deadline_s`` is a PER-CHUNK deadline: the clock restarts on
         every frame, so a long generation streams indefinitely while a
-        stream that goes SILENT past the deadline raises TimeoutError
-        and poisons the connection (stream position unknowable —
-        mirroring ``infer``'s mid-frame semantics; the next call
-        reconnects).
+        stream that goes SILENT past the deadline raises
+        :class:`StreamTimeout` and poisons the connection (stream
+        position unknowable — mirroring ``infer``'s mid-frame
+        semantics; the next call reconnects). A transport death
+        between chunks raises :class:`StreamConnectionLost`. Both are
+        :class:`StreamInterrupted` and carry ``delivered_tokens`` —
+        the chunks already yielded — so a caller can resume the stream
+        instead of losing the prefix it already showed the user.
+
+        ``sample_offset`` > 0 marks a RESUMED stream: the prompt must
+        carry the original prompt plus the tokens already delivered,
+        and the offset shifts the server's position-keyed sampler past
+        them, reproducing the original continuation bitwise ("Stream
+        failover & resume" in the wire spec).
 
         Deliberately NOT retried across reconnects: generation is not
         idempotent and the server keeps decoding until its next write
@@ -1134,24 +1222,40 @@ class Client:
             "<IIfI", int(max_new_tokens),
             0xFFFFFFFF if eos_token_id is None else int(eos_token_id),
             float(temperature), int(seed))
-        body += encode_tensors(
-            [np.ascontiguousarray(prompt_ids, dtype=np.int32)])
+        arrays = [np.ascontiguousarray(prompt_ids, dtype=np.int32)]
+        if sample_offset:
+            arrays.append(np.asarray([int(sample_offset)], np.int32))
+        body += encode_tensors(arrays)
         with self._rcond:
             gen = self._gen
         tag = self._send_frame(self._MAGIC_STREAM,
                                struct.pack("<Q", trace_id) + body)
+        delivered: List[int] = []
         while True:
             deadline = None if eff is None \
                 else time.monotonic() + float(eff)
             try:
                 status, payload = self._recv(tag, gen, deadline)
-            except TimeoutError:
+            except TimeoutError as e:
                 # silent stream: the server may still write chunks for
                 # this tag later, so the connection is unusable
                 self._poison(gen)
-                raise
+                raise StreamTimeout(
+                    f"stream silent past the per-chunk deadline "
+                    f"after {len(delivered)} token(s): {e}",
+                    delivered_tokens=delivered) from e
+            except ConnectionError as e:
+                # transport died between chunks (the reader thread
+                # already poisoned this generation)
+                raise StreamConnectionLost(
+                    f"stream connection lost after {len(delivered)} "
+                    f"token(s): {e}",
+                    delivered_tokens=delivered) from e
             if status == 1:
-                yield decode_tensors(payload)[0]
+                chunk = decode_tensors(payload)[0]
+                delivered.extend(
+                    int(t) for t in np.asarray(chunk).reshape(-1))
+                yield chunk
             elif status == 0:
                 return
             else:

@@ -182,6 +182,20 @@ def _serving_health() -> Optional[Dict[str, Any]]:
     return snap if snap.get("engines") else None
 
 
+def _router_snapshots() -> list:
+    """Live front-door router snapshots for GET /router. Lazy like
+    :func:`_serving_health`: the endpoint answers [] (not an import)
+    when serving_llm.router was never loaded in this process."""
+    import sys
+    mod = sys.modules.get("paddle_tpu.serving_llm.router")
+    if mod is None:
+        return []
+    try:
+        return mod.snapshot_all()
+    except Exception:  # noqa: BLE001 — telemetry must never 500
+        return []
+
+
 def _flags_snapshot() -> Dict[str, Any]:
     try:
         from ..flags import GLOBAL_FLAGS
@@ -394,6 +408,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200 if ok else 503, payload)
             elif url.path == "/fleet/alerts":
                 self._send_json(200, _fleet.fleet_alerts())
+            elif url.path == "/router":
+                self._send_json(200, {"routers": _router_snapshots()})
             elif url.path == "/":
                 self._send(200,
                            b"paddle_tpu observability: /metrics?name=P "
@@ -403,7 +419,7 @@ class _Handler(BaseHTTPRequestHandler):
                            b"/llm/steps?n=N /stacks?format=F "
                            b"/fleet?name=P /fleet/goodput "
                            b"/fleet/health /fleet/alerts "
-                           b"/fleet/stacks\n",
+                           b"/fleet/stacks /router\n",
                            "text/plain")
             else:
                 self._send(404, b"not found\n", "text/plain")
@@ -645,6 +661,12 @@ def self_test() -> int:
             r["step"] == 4 for r in st["steps"]), text
         assert any(d["step"] == 5 and d["phase"] == "prefill"
                    and "age_s" in d for d in st["live"]), text
+        # front-door router plane: lazy like /healthz's serving
+        # section — an empty roster (router module never imported)
+        # still answers with the JSON shape
+        code, text = fetch("/router")
+        rt = json.loads(text)
+        assert code == 200 and isinstance(rt["routers"], list), text
         # hang-doctor plane: the live dump always answers, the sampled
         # profile appears once the sampler ticks, and both export
         # shapes parse

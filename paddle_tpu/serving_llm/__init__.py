@@ -16,13 +16,19 @@ Layering (each piece is independently testable):
 * :mod:`.server` — ``LLMStreamBridge``: glues engine events to
   ``inference.Server``'s streaming (PTST) reply frames, TTFT/TPOT
   histograms, and the reqtrace ring.
+* :mod:`.router` — ``Router``: stdlib front-door over N backends —
+  health-gated rotation with per-backend circuit breakers,
+  deterministic mid-stream failover (resume via the sample offset),
+  retry/shed discipline, and a ``GET /router`` exporter snapshot.
 """
 
 from .kv_cache import KVBlockAllocator
 from .scheduler import ContinuousBatchingScheduler, Sequence
 from .engine import AdmissionRejected, LLMEngine, health_snapshot
 from .server import LLMStreamBridge
+from .router import Backend, BackendPool, CircuitBreaker, Router
 
 __all__ = ["KVBlockAllocator", "ContinuousBatchingScheduler",
            "Sequence", "LLMEngine", "LLMStreamBridge",
-           "AdmissionRejected", "health_snapshot"]
+           "AdmissionRejected", "health_snapshot",
+           "Backend", "BackendPool", "CircuitBreaker", "Router"]

@@ -151,7 +151,7 @@ class LLMEngine:
     def add_request(self, prompt_ids, max_new_tokens: int = 16,
                     eos_token_id: Optional[int] = None,
                     temperature: float = 0.0, seed: int = 0,
-                    trace_id: int = 0) -> int:
+                    trace_id: int = 0, sample_offset: int = 0) -> int:
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -160,12 +160,15 @@ class LLMEngine:
             raise ValueError(f"prompt token out of range [0, {vocab})")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if sample_offset < 0:
+            raise ValueError("sample_offset must be >= 0")
         projected = self._admission_gate(prompt, int(max_new_tokens))
         self._next_seq += 1
         seq = Sequence(seq_id=self._next_seq, prompt=prompt,
                        max_new_tokens=int(max_new_tokens),
                        eos_token_id=eos_token_id,
-                       temperature=float(temperature), seed=int(seed))
+                       temperature=float(temperature), seed=int(seed),
+                       sample_offset=int(sample_offset))
         self._seqs[seq.seq_id] = seq
         self._projected[seq.seq_id] = projected
         self.scheduler.add(seq)
@@ -892,15 +895,19 @@ class LLMEngine:
 
     def _sample_at(self, seq: Sequence, logits, index: int) -> int:
         """Sample the token at generated-index ``index``. The RNG key
-        is derived from (seed, index) — NOT from call order — so
-        speculative verification reproduces exactly the token the
-        sequential sampler would have drawn at that position, at any
-        temperature."""
+        is derived from (seed, sample_offset + index) — NOT from call
+        order — so speculative verification reproduces exactly the
+        token the sequential sampler would have drawn at that
+        position, at any temperature, and a stream resumed elsewhere
+        with ``sample_offset`` set to its delivered-token count draws
+        exactly the keys the original stream would have drawn next
+        (the router-failover parity contract)."""
         _t = time.perf_counter()
         try:
             if seq.temperature > 0.0:
                 key = jax.random.fold_in(
-                    jax.random.PRNGKey(seq.seed), index)
+                    jax.random.PRNGKey(seq.seed),
+                    seq.sample_offset + index)
                 return int(jax.random.categorical(
                     key, logits / jnp.float32(seq.temperature)))
             return int(jnp.argmax(logits))

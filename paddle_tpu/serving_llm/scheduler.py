@@ -45,13 +45,20 @@ class Sequence:
     count satisfied by prefix sharing at admission — prefill starts
     there instead of position 0. ``prefill_done`` flips when the last
     prefill chunk lands; only then does the sequence join the decode
-    batch (chunked prefill advances one chunk per step)."""
+    batch (chunked prefill advances one chunk per step).
+    ``sample_offset`` shifts the position-keyed sampler: a stream
+    resumed after a router failover re-sends prompt+delivered as the
+    prompt and sets this to the delivered count, so token ``i`` of the
+    resumed stream draws the RNG key of generated-index ``offset + i``
+    — bitwise the token the dead backend would have produced next
+    (docs/serving_protocol.md, "Stream failover & resume")."""
     seq_id: int
     prompt: List[int]
     max_new_tokens: int = 16
     eos_token_id: Optional[int] = None
     temperature: float = 0.0
     seed: int = 0
+    sample_offset: int = 0
     generated: List[int] = field(default_factory=list)
     ctx_len: int = 0
     cached_tokens: int = 0

@@ -7,8 +7,10 @@ lifecycle:
 * ``admit`` parses the generate body (``<IIfI`` header —
   max_new_tokens, eos id with ``0xFFFFFFFF`` meaning none,
   temperature, seed — followed by one int32 prompt tensor in the
-  standard tensor codec; docs/serving_protocol.md "Streaming
-  generation") and registers the sequence with the engine;
+  standard tensor codec, plus an optional single-int32 resume-offset
+  tensor for streams resumed after a router failover;
+  docs/serving_protocol.md "Streaming generation" and "Stream
+  failover & resume") and registers the sequence with the engine;
 * ``step`` runs one engine step and turns its token events into
   status-1 reply chunks on the request's tag, the finish event into
   the terminal status-0 frame, and a failed chunk write (client gone)
@@ -70,16 +72,31 @@ class LLMStreamBridge:
             max_new, eos_raw, temperature, seed = struct.unpack_from(
                 GENERATE_HEADER, buf, 0)
             arrs = decode_tensors(buf[hdr:])
-            if len(arrs) != 1 or arrs[0].ndim != 1 \
+            if not arrs or arrs[0].ndim != 1 \
                     or arrs[0].dtype != np.int32:
                 raise ValueError(
-                    "generate body must carry exactly one int32 [T] "
-                    "prompt tensor")
+                    "generate body must carry an int32 [T] prompt "
+                    "tensor first")
+            sample_offset = 0
+            if len(arrs) == 2:
+                # resumed stream (docs/serving_protocol.md, "Stream
+                # failover & resume"): the prompt already carries the
+                # delivered tokens; the second tensor shifts the
+                # position-keyed sampler past them
+                if arrs[1].dtype != np.int32 or arrs[1].size != 1:
+                    raise ValueError(
+                        "resume offset must be a single int32")
+                sample_offset = int(arrs[1].reshape(-1)[0])
+            elif len(arrs) != 1:
+                raise ValueError(
+                    "generate body must carry one prompt tensor plus "
+                    "at most one resume-offset tensor")
             seq_id = self.engine.add_request(
                 arrs[0], max_new_tokens=max_new,
                 eos_token_id=None if eos_raw == EOS_NONE else int(eos_raw),
                 temperature=temperature, seed=seed,
-                trace_id=req.get("trace_id") or 0)
+                trace_id=req.get("trace_id") or 0,
+                sample_offset=sample_offset)
         except Exception as e:  # noqa: BLE001 — fail ONE request
             from .engine import AdmissionRejected
             outcome = "admission_rejected" \

@@ -100,6 +100,16 @@ def test_llm_serving():
     assert r["ttft_p50_ms"] > 0 and r["tokens_per_s"] > 0
 
 
+def test_llm_serving_router():
+    import llm_serving
+    r = llm_serving.main(n_clients=2, max_new_tokens=4, verbose=False,
+                         router=True)
+    assert r["ok"] and r["failovers"] == 1 and r["shed"] == 0
+    # failover demo streams 6 sampled tokens, then 2 clients x 4
+    assert r["tokens"] == 6 + 2 * 4
+    assert r["victim_state"] in ("draining", "open", "half_open")
+
+
 def test_llm_serving_speculative():
     import llm_serving
     r = llm_serving.main(n_clients=2, max_new_tokens=5, verbose=False,

@@ -574,7 +574,8 @@ def test_chaos_drill_list_inventory():
                  "stream_disconnect", "llm_overload_shed",
                  "llm_drain_sigterm", "llm_decode_error",
                  "llm_prefix_cow_leak", "llm_spec_rollback",
-                 "llm_flight_deck"):
+                 "llm_flight_deck", "router_backend_kill",
+                 "router_all_saturated"):
         assert name in proc.stdout, f"{name} missing from --list"
 
 

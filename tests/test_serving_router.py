@@ -1,0 +1,640 @@
+"""Front-door LLM router: circuit breaker, health-gated rotation,
+deterministic mid-stream failover, honest backpressure
+(paddle_tpu/serving_llm/router.py).
+
+Layered like the subsystem: pure-unit breaker mechanics on an
+injected clock (no sleeping), scripted-probe pool semantics
+(drain-vs-death), the StreamInterrupted resume substrate against a
+scripted wire peer, engine-level sample_offset parity (the property
+failover correctness rests on), an in-process two-backend
+end-to-end failover (bitwise parity at temperature 0 AND 0.8), and
+the CLI self-test as a subprocess CI hook.
+"""
+
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu import observability as obs  # noqa: E402
+from paddle_tpu.inference import (Client, Server,  # noqa: E402
+                                  StreamConnectionLost,
+                                  StreamInterrupted, StreamTimeout,
+                                  encode_tensors)
+from paddle_tpu.models import GPTLanguageModel  # noqa: E402
+from paddle_tpu.serving_llm import LLMEngine  # noqa: E402
+from paddle_tpu.serving_llm.router import (Backend,  # noqa: E402
+                                           BackendPool, CircuitBreaker,
+                                           Router)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def metrics_on():
+    pt.set_flags({"enable_metrics": True})
+    try:
+        yield
+    finally:
+        pt.set_flags({"enable_metrics": False})
+        obs.reset_all()
+
+
+@pytest.fixture(scope="module")
+def model():
+    return GPTLanguageModel()
+
+
+class FakeClock:
+    """Injectable monotonic clock: tests advance time, never sleep."""
+
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker (pure unit, fake clock)
+# ---------------------------------------------------------------------------
+
+class TestCircuitBreaker:
+    def _cb(self, **kw):
+        clk = FakeClock()
+        kw.setdefault("threshold", 3)
+        kw.setdefault("backoff_s", 10.0)
+        kw.setdefault("backoff_max_s", 25.0)
+        return CircuitBreaker(clock=clk, **kw), clk
+
+    def test_trips_only_after_consecutive_threshold(self):
+        cb, _ = self._cb()
+        for _ in range(2):
+            cb.record_failure()
+        assert cb.state == "closed" and cb.allow()
+        cb.record_failure()
+        assert cb.state == "open" and not cb.allow()
+        assert cb.opened_total == 1
+
+    def test_success_resets_the_consecutive_count(self):
+        cb, _ = self._cb()
+        cb.record_failure()
+        cb.record_failure()
+        cb.record_success()
+        cb.record_failure()
+        cb.record_failure()
+        assert cb.state == "closed" and cb.failures == 2
+
+    def test_open_fast_fails_until_the_backoff_elapses(self):
+        cb, clk = self._cb()
+        for _ in range(3):
+            cb.record_failure()
+        clk.advance(9.9)
+        assert cb.state == "open" and not cb.allow()
+        clk.advance(0.2)
+        assert cb.state == "half_open"
+
+    def test_half_open_admits_exactly_one_probe(self):
+        cb, clk = self._cb()
+        for _ in range(3):
+            cb.record_failure()
+        clk.advance(10.0)
+        assert cb.allow()          # this caller wins the probe slot
+        assert not cb.allow()      # everyone else keeps fast-failing
+        assert cb.state == "half_open"
+
+    def test_probe_success_closes_and_resets(self):
+        cb, clk = self._cb()
+        for _ in range(3):
+            cb.record_failure()
+        clk.advance(10.0)
+        assert cb.allow()
+        cb.record_success()
+        assert cb.state == "closed" and cb.failures == 0
+        assert cb.allow() and cb.allow()  # no probe slot in closed
+
+    def test_probe_failure_doubles_backoff_up_to_the_cap(self):
+        cb, clk = self._cb()
+        for _ in range(3):
+            cb.record_failure()
+        assert cb.snapshot()["backoff_s"] == 10.0
+        clk.advance(10.0)
+        assert cb.allow()
+        cb.record_failure()        # failed probe: re-open, doubled
+        assert cb.snapshot()["backoff_s"] == 20.0
+        clk.advance(15.0)
+        assert not cb.allow()      # doubled span not yet elapsed
+        clk.advance(5.0)
+        assert cb.allow()
+        cb.record_failure()
+        assert cb.snapshot()["backoff_s"] == 25.0  # capped
+        assert cb.opened_total == 3
+
+    def test_failure_while_open_does_not_extend_the_backoff(self):
+        cb, clk = self._cb()
+        for _ in range(3):
+            cb.record_failure()
+        cb.record_failure()        # in-flight stream predating the trip
+        clk.advance(10.0)
+        assert cb.state == "half_open"
+
+    def test_defaults_come_from_flags_lazily(self):
+        pt.set_flags({"router_breaker_threshold": 2})
+        try:
+            cb = CircuitBreaker(clock=FakeClock())
+            cb.record_failure()
+            assert cb.state == "closed"
+            cb.record_failure()
+            assert cb.state == "open"
+        finally:
+            pt.set_flags({"router_breaker_threshold": 3})
+
+
+# ---------------------------------------------------------------------------
+# backend pool: scripted probes, drain-vs-death
+# ---------------------------------------------------------------------------
+
+class TestBackendPool:
+    def test_drain_flag_is_draining_not_open(self):
+        """SIGTERM semantics: a backend that ANSWERS its probe with
+        the drain flag leaves rotation as ``draining`` — the breaker
+        must stay closed (drain is orderly, not a failure)."""
+        b = Backend("127.0.0.1", 1)
+        answers = {"stats": {"serving.draining": 1}}
+        pool = BackendPool([b], probe=lambda _b: answers)
+        pool.probe_once()
+        assert b.state() == "draining" and not b.in_rotation()
+        assert b.breaker.state == "closed"
+        assert b.breaker.snapshot()["opened_total"] == 0
+        # drain flag clears (e.g. a rolling restart came back)
+        answers["stats"] = {"serving.draining": 0}
+        pool.probe_once()
+        assert b.state() == "closed" and b.in_rotation()
+
+    def test_dead_probe_is_breaker_food(self):
+        def probe(_b):
+            raise ConnectionError("connection refused")
+        b = Backend("127.0.0.1", 1,
+                    breaker=CircuitBreaker(threshold=3, backoff_s=60.0,
+                                           clock=FakeClock()))
+        pool = BackendPool([b], probe=probe)
+        pool.probe_once()
+        pool.probe_once()
+        assert b.state() == "closed"       # under threshold
+        pool.probe_once()
+        assert b.state() == "open"
+        assert pool.pick() is None
+        assert "connection refused" in b.snapshot()["last_error"]
+
+    def test_open_breaker_gates_probes_until_backoff(self):
+        calls = []
+
+        def probe(_b):
+            calls.append(1)
+            raise ConnectionError("down")
+        clk = FakeClock()
+        b = Backend("127.0.0.1", 1,
+                    breaker=CircuitBreaker(threshold=1, backoff_s=30.0,
+                                           clock=clk))
+        pool = BackendPool([b], probe=probe)
+        pool.probe_once()
+        assert b.state() == "open" and len(calls) == 1
+        pool.probe_once()          # backoff pending: left alone
+        assert len(calls) == 1
+        clk.advance(30.0)
+        pool.probe_once()          # THE half-open single probe
+        assert len(calls) == 2
+
+    def test_half_open_probe_success_recovers_the_backend(self):
+        state = {"up": False}
+
+        def probe(_b):
+            if not state["up"]:
+                raise ConnectionError("down")
+            return {"stats": {}}
+        clk = FakeClock()
+        b = Backend("127.0.0.1", 1,
+                    breaker=CircuitBreaker(threshold=1, backoff_s=5.0,
+                                           clock=clk))
+        pool = BackendPool([b], probe=probe)
+        pool.probe_once()
+        assert b.state() == "open"
+        state["up"] = True
+        clk.advance(5.0)
+        pool.probe_once()
+        assert b.state() == "closed" and b.in_rotation()
+        assert b.breaker.failures == 0
+
+    def test_healthz_codes_map_to_states(self):
+        answers = {"stats": {}, "healthz": 200}
+        b = Backend("127.0.0.1", 1, healthz=("127.0.0.1", 2))
+        pool = BackendPool([b], probe=lambda _b: answers)
+        pool.probe_once()
+        assert b.state() == "closed"
+        answers["healthz"] = 503   # exporter drain signal
+        pool.probe_once()
+        assert b.state() == "draining"
+        answers["healthz"] = 500
+        pool.probe_once()
+        assert b.state() == "unhealthy"
+
+    def test_breaker_state_wins_over_stale_drain_flag(self):
+        """A drained process that finally DIED must read ``open``,
+        not ``draining`` — the last successful probe's drain flag is
+        stale data once the breaker trips."""
+        b = Backend("127.0.0.1", 1,
+                    breaker=CircuitBreaker(threshold=1, backoff_s=60.0,
+                                           clock=FakeClock()))
+        b.set_health(draining=True, unhealthy=False)
+        assert b.state() == "draining"
+        b.breaker.record_failure()
+        assert b.state() == "open"
+
+    def test_pick_round_robins_and_skips_burned(self):
+        bs = [Backend("127.0.0.1", p) for p in (1, 2, 3)]
+        pool = BackendPool(bs, probe=lambda _b: {"stats": {}})
+        bs[1].mark_draining()
+        first, second = pool.pick(), pool.pick()
+        assert {first.port, second.port} == {1, 3}
+        assert pool.pick(exclude=[bs[0]]).port == 3
+        assert pool.pick(exclude=[bs[0], bs[2]]) is None
+        assert pool.available() == 2
+
+    def test_fresh_server_clears_stale_drain_flag(self):
+        """The serving.draining monitor stat is process-global and
+        sticky: an EARLIER in-process server's drain must not park a
+        freshly constructed backend as draining forever
+        (Server.__init__ clears the stale flag — regression: router
+        probes saw every backend as draining after any in-process
+        drain, and failover found no backend)."""
+        old = Server(None)
+        old.drain(deadline_s=0.1, wait=True)
+        old.stop()
+        srv = Server(None)
+        try:
+            b = Backend("127.0.0.1", srv.port)
+            pool = BackendPool([b])
+            pool.probe_once()
+            assert b.state() == "closed", b.snapshot()
+            assert b.in_rotation()
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# StreamInterrupted carries the resume substrate (scripted wire peer)
+# ---------------------------------------------------------------------------
+
+_REQ_HDR = struct.Struct("<IQI")
+_REPLY_HDR = struct.Struct("<QqI")
+
+
+class _ScriptedPeer:
+    """A one-connection wire-protocol peer: reads one request frame,
+    plays back scripted reply frames, then runs a final action
+    (``close`` or ``hang``). Lets tests produce mid-stream transport
+    deaths and silences deterministically."""
+
+    def __init__(self, chunks, final="close"):
+        self._chunks = list(chunks)
+        self._final = final
+        self._done = threading.Event()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(1)
+        self.port = self._sock.getsockname()[1]
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        conn, _ = self._sock.accept()
+        try:
+            hdr = b""
+            while len(hdr) < _REQ_HDR.size:
+                hdr += conn.recv(_REQ_HDR.size - len(hdr))
+            _magic, tag, n = _REQ_HDR.unpack(hdr)
+            body = b""
+            while len(body) < n:
+                body += conn.recv(n - len(body))
+            for tok in self._chunks:
+                payload = encode_tensors([np.asarray([tok], np.int32)])
+                conn.sendall(_REPLY_HDR.pack(tag, 1, len(payload))
+                             + payload)
+            if self._final == "close":
+                conn.close()
+            elif self._final == "close_clean":
+                conn.sendall(_REPLY_HDR.pack(tag, 0, 0))
+                self._done.wait(30.0)
+                conn.close()
+            else:
+                self._done.wait(30.0)  # go silent, hold the socket
+                conn.close()
+        finally:
+            self._sock.close()
+
+    def stop(self):
+        self._done.set()
+        self._thread.join(timeout=5.0)
+
+
+class TestStreamInterruptedResumeSubstrate:
+    def test_connection_lost_carries_delivered_tokens(self):
+        peer = _ScriptedPeer([7, 8], final="close")
+        cli = Client(port=peer.port, timeout_s=10.0, max_reconnects=0,
+                     traced=False)
+        try:
+            seen = []
+            with pytest.raises(StreamConnectionLost) as ei:
+                for ch in cli.generate_stream([1, 2], max_new_tokens=5):
+                    seen.extend(int(t) for t in np.asarray(ch).ravel())
+            e = ei.value
+            assert seen == [7, 8]
+            assert e.delivered_tokens == [7, 8]
+            assert np.array_equal(e.partial(),
+                                  np.asarray([7, 8], np.int32))
+            assert e.partial().dtype == np.int32
+            # existing except-discipline keeps working
+            assert isinstance(e, ConnectionError)
+            assert isinstance(e, StreamInterrupted)
+        finally:
+            cli.close()
+            peer.stop()
+
+    def test_stream_timeout_carries_delivered_tokens(self):
+        peer = _ScriptedPeer([4], final="hang")
+        cli = Client(port=peer.port, timeout_s=10.0, max_reconnects=0,
+                     traced=False)
+        try:
+            with pytest.raises(StreamTimeout) as ei:
+                for _ch in cli.generate_stream([1], max_new_tokens=5,
+                                               deadline_s=0.3):
+                    pass
+            e = ei.value
+            assert e.delivered_tokens == [4]
+            assert isinstance(e, TimeoutError)
+            assert "after 1 token(s)" in str(e)
+        finally:
+            cli.close()
+            peer.stop()
+
+    def test_zero_token_interrupt_has_empty_partial(self):
+        peer = _ScriptedPeer([], final="close")
+        cli = Client(port=peer.port, timeout_s=10.0, max_reconnects=0,
+                     traced=False)
+        try:
+            with pytest.raises(StreamConnectionLost) as ei:
+                list(cli.generate_stream([1], max_new_tokens=5))
+            assert ei.value.delivered_tokens == []
+            assert ei.value.partial().shape == (0,)
+        finally:
+            cli.close()
+            peer.stop()
+
+
+# ---------------------------------------------------------------------------
+# engine-level resume parity (the property failover rests on)
+# ---------------------------------------------------------------------------
+
+class TestSampleOffsetParity:
+    def _run(self, engine):
+        out = {}
+        steps = 0
+        while engine.active():
+            steps += 1
+            assert steps <= 300, "engine did not quiesce"
+            for ev in engine.step():
+                if ev["type"] == "token":
+                    out.setdefault(ev["seq_id"], []).append(ev["token"])
+                elif ev["type"] != "finished":
+                    raise AssertionError(f"unexpected event {ev}")
+        return out
+
+    @pytest.mark.parametrize("temp", [0.0, 0.8])
+    def test_resume_with_offset_is_bitwise(self, model, temp):
+        prompt = [5, 9, 2]
+        eng = LLMEngine(model, block_size=4, pool_blocks=32)
+        sid = eng.add_request(prompt, max_new_tokens=12,
+                              temperature=temp, seed=11)
+        full = self._run(eng)[sid]
+        assert len(full) == 12
+        cut = 5
+        eng2 = LLMEngine(model, block_size=4, pool_blocks=32)
+        sid2 = eng2.add_request(prompt + full[:cut], max_new_tokens=7,
+                                temperature=temp, seed=11,
+                                sample_offset=cut)
+        assert self._run(eng2)[sid2] == full[cut:]
+        assert eng.allocator.num_used == 0
+        assert eng2.allocator.num_used == 0
+
+
+# ---------------------------------------------------------------------------
+# router end-to-end (in-process backends)
+# ---------------------------------------------------------------------------
+
+def _drain_tokens(chunks):
+    return [int(t) for ch in chunks for t in np.asarray(ch).ravel()]
+
+
+class TestRouterEndToEnd:
+    @pytest.fixture
+    def fleet(self, model):
+        pt.set_flags({"router_retry_backoff_s": 0.0})
+        eng_a = LLMEngine(model, block_size=4, pool_blocks=32)
+        eng_b = LLMEngine(model, block_size=4, pool_blocks=32)
+        srv_a = Server(None, llm_engine=eng_a)
+        srv_b = Server(None, llm_engine=eng_b)
+        router = Router([("127.0.0.1", srv_a.port),
+                         ("127.0.0.1", srv_b.port)],
+                        probe_interval_s=0.2).start()
+        try:
+            yield router, (srv_a, eng_a), (srv_b, eng_b)
+        finally:
+            router.stop()
+            for srv in (srv_a, srv_b):
+                try:
+                    srv.stop()
+                # ptlint: disable=silent-failure -- teardown: the failover victim is already stopped
+                except Exception:
+                    pass
+            pt.set_flags({"router_retry_backoff_s": 0.05})
+
+    @pytest.mark.parametrize("temp", [0.0, 0.8])
+    def test_midstream_failover_is_bitwise(self, fleet, temp):
+        """Stop the backend actively serving a stream after two
+        delivered chunks: the client-visible sequence must be
+        BITWISE the uninterrupted reference — greedy AND sampled
+        (position-keyed sampling + sample_offset resume)."""
+        router, (srv_a, eng_a), (srv_b, eng_b) = fleet
+        prompt = [5, 9, 2, 7]
+        kw = dict(max_new_tokens=10, temperature=temp, seed=3)
+        with Client(port=srv_a.port, timeout_s=60.0,
+                    deadline_s=60.0) as direct:
+            ref = _drain_tokens(direct.generate_stream(prompt, **kw))
+        assert len(ref) == 10
+
+        # pace decode so the stream is still mid-flight at chunk 1 —
+        # without this, a loaded box can buffer all 10 chunks before
+        # the client reads the second one and the stop lands late
+        pt.set_flags({"fault_spec": "llm_decode:sleep=100"})
+        try:
+            got = []
+            with Client(port=router.port, timeout_s=60.0,
+                        deadline_s=60.0) as cli:
+                for i, ch in enumerate(cli.generate_stream(prompt,
+                                                           **kw)):
+                    got.extend(int(t) for t in np.asarray(ch).ravel())
+                    if i == 1:
+                        snap = router.snapshot()
+                        busy = [b for b in snap["backends"]
+                                if b["streams_active"] > 0]
+                        assert len(busy) == 1, snap
+                        port = int(busy[0]["name"].rsplit(":", 1)[1])
+                        victim = srv_a if port == srv_a.port else srv_b
+                        victim.stop()
+        finally:
+            pt.set_flags({"fault_spec": ""})
+        assert got == ref
+        snap = router.snapshot()
+        assert snap["failovers_total"] == 1, snap
+        assert snap["retries_total"] == 0, snap
+        assert snap["shed_total"] == 0, snap
+        # both engines end clean: the victim drained its sequence,
+        # the survivor finished the resumed one
+        deadline = time.monotonic() + 10.0
+        while (eng_a.allocator.num_used or eng_b.allocator.num_used) \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert eng_a.allocator.num_used == 0
+        assert eng_b.allocator.num_used == 0
+
+    def test_stats_through_the_router_door(self, fleet):
+        router, _, _ = fleet
+        with Client(port=router.port) as cli:
+            st = cli.stats()
+        assert st["router.proto_version"] == 1
+        assert st["router.backends"] == 2
+        assert st["router.available"] == 2
+        assert st["router.backend.0.state"] == 0
+        assert all(isinstance(v, int) for v in st.values())
+
+    def test_plain_generate_proxies_without_failover(self, fleet):
+        router, _, _ = fleet
+        with Client(port=router.port, timeout_s=60.0,
+                    deadline_s=60.0) as cli:
+            out = cli.generate([3, 1, 4], max_new_tokens=6,
+                               temperature=0.0)
+        assert out.dtype == np.int32 and len(out) == 6
+        snap = router.snapshot()
+        assert snap["failovers_total"] == 0
+        assert snap["streams_total"] == 1
+
+
+class TestRouterBackpressure:
+    def test_all_saturated_sheds_with_max_hint(self):
+        """Every backend answers the stream with an admission
+        refusal: the router sheds AT THE DOOR with the aggregated
+        max retry_after_ms hint, and saturation must not look like
+        failure (no breaker trips, no retry counters)."""
+        peers = [_RefusingPeer(75), _RefusingPeer(120)]
+        router = Router([("127.0.0.1", p.port) for p in peers],
+                        start_probes=False).start()
+        try:
+            with Client(port=router.port, timeout_s=10.0) as cli:
+                with pytest.raises(RuntimeError) as ei:
+                    list(cli.generate_stream([1, 2], max_new_tokens=4))
+            msg = str(ei.value)
+            assert "all backends saturated" in msg
+            assert "retry_after_ms=120" in msg
+            snap = router.snapshot()
+            assert snap["shed_total"] == 1, snap
+            assert snap["retries_total"] == 0, snap
+            assert snap["failovers_total"] == 0, snap
+            assert all(b["breaker"]["opened_total"] == 0
+                       for b in snap["backends"]), snap
+        finally:
+            router.stop()
+            for p in peers:
+                p.stop()
+
+    def test_dead_backend_is_a_counted_retry_not_a_shed(self):
+        """Zero tokens delivered + a connect failure: the stream
+        RETRIES onto the next backend (counted), never sheds."""
+        dead = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        dead.bind(("127.0.0.1", 0))
+        dead_port = dead.getsockname()[1]
+        dead.close()               # nothing listens here now
+        peer = _ScriptedPeer([6], final="close_clean")
+        router = Router([("127.0.0.1", dead_port),
+                         ("127.0.0.1", peer.port)],
+                        start_probes=False).start()
+        pt.set_flags({"router_retry_backoff_s": 0.0})
+        try:
+            with Client(port=router.port, timeout_s=10.0) as cli:
+                toks = _drain_tokens(
+                    cli.generate_stream([1], max_new_tokens=1))
+            assert toks == [6]
+            snap = router.snapshot()
+            assert snap["retries_total"] == 1, snap
+            assert snap["failovers_total"] == 0, snap
+            assert snap["backends"][0]["breaker"]["failures"] == 1
+        finally:
+            pt.set_flags({"router_retry_backoff_s": 0.05})
+            router.stop()
+            peer.stop()
+
+
+class _RefusingPeer(_ScriptedPeer):
+    """Wire peer that answers every stream request with an
+    admission-style refusal carrying a retry-after hint."""
+
+    def __init__(self, hint_ms):
+        self._hint = hint_ms
+        super().__init__([], final="refuse")
+
+    def _serve(self):
+        conn, _ = self._sock.accept()
+        try:
+            hdr = b""
+            while len(hdr) < _REQ_HDR.size:
+                hdr += conn.recv(_REQ_HDR.size - len(hdr))
+            _magic, tag, n = _REQ_HDR.unpack(hdr)
+            body = b""
+            while len(body) < n:
+                body += conn.recv(n - len(body))
+            payload = (f"admission rejected: queue full: "
+                       f"retry_after_ms={self._hint}").encode()
+            conn.sendall(_REPLY_HDR.pack(tag, -1, len(payload))
+                         + payload)
+            self._done.wait(30.0)
+            conn.close()
+        finally:
+            self._sock.close()
+
+
+# ---------------------------------------------------------------------------
+# CLI self-test: the CI hook (subprocess, two real backends)
+# ---------------------------------------------------------------------------
+
+def test_llm_router_self_test_subprocess():
+    """tools/llm_router.py --self-test must pass without a TPU:
+    SIGKILL mid-stream failover with bitwise parity at temperature
+    0.8, cross-process weight determinism, clean survivor drain."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "llm_router.py"),
+         "--self-test"],
+        capture_output=True, text=True, env=env, timeout=540)
+    assert proc.returncode == 0, proc.stderr + proc.stdout
+    assert "self-test OK" in proc.stdout

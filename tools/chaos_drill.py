@@ -1502,6 +1502,270 @@ def drill_exact_resume(tmp):
         raise DrillFailure(str(e)) from e
 
 
+def _driver_metrics_on():
+    """Enable driver-process metrics for the router drills (their
+    router runs in the driver so its counters are asserted directly);
+    returns the previous value for restoration."""
+    import paddle_tpu as pt
+    from paddle_tpu.flags import GLOBAL_FLAGS
+    prev = bool(GLOBAL_FLAGS.get("enable_metrics"))
+    pt.set_flags({"enable_metrics": True, "metrics_port": -1})
+    return prev
+
+
+def drill_router_backend_kill(tmp):
+    """SIGKILL one of two backends mid-stream (after >= 2 delivered
+    tokens): the front-door router resumes on the survivor and the
+    client-visible token sequence is BITWISE identical to an
+    uninterrupted single-backend run — at temperature 0 AND 0.8 —
+    with exactly one failover counted, zero retries/sheds, and a
+    clean KV audit on the SIGTERMed survivor."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import observability as obs
+    from paddle_tpu.inference import Client
+    from paddle_tpu.serving_llm.router import Router
+    try:
+        from tools import llm_router
+    except ImportError:  # run from inside tools/
+        import llm_router
+
+    prev_metrics = _driver_metrics_on()
+    pt.set_flags({"router_retry_backoff_s": 0.0,
+                  "router_probe_interval_s": 0.3})
+    summaries = []
+    try:
+        for temp in (0.0, 0.8):
+            sub = os.path.join(tmp, f"router_kill_t{int(temp * 10)}")
+            os.makedirs(sub, exist_ok=True)
+            pa, pfa, audit_a = llm_router._spawn_backend(sub, 0)
+            pb, pfb, audit_b = llm_router._spawn_backend(sub, 1)
+            router = None
+            try:
+                port_a = llm_router._wait_port(pa, pfa)
+                port_b = llm_router._wait_port(pb, pfb)
+                prompt = (np.arange(6, dtype=np.int32) * 5) % 60
+                kw = dict(max_new_tokens=20, temperature=temp, seed=11)
+                # uninterrupted single-backend reference
+                with Client(port=port_a, timeout_s=120.0,
+                            deadline_s=120.0) as cli:
+                    ref = cli.generate(prompt, **kw).tolist()
+                _check(len(ref) == 20, f"reference stunted: {ref}")
+                before = obs.counter("router_failovers_total",
+                                     "x").value()
+                router = Router([("127.0.0.1", port_a),
+                                 ("127.0.0.1", port_b)],
+                                probe_interval_s=0.3).start()
+                got, victim = [], None
+                with Client(port=router.port, timeout_s=120.0,
+                            deadline_s=120.0) as cli:
+                    for i, ch in enumerate(
+                            cli.generate_stream(prompt, **kw)):
+                        got.extend(int(t)
+                                   for t in np.asarray(ch).ravel())
+                        if i == 1:
+                            snap = router.snapshot()
+                            busy = [b["name"]
+                                    for b in snap["backends"]
+                                    if b["streams_active"] > 0]
+                            _check(len(busy) == 1,
+                                   f"one backend should hold the "
+                                   f"stream: {snap}")
+                            vport = int(busy[0].rsplit(":", 1)[1])
+                            victim = pa if vport == port_a else pb
+                            victim.send_signal(signal.SIGKILL)
+                _check(got == ref,
+                       f"temp {temp}: spliced stream diverged:\n"
+                       f"  got {got}\n  ref {ref}")
+                snap = router.snapshot()
+                _check(snap["failovers_total"] == 1
+                       and snap["retries_total"] == 0
+                       and snap["shed_total"] == 0,
+                       f"engineered scenario is exactly 1 failover, "
+                       f"0 retries, 0 sheds: {snap}")
+                _check(obs.counter("router_failovers_total",
+                                   "x").value() - before == 1,
+                       "router_failovers_total must move by exactly 1")
+                victim.wait(10)
+                survivor, s_audit = (pb, audit_b) if victim is pa \
+                    else (pa, audit_a)
+                survivor.send_signal(signal.SIGTERM)
+                rc = survivor.wait(60)
+                _check(rc == -signal.SIGTERM,
+                       f"survivor exit status {rc}")
+                audit = json.load(open(s_audit))
+                _check(audit["kv_used"] == 0 and audit["check_ok"]
+                       and audit["gauges_ok"]
+                       and audit["open_streams"] == 0,
+                       f"survivor KV audit dirty: {audit}")
+                summaries.append(f"temp {temp}: 20 tokens spliced "
+                                 f"bitwise")
+            finally:
+                if router is not None:
+                    router.stop()
+                for p in (pa, pb):
+                    if p.poll() is None:
+                        p.kill()
+                for p in (pa, pb):
+                    try:
+                        p.wait(10)
+                    except subprocess.TimeoutExpired:
+                        pass
+    finally:
+        pt.set_flags({"enable_metrics": prev_metrics})
+    return ("; ".join(summaries) + "; 1 failover each, survivor "
+            "audits clean")
+
+
+_ROUTER_TIGHT_BACKEND = r"""
+import sys
+import paddle_tpu as pt
+from paddle_tpu.inference import Server
+from paddle_tpu.models import GPTLanguageModel
+from paddle_tpu.serving_llm import LLMEngine
+
+portfile = sys.argv[1]
+pt.seed(0)
+model = GPTLanguageModel()
+# 8-block pool + the 0.5 admission watermark from the env: budget 4
+# blocks, each stream projects 3 (4 prompt + 8 new = 12 tokens), so
+# each backend admits exactly ONE stream at a time
+engine = LLMEngine(model, block_size=4, pool_blocks=8)
+srv = Server(None, llm_engine=engine)
+with open(portfile, "w") as f:
+    f.write(str(srv.port))
+srv.serve_forever()
+"""
+
+
+def drill_router_all_saturated(tmp):
+    """Flood the router at 4x fleet capacity: the two fully-loaded
+    backends refuse extras at admission, and the router sheds those
+    streams AT THE DOOR with the aggregated max retry_after_ms hint —
+    no router-side queueing, no retries, no breaker trips (saturation
+    is not failure), pool back to idle after the flood."""
+    import threading
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.inference import Client
+    from paddle_tpu.serving_llm.router import Router
+
+    prev_metrics = _driver_metrics_on()
+    pt.set_flags({"router_retry_backoff_s": 0.0,
+                  "router_probe_interval_s": 0.5})
+    procs, router = [], None
+    try:
+        ports = []
+        for idx in range(2):
+            script = os.path.join(tmp, f"tight_backend_{idx}.py")
+            with open(script, "w") as f:
+                f.write(_ROUTER_TIGHT_BACKEND)
+            portfile = os.path.join(tmp, f"tight_port_{idx}.txt")
+            if os.path.exists(portfile):
+                os.remove(portfile)
+            env = _env(tmp)
+            env["FLAGS_kv_admission_watermark"] = "0.5"
+            procs.append(subprocess.Popen(
+                [sys.executable, script, portfile], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True))
+            ports.append((procs[-1], portfile))
+        bound = []
+        for proc, portfile in ports:
+            deadline = time.time() + 180
+            while not os.path.exists(portfile) \
+                    and time.time() < deadline:
+                if proc.poll() is not None:
+                    raise DrillFailure(
+                        f"tight backend died during startup\n"
+                        f"{proc.communicate()[1]}")
+                time.sleep(0.1)
+            _check(os.path.exists(portfile),
+                   "tight backend never bound")
+            bound.append(int(open(portfile).read()))
+
+        router = Router([("127.0.0.1", p) for p in bound],
+                        probe_interval_s=0.5).start()
+        outcomes, lock = [], threading.Lock()
+
+        def worker(i):
+            prompt = np.asarray([1 + i, 2, 3, 4], np.int32)
+            cli = Client(port=router.port, timeout_s=120.0,
+                         deadline_s=120.0)
+            try:
+                toks = []
+                for ch in cli.generate_stream(prompt,
+                                              max_new_tokens=8):
+                    toks.extend(int(t) for t in np.asarray(ch).ravel())
+                out = ("ok", len(toks))
+            except RuntimeError as e:
+                out = ("shed", str(e)) \
+                    if "all backends saturated" in str(e) \
+                    else ("error", str(e))
+            except Exception as e:  # noqa: BLE001 — report, not crash
+                out = (type(e).__name__, str(e))
+            finally:
+                cli.close()
+            with lock:
+                outcomes.append(out)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        n_ok = sum(1 for o in outcomes if o[0] == "ok" and o[1] == 8)
+        sheds = [o[1] for o in outcomes if o[0] == "shed"]
+        _check(n_ok + len(sheds) == 8,
+               f"flood must split into completed + door-shed, got "
+               f"{outcomes}")
+        _check(n_ok >= 2, f"capacity-2 fleet should finish at least "
+               f"2 streams: {outcomes}")
+        _check(len(sheds) >= 4,
+               f"a 4x-capacity flood should shed most of the wave: "
+               f"{outcomes}")
+        _check(all("retry_after_ms=" in s for s in sheds),
+               f"every shed must carry the aggregated retry-after "
+               f"hint: {sheds}")
+        snap = router.snapshot()
+        _check(snap["shed_total"] == len(sheds),
+               f"router_shed_total disagrees with client sheds: "
+               f"{snap} vs {len(sheds)}")
+        _check(snap["failovers_total"] == 0
+               and snap["retries_total"] == 0,
+               f"saturation must not look like failure (no retries, "
+               f"no failovers): {snap}")
+        # the stream thread decrements its gauge just after the
+        # terminal frame the client saw — allow that cleanup a moment
+        deadline = time.time() + 10
+        while snap["streams_active"] != 0 and time.time() < deadline:
+            time.sleep(0.05)
+            snap = router.snapshot()
+        _check(snap["streams_active"] == 0,
+               f"router must hold no queued streams after the flood: "
+               f"{snap}")
+        _check(all(b["breaker"]["state"] == "closed"
+                   and b["breaker"]["opened_total"] == 0
+                   for b in snap["backends"]),
+               f"admission rejections must never trip a breaker: "
+               f"{snap}")
+    finally:
+        if router is not None:
+            router.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(10)
+            except subprocess.TimeoutExpired:
+                pass
+        pt.set_flags({"enable_metrics": prev_metrics})
+    return (f"{n_ok} streams finished, {len(sheds)} door-shed with "
+            f"retry hints; 0 retries, 0 failovers, breakers closed")
+
+
 DRILLS = {
     "kill_mid_save": drill_kill_mid_save,
     "corrupt_leaf": drill_corrupt_leaf,
@@ -1518,6 +1782,8 @@ DRILLS = {
     "llm_prefix_cow_leak": drill_llm_prefix_cow_leak,
     "llm_spec_rollback": drill_llm_spec_rollback,
     "llm_flight_deck": drill_llm_flight_deck,
+    "router_backend_kill": drill_router_backend_kill,
+    "router_all_saturated": drill_router_all_saturated,
 }
 
 

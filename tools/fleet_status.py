@@ -115,7 +115,45 @@ def _host_top_frames(fstacks: Dict[str, Any]) -> Dict[str, str]:
     return out
 
 
-def render(addr: str, stacks: bool = False) -> int:
+def _render_router(addr: str) -> None:
+    """The ``--router`` backend-pool table: every live front-door
+    router registered on the exporter's ``GET /router`` endpoint
+    (serving_llm/router.py), one row per backend with its rotation
+    state, live stream count, and breaker posture."""
+    code, rt = _get(addr, "/router")
+    routers = rt.get("routers", []) if isinstance(rt, dict) else []
+    if code != 200 or not routers:
+        print("router: none registered on this exporter")
+        return
+    for r in routers:
+        print(f"router @ {r.get('addr')}: "
+              f"{r.get('available', 0)}/{len(r.get('backends', []))} "
+              f"backend(s) in rotation, "
+              f"streams={r.get('streams_active', 0)} "
+              f"failovers={r.get('failovers_total', 0)} "
+              f"retries={r.get('retries_total', 0)} "
+              f"shed={r.get('shed_total', 0)}")
+        cols = ("backend", "state", "streams", "breaker",
+                "consec fails", "opened", "last error")
+        rows = []
+        for b in r.get("backends", []):
+            br = b.get("breaker") or {}
+            rows.append((str(b.get("name")), str(b.get("state")),
+                         str(b.get("streams_active", 0)),
+                         str(br.get("state", "-")),
+                         str(br.get("failures", 0)),
+                         str(br.get("opened_total", 0)),
+                         str(b.get("last_error") or "-")[:40]))
+        widths = [max(len(c), *(len(row[i]) for row in rows)) if rows
+                  else len(c) for i, c in enumerate(cols)]
+        print("  " + "  ".join(c.ljust(w)
+                               for c, w in zip(cols, widths)))
+        for row in rows:
+            print("  " + "  ".join(v.ljust(w)
+                                   for v, w in zip(row, widths)))
+
+
+def render(addr: str, stacks: bool = False, router: bool = False) -> int:
     """Print the fleet table; exit 0 healthy, 1 degraded/unreachable."""
     try:
         _, view = _get(addr, "/fleet?format=json")
@@ -166,6 +204,8 @@ def render(addr: str, stacks: bool = False) -> int:
     print("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
     for r in rows:
         print("  ".join(v.ljust(w) for v, w in zip(r, widths)))
+    if router:
+        _render_router(addr)
     if view.get("merge_error"):
         print(f"MERGE ERROR: {view['merge_error']}", file=sys.stderr)
         return 1
@@ -349,7 +389,12 @@ def self_test() -> int:
         assert tops["w1"].startswith("unreachable"), tops
         print("/fleet/stacks: live workers dumped, dead worker "
               "degraded to error")
-        render(addr, stacks=True)
+        # --router table: no router lives in the aggregator process,
+        # so GET /router answers the empty roster and the renderer
+        # degrades to a one-liner instead of erroring
+        code, rt = _get(addr, "/router")
+        assert code == 200 and rt["routers"] == [], rt
+        render(addr, stacks=True, router=True)
     finally:
         for p in workers:
             if p.poll() is None:
@@ -375,6 +420,9 @@ def main(argv=None) -> int:
     ap.add_argument("--stacks", action="store_true",
                     help="add each worker's current top frame "
                          "(live /fleet/stacks fan-out)")
+    ap.add_argument("--router", action="store_true",
+                    help="add the front-door router backend-pool "
+                         "table (the exporter's GET /router snapshot)")
     ap.add_argument("--self-test", action="store_true")
     args = ap.parse_args(argv)
     if args.self_test:
@@ -386,11 +434,11 @@ def main(argv=None) -> int:
         try:
             while True:
                 print("\033[2J\033[H", end="")
-                render(addr, stacks=args.stacks)
+                render(addr, stacks=args.stacks, router=args.router)
                 time.sleep(args.watch)
         except KeyboardInterrupt:
             return 0
-    return render(addr, stacks=args.stacks)
+    return render(addr, stacks=args.stacks, router=args.router)
 
 
 if __name__ == "__main__":

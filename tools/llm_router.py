@@ -1,0 +1,261 @@
+"""Front-door LLM router launcher + no-TPU self-test.
+
+Runs ``paddle_tpu.serving_llm.router.Router`` as its own process: a
+stdlib front door speaking the serving wire protocol
+(docs/serving_protocol.md) that spreads streams over N
+``inference.Server`` backends with health-gated rotation, circuit
+breaking, and deterministic mid-stream failover
+(docs/fault_tolerance.md, "Router failover taxonomy").
+
+Usage:
+    python tools/llm_router.py --backend H:P --backend H:P [--port N]
+    python tools/llm_router.py --self-test       # no-TPU CI drill
+
+A backend spec is ``host:port`` (the serving wire port) or
+``host:port:healthzport`` to add exporter ``/healthz`` probing beside
+the PTSC STATS probe. ``--portfile`` writes the bound router port for
+scripting (the launcher idiom tools/chaos_drill.py uses).
+
+``--self-test`` boots TWO real backend processes with identical
+weights (both seed ``pt.seed(0)`` before building the model), routes
+a stream through them, SIGKILLs the backend that is actively serving
+it after two delivered tokens, and asserts the spliced client-visible
+sequence is bitwise identical to an uninterrupted single-backend
+reference at temperature 0.8 — the position-keyed-sampling failover
+guarantee — with exactly one failover counted, zero retries, and a
+clean KV audit on the SIGTERMed survivor.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+
+# --------------------------------------------------------------- self-test
+
+_BACKEND_SRC = r"""
+import json, sys
+import paddle_tpu as pt
+from paddle_tpu.inference import Server
+from paddle_tpu.models import GPTLanguageModel
+from paddle_tpu.serving_llm import LLMEngine
+
+out, portfile = sys.argv[1], sys.argv[2]
+# identical weights on every backend — the precondition for exact
+# failover parity (a real fleet loads the same checkpoint)
+pt.seed(0)
+model = GPTLanguageModel()
+engine = LLMEngine(model, block_size=4, pool_blocks=256)
+srv = Server(None, llm_engine=engine)
+
+def on_drained(server):
+    ok = True
+    try:
+        engine.allocator.check()
+    except AssertionError:
+        ok = False
+    json.dump({"kv_used": engine.allocator.num_used,
+               "check_ok": ok,
+               "gauges_ok": bool(engine.allocator.gauges_agree()),
+               "open_streams": len(server._llm._reqs)},
+              open(out, "w"))
+
+with open(portfile, "w") as f:
+    f.write(str(srv.port))
+srv.serve_forever(on_drained=on_drained)
+"""
+
+
+def _spawn_backend(tmp: str, idx: int):
+    """One backend subprocess; returns (proc, port, audit_path)."""
+    script = os.path.join(tmp, f"backend_{idx}.py")
+    with open(script, "w") as f:
+        f.write(_BACKEND_SRC)
+    audit = os.path.join(tmp, f"audit_{idx}.json")
+    portfile = os.path.join(tmp, f"port_{idx}.txt")
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT,
+                "FLAGS_enable_metrics": "1", "FLAGS_metrics_port": "-1",
+                "FLAGS_serving_drain_deadline_s": "5.0"})
+    proc = subprocess.Popen([sys.executable, script, audit, portfile],
+                            env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    return proc, portfile, audit
+
+
+def _wait_port(proc, portfile: str, timeout_s: float = 180.0) -> int:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if os.path.exists(portfile):
+            return int(open(portfile).read())
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"backend died during startup:\n{proc.communicate()[1]}")
+        time.sleep(0.1)
+    raise AssertionError("backend never bound its port")
+
+
+def self_test() -> int:
+    """Kill-one-of-two mid-stream; the client must not notice."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.inference import Client
+    from paddle_tpu.serving_llm.router import Router
+
+    pt.set_flags({"enable_metrics": True, "metrics_port": -1,
+                  "router_retry_backoff_s": 0.0,
+                  "router_probe_interval_s": 0.3})
+    tmp = tempfile.mkdtemp(prefix="llm_router_selftest_")
+    procs = []
+    router = None
+    try:
+        pa, pfa, audit_a = _spawn_backend(tmp, 0)
+        pb, pfb, audit_b = _spawn_backend(tmp, 1)
+        procs = [pa, pb]
+        port_a = _wait_port(pa, pfa)
+        port_b = _wait_port(pb, pfb)
+        print(f"backends up: {port_a} {port_b}", flush=True)
+
+        prompt = (np.arange(8, dtype=np.int32) * 3) % 64
+        gen_kw = dict(max_new_tokens=24, temperature=0.8, seed=7)
+
+        # uninterrupted single-backend reference (backend A)
+        with Client(port=port_a, timeout_s=120.0,
+                    deadline_s=120.0) as cli:
+            ref = cli.generate(prompt, **gen_kw).tolist()
+            ref0 = cli.generate(prompt, max_new_tokens=8,
+                                temperature=0.0).tolist()
+        assert len(ref) == 24, ref
+        print(f"reference tokens: {ref}", flush=True)
+
+        router = Router([("127.0.0.1", port_a), ("127.0.0.1", port_b)],
+                        probe_interval_s=0.3).start()
+        print(f"router up: {router.port}", flush=True)
+
+        # stream through the router; SIGKILL the serving backend
+        # after two delivered tokens
+        got = []
+        with Client(port=router.port, timeout_s=120.0,
+                    deadline_s=120.0) as cli:
+            for i, chunk in enumerate(cli.generate_stream(
+                    prompt, **gen_kw)):
+                got.extend(int(t) for t in np.asarray(chunk).ravel())
+                if i == 1:
+                    snap = router.snapshot()
+                    busy = [b["name"] for b in snap["backends"]
+                            if b["streams_active"] > 0]
+                    assert len(busy) == 1, snap
+                    victim_port = int(busy[0].rsplit(":", 1)[1])
+                    victim = pa if victim_port == port_a else pb
+                    victim.send_signal(signal.SIGKILL)
+                    print(f"SIGKILLed backend :{victim_port} after "
+                          f"{len(got)} tokens", flush=True)
+            assert got == ref, (got, ref)
+            print("failover parity OK (temperature 0.8)", flush=True)
+
+            snap = router.snapshot()
+            assert snap["failovers_total"] == 1, snap
+            assert snap["retries_total"] == 0, snap
+            assert snap["shed_total"] == 0, snap
+
+            # survivor still serves; temp-0 parity across processes
+            # proves the seeded weights really are identical
+            out0 = cli.generate(prompt, max_new_tokens=8,
+                                temperature=0.0).tolist()
+            assert out0 == ref0, (out0, ref0)
+            print("survivor parity OK (temperature 0)", flush=True)
+
+        victim.wait(10)
+        survivor = pb if victim is pa else pa
+        survivor_audit = audit_b if victim is pa else audit_a
+
+        # SIGTERM the survivor: graceful drain, then a clean KV audit
+        survivor.send_signal(signal.SIGTERM)
+        rc = survivor.wait(60)
+        assert rc == -signal.SIGTERM, rc
+        audit = json.load(open(survivor_audit))
+        assert audit["kv_used"] == 0, audit
+        assert audit["check_ok"] and audit["gauges_ok"], audit
+        assert audit["open_streams"] == 0, audit
+        print(f"survivor audit clean: {audit}", flush=True)
+    finally:
+        if router is not None:
+            router.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in procs:
+            try:
+                p.wait(10)
+            except subprocess.TimeoutExpired:
+                pass
+    print("self-test OK")
+    return 0
+
+
+# -------------------------------------------------------------- launcher
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="front-door router over N LLM serving backends "
+                    "(health-gated rotation, circuit breaking, "
+                    "deterministic mid-stream failover)")
+    ap.add_argument("--backend", action="append", default=[],
+                    metavar="HOST:PORT[:HEALTHZPORT]",
+                    help="serving backend (repeatable; optional third "
+                         "field = exporter port for /healthz probes)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="router listen port (0 = ephemeral)")
+    ap.add_argument("--probe-interval", type=float, default=None,
+                    metavar="S", help="backend probe period "
+                    "(default FLAGS_router_probe_interval_s)")
+    ap.add_argument("--portfile", default=None,
+                    help="write the bound port here once listening")
+    ap.add_argument("--self-test", action="store_true")
+    args = ap.parse_args(argv)
+    if args.self_test:
+        return self_test()
+    if not args.backend:
+        ap.error("at least one --backend required (or --self-test)")
+
+    from paddle_tpu.serving_llm.router import Router
+    router = Router(args.backend, host=args.host, port=args.port,
+                    probe_interval_s=args.probe_interval).start()
+    print(f"llm_router: listening on {router.addr}, "
+          f"{len(router.pool.backends)} backend(s)", flush=True)
+    if args.portfile:
+        with open(args.portfile, "w") as f:
+            f.write(str(router.port))
+
+    stop = threading.Event()
+
+    def _sig(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    try:
+        while not stop.is_set():
+            stop.wait(0.5)
+    finally:
+        router.stop()
+        print("llm_router: stopped", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
