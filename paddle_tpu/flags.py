@@ -555,6 +555,50 @@ define_flag("kv_admission_watermark", 0.0,
             "counted in llm_admission_rejected_total. 0 (default) "
             "disables the gate; admitted load can then exceed the "
             "pool and is handled by preemption.")
+define_flag("tenant_fair_share", False,
+            "LLM serving multi-tenancy: weighted fair-share "
+            "admission. Off (default), the waiting queue is strictly "
+            "FCFS across every tenant. On, each admission slot goes "
+            "to the head of the tenant queue with the LOWEST "
+            "weight-normalized token-second service (cumulative "
+            "resident context-length x wall-seconds / "
+            "FLAGS_tenant_weights weight), FCFS *within* each tenant, "
+            "so one tenant's prompt flood can no longer starve the "
+            "rest. A tenant returning from idle is floored to the "
+            "current minimum service so it cannot replay its idle "
+            "time as a monopoly. Victim selection under pool "
+            "pressure is always (priority class asc, admission seq "
+            "desc) — preempt-lowest-class, youngest within class — "
+            "and a grower never evicts a higher class than its own. "
+            "Read every scheduler pass, so it can be flipped on a "
+            "live server.")
+define_flag("tenant_weights", "",
+            "LLM serving multi-tenancy: fair-share weights as "
+            "'tenant=weight,tenant=weight' (e.g. "
+            "'premium-corp=10,scraper=1'). Tenants not listed weigh "
+            "1.0; weight 0 means the tenant runs only when every "
+            "weighted tenant is idle (it still progresses then — "
+            "the starvation floor). Malformed entries are skipped, "
+            "not fatal. Read per admission pass under "
+            "FLAGS_tenant_fair_share.")
+define_flag("tenant_kv_budget", "",
+            "LLM serving multi-tenancy: per-tenant KV-block budgets "
+            "as 'tenant=fraction,tenant=fraction' of kv_pool_blocks "
+            "(e.g. 'bulk-ingest=0.5'). A tenant at its budget is "
+            "rejected at add_request with a retry-after hint "
+            "(llm_admission_rejected_total{tenant=}) even when the "
+            "global kv_admission_watermark still has room — bulk "
+            "load exhausts bulk's budget, never the pool premium "
+            "needs. Unlisted tenants are uncapped. Read per "
+            "admission gate.")
+define_flag("tenant_label_max", 16,
+            "Metric-cardinality bound for the {tenant=} label on "
+            "serving counters (requests_shed_total, "
+            "llm_admission_rejected_total, router_shed_total, "
+            "llm_tenant_admitted_total, llm_tenant_active): the "
+            "first N distinct tenant ids keep verbatim labels, the "
+            "rest share 16 stable crc32 overflow buckets "
+            "(serving_llm/tenancy.py). Read per label lookup.")
 define_flag("serving_drain_deadline_s", 5.0,
             "Graceful drain budget for inference.Server. When a "
             "drain starts (SIGTERM under Server.serve_forever, or "
@@ -721,6 +765,18 @@ define_flag("router_backend_deadline_s", 30.0,
             "backend silent past this is treated as dead: breaker "
             "failure plus retry (unstarted) or deterministic failover "
             "(started). Read per backend attempt.")
+define_flag("router_prefix_affinity", False,
+            "Front-door router: prefix-affinity pick(). On, the "
+            "router hashes each prompt's leading FULL KV blocks "
+            "(FLAGS_kv_block_size tokens each) and routes to the "
+            "backend that most recently served the longest matching "
+            "prefix (LRU placement memory, longest match wins), so "
+            "shared-prefix traffic lands where its blocks are "
+            "already hot and FLAGS_kv_prefix_sharing hits multiply "
+            "fleet-wide (kv_prefix_hit_tokens_total). No affinity "
+            "match falls back to least-loaded by live stream count "
+            "(round-robin order breaking ties). Off (default) keeps "
+            "pure round-robin. Read per stream dispatch.")
 
 
 def _fault_spec_changed(value) -> None:

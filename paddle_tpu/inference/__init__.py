@@ -531,6 +531,33 @@ class Server:
                 "ingress_unix": ingress, "dequeue_unix": time.time(),
                 "dequeue_mono": time.monotonic(), "stream": is_stream}
 
+    @staticmethod
+    def _req_tenancy(req: Dict[str, Any]) -> Tuple[str, str]:
+        """(tenant, class) of a request for shed/reject accounting.
+        Streams carry the optional uint8 tenant descriptor in their
+        PTST body (serving_llm/tenancy.py); everything else — tensor
+        requests, malformed bodies, pre-tenancy frames — accounts as
+        default/standard. Memoized on the req dict (the bridge sets
+        the same keys when it admits the stream)."""
+        from ..serving_llm import tenancy
+        if "tenant" in req:
+            return req["tenant"], req.get("class",
+                                          tenancy.DEFAULT_CLASS)
+        tenant, cls = tenancy.DEFAULT_TENANT, tenancy.DEFAULT_CLASS
+        if req.get("stream"):
+            try:
+                hdr = struct.calcsize("<IIfI")
+                for arr in decode_tensors(
+                        req["payload"][hdr:])[1:]:
+                    if arr.dtype == np.uint8:
+                        tenant, cls = tenancy.decode_descriptor(arr)
+                        break
+            # ptlint: disable=silent-failure -- a body the bridge itself would reject parses as the default tenant; the shed/decode error is counted by the caller
+            except Exception:  # noqa: BLE001
+                pass
+        req["tenant"], req["class"] = tenant, cls
+        return tenant, cls
+
     def _drain_transport(self) -> None:
         while True:
             r = self.transport.next_request_ex2(timeout_ms=0)
@@ -586,12 +613,17 @@ class Server:
                        deadline_ms=round(deadline_s * 1e3, 3))
         from .. import observability as obs
         if obs.enabled():
+            from ..serving_llm import tenancy
+            tenant, _cls = self._req_tenancy(req)
             obs.counter("requests_shed_total",
                         "requests answered with an error because they "
                         "sat in the serving queue longer than the "
                         "queue deadline (kind=stream for PTST "
-                        "generates, kind=tensor otherwise)").inc(
-                kind="stream" if req.get("stream") else "tensor")
+                        "generates, kind=tensor otherwise; tenant= is "
+                        "the bounded tenant label, default for "
+                        "tenant-less frames)").inc(
+                kind="stream" if req.get("stream") else "tensor",
+                tenant=tenancy.tenant_label(tenant))
             self._record_span(req, status=-1, outcome="shed",
                               reply_unix=time.time())
 
@@ -712,12 +744,17 @@ class Server:
             pass
         from .. import observability as obs
         if obs.enabled():
+            from ..serving_llm import tenancy
+            tenant, _cls = self._req_tenancy(req)
             obs.counter("requests_shed_total",
                         "requests answered with an error because they "
                         "sat in the serving queue longer than the "
                         "queue deadline (kind=stream for PTST "
-                        "generates, kind=tensor otherwise)").inc(
-                kind="stream" if req.get("stream") else "tensor")
+                        "generates, kind=tensor otherwise; tenant= is "
+                        "the bounded tenant label, default for "
+                        "tenant-less frames)").inc(
+                kind="stream" if req.get("stream") else "tensor",
+                tenant=tenancy.tenant_label(tenant))
             self._record_span(req, status=-1, outcome="draining",
                               reply_unix=time.time())
 
@@ -867,6 +904,9 @@ class Server:
                 rec["batch_members"] = batch_members
             if error is not None:
                 rec["error"] = error
+            if "tenant" in req:  # streams: per-tenant gap attribution
+                rec["tenant"] = req["tenant"]
+                rec["cls"] = req.get("class")
 
             def span_ms(a, b):
                 if rec.get(a) is None or rec.get(b) is None:
@@ -1185,7 +1225,9 @@ class Client:
                         temperature: float = 0.0, seed: int = 0,
                         deadline_s: Optional[float] = None,
                         trace_id: Optional[int] = None,
-                        sample_offset: int = 0):
+                        sample_offset: int = 0,
+                        tenant: Optional[str] = None,
+                        priority_class: Optional[str] = None):
         """Streaming generate: send one 'PTST' frame, then yield each
         token chunk (an int32 array, length 1 per chunk) as the server
         streams it, until the terminal frame (docs/serving_protocol.md,
@@ -1213,6 +1255,13 @@ class Client:
         idempotent and the server keeps decoding until its next write
         fails, so a resend could double-generate. (``generate`` allows
         exactly one retry iff zero chunks arrived.)
+
+        ``tenant``/``priority_class`` ride the optional uint8 tenant
+        descriptor tensor (docs/serving_protocol.md, "Tenant
+        descriptor"): who pays for the tokens and what isolation
+        class they bought (bulk < standard < premium). Omitted, the
+        frame is byte-identical to a pre-tenancy client's and the
+        server accounts it as default/standard.
         """
         if trace_id is None:
             trace_id = self.make_trace_id()
@@ -1225,6 +1274,11 @@ class Client:
         arrays = [np.ascontiguousarray(prompt_ids, dtype=np.int32)]
         if sample_offset:
             arrays.append(np.asarray([int(sample_offset)], np.int32))
+        if tenant is not None or priority_class is not None:
+            from ..serving_llm import tenancy
+            arrays.append(tenancy.encode_descriptor(
+                tenant or tenancy.DEFAULT_TENANT,
+                priority_class or tenancy.DEFAULT_CLASS))
         body += encode_tensors(arrays)
         with self._rcond:
             gen = self._gen

@@ -160,6 +160,21 @@ class Counter(_Instrument):
         with self._lock:
             return self._series.get(_label_key(labels), 0)
 
+    def total(self, **labels) -> float:
+        """Sum across every series whose labels contain ``labels`` as
+        a subset — the roll-up readers need once a counter gains a
+        new label dimension (e.g. requests_shed_total{kind=,tenant=}:
+        ``total(kind="stream")`` sums over tenants)."""
+        want = {k: str(v) for k, v in labels.items()}
+        with self._lock:
+            items = list(self._series.items())
+        out = 0.0
+        for key, v in items:
+            have = dict(key)
+            if all(have.get(k) == lv for k, lv in want.items()):
+                out += v
+        return out
+
     # compat for the old StatRegistry.set() (monitor.h allowed it);
     # not part of the counter contract proper.
     def set_total(self, value: float, **labels) -> None:

@@ -54,6 +54,7 @@ import numpy as np
 from ..models.gpt_lm import dense_causal_attention
 from ..observability import seqtrace as _seqtrace
 from ..observability import stepprof as _stepprof
+from . import tenancy
 from .kv_cache import KVBlockAllocator
 from .scheduler import ContinuousBatchingScheduler, Sequence
 
@@ -115,6 +116,8 @@ class LLMEngine:
         self._v_pools = [jnp.zeros(shape, jnp.float32)
                          for _ in range(cfg.num_layers)]
         self._seqs: Dict[int, Sequence] = {}  # guarded-by: single-owner (serving thread)
+        # tenant labels that ever held a live sequence (gauge zeroing)
+        self._tenant_labels_seen: set = set()
         self._next_seq = 0
         self.tokens_generated = 0
         # projected peak blocks per live sequence (watermark gate)
@@ -151,7 +154,10 @@ class LLMEngine:
     def add_request(self, prompt_ids, max_new_tokens: int = 16,
                     eos_token_id: Optional[int] = None,
                     temperature: float = 0.0, seed: int = 0,
-                    trace_id: int = 0, sample_offset: int = 0) -> int:
+                    trace_id: int = 0, sample_offset: int = 0,
+                    tenant: str = tenancy.DEFAULT_TENANT,
+                    priority_class: str = tenancy.DEFAULT_CLASS
+                    ) -> int:
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -162,21 +168,34 @@ class LLMEngine:
             raise ValueError("max_new_tokens must be >= 1")
         if sample_offset < 0:
             raise ValueError("sample_offset must be >= 0")
-        projected = self._admission_gate(prompt, int(max_new_tokens))
+        tenant = tenancy.sanitize_tenant(tenant)
+        priority_class = tenancy.normalize_class(priority_class)
+        projected = self._admission_gate(prompt, int(max_new_tokens),
+                                         tenant, priority_class)
         self._next_seq += 1
         seq = Sequence(seq_id=self._next_seq, prompt=prompt,
                        max_new_tokens=int(max_new_tokens),
                        eos_token_id=eos_token_id,
                        temperature=float(temperature), seed=int(seed),
-                       sample_offset=int(sample_offset))
+                       sample_offset=int(sample_offset),
+                       tenant=tenant, priority_class=priority_class)
         self._seqs[seq.seq_id] = seq
         self._projected[seq.seq_id] = projected
         self.scheduler.add(seq)
+        from .. import observability as obs
+        if obs.enabled():
+            obs.counter("llm_tenant_admitted_total",
+                        "sequences accepted into the engine per "
+                        "tenant label (past the watermark AND the "
+                        "tenant KV budget; the fleet_status.py "
+                        "--tenants admitted column)").inc(
+                            tenant=tenancy.tenant_label(tenant))
         # seq timeline opens here; trace_id is the wire id the bridge
         # carries so /requests records link to this /llm/seqs entry
         _seqtrace.begin(seq.seq_id, trace_id=int(trace_id),
                         engine=id(self), prompt_tokens=len(prompt),
-                        max_new_tokens=int(max_new_tokens))
+                        max_new_tokens=int(max_new_tokens),
+                        tenant=tenant, cls=priority_class)
         return seq.seq_id
 
     def _projected_blocks(self, prompt: List[int],
@@ -202,12 +221,18 @@ class LLMEngine:
             m = max(m, c)
         return max(1, projected - m // self.block_size)
 
-    def _admission_gate(self, prompt: List[int], max_new: int) -> int:
+    def _admission_gate(self, prompt: List[int], max_new: int,
+                        tenant: str = tenancy.DEFAULT_TENANT,
+                        priority_class: str = tenancy.DEFAULT_CLASS
+                        ) -> int:
         """KV-watermark admission control: compute the sequence's
         projected peak block demand (an upper bound — blocks for
         prompt + max_new tokens, minus blocks prefix sharing will
         satisfy) and reject when the summed projection of every live
-        sequence would cross the watermark. Admitted load then
+        sequence would cross the watermark, OR when this tenant's own
+        summed projection would cross its FLAGS_tenant_kv_budget
+        fraction of the pool (bulk load exhausts bulk's budget, never
+        the headroom premium admissions need). Admitted load then
         provably fits without preemption."""
         projected = self._projected_blocks(prompt, max_new)
         from ..flags import GLOBAL_FLAGS
@@ -215,12 +240,32 @@ class LLMEngine:
             watermark = float(GLOBAL_FLAGS.get("kv_admission_watermark"))
         except Exception:  # noqa: BLE001
             watermark = 0.0
+        # the tenant budget gates even when the global watermark is
+        # off: it is an isolation contract, not an overload valve
+        frac = tenancy.tenant_budget_frac(tenant)
+        if frac is not None:
+            t_budget = frac * self.pool_blocks
+            t_committed = sum(
+                p for sid, p in self._projected.items()
+                if (s := self._seqs.get(sid)) is not None
+                and s.tenant == tenant)
+            if t_committed + projected > t_budget:
+                self._reject(projected, t_committed, t_budget, tenant,
+                             reason="tenant_budget")
         if watermark <= 0:
             return projected
         budget = watermark * self.pool_blocks
         committed = sum(self._projected.values())
         if committed + projected <= budget:
             return projected
+        self._reject(projected, committed, budget, tenant,
+                     reason="watermark")
+        raise AssertionError("unreachable")  # _reject always raises
+
+    def _reject(self, projected: int, committed: float, budget: float,
+                tenant: str, reason: str) -> None:
+        """Count + flight-record one admission rejection and raise
+        AdmissionRejected with the retry-after hint."""
         self.admission_rejected_total += 1
         # backoff hint scaled to how much work is ahead of the caller
         load = len(self.scheduler.running) + len(self.scheduler.waiting)
@@ -230,17 +275,23 @@ class LLMEngine:
                        projected_blocks=projected,
                        committed_blocks=committed,
                        budget_blocks=round(budget, 1),
+                       reason=reason, tenant=tenant,
                        retry_after_ms=retry_after_ms)
         from .. import observability as obs
         if obs.enabled():
             obs.counter("llm_admission_rejected_total",
-                        "new sequences refused by the KV-watermark "
-                        "admission gate (kv_admission_watermark) "
-                        "before prefill — overload fail-fast, not a "
-                        "shed or a preemption").inc()
+                        "new sequences refused before prefill, per "
+                        "tenant label — by the KV-watermark admission "
+                        "gate (kv_admission_watermark) or the "
+                        "tenant's own KV budget (tenant_kv_budget); "
+                        "overload fail-fast, not a shed or a "
+                        "preemption").inc(
+                            tenant=tenancy.tenant_label(tenant))
+        what = ("tenant KV budget" if reason == "tenant_budget"
+                else "watermark budget")
         raise AdmissionRejected(
             f"admission rejected: projected {projected} KV blocks + "
-            f"{committed} committed exceeds watermark budget "
+            f"{committed} committed exceeds {what} "
             f"{budget:.1f} of {self.pool_blocks}; "
             f"retry_after_ms={retry_after_ms}", retry_after_ms)
 
@@ -283,6 +334,8 @@ class LLMEngine:
             events = self._step_inner()
         finally:
             dt = time.perf_counter() - t0
+            # fair-share ledger: resident context x step wall time
+            self.scheduler.charge(dt)
             stalls_before = self.stalls_total
             self._note_step(dt)
             self._prof_end(dt, events,
@@ -438,6 +491,11 @@ class LLMEngine:
             if r is None:
                 continue
             if r is False:
+                if seq not in self.scheduler.running:
+                    # preempted itself: higher-class residents hold
+                    # the pool; the write aborts and readmission
+                    # retries (callers check running membership)
+                    return
                 raise RuntimeError(
                     f"sequence needs a private copy of a shared KV "
                     f"block but the pool holds "
@@ -481,6 +539,8 @@ class LLMEngine:
         # COW before any write: the first uncached position may land
         # in a block still shared with another sequence
         self._make_writable(seq, c0, c0 + n)
+        if seq not in self.scheduler.running:
+            return []  # preempted itself inside the COW gate
         pos = np.arange(c0, c0 + n, dtype=np.int32)
         blks, offs = self._slots(seq, pos)
         cb = co = None
@@ -563,6 +623,10 @@ class LLMEngine:
                 events.append(self._fail(seq, f"decode: {e}"))
                 continue
             if not grown:
+                if seq not in self.scheduler.running:
+                    # preempted ITSELF: higher-class residents hold
+                    # the pool — it waits for readmission, not death
+                    continue
                 events.append(self._fail(
                     seq, f"sequence needs {seq.ctx_len + 1} tokens of "
                          f"KV cache but the pool holds "
@@ -741,6 +805,8 @@ class LLMEngine:
             self._prof_acc("spec_verify", prop_ms)
             prop_ms_by[seq.seq_id] = prop_ms
             if not grown:
+                if seq not in self.scheduler.running:
+                    continue  # preempted itself (class-gated pool)
                 events.append(self._fail(
                     seq, f"sequence needs "
                          f"{seq.ctx_len + len(proposal) + 1} tokens "
@@ -1093,3 +1159,16 @@ class LLMEngine:
                   "yet in the decode batch)").set(float(
                       sum(1 for s in self.scheduler.running
                           if not s.prefill_done)))
+        active: Dict[str, int] = {}
+        for s in self.scheduler.running:
+            lbl = tenancy.tenant_label(s.tenant)
+            active[lbl] = active.get(lbl, 0) + 1
+        g = obs.gauge("llm_tenant_active",
+                      "live sequences (running set) per tenant label "
+                      "— the fleet_status.py --tenants active column")
+        for lbl, n in active.items():
+            g.set(float(n), tenant=lbl)
+        # a tenant that just drained must read 0, not its last value
+        for lbl in self._tenant_labels_seen - set(active):
+            g.set(0.0, tenant=lbl)
+        self._tenant_labels_seen |= set(active)

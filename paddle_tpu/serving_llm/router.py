@@ -38,10 +38,24 @@ Four pillars, each independently testable:
   graceful degradation, no retry storms against open breakers.
 * **Observability** — ``router_backend_state{backend=}`` gauge,
   ``router_failovers_total`` / ``router_retries_total`` /
-  ``router_shed_total`` counters, per-hop reqtrace spans riding the
-  client's trace_id, flight events for breaker transitions and
-  failovers, and a ``GET /router`` JSON snapshot on the exporter
+  ``router_shed_total{tenant=}`` counters, per-hop reqtrace spans
+  riding the client's trace_id, flight events for breaker transitions
+  and failovers, and a ``GET /router`` JSON snapshot on the exporter
   (module-level registry, :func:`snapshot_all`).
+
+Tenancy rides the same wire (docs/serving_protocol.md, "Tenant
+descriptor"): a PTST frame may carry a uint8 tenant descriptor, which
+the router decodes, forwards verbatim to the backend, and uses for
+two class-aware decisions. Under ``FLAGS_router_prefix_affinity``,
+``pick`` routes a prompt to the backend already holding its longest
+recorded leading-block prefix (multiplying the backends'
+``kv_prefix_hit_tokens_total``), falling back to a class-weighted
+load pick — premium to the least-loaded backend, bulk packed onto the
+busiest so the quiet one keeps premium headroom. And under
+saturation the door sheds in class order: bulk gives up on the first
+saturated answer, standard sweeps the whole pool once (the PR-19
+default), premium re-sweeps until the retry budget is spent —
+``router_shed_total{tenant=}`` records who was turned away.
 
 Everything here is standard library + numpy; the router runs as its
 own process via tools/llm_router.py or in-process for tests.
@@ -55,6 +69,8 @@ import struct
 import threading
 import time
 import weakref
+import zlib
+from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence as Seq, Tuple
 
 import numpy as np
@@ -75,6 +91,11 @@ _EOS_NONE = 0xFFFFFFFF
 _MAX_PAYLOAD = 64 * 1024 * 1024
 _CONNECT_TIMEOUT_S = 5.0
 _PROBE_DEADLINE_S = 2.0
+# prefix-affinity placement map bounds (FLAGS_router_prefix_affinity):
+# at most _AFFINITY_BLOCKS leading full KV blocks are hashed per
+# prompt, and the LRU map holds at most _AFFINITY_CAP prefixes
+_AFFINITY_BLOCKS = 32
+_AFFINITY_CAP = 4096
 
 # numeric codes for the router_backend_state gauge (and the STATS
 # text): rotation-eligible is exactly code 0
@@ -528,6 +549,11 @@ class Router:
         # guarded-by: self._lock
         self._counts = {"failovers": 0, "retries": 0, "shed": 0,
                         "streams": 0, "proxied": 0}
+        # prefix-affinity placement map: crc32 of the leading full
+        # prompt blocks -> backend name, LRU-bounded at _AFFINITY_CAP.
+        # Advisory only — a dead/burned backend falls through to the
+        # class-weighted load pick. guarded-by: self._lock
+        self._affinity: "OrderedDict[int, str]" = OrderedDict()
         self._t0 = time.monotonic()
 
     # -- lifecycle --------------------------------------------------------
@@ -603,6 +629,94 @@ class Router:
     def _backend_deadline_s(self) -> float:
         return float(_flag("router_backend_deadline_s"))
 
+    @staticmethod
+    def _sat_persistence(cls: str) -> int:
+        """Extra full-pool sweeps a stream gets once every backend has
+        answered "saturated": premium persists for the full retry
+        budget, everyone else sheds after the single exhausted pass.
+        Together with the bulk early-shed in ``_serve_stream`` (bulk
+        gives up on the FIRST saturated answer, before sweeping the
+        rest of the pool) this is the router half of the shed order —
+        the door turns away bulk before standard before premium."""
+        from . import tenancy
+        if tenancy.class_rank(cls) >= tenancy.class_rank("premium"):
+            return max(0, int(_flag("router_retry_budget")))
+        return 0
+
+    # -- backend selection (prefix affinity + class-weighted load) --------
+
+    def _pick_backend(self, burned: List[Backend], prompt: np.ndarray,
+                      cls: str) -> Optional[Backend]:
+        """One backend for the next attempt. With
+        ``FLAGS_router_prefix_affinity`` off this is the PR-19
+        round-robin pick. With it on: route to the backend that
+        already holds the longest recorded prompt-block prefix (its
+        prefix cache turns the prompt into ``kv_prefix_hit_tokens``
+        instead of recompute); on a miss fall back to a
+        class-weighted load pick — premium takes the least-loaded
+        backend, bulk bin-packs onto the most-loaded one so the quiet
+        backend stays free for premium, standard keeps round-robin.
+        The chosen backend is recorded for the prompt's prefixes
+        either way, so concurrent same-prefix streams converge."""
+        if not bool(_flag("router_prefix_affinity")):
+            return self.pool.pick(exclude=burned)
+        from . import tenancy
+        keys = self._prefix_keys(prompt)
+        with self._lock:
+            name = next((self._affinity[k] for k in keys
+                         if k in self._affinity), None)
+        b = None
+        if name is not None:
+            b = next((x for x in self.pool.backends
+                      if x.name == name and x.in_rotation()
+                      and x not in burned), None)
+        if b is None:
+            cands = [x for x in self.pool.backends
+                     if x.in_rotation() and x not in burned]
+            if not cands:
+                return None
+            rank = tenancy.class_rank(cls)
+            if rank >= tenancy.class_rank("premium"):
+                b = min(cands, key=lambda x: x.stream_delta(0))
+            elif rank <= tenancy.class_rank("bulk"):
+                b = max(cands, key=lambda x: x.stream_delta(0))
+            else:
+                b = self.pool.pick(exclude=burned)
+        return self._record_affinity(keys, b)
+
+    def _prefix_keys(self, prompt: np.ndarray) -> List[int]:
+        """crc32 keys of the leading full KV blocks of ``prompt``,
+        longest prefix first (capped at ``_AFFINITY_BLOCKS`` blocks).
+        Block size mirrors the backends' paged KV allocator, so a key
+        hit means the backend's prefix cache can reuse exactly those
+        blocks."""
+        try:
+            bs = int(_flag("kv_block_size"))
+        # ptlint: disable=silent-failure -- affinity is advisory; an
+        # unreadable flag just disables the prefix keys
+        except Exception:
+            bs = 0
+        if bs <= 0:
+            return []
+        nb = min(len(prompt) // bs, _AFFINITY_BLOCKS)
+        if nb <= 0:
+            return []
+        raw = np.asarray(prompt[:nb * bs], np.int32).tobytes()
+        return [zlib.crc32(raw[:j * bs * 4])
+                for j in range(nb, 0, -1)]
+
+    def _record_affinity(self, keys: List[int],
+                         b: Optional[Backend]) -> Optional[Backend]:
+        if b is None or not keys:
+            return b
+        with self._lock:
+            for k in keys:
+                self._affinity[k] = b.name
+                self._affinity.move_to_end(k)
+            while len(self._affinity) > _AFFINITY_CAP:
+                self._affinity.popitem(last=False)
+        return b
+
     # -- accept / frame loop ----------------------------------------------
 
     def _accept_loop(self) -> None:
@@ -664,11 +778,19 @@ class Router:
             (trace_id,) = struct.unpack_from("<Q", payload, 0)
             max_new, eos_raw, temp, seed = _GEN_HDR.unpack_from(payload, 8)
             from ..inference import decode_tensors
+            from . import tenancy
             try:
                 arrs = decode_tensors(payload[8 + _GEN_HDR.size:])
                 prompt = np.asarray(arrs[0], np.int32).reshape(-1)
-                base_offset = int(arrs[1].reshape(-1)[0]) \
-                    if len(arrs) > 1 else 0
+                # optional tails, dtype-disambiguated like the bridge:
+                # int32 [1] resume offset, uint8 tenant descriptor
+                base_offset = 0
+                tenant_cls: Optional[Tuple[str, str]] = None
+                for arr in arrs[1:]:
+                    if arr.dtype == np.int32 and arr.size == 1:
+                        base_offset = int(arr.reshape(-1)[0])
+                    elif arr.dtype == np.uint8:
+                        tenant_cls = tenancy.decode_descriptor(arr)
             except Exception as e:  # noqa: BLE001 — fail ONE request
                 self._reply(conn, wlock, tag, -1,
                             f"router: bad generate body: {e}".encode())
@@ -677,7 +799,7 @@ class Router:
                 target=self._serve_stream,
                 args=(conn, wlock, tag, trace_id, prompt, int(max_new),
                       None if eos_raw == _EOS_NONE else int(eos_raw),
-                      float(temp), int(seed), base_offset),
+                      float(temp), int(seed), base_offset, tenant_cls),
                 name="router-stream", daemon=True).start()
         else:
             self._reply(conn, wlock, tag, -4,
@@ -743,12 +865,16 @@ class Router:
     def _serve_stream(self, conn, wlock, tag: int, trace_id: int,
                       prompt: np.ndarray, max_new: int,
                       eos: Optional[int], temp: float, seed: int,
-                      base_offset: int) -> None:
+                      base_offset: int,
+                      tenant_cls: Optional[Tuple[str, str]] = None) -> None:
+        from . import tenancy
+        tenant, cls = tenant_cls if tenant_cls is not None else (
+            tenancy.DEFAULT_TENANT, tenancy.DEFAULT_CLASS)
         t_ingress = time.time()
         delivered: List[int] = []
         burned: List[Backend] = []
         hints: List[int] = []
-        retries = failovers = 0
+        retries = failovers = sat_rounds = 0
         last_err = "no backend available"
         last_backend = ""
         dispatch_unix: Optional[float] = None
@@ -758,10 +884,21 @@ class Router:
             self._set_streams_gauge()
         try:
             while True:
-                b = self.pool.pick(exclude=burned)
+                b = self._pick_backend(burned, prompt, cls)
                 if b is None:
                     if hints and not delivered:
-                        self._shed(conn, wlock, tag, trace_id, hints)
+                        # every backend answered "saturated": how hard
+                        # we push back depends on the stream's class —
+                        # bulk sheds on the first exhausted pass,
+                        # standard re-sweeps the pool once, premium
+                        # persists to the full retry budget
+                        sat_rounds += 1
+                        if sat_rounds <= self._sat_persistence(cls):
+                            burned.clear()
+                            self._sleep_jittered(sat_rounds)
+                            continue
+                        self._shed(conn, wlock, tag, trace_id, hints,
+                                   tenant)
                         outcome = "shed"
                     else:
                         self._reply(
@@ -772,7 +909,7 @@ class Router:
                         outcome = "error"
                     self._trace(trace_id, t_ingress, dispatch_unix,
                                 last_backend, delivered, retries,
-                                failovers, outcome)
+                                failovers, outcome, tenant, cls)
                     return
                 burned.append(b)
                 last_backend = b.name
@@ -782,7 +919,8 @@ class Router:
                 try:
                     self._run_attempt(b, conn, wlock, tag, trace_id,
                                       prompt, delivered, max_new, eos,
-                                      temp, seed, base_offset)
+                                      temp, seed, base_offset,
+                                      tenant_cls)
                 except _ClientGone:
                     return  # downstream client gone; backend conn is
                     # closed, its dead-write path cancels the sequence
@@ -802,9 +940,23 @@ class Router:
                     elif _retry_hint(msg) is not None:
                         # saturated: collect the hint, try the next
                         # backend immediately (no backoff — the shed
-                        # decision needs every backend's answer)
+                        # decision needs every backend's answer).
+                        # Bulk streams don't even finish the sweep:
+                        # one saturated answer is their shed signal,
+                        # leaving the rest of the pool's headroom to
+                        # the classes above them.
                         hints.append(_retry_hint(msg))
                         last_err = msg
+                        from . import tenancy as _tn
+                        if (not delivered and _tn.class_rank(cls)
+                                <= _tn.class_rank("bulk")):
+                            self._shed(conn, wlock, tag, trace_id,
+                                       hints, tenant)
+                            self._trace(trace_id, t_ingress,
+                                        dispatch_unix, last_backend,
+                                        delivered, retries, failovers,
+                                        "shed", tenant, cls)
+                            return
                         continue
                     else:
                         # application error (bad params, execute
@@ -814,14 +966,15 @@ class Router:
                                     _strip_client_prefix(msg).encode())
                         self._trace(trace_id, t_ingress, dispatch_unix,
                                     last_backend, delivered, retries,
-                                    failovers, "backend_error")
+                                    failovers, "backend_error",
+                                    tenant, cls)
                         return
                 else:
                     # backend finished cleanly: close the stream
                     self._reply(conn, wlock, tag, 0, b"")
                     self._trace(trace_id, t_ingress, dispatch_unix,
                                 last_backend, delivered, retries,
-                                failovers, "ok")
+                                failovers, "ok", tenant, cls)
                     return
                 # infra failure: started streams fail over (resume
                 # with the offset), unstarted ones retry with backoff
@@ -835,7 +988,8 @@ class Router:
                             f"{last_err}".encode())
                         self._trace(trace_id, t_ingress, dispatch_unix,
                                     last_backend, delivered, retries,
-                                    failovers, "failover_exhausted")
+                                    failovers, "failover_exhausted",
+                                    tenant, cls)
                         return
                     self._count_failover(trace_id, b, delivered)
                 else:
@@ -847,7 +1001,8 @@ class Router:
                             f"{last_err}".encode())
                         self._trace(trace_id, t_ingress, dispatch_unix,
                                     last_backend, delivered, retries,
-                                    failovers, "retry_exhausted")
+                                    failovers, "retry_exhausted",
+                                    tenant, cls)
                         return
                     self._count_retry(trace_id, b)
                     self._sleep_jittered(retries)
@@ -862,7 +1017,9 @@ class Router:
                      trace_id: int, prompt: np.ndarray,
                      delivered: List[int], max_new: int,
                      eos: Optional[int], temp: float, seed: int,
-                     base_offset: int) -> None:
+                     base_offset: int,
+                     tenant_cls: Optional[Tuple[str, str]] = None
+                     ) -> None:
         """One backend attempt. Forwards chunks as they arrive and
         appends them to ``delivered`` (the failover resume state).
         Raises the attempt's infra/application error; returns on the
@@ -887,10 +1044,17 @@ class Router:
                               connect_timeout_s=_CONNECT_TIMEOUT_S,
                               deadline_s=self._backend_deadline_s(),
                               max_reconnects=0, traced=False)
+            # forward the tenant descriptor only when the inbound
+            # frame carried one, so tenant-less traffic stays
+            # byte-identical end to end
+            tkw = {} if tenant_cls is None else {
+                "tenant": tenant_cls[0],
+                "priority_class": tenant_cls[1]}
             for chunk in cli.generate_stream(
                     full_prompt, max_new_tokens=remaining,
                     eos_token_id=eos, temperature=temp, seed=seed,
-                    trace_id=trace_id or None, sample_offset=offset):
+                    trace_id=trace_id or None, sample_offset=offset,
+                    **tkw):
                 toks = [int(t) for t in np.asarray(chunk).reshape(-1)]
                 try:
                     self._reply(conn, wlock, tag, 1,
@@ -908,8 +1072,10 @@ class Router:
     # -- shed / counters / tracing ----------------------------------------
 
     def _shed(self, conn, wlock, tag: int, trace_id: int,
-              hints: List[int]) -> None:
+              hints: List[int], tenant: str = "") -> None:
+        from . import tenancy
         hint = max(hints)
+        label = tenancy.tenant_label(tenant or tenancy.DEFAULT_TENANT)
         with self._lock:
             self._counts["shed"] += 1
         from .. import observability as obs
@@ -918,9 +1084,12 @@ class Router:
             obs.counter("router_shed_total",
                         "streams refused at the router door because "
                         "every backend was saturated (the reply "
-                        "carries the max retry_after_ms hint)").inc()
+                        "carries the max retry_after_ms hint); "
+                        "tenant= is the bounded tenant label, "
+                        "default for tenant-less frames"
+                        ).inc(tenant=label)
         _flight.record("router_shed", trace_id=trace_id,
-                       retry_after_ms=hint)
+                       retry_after_ms=hint, tenant=label)
         self._reply(conn, wlock, tag, -1,
                     f"router: all backends saturated: "
                     f"retry_after_ms={hint}".encode())
@@ -969,7 +1138,8 @@ class Router:
     def _trace(self, trace_id: int, ingress_unix: float,
                dispatch_unix: Optional[float], backend: str,
                delivered: List[int], retries: int, failovers: int,
-               outcome: str) -> None:
+               outcome: str, tenant: str = "",
+               cls: str = "") -> None:
         """Per-hop reqtrace span riding the client's trace id: joins
         against the backend's own span for the same id, making the
         router hop visible in tools/serving_report.py."""
@@ -981,7 +1151,7 @@ class Router:
             "reply_unix": time.time(),
             "backend": backend, "tokens": len(delivered),
             "retries": retries, "failovers": failovers,
-            "outcome": outcome})
+            "outcome": outcome, "tenant": tenant, "cls": cls})
 
     # -- stats / snapshot -------------------------------------------------
 

@@ -7,10 +7,13 @@ lifecycle:
 * ``admit`` parses the generate body (``<IIfI`` header —
   max_new_tokens, eos id with ``0xFFFFFFFF`` meaning none,
   temperature, seed — followed by one int32 prompt tensor in the
-  standard tensor codec, plus an optional single-int32 resume-offset
-  tensor for streams resumed after a router failover;
-  docs/serving_protocol.md "Streaming generation" and "Stream
-  failover & resume") and registers the sequence with the engine;
+  standard tensor codec, plus two dtype-disambiguated optional
+  tails in any order: a single-int32 resume-offset tensor for
+  streams resumed after a router failover and a uint8 tenant
+  descriptor carrying ``tenant \\x00 class``;
+  docs/serving_protocol.md "Streaming generation", "Stream
+  failover & resume" and "Tenant descriptor") and registers the
+  sequence with the engine;
 * ``step`` runs one engine step and turns its token events into
   status-1 reply chunks on the request's tag, the finish event into
   the terminal status-0 frame, and a failed chunk write (client gone)
@@ -33,6 +36,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from . import tenancy
 from .engine import LLMEngine
 
 __all__ = ["LLMStreamBridge", "GENERATE_HEADER", "EOS_NONE"]
@@ -78,25 +82,38 @@ class LLMStreamBridge:
                     "generate body must carry an int32 [T] prompt "
                     "tensor first")
             sample_offset = 0
-            if len(arrs) == 2:
-                # resumed stream (docs/serving_protocol.md, "Stream
-                # failover & resume"): the prompt already carries the
-                # delivered tokens; the second tensor shifts the
-                # position-keyed sampler past them
-                if arrs[1].dtype != np.int32 or arrs[1].size != 1:
+            offset_seen = descriptor_seen = False
+            tenant, cls = (tenancy.DEFAULT_TENANT,
+                           tenancy.DEFAULT_CLASS)
+            # the two optional tails are disambiguated by dtype and
+            # compose in any order: int32 [1] resume offset ("Stream
+            # failover & resume"), uint8 tenant descriptor ("Tenant
+            # descriptor"); old frames carry neither
+            for arr in arrs[1:]:
+                if arr.dtype == np.int32 and arr.size == 1 \
+                        and not offset_seen:
+                    # resumed stream: the prompt already carries the
+                    # delivered tokens; this shifts the position-keyed
+                    # sampler past them
+                    sample_offset = int(arr.reshape(-1)[0])
+                    offset_seen = True
+                elif arr.dtype == np.uint8 and not descriptor_seen:
+                    tenant, cls = tenancy.decode_descriptor(arr)
+                    descriptor_seen = True
+                else:
                     raise ValueError(
-                        "resume offset must be a single int32")
-                sample_offset = int(arrs[1].reshape(-1)[0])
-            elif len(arrs) != 1:
-                raise ValueError(
-                    "generate body must carry one prompt tensor plus "
-                    "at most one resume-offset tensor")
+                        "generate body must carry one prompt tensor "
+                        "plus at most one resume-offset tensor "
+                        "(int32 [1]) and one tenant descriptor "
+                        "(uint8)")
+            req["tenant"], req["class"] = tenant, cls
             seq_id = self.engine.add_request(
                 arrs[0], max_new_tokens=max_new,
                 eos_token_id=None if eos_raw == EOS_NONE else int(eos_raw),
                 temperature=temperature, seed=seed,
                 trace_id=req.get("trace_id") or 0,
-                sample_offset=sample_offset)
+                sample_offset=sample_offset,
+                tenant=tenant, priority_class=cls)
         except Exception as e:  # noqa: BLE001 — fail ONE request
             from .engine import AdmissionRejected
             outcome = "admission_rejected" \
@@ -294,6 +311,9 @@ class LLMStreamBridge:
                 rec["finish_reason"] = reason
             if error is not None:
                 rec["error"] = error
+            if "tenant" in req:  # per-tenant gap attribution
+                rec["tenant"] = req["tenant"]
+                rec["cls"] = req.get("class")
             ing = rec["ingress_unix"]
             if toks and ing is not None:
                 rec["ttft_ms"] = max(0.0, (toks[0] - ing) * 1e3)

@@ -110,6 +110,17 @@ def test_llm_serving_router():
     assert r["victim_state"] in ("draining", "open", "half_open")
 
 
+def test_llm_serving_tenants():
+    import llm_serving
+    r = llm_serving.main(n_clients=3, max_new_tokens=3, verbose=False,
+                         tenants=True)
+    # 3 bulk + 2 premium streams all finish under fair share
+    assert r["ok"] and r["bulk_clients"] == 3 and r["premium_clients"] == 2
+    assert r["premium_ttft_p50_ms"] > 0 and r["bulk_ttft_p50_ms"] > 0
+    # wire descriptors landed: per-tenant admission accounting saw both
+    assert r["admitted_prem"] >= 2 and r["admitted_bulk"] == 3
+
+
 def test_llm_serving_speculative():
     import llm_serving
     r = llm_serving.main(n_clients=2, max_new_tokens=5, verbose=False,

@@ -572,6 +572,7 @@ def test_chaos_drill_list_inventory():
     for name in ("kill_mid_save", "corrupt_leaf", "sigterm_mid_fit",
                  "crash_loop", "nonfinite_skip", "exact_resume",
                  "stream_disconnect", "llm_overload_shed",
+                 "llm_tenant_flood",
                  "llm_drain_sigterm", "llm_decode_error",
                  "llm_prefix_cow_leak", "llm_spec_rollback",
                  "llm_flight_deck", "router_backend_kill",

@@ -624,6 +624,110 @@ class _RefusingPeer(_ScriptedPeer):
 
 
 # ---------------------------------------------------------------------------
+# prefix-affinity routing: shared-prefix traffic converges on one box
+# ---------------------------------------------------------------------------
+
+class TestPrefixAffinity:
+    PREFIX = [5, 9, 2, 7, 3, 1, 4, 6]        # two full 4-token blocks
+
+    def _wave(self, model, affinity):
+        """Four concurrent shared-prefix streams through a 2-backend
+        router; each stream is held mid-flight (paced decode) while
+        the next starts, so the prefix is resident when later
+        arrivals allocate. Returns (prompts, outputs, prefix-hit
+        token delta)."""
+        # the router derives affinity keys from FLAGS_kv_block_size —
+        # it must mirror the engines' block_size=4 or every prompt is
+        # shorter than one "block" and the keys come back empty
+        pt.set_flags({"kv_prefix_sharing": True,
+                      "kv_block_size": 4,
+                      "router_prefix_affinity": affinity,
+                      "router_retry_backoff_s": 0.0})
+        eng_a = LLMEngine(model, block_size=4, pool_blocks=32)
+        eng_b = LLMEngine(model, block_size=4, pool_blocks=32)
+        srv_a = Server(None, llm_engine=eng_a)
+        srv_b = Server(None, llm_engine=eng_b)
+        router = Router([("127.0.0.1", srv_a.port),
+                         ("127.0.0.1", srv_b.port)],
+                        start_probes=False).start()
+        prompts = [self.PREFIX + [10 + i] for i in range(4)]
+        outs = {}
+        try:
+            # warm the compile caches off the clock (and off the
+            # counter: snapshot after)
+            with Client(port=srv_a.port, timeout_s=60.0,
+                        deadline_s=60.0) as warm:
+                _drain_tokens(warm.generate_stream(
+                    prompts[0], max_new_tokens=2, temperature=0.0))
+            before = obs.counter("kv_prefix_hit_tokens_total").total()
+            # pacing bounds the residence window: after stream i's
+            # first chunk it stays resident ~(max_new-1) x 300 ms,
+            # and only the NEXT stream's start must fit inside that
+            # (any earlier resident stream donates the prefix) — 3 s
+            # of slack per leg holds even on a loaded 1-CPU runner
+            pt.set_flags({"fault_spec": "llm_decode:sleep=300"})
+            try:
+                clis = [Client(port=router.port, timeout_s=60.0,
+                               deadline_s=60.0) for _ in prompts]
+                gens = []
+                for cli, p in zip(clis, prompts):
+                    g = cli.generate_stream(p, max_new_tokens=12,
+                                            temperature=0.0)
+                    # first chunk read => this stream's blocks are
+                    # resident before the next stream allocates
+                    first = next(g)
+                    gens.append((p, [int(t) for t in
+                                     np.asarray(first).ravel()], g))
+                for p, got, g in gens:
+                    got.extend(_drain_tokens(g))
+                    outs[tuple(p)] = got
+            finally:
+                pt.set_flags({"fault_spec": ""})
+                for cli in clis:
+                    cli.close()
+            hits = obs.counter(
+                "kv_prefix_hit_tokens_total").total() - before
+            return prompts, outs, hits
+        finally:
+            router.stop()
+            srv_a.stop()
+            srv_b.stop()
+
+    def test_affinity_beats_round_robin_with_exact_parity(
+            self, model, metrics_on):
+        """With FLAGS_router_prefix_affinity on, all shared-prefix
+        streams land on the backend already holding the prefix —
+        strictly more kv_prefix_hit_tokens_total than round-robin
+        spraying them over both — and routing never changes tokens
+        (bitwise parity with a direct backend run)."""
+        prev = pt.get_flags(["kv_block_size", "kv_prefix_sharing",
+                             "router_prefix_affinity"])
+        try:
+            prompts, rr_outs, rr_hits = self._wave(model, affinity=False)
+            obs.reset_all()
+            pt.set_flags({"enable_metrics": True})
+            _, aff_outs, aff_hits = self._wave(model, affinity=True)
+            # round-robin over 2 backends: only within-backend arrivals
+            # can share; affinity converges every stream on one backend
+            assert aff_hits > rr_hits, (aff_hits, rr_hits)
+            # exact token parity with direct (router-less) generation
+            eng = LLMEngine(model, block_size=4, pool_blocks=32)
+            srv = Server(None, llm_engine=eng)
+            try:
+                with Client(port=srv.port, timeout_s=60.0,
+                            deadline_s=60.0) as direct:
+                    for p in prompts:
+                        ref = _drain_tokens(direct.generate_stream(
+                            p, max_new_tokens=12, temperature=0.0))
+                        assert aff_outs[tuple(p)] == ref
+                        assert rr_outs[tuple(p)] == ref
+            finally:
+                srv.stop()
+        finally:
+            pt.set_flags(prev)
+
+
+# ---------------------------------------------------------------------------
 # CLI self-test: the CI hook (subprocess, two real backends)
 # ---------------------------------------------------------------------------
 

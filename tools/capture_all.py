@@ -88,6 +88,10 @@ STAGES = {
     # Both flags are [assumed off] until these land on-chip numbers.
     "llm_prefix_reuse": (["llm_prefix_reuse"], _SKIP, 600),
     "llm_mixed_prefill": (["llm_mixed_prefill"], _SKIP, 600),
+    # multi-tenant isolation: premium TTFT p99 under a weight-1 bulk
+    # flood with fair share on — the loaded/unloaded ratio the
+    # llm_tenant_flood chaos drill gates at 1.25x
+    "llm_tenant_flood": (["llm_tenant_flood"], _SKIP, 600),
     # speculative decoding (self-draft sanity config): accepted
     # tokens/s vs non-speculative, accept-rate + verify-latency
     # partials. FLAGS_speculative_k is [assumed off] until this lands

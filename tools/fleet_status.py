@@ -153,7 +153,55 @@ def _render_router(addr: str) -> None:
                                    for v, w in zip(row, widths)))
 
 
-def render(addr: str, stacks: bool = False, router: bool = False) -> int:
+def _label_sums(view: Dict[str, Any], name: str,
+                label: str) -> Dict[str, float]:
+    """label value -> summed series value for one merged metric
+    (series without the label are skipped; extra labels like kind=
+    are summed over)."""
+    ent = (view.get("metrics") or {}).get(name) or {}
+    out: Dict[str, float] = {}
+    for s in ent.get("series", []):
+        v = (s.get("labels") or {}).get(label)
+        if v is not None:
+            out[str(v)] = out.get(str(v), 0.0) + float(s["value"])
+    return out
+
+
+def _render_tenants(view: Dict[str, Any]) -> None:
+    """The ``--tenants`` traffic table: per-tenant admitted/active/
+    rejected/shed totals from the merged fleet metrics. Tenant labels
+    are the bounded ones from serving_llm/tenancy.py (verbatim up to
+    FLAGS_tenant_label_max, overflow-NN buckets beyond)."""
+    admitted = _label_sums(view, "llm_tenant_admitted_total", "tenant")
+    active = _label_sums(view, "llm_tenant_active", "tenant")
+    rejected = _label_sums(view, "llm_admission_rejected_total",
+                           "tenant")
+    shed = _label_sums(view, "requests_shed_total", "tenant")
+    door = _label_sums(view, "router_shed_total", "tenant")
+    tenants = sorted(set(admitted) | set(active) | set(rejected)
+                     | set(shed) | set(door))
+    if not tenants:
+        print("tenants: no tenant-labeled serving traffic yet")
+        return
+    print(f"tenants: {len(tenants)} label(s) across the fleet")
+    cols = ("tenant", "admitted", "active", "rejected", "shed",
+            "door shed")
+    rows = [(t,
+             f"{admitted.get(t, 0.0):.0f}",
+             f"{active.get(t, 0.0):.0f}",
+             f"{rejected.get(t, 0.0):.0f}",
+             f"{shed.get(t, 0.0):.0f}",
+             f"{door.get(t, 0.0):.0f}") for t in tenants]
+    widths = [max(len(c), *(len(r[i]) for r in rows))
+              for i, c in enumerate(cols)]
+    print("  " + "  ".join(c.ljust(w) for c, w in zip(cols, widths)))
+    for r in rows:
+        print("  " + "  ".join(v.ljust(w)
+                               for v, w in zip(r, widths)))
+
+
+def render(addr: str, stacks: bool = False, router: bool = False,
+           tenants: bool = False) -> int:
     """Print the fleet table; exit 0 healthy, 1 degraded/unreachable."""
     try:
         _, view = _get(addr, "/fleet?format=json")
@@ -206,6 +254,8 @@ def render(addr: str, stacks: bool = False, router: bool = False) -> int:
         print("  ".join(v.ljust(w) for v, w in zip(r, widths)))
     if router:
         _render_router(addr)
+    if tenants:
+        _render_tenants(view)
     if view.get("merge_error"):
         print(f"MERGE ERROR: {view['merge_error']}", file=sys.stderr)
         return 1
@@ -229,6 +279,12 @@ pt.set_flags({"enable_metrics": True, "fleet_push_interval_s": 0.15})
 obs_server.start(0)
 obs.counter("fleet_selftest_total").inc(rank + 1)
 obs.counter("fleet_selftest_total").inc(10, route="labeled")
+# per-tenant serving traffic for the --tenants table: every worker
+# admits for "acme", rank 0 also sheds one "bulkco" request
+obs.counter("llm_tenant_admitted_total").inc(rank + 1, tenant="acme")
+obs.gauge("llm_tenant_active").set(1.0, tenant="acme")
+if rank == 0:
+    obs.counter("llm_admission_rejected_total").inc(tenant="bulkco")
 obs.gauge("fleet_selftest_gauge").set(float(rank))
 obs.histogram("fleet_selftest_ms",
               buckets=obs.metrics.LATENCY_MS_BUCKETS
@@ -394,7 +450,16 @@ def self_test() -> int:
         # degrades to a one-liner instead of erroring
         code, rt = _get(addr, "/router")
         assert code == 200 and rt["routers"] == [], rt
-        render(addr, stacks=True, router=True)
+        # --tenants table: the merged view sums the per-tenant series
+        # across hosts (1+2+3 admitted for acme, one bulkco reject)
+        _, view = _get(addr, "/fleet?format=json")
+        adm = _label_sums(view, "llm_tenant_admitted_total", "tenant")
+        assert adm.get("acme") == 6.0, adm
+        rej = _label_sums(view, "llm_admission_rejected_total",
+                          "tenant")
+        assert rej.get("bulkco") == 1.0, rej
+        print("--tenants: per-tenant series merged across hosts")
+        render(addr, stacks=True, router=True, tenants=True)
     finally:
         for p in workers:
             if p.poll() is None:
@@ -423,6 +488,10 @@ def main(argv=None) -> int:
     ap.add_argument("--router", action="store_true",
                     help="add the front-door router backend-pool "
                          "table (the exporter's GET /router snapshot)")
+    ap.add_argument("--tenants", action="store_true",
+                    help="add the per-tenant serving traffic table "
+                         "(admitted/active/rejected/shed from the "
+                         "merged fleet metrics)")
     ap.add_argument("--self-test", action="store_true")
     args = ap.parse_args(argv)
     if args.self_test:
@@ -434,11 +503,13 @@ def main(argv=None) -> int:
         try:
             while True:
                 print("\033[2J\033[H", end="")
-                render(addr, stacks=args.stacks, router=args.router)
+                render(addr, stacks=args.stacks, router=args.router,
+                       tenants=args.tenants)
                 time.sleep(args.watch)
         except KeyboardInterrupt:
             return 0
-    return render(addr, stacks=args.stacks, router=args.router)
+    return render(addr, stacks=args.stacks, router=args.router,
+                  tenants=args.tenants)
 
 
 if __name__ == "__main__":
